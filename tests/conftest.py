@@ -1,0 +1,28 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's DistributedQueryRunner trick (N workers in one JVM,
+testing/trino-testing/.../DistributedQueryRunner.java:84): N logical TPU
+workers are N XLA host devices in one process.  Real-TPU runs happen only in
+bench.py.
+"""
+
+import os
+
+# Must be set before jax initializes its backends.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

@@ -1,0 +1,134 @@
+"""Columnar core tests (mirrors reference spi/block + Page tests,
+core/trino-spi/src/test/java/io/trino/spi/block/)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.columnar import (
+    Batch,
+    Column,
+    RowBatchBuilder,
+    StringDictionary,
+    batch_from_rows,
+)
+from trino_tpu.columnar.builders import pad_batch
+from trino_tpu.columnar.batch import concat_batches
+from trino_tpu.columnar.dictionary import union_dictionaries
+from decimal import Decimal
+
+
+def test_types_parse_roundtrip():
+    for s in ["bigint", "integer", "double", "boolean", "date", "varchar",
+              "varchar(25)", "decimal(12,2)", "char(1)", "timestamp"]:
+        t = T.parse_type(s)
+        assert t.name == s or s in ("varchar",) or t.name.startswith(s.split("(")[0])
+    assert T.parse_type("decimal(12,2)").scale == 2
+    assert T.parse_type("varchar(25)").length == 25
+
+
+def test_common_super_type():
+    assert T.common_super_type(T.INTEGER, T.BIGINT) == T.BIGINT
+    assert T.common_super_type(T.BIGINT, T.DOUBLE) == T.DOUBLE
+    assert T.common_super_type(T.UNKNOWN, T.DATE) == T.DATE
+    d = T.common_super_type(T.DecimalType(12, 2), T.DecimalType(10, 4))
+    assert isinstance(d, T.DecimalType) and d.scale == 4
+    assert T.common_super_type(T.DecimalType(12, 2), T.BIGINT).scale == 2
+
+
+def test_dictionary_order_preserving():
+    d = StringDictionary.from_unsorted(["pear", "apple", "fig"])
+    assert d.values == ("apple", "fig", "pear")
+    assert d.code_of("fig") == 1
+    codes = d.encode(["pear", "apple"])
+    assert codes.tolist() == [2, 0]
+    assert d.decode(codes) == ["pear", "apple"]
+    # order preserving: code order == lexicographic order
+    assert d.code_of("apple") < d.code_of("fig") < d.code_of("pear")
+    assert d.lower_bound("b") == 1 and d.upper_bound("fig") == 2
+    tbl = d.predicate_table(lambda v: "p" in v)
+    assert tbl.tolist() == [True, False, True]
+
+
+def test_dictionary_union():
+    a = StringDictionary(["a", "c"])
+    b = StringDictionary(["b", "c"])
+    m, ra, rb = union_dictionaries(a, b)
+    assert m.values == ("a", "b", "c")
+    assert ra.tolist() == [0, 2] and rb.tolist() == [1, 2]
+
+
+def test_batch_builder_and_pylist():
+    b = (
+        RowBatchBuilder([T.BIGINT, T.VARCHAR, T.DecimalType(10, 2)])
+        .row(1, "x", Decimal("1.50"))
+        .row(2, None, Decimal("2.25"))
+        .row(3, "y", None)
+        .build()
+    )
+    assert b.capacity == 3 and b.width == 3
+    rows = b.to_pylist()
+    assert rows[0] == [1, "x", Decimal("1.50")]
+    assert rows[1][1] is None
+    assert rows[2][2] is None
+
+
+def test_batch_filter_and_compact():
+    b = batch_from_rows(
+        [T.BIGINT, T.DOUBLE], [[i, float(i) * 0.5] for i in range(10)]
+    ).device_put()
+    keep = jnp.asarray(np.arange(10) % 3 == 0)
+    fb = b.filter(keep)
+    assert fb.num_rows_host() == 4
+    cb = fb.compact_device()
+    assert cb.capacity == 10
+    assert cb.num_rows_host() == 4
+    rows = cb.to_pylist()
+    assert [r[0] for r in rows] == [0, 3, 6, 9]
+    # compact into a smaller capacity
+    cb2 = fb.compact_device(out_capacity=6)
+    assert cb2.capacity == 6
+    assert [r[0] for r in cb2.to_pylist()] == [0, 3, 6, 9]
+
+
+def test_batch_compact_under_jit():
+    b = batch_from_rows([T.BIGINT], [[i] for i in range(8)]).device_put()
+
+    @jax.jit
+    def f(batch):
+        fb = batch.filter(batch.columns[0].data % 2 == 1)
+        return fb.compact_device()
+
+    out = f(b)
+    assert [r[0] for r in out.to_pylist()] == [1, 3, 5, 7]
+
+
+def test_batch_gather_pytree_and_pad():
+    b = batch_from_rows([T.BIGINT, T.VARCHAR], [[1, "a"], [2, "b"], [3, "c"]])
+    g = b.gather(np.array([2, 0]))
+    assert g.to_pylist() == [[3, "c"], [1, "a"]]
+    leaves, treedef = jax.tree_util.tree_flatten(b)
+    b2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert b2.columns[1].dictionary is b.columns[1].dictionary
+    pb = pad_batch(b, 7)
+    assert pb.capacity == 7 and pb.num_rows_host() == 3
+    assert pb.to_pylist() == b.to_pylist()
+
+
+def test_concat_batches():
+    b1 = batch_from_rows([T.BIGINT], [[1], [2]])
+    b2 = batch_from_rows([T.BIGINT], [[3], [4]]).filter(np.array([True, False]))
+    cb = concat_batches([b1.device_put(), b2.device_put()])
+    assert cb.capacity == 4
+    assert [r[0] for r in cb.to_pylist()] == [1, 2, 3]
+
+
+def test_column_null_handling():
+    c = Column.from_numpy(
+        np.array([1, 2, 3]), T.BIGINT, valid=np.array([True, False, True])
+    )
+    assert c.to_pylist() == [1, None, 3]
+    g = c.gather(jnp.asarray([1, 1, 0]))
+    assert g.to_pylist() == [None, None, 1]

@@ -1,0 +1,31 @@
+"""Device-resident columnar data model — the Page/Block analog.
+
+Reference roles:
+  - spi/Page.java:31        -> Batch (a bundle of equal-length columns)
+  - spi/block/Block.java    -> Column (values + validity mask)
+  - DictionaryBlock         -> order-preserving StringDictionary + i32 codes
+  - RowPagesBuilder (tests) -> builders.RowBatchBuilder
+
+Design: batches are fixed-capacity struct-of-arrays with boolean row masks so
+that every downstream computation is shape-stable under jit.  Selection never
+reallocates on device; it ANDs masks.  Compaction happens only at exchange /
+result boundaries.
+"""
+
+from trino_tpu.columnar.dictionary import StringDictionary
+from trino_tpu.columnar.column import Column
+from trino_tpu.columnar.batch import Batch
+from trino_tpu.columnar.builders import (
+    RowBatchBuilder,
+    batch_from_arrays,
+    batch_from_rows,
+)
+
+__all__ = [
+    "StringDictionary",
+    "Column",
+    "Batch",
+    "RowBatchBuilder",
+    "batch_from_arrays",
+    "batch_from_rows",
+]

@@ -1,0 +1,158 @@
+"""Batch: the engine's Page (reference: spi/Page.java:31).
+
+A Batch is a tuple of equal-capacity Columns plus an optional boolean row mask.
+Filtering ANDs the mask (never reallocates on device); operators that need
+dense input (exchange partitioning, result rendering) compact explicitly.
+Positional channels, not names — the planner tracks symbols->channels exactly
+like the reference's LocalExecutionPlanner layout mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu.columnar.column import Column
+
+
+class Batch:
+    __slots__ = ("columns", "row_mask")
+
+    def __init__(self, columns: Sequence[Column], row_mask=None):
+        self.columns = tuple(columns)
+        self.row_mask = row_mask  # None => all rows live
+
+    # -- shape ---------------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        if self.columns:
+            return self.columns[0].capacity
+        if self.row_mask is not None:
+            return self.row_mask.shape[0]
+        return 0
+
+    @property
+    def width(self) -> int:
+        return len(self.columns)
+
+    def mask(self):
+        """Materialized live-row mask, shape [capacity]."""
+        if self.row_mask is None:
+            return jnp.ones(self.capacity, dtype=bool)
+        return self.row_mask
+
+    def count(self):
+        """Device scalar: number of live rows."""
+        if self.row_mask is None:
+            return jnp.asarray(self.capacity, dtype=jnp.int64)
+        return jnp.sum(self.row_mask, dtype=jnp.int64)
+
+    # -- transforms ----------------------------------------------------------
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def with_columns(self, columns: Sequence[Column]) -> "Batch":
+        return Batch(columns, self.row_mask)
+
+    def append_column(self, col: Column) -> "Batch":
+        return Batch(self.columns + (col,), self.row_mask)
+
+    def project(self, channels: Sequence[int]) -> "Batch":
+        return Batch([self.columns[i] for i in channels], self.row_mask)
+
+    def filter(self, keep_mask) -> "Batch":
+        """AND a boolean mask into the live-row mask."""
+        if self.row_mask is None:
+            return Batch(self.columns, keep_mask)
+        return Batch(self.columns, jnp.logical_and(self.row_mask, keep_mask))
+
+    def gather(self, indices, valid=None) -> "Batch":
+        """Row gather; `valid` marks which gathered slots are live."""
+        cols = [c.gather(indices) for c in self.columns]
+        if valid is None and self.row_mask is not None:
+            valid = jnp.take(self.row_mask, indices, axis=0, mode="clip")
+        return Batch(cols, valid)
+
+    def compact_device(self, out_capacity: Optional[int] = None) -> "Batch":
+        """Pack live rows to the front (stable) via cumsum-scatter.
+
+        Shape-stable: output capacity is static (`out_capacity` or input
+        capacity); trailing slots are dead.  This is the selection-vector ->
+        dense step the reference does in PageProcessor output.
+        """
+        cap = self.capacity
+        outc = out_capacity or cap
+        m = self.mask()
+        pos = jnp.cumsum(m) - 1  # target slot per live row
+        idx = jnp.where(m, pos, outc)  # dead rows scatter out of range
+        n = jnp.sum(m)
+        # inverse permutation: for each output slot, which input row
+        inv = jnp.zeros(outc + 1, dtype=jnp.int64).at[idx].set(
+            jnp.arange(cap, dtype=jnp.int64), mode="drop"
+        )[:outc]
+        live = jnp.arange(outc, dtype=jnp.int64) < n
+        cols = [c.gather(inv) for c in self.columns]
+        return Batch(cols, live)
+
+    # -- host-side -----------------------------------------------------------
+
+    def device_put(self, device=None) -> "Batch":
+        return jax.device_put(self, device)
+
+    def block_until_ready(self) -> "Batch":
+        for c in self.columns:
+            if hasattr(c.data, "block_until_ready"):
+                c.data.block_until_ready()
+        return self
+
+    def num_rows_host(self) -> int:
+        if self.row_mask is None:
+            return self.capacity
+        return int(np.asarray(jnp.sum(self.row_mask)))
+
+    def to_pylist(self) -> list[list]:
+        """Rows of python values (live rows only, in order)."""
+        rm = None if self.row_mask is None else np.asarray(self.row_mask)
+        cols = [c.to_pylist(rm) for c in self.columns]
+        return [list(r) for r in zip(*cols)] if cols else []
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Batch(cap={self.capacity}, width={self.width})"
+
+
+def _batch_flatten(b: Batch):
+    return (b.columns, b.row_mask), None
+
+
+def _batch_unflatten(aux, children):
+    columns, row_mask = children
+    return Batch(columns, row_mask)
+
+
+jax.tree_util.register_pytree_node(Batch, _batch_flatten, _batch_unflatten)
+
+
+def concat_batches(batches: Sequence[Batch]) -> Batch:
+    """Host-side concat (used by accumulating operators between jit steps)."""
+    assert batches
+    width = batches[0].width
+    cols = []
+    for ch in range(width):
+        parts = [b.columns[ch] for b in batches]
+        data = jnp.concatenate([p.data for p in parts])
+        if any(p.valid is not None for p in parts):
+            valid = jnp.concatenate([p.valid_mask() for p in parts])
+        else:
+            valid = None
+        c0 = parts[0]
+        cols.append(Column(data, c0.type, valid, c0.dictionary))
+    if any(b.row_mask is not None for b in batches):
+        mask = jnp.concatenate([b.mask() for b in batches])
+    else:
+        mask = None
+    return Batch(cols, mask)

@@ -1,0 +1,122 @@
+"""Batch builders — the test/ingest-side mirror of RowPagesBuilder.
+
+Reference role: core/trino-main/src/test/java/io/trino/RowPagesBuilder.java and
+the connector-side PageBuilder (spi/PageBuilder.java): turn row-oriented host
+data (python rows, numpy arrays, pandas frames) into device Batches.
+"""
+
+from __future__ import annotations
+
+import datetime
+from decimal import Decimal
+from typing import Optional, Sequence
+
+import numpy as np
+
+from trino_tpu.types import (
+    Type,
+    DecimalType,
+    DATE,
+    TIMESTAMP,
+    is_string_kind,
+)
+from trino_tpu.columnar.column import Column
+from trino_tpu.columnar.batch import Batch
+from trino_tpu.columnar.dictionary import StringDictionary
+
+_EPOCH_DATE = datetime.date(1970, 1, 1)
+_EPOCH_TS = datetime.datetime(1970, 1, 1)
+
+
+def _to_device_scalar(v, t: Type):
+    if isinstance(t, DecimalType):
+        if isinstance(v, Decimal):
+            return int(v.scaleb(t.scale).to_integral_value())
+        return int(round(float(v) * t.scale_factor))
+    if t is DATE and isinstance(v, datetime.date):
+        return (v - _EPOCH_DATE).days
+    if t is TIMESTAMP and isinstance(v, datetime.datetime):
+        return int((v - _EPOCH_TS).total_seconds() * 1_000_000)
+    return v
+
+
+def column_from_values(values: Sequence, t: Type) -> Column:
+    n = len(values)
+    valid_list = [v is not None for v in values]
+    has_nulls = not all(valid_list)
+    valid = np.array(valid_list, dtype=bool) if has_nulls else None
+    if is_string_kind(t) or (t.is_dictionary_encoded):
+        present = sorted({v for v in values if v is not None})
+        d = StringDictionary(present)
+        codes = d.encode([v if v is not None else None for v in values])
+        return Column(codes, t, valid, d)
+    arr = np.zeros(n, dtype=t.np_dtype)
+    for i, v in enumerate(values):
+        if v is not None:
+            arr[i] = _to_device_scalar(v, t)
+    return Column(arr, t, valid)
+
+
+def batch_from_rows(types: Sequence[Type], rows: Sequence[Sequence]) -> Batch:
+    cols = []
+    for ch, t in enumerate(types):
+        cols.append(column_from_values([r[ch] for r in rows], t))
+    return Batch(cols)
+
+
+def batch_from_arrays(
+    arrays: Sequence[np.ndarray],
+    types: Sequence[Type],
+    valids: Optional[Sequence[Optional[np.ndarray]]] = None,
+    dictionaries: Optional[Sequence[Optional[StringDictionary]]] = None,
+) -> Batch:
+    cols = []
+    for i, (a, t) in enumerate(zip(arrays, types)):
+        valid = valids[i] if valids else None
+        d = dictionaries[i] if dictionaries else None
+        cols.append(Column.from_numpy(a, t, valid, d))
+    return Batch(cols)
+
+
+class RowBatchBuilder:
+    """Append rows, then build a (optionally padded) Batch."""
+
+    def __init__(self, types: Sequence[Type]):
+        self.types = list(types)
+        self.rows: list[list] = []
+
+    def row(self, *values) -> "RowBatchBuilder":
+        assert len(values) == len(self.types)
+        self.rows.append(list(values))
+        return self
+
+    def build(self, capacity: Optional[int] = None) -> Batch:
+        b = batch_from_rows(self.types, self.rows)
+        if capacity is None or capacity == len(self.rows):
+            return b
+        return pad_batch(b, capacity)
+
+
+def pad_batch(b: Batch, capacity: int) -> Batch:
+    """Pad to a larger static capacity; padded rows are dead."""
+    n = b.capacity
+    assert capacity >= n, (capacity, n)
+    if capacity == n:
+        return b
+    pad = capacity - n
+    cols = []
+    for c in b.columns:
+        data = np.concatenate(
+            [np.asarray(c.data), np.zeros(pad, dtype=c.type.np_dtype)]
+        )
+        valid = None
+        if c.valid is not None:
+            valid = np.concatenate([np.asarray(c.valid), np.zeros(pad, dtype=bool)])
+        cols.append(Column(data, c.type, valid, c.dictionary))
+    mask = np.concatenate(
+        [
+            np.asarray(b.mask()),
+            np.zeros(pad, dtype=bool),
+        ]
+    )
+    return Batch(cols, mask)

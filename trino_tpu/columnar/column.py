@@ -1,0 +1,162 @@
+"""Column: a typed device array plus optional validity mask.
+
+Reference role: spi/block/Block.java (and its 70 concrete blocks).  Where the
+reference has per-encoding block classes (RunLength, Dictionary, VariableWidth,
+...), the device representation is always dense fixed-width values; dictionary
+encoding lives in the Column's `dictionary` metadata, and RLE is simply a
+broadcasted array (XLA folds it).
+
+Column is a registered pytree so it can flow through jit boundaries: the
+arrays are leaves, the (type, dictionary) pair is static aux data — changing a
+dictionary identity therefore retraces, which is what we want since host-side
+predicate tables are baked per dictionary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu.types import Type
+from trino_tpu.columnar.dictionary import StringDictionary
+
+
+class Column:
+    __slots__ = ("data", "valid", "type", "dictionary")
+
+    def __init__(
+        self,
+        data,
+        type: Type,
+        valid=None,
+        dictionary: Optional[StringDictionary] = None,
+    ):
+        self.data = data
+        self.type = type
+        self.valid = valid  # None => no nulls
+        self.dictionary = dictionary
+
+    # -- shape ---------------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def may_have_nulls(self) -> bool:
+        return self.valid is not None
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_numpy(
+        cls,
+        values: np.ndarray,
+        type: Type,
+        valid: Optional[np.ndarray] = None,
+        dictionary: Optional[StringDictionary] = None,
+    ) -> "Column":
+        data = np.asarray(values, dtype=type.np_dtype)
+        v = None if valid is None else np.asarray(valid, dtype=bool)
+        return cls(data, type, v, dictionary)
+
+    @classmethod
+    def from_strings(cls, values, type: Type) -> "Column":
+        """Encode python strings (None allowed) into a fresh dictionary."""
+        present = [v for v in values if v is not None]
+        d = StringDictionary.from_unsorted(present)
+        codes = d.encode(values)
+        valid = None
+        if len(present) != len(values):
+            valid = np.fromiter(
+                (v is not None for v in values), dtype=bool, count=len(values)
+            )
+        return cls(codes, type, valid, d)
+
+    # -- transforms (device-safe, shape preserving) --------------------------
+
+    def with_valid(self, valid) -> "Column":
+        return Column(self.data, self.type, valid, self.dictionary)
+
+    def gather(self, indices) -> "Column":
+        data = jnp.take(self.data, indices, axis=0, mode="clip")
+        valid = (
+            None
+            if self.valid is None
+            else jnp.take(self.valid, indices, axis=0, mode="clip")
+        )
+        return Column(data, self.type, valid, self.dictionary)
+
+    def valid_mask(self):
+        """Always-materialized bool mask (shape [capacity])."""
+        if self.valid is None:
+            return jnp.ones(self.data.shape[0], dtype=bool)
+        return self.valid
+
+    # -- host-side materialization ------------------------------------------
+
+    def to_numpy(self) -> tuple[np.ndarray, Optional[np.ndarray]]:
+        data = np.asarray(self.data)
+        valid = None if self.valid is None else np.asarray(self.valid)
+        return data, valid
+
+    def to_pylist(self, row_mask: Optional[np.ndarray] = None) -> list:
+        """Decode to python objects (strings/decimals rendered)."""
+        from trino_tpu.types import DecimalType, DATE, TIMESTAMP
+
+        data, valid = self.to_numpy()
+        n = data.shape[0]
+        if row_mask is None:
+            rows = range(n)
+        else:
+            rows = np.nonzero(np.asarray(row_mask))[0]
+        out = []
+        t = self.type
+        is_dec = isinstance(t, DecimalType)
+        for i in rows:
+            if valid is not None and not valid[i]:
+                out.append(None)
+            elif self.dictionary is not None:
+                out.append(self.dictionary.values[int(data[i])])
+            elif is_dec:
+                from decimal import Decimal
+
+                out.append(Decimal(int(data[i])) / (10 ** t.scale))
+            elif t is DATE:
+                import datetime
+
+                out.append(
+                    datetime.date(1970, 1, 1) + datetime.timedelta(days=int(data[i]))
+                )
+            elif t is TIMESTAMP:
+                import datetime
+
+                out.append(
+                    datetime.datetime(1970, 1, 1)
+                    + datetime.timedelta(microseconds=int(data[i]))
+                )
+            elif np.issubdtype(data.dtype, np.floating):
+                out.append(float(data[i]))
+            elif data.dtype == np.dtype(bool):
+                out.append(bool(data[i]))
+            else:
+                out.append(int(data[i]))
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Column({self.type.name}, cap={self.data.shape[0]}, nulls={self.valid is not None})"
+
+
+def _column_flatten(c: Column):
+    return (c.data, c.valid), (c.type, c.dictionary)
+
+
+def _column_unflatten(aux, children):
+    type_, dictionary = aux
+    data, valid = children
+    return Column(data, type_, valid, dictionary)
+
+
+jax.tree_util.register_pytree_node(Column, _column_flatten, _column_unflatten)

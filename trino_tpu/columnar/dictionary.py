@@ -1,0 +1,135 @@
+"""Order-preserving string dictionaries.
+
+The engine's answer to variable-width data on a fixed-width device (reference:
+spi/block/DictionaryBlock.java + VariableWidthBlock.java): strings are encoded
+once, host-side, into i32 codes whose numeric order equals the lexicographic
+order of the values.  Device kernels then compare/sort/join on codes; only
+ingest and final result rendering touch bytes.
+
+String *functions* (LIKE, substr, ||, upper, ...) evaluate host-side over the
+dictionary (cardinality, not row count) and become device gathers through a
+code-indexed lookup table — an O(|dict|) precompute instead of an O(rows)
+scalar loop, which is exactly the trade a TPU wants.
+"""
+
+from __future__ import annotations
+
+import bisect
+from functools import cached_property
+
+import numpy as np
+
+
+class StringDictionary:
+    """Immutable sorted dictionary of strings; code == rank.
+
+    ``values`` are unique and sorted, so ``code_a < code_b`` iff
+    ``value_a < value_b``.  Null is NOT in the dictionary — nulls live in the
+    column validity mask with a device fill value of 0.
+    """
+
+    __slots__ = ("values", "_index", "_hash")
+
+    def __init__(self, values):
+        vals = tuple(values)
+        assert all(
+            vals[i] < vals[i + 1] for i in range(len(vals) - 1)
+        ), "dictionary values must be unique and sorted"
+        object.__setattr__(self, "values", vals)
+        object.__setattr__(self, "_index", None)
+        object.__setattr__(self, "_hash", None)
+
+    def __setattr__(self, name, value):  # immutability
+        raise AttributeError("StringDictionary is immutable")
+
+    @classmethod
+    def from_unsorted(cls, values) -> "StringDictionary":
+        return cls(sorted(set(values)))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def __hash__(self) -> int:
+        h = self._hash
+        if h is None:
+            h = hash(self.values)
+            object.__setattr__(self, "_hash", h)
+        return h
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, StringDictionary) and (
+            self is other or self.values == other.values
+        )
+
+    @property
+    def index(self) -> dict:
+        ix = self._index
+        if ix is None:
+            ix = {v: i for i, v in enumerate(self.values)}
+            object.__setattr__(self, "_index", ix)
+        return ix
+
+    def code_of(self, value: str) -> int:
+        """Exact-match code, -1 if absent."""
+        return self.index.get(value, -1)
+
+    def encode(self, values, out=None) -> np.ndarray:
+        """Encode an iterable of strings (None -> 0, caller tracks nulls)."""
+        ix = self.index
+        arr = np.fromiter(
+            (0 if v is None else ix[v] for v in values),
+            dtype=np.int32,
+            count=len(values),
+        )
+        return arr
+
+    def decode(self, codes: np.ndarray) -> list:
+        vals = self.values
+        return [vals[int(c)] for c in codes]
+
+    # -- range positioning for order-preserving predicates ------------------
+
+    def lower_bound(self, value: str) -> int:
+        """Smallest code whose value >= `value` (len(dict) if none)."""
+        return bisect.bisect_left(self.values, value)
+
+    def upper_bound(self, value: str) -> int:
+        """Smallest code whose value > `value`."""
+        return bisect.bisect_right(self.values, value)
+
+    def predicate_table(self, fn) -> np.ndarray:
+        """Evaluate a python predicate over every dictionary value.
+
+        Returns a bool[|dict|] lookup table; callers gather it by code on
+        device.  This is how LIKE / regexp / prefix predicates run (reference
+        role: likematcher/LikeMatcher.java, but amortized over the dictionary).
+        """
+        return np.fromiter(
+            (bool(fn(v)) for v in self.values), dtype=bool, count=len(self.values)
+        )
+
+    def map_table(self, fn, out_dictionary: "StringDictionary") -> np.ndarray:
+        """i32[|dict|] table mapping each value through a string->string fn
+        into codes of `out_dictionary` (for substr/upper/trim/|| projections)."""
+        ix = out_dictionary.index
+        return np.fromiter(
+            (ix[fn(v)] for v in self.values), dtype=np.int32, count=len(self.values)
+        )
+
+    @cached_property
+    def max_len(self) -> int:
+        return max((len(v) for v in self.values), default=0)
+
+
+def union_dictionaries(a: StringDictionary, b: StringDictionary):
+    """Merge two dictionaries; returns (merged, recode_a, recode_b) where
+    recode_x is an i32 table mapping old codes -> merged codes."""
+    if a is b or a == b:
+        n = len(a)
+        ident = np.arange(n, dtype=np.int32)
+        return a, ident, ident
+    merged = StringDictionary.from_unsorted(a.values + b.values)
+    ix = merged.index
+    ra = np.fromiter((ix[v] for v in a.values), dtype=np.int32, count=len(a))
+    rb = np.fromiter((ix[v] for v in b.values), dtype=np.int32, count=len(b))
+    return merged, ra, rb

@@ -1,0 +1,314 @@
+"""SQL type system.
+
+Role analog of the reference engine's ``spi/type/Type.java`` hierarchy
+(reference: core/trino-spi/src/main/java/io/trino/spi/type/Type.java), but
+designed around device representation: every SQL type maps to a fixed-width
+numpy/JAX dtype so that whole columns are dense device arrays.  Variable-width
+values (VARCHAR/CHAR/VARBINARY) are dictionary-encoded at ingest with
+*order-preserving* codes (see columnar.dictionary), so comparisons and sorts on
+the device operate on i32 codes directly.
+
+DECIMAL(p, s) with p <= 18 is a scaled i64 ("short decimal"), exactly like the
+reference's long-encoded short decimals (spi/type/DecimalType.java) — this keeps
+TPC-H money arithmetic in fast integer ops instead of f64.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "Type",
+    "BOOLEAN",
+    "TINYINT",
+    "SMALLINT",
+    "INTEGER",
+    "BIGINT",
+    "REAL",
+    "DOUBLE",
+    "DATE",
+    "TIMESTAMP",
+    "INTERVAL_DAY",
+    "UNKNOWN",
+    "DecimalType",
+    "VarcharType",
+    "CharType",
+    "VarbinaryType",
+    "VARCHAR",
+    "VARBINARY",
+    "ArrayType",
+    "RowType",
+    "parse_type",
+    "common_super_type",
+    "is_numeric",
+    "is_integer_kind",
+    "is_string_kind",
+]
+
+
+class Type:
+    """Base SQL type. Immutable; equality by (name, params)."""
+
+    #: SQL display name, e.g. 'bigint', 'decimal(12,2)'
+    name: str = "unknown"
+    #: numpy dtype of the device representation
+    np_dtype: np.dtype = np.dtype(np.int64)
+    #: whether ORDER BY / comparisons are defined
+    orderable: bool = True
+    comparable: bool = True
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return self.name
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Type) and self.name == other.name
+
+    def __hash__(self) -> int:
+        return hash(self.name)
+
+    @property
+    def is_dictionary_encoded(self) -> bool:
+        return isinstance(self, (VarcharType, CharType, VarbinaryType))
+
+    def null_device_value(self):
+        """Fill value used in device arrays under a null mask."""
+        if np.issubdtype(self.np_dtype, np.floating):
+            return self.np_dtype.type(0.0)
+        if self.np_dtype == np.dtype(bool):
+            return False
+        return self.np_dtype.type(0)
+
+
+class _Simple(Type):
+    def __init__(self, name: str, np_dtype, orderable: bool = True):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        self.orderable = orderable
+        self.comparable = True
+
+
+BOOLEAN = _Simple("boolean", bool)
+TINYINT = _Simple("tinyint", np.int8)
+SMALLINT = _Simple("smallint", np.int16)
+INTEGER = _Simple("integer", np.int32)
+BIGINT = _Simple("bigint", np.int64)
+REAL = _Simple("real", np.float32)
+DOUBLE = _Simple("double", np.float64)
+#: days since 1970-01-01, i32 (reference: spi/type/DateType.java)
+DATE = _Simple("date", np.int32)
+#: microseconds since epoch, i64 (reference: spi/type/TimestampType.java, p=6)
+TIMESTAMP = _Simple("timestamp", np.int64)
+#: interval day-to-second, microseconds, i64
+INTERVAL_DAY = _Simple("interval day to second", np.int64)
+
+
+class _Unknown(Type):
+    """The type of a bare NULL literal (reference: spi UnknownType)."""
+
+    def __init__(self):
+        self.name = "unknown"
+        self.np_dtype = np.dtype(np.int64)
+        self.orderable = True
+        self.comparable = True
+
+
+UNKNOWN = _Unknown()
+
+
+class DecimalType(Type):
+    """Short decimal: scaled i64. precision <= 18 enforced.
+
+    Reference: spi/type/DecimalType.java (long-encoded short decimals).
+    """
+
+    def __init__(self, precision: int = 38, scale: int = 0):
+        if precision > 18:
+            # The engine computes in i64; TPC-H/TPC-DS fit in (18, s) after the
+            # standard sum-widening clamp.
+            precision = 18
+        self.precision = precision
+        self.scale = scale
+        self.name = f"decimal({precision},{scale})"
+        self.np_dtype = np.dtype(np.int64)
+        self.orderable = True
+        self.comparable = True
+
+    @property
+    def scale_factor(self) -> int:
+        return 10 ** self.scale
+
+
+class VarcharType(Type):
+    """Dictionary-encoded string: device value is an i32 code.
+
+    Codes are *order preserving* within a single dictionary (see
+    columnar.dictionary.StringDictionary), so <, >, ORDER BY work on codes when
+    both sides share a dictionary; general cross-dictionary comparison re-encodes.
+    Reference: spi/type/VarcharType.java.
+    """
+
+    UNBOUNDED = 2**31 - 1
+
+    def __init__(self, length: int | None = None):
+        self.length = VarcharType.UNBOUNDED if length is None else length
+        self.name = (
+            "varchar"
+            if self.length == VarcharType.UNBOUNDED
+            else f"varchar({self.length})"
+        )
+        self.np_dtype = np.dtype(np.int32)
+        self.orderable = True
+        self.comparable = True
+
+
+VARCHAR = VarcharType()
+
+
+class CharType(Type):
+    """CHAR(n); same device representation as varchar (reference: spi/type/CharType.java)."""
+
+    def __init__(self, length: int):
+        self.length = length
+        self.name = f"char({length})"
+        self.np_dtype = np.dtype(np.int32)
+        self.orderable = True
+        self.comparable = True
+
+
+class VarbinaryType(Type):
+    def __init__(self):
+        self.name = "varbinary"
+        self.np_dtype = np.dtype(np.int32)
+        self.orderable = False
+        self.comparable = True
+
+
+VARBINARY = VarbinaryType()
+
+
+class ArrayType(Type):
+    """Fixed-capacity array-of-T (round-1: host-side only semantics)."""
+
+    def __init__(self, element: Type):
+        self.element = element
+        self.name = f"array({element.name})"
+        self.np_dtype = element.np_dtype
+        self.orderable = False
+        self.comparable = True
+
+
+class RowType(Type):
+    def __init__(self, fields: list[tuple[str | None, Type]]):
+        self.fields = tuple(fields)
+        inner = ", ".join(
+            (f"{n} {t.name}" if n else t.name) for n, t in self.fields
+        )
+        self.name = f"row({inner})"
+        self.np_dtype = np.dtype(np.int64)
+        self.orderable = False
+        self.comparable = True
+
+
+# ---------------------------------------------------------------------------
+# type algebra helpers
+
+
+_SIMPLE_BY_NAME = {
+    t.name: t
+    for t in (
+        BOOLEAN,
+        TINYINT,
+        SMALLINT,
+        INTEGER,
+        BIGINT,
+        REAL,
+        DOUBLE,
+        DATE,
+        TIMESTAMP,
+        UNKNOWN,
+    )
+}
+_SIMPLE_BY_NAME["varchar"] = VARCHAR
+_SIMPLE_BY_NAME["varbinary"] = VARBINARY
+_SIMPLE_BY_NAME["string"] = VARCHAR  # convenience alias
+
+
+def parse_type(text: str) -> Type:
+    """Parse a SQL type name, e.g. 'decimal(12,2)', 'varchar(25)'."""
+    s = text.strip().lower()
+    if s in _SIMPLE_BY_NAME:
+        return _SIMPLE_BY_NAME[s]
+    if s.startswith("decimal"):
+        if "(" in s:
+            inner = s[s.index("(") + 1 : s.rindex(")")]
+            parts = [p.strip() for p in inner.split(",")]
+            p = int(parts[0])
+            sc = int(parts[1]) if len(parts) > 1 else 0
+            return DecimalType(p, sc)
+        return DecimalType(38, 0)
+    if s.startswith("varchar("):
+        return VarcharType(int(s[8:-1]))
+    if s.startswith("char("):
+        return CharType(int(s[5:-1]))
+    if s == "char":
+        return CharType(1)
+    if s.startswith("array(") or s.startswith("array<"):
+        return ArrayType(parse_type(s[6:-1]))
+    raise ValueError(f"unknown type: {text!r}")
+
+
+_NUMERIC_ORDER = {
+    "tinyint": 0,
+    "smallint": 1,
+    "integer": 2,
+    "bigint": 3,
+    "real": 5,
+    "double": 6,
+}
+
+
+def is_integer_kind(t: Type) -> bool:
+    return t.name in ("tinyint", "smallint", "integer", "bigint")
+
+
+def is_numeric(t: Type) -> bool:
+    return t.name in _NUMERIC_ORDER or isinstance(t, DecimalType)
+
+
+def is_string_kind(t: Type) -> bool:
+    return isinstance(t, (VarcharType, CharType))
+
+
+def common_super_type(a: Type, b: Type) -> Type:
+    """Least common type for binary operations / UNION / CASE branches.
+
+    Mirrors the coercion lattice of the reference's TypeCoercion
+    (core/trino-main/.../type/TypeCoercion.java), restricted to the types the
+    engine implements.
+    """
+    if a == b:
+        return a
+    if a == UNKNOWN:
+        return b
+    if b == UNKNOWN:
+        return a
+    if is_string_kind(a) and is_string_kind(b):
+        return VARCHAR
+    da, db = isinstance(a, DecimalType), isinstance(b, DecimalType)
+    if da or db:
+        if da and db:
+            scale = max(a.scale, b.scale)
+            intd = max(a.precision - a.scale, b.precision - b.scale)
+            return DecimalType(min(intd + scale, 18), scale)
+        other = b if da else a
+        dec = a if da else b
+        if other.name in ("tinyint", "smallint", "integer", "bigint"):
+            return DecimalType(18, dec.scale)
+        if other.name in ("real", "double"):
+            return DOUBLE
+        raise TypeError(f"no common type for {a} and {b}")
+    if a.name in _NUMERIC_ORDER and b.name in _NUMERIC_ORDER:
+        return a if _NUMERIC_ORDER[a.name] >= _NUMERIC_ORDER[b.name] else b
+    if {a.name, b.name} == {"date", "timestamp"}:
+        return TIMESTAMP
+    raise TypeError(f"no common type for {a} and {b}")
