@@ -1,0 +1,219 @@
+"""Expression engine tests (mirrors reference operator/scalar tests and
+sql/gen/TestPageFunctionCompiler)."""
+
+from decimal import Decimal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.columnar import batch_from_rows
+from trino_tpu.expr import ExprCompiler, InputRef, Literal, Call, SpecialForm, Form
+from trino_tpu.expr.ir import and_, or_, not_, comparison
+
+
+def _eval(expr, types, rows):
+    """Evaluate one expression over rows, returning python list."""
+    b = batch_from_rows(types, rows).device_put()
+
+    @jax.jit
+    def run(batch):
+        return ExprCompiler(batch).column(expr)
+
+    return run(b).to_pylist()
+
+
+def _ref(ch, t):
+    return InputRef(ch, t)
+
+
+def test_arith_bigint():
+    e = Call("$add", [Call("$mul", [_ref(0, T.BIGINT), Literal(3, T.BIGINT)], T.BIGINT),
+                      _ref(1, T.BIGINT)], T.BIGINT)
+    out = _eval(e, [T.BIGINT, T.BIGINT], [[1, 10], [2, 20], [None, 5]])
+    assert out == [13, 26, None]
+
+
+def test_arith_decimal_mul_and_scale():
+    # l_extendedprice * (1 - l_discount): dec(12,2) * dec(12,2) -> scale 4
+    price, disc = T.DecimalType(12, 2), T.DecimalType(12, 2)
+    e = Call(
+        "$mul",
+        [
+            _ref(0, price),
+            Call("$sub", [Literal(1, T.DecimalType(12, 2)), _ref(1, disc)],
+                 T.DecimalType(12, 2)),
+        ],
+        T.DecimalType(18, 4),
+    )
+    out = _eval(e, [price, disc],
+                [[Decimal("100.00"), Decimal("0.10")],
+                 [Decimal("10.50"), Decimal("0.00")]])
+    assert out == [Decimal("90.0000"), Decimal("10.5000")]
+
+
+def test_decimal_division_rounding():
+    a, b = T.DecimalType(10, 2), T.DecimalType(10, 2)
+    e = Call("$div", [_ref(0, a), _ref(1, b)], T.DecimalType(18, 2))
+    out = _eval(e, [a, b], [[Decimal("7.00"), Decimal("2.00")],
+                            [Decimal("1.00"), Decimal("3.00")],
+                            [Decimal("5.00"), Decimal("0.00")]])
+    assert out == [Decimal("3.50"), Decimal("0.33"), None]
+
+
+def test_integer_division_truncates():
+    e = Call("$div", [_ref(0, T.BIGINT), _ref(1, T.BIGINT)], T.BIGINT)
+    out = _eval(e, [T.BIGINT, T.BIGINT], [[7, 2], [-7, 2], [7, -2]])
+    assert out == [3, -3, -3]
+
+
+def test_three_valued_logic():
+    x = _ref(0, T.BOOLEAN)
+    y = _ref(1, T.BOOLEAN)
+    rows = [[True, None], [False, None], [None, None], [True, False], [None, True]]
+    assert _eval(and_(x, y), [T.BOOLEAN] * 2, rows) == [None, False, None, False, None]
+    assert _eval(or_(x, y), [T.BOOLEAN] * 2, rows) == [True, None, None, True, True]
+    assert _eval(not_(x), [T.BOOLEAN] * 2, rows) == [False, True, None, False, None]
+
+
+def test_comparisons_and_filter_mask():
+    e = comparison("<", _ref(0, T.BIGINT), Literal(5, T.BIGINT))
+    b = batch_from_rows([T.BIGINT], [[3], [7], [None], [4]]).device_put()
+    mask = np.asarray(jax.jit(lambda bb: ExprCompiler(bb).filter_mask(e))(b))
+    assert mask.tolist() == [True, False, False, True]
+
+
+def test_string_eq_and_range():
+    v = T.VARCHAR
+    rows = [["AIR"], ["MAIL"], ["SHIP"], [None]]
+    eq = comparison("=", _ref(0, v), Literal("MAIL", v))
+    assert _eval(eq, [v], rows) == [False, True, False, None]
+    lt = comparison("<", _ref(0, v), Literal("MAIL", v))
+    assert _eval(lt, [v], rows) == [True, False, False, None]
+    ge = comparison(">=", _ref(0, v), Literal("B", v))
+    assert _eval(ge, [v], rows) == [False, True, True, None]
+    # equality against absent value
+    eq2 = comparison("=", _ref(0, v), Literal("TRUCK", v))
+    assert _eval(eq2, [v], rows) == [False, False, False, None]
+
+
+def test_like():
+    v = T.VARCHAR
+    rows = [["PROMO BRUSHED"], ["STANDARD"], ["PROMO X"], ["SMALL PROMO"]]
+    e = Call("like", [_ref(0, v), Literal("PROMO%", v)], T.BOOLEAN)
+    assert _eval(e, [v], rows) == [True, False, True, False]
+    e2 = Call("like", [_ref(0, v), Literal("%PROMO%", v)], T.BOOLEAN)
+    assert _eval(e2, [v], rows) == [True, False, True, True]
+    e3 = Call("like", [_ref(0, v), Literal("S_A%", v)], T.BOOLEAN)
+    # both STANDARD (S-T-A) and SMALL PROMO (S-M-A) match S_A%
+    assert _eval(e3, [v], rows) == [False, True, False, True]
+
+
+def test_case_and_coalesce():
+    # CASE WHEN x > 2 THEN x*10 WHEN x > 0 THEN x ELSE -1
+    x = _ref(0, T.BIGINT)
+    case = SpecialForm(
+        Form.CASE,
+        [
+            comparison(">", x, Literal(2, T.BIGINT)),
+            Call("$mul", [x, Literal(10, T.BIGINT)], T.BIGINT),
+            comparison(">", x, Literal(0, T.BIGINT)),
+            x,
+            Literal(-1, T.BIGINT),
+        ],
+        T.BIGINT,
+    )
+    assert _eval(case, [T.BIGINT], [[3], [1], [0], [None]]) == [30, 1, -1, -1]
+    co = SpecialForm(Form.COALESCE, [x, Literal(99, T.BIGINT)], T.BIGINT)
+    assert _eval(co, [T.BIGINT], [[5], [None]]) == [5, 99]
+
+
+def test_in_between_isnull():
+    x = _ref(0, T.BIGINT)
+    e = SpecialForm(Form.IN, [x, Literal(1, T.BIGINT), Literal(3, T.BIGINT)], T.BOOLEAN)
+    assert _eval(e, [T.BIGINT], [[1], [2], [3], [None]]) == [True, False, True, None]
+    e = SpecialForm(Form.BETWEEN, [x, Literal(2, T.BIGINT), Literal(4, T.BIGINT)], T.BOOLEAN)
+    assert _eval(e, [T.BIGINT], [[1], [3], [None]]) == [False, True, None]
+    e = SpecialForm(Form.IS_NULL, [x], T.BOOLEAN)
+    assert _eval(e, [T.BIGINT], [[1], [None]]) == [False, True]
+
+
+def test_date_extract():
+    import datetime
+    d = T.DATE
+    rows = [[datetime.date(1998, 9, 2)], [datetime.date(1970, 1, 1)],
+            [datetime.date(1995, 12, 31)], [datetime.date(2000, 2, 29)]]
+    assert _eval(Call("year", [_ref(0, d)], T.BIGINT), [d], rows) == [1998, 1970, 1995, 2000]
+    assert _eval(Call("month", [_ref(0, d)], T.BIGINT), [d], rows) == [9, 1, 12, 2]
+    assert _eval(Call("day", [_ref(0, d)], T.BIGINT), [d], rows) == [2, 1, 31, 29]
+    assert _eval(Call("quarter", [_ref(0, d)], T.BIGINT), [d], rows) == [3, 1, 4, 1]
+
+
+def test_date_add_months_clamps():
+    import datetime
+    d = T.DATE
+    e = Call("date_add_months", [_ref(0, d), Literal(1, T.BIGINT)], d)
+    out = _eval(e, [d], [[datetime.date(1995, 1, 31)], [datetime.date(1995, 3, 15)]])
+    assert out == [datetime.date(1995, 2, 28), datetime.date(1995, 4, 15)]
+
+
+def test_string_functions():
+    v = T.VARCHAR
+    rows = [["Customer#001"], ["abc"], [None]]
+    sub = Call("substr", [_ref(0, v), Literal(1, T.BIGINT), Literal(3, T.BIGINT)], v)
+    assert _eval(sub, [v], rows) == ["Cus", "abc", None]
+    up = Call("upper", [_ref(0, v)], v)
+    assert _eval(up, [v], rows) == ["CUSTOMER#001", "ABC", None]
+    ln = Call("length", [_ref(0, v)], T.BIGINT)
+    assert _eval(ln, [v], rows) == [12, 3, None]
+    cc = Call("concat", [Literal("<", v), _ref(0, v), Literal(">", v)], v)
+    assert _eval(cc, [v], rows) == ["<Customer#001>", "<abc>", None]
+
+
+def test_cast():
+    e = SpecialForm(Form.CAST, [_ref(0, T.BIGINT)], T.DOUBLE)
+    assert _eval(e, [T.BIGINT], [[3]]) == [3.0]
+    e = SpecialForm(Form.CAST, [_ref(0, T.DOUBLE)], T.BIGINT)
+    assert _eval(e, [T.DOUBLE], [[3.7], [-2.5]]) == [4, -3]
+    e = SpecialForm(Form.CAST, [_ref(0, T.DecimalType(10, 2))], T.DOUBLE)
+    assert _eval(e, [T.DecimalType(10, 2)], [[Decimal("1.50")]]) == [1.5]
+    e = SpecialForm(Form.CAST, [_ref(0, T.VARCHAR)], T.BIGINT)
+    assert _eval(e, [T.VARCHAR], [["42"], ["oops"]]) == [42, None]
+
+
+def test_round_and_abs():
+    e = Call("round", [_ref(0, T.DOUBLE)], T.BIGINT)
+    assert _eval(e, [T.DOUBLE], [[2.5], [-2.5], [2.4]]) == [3, -3, 2]
+    e = Call("abs", [_ref(0, T.BIGINT)], T.BIGINT)
+    assert _eval(e, [T.BIGINT], [[-5], [5]]) == [5, 5]
+
+
+def test_negative_decimal_division_half_away_from_zero():
+    a, b = T.DecimalType(18, 1), T.DecimalType(18, 1)
+    e = Call("$div", [_ref(0, a), _ref(1, b)], T.DecimalType(18, 1))
+    out = _eval(e, [a, b], [[Decimal("-0.5"), Decimal("2.0")],
+                            [Decimal("0.5"), Decimal("-2.0")],
+                            [Decimal("0.5"), Decimal("2.0")]])
+    assert out == [Decimal("-0.3"), Decimal("-0.3"), Decimal("0.3")]
+
+
+def test_substr_edge_semantics():
+    v = T.VARCHAR
+    rows = [["abc"], ["x"]]
+    z = Call("substr", [_ref(0, v), Literal(0, T.BIGINT)], v)
+    assert _eval(z, [v], rows) == ["", ""]
+    neg = Call("substr", [_ref(0, v), Literal(-5, T.BIGINT)], v)
+    assert _eval(neg, [v], rows) == ["", ""]
+    negl = Call("substr", [_ref(0, v), Literal(1, T.BIGINT), Literal(-1, T.BIGINT)], v)
+    assert _eval(negl, [v], rows) == ["", ""]
+    tail = Call("substr", [_ref(0, v), Literal(-2, T.BIGINT)], v)
+    assert _eval(tail, [v], rows) == ["bc", ""]
+
+
+def test_greatest_cross_dictionary():
+    v = T.VARCHAR
+    g = Call("greatest", [_ref(0, v), _ref(1, v)], v)
+    out = _eval(g, [v, v], [["apple", "zebra"], ["pear", "fig"]])
+    assert out == ["zebra", "pear"]

@@ -123,7 +123,7 @@ class Column:
             elif is_dec:
                 from decimal import Decimal
 
-                out.append(Decimal(int(data[i])) / (10 ** t.scale))
+                out.append(Decimal(int(data[i])).scaleb(-t.scale))
             elif t is DATE:
                 import datetime
 

@@ -97,6 +97,21 @@ class StringDictionary:
         """Smallest code whose value > `value`."""
         return bisect.bisect_right(self.values, value)
 
+    def prefix_range(self, prefix: str) -> tuple[int, int]:
+        """[lo, hi) code range of values starting with `prefix`."""
+        if not prefix:
+            return 0, len(self.values)
+        lo = bisect.bisect_left(self.values, prefix)
+        last = prefix[-1]
+        if ord(last) >= 0x10FFFF:
+            # cannot form a successor string; scan is fine at dict cardinality
+            hi = lo
+            while hi < len(self.values) and self.values[hi].startswith(prefix):
+                hi += 1
+            return lo, hi
+        hi = bisect.bisect_left(self.values, prefix[:-1] + chr(ord(last) + 1))
+        return lo, hi
+
     def predicate_table(self, fn) -> np.ndarray:
         """Evaluate a python predicate over every dictionary value.
 

@@ -1,0 +1,34 @@
+"""Expression subsystem: typed IR + trace-time JAX compiler.
+
+Reference roles:
+  - sql/relational/RowExpression.java  -> ir.Expr hierarchy
+  - sql/gen/PageFunctionCompiler.java  -> compiler.compile_projection / compile_filter
+  - operator/scalar/* (139 files)      -> functions.FUNCTIONS registry
+  - likematcher/LikeMatcher.java       -> strings.like_to_predicate (dictionary tables)
+
+Where the reference generates JVM bytecode per expression at query setup, this
+engine *traces* the expression into the fragment's XLA computation: the
+compiled fragment is one fused device program, and string predicates become
+dictionary lookup tables baked in as constants at trace time.
+"""
+
+from trino_tpu.expr.ir import (
+    Expr,
+    InputRef,
+    Literal,
+    Call,
+    SpecialForm,
+    Form,
+)
+from trino_tpu.expr.compiler import ExprCompiler, Val
+
+__all__ = [
+    "Expr",
+    "InputRef",
+    "Literal",
+    "Call",
+    "SpecialForm",
+    "Form",
+    "ExprCompiler",
+    "Val",
+]

@@ -1,0 +1,788 @@
+"""Scalar function library (reference: operator/scalar/* — 139 files — plus the
+per-type operators in type/*Operators.java).
+
+Each handler runs at trace time: it receives compiled argument Vals and emits
+jnp ops.  String functions evaluate over dictionaries host-side and emit
+constant lookup tables (see expr/strings.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.columnar import StringDictionary
+from trino_tpu.expr.compiler import ExprCompiler, Val, _and_valid, _valid_arr
+from trino_tpu.expr.ir import Call
+from trino_tpu.expr.strings import like_to_regex, like_prefix
+
+FUNCTIONS: dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        FUNCTIONS[name] = fn
+        return fn
+
+    return deco
+
+
+def dispatch(ctx: ExprCompiler, call: Call) -> Val:
+    fn = FUNCTIONS.get(call.name)
+    if fn is None:
+        raise NotImplementedError(f"scalar function not implemented: {call.name}")
+    vals = [ctx.value(a) for a in call.args]
+    return fn(ctx, call, *vals)
+
+
+# ---------------------------------------------------------------------------
+# numeric coercion helpers
+
+
+def _dec_scale(t: T.Type) -> int | None:
+    return t.scale if isinstance(t, T.DecimalType) else None
+
+
+def _to_float(v: Val):
+    """Numeric value as f64 data."""
+    d = jnp.asarray(v.data)
+    if isinstance(v.type, T.DecimalType):
+        return d.astype(jnp.float64) / float(v.type.scale_factor)
+    return d.astype(jnp.float64)
+
+
+def _align_numeric(a: Val, b: Val):
+    """Coerce two numeric values to a common device representation.
+
+    Returns (a_data, b_data, result_type_hint) where decimal operands are
+    rescaled to a shared scale (integer math), or both lifted to f64.
+    """
+    ta, tb = a.type, b.type
+    if T.is_string_kind(ta) or T.is_string_kind(tb):
+        raise TypeError("string arithmetic")
+    fa = ta.name in ("real", "double")
+    fb = tb.name in ("real", "double")
+    da, db = isinstance(ta, T.DecimalType), isinstance(tb, T.DecimalType)
+    if fa or fb:
+        return _to_float(a), _to_float(b), T.DOUBLE
+    if da or db:
+        sa = ta.scale if da else 0
+        sb = tb.scale if db else 0
+        s = max(sa, sb)
+        ad = jnp.asarray(a.data, dtype=jnp.int64) * (10 ** (s - sa))
+        bd = jnp.asarray(b.data, dtype=jnp.int64) * (10 ** (s - sb))
+        return ad, bd, T.DecimalType(18, s)
+    # integer kinds (and date/timestamp, which are integers on device)
+    dt = np.promote_types(ta.np_dtype, tb.np_dtype)
+    return (
+        jnp.asarray(a.data).astype(dt),
+        jnp.asarray(b.data).astype(dt),
+        ta if ta.np_dtype == dt else tb,
+    )
+
+
+def _rescale_decimal(data, from_scale: int, to_scale: int):
+    if from_scale == to_scale:
+        return data
+    if to_scale > from_scale:
+        return data * (10 ** (to_scale - from_scale))
+    # round half up on downscale
+    f = 10 ** (from_scale - to_scale)
+    return (data + jnp.sign(data) * (f // 2)) // f
+
+
+def _result_as(call_type: T.Type, data, valid) -> Val:
+    return Val(data, valid, call_type)
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+
+
+def _arith(ctx, call, a, b, int_op, float_op):
+    ad, bd, hint = _align_numeric(a, b)
+    valid = _and_valid(a.valid, b.valid)
+    rt = call.type
+    if rt.name in ("real", "double") or hint is T.DOUBLE:
+        out = float_op(jnp.asarray(ad, jnp.float64), jnp.asarray(bd, jnp.float64))
+        return Val(out, valid, T.DOUBLE if rt.name not in ("real",) else rt)
+    out = int_op(ad, bd)
+    if isinstance(rt, T.DecimalType) and isinstance(hint, T.DecimalType):
+        out = _rescale_decimal(out, hint.scale, rt.scale)
+    return Val(out, valid, rt)
+
+
+@register("$add")
+def _add(ctx, call, a, b):
+    return _arith(ctx, call, a, b, jnp.add, jnp.add)
+
+
+@register("$sub")
+def _sub(ctx, call, a, b):
+    return _arith(ctx, call, a, b, jnp.subtract, jnp.subtract)
+
+
+@register("$mul")
+def _mul(ctx, call, a, b):
+    rt = call.type
+    valid = _and_valid(a.valid, b.valid)
+    sa, sb = _dec_scale(a.type), _dec_scale(b.type)
+    if sa is not None or sb is not None:
+        if a.type.name in ("real", "double") or b.type.name in ("real", "double"):
+            return Val(_to_float(a) * _to_float(b), valid, T.DOUBLE)
+        ad = jnp.asarray(a.data, jnp.int64)
+        bd = jnp.asarray(b.data, jnp.int64)
+        prod_scale = (sa or 0) + (sb or 0)
+        out = ad * bd
+        if isinstance(rt, T.DecimalType):
+            out = _rescale_decimal(out, prod_scale, rt.scale)
+            return Val(out, valid, rt)
+        return Val(out, valid, T.DecimalType(18, prod_scale))
+    return _arith(ctx, call, a, b, jnp.multiply, jnp.multiply)
+
+
+@register("$div")
+def _div(ctx, call, a, b):
+    # Decimal/integer division both produce exact SQL semantics; div-by-zero
+    # yields null (TRY semantics; strict mode is a session property).
+    valid = _and_valid(a.valid, b.valid)
+    rt = call.type
+    sa, sb = _dec_scale(a.type), _dec_scale(b.type)
+    bzero = jnp.asarray(b.data) == 0
+    valid = _and_valid(valid, jnp.logical_not(bzero))
+    if rt.name in ("real", "double"):
+        ad, bd = _to_float(a), _to_float(b)
+        out = ad / jnp.where(bzero, 1.0, bd)
+        return Val(out, valid, rt)
+    if isinstance(rt, T.DecimalType):
+        # Trino short-decimal division: rescale numerator by 10^(s_out - sa + sb)
+        ad = jnp.asarray(a.data, jnp.int64)
+        bd = jnp.asarray(b.data, jnp.int64)
+        shift = rt.scale - (sa or 0) + (sb or 0)
+        num = ad * (10 ** max(shift, 0))
+        den = jnp.where(bzero, 1, bd) * (10 ** max(-shift, 0))
+        # truncating division + round half away from zero (SQL), NOT floor-div
+        sign = jnp.sign(num) * jnp.sign(den)
+        q = jnp.abs(num) // jnp.abs(den)
+        r = jnp.abs(num) - q * jnp.abs(den)
+        adj = jnp.where(2 * r >= jnp.abs(den), 1, 0)
+        return Val(sign * (q + adj), valid, rt)
+    # integer division truncates toward zero (SQL), unlike python floor-div
+    ad = jnp.asarray(a.data, jnp.int64)
+    bd = jnp.where(bzero, 1, jnp.asarray(b.data, jnp.int64))
+    out = jnp.sign(ad) * jnp.sign(bd) * (jnp.abs(ad) // jnp.abs(bd))
+    return Val(out.astype(rt.np_dtype), valid, rt)
+
+
+@register("$mod")
+def _mod(ctx, call, a, b):
+    valid = _and_valid(a.valid, b.valid)
+    bzero = jnp.asarray(b.data) == 0
+    valid = _and_valid(valid, ~bzero)
+    ad, bd, hint = _align_numeric(a, b)
+    bd = jnp.where(bzero, 1, bd)
+    # SQL mod: sign follows dividend
+    out = jnp.sign(ad) * (jnp.abs(ad) % jnp.abs(bd))
+    return Val(out, valid, call.type)
+
+
+@register("$neg")
+def _neg(ctx, call, a):
+    return Val(jnp.negative(jnp.asarray(a.data)), a.valid, call.type)
+
+
+# ---------------------------------------------------------------------------
+# comparisons (dictionary-aware)
+
+
+def _cmp_operands(ctx, a: Val, b: Val):
+    """Align two values for comparison; returns (ad, bd) arrays."""
+    if a.dictionary is not None or b.dictionary is not None:
+        da, db = a.dictionary, b.dictionary
+        if da is not None and db is not None:
+            if da is db or da == db:
+                return jnp.asarray(a.data, jnp.int32), jnp.asarray(b.data, jnp.int32)
+            from trino_tpu.columnar.dictionary import union_dictionaries
+
+            m, ra, rb = union_dictionaries(da, db)
+            ad = jnp.take(jnp.asarray(ra), jnp.asarray(a.data, jnp.int32), mode="clip")
+            bd = jnp.take(jnp.asarray(rb), jnp.asarray(b.data, jnp.int32), mode="clip")
+            return ad, bd
+        raise TypeError("comparison between string and non-string")
+    ad, bd, _ = _align_numeric(a, b)
+    return ad, bd
+
+
+def _string_literal_of(v: Val) -> str | None:
+    """If v is a single-value-dictionary scalar (a string literal), return it."""
+    if v.dictionary is not None and len(v.dictionary) == 1 and jnp.ndim(v.data) == 0:
+        return v.dictionary.values[0]
+    return None
+
+
+def _dict_range_cmp(op: str, col: Val, lit: str):
+    """Order comparison of a dictionary column against a string literal using
+    the order-preserving property: translate to a code-range test."""
+    d = col.dictionary
+    codes = jnp.asarray(col.data, jnp.int32)
+    if op == "$lt":
+        return codes < d.lower_bound(lit)
+    if op == "$le":
+        return codes < d.upper_bound(lit)
+    if op == "$gt":
+        return codes >= d.upper_bound(lit)
+    if op == "$ge":
+        return codes >= d.lower_bound(lit)
+    raise AssertionError(op)
+
+
+def _comparison(op: str, jop):
+    def handler(ctx, call, a, b):
+        valid = _and_valid(a.valid, b.valid)
+        # string-vs-literal fast paths
+        la, lb = _string_literal_of(a), _string_literal_of(b)
+        if a.dictionary is not None and lb is not None and la is None:
+            if op in ("$eq", "$ne"):
+                code = a.dictionary.code_of(lb)
+                r = jnp.asarray(a.data, jnp.int32) == code
+                return Val(r if op == "$eq" else ~r, valid, T.BOOLEAN)
+            return Val(_dict_range_cmp(op, a, lb), valid, T.BOOLEAN)
+        if b.dictionary is not None and la is not None and lb is None:
+            flip = {"$lt": "$gt", "$le": "$ge", "$gt": "$lt", "$ge": "$le"}
+            if op in ("$eq", "$ne"):
+                code = b.dictionary.code_of(la)
+                r = jnp.asarray(b.data, jnp.int32) == code
+                return Val(r if op == "$eq" else ~r, valid, T.BOOLEAN)
+            return Val(_dict_range_cmp(flip[op], b, la), valid, T.BOOLEAN)
+        ad, bd = _cmp_operands(ctx, a, b)
+        return Val(jop(ad, bd), valid, T.BOOLEAN)
+
+    return handler
+
+
+FUNCTIONS["$eq"] = _comparison("$eq", jnp.equal)
+FUNCTIONS["$ne"] = _comparison("$ne", jnp.not_equal)
+FUNCTIONS["$lt"] = _comparison("$lt", jnp.less)
+FUNCTIONS["$le"] = _comparison("$le", jnp.less_equal)
+FUNCTIONS["$gt"] = _comparison("$gt", jnp.greater)
+FUNCTIONS["$ge"] = _comparison("$ge", jnp.greater_equal)
+
+
+# ---------------------------------------------------------------------------
+# math
+
+
+def _unary_float(jfn):
+    def handler(ctx, call, a):
+        return Val(jfn(_to_float(a)), a.valid, T.DOUBLE)
+
+    return handler
+
+
+FUNCTIONS["sqrt"] = _unary_float(jnp.sqrt)
+FUNCTIONS["cbrt"] = _unary_float(jnp.cbrt)
+FUNCTIONS["exp"] = _unary_float(jnp.exp)
+FUNCTIONS["ln"] = _unary_float(jnp.log)
+FUNCTIONS["log10"] = _unary_float(jnp.log10)
+FUNCTIONS["log2"] = _unary_float(jnp.log2)
+FUNCTIONS["sin"] = _unary_float(jnp.sin)
+FUNCTIONS["cos"] = _unary_float(jnp.cos)
+FUNCTIONS["tan"] = _unary_float(jnp.tan)
+FUNCTIONS["degrees"] = _unary_float(jnp.degrees)
+FUNCTIONS["radians"] = _unary_float(jnp.radians)
+FUNCTIONS["sign"] = lambda ctx, call, a: Val(
+    jnp.sign(jnp.asarray(a.data)), a.valid, call.type
+)
+
+
+@register("abs")
+def _abs(ctx, call, a):
+    return Val(jnp.abs(jnp.asarray(a.data)), a.valid, call.type)
+
+
+@register("power")
+def _power(ctx, call, a, b):
+    return Val(
+        jnp.power(_to_float(a), _to_float(b)), _and_valid(a.valid, b.valid), T.DOUBLE
+    )
+
+
+@register("pow")
+def _pow(ctx, call, a, b):
+    return _power(ctx, call, a, b)
+
+
+@register("mod")
+def _mod_fn(ctx, call, a, b):
+    return _mod(ctx, call, a, b)
+
+
+@register("floor")
+def _floor(ctx, call, a):
+    if isinstance(a.type, T.DecimalType):
+        # jnp // on ints is floor division, exactly SQL floor-to-scale-0
+        d = jnp.asarray(a.data, jnp.int64) // a.type.scale_factor
+        return Val(d, a.valid, T.DecimalType(18, 0))
+    if a.type.name in ("double", "real"):
+        return Val(jnp.floor(_to_float(a)), a.valid, T.DOUBLE)
+    return a
+
+
+@register("ceil")
+@register("ceiling")
+def _ceil(ctx, call, a):
+    if isinstance(a.type, T.DecimalType):
+        d = -((-jnp.asarray(a.data, jnp.int64)) // a.type.scale_factor)
+        return Val(d, a.valid, T.DecimalType(18, 0))
+    if a.type.name in ("double", "real"):
+        return Val(jnp.ceil(_to_float(a)), a.valid, T.DOUBLE)
+    return a
+
+
+@register("round")
+def _round(ctx, call, a, nd=None):
+    digits = 0
+    if nd is not None:
+        digits = int(np.asarray(nd.data))  # literal digits only
+    if isinstance(a.type, T.DecimalType):
+        from trino_tpu.expr.functions import _rescale_decimal
+
+        s = a.type.scale
+        out_t = call.type
+        out_s = out_t.scale if isinstance(out_t, T.DecimalType) else digits
+        d = _rescale_decimal(jnp.asarray(a.data, jnp.int64), s, min(s, digits))
+        d = _rescale_decimal(d, min(s, digits), out_s)
+        return Val(d, a.valid, out_t if isinstance(out_t, T.DecimalType) else T.DecimalType(18, out_s))
+    f = _to_float(a)
+    m = 10.0 ** digits
+    # SQL rounds half away from zero; jnp.round is half-to-even
+    out = jnp.sign(f) * jnp.floor(jnp.abs(f) * m + 0.5) / m
+    if call.type.name in ("bigint", "integer") and digits == 0:
+        return Val(out.astype(call.type.np_dtype), a.valid, call.type)
+    return Val(out, a.valid, T.DOUBLE)
+
+
+def _minmax(jop):
+    def handler(ctx, call, *vals):
+        valid = None
+        for v in vals:
+            valid = _and_valid(valid, v.valid)
+        dicts = [v.dictionary for v in vals if v.dictionary is not None]
+        if dicts:
+            # recode everything into one union dictionary up front so codes
+            # stay comparable and the result dictionary matches its codes
+            out_dict = dicts[0]
+            for d in dicts[1:]:
+                if d is not out_dict and d != out_dict:
+                    out_dict = StringDictionary.from_unsorted(out_dict.values + d.values)
+            datas = [ctx._recode(v, out_dict) for v in vals]
+        else:
+            out_dict = None
+            base = vals[0]
+            datas = [_align_numeric(v, base)[0] for v in vals]
+        acc = datas[0]
+        for d in datas[1:]:
+            acc = jop(acc, d)
+        return Val(acc, valid, call.type, out_dict)
+
+    return handler
+
+
+FUNCTIONS["greatest"] = _minmax(jnp.maximum)
+FUNCTIONS["least"] = _minmax(jnp.minimum)
+
+
+# ---------------------------------------------------------------------------
+# date/time (civil calendar math on day numbers; Howard Hinnant's algorithms)
+
+
+def _civil_from_days(days):
+    z = jnp.asarray(days, jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _as_days(v: Val):
+    if v.type is T.TIMESTAMP:
+        return jnp.asarray(v.data, jnp.int64) // 86_400_000_000
+    return jnp.asarray(v.data, jnp.int64)
+
+
+@register("year")
+def _year(ctx, call, a):
+    y, _, _ = _civil_from_days(_as_days(a))
+    return Val(y, a.valid, T.BIGINT)
+
+
+@register("month")
+def _month(ctx, call, a):
+    _, m, _ = _civil_from_days(_as_days(a))
+    return Val(m, a.valid, T.BIGINT)
+
+
+@register("day")
+@register("day_of_month")
+def _day(ctx, call, a):
+    _, _, d = _civil_from_days(_as_days(a))
+    return Val(d, a.valid, T.BIGINT)
+
+
+@register("quarter")
+def _quarter(ctx, call, a):
+    _, m, _ = _civil_from_days(_as_days(a))
+    return Val((m - 1) // 3 + 1, a.valid, T.BIGINT)
+
+
+@register("day_of_week")
+@register("dow")
+def _dow(ctx, call, a):
+    d = _as_days(a)
+    return Val((d + 3) % 7 + 1, a.valid, T.BIGINT)  # 1=Monday..7=Sunday
+
+
+@register("day_of_year")
+@register("doy")
+def _doy(ctx, call, a):
+    d = _as_days(a)
+    y, _, _ = _civil_from_days(d)
+    jan1 = _days_from_civil(y, jnp.asarray(1), jnp.asarray(1))
+    return Val(d - jan1 + 1, a.valid, T.BIGINT)
+
+
+@register("date_add_days")
+def _date_add_days(ctx, call, a, n):
+    return Val(
+        jnp.asarray(a.data, jnp.int64) + jnp.asarray(n.data, jnp.int64),
+        _and_valid(a.valid, n.valid),
+        call.type,
+    )
+
+
+@register("date_add_months")
+def _date_add_months(ctx, call, a, n):
+    y, m, d = _civil_from_days(_as_days(a))
+    months = y * 12 + (m - 1) + jnp.asarray(n.data, jnp.int64)
+    ny, nm = months // 12, months % 12 + 1
+    # clamp day to last day of target month
+    last = _days_from_civil(
+        jnp.where(nm == 12, ny + 1, ny), jnp.where(nm == 12, 1, nm + 1), jnp.asarray(1)
+    ) - _days_from_civil(ny, nm, jnp.asarray(1))
+    nd = jnp.minimum(d, last)
+    return Val(_days_from_civil(ny, nm, nd), _and_valid(a.valid, n.valid), call.type)
+
+
+@register("date_trunc_month")
+def _date_trunc_month(ctx, call, a):
+    y, m, _ = _civil_from_days(_as_days(a))
+    return Val(_days_from_civil(y, m, jnp.asarray(1)), a.valid, T.DATE)
+
+
+@register("date_trunc_year")
+def _date_trunc_year(ctx, call, a):
+    y, _, _ = _civil_from_days(_as_days(a))
+    return Val(_days_from_civil(y, jnp.asarray(1), jnp.asarray(1)), a.valid, T.DATE)
+
+
+# ---------------------------------------------------------------------------
+# strings (dictionary tables)
+
+
+def _require_dict(v: Val, what: str) -> StringDictionary:
+    if v.dictionary is None:
+        raise TypeError(f"{what} requires a string (dictionary) value")
+    return v.dictionary
+
+
+def _literal_str(v: Val, what: str) -> str:
+    s = _string_literal_of(v)
+    if s is None:
+        raise NotImplementedError(f"{what}: pattern/argument must be a literal")
+    return s
+
+
+@register("like")
+def _like(ctx, call, value, pattern, escape=None):
+    d = _require_dict(value, "LIKE")
+    pat = _literal_str(pattern, "LIKE")
+    esc = _literal_str(escape, "LIKE escape") if escape is not None else None
+    codes = jnp.asarray(value.data, jnp.int32)
+    pfx = like_prefix(pat, esc)
+    if pfx is not None:
+        lo, hi = d.prefix_range(pfx)
+        return Val((codes >= lo) & (codes < hi), value.valid, T.BOOLEAN)
+    rx = like_to_regex(pat, esc)
+    table = jnp.asarray(d.predicate_table(lambda s: rx.match(s) is not None))
+    return Val(jnp.take(table, codes, mode="clip"), value.valid, T.BOOLEAN)
+
+
+def _string_map(ctx, call, value: Val, fn, what: str) -> Val:
+    """Map a python string fn over the dictionary -> new dictionary + table."""
+    d = _require_dict(value, what)
+    outs = [fn(s) for s in d.values]
+    nd = StringDictionary.from_unsorted(outs)
+    ix = nd.index
+    table = jnp.asarray(
+        np.fromiter((ix[o] for o in outs), dtype=np.int32, count=len(outs))
+    )
+    codes = jnp.take(table, jnp.asarray(value.data, jnp.int32), mode="clip")
+    return Val(codes, value.valid, call.type, nd)
+
+
+@register("substr")
+@register("substring")
+def _substr(ctx, call, value, start, length=None):
+    s0 = int(np.asarray(start.data))
+    ln = int(np.asarray(length.data)) if length is not None else None
+
+    def fn(s: str) -> str:
+        # SQL substr is 1-based; start=0, non-positive length, or a negative
+        # start before the beginning all yield '' (ref: StringFunctions.java:280,327)
+        if s0 == 0 or (ln is not None and ln <= 0):
+            return ""
+        if s0 > 0:
+            begin = s0 - 1
+        else:
+            begin = len(s) + s0
+            if begin < 0:
+                return ""
+        return s[begin : begin + ln] if ln is not None else s[begin:]
+
+    return _string_map(ctx, call, value, fn, "substr")
+
+
+@register("upper")
+def _upper(ctx, call, value):
+    return _string_map(ctx, call, value, str.upper, "upper")
+
+
+@register("lower")
+def _lower(ctx, call, value):
+    return _string_map(ctx, call, value, str.lower, "lower")
+
+
+@register("trim")
+def _trim(ctx, call, value):
+    return _string_map(ctx, call, value, str.strip, "trim")
+
+
+@register("ltrim")
+def _ltrim(ctx, call, value):
+    return _string_map(ctx, call, value, str.lstrip, "ltrim")
+
+
+@register("rtrim")
+def _rtrim(ctx, call, value):
+    return _string_map(ctx, call, value, str.rstrip, "rtrim")
+
+
+@register("reverse")
+def _reverse(ctx, call, value):
+    return _string_map(ctx, call, value, lambda s: s[::-1], "reverse")
+
+
+@register("replace")
+def _replace(ctx, call, value, find, repl=None):
+    f = _literal_str(find, "replace")
+    r = _literal_str(repl, "replace") if repl is not None else ""
+    return _string_map(ctx, call, value, lambda s: s.replace(f, r), "replace")
+
+
+@register("length")
+def _length(ctx, call, value):
+    d = _require_dict(value, "length")
+    table = jnp.asarray(np.fromiter((len(s) for s in d.values), np.int64, len(d)))
+    return Val(
+        jnp.take(table, jnp.asarray(value.data, jnp.int32), mode="clip"),
+        value.valid,
+        T.BIGINT,
+    )
+
+
+@register("strpos")
+@register("position")
+def _strpos(ctx, call, value, sub):
+    d = _require_dict(value, "strpos")
+    s = _literal_str(sub, "strpos")
+    table = jnp.asarray(np.fromiter((v.find(s) + 1 for v in d.values), np.int64, len(d)))
+    return Val(
+        jnp.take(table, jnp.asarray(value.data, jnp.int32), mode="clip"),
+        value.valid,
+        T.BIGINT,
+    )
+
+
+@register("concat")
+@register("$concat")
+def _concat(ctx, call, *vals):
+    # SQL: concat with any NULL argument is NULL
+    if any(v.is_literal_null for v in vals):
+        return Val(np.int32(0), False, call.type)
+    # Supported shapes: any mix where at most ONE argument is a non-literal
+    # dictionary column (covers 'lit' || col || 'lit' chains).
+    col_ix = [
+        i
+        for i, v in enumerate(vals)
+        if v.dictionary is not None and _string_literal_of(v) is None
+    ]
+    if not col_ix:
+        s = "".join(_literal_str(v, "concat") for v in vals)
+        d = StringDictionary([s])
+        return Val(np.int32(0), None, call.type, d)
+    if len(col_ix) > 1:
+        raise NotImplementedError("concat of multiple string columns")
+    i = col_ix[0]
+    pre = "".join(_literal_str(v, "concat") for v in vals[:i])
+    post = "".join(_literal_str(v, "concat") for v in vals[i + 1 :])
+    valid = None
+    for v in vals:
+        valid = _and_valid(valid, v.valid)
+    out = _string_map(ctx, call, vals[i], lambda s: pre + s + post, "concat")
+    return Val(out.data, valid, call.type, out.dictionary)
+
+
+@register("starts_with")
+def _starts_with(ctx, call, value, prefix):
+    d = _require_dict(value, "starts_with")
+    p = _literal_str(prefix, "starts_with")
+    codes = jnp.asarray(value.data, jnp.int32)
+    lo, hi = d.prefix_range(p)
+    return Val((codes >= lo) & (codes < hi), value.valid, T.BOOLEAN)
+
+
+@register("hamming_distance")
+def _unsupported(ctx, call, *vals):  # pragma: no cover - explicitness
+    raise NotImplementedError(call.name)
+
+
+# ---------------------------------------------------------------------------
+# casts
+
+
+def compile_cast(ctx: ExprCompiler, v: Val, to: T.Type) -> Val:
+    frm = v.type
+    if frm == to or frm.name == to.name:
+        return Val(v.data, v.valid, to, v.dictionary)
+    if to == T.UNKNOWN:
+        return v
+    if T.is_string_kind(to):
+        if v.dictionary is not None:
+            return Val(v.data, v.valid, to, v.dictionary)
+        # numeric/date -> varchar must happen host-side; only literals allowed
+        if jnp.ndim(v.data) == 0 and not isinstance(v.data, jnp.ndarray):
+            s = _render_scalar(v)
+            d = StringDictionary([s])
+            return Val(np.int32(0), v.valid, to, d)
+        raise NotImplementedError(f"cast {frm.name} -> varchar on columns")
+    if T.is_string_kind(frm):
+        # varchar -> numeric/date via dictionary table
+        d = _require_dict(v, "cast from varchar")
+        table = np.zeros(len(d), dtype=to.np_dtype)
+        ok = np.ones(len(d), dtype=bool)
+        for i, s in enumerate(d.values):
+            try:
+                table[i] = _parse_scalar(s, to)
+            except (ValueError, ArithmeticError):
+                ok[i] = False
+        codes = jnp.asarray(v.data, jnp.int32)
+        data = jnp.take(jnp.asarray(table), codes, mode="clip")
+        valid = _and_valid(v.valid, jnp.take(jnp.asarray(ok), codes, mode="clip"))
+        return Val(data, valid, to)
+    if isinstance(to, T.DecimalType):
+        if isinstance(frm, T.DecimalType):
+            return Val(
+                _rescale_decimal(jnp.asarray(v.data, jnp.int64), frm.scale, to.scale),
+                v.valid,
+                to,
+            )
+        if frm.name in ("double", "real"):
+            f = _to_float(v) * to.scale_factor
+            return Val(
+                (jnp.sign(f) * jnp.floor(jnp.abs(f) + 0.5)).astype(jnp.int64),
+                v.valid,
+                to,
+            )
+        return Val(
+            jnp.asarray(v.data, jnp.int64) * to.scale_factor, v.valid, to
+        )
+    if to.name in ("double", "real"):
+        return Val(_to_float(v).astype(to.np_dtype), v.valid, to)
+    if to.name in ("bigint", "integer", "smallint", "tinyint"):
+        if isinstance(frm, T.DecimalType):
+            return Val(
+                _rescale_decimal(jnp.asarray(v.data, jnp.int64), frm.scale, 0).astype(
+                    to.np_dtype
+                ),
+                v.valid,
+                to,
+            )
+        if frm.name in ("double", "real"):
+            f = _to_float(v)
+            return Val(
+                (jnp.sign(f) * jnp.floor(jnp.abs(f) + 0.5)).astype(to.np_dtype),
+                v.valid,
+                to,
+            )
+        return Val(jnp.asarray(v.data).astype(to.np_dtype), v.valid, to)
+    if to is T.DATE and frm is T.TIMESTAMP:
+        return Val(jnp.asarray(v.data, jnp.int64) // 86_400_000_000, v.valid, to)
+    if to is T.TIMESTAMP and frm is T.DATE:
+        return Val(jnp.asarray(v.data, jnp.int64) * 86_400_000_000, v.valid, to)
+    if to is T.BOOLEAN:
+        return Val(jnp.asarray(v.data) != 0, v.valid, to)
+    if frm is T.BOOLEAN:
+        return Val(jnp.asarray(v.data).astype(to.np_dtype), v.valid, to)
+    raise NotImplementedError(f"cast {frm.name} -> {to.name}")
+
+
+def _render_scalar(v: Val) -> str:
+    if isinstance(v.type, T.DecimalType):
+        x = int(np.asarray(v.data))
+        s = v.type.scale
+        if s == 0:
+            return str(x)
+        sign = "-" if x < 0 else ""
+        x = abs(x)
+        return f"{sign}{x // 10**s}.{x % 10**s:0{s}d}"
+    return str(np.asarray(v.data))
+
+
+def _parse_scalar(s: str, to: T.Type):
+    s = s.strip()
+    if to.name in ("bigint", "integer", "smallint", "tinyint"):
+        return int(s)
+    if to.name in ("double", "real"):
+        return float(s)
+    if isinstance(to, T.DecimalType):
+        from decimal import Decimal
+
+        return int(Decimal(s).scaleb(to.scale).to_integral_value())
+    if to is T.DATE:
+        import datetime
+
+        y, m, d = map(int, s.split("-"))
+        return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+    if to is T.BOOLEAN:
+        return s.lower() in ("true", "t", "1")
+    raise ValueError(f"cannot parse {s!r} as {to.name}")

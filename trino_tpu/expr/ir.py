@@ -1,0 +1,185 @@
+"""Typed row-expression IR (reference: sql/relational/RowExpression.java).
+
+Produced by the analyzer/planner, consumed by the trace-time compiler and by
+optimizer rules (constant folding, predicate pushdown, dynamic-filter
+extraction).  Deliberately small: InputRef / Literal / Call / SpecialForm.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Sequence
+
+from trino_tpu.types import Type, BOOLEAN
+
+
+class Expr:
+    type: Type
+
+    def children(self) -> Sequence["Expr"]:
+        return ()
+
+    def with_children(self, children: Sequence["Expr"]) -> "Expr":
+        assert not children
+        return self
+
+    # structural equality for optimizer rules
+    def key(self):
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return isinstance(other, Expr) and self.key() == other.key()
+
+    def __hash__(self):
+        return hash(self.key())
+
+
+class InputRef(Expr):
+    """Reference to an input channel of the operator's input batch."""
+
+    __slots__ = ("channel", "type")
+
+    def __init__(self, channel: int, type: Type):
+        self.channel = channel
+        self.type = type
+
+    def key(self):
+        return ("input", self.channel, self.type.name)
+
+    def __repr__(self):
+        return f"#{self.channel}:{self.type.name}"
+
+
+class Literal(Expr):
+    """Constant. `value` is the *logical* host python value — Decimal/int/float
+    for decimals (scaled at compile time), day numbers for dates, python str
+    for strings (resolved against column dictionaries at trace time)."""
+
+    __slots__ = ("value", "type")
+
+    def __init__(self, value: Any, type: Type):
+        self.value = value
+        self.type = type
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+    def key(self):
+        return ("lit", self.value, self.type.name)
+
+    def __repr__(self):
+        return f"{self.value!r}:{self.type.name}"
+
+
+class Call(Expr):
+    """Scalar function call, name-resolved (e.g. '$add', 'substr', 'year')."""
+
+    __slots__ = ("name", "args", "type")
+
+    def __init__(self, name: str, args: Sequence[Expr], type: Type):
+        self.name = name
+        self.args = tuple(args)
+        self.type = type
+
+    def children(self):
+        return self.args
+
+    def with_children(self, children):
+        return Call(self.name, tuple(children), self.type)
+
+    def key(self):
+        return ("call", self.name, tuple(a.key() for a in self.args), self.type.name)
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+class Form(enum.Enum):
+    AND = "and"
+    OR = "or"
+    NOT = "not"
+    IF = "if"                  # if(cond, then, else)
+    CASE = "case"              # searched case: [c1, v1, c2, v2, ..., default]
+    COALESCE = "coalesce"
+    IN = "in"                  # in(value, item1, item2, ...)
+    BETWEEN = "between"        # between(v, lo, hi)
+    IS_NULL = "is_null"
+    CAST = "cast"
+    TRY = "try"
+    NULLIF = "nullif"
+    ROW = "row"
+    DEREFERENCE = "dereference"
+
+
+class SpecialForm(Expr):
+    __slots__ = ("form", "args", "type")
+
+    def __init__(self, form: Form, args: Sequence[Expr], type: Type = BOOLEAN):
+        self.form = form
+        self.args = tuple(args)
+        self.type = type
+
+    def children(self):
+        return self.args
+
+    def with_children(self, children):
+        return SpecialForm(self.form, tuple(children), self.type)
+
+    def key(self):
+        return ("form", self.form.value, tuple(a.key() for a in self.args), self.type.name)
+
+    def __repr__(self):
+        return f"{self.form.value}({', '.join(map(repr, self.args))})"
+
+
+# -- convenience constructors used throughout the planner --------------------
+
+
+def and_(*args: Expr) -> Expr:
+    flat = []
+    for a in args:
+        if isinstance(a, SpecialForm) and a.form == Form.AND:
+            flat.extend(a.args)
+        elif isinstance(a, Literal) and a.value is True:
+            continue
+        else:
+            flat.append(a)
+    if not flat:
+        return Literal(True, BOOLEAN)
+    if len(flat) == 1:
+        return flat[0]
+    return SpecialForm(Form.AND, flat, BOOLEAN)
+
+
+def or_(*args: Expr) -> Expr:
+    if len(args) == 1:
+        return args[0]
+    return SpecialForm(Form.OR, list(args), BOOLEAN)
+
+
+def not_(a: Expr) -> Expr:
+    return SpecialForm(Form.NOT, [a], BOOLEAN)
+
+
+def comparison(op: str, left: Expr, right: Expr) -> Expr:
+    return Call({"=": "$eq", "<>": "$ne", "!=": "$ne", "<": "$lt",
+                 "<=": "$le", ">": "$gt", ">=": "$ge"}[op], [left, right], BOOLEAN)
+
+
+def visit(expr: Expr, fn) -> Expr:
+    """Bottom-up rewrite: fn applied to every node after its children."""
+    kids = expr.children()
+    if kids:
+        expr = expr.with_children([visit(k, fn) for k in kids])
+    return fn(expr)
+
+
+def collect_input_channels(expr: Expr, acc: set | None = None) -> set:
+    if acc is None:
+        acc = set()
+    if isinstance(expr, InputRef):
+        acc.add(expr.channel)
+    for k in expr.children():
+        collect_input_channels(k, acc)
+    return acc

@@ -1,0 +1,47 @@
+"""Host-side string machinery: LIKE translation and dictionary-table helpers.
+
+Reference role: core/trino-main/.../likematcher/LikeMatcher.java and
+operator/scalar/Like*.java — but evaluated once per *dictionary value* instead
+of once per row, then gathered on device by code.
+"""
+
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+
+
+@lru_cache(maxsize=4096)
+def like_to_regex(pattern: str, escape: str | None = None) -> "re.Pattern":
+    """Translate a SQL LIKE pattern into an anchored python regex."""
+    out = []
+    i = 0
+    n = len(pattern)
+    while i < n:
+        ch = pattern[i]
+        if escape and ch == escape:
+            if i + 1 >= n:
+                raise ValueError(
+                    f"LIKE pattern must not end with escape character: {pattern!r}"
+                )
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return re.compile("^" + "".join(out) + "$", flags=re.DOTALL)
+
+
+def like_prefix(pattern: str, escape: str | None = None) -> str | None:
+    """If the pattern is 'prefix%' with no other wildcards, return the prefix
+    (enables an O(log n) dictionary range instead of a full regex table)."""
+    if escape and escape in pattern:
+        return None
+    if pattern.endswith("%") and "%" not in pattern[:-1] and "_" not in pattern:
+        return pattern[:-1]
+    return None
