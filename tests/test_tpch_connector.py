@@ -1,0 +1,153 @@
+"""TPC-H generator connector tests (reference: plugin/trino-tpch tests).
+
+Checks cardinalities, key structure, FK consistency, split determinism, and
+the spec-shaped invariants the queries depend on.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from trino_tpu.connectors.api import TableHandle
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.connectors.tpch.generator import generator_for, CURRENT_DATE
+from trino_tpu.testing import connector_table_to_pandas, tpch_pandas
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector()
+
+
+def test_cardinalities_tiny(conn):
+    md = conn.metadata()
+    assert md.table_statistics("tiny", "region").row_count == 5
+    assert md.table_statistics("tiny", "nation").row_count == 25
+    assert md.table_statistics("tiny", "supplier").row_count == 100
+    assert md.table_statistics("tiny", "customer").row_count == 1500
+    assert md.table_statistics("tiny", "orders").row_count == 15000
+    li = md.table_statistics("tiny", "lineitem").row_count
+    assert 15000 * 1 <= li <= 15000 * 7
+    # lineitem row count is exact and stable
+    assert li == md.table_statistics("tiny", "lineitem").row_count
+
+
+def test_split_determinism_and_coverage(conn):
+    h = TableHandle("tpch", "tiny", "orders")
+    one = connector_table_to_pandas(conn, "tiny", "orders", ["o_orderkey", "o_totalprice"])
+    # re-read with many splits: same rows
+    splits = conn.splits(h, target_splits=7)
+    assert len(splits) > 1
+    parts = []
+    for s in splits:
+        src = conn.page_source(s, ["o_orderkey", "o_totalprice"])
+        for page in src.pages():
+            parts.append(
+                pd.DataFrame(
+                    {"o_orderkey": page[0].values, "o_totalprice": page[1].values}
+                )
+            )
+    many = pd.concat(parts, ignore_index=True)
+    assert len(many) == len(one)
+    a = one.sort_values("o_orderkey").reset_index(drop=True)
+    b = many.sort_values("o_orderkey").reset_index(drop=True)
+    assert (a["o_orderkey"].values == b["o_orderkey"].values).all()
+    # b carries raw cents straight from the page source
+    assert (a["o_totalprice__cents"].values == b["o_totalprice"].values).all()
+
+
+def test_keys_dense_and_fk_consistency():
+    li = tpch_pandas("tiny", "lineitem")
+    orders = tpch_pandas("tiny", "orders")
+    ps = tpch_pandas("tiny", "partsupp")
+    cust = tpch_pandas("tiny", "customer")
+
+    assert orders["o_orderkey"].tolist() == list(range(1, 15001))
+    # every lineitem joins an order
+    assert set(li["l_orderkey"]).issubset(set(orders["o_orderkey"]))
+    # o_custkey skips every third customer and stays in range
+    assert (orders["o_custkey"] % 3 != 0).all()
+    assert orders["o_custkey"].between(1, 1500).all()
+    assert set(cust["c_custkey"]) == set(range(1, 1501))
+    # (l_partkey, l_suppkey) always exists in partsupp  (Q9 depends on this)
+    ps_keys = set(zip(ps["ps_partkey"], ps["ps_suppkey"]))
+    li_keys = set(zip(li["l_partkey"], li["l_suppkey"]))
+    assert li_keys.issubset(ps_keys)
+    # each part has exactly 4 suppliers
+    assert (ps.groupby("ps_partkey").size() == 4).all()
+
+
+def test_derived_flags_and_dates():
+    li = tpch_pandas("tiny", "lineitem")
+    ship = (
+        li["l_shipdate"].values.astype("datetime64[D]")
+        - np.datetime64("1970-01-01", "D")
+    ).astype(int)
+    rcpt = (
+        li["l_receiptdate"].values.astype("datetime64[D]")
+        - np.datetime64("1970-01-01", "D")
+    ).astype(int)
+    # receipt strictly after ship
+    assert (rcpt > ship).all()
+    status = li["l_linestatus"].values
+    assert ((status == "O") == (ship > CURRENT_DATE)).all()
+    flags = li["l_returnflag"].values
+    assert (np.isin(flags[rcpt <= CURRENT_DATE], ["R", "A"])).all()
+    assert (flags[rcpt > CURRENT_DATE] == "N").all()
+    # both linestatus values occur (Q1 groups on them)
+    assert set(status) == {"F", "O"}
+    assert set(flags) == {"A", "N", "R"}
+
+
+def test_totalprice_matches_lineitems():
+    li = tpch_pandas("tiny", "lineitem")
+    orders = tpch_pandas("tiny", "orders")
+    lt = (
+        li["l_extendedprice__cents"]
+        * (100 + li["l_tax__cents"])
+        * (100 - li["l_discount__cents"])
+    ) // 10000
+    per_order = lt.groupby(li["l_orderkey"]).sum()
+    got = orders.set_index("o_orderkey")["o_totalprice__cents"]
+    assert (per_order == got.loc[per_order.index]).all()
+
+
+def test_strings_and_predicate_content():
+    part = tpch_pandas("tiny", "part")
+    # p_type has the spec's 150 values; BRASS appears (Q2)
+    assert part["p_type"].str.endswith("BRASS").any()
+    assert part["p_name"].str.contains("green").any()  # Q9 parameter
+    supp = tpch_pandas("tiny", "supplier")
+    assert supp["s_comment"].str.contains("Customer Complaints").any()  # Q16
+    orders = tpch_pandas("tiny", "orders")
+    assert orders["o_comment"].str.contains("special requests").any()  # Q13
+    cust = tpch_pandas("tiny", "customer")
+    assert set(cust["c_mktsegment"]) == {
+        "AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"
+    }
+    # phone country code ties to nation (Q22 does substring(c_phone,1,2))
+    cc = cust["c_phone"].str.slice(0, 2).astype(int)
+    assert (cc == cust["c_nationkey"] + 10).all()
+
+
+def test_pattern_dictionary_names():
+    cust = tpch_pandas("tiny", "customer")
+    assert cust["c_name"].iloc[0] == "Customer#000000001"
+    assert cust["c_name"].iloc[1499] == "Customer#000001500"
+    gen = generator_for(0.01)
+    d = gen.dictionary("customer", "c_name")
+    assert d.code_of("Customer#000000042") == 41
+    assert d.code_of("nope") == -1
+
+
+def test_retailprice_formula():
+    part = tpch_pandas("tiny", "part")
+    p = part["p_partkey"].values
+    expect = 90000 + ((p // 10) % 20001) + 100 * (p % 1000)
+    assert (part["p_retailprice__cents"].values == expect).all()
+
+
+def test_sf_scaling():
+    md = TpchConnector().metadata()
+    assert md.table_statistics("sf1", "orders").row_count == 1_500_000
+    assert md.table_statistics("sf1", "part").row_count == 200_000

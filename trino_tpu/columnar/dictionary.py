@@ -15,7 +15,6 @@ scalar loop, which is exactly the trade a TPU wants.
 from __future__ import annotations
 
 import bisect
-from functools import cached_property
 
 import numpy as np
 
@@ -131,9 +130,89 @@ class StringDictionary:
             (ix[fn(v)] for v in self.values), dtype=np.int32, count=len(self.values)
         )
 
-    @cached_property
+    @property
     def max_len(self) -> int:
         return max((len(v) for v in self.values), default=0)
+
+
+class _LazySeq:
+    """Read-only sequence computing values on demand (bisect-compatible)."""
+
+    __slots__ = ("fn", "n")
+
+    def __init__(self, fn, n: int):
+        self.fn = fn
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return tuple(self.fn(j) for j in range(*i.indices(self.n)))
+        if i < 0:
+            i += self.n
+        if not 0 <= i < self.n:
+            raise IndexError(i)
+        return self.fn(i)
+
+    def __iter__(self):
+        return (self.fn(i) for i in range(self.n))
+
+    def __add__(self, other):
+        return tuple(self) + tuple(other)
+
+    def __radd__(self, other):
+        return tuple(other) + tuple(self)
+
+
+class PatternDictionary(StringDictionary):
+    """Dictionary whose value at code i is computed by a *monotone* function
+    (e.g. 'Customer#%09d' % (i+1)) — zero-padded formats sort lexicographically
+    in numeric order, so the order-preserving invariant holds without ever
+    materializing the values.  Used for the huge formatted-name columns
+    (c_name, s_name, o_clerk at SF100 would otherwise cost GBs host-side).
+    """
+
+    __slots__ = ("pattern_key",)
+
+    def __init__(self, fn, n: int, pattern_key):
+        object.__setattr__(self, "values", _LazySeq(fn, n))
+        object.__setattr__(self, "_index", None)
+        object.__setattr__(self, "_hash", None)
+        object.__setattr__(self, "pattern_key", pattern_key)
+
+    def __hash__(self):
+        return hash(("pattern", self.pattern_key, len(self.values)))
+
+    def __eq__(self, other):
+        if isinstance(other, PatternDictionary):
+            return (
+                self.pattern_key == other.pattern_key
+                and len(self.values) == len(other.values)
+            )
+        return isinstance(other, StringDictionary) and tuple(self.values) == tuple(
+            getattr(other, "values", ())
+        )
+
+    @property
+    def index(self) -> dict:
+        raise TypeError(
+            "PatternDictionary has no materialized index; use code_of/bounds"
+        )
+
+    def code_of(self, value: str) -> int:
+        lo = bisect.bisect_left(self.values, value)
+        if lo < len(self.values) and self.values[lo] == value:
+            return lo
+        return -1
+
+    def encode(self, values, out=None):
+        return np.fromiter(
+            (0 if v is None else self.code_of(v) for v in values),
+            dtype=np.int32,
+            count=len(values),
+        )
 
 
 def union_dictionaries(a: StringDictionary, b: StringDictionary):

@@ -1,0 +1,33 @@
+"""Connector plugins (reference: plugin/* — 53 modules on spi.Plugin).
+
+Round-1 set mirrors the reference's baseline-critical connectors:
+  tpch       -> plugin/trino-tpch (on-the-fly TPC-H generation at any SF)
+  tpcds      -> plugin/trino-tpcds
+  memory     -> plugin/trino-memory (in-RAM pages store, test workhorse)
+  blackhole  -> plugin/trino-blackhole (null source/sink for perf tests)
+  parquet    -> lib/trino-parquet read path (via pyarrow host decode)
+"""
+
+from trino_tpu.connectors.api import (
+    Connector,
+    ConnectorMetadata,
+    ColumnMeta,
+    TableMetadata,
+    TableHandle,
+    Split,
+    PageSource,
+    TableStatistics,
+    CatalogManager,
+)
+
+__all__ = [
+    "Connector",
+    "ConnectorMetadata",
+    "ColumnMeta",
+    "TableMetadata",
+    "TableHandle",
+    "Split",
+    "PageSource",
+    "TableStatistics",
+    "CatalogManager",
+]

@@ -1,0 +1,206 @@
+"""Connector SPI (reference: core/trino-spi/src/main/java/io/trino/spi/connector/).
+
+The plugin boundary: the engine sees tables as (metadata, splits, page
+sources).  A PageSource yields host-side numpy column data for a split which
+the scan operator turns into device Batches.  Connectors may implement
+predicate pushdown (TupleDomain-style min/max pruning) and report row-count
+statistics the planner uses for capacity planning — on a shape-static device,
+stats are not just cost hints but *allocation* inputs.
+
+Key interface analogs:
+  Connector                -> spi/connector/Connector.java
+  ConnectorMetadata        -> spi/connector/ConnectorMetadata.java:63
+  ConnectorSplitManager    -> splits() here
+  ConnectorPageSource      -> spi/connector/ConnectorPageSource.java:24
+  TableStatistics          -> spi/statistics/TableStatistics.java
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from trino_tpu.types import Type
+from trino_tpu.columnar import StringDictionary
+
+
+@dataclass(frozen=True)
+class ColumnMeta:
+    name: str
+    type: Type
+    #: whether the generator can bound this column's values per split
+    #: (enables min/max split pruning, the TupleDomain analog)
+    ordered: bool = False
+
+
+@dataclass(frozen=True)
+class TableMetadata:
+    schema: str
+    name: str
+    columns: tuple[ColumnMeta, ...]
+
+    def column_index(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(name)
+
+    def column(self, name: str) -> ColumnMeta:
+        return self.columns[self.column_index(name)]
+
+
+@dataclass(frozen=True)
+class TableHandle:
+    catalog: str
+    schema: str
+    table: str
+
+
+@dataclass(frozen=True)
+class ColumnRange:
+    """Min/max bound of a column within a split (for pruning)."""
+
+    low: object
+    high: object
+
+
+@dataclass(frozen=True)
+class Split:
+    """A unit of scan parallelism (reference: spi/connector/ConnectorSplit.java).
+
+    `row_start`/`row_count` describe the slice for generator/memory
+    connectors; file connectors put their own info in `info`.
+    """
+
+    table: TableHandle
+    seq: int
+    row_start: int = 0
+    row_count: int = 0
+    info: object = None
+    #: optional per-column (name, (low, high)) ranges for pruning
+    ranges: tuple = ()
+
+
+@dataclass
+class ColumnData:
+    """Host-side column produced by a PageSource."""
+
+    values: np.ndarray
+    valid: Optional[np.ndarray] = None
+    dictionary: Optional[StringDictionary] = None
+
+
+class PageSource:
+    """Produces host column data for one split, projected columns only."""
+
+    def pages(self) -> Iterator[list[ColumnData]]:
+        raise NotImplementedError
+
+    def row_count(self) -> int:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class ColumnStatistics:
+    distinct_count: Optional[float] = None
+    null_fraction: float = 0.0
+    low: object = None
+    high: object = None
+
+
+@dataclass(frozen=True)
+class TableStatistics:
+    row_count: Optional[int] = None
+    columns: dict = field(default_factory=dict)  # name -> ColumnStatistics
+
+
+class ConnectorMetadata:
+    def list_schemas(self) -> Sequence[str]:
+        raise NotImplementedError
+
+    def list_tables(self, schema: str) -> Sequence[str]:
+        raise NotImplementedError
+
+    def table_metadata(self, schema: str, table: str) -> TableMetadata:
+        raise NotImplementedError
+
+    def table_statistics(self, schema: str, table: str) -> TableStatistics:
+        return TableStatistics()
+
+
+class Connector:
+    """One catalog's implementation."""
+
+    name: str = "connector"
+
+    def metadata(self) -> ConnectorMetadata:
+        raise NotImplementedError
+
+    def splits(
+        self,
+        handle: TableHandle,
+        target_splits: int,
+        predicate=None,
+    ) -> Sequence[Split]:
+        raise NotImplementedError
+
+    def page_source(
+        self,
+        split: Split,
+        columns: Sequence[str],
+        max_rows_per_page: int = 1 << 20,
+    ) -> PageSource:
+        raise NotImplementedError
+
+    # -- write path (memory/blackhole connectors; reference: ConnectorPageSink)
+
+    def supports_writes(self) -> bool:
+        return False
+
+    def page_sink(self, handle: TableHandle, column_names, column_types):
+        raise NotImplementedError
+
+    def create_table(self, schema: str, table: str, columns) -> None:
+        raise NotImplementedError
+
+    def drop_table(self, handle: TableHandle) -> None:
+        raise NotImplementedError
+
+
+class CatalogManager:
+    """catalog name -> Connector (reference: connector/StaticCatalogManager.java)."""
+
+    def __init__(self):
+        self._catalogs: dict[str, Connector] = {}
+
+    def register(self, name: str, connector: Connector) -> None:
+        self._catalogs[name] = connector
+
+    def get(self, name: str) -> Connector:
+        if name not in self._catalogs:
+            raise KeyError(f"catalog not found: {name}")
+        return self._catalogs[name]
+
+    def names(self):
+        return sorted(self._catalogs)
+
+
+def default_catalogs() -> CatalogManager:
+    """The standard test/bench catalog set."""
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.blackhole import BlackholeConnector
+
+    cm = CatalogManager()
+    cm.register("tpch", TpchConnector())
+    cm.register("memory", MemoryConnector())
+    cm.register("blackhole", BlackholeConnector())
+    try:
+        from trino_tpu.connectors.tpcds import TpcdsConnector
+
+        cm.register("tpcds", TpcdsConnector())
+    except ImportError:  # pragma: no cover
+        pass
+    return cm

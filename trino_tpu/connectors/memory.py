@@ -1,0 +1,181 @@
+"""In-RAM connector (reference: plugin/trino-memory — MemoryPagesStore).
+
+The test workhorse: CREATE TABLE / INSERT land host-side numpy columns;
+scans serve them back as pages.  Supports the engine's write path
+(page_sink) so CTAS and INSERT tests run against it.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from trino_tpu.types import Type
+from trino_tpu.columnar import StringDictionary
+from trino_tpu.connectors.api import (
+    ColumnData,
+    ColumnMeta,
+    Connector,
+    ConnectorMetadata,
+    PageSource,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+
+
+@dataclass
+class _Stored:
+    meta: TableMetadata
+    columns: list  # list[ColumnData], concatenated
+
+    @property
+    def rows(self) -> int:
+        return len(self.columns[0].values) if self.columns else 0
+
+
+class MemoryMetadata(ConnectorMetadata):
+    def __init__(self, store):
+        self.store = store
+
+    def list_schemas(self):
+        return sorted({s for s, _ in self.store})
+
+    def list_tables(self, schema: str):
+        return sorted(t for s, t in self.store if s == schema)
+
+    def table_metadata(self, schema: str, table: str) -> TableMetadata:
+        key = (schema, table)
+        if key not in self.store:
+            raise KeyError(f"memory table not found: {schema}.{table}")
+        return self.store[key].meta
+
+    def table_statistics(self, schema: str, table: str) -> TableStatistics:
+        key = (schema, table)
+        if key not in self.store:
+            return TableStatistics()
+        return TableStatistics(row_count=self.store[key].rows)
+
+
+class _MemoryPageSource(PageSource):
+    def __init__(self, stored: _Stored, split: Split, columns):
+        self.stored = stored
+        self.split = split
+        self.columns = columns
+
+    def row_count(self) -> int:
+        return self.split.row_count
+
+    def pages(self):
+        a = self.split.row_start
+        b = a + self.split.row_count
+        ix = [self.stored.meta.column_index(c) for c in self.columns]
+        yield [
+            ColumnData(
+                self.stored.columns[i].values[a:b],
+                None
+                if self.stored.columns[i].valid is None
+                else self.stored.columns[i].valid[a:b],
+                self.stored.columns[i].dictionary,
+            )
+            for i in ix
+        ]
+
+
+class _MemorySink:
+    def __init__(self, stored: _Stored):
+        self.stored = stored
+
+    def append(self, columns: Sequence[ColumnData]) -> int:
+        st = self.stored
+        if not st.columns:
+            st.columns = list(columns)
+        else:
+            merged = []
+            for old, new in zip(st.columns, columns):
+                dictionary = old.dictionary
+                ov, nv = old.values, new.values
+                if (old.dictionary is None) != (new.dictionary is None):
+                    raise TypeError(
+                        "cannot append a dictionary-encoded page to a plain "
+                        "column (or vice versa)"
+                    )
+                if old.dictionary is not None:
+                    from trino_tpu.columnar.dictionary import union_dictionaries
+
+                    dictionary, ra, rb = union_dictionaries(
+                        old.dictionary, new.dictionary
+                    )
+                    ov = ra[ov.astype(np.int64)]
+                    nv = rb[nv.astype(np.int64)]
+                valid = None
+                if old.valid is not None or new.valid is not None:
+                    valid = np.concatenate(
+                        [
+                            old.valid
+                            if old.valid is not None
+                            else np.ones(len(ov), bool),
+                            new.valid
+                            if new.valid is not None
+                            else np.ones(len(nv), bool),
+                        ]
+                    )
+                merged.append(
+                    ColumnData(np.concatenate([ov, nv]), valid, dictionary)
+                )
+            st.columns = merged
+        return len(columns[0].values) if columns else 0
+
+
+class MemoryConnector(Connector):
+    name = "memory"
+
+    def __init__(self):
+        self.store: dict[tuple, _Stored] = {}
+        self._metadata = MemoryMetadata(self.store)
+
+    def metadata(self):
+        return self._metadata
+
+    def supports_writes(self) -> bool:
+        return True
+
+    def create_table(self, schema: str, table: str, columns: Sequence[ColumnMeta]):
+        self.store[(schema, table)] = _Stored(
+            TableMetadata(schema, table, tuple(columns)), []
+        )
+
+    def drop_table(self, handle: TableHandle):
+        self.store.pop((handle.schema, handle.table), None)
+
+    def page_sink(self, handle: TableHandle, column_names, column_types):
+        key = (handle.schema, handle.table)
+        if key not in self.store:
+            self.create_table(
+                handle.schema,
+                handle.table,
+                [ColumnMeta(n, t) for n, t in zip(column_names, column_types)],
+            )
+        return _MemorySink(self.store[key])
+
+    def splits(self, handle: TableHandle, target_splits: int, predicate=None):
+        st = self.store[(handle.schema, handle.table)]
+        n = st.rows
+        nsplits = max(1, min(target_splits, math.ceil(n / 4096))) if n else 1
+        per = math.ceil(n / nsplits) if n else 0
+        out = []
+        for i in range(nsplits):
+            a = i * per
+            b = min(n, a + per)
+            out.append(Split(handle, i, row_start=a, row_count=max(0, b - a)))
+            if b >= n:
+                break
+        return out
+
+    def page_source(self, split: Split, columns, max_rows_per_page: int = 1 << 20):
+        st = self.store[(split.table.schema, split.table.table)]
+        return _MemoryPageSource(st, split, list(columns))

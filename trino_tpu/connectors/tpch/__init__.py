@@ -1,0 +1,136 @@
+"""TPC-H generator connector (reference: plugin/trino-tpch — TpchConnectorFactory,
+TpchMetadata, TpchRecordSetProvider/TpchPageSourceProvider).
+
+Schemas tiny (SF0.01), sf1, sf10, sf100, ... generate rows on the fly; splits
+are row ranges (order ranges for lineitem so each order's lines stay together,
+mirroring the reference's per-order generation).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import numpy as np
+
+from trino_tpu.connectors.api import (
+    ColumnData,
+    Connector,
+    ConnectorMetadata,
+    PageSource,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+    ColumnStatistics,
+)
+from trino_tpu.connectors.tpch import schema as tpch_schema
+from trino_tpu.connectors.tpch.generator import TpchGenerator, generator_for
+
+
+class TpchMetadata(ConnectorMetadata):
+    def list_schemas(self):
+        return sorted(tpch_schema.SCHEMAS)
+
+    def list_tables(self, schema: str):
+        tpch_schema.schema_scale(schema)
+        return list(tpch_schema.TABLE_NAMES)
+
+    def table_metadata(self, schema: str, table: str) -> TableMetadata:
+        tpch_schema.schema_scale(schema)
+        if table not in tpch_schema.TABLE_NAMES:
+            raise KeyError(f"tpch table not found: {table}")
+        return tpch_schema.table_metadata(schema, table)
+
+    def table_statistics(self, schema: str, table: str) -> TableStatistics:
+        sf = tpch_schema.schema_scale(schema)
+        gen = generator_for(sf)
+        rows = gen.row_count(table)
+        cols = {}
+        key_col = {
+            "region": "r_regionkey",
+            "nation": "n_nationkey",
+            "supplier": "s_suppkey",
+            "part": "p_partkey",
+            "customer": "c_custkey",
+            "orders": "o_orderkey",
+        }.get(table)
+        if key_col:
+            cols[key_col] = ColumnStatistics(
+                distinct_count=rows, low=0 if table in ("region", "nation") else 1,
+                high=rows if table not in ("region", "nation") else rows - 1,
+            )
+        if table == "lineitem":
+            cols["l_orderkey"] = ColumnStatistics(
+                distinct_count=gen.O, low=1, high=gen.O
+            )
+        return TableStatistics(row_count=rows, columns=cols)
+
+
+class TpchPageSource(PageSource):
+    def __init__(self, gen: TpchGenerator, split: Split, columns, page_rows: int):
+        self.gen = gen
+        self.split = split
+        self.columns = list(columns)
+        self.page_rows = page_rows
+
+    def row_count(self) -> int:
+        if self.split.table.table == "lineitem":
+            prefix = self.gen.lineitem_counts_prefix()
+            a = self.split.row_start
+            b = a + self.split.row_count
+            return int(prefix[b] - prefix[a])
+        return self.split.row_count
+
+    def pages(self):
+        t = self.split.table.table
+        start, remaining = self.split.row_start, self.split.row_count
+        if t == "lineitem":
+            # chunk by orders so ~page_rows lines per page (avg 4 lines/order)
+            per_page = max(1, self.page_rows // 5)
+        else:
+            per_page = self.page_rows
+        while remaining > 0:
+            n = min(per_page, remaining)
+            data = self.gen.generate(t, start, n, self.columns)
+            yield [data[c] for c in self.columns]
+            start += n
+            remaining -= n
+
+
+class TpchConnector(Connector):
+    name = "tpch"
+
+    def __init__(self):
+        self._metadata = TpchMetadata()
+
+    def metadata(self) -> TpchMetadata:
+        return self._metadata
+
+    def splits(self, handle: TableHandle, target_splits: int, predicate=None):
+        sf = tpch_schema.schema_scale(handle.schema)
+        gen = generator_for(sf)
+        t = handle.table
+        # lineitem/orders splits are order ranges; others row ranges
+        n = gen.O if t in ("orders", "lineitem") else gen.row_count(t)
+        nsplits = max(1, min(target_splits, math.ceil(n / 1024)))
+        per = math.ceil(n / nsplits)
+        out = []
+        for i in range(nsplits):
+            a = i * per
+            b = min(n, a + per)
+            if a >= b:
+                break
+            ranges = ()
+            if t == "orders":
+                ranges = (("o_orderkey", (a + 1, b)),)
+            elif t == "lineitem":
+                ranges = (("l_orderkey", (a + 1, b)),)
+            out.append(
+                Split(handle, i, row_start=a, row_count=b - a, ranges=ranges)
+            )
+        return out
+
+    def page_source(self, split: Split, columns, max_rows_per_page: int = 1 << 20):
+        sf = tpch_schema.schema_scale(split.table.schema)
+        return TpchPageSource(generator_for(sf), split, columns, max_rows_per_page)

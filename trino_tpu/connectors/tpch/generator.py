@@ -1,0 +1,696 @@
+"""Vectorized, counter-based TPC-H data generator.
+
+Reference role: the row generators behind plugin/trino-tpch
+(TpchRecordSetProvider / io.trino.tpch dbgen port).  Re-designed for a columnar
+TPU engine: every value is a pure function of (table, column, row index) via a
+splitmix64 hash, so any split's columns generate independently, in O(rows)
+vectorized numpy, at any scale factor, with zero shared state.
+
+Distributions/shapes follow the TPC-H spec closely enough for every query to
+exercise its intended plan shape (key structure, FK consistency — including
+l_suppkey drawn from the part's 4 partsupp suppliers and o_custkey skipping
+every third customer — date windows, derived flags).  The correctness oracle is
+pandas over these same tables, so engine results are checked end-to-end.
+Text columns draw from bounded pools so dictionaries are global per column
+(shape- and trace-stable across splits).
+"""
+
+from __future__ import annotations
+
+import datetime
+from functools import lru_cache
+
+import numpy as np
+
+from trino_tpu.columnar.dictionary import PatternDictionary, StringDictionary
+from trino_tpu.connectors.api import ColumnData
+from trino_tpu.connectors.tpch.schema import BASE_ROWS, scaled_rows
+
+# ---------------------------------------------------------------------------
+# counter-based RNG: splitmix64 over (seed ^ stream, index)
+
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix(x: np.ndarray) -> np.ndarray:
+    x = np.asarray(x, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        z = x + _GOLDEN
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+
+def _stream(name: str) -> np.uint64:
+    h = np.uint64(1469598103934665603)
+    with np.errstate(over="ignore"):
+        for ch in name.encode():
+            h = (h ^ np.uint64(ch)) * np.uint64(1099511628211)
+    return h
+
+
+def _rand64(stream: str, idx: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        return _mix(np.asarray(idx, np.uint64) * np.uint64(0x2545F4914F6CDD1D) + _stream(stream))
+
+
+def randint(stream: str, idx, lo: int, hi: int) -> np.ndarray:
+    """Uniform integer in [lo, hi] inclusive."""
+    r = _rand64(stream, idx)
+    return (r % np.uint64(hi - lo + 1)).astype(np.int64) + lo
+
+
+# ---------------------------------------------------------------------------
+# fixed vocabularies (spec 4.2.2.13)
+
+REGIONS = ("AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST")
+NATIONS = (
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+)
+SEGMENTS = ("AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY")
+PRIORITIES = ("1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW")
+INSTRUCTIONS = ("COLLECT COD", "DELIVER IN PERSON", "NONE", "TAKE BACK RETURN")
+MODES = ("AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK")
+TYPE_S1 = ("ECONOMY", "LARGE", "MEDIUM", "PROMO", "SMALL", "STANDARD")
+TYPE_S2 = ("ANODIZED", "BRUSHED", "BURNISHED", "PLATED", "POLISHED")
+TYPE_S3 = ("BRASS", "COPPER", "NICKEL", "STEEL", "TIN")
+CONTAINER_S1 = ("JUMBO", "LG", "MED", "SM", "WRAP")
+CONTAINER_S2 = ("BAG", "BOX", "CAN", "CASE", "DRUM", "JAR", "PACK", "PKG")
+COLORS = (
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+    "blanched", "blue", "blush", "brown", "burlywood", "burnished", "chartreuse",
+    "chiffon", "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan",
+    "dark", "deep", "dim", "dodger", "drab", "firebrick", "floral", "forest",
+    "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey", "honeydew",
+    "hot", "hunter", "indian", "ivory", "khaki", "lace", "lavender", "lawn",
+    "lemon", "light", "lime", "linen", "magenta", "maroon", "medium", "metallic",
+    "midnight", "mint", "misty", "moccasin", "navajo", "navy", "olive", "orange",
+    "orchid", "pale", "papaya", "peach", "peru", "pink", "plum", "powder",
+    "puff", "purple", "red", "rose", "rosy", "royal", "saddle", "salmon",
+    "sandy", "seashell", "sienna", "sky", "slate", "smoke", "snow", "spring",
+    "steel", "tan", "thistle", "tomato", "turquoise", "violet", "wheat", "white",
+    "yellow",
+)
+_COMMENT_VOCAB = (
+    "about", "above", "according", "accounts", "across", "after", "again",
+    "against", "along", "alongside", "among", "apart", "asymptotes", "attain",
+    "bold", "boost", "braids", "brave", "busily", "busy", "carefully",
+    "cautious", "close", "courts", "daring", "deposits", "dependencies",
+    "depths", "doggedly", "dolphins", "dugouts", "during", "enticing",
+    "escapades", "even", "excuses", "express", "final", "fluffily", "foxes",
+    "frays", "furious", "furiously", "gifts", "grouches", "haggle", "hockey",
+    "ideas", "instructions", "ironic", "instead", "integrate", "kindle",
+    "notornis", "packages", "pains", "patterns", "pending", "permanent",
+    "pinto", "platelets", "players", "quick", "quickly", "quiet", "realms",
+    "regular", "requests", "sauternes", "sentiments", "silent", "sleep",
+    "slyly", "special", "stealthy", "theodolites", "thin", "ruthless",
+    "unusual", "wake", "warhorses", "waters",
+)
+
+EPOCH = datetime.date(1970, 1, 1)
+START_DATE = (datetime.date(1992, 1, 1) - EPOCH).days      # 8035
+END_DATE = (datetime.date(1998, 12, 31) - EPOCH).days
+CURRENT_DATE = (datetime.date(1995, 6, 17) - EPOCH).days   # spec 4.2.2.12
+ORDER_DATE_SPAN = END_DATE - START_DATE - 151              # last orderdate
+
+
+# ---------------------------------------------------------------------------
+# bounded text pools (sorted => global, order-preserving dictionaries)
+
+
+@lru_cache(maxsize=32)
+def _comment_pool(tag: str, size: int, max_words: int, special: str | None = None):
+    """Deterministic pool of `size` comment strings; ~1/2000 contain the
+    `special` marker phrase when given (for Q13/Q16-style predicates)."""
+    rng = np.random.default_rng(abs(hash(("pool", tag))) % (2**32))
+    vocab = np.array(_COMMENT_VOCAB)
+    out = set()
+    while len(out) < size:
+        need = size - len(out)
+        nwords = rng.integers(3, max_words + 1, size=need)
+        picks = rng.integers(0, len(vocab), size=(need, max_words))
+        for k in range(need):
+            words = vocab[picks[k, : nwords[k]]]
+            out.add(" ".join(words))
+    pool = sorted(out)[:size]
+    if special is not None:
+        # overwrite a deterministic slice with marker-bearing comments
+        n_special = max(4, size // 500)
+        for j in range(n_special):
+            i = (j * 997 + 13) % size
+            pool[i] = f"{pool[i][:10]}{special}{pool[i][10:20]}"
+        pool = sorted(set(pool))
+    return tuple(pool)
+
+
+@lru_cache(maxsize=32)
+def _pool_dict(tag: str, size: int, max_words: int, special: str | None = None):
+    return StringDictionary(_comment_pool(tag, size, max_words, special))
+
+
+def _pool_codes(dict_: StringDictionary, stream: str, idx) -> np.ndarray:
+    return (_rand64(stream, idx) % np.uint64(len(dict_))).astype(np.int32)
+
+
+@lru_cache(maxsize=32)
+def _address_pool(tag: str, size: int):
+    rng = np.random.default_rng(abs(hash(("addr", tag))) % (2**32))
+    chars = np.array(list("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789 ,"))
+    out = set()
+    while len(out) < size:
+        need = size - len(out)
+        lens = rng.integers(10, 30, size=need)
+        picks = rng.integers(0, len(chars), size=(need, 30))
+        for k in range(need):
+            out.add("".join(chars[picks[k, : lens[k]]]))
+    return StringDictionary(sorted(out)[:size])
+
+
+def _fixed_dict(values) -> StringDictionary:
+    return StringDictionary(sorted(values))
+
+
+def _choice_codes(d: StringDictionary, values, stream, idx) -> np.ndarray:
+    """Pick uniformly among `values` (a subset giving the spec's order),
+    returning codes in dictionary d."""
+    lookup = np.fromiter((d.code_of(v) for v in values), np.int32, len(values))
+    r = (_rand64(stream, idx) % np.uint64(len(values))).astype(np.int64)
+    return lookup[r]
+
+
+def _pattern_dict(prefix: str, width: int, n: int) -> PatternDictionary:
+    fmt = prefix + "#%0" + str(width) + "d"
+
+    def fn(i: int) -> str:
+        return fmt % (i + 1)
+
+    return PatternDictionary(fn, n, (prefix, width))
+
+
+# ---------------------------------------------------------------------------
+# key-structure helpers (FK consistency)
+
+
+def _num_valid_custkeys(C: int) -> int:
+    return C - C // 3
+
+
+def _custkey_from_rank(r: np.ndarray) -> np.ndarray:
+    """kth customer key skipping multiples of 3: 1,2,4,5,7,8,..."""
+    return (r // 2) * 3 + 1 + (r % 2)
+
+
+def _ps_suppkey(partkey: np.ndarray, i, S: int) -> np.ndarray:
+    """Supplier j (0..3) for a part (spec 4.2.3 partsupp bridge)."""
+    p = np.asarray(partkey, np.int64)
+    return ((p + i * (S // 4 + (p - 1) // S)) % S) + 1
+
+
+def _retailprice_cents(partkey: np.ndarray) -> np.ndarray:
+    p = np.asarray(partkey, np.int64)
+    return 90000 + ((p // 10) % 20001) + 100 * (p % 1000)
+
+
+def _line_count(order_idx: np.ndarray) -> np.ndarray:
+    return 1 + (_rand64("l_count", order_idx) % np.uint64(7)).astype(np.int64)
+
+
+@lru_cache(maxsize=8)
+def _lineitem_prefix(num_orders: int) -> np.ndarray:
+    lc = _line_count(np.arange(num_orders, dtype=np.int64))
+    out = np.zeros(num_orders + 1, dtype=np.int64)
+    np.cumsum(lc, out=out[1:])
+    return out
+
+
+@lru_cache(maxsize=1)
+def _phone_pool() -> StringDictionary:
+    # bounded pool: country code (10..34) x 256 local variants
+    vals = []
+    for cc in range(10, 35):
+        for j in range(256):
+            h = int(_mix(np.uint64(cc * 7919 + j)))
+            a, b, c = 100 + h % 900, 100 + (h >> 10) % 900, 1000 + (h >> 20) % 9000
+            vals.append(f"{cc}-{a}-{b}-{c}")
+    return StringDictionary(sorted(set(vals)))
+
+
+@lru_cache(maxsize=1)
+def _phone_cc_ranges() -> np.ndarray:
+    """[25, 2] (lo, hi) code ranges per country code in the phone pool."""
+    d = _phone_pool()
+    out = np.zeros((25, 2), dtype=np.int64)
+    for i, cc in enumerate(range(10, 35)):
+        out[i] = d.prefix_range(f"{cc}-")
+    return out
+
+
+@lru_cache(maxsize=1)
+def _pname_pool() -> StringDictionary:
+    # p_name = 2 colors joined (bounded pool, contains every color)
+    vals = {f"{a} {b}" for a in COLORS for b in COLORS if a < b}
+    return StringDictionary(sorted(vals))
+
+
+@lru_cache(maxsize=4)
+def _complaint_codes(tag: str, size: int, max_words: int, special: str) -> np.ndarray:
+    d = _pool_dict(tag, size, max_words, special)
+    return np.flatnonzero(
+        np.fromiter((special in v for v in d.values), bool, len(d))
+    ).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# per-table generators: (sf, row_start, row_count, columns) -> {name: ColumnData}
+
+
+class TpchGenerator:
+    def __init__(self, sf: float):
+        self.sf = sf
+        self.S = scaled_rows("supplier", sf)
+        self.P = scaled_rows("part", sf)
+        self.C = scaled_rows("customer", sf)
+        self.O = scaled_rows("orders", sf)
+
+    # -- cardinalities -------------------------------------------------------
+
+    def row_count(self, table: str) -> int:
+        if table == "lineitem":
+            return int(self.lineitem_counts_prefix()[-1])
+        if table == "partsupp":
+            return self.P * 4
+        return scaled_rows(table, self.sf)
+
+    def lineitem_counts_prefix(self) -> np.ndarray:
+        """prefix[i] = number of lineitems in orders[0..i); prefix[O] total."""
+        return _lineitem_prefix(self.O)
+
+    # -- dictionaries (global per column) ------------------------------------
+
+    @lru_cache(maxsize=512)
+    def dictionary(self, table: str, column: str) -> StringDictionary | None:
+        d = {
+            ("region", "r_name"): lambda: _fixed_dict(REGIONS),
+            ("region", "r_comment"): lambda: _pool_dict("r_comment", 8, 10),
+            ("nation", "n_name"): lambda: _fixed_dict(n for n, _ in NATIONS),
+            ("nation", "n_comment"): lambda: _pool_dict("n_comment", 32, 10),
+            ("supplier", "s_name"): lambda: _pattern_dict("Supplier", 9, self.S),
+            ("supplier", "s_address"): lambda: _address_pool("s_address", 8192),
+            ("supplier", "s_phone"): lambda: self._phone_dict(),
+            ("supplier", "s_comment"): lambda: _pool_dict(
+                "s_comment", 16384, 12, "Customer Complaints"
+            ),
+            ("part", "p_name"): lambda: self._pname_dict(),
+            ("part", "p_mfgr"): lambda: _pattern_dict("Manufacturer", 1, 5),
+            ("part", "p_brand"): lambda: _fixed_dict(
+                f"Brand#{m}{n}" for m in range(1, 6) for n in range(1, 6)
+            ),
+            ("part", "p_type"): lambda: _fixed_dict(
+                f"{a} {b} {c}" for a in TYPE_S1 for b in TYPE_S2 for c in TYPE_S3
+            ),
+            ("part", "p_container"): lambda: _fixed_dict(
+                f"{a} {b}" for a in CONTAINER_S1 for b in CONTAINER_S2
+            ),
+            ("part", "p_comment"): lambda: _pool_dict("p_comment", 8192, 5),
+            ("partsupp", "ps_comment"): lambda: _pool_dict("ps_comment", 16384, 20),
+            ("customer", "c_name"): lambda: _pattern_dict("Customer", 9, self.C),
+            ("customer", "c_address"): lambda: _address_pool("c_address", 16384),
+            ("customer", "c_phone"): lambda: self._phone_dict(),
+            ("customer", "c_mktsegment"): lambda: _fixed_dict(SEGMENTS),
+            ("customer", "c_comment"): lambda: _pool_dict("c_comment", 16384, 14),
+            ("orders", "o_orderstatus"): lambda: _fixed_dict("FOP"),
+            ("orders", "o_orderpriority"): lambda: _fixed_dict(PRIORITIES),
+            ("orders", "o_clerk"): lambda: _pattern_dict(
+                "Clerk", 9, max(1000, int(1000 * self.sf))
+            ),
+            ("orders", "o_comment"): lambda: _pool_dict(
+                "o_comment", 32768, 10, "special requests"
+            ),
+            ("lineitem", "l_returnflag"): lambda: _fixed_dict("ANR"),
+            ("lineitem", "l_linestatus"): lambda: _fixed_dict("FO"),
+            ("lineitem", "l_shipinstruct"): lambda: _fixed_dict(INSTRUCTIONS),
+            ("lineitem", "l_shipmode"): lambda: _fixed_dict(MODES),
+            ("lineitem", "l_comment"): lambda: _pool_dict("l_comment", 32768, 6),
+        }.get((table, column))
+        return d() if d else None
+
+    def _phone_dict(self) -> StringDictionary:
+        return _phone_pool()
+
+    def _pname_dict(self) -> StringDictionary:
+        return _pname_pool()
+
+    def _phone_codes(self, nationkey: np.ndarray, stream: str, idx) -> np.ndarray:
+        """Vectorized: random variant within the customer's country-code range,
+        so substring(phone, 1, 2) == nationkey + 10 always holds (Q22)."""
+        ranges = _phone_cc_ranges()
+        nk = nationkey.astype(np.int64)
+        lo, hi = ranges[nk, 0], ranges[nk, 1]
+        h = (_rand64(stream, idx) % np.uint64(1 << 32)).astype(np.int64)
+        return (lo + h % np.maximum(hi - lo, 1)).astype(np.int32)
+
+    # -- generation ----------------------------------------------------------
+
+    def generate(self, table: str, row_start: int, row_count: int, columns):
+        fn = getattr(self, "_gen_" + table)
+        return fn(row_start, row_count, list(columns))
+
+    def _money(self, cents: np.ndarray) -> ColumnData:
+        return ColumnData(cents.astype(np.int64))
+
+    def _gen_region(self, start, count, columns):
+        idx = np.arange(start, start + count, dtype=np.int64)
+        out = {}
+        for col in columns:
+            if col == "r_regionkey":
+                out[col] = ColumnData(idx)
+            elif col == "r_name":
+                d = self.dictionary("region", "r_name")
+                out[col] = ColumnData(d.encode([REGIONS[i] for i in idx]), dictionary=d)
+            elif col == "r_comment":
+                d = self.dictionary("region", "r_comment")
+                out[col] = ColumnData(_pool_codes(d, "r_comment", idx), dictionary=d)
+        return out
+
+    def _gen_nation(self, start, count, columns):
+        idx = np.arange(start, start + count, dtype=np.int64)
+        out = {}
+        for col in columns:
+            if col == "n_nationkey":
+                out[col] = ColumnData(idx)
+            elif col == "n_name":
+                d = self.dictionary("nation", "n_name")
+                out[col] = ColumnData(
+                    d.encode([NATIONS[i][0] for i in idx]), dictionary=d
+                )
+            elif col == "n_regionkey":
+                out[col] = ColumnData(
+                    np.array([NATIONS[i][1] for i in idx], dtype=np.int64)
+                )
+            elif col == "n_comment":
+                d = self.dictionary("nation", "n_comment")
+                out[col] = ColumnData(_pool_codes(d, "n_comment", idx), dictionary=d)
+        return out
+
+    def _gen_supplier(self, start, count, columns):
+        idx = np.arange(start, start + count, dtype=np.int64)
+        key = idx + 1
+        nk = randint("s_nation", idx, 0, 24)
+        out = {}
+        for col in columns:
+            if col == "s_suppkey":
+                out[col] = ColumnData(key)
+            elif col == "s_name":
+                d = self.dictionary("supplier", "s_name")
+                out[col] = ColumnData(idx.astype(np.int32), dictionary=d)
+            elif col == "s_address":
+                d = self.dictionary("supplier", "s_address")
+                out[col] = ColumnData(_pool_codes(d, "s_address", idx), dictionary=d)
+            elif col == "s_nationkey":
+                out[col] = ColumnData(nk)
+            elif col == "s_phone":
+                d = self.dictionary("supplier", "s_phone")
+                out[col] = ColumnData(
+                    self._phone_codes(nk, "s_phone", idx), dictionary=d
+                )
+            elif col == "s_acctbal":
+                out[col] = self._money(randint("s_acctbal", idx, -99999, 999999))
+            elif col == "s_comment":
+                d = self.dictionary("supplier", "s_comment")
+                codes = _pool_codes(d, "s_comment", idx)
+                # deterministic ~1/200 suppliers carry the complaint marker
+                special = _complaint_codes(
+                    "s_comment", 16384, 12, "Customer Complaints"
+                )
+                marked = key % 199 == 3
+                codes = np.where(
+                    marked, special[(key % len(special)).astype(np.int64)], codes
+                )
+                out[col] = ColumnData(codes.astype(np.int32), dictionary=d)
+        return out
+
+    def _gen_part(self, start, count, columns):
+        idx = np.arange(start, start + count, dtype=np.int64)
+        key = idx + 1
+        out = {}
+        mfgr = 1 + (_rand64("p_mfgr", idx) % np.uint64(5)).astype(np.int64)
+        for col in columns:
+            if col == "p_partkey":
+                out[col] = ColumnData(key)
+            elif col == "p_name":
+                d = self._pname_dict()
+                out[col] = ColumnData(_pool_codes(d, "p_name", idx), dictionary=d)
+            elif col == "p_mfgr":
+                d = self.dictionary("part", "p_mfgr")
+                out[col] = ColumnData((mfgr - 1).astype(np.int32), dictionary=d)
+            elif col == "p_brand":
+                d = self.dictionary("part", "p_brand")
+                brand = 1 + (_rand64("p_brand", idx) % np.uint64(5)).astype(np.int64)
+                names = [f"Brand#{m}{n}" for m, n in zip(mfgr, brand)]
+                out[col] = ColumnData(d.encode(names), dictionary=d)
+            elif col == "p_type":
+                d = self.dictionary("part", "p_type")
+                out[col] = ColumnData(
+                    _pool_codes(d, "p_type", idx), dictionary=d
+                )
+            elif col == "p_size":
+                out[col] = ColumnData(randint("p_size", idx, 1, 50))
+            elif col == "p_container":
+                d = self.dictionary("part", "p_container")
+                out[col] = ColumnData(_pool_codes(d, "p_container", idx), dictionary=d)
+            elif col == "p_retailprice":
+                out[col] = self._money(_retailprice_cents(key))
+            elif col == "p_comment":
+                d = self.dictionary("part", "p_comment")
+                out[col] = ColumnData(_pool_codes(d, "p_comment", idx), dictionary=d)
+        return out
+
+    def _gen_partsupp(self, start, count, columns):
+        idx = np.arange(start, start + count, dtype=np.int64)
+        partkey = idx // 4 + 1
+        j = idx % 4
+        out = {}
+        for col in columns:
+            if col == "ps_partkey":
+                out[col] = ColumnData(partkey)
+            elif col == "ps_suppkey":
+                out[col] = ColumnData(_ps_suppkey(partkey, j, self.S))
+            elif col == "ps_availqty":
+                out[col] = ColumnData(randint("ps_avail", idx, 1, 9999))
+            elif col == "ps_supplycost":
+                out[col] = self._money(randint("ps_cost", idx, 100, 100000))
+            elif col == "ps_comment":
+                d = self.dictionary("partsupp", "ps_comment")
+                out[col] = ColumnData(_pool_codes(d, "ps_comment", idx), dictionary=d)
+        return out
+
+    def _gen_customer(self, start, count, columns):
+        idx = np.arange(start, start + count, dtype=np.int64)
+        key = idx + 1
+        nk = randint("c_nation", idx, 0, 24)
+        out = {}
+        for col in columns:
+            if col == "c_custkey":
+                out[col] = ColumnData(key)
+            elif col == "c_name":
+                d = self.dictionary("customer", "c_name")
+                out[col] = ColumnData(idx.astype(np.int32), dictionary=d)
+            elif col == "c_address":
+                d = self.dictionary("customer", "c_address")
+                out[col] = ColumnData(_pool_codes(d, "c_address", idx), dictionary=d)
+            elif col == "c_nationkey":
+                out[col] = ColumnData(nk)
+            elif col == "c_phone":
+                d = self.dictionary("customer", "c_phone")
+                out[col] = ColumnData(
+                    self._phone_codes(nk, "c_phone", idx), dictionary=d
+                )
+            elif col == "c_acctbal":
+                out[col] = self._money(randint("c_acctbal", idx, -99999, 999999))
+            elif col == "c_mktsegment":
+                d = self.dictionary("customer", "c_mktsegment")
+                out[col] = ColumnData(
+                    _pool_codes(d, "c_mktseg", idx), dictionary=d
+                )
+            elif col == "c_comment":
+                d = self.dictionary("customer", "c_comment")
+                out[col] = ColumnData(_pool_codes(d, "c_comment", idx), dictionary=d)
+        return out
+
+    # -- orders + lineitem (generated from the same per-order streams) -------
+
+    def _order_dates(self, oidx: np.ndarray) -> np.ndarray:
+        return START_DATE + (
+            _rand64("o_date", oidx) % np.uint64(ORDER_DATE_SPAN)
+        ).astype(np.int64)
+
+    def _line_arrays(self, oidx: np.ndarray):
+        """Flattened per-line arrays for the given order indices.
+
+        Returns dict of numpy arrays, all length sum(line_count)."""
+        lc = _line_count(oidx)
+        total = int(lc.sum())
+        order_rep = np.repeat(oidx, lc)
+        # line number within order: 1..lc
+        ln = np.arange(total, dtype=np.int64) - np.repeat(
+            np.cumsum(lc) - lc, lc
+        ) + 1
+        lid = order_rep * 8 + ln  # unique per line, stable across splits
+        odate = np.repeat(self._order_dates(oidx), lc)
+        partkey = 1 + (_rand64("l_part", lid) % np.uint64(self.P)).astype(np.int64)
+        suppkey = _ps_suppkey(partkey, (_rand64("l_supp", lid) % np.uint64(4)).astype(np.int64), self.S)
+        qty = 1 + (_rand64("l_qty", lid) % np.uint64(50)).astype(np.int64)
+        extprice = qty * _retailprice_cents(partkey)
+        disc = (_rand64("l_disc", lid) % np.uint64(11)).astype(np.int64)     # 0.00-0.10
+        tax = (_rand64("l_tax", lid) % np.uint64(9)).astype(np.int64)        # 0.00-0.08
+        shipdate = odate + 1 + (_rand64("l_ship", lid) % np.uint64(121)).astype(np.int64)
+        commitdate = odate + 30 + (_rand64("l_commit", lid) % np.uint64(61)).astype(np.int64)
+        receiptdate = shipdate + 1 + (_rand64("l_rcpt", lid) % np.uint64(30)).astype(np.int64)
+        return {
+            "order_idx": order_rep,
+            "orderkey": order_rep + 1,
+            "linenumber": ln,
+            "lid": lid,
+            "partkey": partkey,
+            "suppkey": suppkey,
+            "quantity": qty * 100,  # decimal(12,2) cents-style
+            "extendedprice": extprice,
+            "discount": disc,
+            "tax": tax,
+            "shipdate": shipdate,
+            "commitdate": commitdate,
+            "receiptdate": receiptdate,
+            "lc": lc,
+        }
+
+    def _gen_orders(self, start, count, columns):
+        oidx = np.arange(start, start + count, dtype=np.int64)
+        out = {}
+        need_lines = any(
+            c in ("o_totalprice", "o_orderstatus") for c in columns
+        )
+        la = self._line_arrays(oidx) if need_lines else None
+        for col in columns:
+            if col == "o_orderkey":
+                out[col] = ColumnData(oidx + 1)
+            elif col == "o_custkey":
+                nvalid = _num_valid_custkeys(self.C)
+                r = (_rand64("o_cust", oidx) % np.uint64(nvalid)).astype(np.int64)
+                out[col] = ColumnData(_custkey_from_rank(r))
+            elif col == "o_orderstatus":
+                d = self.dictionary("orders", "o_orderstatus")
+                shipped = la["shipdate"] <= CURRENT_DATE
+                lc = la["lc"]
+                seg = np.repeat(np.arange(len(oidx)), lc)
+                n_shipped = np.bincount(seg, weights=shipped, minlength=len(oidx))
+                status = np.where(
+                    n_shipped == lc,
+                    d.code_of("F"),
+                    np.where(n_shipped == 0, d.code_of("O"), d.code_of("P")),
+                )
+                out[col] = ColumnData(status.astype(np.int32), dictionary=d)
+            elif col == "o_totalprice":
+                # sum(extprice * (1+tax) * (1-disc)); cents * basis points
+                ep = la["extendedprice"].astype(np.int64)
+                line_total = (
+                    ep * (100 + la["tax"]) * (100 - la["discount"])
+                ) // 10000
+                lc = la["lc"]
+                seg = np.repeat(np.arange(len(oidx)), lc)
+                out[col] = self._money(
+                    np.bincount(seg, weights=line_total, minlength=len(oidx)).astype(
+                        np.int64
+                    )
+                )
+            elif col == "o_orderdate":
+                out[col] = ColumnData(self._order_dates(oidx).astype(np.int32))
+            elif col == "o_orderpriority":
+                d = self.dictionary("orders", "o_orderpriority")
+                out[col] = ColumnData(
+                    _choice_codes(d, PRIORITIES, "o_prio", oidx), dictionary=d
+                )
+            elif col == "o_clerk":
+                d = self.dictionary("orders", "o_clerk")
+                nclerk = max(1000, int(1000 * self.sf))
+                out[col] = ColumnData(
+                    (_rand64("o_clerk", oidx) % np.uint64(nclerk)).astype(np.int32),
+                    dictionary=d,
+                )
+            elif col == "o_shippriority":
+                out[col] = ColumnData(np.zeros(count, dtype=np.int64))
+            elif col == "o_comment":
+                d = self.dictionary("orders", "o_comment")
+                out[col] = ColumnData(_pool_codes(d, "o_comment", oidx), dictionary=d)
+        return out
+
+    def _gen_lineitem(self, start, count, columns):
+        """`start`/`count` index ORDERS; emits all their lines."""
+        oidx = np.arange(start, start + count, dtype=np.int64)
+        la = self._line_arrays(oidx)
+        out = {}
+        for col in columns:
+            if col == "l_orderkey":
+                out[col] = ColumnData(la["orderkey"])
+            elif col == "l_partkey":
+                out[col] = ColumnData(la["partkey"])
+            elif col == "l_suppkey":
+                out[col] = ColumnData(la["suppkey"])
+            elif col == "l_linenumber":
+                out[col] = ColumnData(la["linenumber"])
+            elif col == "l_quantity":
+                out[col] = self._money(la["quantity"])
+            elif col == "l_extendedprice":
+                out[col] = self._money(la["extendedprice"])
+            elif col == "l_discount":
+                out[col] = self._money(la["discount"])
+            elif col == "l_tax":
+                out[col] = self._money(la["tax"])
+            elif col == "l_returnflag":
+                d = self.dictionary("lineitem", "l_returnflag")
+                received = la["receiptdate"] <= CURRENT_DATE
+                r5050 = (_rand64("l_rflag", la["lid"]) % np.uint64(2)).astype(bool)
+                codes = np.where(
+                    received,
+                    np.where(r5050, d.code_of("R"), d.code_of("A")),
+                    d.code_of("N"),
+                )
+                out[col] = ColumnData(codes.astype(np.int32), dictionary=d)
+            elif col == "l_linestatus":
+                d = self.dictionary("lineitem", "l_linestatus")
+                codes = np.where(
+                    la["shipdate"] > CURRENT_DATE, d.code_of("O"), d.code_of("F")
+                )
+                out[col] = ColumnData(codes.astype(np.int32), dictionary=d)
+            elif col == "l_shipdate":
+                out[col] = ColumnData(la["shipdate"].astype(np.int32))
+            elif col == "l_commitdate":
+                out[col] = ColumnData(la["commitdate"].astype(np.int32))
+            elif col == "l_receiptdate":
+                out[col] = ColumnData(la["receiptdate"].astype(np.int32))
+            elif col == "l_shipinstruct":
+                d = self.dictionary("lineitem", "l_shipinstruct")
+                out[col] = ColumnData(
+                    _choice_codes(d, INSTRUCTIONS, "l_instr", la["lid"]), dictionary=d
+                )
+            elif col == "l_shipmode":
+                d = self.dictionary("lineitem", "l_shipmode")
+                out[col] = ColumnData(
+                    _choice_codes(d, MODES, "l_mode", la["lid"]), dictionary=d
+                )
+            elif col == "l_comment":
+                d = self.dictionary("lineitem", "l_comment")
+                out[col] = ColumnData(
+                    _pool_codes(d, "l_comment", la["lid"]), dictionary=d
+                )
+        return out
+
+
+@lru_cache(maxsize=8)
+def generator_for(sf: float) -> TpchGenerator:
+    return TpchGenerator(sf)

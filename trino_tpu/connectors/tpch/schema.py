@@ -1,0 +1,142 @@
+"""TPC-H schema definitions (reference: plugin/trino-tpch/.../TpchMetadata.java
+and the io.trino.tpch table generators it wraps).
+
+Types follow the TPC-H spec as the reference surfaces them: money columns are
+decimal(12,2) (device i64 cents), keys bigint, dates DATE.
+"""
+
+from __future__ import annotations
+
+from trino_tpu import types as T
+from trino_tpu.connectors.api import ColumnMeta, TableMetadata
+
+MONEY = T.DecimalType(12, 2)
+
+_TABLES = {
+    "region": [
+        ("r_regionkey", T.BIGINT, True),
+        ("r_name", T.VarcharType(25), False),
+        ("r_comment", T.VarcharType(152), False),
+    ],
+    "nation": [
+        ("n_nationkey", T.BIGINT, True),
+        ("n_name", T.VarcharType(25), False),
+        ("n_regionkey", T.BIGINT, False),
+        ("n_comment", T.VarcharType(152), False),
+    ],
+    "supplier": [
+        ("s_suppkey", T.BIGINT, True),
+        ("s_name", T.VarcharType(25), True),
+        ("s_address", T.VarcharType(40), False),
+        ("s_nationkey", T.BIGINT, False),
+        ("s_phone", T.VarcharType(15), False),
+        ("s_acctbal", MONEY, False),
+        ("s_comment", T.VarcharType(101), False),
+    ],
+    "part": [
+        ("p_partkey", T.BIGINT, True),
+        ("p_name", T.VarcharType(55), False),
+        ("p_mfgr", T.VarcharType(25), False),
+        ("p_brand", T.VarcharType(10), False),
+        ("p_type", T.VarcharType(25), False),
+        ("p_size", T.BIGINT, False),
+        ("p_container", T.VarcharType(10), False),
+        ("p_retailprice", MONEY, False),
+        ("p_comment", T.VarcharType(23), False),
+    ],
+    "partsupp": [
+        ("ps_partkey", T.BIGINT, True),
+        ("ps_suppkey", T.BIGINT, False),
+        ("ps_availqty", T.BIGINT, False),
+        ("ps_supplycost", MONEY, False),
+        ("ps_comment", T.VarcharType(199), False),
+    ],
+    "customer": [
+        ("c_custkey", T.BIGINT, True),
+        ("c_name", T.VarcharType(25), True),
+        ("c_address", T.VarcharType(40), False),
+        ("c_nationkey", T.BIGINT, False),
+        ("c_phone", T.VarcharType(15), False),
+        ("c_acctbal", MONEY, False),
+        ("c_mktsegment", T.VarcharType(10), False),
+        ("c_comment", T.VarcharType(117), False),
+    ],
+    "orders": [
+        ("o_orderkey", T.BIGINT, True),
+        ("o_custkey", T.BIGINT, False),
+        ("o_orderstatus", T.VarcharType(1), False),
+        ("o_totalprice", MONEY, False),
+        ("o_orderdate", T.DATE, False),
+        ("o_orderpriority", T.VarcharType(15), False),
+        ("o_clerk", T.VarcharType(15), True),
+        ("o_shippriority", T.BIGINT, False),
+        ("o_comment", T.VarcharType(79), False),
+    ],
+    "lineitem": [
+        ("l_orderkey", T.BIGINT, True),
+        ("l_partkey", T.BIGINT, False),
+        ("l_suppkey", T.BIGINT, False),
+        ("l_linenumber", T.BIGINT, False),
+        ("l_quantity", MONEY, False),
+        ("l_extendedprice", MONEY, False),
+        ("l_discount", MONEY, False),
+        ("l_tax", MONEY, False),
+        ("l_returnflag", T.VarcharType(1), False),
+        ("l_linestatus", T.VarcharType(1), False),
+        ("l_shipdate", T.DATE, False),
+        ("l_commitdate", T.DATE, False),
+        ("l_receiptdate", T.DATE, False),
+        ("l_shipinstruct", T.VarcharType(25), False),
+        ("l_shipmode", T.VarcharType(10), False),
+        ("l_comment", T.VarcharType(44), False),
+    ],
+}
+
+TABLE_NAMES = tuple(_TABLES)
+
+#: base cardinalities at SF1 (spec table 4.2.1; lineitem is derived)
+BASE_ROWS = {
+    "region": 5,
+    "nation": 25,
+    "supplier": 10_000,
+    "part": 200_000,
+    "partsupp": 800_000,
+    "customer": 150_000,
+    "orders": 1_500_000,
+}
+
+SCHEMAS = {
+    "tiny": 0.01,
+    "sf1": 1.0,
+    "sf10": 10.0,
+    "sf100": 100.0,
+    "sf300": 300.0,
+    "sf1000": 1000.0,
+}
+
+
+def schema_scale(schema: str) -> float:
+    if schema in SCHEMAS:
+        return SCHEMAS[schema]
+    if schema.startswith("sf"):
+        try:
+            return float(schema[2:].replace("_", "."))
+        except ValueError:
+            pass
+    raise KeyError(f"unknown tpch schema: {schema}")
+
+
+def table_metadata(schema: str, table: str) -> TableMetadata:
+    cols = _TABLES[table]
+    return TableMetadata(
+        schema,
+        table,
+        tuple(ColumnMeta(n, t, ordered) for n, t, ordered in cols),
+    )
+
+
+def scaled_rows(table: str, sf: float) -> int:
+    """Row count for fixed-cardinality tables (not lineitem)."""
+    if table in ("region", "nation"):
+        return BASE_ROWS[table]
+    return max(1, int(BASE_ROWS[table] * sf))

@@ -1,0 +1,11 @@
+"""Test harness utilities (reference: core/trino-main/.../testing and
+testing/trino-testing).
+
+The pandas oracle plays H2's role from the reference's QueryAssertions:
+an independent engine over the *same* connector data that expected results
+are computed against.
+"""
+
+from trino_tpu.testing.oracle import connector_table_to_pandas, tpch_pandas
+
+__all__ = ["connector_table_to_pandas", "tpch_pandas"]

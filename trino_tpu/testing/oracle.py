@@ -1,0 +1,57 @@
+"""Pandas materialization of connector tables — the correctness oracle.
+
+Reference role: testing/trino-testing/.../H2QueryRunner.java + QueryAssertions:
+expected results come from an independent implementation over identical data.
+Decimals are materialized as float (tests use tolerances for decimal results,
+mirroring QueryAssertions' approximate assertions) plus a parallel *_cents
+int column when exactness matters.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+import pandas as pd
+
+from trino_tpu import types as T
+from trino_tpu.connectors.api import Connector, TableHandle
+
+
+def connector_table_to_pandas(
+    connector: Connector, schema: str, table: str, columns=None
+) -> pd.DataFrame:
+    meta = connector.metadata().table_metadata(schema, table)
+    names = columns or [c.name for c in meta.columns]
+    handle = TableHandle(connector.name, schema, table)
+    frames = []
+    for split in connector.splits(handle, target_splits=1 << 30):
+        src = connector.page_source(split, names)
+        for page in src.pages():
+            cols = {}
+            for cm_name, cd in zip(names, page):
+                t = meta.column(cm_name).type
+                if cd.dictionary is not None:
+                    vals = np.asarray(cd.dictionary.decode(cd.values), dtype=object)
+                elif isinstance(t, T.DecimalType):
+                    vals = cd.values.astype(np.float64) / t.scale_factor
+                    cols[cm_name + "__cents"] = cd.values.astype(np.int64)
+                elif t is T.DATE:
+                    vals = np.array("1970-01-01", dtype="datetime64[D]") + cd.values
+                else:
+                    vals = cd.values
+                if cd.valid is not None:
+                    vals = np.where(cd.valid, vals, None)
+                cols[cm_name] = vals
+            frames.append(pd.DataFrame(cols))
+    if not frames:
+        return pd.DataFrame({n: [] for n in names})
+    return pd.concat(frames, ignore_index=True)
+
+
+@lru_cache(maxsize=16)
+def tpch_pandas(schema: str, table: str) -> pd.DataFrame:
+    """Cached full-table pandas frame for a tpch schema (tests: tiny/sf1)."""
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    return connector_table_to_pandas(TpchConnector(), schema, table)
