@@ -8,8 +8,10 @@ bench.py.
 
 import os
 
-# Must be set before jax initializes its backends.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initializes its backends.  FORCE cpu: the ambient
+# environment points JAX_PLATFORMS at the real TPU (axon), which tests must
+# never use — the bench harness owns the chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

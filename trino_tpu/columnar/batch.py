@@ -138,19 +138,42 @@ jax.tree_util.register_pytree_node(Batch, _batch_flatten, _batch_unflatten)
 
 
 def concat_batches(batches: Sequence[Batch]) -> Batch:
-    """Host-side concat (used by accumulating operators between jit steps)."""
+    """Host-side concat (used by accumulating operators between jit steps).
+
+    Dictionary-encoded columns whose batches carry different dictionaries are
+    recoded into a union dictionary (reference analog: DictionaryBlock
+    compaction when appending across pages)."""
     assert batches
     width = batches[0].width
     cols = []
     for ch in range(width):
         parts = [b.columns[ch] for b in batches]
+        dictionary = None
+        dicts = [p.dictionary for p in parts]
+        if any(d is not None for d in dicts):
+            from trino_tpu.columnar.dictionary import union_many
+
+            dictionary, tables = union_many(dicts)
+            parts = [
+                p
+                if table is None
+                else Column(
+                    jnp.take(
+                        jnp.asarray(table), jnp.asarray(p.data, jnp.int32), mode="clip"
+                    ),
+                    p.type,
+                    p.valid,
+                    dictionary,
+                )
+                for p, table in zip(parts, tables)
+            ]
         data = jnp.concatenate([p.data for p in parts])
         if any(p.valid is not None for p in parts):
             valid = jnp.concatenate([p.valid_mask() for p in parts])
         else:
             valid = None
         c0 = parts[0]
-        cols.append(Column(data, c0.type, valid, c0.dictionary))
+        cols.append(Column(data, c0.type, valid, dictionary))
     if any(b.row_mask is not None for b in batches):
         mask = jnp.concatenate([b.mask() for b in batches])
     else:

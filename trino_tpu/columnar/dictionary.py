@@ -215,15 +215,30 @@ class PatternDictionary(StringDictionary):
         )
 
 
+def union_many(dicts):
+    """Merge N dictionaries; returns (merged, [recode tables]) where table[i]
+    maps dict i's codes -> merged codes (None when already identical)."""
+    first = dicts[0]
+    if all(d is first or d == first for d in dicts):
+        return first, [None] * len(dicts)
+    merged = StringDictionary.from_unsorted([v for d in dicts for v in d.values])
+    ix = merged.index
+    tables = []
+    for d in dicts:
+        if d is merged:
+            tables.append(None)
+        else:
+            tables.append(
+                np.fromiter((ix[v] for v in d.values), dtype=np.int32, count=len(d))
+            )
+    return merged, tables
+
+
 def union_dictionaries(a: StringDictionary, b: StringDictionary):
     """Merge two dictionaries; returns (merged, recode_a, recode_b) where
     recode_x is an i32 table mapping old codes -> merged codes."""
-    if a is b or a == b:
-        n = len(a)
-        ident = np.arange(n, dtype=np.int32)
-        return a, ident, ident
-    merged = StringDictionary.from_unsorted(a.values + b.values)
-    ix = merged.index
-    ra = np.fromiter((ix[v] for v in a.values), dtype=np.int32, count=len(a))
-    rb = np.fromiter((ix[v] for v in b.values), dtype=np.int32, count=len(b))
-    return merged, ra, rb
+    merged, (ra, rb) = union_many([a, b])
+    ident = np.arange(len(merged), dtype=np.int32)
+    return merged, (ra if ra is not None else ident[: len(a)]), (
+        rb if rb is not None else ident[: len(b)]
+    )

@@ -1,0 +1,26 @@
+"""Physical operators (reference: core/trino-main/.../operator/** — 170 files).
+
+TPU-first redesign (SURVEY.md §7): instead of a per-row pull loop with JIT'd
+bytecode inner loops, each operator step is one jitted, shape-stable XLA
+computation over whole columnar batches:
+
+  ScanFilterAndProjectOperator  -> scan.ScanOperator + filter_project
+  HashAggregationOperator +
+  MultiChannelGroupByHash       -> aggregation (sort-based segmented reduce)
+  HashBuilder/LookupJoinOperator-> join (sorted build + searchsorted probe)
+  TopNOperator                  -> sort.TopNOperator (bounded sort-merge state)
+  OrderByOperator               -> sort.OrderByOperator
+  LimitOperator                 -> sort.LimitOperator
+  ValuesOperator                -> values.ValuesOperator
+
+Operators are host-side generators over Batch streams; all device math lives
+in jitted step functions reused across batches (shape-bucketed capacities keep
+the trace cache small).
+"""
+
+from trino_tpu.ops.common import (
+    multi_key_sort_perm,
+    SortKey,
+)
+
+__all__ = ["multi_key_sort_perm", "SortKey"]

@@ -1,0 +1,381 @@
+"""Aggregation operator — sort-based grouped reduction.
+
+Reference roles: HashAggregationOperator.java:49, AggregationOperator (global),
+MultiChannelGroupByHash.java:216 (group ids), operator/aggregation/* (the
+accumulator library).  TPU substitution (SURVEY.md §7): no per-row hash
+probing — group ids come from a stable multi-key sort + key-change cumsum, and
+accumulators are segmented reductions, all in one jitted finish step.
+
+Modes mirror the reference's AggregationNode.Step:
+  SINGLE  : raw rows -> final values
+  PARTIAL : raw rows -> state columns (for exchange)
+  FINAL   : state columns -> final values
+
+`streaming=True` reduces every pushed batch immediately and keeps only the
+per-batch group states (bounded memory for low-cardinality groupings like
+TPC-H Q1); otherwise input is materialized and reduced once at finish.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.columnar.batch import concat_batches
+from trino_tpu.ops.common import (
+    SortKey,
+    group_ids_from_sorted,
+    multi_key_sort_perm,
+    next_pow2,
+    segment_reduce,
+)
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One SQL aggregate: name in {count, count_star, sum, min, max, avg,
+    any_value, bool_and, bool_or}, arg = input channel (None for count_star)."""
+
+    name: str
+    arg: Optional[int]
+    out_type: T.Type
+
+
+# primitive states per SQL aggregate (state kinds: sum/count/min/max/any)
+def _primitives(spec: AggSpec):
+    if spec.name == "count_star":
+        return [("count_star", None)]
+    if spec.name == "count":
+        return [("count", spec.arg)]
+    if spec.name in ("sum", "avg"):
+        return [("sum", spec.arg), ("count", spec.arg)]
+    if spec.name in ("min", "bool_and"):
+        return [("min", spec.arg), ("count", spec.arg)]
+    if spec.name in ("max", "bool_or"):
+        return [("max", spec.arg), ("count", spec.arg)]
+    if spec.name == "any_value":
+        return [("any", spec.arg), ("count", spec.arg)]
+    raise NotImplementedError(f"aggregate: {spec.name}")
+
+
+def _state_types(spec: AggSpec, input_types) -> list[T.Type]:
+    out = []
+    for kind, arg in _primitives(spec):
+        if kind in ("count", "count_star"):
+            out.append(T.BIGINT)
+        elif kind == "sum":
+            t = input_types[arg]
+            if isinstance(t, T.DecimalType):
+                out.append(T.DecimalType(18, t.scale))
+            elif t.name in ("double", "real"):
+                out.append(T.DOUBLE)
+            else:
+                out.append(T.BIGINT)
+        else:
+            out.append(input_types[arg])
+    return out
+
+
+def _merge_primitives(spec: AggSpec):
+    """How each state column merges in FINAL mode (state kind per column)."""
+    prims = _primitives(spec)
+    merged = []
+    for kind, _ in prims:
+        merged.append("sum" if kind in ("count", "count_star") else kind)
+    return merged
+
+
+def _finalize(spec: AggSpec, states: list[Column]) -> Column:
+    """Combine state columns into the SQL result column."""
+    name = spec.name
+    if name in ("count", "count_star"):
+        return Column(states[0].data, T.BIGINT, None)
+    value, cnt = states[0], states[1]
+    nonempty = cnt.data > 0
+    valid = nonempty
+    if name == "avg":
+        if isinstance(spec.out_type, T.DecimalType):
+            num = value.data
+            den = jnp.where(nonempty, cnt.data, 1)
+            sign = jnp.sign(num)
+            q = jnp.abs(num) // den
+            r = jnp.abs(num) - q * den
+            data = sign * (q + jnp.where(2 * r >= den, 1, 0))
+        else:
+            data = value.data.astype(jnp.float64) / jnp.where(nonempty, cnt.data, 1)
+        return Column(data.astype(spec.out_type.np_dtype), spec.out_type, valid)
+    # sum/min/max/any_value/bool_*
+    return Column(
+        value.data.astype(spec.out_type.np_dtype),
+        spec.out_type,
+        valid,
+        states[0].dictionary,
+    )
+
+
+def _masked_reduce(data, valid, kind: str):
+    """Whole-column null-skipping reduction to a scalar (global aggregation)."""
+    from trino_tpu.ops.common import _max_sentinel, _min_sentinel
+
+    if kind in ("count", "count_star"):
+        return jnp.sum(valid, dtype=jnp.int64)
+    if kind == "sum":
+        return jnp.sum(jnp.where(valid, data, 0))
+    if kind == "min":
+        return jnp.min(jnp.where(valid, data, _max_sentinel(data.dtype)))
+    if kind == "max":
+        return jnp.max(jnp.where(valid, data, _min_sentinel(data.dtype)))
+    if kind == "any":
+        idx = jnp.argmax(valid)
+        return data[idx]
+    raise ValueError(kind)
+
+
+def _pad_device(batch: Batch, cap: int) -> Batch:
+    n = batch.capacity
+    if n == cap:
+        return batch
+    pad = cap - n
+    cols = []
+    for c in batch.columns:
+        data = jnp.concatenate([c.data, jnp.zeros(pad, dtype=c.data.dtype)])
+        valid = (
+            None
+            if c.valid is None
+            else jnp.concatenate([c.valid, jnp.zeros(pad, dtype=bool)])
+        )
+        cols.append(Column(data, c.type, valid, c.dictionary))
+    mask = jnp.concatenate([batch.mask(), jnp.zeros(pad, dtype=bool)])
+    return Batch(cols, mask)
+
+
+class AggregationOperator:
+    def __init__(
+        self,
+        group_channels: Sequence[int],
+        aggregates: Sequence[AggSpec],
+        input_types: Sequence[T.Type],
+        mode: str = "single",  # single | partial | final | merge
+        streaming: bool = False,
+    ):
+        # merge: states in -> states out (used to combine partial outputs)
+        assert mode in ("single", "partial", "final", "merge")
+        self.group_channels = list(group_channels)
+        self.aggregates = list(aggregates)
+        self.input_types = list(input_types)
+        self.mode = mode
+        self.streaming = streaming
+        self._acc: list[Batch] = []
+        self._step = jax.jit(self._reduce_step, static_argnames=("out_cap",))
+
+    # -- the jitted kernel ---------------------------------------------------
+
+    def _reduce_step(self, batch: Batch, out_cap: int) -> Batch:
+        gch = self.group_channels
+        if not gch:
+            return self._global_reduce(batch)
+        perm = multi_key_sort_perm(batch, [SortKey(ch) for ch in gch])
+        gid, ngroups, new_group = group_ids_from_sorted(batch, perm, gch)
+        live = jnp.take(batch.mask(), perm, mode="clip")
+        gid_c = jnp.minimum(gid, out_cap)
+        nseg = out_cap + 1
+        out_live = jnp.arange(out_cap, dtype=jnp.int64) < ngroups
+        cols: list[Column] = []
+        # group key columns: value at each group's first row
+        first_idx = jnp.where(new_group, gid_c, out_cap)
+        for ch in gch:
+            col = batch.columns[ch]
+            d = jnp.take(col.data, perm, mode="clip")
+            key_out = (
+                jnp.zeros(nseg, dtype=col.data.dtype)
+                .at[first_idx]
+                .set(d, mode="drop")[:out_cap]
+            )
+            valid = None
+            if col.valid is not None:
+                v = jnp.take(col.valid, perm, mode="clip")
+                valid = (
+                    jnp.zeros(nseg, dtype=bool)
+                    .at[first_idx]
+                    .set(v, mode="drop")[:out_cap]
+                )
+            cols.append(Column(key_out, col.type, valid, col.dictionary))
+        # aggregate states/values
+        for spec in self.aggregates:
+            state_cols = self._reduce_one(
+                batch, spec, perm, live, gid_c, nseg, out_cap
+            )
+            if self.mode in ("partial", "merge"):
+                cols.extend(state_cols)
+            else:
+                cols.append(_finalize(spec, state_cols))
+        return Batch(cols, out_live)
+
+    def _reduce_one(self, batch, spec, perm, live, gid, nseg, out_cap):
+        if self.mode in ("final", "merge"):
+            prims = list(zip(_merge_primitives(spec), _primitives(spec)))
+            # state columns arrive as consecutive input channels starting at arg
+            state_cols = []
+            ch = spec.arg
+            for kind, _ in prims:
+                col = batch.columns[ch]
+                d = jnp.take(col.data, perm, mode="clip")
+                v = live
+                if col.valid is not None:
+                    v = jnp.logical_and(v, jnp.take(col.valid, perm, mode="clip"))
+                red = segment_reduce(d, gid, nseg, kind, valid=v)[:out_cap]
+                state_cols.append(Column(red, col.type, None, col.dictionary))
+                ch += 1
+            return state_cols
+        out = []
+        for kind, arg in _primitives(spec):
+            if kind == "count_star":
+                red = segment_reduce(
+                    jnp.ones(batch.capacity, jnp.int64), gid, nseg, "count", valid=live
+                )[:out_cap]
+                out.append(Column(red, T.BIGINT, None))
+                continue
+            col = batch.columns[arg]
+            d = jnp.take(col.data, perm, mode="clip")
+            v = live
+            if col.valid is not None:
+                v = jnp.logical_and(v, jnp.take(col.valid, perm, mode="clip"))
+            st = _state_types(spec, self.input_types)[len(out)]
+            if kind == "sum":
+                # widen BEFORE reducing: int32 inputs must accumulate in int64
+                d = d.astype(st.np_dtype)
+            red = segment_reduce(d, gid, nseg, kind, valid=v)[:out_cap]
+            out.append(
+                Column(red.astype(st.np_dtype), st, None, col.dictionary)
+            )
+        return out
+
+    def _global_reduce(self, batch: Batch) -> Batch:
+        """No group keys: one output row (present even for empty input)."""
+        live = batch.mask()
+        cols = []
+        for spec in self.aggregates:
+            states = []
+            if self.mode in ("final", "merge"):
+                ch = spec.arg
+                for kind in _merge_primitives(spec):
+                    col = batch.columns[ch]
+                    v = live
+                    if col.valid is not None:
+                        v = jnp.logical_and(v, col.valid)
+                    states.append(
+                        Column(
+                            _masked_reduce(col.data, v, kind)[None],
+                            col.type,
+                            None,
+                            col.dictionary,
+                        )
+                    )
+                    ch += 1
+            else:
+                for kind, arg in _primitives(spec):
+                    if kind == "count_star":
+                        states.append(
+                            Column(jnp.sum(live, dtype=jnp.int64)[None], T.BIGINT, None)
+                        )
+                        continue
+                    col = batch.columns[arg]
+                    v = live
+                    if col.valid is not None:
+                        v = jnp.logical_and(v, col.valid)
+                    st = _state_types(spec, self.input_types)[len(states)]
+                    d = col.data
+                    if kind == "sum":
+                        d = d.astype(st.np_dtype)  # widen before reducing
+                    states.append(
+                        Column(
+                            _masked_reduce(d, v, kind)[None].astype(st.np_dtype),
+                            st,
+                            None,
+                            col.dictionary,
+                        )
+                    )
+            if self.mode in ("partial", "merge"):
+                cols.extend(states)
+            else:
+                cols.append(_finalize(spec, states))
+        return Batch(cols, jnp.ones(1, dtype=bool))
+
+    # -- host-side streaming -------------------------------------------------
+
+    def _batch_reducer(self) -> "AggregationOperator":
+        """Per-batch operator for streaming: raw rows -> states, or (when this
+        op's input is already states) states -> states."""
+        per_mode = "merge" if self.mode in ("final", "merge") else "partial"
+        return AggregationOperator(
+            self.group_channels,
+            self.aggregates,
+            self.input_types,
+            mode=per_mode,
+        )
+
+    #: fold accumulated per-batch states after this many batches (bounds
+    #: device memory at ~FOLD_EVERY batch capacities, the revoke analog)
+    FOLD_EVERY = 8
+
+    def process(self, stream):
+        per_batch = self._batch_reducer() if self.streaming else None
+        for batch in stream:
+            if per_batch is not None:
+                self._acc.append(per_batch._step(batch, out_cap=batch.capacity))
+                if len(self._acc) >= self.FOLD_EVERY:
+                    self._fold_states()
+            else:
+                self._acc.append(batch)
+        yield self.finish()
+
+    def _fold_states(self) -> None:
+        """Merge accumulated state batches into one, compacted to live size."""
+        merged = self._combine(concat_batches(self._acc), "merge")
+        n = merged.num_rows_host()
+        self._acc = [merged.compact_device(next_pow2(max(n, 1), floor=1))]
+
+    def finish(self) -> Batch:
+        if not self._acc:
+            empty = self._empty_input()
+            return self._step(empty, out_cap=max(1, empty.capacity))
+        big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
+        if self.streaming:
+            out_mode = "merge" if self.mode in ("partial", "merge") else "final"
+            return self._combine(big, out_mode)
+        cap = next_pow2(big.capacity, floor=1)
+        return self._step(_pad_device(big, cap), out_cap=cap)
+
+    def _combine(self, states_batch: Batch, out_mode: str) -> Batch:
+        """Re-reduce a batch of state rows (group keys + state columns)."""
+        merger = AggregationOperator(
+            list(range(len(self.group_channels))),
+            [
+                AggSpec(s.name, self._state_channel(i), s.out_type)
+                for i, s in enumerate(self.aggregates)
+            ],
+            [c.type for c in states_batch.columns],
+            mode=out_mode,
+        )
+        cap = next_pow2(states_batch.capacity, floor=1)
+        return merger._step(_pad_device(states_batch, cap), out_cap=cap)
+
+    def _state_channel(self, agg_index: int) -> int:
+        ch = len(self.group_channels)
+        for s in self.aggregates[:agg_index]:
+            ch += len(_primitives(s))
+        return ch
+
+    def _empty_input(self) -> Batch:
+        import numpy as np
+
+        cols = [
+            Column(np.zeros(1, dtype=t.np_dtype), t, np.zeros(1, dtype=bool))
+            for t in self.input_types
+        ]
+        return Batch(cols, np.zeros(1, dtype=bool))

@@ -1,0 +1,149 @@
+"""Shared device kernels: multi-key stable sort, segmented grouping.
+
+Reference roles: OrderingCompiler (sql/gen/OrderingCompiler.java) for sort
+orders, MultiChannelGroupByHash.getGroupIds (operator/MultiChannelGroupByHash
+.java:216) for group-id assignment.  The TPU substitution is sort-based:
+iterated stable argsorts (lexicographic) + key-change flags + cumsum group ids
++ segmented reductions — all static-shape, all fusable by XLA.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu.columnar import Batch, Column
+
+
+@dataclass(frozen=True)
+class SortKey:
+    channel: int
+    ascending: bool = True
+    nulls_first: bool = False
+
+
+def _key_with_null_order(col: Column, ascending: bool, nulls_first: bool):
+    """(rank or None, value key) for one sort key.
+
+    The value key realizes direction without arithmetic negation of ints
+    (bitwise complement is INT64_MIN-safe) and without float bitcasts (which
+    the TPU x64-rewrite cannot lower): NaN and NULL placement ride a small
+    int8 rank sorted in a second stable pass.  NaN orders as largest
+    (reference DoubleOperators semantics); NULL placement follows nulls_first.
+    """
+    data = col.data
+    if data.dtype == jnp.bool_:
+        data = data.astype(jnp.int8)
+    rank = None
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        nan = jnp.isnan(data)
+        value_key = jnp.where(nan, jnp.asarray(0, data.dtype), data)
+        if not ascending:
+            value_key = -value_key  # finite negation is exact for floats
+        rank = jnp.where(nan, 1 if ascending else -1, 0).astype(jnp.int8)
+    else:
+        value_key = data if ascending else ~data
+    if col.valid is not None:
+        base = rank if rank is not None else jnp.zeros_like(data, dtype=jnp.int8)
+        rank = jnp.where(
+            col.valid, base, jnp.asarray(-2 if nulls_first else 2, jnp.int8)
+        )
+    return rank, value_key
+
+
+def multi_key_sort_perm(batch: Batch, keys, capacity=None):
+    """Stable permutation sorting live rows by `keys` (lexicographic);
+    dead rows sort last.  keys: sequence of SortKey."""
+    n = batch.capacity
+    perm = jnp.arange(n, dtype=jnp.int64)
+    # iterate stable sorts from least-significant key to most-significant
+    for k in reversed(list(keys)):
+        col = batch.columns[k.channel].gather(perm)
+        rank, key = _key_with_null_order(col, k.ascending, k.nulls_first)
+        order = jnp.argsort(key, stable=True)
+        perm = perm[order]
+        if rank is not None:
+            perm = perm[jnp.argsort(rank[order], stable=True)]
+    # dead rows last (most significant)
+    dead = jnp.logical_not(jnp.take(batch.mask(), perm, mode="clip"))
+    perm = perm[jnp.argsort(dead, stable=True)]
+    return perm
+
+
+def group_ids_from_sorted(batch: Batch, perm, key_channels):
+    """Given a sort permutation over group keys, return (gid_sorted, ngroups,
+    new_group_flags): group ids in sorted order, null-safe equality."""
+    n = batch.capacity
+    live = jnp.take(batch.mask(), perm, mode="clip")
+    change = jnp.zeros(n, dtype=bool)
+    for ch in key_channels:
+        col = batch.columns[ch]
+        d = jnp.take(col.data, perm, mode="clip")
+        prev = jnp.roll(d, 1)
+        neq = d != prev
+        if col.valid is not None:
+            v = jnp.take(col.valid, perm, mode="clip")
+            pv = jnp.roll(v, 1)
+            neq = jnp.logical_or(jnp.logical_and(neq, jnp.logical_and(v, pv)), v != pv)
+        change = jnp.logical_or(change, neq)
+    first_live = jnp.logical_and(live, jnp.cumsum(live) == 1)
+    new_group = jnp.logical_and(live, jnp.logical_or(change, first_live))
+    new_group = jnp.logical_or(new_group, first_live)
+    gid = jnp.cumsum(new_group) - 1
+    gid = jnp.where(live, gid, n - 1)  # dead rows into last (masked) slot
+    ngroups = jnp.sum(new_group)
+    return gid, ngroups, new_group
+
+
+def segment_reduce(values, gid, num_segments: int, kind: str, valid=None):
+    """Null-skipping segmented reduction. kind: sum/min/max/count/any."""
+    if kind == "count":
+        w = jnp.ones_like(gid, dtype=jnp.int64)
+        if valid is not None:
+            w = jnp.where(valid, w, 0)
+        return jax.ops.segment_sum(w, gid, num_segments)
+    if valid is not None:
+        if kind == "sum":
+            values = jnp.where(valid, values, 0)
+        elif kind == "min":
+            values = jnp.where(valid, values, _max_sentinel(values.dtype))
+        elif kind == "max":
+            values = jnp.where(valid, values, _min_sentinel(values.dtype))
+        elif kind == "any":
+            pass
+    if kind == "sum":
+        return jax.ops.segment_sum(values, gid, num_segments)
+    if kind == "min":
+        return jax.ops.segment_min(values, gid, num_segments)
+    if kind == "max":
+        return jax.ops.segment_max(values, gid, num_segments)
+    if kind == "any":
+        # first VALID value per segment (any_value): min row index among valid
+        n = values.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int64)
+        if valid is not None:
+            idx = jnp.where(valid, idx, n)
+        first = jax.ops.segment_min(idx, gid, num_segments)
+        return jnp.take(values, jnp.clip(first, 0, n - 1), mode="clip")
+    raise ValueError(kind)
+
+
+def _max_sentinel(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+
+
+def _min_sentinel(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(-jnp.inf, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).min, dtype)
+
+
+def next_pow2(n: int, floor: int = 1024) -> int:
+    c = floor
+    while c < n:
+        c <<= 1
+    return c

@@ -1,0 +1,43 @@
+"""Filter + project operator (reference: FilterAndProjectOperator +
+the generated PageFilter/PageProjection from sql/gen/PageFunctionCompiler).
+
+One jitted step evaluates the predicate and all projections over a batch; XLA
+fuses everything into a single device program.  Output stays masked (no
+compaction) — downstream operators work on masks; compaction happens only at
+exchange/result boundaries.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+
+from trino_tpu.columnar import Batch
+from trino_tpu.expr import ExprCompiler
+from trino_tpu.expr.ir import Expr
+
+
+class FilterProjectOperator:
+    def __init__(self, predicate: Optional[Expr], projections: Sequence[Expr]):
+        self.predicate = predicate
+        self.projections = list(projections)
+        self._step = jax.jit(self._make_step())
+
+    def _make_step(self):
+        pred, projs = self.predicate, self.projections
+
+        def step(batch: Batch) -> Batch:
+            c = ExprCompiler(batch)
+            out = batch
+            if pred is not None:
+                out = out.filter(c.filter_mask(pred))
+            cols = [c.column(e) for e in projs]
+            return Batch(cols, out.row_mask)
+
+        return step
+
+    def process(self, stream):
+        for batch in stream:
+            yield self._step(batch)

@@ -1,0 +1,67 @@
+"""Table scan: connector pages -> padded device batches.
+
+Reference role: operator/TableScanOperator.java:47 +
+ScanFilterAndProjectOperator.java:68.  Host-side decode (the connector) feeds
+shape-bucketed device batches; when a filter/projection is attached the scan
+fuses them into the same jitted step (the ScanFilterAndProject analog), so a
+page goes host->device once and is filtered/projected in one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.connectors.api import Connector, Split
+from trino_tpu.ops.common import next_pow2
+from trino_tpu.types import Type
+
+
+def page_to_batch(page, types: Sequence[Type], capacity: Optional[int] = None) -> Batch:
+    """Pad host ColumnData to a pow2 capacity and build a host Batch."""
+    n = len(page[0].values) if page else 0
+    cap = capacity or next_pow2(n)
+    cols = []
+    for cd, t in zip(page, types):
+        data = np.asarray(cd.values, dtype=t.np_dtype)
+        if len(data) < cap:
+            data = np.concatenate([data, np.zeros(cap - len(data), dtype=t.np_dtype)])
+        valid = None
+        if cd.valid is not None:
+            v = np.asarray(cd.valid, dtype=bool)
+            valid = np.concatenate([v, np.zeros(cap - len(v), dtype=bool)])
+        cols.append(Column(data, t, valid, cd.dictionary))
+    mask = np.zeros(cap, dtype=bool)
+    mask[:n] = True
+    return Batch(cols, mask)
+
+
+class ScanOperator:
+    """Streams one split's pages as device batches."""
+
+    def __init__(
+        self,
+        connector: Connector,
+        split: Split,
+        column_names: Sequence[str],
+        column_types: Sequence[Type],
+        page_rows: int = 1 << 17,
+        device=None,
+    ):
+        self.connector = connector
+        self.split = split
+        self.column_names = list(column_names)
+        self.column_types = list(column_types)
+        self.page_rows = page_rows
+        self.device = device
+
+    def batches(self):
+        src = self.connector.page_source(
+            self.split, self.column_names, max_rows_per_page=self.page_rows
+        )
+        for page in src.pages():
+            b = page_to_batch(page, self.column_types)
+            yield jax.device_put(b, self.device)

@@ -1,0 +1,103 @@
+"""Ordering operators (reference: OrderByOperator.java, TopNOperator.java:35,
+LimitOperator.java, DistinctLimitOperator.java).
+
+TopN keeps a bounded device state: each pushed batch is merged with the
+current top-N candidates and re-truncated — the TPU analog of the reference's
+TopNProcessor heap, with `lax.sort` doing the heap's job (SURVEY.md §7 maps
+TopNOperator to top_k/sort).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu.columnar import Batch
+from trino_tpu.columnar.batch import concat_batches
+from trino_tpu.ops.common import SortKey, multi_key_sort_perm, next_pow2
+from trino_tpu.ops.aggregation import _pad_device
+
+
+class OrderByOperator:
+    """Full materialized sort; emits one sorted, compacted batch."""
+
+    def __init__(self, keys: Sequence[SortKey]):
+        self.keys = list(keys)
+        self._acc: list[Batch] = []
+        self._step = jax.jit(self._sort_step)
+
+    def _sort_step(self, batch: Batch) -> Batch:
+        perm = multi_key_sort_perm(batch, self.keys)
+        live = jnp.take(batch.mask(), perm, mode="clip")
+        return batch.gather(perm, valid=live)
+
+    def process(self, stream):
+        for b in stream:
+            self._acc.append(b)
+        if not self._acc:
+            return
+        big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
+        big = _pad_device(big, next_pow2(big.capacity, floor=1))
+        yield self._step(big)
+
+
+class TopNOperator:
+    def __init__(self, keys: Sequence[SortKey], n: int):
+        self.keys = list(keys)
+        self.n = n
+        self._state: Optional[Batch] = None
+        self._step = jax.jit(self._merge_step, static_argnames=("out_cap",))
+
+    def _merge_step(self, batch: Batch, out_cap: int) -> Batch:
+        perm = multi_key_sort_perm(batch, self.keys)
+        live = jnp.take(batch.mask(), perm, mode="clip")
+        # keep only first n live rows
+        rank = jnp.cumsum(live) - 1
+        keep = jnp.logical_and(live, rank < self.n)
+        out = batch.gather(perm, valid=keep)
+        return _truncate(out, out_cap)
+
+    def process(self, stream):
+        out_cap = next_pow2(self.n, floor=1)
+        for b in stream:
+            if self._state is not None:
+                b = concat_batches([self._state, b])
+            b = _pad_device(b, next_pow2(b.capacity, floor=1))
+            self._state = self._step(b, out_cap=out_cap)
+        if self._state is not None:
+            yield self._state
+
+
+class LimitOperator:
+    """LIMIT without ordering; truncates the stream host-side."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def process(self, stream):
+        remaining = self.n
+        for b in stream:
+            if remaining <= 0:
+                break
+            cnt = b.num_rows_host()
+            if cnt <= remaining:
+                remaining -= cnt
+                yield b
+            else:
+                live = b.mask()
+                rank = jnp.cumsum(live) - 1
+                yield b.filter(jnp.logical_and(live, rank < remaining))
+                remaining = 0
+
+
+def _truncate(batch: Batch, cap: int) -> Batch:
+    """Slice the leading `cap` rows (used after sorts put keepers first)."""
+    from trino_tpu.columnar import Column
+
+    cols = [
+        Column(c.data[:cap], c.type, None if c.valid is None else c.valid[:cap], c.dictionary)
+        for c in batch.columns
+    ]
+    return Batch(cols, batch.mask()[:cap])

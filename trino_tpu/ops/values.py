@@ -1,0 +1,21 @@
+"""Literal-rows operator (reference: operator/ValuesOperator.java)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from trino_tpu.columnar import batch_from_rows
+from trino_tpu.types import Type
+
+
+class ValuesOperator:
+    def __init__(self, types: Sequence[Type], rows: Sequence[Sequence]):
+        self.types = list(types)
+        self.rows = list(rows)
+
+    def batches(self):
+        if not self.rows:
+            return
+        yield jax.device_put(batch_from_rows(self.types, self.rows))

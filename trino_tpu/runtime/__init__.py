@@ -1,0 +1,1 @@
+"""Execution runtime (reference: core/trino-main/.../execution/**)."""

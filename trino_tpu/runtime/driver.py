@@ -1,0 +1,35 @@
+"""Driver: composes a source + operator chain into a batch stream.
+
+Reference role: operator/Driver.java:371 (processInternal) — but where the
+reference pulls pages operator-by-operator under a time-sliced executor, here
+each operator is a generator transform and every device step is an async XLA
+dispatch; the host thread just keeps the feed full (SURVEY.md §7 maps
+TaskExecutor time-slicing to a host feed/step/drain pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Sequence
+
+from trino_tpu.columnar import Batch
+
+
+class Driver:
+    def __init__(self, source: Iterable[Batch], operators: Sequence = ()):
+        self.source = source
+        self.operators = list(operators)
+
+    def run(self) -> Iterator[Batch]:
+        stream: Iterable[Batch] = self.source
+        for op in self.operators:
+            stream = op.process(stream)
+        return iter(stream)
+
+    def collect(self) -> list[Batch]:
+        return list(self.run())
+
+    def rows(self) -> list[list]:
+        out = []
+        for b in self.collect():
+            out.extend(b.to_pylist())
+        return out
