@@ -1,0 +1,147 @@
+"""Parser + analyzer/logical-planner tests (reference: trino-parser tests +
+BasePlanTest plan-shape assertions)."""
+
+import pytest
+
+from trino_tpu.connectors.api import default_catalogs
+from trino_tpu.connectors.tpch.queries import QUERIES
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.analyzer import AnalysisError
+from trino_tpu.planner.logical_planner import LogicalPlanner, Session
+from trino_tpu.planner.plan import plan_text, walk
+from trino_tpu.sql import ast
+from trino_tpu.sql.parser import ParseError, parse_statement
+
+
+@pytest.fixture(scope="module")
+def catalogs():
+    return default_catalogs()
+
+
+def _plan(sql, catalogs, schema="tiny"):
+    stmt = parse_statement(sql)
+    return LogicalPlanner(catalogs, Session("tpch", schema)).plan(stmt.query)
+
+
+def test_parse_all_tpch():
+    for qid, sql in QUERIES.items():
+        stmt = parse_statement(sql)
+        assert isinstance(stmt, ast.SelectStatement), f"Q{qid}"
+
+
+def test_plan_all_tpch(catalogs):
+    for qid, sql in QUERIES.items():
+        out = _plan(sql, catalogs)
+        assert isinstance(out, P.OutputNode), f"Q{qid}"
+        assert plan_text(out)
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse_statement("select from where")
+    with pytest.raises(ParseError):
+        parse_statement("select 1 blah blah blah")
+    with pytest.raises(ParseError):
+        parse_statement("select * from t join u")  # missing ON/USING
+
+
+def test_plan_shapes_q1(catalogs):
+    out = _plan(QUERIES[1], catalogs)
+    kinds = [type(n).__name__ for n in walk(out)]
+    assert "AggregationNode" in kinds
+    assert "TopNNode" in kinds or "SortNode" in kinds
+    agg = next(n for n in walk(out) if isinstance(n, P.AggregationNode))
+    assert len(agg.group_symbols) == 2
+    assert len(agg.aggregations) == 8  # 4 sums + 3 avgs + count(*)
+
+
+def test_plan_semi_join_q18(catalogs):
+    out = _plan(QUERIES[18], catalogs)
+    semis = [n for n in walk(out) if isinstance(n, P.SemiJoinNode)]
+    assert len(semis) == 1
+
+
+def test_plan_decorrelated_exists_q4(catalogs):
+    out = _plan(QUERIES[4], catalogs)
+    semis = [n for n in walk(out) if isinstance(n, P.SemiJoinNode)]
+    assert len(semis) == 1
+    assert semis[0].filter is None
+
+
+def test_plan_q21_anti_and_semi(catalogs):
+    out = _plan(QUERIES[21], catalogs)
+    semis = [n for n in walk(out) if isinstance(n, P.SemiJoinNode)]
+    assert len(semis) == 2
+    assert all(s.filter is not None for s in semis)  # l_suppkey <> correlation
+
+
+def test_plan_scalar_subquery_q17(catalogs):
+    out = _plan(QUERIES[17], catalogs)
+    joins = [n for n in walk(out) if isinstance(n, P.JoinNode) and n.kind == "left"]
+    assert joins, "correlated scalar should become a LEFT join"
+    aggs = [n for n in walk(out) if isinstance(n, P.AggregationNode)]
+    assert any(len(a.group_symbols) == 1 for a in aggs)  # grouped by partkey
+
+
+def test_error_messages(catalogs):
+    with pytest.raises(AnalysisError, match="column not found"):
+        _plan("select nope from lineitem", catalogs)
+    with pytest.raises(AnalysisError, match="GROUP BY"):
+        _plan("select l_orderkey, sum(l_quantity) from lineitem group by l_partkey",
+              catalogs)
+    with pytest.raises(KeyError, match="not found"):
+        _plan("select * from nosuchtable", catalogs)
+    with pytest.raises(AnalysisError, match="ambiguous"):
+        _plan("select n_name from nation n1, nation n2", catalogs)
+
+
+def test_order_by_alias_and_ordinal(catalogs):
+    out = _plan(
+        "select l_returnflag x, count(*) c from lineitem group by 1 order by c desc, 1",
+        catalogs,
+    )
+    topn = [n for n in walk(out) if isinstance(n, (P.SortNode, P.TopNNode))]
+    assert topn and len(topn[0].orderings) == 2
+    assert topn[0].orderings[0][1] is False  # desc
+
+
+def test_union_and_values(catalogs):
+    out = _plan("select 1 x union all select 2", catalogs)
+    assert any(isinstance(n, P.UnionNode) for n in walk(out))
+    out = _plan("select * from (values (1, 'a'), (2, 'b')) t(id, name) where id > 1",
+                catalogs)
+    assert any(isinstance(n, P.ValuesNode) for n in walk(out))
+
+
+def test_cte_planning(catalogs):
+    out = _plan(
+        "with r as (select l_suppkey k, sum(l_quantity) q from lineitem group by l_suppkey) "
+        "select * from r where q > 100", catalogs)
+    assert any(isinstance(n, P.AggregationNode) for n in walk(out))
+
+
+def test_parser_no_hang_on_malformed(catalogs):
+    with pytest.raises(ParseError):
+        parse_statement("EXPLAIN (")
+    with pytest.raises(ParseError):
+        parse_statement("select sum(x) over (order by y rows unbounded")
+
+
+def test_offset_rejected_loudly(catalogs):
+    with pytest.raises(AnalysisError, match="OFFSET"):
+        _plan("select r_name from region offset 2", catalogs)
+    with pytest.raises(AnalysisError, match="OFFSET"):
+        _plan("select r_name from region order by r_name offset 2 limit 1", catalogs)
+
+
+def test_scalar_count_subquery_coalesced(catalogs):
+    out = _plan(
+        "select c_custkey, (select count(*) from orders where o_custkey = c_custkey) n "
+        "from customer", catalogs)
+    from trino_tpu.expr.ir import SpecialForm as SF, Form as F
+    projs = [n for n in walk(out) if isinstance(n, P.ProjectNode)]
+    found = any(
+        isinstance(e, SF) and e.form == F.COALESCE
+        for p in projs for _, e in p.assignments
+    )
+    assert found

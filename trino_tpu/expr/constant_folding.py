@@ -1,0 +1,90 @@
+"""Constant folding over the typed IR (reference role:
+iterative/rule/SimplifyExpressions + the interpreter for constant subtrees).
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+from trino_tpu import types as T
+from trino_tpu.expr.ir import Call, Expr, Form, Literal, SpecialForm
+
+
+def _lit_value(e: Expr):
+    if isinstance(e, Literal):
+        return e.value
+    raise ValueError("not a literal")
+
+
+def try_fold(e: Expr) -> Expr:
+    """Best-effort: fold arithmetic/comparison/cast over literal children."""
+    kids = [try_fold(k) for k in e.children()]
+    if kids:
+        e = e.with_children(kids)
+    if isinstance(e, Literal):
+        return e
+    if not all(isinstance(k, Literal) for k in kids):
+        return e
+    try:
+        if isinstance(e, Call):
+            vals = [k.value for k in kids]
+            if any(v is None for v in vals):
+                return Literal(None, e.type)
+            if e.name == "$neg":
+                return Literal(-vals[0], e.type)
+            if e.name in ("$add", "$sub", "$mul", "$div"):
+                a, b = _to_py(kids[0]), _to_py(kids[1])
+                out = {
+                    "$add": lambda: a + b,
+                    "$sub": lambda: a - b,
+                    "$mul": lambda: a * b,
+                    "$div": lambda: a / b if b else None,
+                }[e.name]()
+                return _from_py(out, e.type)
+            if e.name in ("$eq", "$ne", "$lt", "$le", "$gt", "$ge"):
+                a, b = _to_py(kids[0]), _to_py(kids[1])
+                out = {
+                    "$eq": a == b, "$ne": a != b, "$lt": a < b,
+                    "$le": a <= b, "$gt": a > b, "$ge": a >= b,
+                }[e.name]
+                return Literal(out, T.BOOLEAN)
+            if e.name == "date_add_days":
+                return Literal(int(vals[0]) + int(vals[1]), e.type)
+            if e.name == "date_add_months":
+                import datetime
+
+                d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(vals[0]))
+                months = d.year * 12 + d.month - 1 + int(vals[1])
+                y, m = divmod(months, 12)
+                m += 1
+                import calendar
+
+                day = min(d.day, calendar.monthrange(y, m)[1])
+                nd = datetime.date(y, m, day)
+                return Literal((nd - datetime.date(1970, 1, 1)).days, e.type)
+        if isinstance(e, SpecialForm) and e.form == Form.CAST:
+            v = kids[0].value
+            if v is None:
+                return Literal(None, e.type)
+            return _from_py(_to_py(kids[0]), e.type)
+    except (ValueError, TypeError, ArithmeticError):
+        return e
+    return e
+
+
+def _to_py(lit: Literal):
+    if isinstance(lit.type, T.DecimalType) and not isinstance(lit.value, Decimal):
+        return Decimal(str(lit.value))
+    return lit.value
+
+
+def _from_py(v, t: T.Type) -> Literal:
+    if v is None:
+        return Literal(None, t)
+    if isinstance(t, T.DecimalType):
+        return Literal(Decimal(str(v)), t)
+    if T.is_integer_kind(t):
+        return Literal(int(v), t)
+    if t.name in ("double", "real"):
+        return Literal(float(v), t)
+    return Literal(v, t)

@@ -50,6 +50,24 @@ class InputRef(Expr):
         return f"#{self.channel}:{self.type.name}"
 
 
+class SymbolRef(Expr):
+    """Named symbol reference used in logical plans (reference:
+    sql/planner/Symbol.java); rewritten to InputRef channels by the local
+    execution planner."""
+
+    __slots__ = ("name", "type")
+
+    def __init__(self, name: str, type: Type):
+        self.name = name
+        self.type = type
+
+    def key(self):
+        return ("sym", self.name, self.type.name)
+
+    def __repr__(self):
+        return f"${self.name}:{self.type.name}"
+
+
 class Literal(Expr):
     """Constant. `value` is the *logical* host python value — Decimal/int/float
     for decimals (scaled at compile time), day numbers for dates, python str

@@ -1,0 +1,3 @@
+"""Logical planning (reference: core/trino-main/.../sql/planner)."""
+
+from trino_tpu.planner.plan import *  # noqa: F401,F403

@@ -1,0 +1,381 @@
+"""Scoped expression analysis: AST -> typed IR over SymbolRefs.
+
+Reference roles: sql/analyzer/ExpressionAnalyzer.java (typing/resolution) and
+sql/planner/TranslationMap (the pluggable `hook` lets the aggregation planner
+map group-by expressions and aggregate calls to their computed symbols, which
+is exactly TranslationMap's job).
+"""
+
+from __future__ import annotations
+
+import datetime
+from decimal import Decimal
+from typing import Callable, Optional
+
+from trino_tpu import types as T
+from trino_tpu.expr import ir
+from trino_tpu.expr.ir import Call, Expr, Form, Literal, SpecialForm, SymbolRef
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.functions import (
+    AGG_FUNCS,
+    arith_result_type,
+    scalar_result_type,
+)
+from trino_tpu.sql import ast
+
+
+class AnalysisError(ValueError):
+    pass
+
+
+class Field:
+    __slots__ = ("name", "symbol", "alias")
+
+    def __init__(self, name: str, symbol: P.Symbol, alias: Optional[str] = None):
+        self.name = name
+        self.symbol = symbol
+        self.alias = alias
+
+    def __repr__(self):  # pragma: no cover
+        return f"{self.alias or ''}.{self.name}->{self.symbol.name}"
+
+
+class Scope:
+    """Name resolution scope with outer parent for correlation
+    (reference: sql/analyzer/Scope.java)."""
+
+    def __init__(self, fields: list[Field], parent: Optional["Scope"] = None):
+        self.fields = fields
+        self.parent = parent
+
+    def resolve(self, parts: tuple) -> tuple[P.Symbol, bool]:
+        """Returns (symbol, is_outer)."""
+        sym = self._resolve_local(parts)
+        if sym is not None:
+            return sym, False
+        if self.parent is not None:
+            s, _ = self.parent.resolve(parts)
+            return s, True
+        raise AnalysisError(f"column not found: {'.'.join(parts)}")
+
+    def _resolve_local(self, parts: tuple) -> Optional[P.Symbol]:
+        if len(parts) == 1:
+            matches = [f for f in self.fields if f.name == parts[0]]
+        elif len(parts) == 2:
+            matches = [
+                f for f in self.fields if f.name == parts[1] and f.alias == parts[0]
+            ]
+        else:
+            return None
+        if len(matches) > 1:
+            raise AnalysisError(f"ambiguous column: {'.'.join(parts)}")
+        return matches[0].symbol if matches else None
+
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _parse_date(text: str) -> int:
+    y, m, d = (int(x) for x in text.strip().split("-"))
+    return (datetime.date(y, m, d) - _EPOCH).days
+
+
+_CMP_OPS = {"=", "<>", "!=", "<", "<=", ">", ">="}
+_BOOL_OPS = {"and", "or"}
+_ARITH_OPS = {"+", "-", "*", "/", "%"}
+
+
+class ExprAnalyzer:
+    """Analyzes one expression.  `hook(node)` may return an ir.Expr to
+    short-circuit resolution (used for post-aggregation translation);
+    `on_subquery(node)` handles subquery expressions by grafting plans
+    (raises by default)."""
+
+    def __init__(
+        self,
+        scope: Scope,
+        hook: Optional[Callable] = None,
+        on_subquery: Optional[Callable] = None,
+        outer_refs: Optional[set] = None,
+    ):
+        self.scope = scope
+        self.hook = hook
+        self.on_subquery = on_subquery
+        self.outer_refs = outer_refs  # set of symbol names resolved from parent
+
+    def analyze(self, node: ast.Node) -> Expr:
+        if self.hook is not None:
+            out = self.hook(node, self)
+            if out is not None:
+                return out
+        return self._analyze(node)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _analyze(self, node: ast.Node) -> Expr:
+        m = getattr(self, "_a_" + type(node).__name__, None)
+        if m is None:
+            raise AnalysisError(f"unsupported expression: {type(node).__name__}")
+        return m(node)
+
+    def _a_Identifier(self, n: ast.Identifier) -> Expr:
+        sym, outer = self.scope.resolve(n.parts)
+        if outer and self.outer_refs is not None:
+            self.outer_refs.add(sym.name)
+        return sym.ref()
+
+    def _a_NumberLiteral(self, n: ast.NumberLiteral) -> Expr:
+        t = n.text
+        if "e" in t.lower():
+            return Literal(float(t), T.DOUBLE)
+        if "." in t:
+            scale = len(t.split(".")[1])
+            return Literal(Decimal(t), T.DecimalType(18, scale))
+        v = int(t)
+        return Literal(v, T.INTEGER if -(2**31) <= v < 2**31 else T.BIGINT)
+
+    def _a_StringLiteral(self, n: ast.StringLiteral) -> Expr:
+        return Literal(n.value, T.VarcharType(len(n.value)))
+
+    def _a_BooleanLiteral(self, n: ast.BooleanLiteral) -> Expr:
+        return Literal(n.value, T.BOOLEAN)
+
+    def _a_NullLiteral(self, n: ast.NullLiteral) -> Expr:
+        return Literal(None, T.UNKNOWN)
+
+    def _a_DateLiteral(self, n: ast.DateLiteral) -> Expr:
+        return Literal(_parse_date(n.text), T.DATE)
+
+    def _a_TimestampLiteral(self, n: ast.TimestampLiteral) -> Expr:
+        s = n.text.strip().replace("t", " ").replace("T", " ")
+        if " " in s:
+            d, tm = s.split(" ", 1)
+        else:
+            d, tm = s, "00:00:00"
+        days = _parse_date(d)
+        parts = tm.split(":")
+        h = int(parts[0]) if parts and parts[0] else 0
+        mi = int(parts[1]) if len(parts) > 1 else 0
+        sec = float(parts[2]) if len(parts) > 2 else 0.0
+        micros = days * 86_400_000_000 + (h * 3600 + mi * 60) * 1_000_000 + int(
+            sec * 1_000_000
+        )
+        return Literal(micros, T.TIMESTAMP)
+
+    def _a_IntervalLiteral(self, n: ast.IntervalLiteral) -> Expr:
+        # stands alone only long enough for date arithmetic to consume it
+        raise AnalysisError("INTERVAL literal outside date arithmetic")
+
+    def _a_BinaryOp(self, n: ast.BinaryOp) -> Expr:
+        op = n.op
+        if op in _BOOL_OPS:
+            l, r = self.analyze(n.left), self.analyze(n.right)
+            return ir.and_(l, r) if op == "and" else ir.or_(l, r)
+        if op in _CMP_OPS:
+            l, r = self.analyze(n.left), self.analyze(n.right)
+            self._check_comparable(l, r)
+            return ir.comparison(op, l, r)
+        if op == "||":
+            l, r = self.analyze(n.left), self.analyze(n.right)
+            return Call("concat", [l, r], T.VARCHAR)
+        if op in _ARITH_OPS:
+            # date +/- interval
+            if op in ("+", "-") and isinstance(n.right, ast.IntervalLiteral):
+                return self._date_interval(n.left, n.right, op)
+            if op == "+" and isinstance(n.left, ast.IntervalLiteral):
+                return self._date_interval(n.right, n.left, op)
+            l, r = self.analyze(n.left), self.analyze(n.right)
+            rt = arith_result_type(op, l.type, r.type)
+            name = {"+": "$add", "-": "$sub", "*": "$mul", "/": "$div", "%": "$mod"}[op]
+            return Call(name, [l, r], rt)
+        raise AnalysisError(f"unsupported operator {op}")
+
+    def _date_interval(self, date_node, interval: ast.IntervalLiteral, op: str):
+        d = self.analyze(date_node)
+        count = int(interval.value) * interval.sign
+        if op == "-":
+            count = -count
+        if interval.unit in ("day", "days"):
+            return Call(
+                "date_add_days", [d, Literal(count, T.BIGINT)], d.type
+            )
+        if interval.unit in ("month", "months"):
+            return Call("date_add_months", [d, Literal(count, T.BIGINT)], d.type)
+        if interval.unit in ("year", "years"):
+            return Call("date_add_months", [d, Literal(count * 12, T.BIGINT)], d.type)
+        if interval.unit in ("hour", "minute", "second") and d.type is T.TIMESTAMP:
+            mult = {"hour": 3_600_000_000, "minute": 60_000_000, "second": 1_000_000}
+            return Call(
+                "$add",
+                [d, Literal(count * mult[interval.unit], T.BIGINT)],
+                T.TIMESTAMP,
+            )
+        raise AnalysisError(f"unsupported interval unit {interval.unit}")
+
+    def _check_comparable(self, l: Expr, r: Expr) -> None:
+        lt, rt = l.type, r.type
+        if lt == T.UNKNOWN or rt == T.UNKNOWN:
+            return
+        ls, rs = T.is_string_kind(lt), T.is_string_kind(rt)
+        if ls != rs and not (lt is T.BOOLEAN and rt is T.BOOLEAN):
+            if ls or rs:
+                raise AnalysisError(f"cannot compare {lt.name} with {rt.name}")
+
+    def _a_UnaryOp(self, n: ast.UnaryOp) -> Expr:
+        if n.op == "not":
+            return ir.not_(self.analyze(n.operand))
+        v = self.analyze(n.operand)
+        if n.op == "-":
+            if isinstance(v, Literal) and v.value is not None:
+                return Literal(-v.value, v.type)
+            return Call("$neg", [v], v.type)
+        return v
+
+    def _a_FunctionCall(self, n: ast.FunctionCall) -> Expr:
+        if n.window is not None:
+            raise AnalysisError("window functions not supported here")
+        if n.name in AGG_FUNCS or (n.name == "count" and n.is_star):
+            raise AnalysisError(
+                f"aggregate function {n.name} not allowed in this context"
+            )
+        if n.name == "current_date":
+            today = (datetime.date.today() - _EPOCH).days
+            return Literal(today, T.DATE)
+        if n.name == "if":
+            args = [self.analyze(a) for a in n.args]
+            rt = T.common_super_type(
+                args[1].type, args[2].type if len(args) > 2 else T.UNKNOWN
+            )
+            if len(args) == 2:
+                args.append(Literal(None, rt))
+            return SpecialForm(Form.IF, args, rt)
+        if n.name == "coalesce":
+            args = [self.analyze(a) for a in n.args]
+            rt = T.UNKNOWN
+            for a in args:
+                rt = T.common_super_type(rt, a.type)
+            return SpecialForm(Form.COALESCE, args, rt)
+        if n.name == "nullif":
+            args = [self.analyze(a) for a in n.args]
+            return SpecialForm(Form.NULLIF, args, args[0].type)
+        if n.name == "try":
+            return SpecialForm(Form.TRY, [self.analyze(n.args[0])], T.UNKNOWN)
+        args = [self.analyze(a) for a in n.args]
+        rt = scalar_result_type(n.name, [a.type for a in args])
+        return Call(n.name, args, rt)
+
+    def _a_CastExpr(self, n: ast.CastExpr) -> Expr:
+        v = self.analyze(n.operand)
+        to = T.parse_type(n.type_name)
+        return SpecialForm(Form.CAST, [v], to)
+
+    def _a_CaseExpr(self, n: ast.CaseExpr) -> Expr:
+        args: list[Expr] = []
+        rt = T.UNKNOWN
+        for cond, val in n.whens:
+            if n.operand is not None:
+                c = ir.comparison(
+                    "=", self.analyze(n.operand), self.analyze(cond)
+                )
+            else:
+                c = self.analyze(cond)
+            v = self.analyze(val)
+            rt = T.common_super_type(rt, v.type)
+            args.extend([c, v])
+        if n.default is not None:
+            d = self.analyze(n.default)
+            rt = T.common_super_type(rt, d.type)
+            args.append(d)
+        # retype branch values (literal nulls pick up the result type)
+        return SpecialForm(Form.CASE, args, rt)
+
+    def _a_InList(self, n: ast.InList) -> Expr:
+        v = self.analyze(n.value)
+        items = [self.analyze(i) for i in n.items]
+        e = SpecialForm(Form.IN, [v] + items, T.BOOLEAN)
+        return ir.not_(e) if n.negated else e
+
+    def _a_Between(self, n: ast.Between) -> Expr:
+        v = self.analyze(n.value)
+        lo = self.analyze(n.low)
+        hi = self.analyze(n.high)
+        e = SpecialForm(Form.BETWEEN, [v, lo, hi], T.BOOLEAN)
+        return ir.not_(e) if n.negated else e
+
+    def _a_Like(self, n: ast.Like) -> Expr:
+        args = [self.analyze(n.value), self.analyze(n.pattern)]
+        if n.escape is not None:
+            args.append(self.analyze(n.escape))
+        e = Call("like", args, T.BOOLEAN)
+        return ir.not_(e) if n.negated else e
+
+    def _a_IsNull(self, n: ast.IsNull) -> Expr:
+        e = SpecialForm(Form.IS_NULL, [self.analyze(n.value)], T.BOOLEAN)
+        return ir.not_(e) if n.negated else e
+
+    def _a_IsDistinctFrom(self, n: ast.IsDistinctFrom) -> Expr:
+        l, r = self.analyze(n.left), self.analyze(n.right)
+        eq = ir.comparison("=", l, r)
+        ln = SpecialForm(Form.IS_NULL, [l], T.BOOLEAN)
+        rn = SpecialForm(Form.IS_NULL, [r], T.BOOLEAN)
+        both_null = ir.and_(ln, rn)
+        neither_null_eq = ir.and_(ir.not_(ln), ir.not_(rn), eq)
+        same = ir.or_(both_null, neither_null_eq)
+        return same if n.negated else ir.not_(same)
+
+    def _a_Extract(self, n: ast.Extract) -> Expr:
+        fn = {
+            "year": "year", "month": "month", "day": "day",
+            "quarter": "quarter", "week": "week",
+            "dow": "day_of_week", "doy": "day_of_year",
+        }.get(n.unit)
+        if fn is None:
+            raise AnalysisError(f"unsupported EXTRACT unit {n.unit}")
+        return Call(fn, [self.analyze(n.operand)], T.BIGINT)
+
+    # subquery expressions delegate to the planner's grafting callback
+
+    def _a_ScalarSubquery(self, n: ast.ScalarSubquery) -> Expr:
+        if self.on_subquery is None:
+            raise AnalysisError("subquery not allowed in this context")
+        return self.on_subquery(n, self)
+
+    def _a_InSubquery(self, n: ast.InSubquery) -> Expr:
+        if self.on_subquery is None:
+            raise AnalysisError("subquery not allowed in this context")
+        return self.on_subquery(n, self)
+
+    def _a_Exists(self, n: ast.Exists) -> Expr:
+        if self.on_subquery is None:
+            raise AnalysisError("subquery not allowed in this context")
+        return self.on_subquery(n, self)
+
+
+def split_conjuncts(node: ast.Node) -> list[ast.Node]:
+    if isinstance(node, ast.BinaryOp) and node.op == "and":
+        return split_conjuncts(node.left) + split_conjuncts(node.right)
+    return [node]
+
+
+def collect_aggregates(node: ast.Node, out: list) -> None:
+    """Find aggregate FunctionCalls, not descending into subqueries."""
+    if isinstance(node, ast.FunctionCall) and node.window is None:
+        if node.name in AGG_FUNCS or node.is_star and node.name == "count":
+            out.append(node)
+            return  # nested aggs are invalid anyway
+    for f in getattr(node, "__dataclass_fields__", {}):
+        v = getattr(node, f)
+        if isinstance(v, ast.Node):
+            if isinstance(v, (ast.Query,)):
+                continue
+            collect_aggregates(v, out)
+        elif isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, ast.Node) and not isinstance(item, ast.Query):
+                    collect_aggregates(item, out)
+                elif isinstance(item, tuple):
+                    for sub in item:
+                        if isinstance(sub, ast.Node) and not isinstance(
+                            sub, ast.Query
+                        ):
+                            collect_aggregates(sub, out)

@@ -1,0 +1,154 @@
+"""Function resolution + result-type rules for the analyzer.
+
+Reference roles: metadata/GlobalFunctionCatalog + FunctionManager (function
+binding) and type/TypeCoercion (operator result types).  Decimal arithmetic
+follows the reference's short-decimal rules with precision capped at 18
+(device i64); decimal division results are DOUBLE (documented divergence —
+ratio outputs are tolerance-compared like QueryAssertions does).
+"""
+
+from __future__ import annotations
+
+from trino_tpu import types as T
+
+#: SQL aggregate functions -> AggSpec name
+AGG_FUNCS = {
+    "count": "count",
+    "sum": "sum",
+    "avg": "avg",
+    "min": "min",
+    "max": "max",
+    "any_value": "any_value",
+    "arbitrary": "any_value",
+    "bool_and": "bool_and",
+    "bool_or": "bool_or",
+    "every": "bool_and",
+}
+
+
+def agg_result_type(name: str, arg_type: T.Type | None) -> T.Type:
+    if name == "count" or name == "count_star":
+        return T.BIGINT
+    if name == "sum":
+        if arg_type is None:
+            raise TypeError("sum requires an argument")
+        if isinstance(arg_type, T.DecimalType):
+            return T.DecimalType(18, arg_type.scale)
+        if arg_type.name in ("double", "real"):
+            return T.DOUBLE
+        return T.BIGINT
+    if name == "avg":
+        if isinstance(arg_type, T.DecimalType):
+            return arg_type
+        return T.DOUBLE
+    if name in ("min", "max", "any_value"):
+        return arg_type
+    if name in ("bool_and", "bool_or"):
+        return T.BOOLEAN
+    raise TypeError(f"unknown aggregate {name}")
+
+
+def arith_result_type(op: str, a: T.Type, b: T.Type) -> T.Type:
+    da, db = isinstance(a, T.DecimalType), isinstance(b, T.DecimalType)
+    if a.name in ("double", "real") or b.name in ("double", "real"):
+        return T.DOUBLE
+    if op in ("+", "-"):
+        if da or db:
+            sa = a.scale if da else 0
+            sb = b.scale if db else 0
+            return T.DecimalType(18, max(sa, sb))
+        if a is T.DATE or b is T.DATE:
+            return T.DATE  # date +/- interval-day
+        return T.common_super_type(a, b)
+    if op == "*":
+        if da or db:
+            sa = a.scale if da else 0
+            sb = b.scale if db else 0
+            return T.DecimalType(18, sa + sb)
+        return T.common_super_type(a, b)
+    if op == "/":
+        if da or db:
+            return T.DOUBLE  # divergence: reference returns decimal
+        if T.is_integer_kind(a) and T.is_integer_kind(b):
+            return T.common_super_type(a, b)
+        return T.DOUBLE
+    if op == "%":
+        return T.common_super_type(a, b)
+    raise TypeError(f"cannot apply {op} to {a.name}, {b.name}")
+
+
+#: scalar function result types: name -> fn(arg_types) -> Type
+def _fixed(t):
+    return lambda args: t
+
+
+def _same_as_first(args):
+    return args[0]
+
+
+SCALAR_RESULT = {
+    "year": _fixed(T.BIGINT),
+    "month": _fixed(T.BIGINT),
+    "day": _fixed(T.BIGINT),
+    "day_of_month": _fixed(T.BIGINT),
+    "quarter": _fixed(T.BIGINT),
+    "week": _fixed(T.BIGINT),
+    "day_of_week": _fixed(T.BIGINT),
+    "dow": _fixed(T.BIGINT),
+    "day_of_year": _fixed(T.BIGINT),
+    "doy": _fixed(T.BIGINT),
+    "date_add_days": _same_as_first,
+    "date_add_months": _same_as_first,
+    "date_trunc_month": _fixed(T.DATE),
+    "date_trunc_year": _fixed(T.DATE),
+    "substr": _fixed(T.VARCHAR),
+    "substring": _fixed(T.VARCHAR),
+    "upper": _fixed(T.VARCHAR),
+    "lower": _fixed(T.VARCHAR),
+    "trim": _fixed(T.VARCHAR),
+    "ltrim": _fixed(T.VARCHAR),
+    "rtrim": _fixed(T.VARCHAR),
+    "reverse": _fixed(T.VARCHAR),
+    "replace": _fixed(T.VARCHAR),
+    "concat": _fixed(T.VARCHAR),
+    "length": _fixed(T.BIGINT),
+    "strpos": _fixed(T.BIGINT),
+    "position": _fixed(T.BIGINT),
+    "starts_with": _fixed(T.BOOLEAN),
+    "like": _fixed(T.BOOLEAN),
+    "abs": _same_as_first,
+    "sign": _same_as_first,
+    "sqrt": _fixed(T.DOUBLE),
+    "cbrt": _fixed(T.DOUBLE),
+    "exp": _fixed(T.DOUBLE),
+    "ln": _fixed(T.DOUBLE),
+    "log10": _fixed(T.DOUBLE),
+    "log2": _fixed(T.DOUBLE),
+    "sin": _fixed(T.DOUBLE),
+    "cos": _fixed(T.DOUBLE),
+    "tan": _fixed(T.DOUBLE),
+    "degrees": _fixed(T.DOUBLE),
+    "radians": _fixed(T.DOUBLE),
+    "power": _fixed(T.DOUBLE),
+    "pow": _fixed(T.DOUBLE),
+    "mod": _same_as_first,
+    "floor": lambda args: T.DecimalType(18, 0)
+    if isinstance(args[0], T.DecimalType)
+    else args[0],
+    "ceil": lambda args: T.DecimalType(18, 0)
+    if isinstance(args[0], T.DecimalType)
+    else args[0],
+    "ceiling": lambda args: T.DecimalType(18, 0)
+    if isinstance(args[0], T.DecimalType)
+    else args[0],
+    "round": lambda args: args[0],
+    "greatest": _same_as_first,
+    "least": _same_as_first,
+}
+
+
+def scalar_result_type(name: str, arg_types) -> T.Type:
+    fn = SCALAR_RESULT.get(name)
+    if fn is None:
+        raise TypeError(f"unknown function: {name}")
+    return fn(list(arg_types))

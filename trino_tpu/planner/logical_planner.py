@@ -1,0 +1,742 @@
+"""AST -> logical plan (reference: sql/planner/LogicalPlanner.java:155,
+QueryPlanner.java, RelationPlanner.java, SubqueryPlanner — combined).
+
+Planning is analysis-driven: expressions are typed while the plan is built.
+Subqueries decorrelate on the way in: correlated equi-conjuncts become join
+criteria (scalar aggregates -> grouped LEFT JOIN; EXISTS/IN -> semi join with
+mark), mirroring the reference's TransformCorrelated* rule family but done
+directly at plan time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from trino_tpu import types as T
+from trino_tpu.connectors.api import CatalogManager, TableHandle
+from trino_tpu.expr import ir
+from trino_tpu.expr.ir import Call, Expr, Form, Literal, SpecialForm, SymbolRef
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.analyzer import (
+    AnalysisError,
+    ExprAnalyzer,
+    Field,
+    Scope,
+    collect_aggregates,
+    split_conjuncts,
+)
+from trino_tpu.planner.functions import AGG_FUNCS, agg_result_type
+from trino_tpu.sql import ast
+
+
+class RelationPlan:
+    def __init__(self, node: P.PlanNode, fields: list[Field]):
+        self.node = node
+        self.fields = fields
+
+    def scope(self, parent: Optional[Scope] = None) -> Scope:
+        return Scope(self.fields, parent)
+
+
+class Session:
+    """Minimal session state (reference: Session.java)."""
+
+    def __init__(self, catalog: Optional[str] = None, schema: Optional[str] = None):
+        self.catalog = catalog
+        self.schema = schema
+        self.properties: dict = {}
+
+
+class LogicalPlanner:
+    def __init__(self, catalogs: CatalogManager, session: Session):
+        self.catalogs = catalogs
+        self.session = session
+        self.alloc = P.SymbolAllocator()
+
+    # -- statements ----------------------------------------------------------
+
+    def plan(self, query: ast.Query) -> P.OutputNode:
+        rp, names = self.plan_query(query, outer=None, ctes={})
+        return P.OutputNode(rp.node, names, [f.symbol for f in rp.fields])
+
+    # -- queries -------------------------------------------------------------
+
+    def plan_query(self, q: ast.Query, outer: Optional[Scope], ctes: dict):
+        ctes = dict(ctes)
+        for w in q.ctes:
+            ctes[w.name] = w
+        rp, names = self.plan_query_body(q.body, outer, ctes)
+        # ORDER BY / LIMIT at query level
+        if q.order_by or q.limit is not None or q.offset:
+            rp, names = self._apply_order_limit(rp, names, q, outer, ctes)
+        return rp, names
+
+    def _apply_order_limit(self, rp, names, q: ast.Query, outer, ctes):
+        node = rp.node
+        if q.order_by:
+            orderings = []
+            scope = rp.scope(outer)
+            by_alias = {}
+            for f, n in zip(rp.fields, names):
+                by_alias.setdefault(n, f.symbol)
+            for item in q.order_by:
+                sym = None
+                if isinstance(item.expr, ast.Identifier) and len(item.expr.parts) == 1:
+                    sym = by_alias.get(item.expr.parts[0])
+                if sym is None and isinstance(item.expr, ast.NumberLiteral):
+                    sym = rp.fields[int(item.expr.text) - 1].symbol
+                if sym is None:
+                    e = ExprAnalyzer(scope).analyze(item.expr)
+                    if isinstance(e, SymbolRef):
+                        sym = P.Symbol(e.name, e.type)
+                    else:
+                        raise AnalysisError(
+                            "ORDER BY expression must be an output column here"
+                        )
+                nf = item.nulls_first
+                if nf is None:
+                    nf = not item.ascending  # reference default: NULLS LAST asc, FIRST desc
+                orderings.append((sym, item.ascending, nf))
+            if q.limit is not None and not q.offset:
+                node = P.TopNNode(node, orderings, q.limit)
+            else:
+                node = P.SortNode(node, orderings)
+                if q.offset:
+                    raise AnalysisError("OFFSET not supported yet")
+                if q.limit is not None:
+                    node = P.LimitNode(node, q.limit)
+        elif q.limit is not None or q.offset:
+            if q.offset:
+                raise AnalysisError("OFFSET not supported yet")
+            node = P.LimitNode(node, q.limit)
+        return RelationPlan(node, rp.fields), names
+
+    def plan_query_body(self, body: ast.Node, outer, ctes):
+        if isinstance(body, ast.QuerySpec):
+            return self.plan_query_spec(body, outer, ctes)
+        if isinstance(body, ast.SetOp):
+            return self.plan_set_op(body, outer, ctes)
+        if isinstance(body, ast.ValuesRelation):
+            rp = self.plan_values(body)
+            return rp, [f.name for f in rp.fields]
+        if isinstance(body, ast.Query):
+            return self.plan_query(body, outer, ctes)
+        if isinstance(body, ast.TableRef):
+            rp = self.plan_relation(body, outer, ctes)
+            return rp, [f.name for f in rp.fields]
+        raise AnalysisError(f"unsupported query body {type(body).__name__}")
+
+    def plan_set_op(self, s: ast.SetOp, outer, ctes):
+        if s.op != "union":
+            raise AnalysisError(f"{s.op.upper()} not supported yet")
+        lrp, lnames = self.plan_query_body(s.left, outer, ctes)
+        rrp, rnames = self.plan_query_body(s.right, outer, ctes)
+        if len(lrp.fields) != len(rrp.fields):
+            raise AnalysisError("UNION inputs must have the same arity")
+        out_syms = []
+        for lf, rf in zip(lrp.fields, rrp.fields):
+            t = T.common_super_type(lf.symbol.type, rf.symbol.type)
+            out_syms.append(self.alloc.new(lf.name, t))
+        node = P.UnionNode(
+            [lrp.node, rrp.node],
+            out_syms,
+            [[f.symbol for f in lrp.fields], [f.symbol for f in rrp.fields]],
+        )
+        if not s.all:
+            node = P.AggregationNode(node, list(out_syms), [])
+        fields = [Field(n, s_) for n, s_ in zip(lnames, out_syms)]
+        return RelationPlan(node, fields), lnames
+
+    def plan_values(self, v: ast.ValuesRelation) -> RelationPlan:
+        scope = Scope([])
+        an = ExprAnalyzer(scope)
+        rows = []
+        col_types: list[T.Type] = []
+        for row in v.rows:
+            vals = []
+            for i, e in enumerate(row):
+                lit = an.analyze(e)
+                if not isinstance(lit, Literal):
+                    from trino_tpu.expr.constant_folding import try_fold
+
+                    lit = try_fold(lit)
+                    if not isinstance(lit, Literal):
+                        raise AnalysisError("VALUES entries must be constant")
+                if i >= len(col_types):
+                    col_types.append(lit.type)
+                else:
+                    col_types[i] = T.common_super_type(col_types[i], lit.type)
+                vals.append(lit.value)
+            rows.append(vals)
+        syms = [
+            self.alloc.new(f"_col{i}", t if t != T.UNKNOWN else T.BIGINT)
+            for i, t in enumerate(col_types)
+        ]
+        fields = [Field(s.name, s) for s in syms]
+        return RelationPlan(P.ValuesNode(syms, rows), fields)
+
+    # -- relations -----------------------------------------------------------
+
+    def plan_relation(self, rel: ast.Node, outer, ctes) -> RelationPlan:
+        if isinstance(rel, ast.TableRef):
+            if len(rel.name) == 1 and rel.name[0] in ctes:
+                w = ctes[rel.name[0]]
+                sub_ctes = {k: v for k, v in ctes.items() if k != rel.name[0]}
+                rp, names = self.plan_query(w.query, outer, sub_ctes)
+                colnames = list(w.column_names) or names
+                fields = [
+                    Field(n, f.symbol, rel.name[0])
+                    for n, f in zip(colnames, rp.fields)
+                ]
+                return RelationPlan(rp.node, fields)
+            return self.plan_table_scan(rel)
+        if isinstance(rel, ast.AliasedRelation):
+            rp = self.plan_relation(rel.relation, outer, ctes)
+            names = list(rel.column_aliases) or [f.name for f in rp.fields]
+            fields = [
+                Field(n, f.symbol, rel.alias) for n, f in zip(names, rp.fields)
+            ]
+            return RelationPlan(rp.node, fields)
+        if isinstance(rel, ast.SubqueryRelation):
+            rp, names = self.plan_query(rel.query, outer, ctes)
+            fields = [Field(n, f.symbol) for n, f in zip(names, rp.fields)]
+            return RelationPlan(rp.node, fields)
+        if isinstance(rel, ast.Join):
+            return self.plan_join(rel, outer, ctes)
+        if isinstance(rel, ast.ValuesRelation):
+            return self.plan_values(rel)
+        raise AnalysisError(f"unsupported relation {type(rel).__name__}")
+
+    def plan_table_scan(self, ref: ast.TableRef) -> RelationPlan:
+        parts = ref.name
+        if len(parts) == 3:
+            catalog, schema, table = parts
+        elif len(parts) == 2:
+            catalog, (schema, table) = self.session.catalog, parts
+        else:
+            catalog, schema, table = self.session.catalog, self.session.schema, parts[0]
+        if catalog is None or schema is None:
+            raise AnalysisError(f"table {'.'.join(parts)}: no current catalog/schema")
+        conn = self.catalogs.get(catalog)
+        meta = conn.metadata().table_metadata(schema, table)
+        handle = TableHandle(catalog, schema, table)
+        assignments = []
+        fields = []
+        for cm in meta.columns:
+            sym = self.alloc.new(cm.name, cm.type)
+            assignments.append((sym, cm.name))
+            fields.append(Field(cm.name, sym, table))
+        return RelationPlan(P.TableScanNode(handle, meta, assignments), fields)
+
+    def plan_join(self, j: ast.Join, outer, ctes) -> RelationPlan:
+        left = self.plan_relation(j.left, outer, ctes)
+        right = self.plan_relation(j.right, outer, ctes)
+        fields = left.fields + right.fields
+        if j.kind == "cross":
+            node = P.JoinNode("cross", left.node, right.node, [])
+            return RelationPlan(node, fields)
+        criteria = []
+        residual: list[Expr] = []
+        scope = Scope(fields, outer)
+        left_syms = {f.symbol.name for f in left.fields}
+        right_syms = {f.symbol.name for f in right.fields}
+        conjuncts: list[ast.Node] = []
+        if j.on is not None:
+            conjuncts = split_conjuncts(j.on)
+        for name in j.using:
+            lsym = Scope(left.fields).resolve((name,))[0]
+            rsym = Scope(right.fields).resolve((name,))[0]
+            criteria.append((lsym, rsym))
+        an = ExprAnalyzer(scope)
+        for c in conjuncts:
+            e = an.analyze(c)
+            pair = _as_equi_pair(e, left_syms, right_syms)
+            if pair is not None:
+                criteria.append(pair)
+            else:
+                residual.append(e)
+        node = P.JoinNode(
+            j.kind, left.node, right.node, criteria,
+            ir.and_(*residual) if residual else None,
+        )
+        return RelationPlan(node, fields)
+
+    # -- SELECT core ---------------------------------------------------------
+
+    def plan_query_spec(self, spec: ast.QuerySpec, outer, ctes):
+        # FROM
+        if spec.relation is not None:
+            rp = self.plan_relation(spec.relation, outer, ctes)
+        else:
+            rp = RelationPlan(P.ValuesNode([], [[]]), [])
+        source_scope = rp.scope(outer)
+
+        # WHERE (with subquery grafting)
+        if spec.where is not None:
+            rp = self._apply_where(rp, spec.where, outer, ctes)
+            source_scope = rp.scope(outer)
+
+        # aggregation?
+        agg_calls: list[ast.FunctionCall] = []
+        for item in spec.items:
+            if isinstance(item, ast.SelectItem):
+                collect_aggregates(item.expr, agg_calls)
+        if spec.having is not None:
+            collect_aggregates(spec.having, agg_calls)
+        has_agg = bool(spec.group_by) or bool(agg_calls)
+
+        names: list[str] = []
+        if has_agg:
+            rp, names = self._plan_aggregation(spec, rp, source_scope, outer, ctes)
+        else:
+            rp, names = self._plan_select_items(spec, rp, source_scope, outer, ctes)
+
+        if spec.distinct:
+            rp = RelationPlan(
+                P.AggregationNode(rp.node, [f.symbol for f in rp.fields], []),
+                rp.fields,
+            )
+        return rp, names
+
+    def _plan_select_items(self, spec, rp, scope, outer, ctes):
+        assignments = []
+        fields = []
+        names = []
+        graft = _SubqueryGrafter(self, rp, outer, ctes)
+        an = ExprAnalyzer(scope, on_subquery=graft)
+        for item in spec.items:
+            if isinstance(item, ast.Star):
+                for f in rp.fields:
+                    if item.qualifier and f.alias != item.qualifier[-1]:
+                        continue
+                    assignments.append((f.symbol, f.symbol.ref()))
+                    fields.append(Field(f.name, f.symbol))
+                    names.append(f.name)
+                continue
+            e = an.analyze(item.expr)
+            name = item.alias or _name_hint(item.expr)
+            sym = self.alloc.new(name, e.type)
+            assignments.append((sym, e))
+            fields.append(Field(name if item.alias else sym.name, sym))
+            names.append(name)
+        rp = graft.plan  # subqueries may have grown the source plan
+        node = P.ProjectNode(rp.node, assignments)
+        return RelationPlan(node, fields), names
+
+    def _plan_aggregation(self, spec, rp, source_scope, outer, ctes, extra_keys=()):
+        """`extra_keys`: source symbols injected as group keys and kept in the
+        output (used by subquery decorrelation)."""
+        alloc = self.alloc
+        pre_assign: list = []  # [(Symbol, Expr)] inputs to the aggregation
+        pre_map: dict = {}  # ir key -> Symbol
+
+        def pre_symbol(e: Expr, hint: str) -> P.Symbol:
+            k = e.key()
+            if k in pre_map:
+                return pre_map[k]
+            if isinstance(e, SymbolRef):
+                sym = P.Symbol(e.name, e.type)
+            else:
+                sym = alloc.new(hint, e.type)
+            pre_map[k] = sym
+            pre_assign.append((sym, e))
+            return sym
+
+        graft = _SubqueryGrafter(self, rp, outer, ctes)
+        src_an = ExprAnalyzer(source_scope, on_subquery=graft)
+
+        # group-by expressions (ordinals allowed)
+        group_irs: list[Expr] = []
+        group_syms: list[P.Symbol] = []
+        group_keys: dict = {}
+        for ksym in extra_keys:
+            e = ksym.ref()
+            if e.key() in group_keys:
+                continue
+            sym = pre_symbol(e, ksym.name)
+            group_syms.append(sym)
+            group_keys[e.key()] = sym
+        for g in spec.group_by:
+            if isinstance(g, ast.NumberLiteral):
+                item = spec.items[int(g.text) - 1]
+                g = item.expr
+            e = src_an.analyze(g)
+            if e.key() in group_keys:
+                continue
+            sym = pre_symbol(e, _name_hint(g))
+            group_irs.append(e)
+            group_syms.append(sym)
+            group_keys[e.key()] = sym
+
+        # aggregates discovered lazily while translating post-agg expressions
+        aggregations: list = []  # [(Symbol, P.Aggregation)]
+        agg_map: dict = {}
+
+        def agg_symbol(fc: ast.FunctionCall) -> P.Symbol:
+            filter_ir = None
+            filter_key = None
+            if fc.filter is not None:
+                filter_sym = pre_symbol(
+                    src_an.analyze(fc.filter), "agg_filter"
+                )
+                filter_ir = filter_sym.ref()
+                filter_key = filter_ir.key()
+            if fc.is_star and fc.name == "count":
+                key = ("count_star", (), False, filter_key)
+                fname, arg_syms, arg_t = "count_star", [], None
+            else:
+                arg_irs = [src_an.analyze(a) for a in fc.args]
+                key = (
+                    AGG_FUNCS[fc.name],
+                    tuple(a.key() for a in arg_irs),
+                    fc.distinct,
+                    filter_key,
+                )
+                fname = AGG_FUNCS[fc.name]
+                arg_syms = [
+                    pre_symbol(a, _name_hint(fc.args[i]))
+                    for i, a in enumerate(arg_irs)
+                ]
+                arg_t = arg_irs[0].type if arg_irs else None
+            if key in agg_map:
+                return agg_map[key]
+            out_t = agg_result_type(fname, arg_t)
+            sym = alloc.new(fc.name, out_t)
+            aggregations.append(
+                (
+                    sym,
+                    P.Aggregation(
+                        fname, [s.ref() for s in arg_syms], fc.distinct, filter_ir
+                    ),
+                )
+            )
+            agg_map[key] = sym
+            return sym
+
+        def post_hook(node: ast.Node, _an) -> Optional[Expr]:
+            if isinstance(node, ast.FunctionCall) and node.window is None and (
+                node.name in AGG_FUNCS or (node.is_star and node.name == "count")
+            ):
+                return agg_symbol(node).ref()
+            # match against group-by expressions
+            try:
+                e = src_an.analyze(node)
+            except AnalysisError:
+                return None
+            sym = group_keys.get(e.key())
+            if sym is not None:
+                return sym.ref()
+            if isinstance(node, ast.Identifier):
+                raise AnalysisError(
+                    f"column {'.'.join(node.parts)} must appear in GROUP BY "
+                    "or be used in an aggregate"
+                )
+            return None
+
+        # translate select items (this fills pre_assign/aggregations)
+        post_assignments = []
+        post_fields = []
+        names = []
+        # injected decorrelation keys lead the output so callers can find them
+        for ksym in extra_keys:
+            gsym = group_keys[ksym.ref().key()]
+            post_assignments.append((gsym, gsym.ref()))
+            post_fields.append(Field(gsym.name, gsym))
+            names.append(gsym.name)
+        for item in spec.items:
+            if isinstance(item, ast.Star):
+                raise AnalysisError("SELECT * not allowed with GROUP BY")
+            post_an = ExprAnalyzer(source_scope, hook=post_hook)
+            e = post_an.analyze(item.expr)
+            name = item.alias or _name_hint(item.expr)
+            sym = alloc.new(name, e.type)
+            post_assignments.append((sym, e))
+            post_fields.append(Field(name if item.alias else sym.name, sym))
+            names.append(name)
+
+        having_ir = None
+        having_subqueries = []
+        if spec.having is not None:
+            for conj in split_conjuncts(spec.having):
+                if _contains_subquery(conj):
+                    having_subqueries.append(conj)
+                else:
+                    post_an = ExprAnalyzer(source_scope, hook=post_hook)
+                    e = post_an.analyze(conj)
+                    having_ir = ir.and_(having_ir, e) if having_ir is not None else e
+
+        # assemble: graft plan -> pre-project -> aggregate -> having -> project
+        src_node = graft.plan.node
+        # keep any source symbols referenced by pre_assign
+        pre_node = P.ProjectNode(src_node, pre_assign)
+        agg_node = P.AggregationNode(pre_node, group_syms, aggregations)
+        cur = RelationPlan(
+            agg_node,
+            [Field(s.name, s) for s in agg_node.outputs],
+        )
+        if having_ir is not None:
+            cur = RelationPlan(P.FilterNode(cur.node, having_ir), cur.fields)
+        for conj in having_subqueries:
+            cur = self._apply_conjunct_with_subquery(
+                cur, conj, outer, ctes,
+                analyzer_factory=lambda g: ExprAnalyzer(
+                    source_scope, hook=post_hook, on_subquery=g
+                ),
+            )
+        node = P.ProjectNode(cur.node, post_assignments)
+        return RelationPlan(node, post_fields), names
+
+    # -- WHERE + subqueries --------------------------------------------------
+
+    def _apply_where(self, rp, where: ast.Node, outer, ctes) -> RelationPlan:
+        for conj in split_conjuncts(where):
+            if _contains_subquery(conj):
+                rp = self._apply_conjunct_with_subquery(rp, conj, outer, ctes)
+            else:
+                an = ExprAnalyzer(rp.scope(outer))
+                rp = RelationPlan(P.FilterNode(rp.node, an.analyze(conj)), rp.fields)
+        return rp
+
+    def _apply_conjunct_with_subquery(
+        self, rp, conj: ast.Node, outer, ctes, analyzer_factory=None
+    ) -> RelationPlan:
+        graft = _SubqueryGrafter(self, rp, outer, ctes)
+        if analyzer_factory is not None:
+            an = analyzer_factory(graft)
+        else:
+            an = ExprAnalyzer(rp.scope(outer), on_subquery=graft)
+        e = an.analyze(conj)
+        out = graft.plan
+        return RelationPlan(P.FilterNode(out.node, e), out.fields)
+
+    # -- subquery grafting ---------------------------------------------------
+
+    def plan_subquery_value(self, rp, q: ast.Query, outer_scope, ctes, kind: str,
+                            negated: bool = False, in_value: Optional[Expr] = None):
+        """Attach a subquery to `rp`; returns (new RelationPlan, value Expr).
+
+        kind: 'scalar' | 'exists' | 'in'
+        """
+        spec = _subquery_spec(q)
+        sub_outer = outer_scope  # subquery sees the enclosing row scope
+        # plan FROM
+        if spec.relation is None:
+            raise AnalysisError("subquery without FROM not supported")
+        sub = self.plan_relation(spec.relation, sub_outer, ctes)
+        # classify conjuncts
+        plain: list[ast.Node] = []
+        correlated: list[Expr] = []
+        crit: list[tuple] = []  # (outer Symbol, inner Symbol)
+        sub_scope = sub.scope(sub_outer)
+        sub_syms = {f.symbol.name for f in sub.fields}
+        if spec.where is not None:
+            for c in split_conjuncts(spec.where):
+                if _contains_subquery(c):
+                    plain.append(c)  # nested subquery: recurse via _apply_where
+                    continue
+                outer_refs: set = set()
+                an = ExprAnalyzer(sub_scope, outer_refs=outer_refs)
+                e = an.analyze(c)
+                if not outer_refs:
+                    sub = RelationPlan(P.FilterNode(sub.node, e), sub.fields)
+                    sub_scope = sub.scope(sub_outer)
+                    continue
+                pair = _as_equi_pair(e, outer_refs, sub_syms)
+                if pair is not None:
+                    crit.append(pair)
+                else:
+                    correlated.append(e)
+        for c in plain:
+            sub = self._apply_where(sub, c, sub_outer, ctes)
+        # ---- EXISTS / IN ----------------------------------------------------
+        if kind == "exists":
+            mark = self.alloc.new("exists", T.BOOLEAN)
+            if not crit:
+                raise AnalysisError("uncorrelated EXISTS not supported yet")
+            (osym, isym), extra = crit[0], crit[1:]
+            filt = None
+            parts = correlated + [
+                ir.comparison("=", o.ref(), i.ref()) for o, i in extra
+            ]
+            if parts:
+                filt = ir.and_(*parts)
+            node = P.SemiJoinNode(rp.node, sub.node, osym, isym, mark, filt)
+            out = RelationPlan(node, rp.fields + [Field(mark.name, mark)])
+            val = mark.ref()
+            return out, (ir.not_(val) if negated else val)
+        if kind == "in":
+            # value IN (select col ...): inner value column from select items
+            sub_proj, names = self._plan_select_items(spec, sub, sub_scope, sub_outer, ctes)
+            if len(sub_proj.fields) != 1:
+                raise AnalysisError("IN subquery must return one column")
+            item_aggs: list = []
+            if spec.items and isinstance(spec.items[0], ast.SelectItem):
+                collect_aggregates(spec.items[0].expr, item_aggs)
+            if spec.group_by or item_aggs or spec.having is not None:
+                # grouped IN subquery (Q18): plan fully then semi join
+                sub_full, _ = self.plan_query_spec(spec, sub_outer, ctes)
+                inner_sym = sub_full.fields[0].symbol
+                sub_node = sub_full.node
+            else:
+                inner_sym = sub_proj.fields[0].symbol
+                sub_node = sub_proj.node
+            mark = self.alloc.new("in_mark", T.BOOLEAN)
+            if crit or correlated:
+                raise AnalysisError("correlated IN subquery not supported yet")
+            assert in_value is not None
+            if isinstance(in_value, SymbolRef):
+                src_sym = P.Symbol(in_value.name, in_value.type)
+                src_node = rp.node
+                out_fields = rp.fields
+            else:
+                src_sym = self.alloc.new("in_value", in_value.type)
+                src_node = P.ProjectNode(
+                    rp.node,
+                    [(f.symbol, f.symbol.ref()) for f in rp.fields]
+                    + [(src_sym, in_value)],
+                )
+                out_fields = rp.fields + [Field(src_sym.name, src_sym)]
+            node = P.SemiJoinNode(src_node, sub_node, src_sym, inner_sym, mark)
+            out = RelationPlan(node, out_fields + [Field(mark.name, mark)])
+            val = mark.ref()
+            return out, (ir.not_(val) if negated else val)
+        # ---- scalar ---------------------------------------------------------
+        assert kind == "scalar"
+        agg_calls: list = []
+        for item in spec.items:
+            if isinstance(item, ast.SelectItem):
+                collect_aggregates(item.expr, agg_calls)
+        if not agg_calls and not spec.group_by:
+            # non-aggregated scalar subquery: single row enforced
+            if crit or correlated:
+                raise AnalysisError(
+                    "correlated non-aggregated scalar subquery not supported"
+                )
+            sub_proj, _ = self._plan_select_items(spec, sub, sub_scope, sub_outer, ctes)
+            single = P.EnforceSingleRowNode(sub_proj.node)
+            node = P.JoinNode("cross", rp.node, single, [])
+            out = RelationPlan(node, rp.fields + sub_proj.fields)
+            return out, sub_proj.fields[0].symbol.ref()
+        # aggregated scalar subquery: group by correlation keys, LEFT JOIN
+        inner_keys = [i for _, i in crit]
+        spec2 = ast.QuerySpec(
+            spec.items, None, None, spec.group_by, spec.having, False
+        )
+        rp2, names2 = self._plan_aggregation(
+            spec2, sub, sub_scope, sub_outer, ctes, extra_keys=inner_keys
+        )
+        if crit:
+            # join against the *output* key symbols of the grouped subquery
+            out_keys = [rp2.fields[i].symbol for i in range(len(inner_keys))]
+            node = P.JoinNode(
+                "left",
+                rp.node,
+                rp2.node,
+                [(o, k) for (o, _), k in zip(crit, out_keys)],
+                ir.and_(*correlated) if correlated else None,
+            )
+            out = RelationPlan(node, rp.fields + rp2.fields)
+            value_sym = rp2.fields[len(inner_keys)].symbol
+            val: Expr = value_sym.ref()
+            # count over no matching rows must be 0, but the LEFT JOIN yields
+            # NULL for unmatched outer rows — coalesce when the subquery's
+            # value is exactly a count aggregate (the classic count bug)
+            if _is_bare_count(spec):
+                val = SpecialForm(
+                    Form.COALESCE, [val, Literal(0, T.BIGINT)], T.BIGINT
+                )
+            return out, val
+        # uncorrelated aggregated scalar: global agg -> single row cross join
+        node = P.JoinNode("cross", rp.node, rp2.node, [])
+        out = RelationPlan(node, rp.fields + rp2.fields)
+        return out, rp2.fields[0].symbol.ref()
+
+
+def _is_bare_count(spec: ast.QuerySpec) -> bool:
+    if len(spec.items) != 1 or not isinstance(spec.items[0], ast.SelectItem):
+        return False
+    e = spec.items[0].expr
+    return isinstance(e, ast.FunctionCall) and e.name == "count"
+
+
+def _subquery_spec(q: ast.Query) -> ast.QuerySpec:
+    body = q.body
+    if isinstance(body, ast.QuerySpec):
+        return body
+    raise AnalysisError("unsupported subquery shape")
+
+
+class _SubqueryGrafter:
+    """on_subquery callback: plans subquery expressions against the current
+    relation plan, growing it via joins (SubqueryPlanner's apply mechanism)."""
+
+    def __init__(self, planner: LogicalPlanner, rp: RelationPlan, outer, ctes):
+        self.planner = planner
+        self.plan = rp
+        self.outer = outer
+        self.ctes = ctes
+
+    def __call__(self, node: ast.Node, an: ExprAnalyzer) -> Expr:
+        scope = self.plan.scope(self.outer)
+        if isinstance(node, ast.Exists):
+            self.plan, val = self.planner.plan_subquery_value(
+                self.plan, node.query, scope, self.ctes, "exists", node.negated
+            )
+            return val
+        if isinstance(node, ast.InSubquery):
+            value_ir = ExprAnalyzer(scope).analyze(node.value)
+            self.plan, val = self.planner.plan_subquery_value(
+                self.plan, node.query, scope, self.ctes, "in", node.negated,
+                in_value=value_ir,
+            )
+            return val
+        if isinstance(node, ast.ScalarSubquery):
+            self.plan, val = self.planner.plan_subquery_value(
+                self.plan, node.query, scope, self.ctes, "scalar"
+            )
+            return val
+        raise AnalysisError(f"unsupported subquery node {type(node).__name__}")
+
+
+def _contains_subquery(node: ast.Node) -> bool:
+    if isinstance(node, (ast.Exists, ast.InSubquery, ast.ScalarSubquery,
+                         ast.QuantifiedComparison)):
+        return True
+    for f in getattr(node, "__dataclass_fields__", {}):
+        v = getattr(node, f)
+        if isinstance(v, ast.Query):
+            continue
+        if isinstance(v, ast.Node) and _contains_subquery(v):
+            return True
+        if isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, ast.Node) and _contains_subquery(item):
+                    return True
+                if isinstance(item, tuple):
+                    for sub in item:
+                        if isinstance(sub, ast.Node) and _contains_subquery(sub):
+                            return True
+    return False
+
+
+def _as_equi_pair(e: Expr, left_names, right_names):
+    """If e is `lsym = rsym` with sides in the two given name sets, return the
+    (left Symbol, right Symbol) pair (swapping as needed)."""
+    if not (isinstance(e, Call) and e.name == "$eq"):
+        return None
+    a, b = e.args
+    if not (isinstance(a, SymbolRef) and isinstance(b, SymbolRef)):
+        return None
+    if a.name in left_names and b.name in right_names:
+        return (P.Symbol(a.name, a.type), P.Symbol(b.name, b.type))
+    if b.name in left_names and a.name in right_names:
+        return (P.Symbol(b.name, b.type), P.Symbol(a.name, a.type))
+    return None
+
+
+def _name_hint(e: ast.Node) -> str:
+    if isinstance(e, ast.Identifier):
+        return e.parts[-1]
+    if isinstance(e, ast.FunctionCall):
+        return e.name
+    return "expr"

@@ -1,0 +1,406 @@
+"""Logical plan nodes (reference: sql/planner/plan/*.java — PlanNode tree).
+
+Symbol-based: every node outputs named, typed Symbols; expressions in nodes
+are expr.ir trees over SymbolRef leaves.  The LocalExecutionPlanner maps
+symbols to channels when building operator chains.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from trino_tpu import types as T
+from trino_tpu.connectors.api import TableHandle, TableMetadata
+from trino_tpu.expr.ir import Expr, SymbolRef
+
+
+@dataclass(frozen=True)
+class Symbol:
+    name: str
+    type: T.Type
+
+    def ref(self) -> SymbolRef:
+        return SymbolRef(self.name, self.type)
+
+
+class SymbolAllocator:
+    """Unique symbol names (reference: sql/planner/SymbolAllocator.java)."""
+
+    def __init__(self):
+        self._counter = itertools.count()
+        self._used: set[str] = set()
+
+    def new(self, hint: str, type: T.Type) -> Symbol:
+        base = "".join(c if (c.isalnum() or c == "_") else "_" for c in hint.lower()) or "expr"
+        name = base
+        while name in self._used:
+            name = f"{base}_{next(self._counter)}"
+        self._used.add(name)
+        return Symbol(name, type)
+
+
+class PlanNode:
+    id: int = 0
+
+    @property
+    def outputs(self) -> list[Symbol]:
+        raise NotImplementedError
+
+    @property
+    def children(self) -> list["PlanNode"]:
+        return []
+
+    def with_children(self, children: Sequence["PlanNode"]) -> "PlanNode":
+        raise NotImplementedError
+
+
+@dataclass
+class TableScanNode(PlanNode):
+    handle: TableHandle
+    table_meta: TableMetadata
+    assignments: list  # [(Symbol, column_name)]
+    #: conjuncts pushed into the connector scan (TupleDomain analog)
+    pushed_predicate: Optional[Expr] = None
+
+    @property
+    def outputs(self):
+        return [s for s, _ in self.assignments]
+
+    def with_children(self, children):
+        assert not children
+        return self
+
+
+@dataclass
+class FilterNode(PlanNode):
+    source: PlanNode
+    predicate: Expr
+
+    @property
+    def outputs(self):
+        return self.source.outputs
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return FilterNode(children[0], self.predicate)
+
+
+@dataclass
+class ProjectNode(PlanNode):
+    source: PlanNode
+    assignments: list  # [(Symbol, Expr)]
+
+    @property
+    def outputs(self):
+        return [s for s, _ in self.assignments]
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return ProjectNode(children[0], self.assignments)
+
+    def is_identity(self) -> bool:
+        src = self.source.outputs
+        if len(src) != len(self.assignments):
+            return False
+        return all(
+            isinstance(e, SymbolRef)
+            and e.name == s.name
+            and s.name == src_s.name
+            for (s, e), src_s in zip(self.assignments, src)
+        )
+
+
+@dataclass
+class Aggregation:
+    """One aggregate: function name + argument expressions (symbol refs)."""
+
+    function: str  # sum/count/count_star/avg/min/max/any_value/...
+    args: list  # [Expr]; empty for count_star
+    distinct: bool = False
+    filter: Optional[Expr] = None
+
+
+@dataclass
+class AggregationNode(PlanNode):
+    source: PlanNode
+    group_symbols: list  # [Symbol] (outputs for keys)
+    aggregations: list  # [(Symbol, Aggregation)]
+    step: str = "single"  # single | partial | final
+
+    @property
+    def outputs(self):
+        return list(self.group_symbols) + [s for s, _ in self.aggregations]
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return AggregationNode(
+            children[0], self.group_symbols, self.aggregations, self.step
+        )
+
+
+@dataclass
+class JoinNode(PlanNode):
+    kind: str  # inner | left | right | full | cross
+    left: PlanNode
+    right: PlanNode
+    criteria: list  # [(left Symbol, right Symbol)] equi-join keys
+    filter: Optional[Expr] = None  # residual over combined symbols
+    #: planner hint: 'partitioned' or 'broadcast' (AddExchanges decision)
+    distribution: Optional[str] = None
+
+    @property
+    def outputs(self):
+        return self.left.outputs + self.right.outputs
+
+    @property
+    def children(self):
+        return [self.left, self.right]
+
+    def with_children(self, children):
+        return JoinNode(
+            self.kind, children[0], children[1], self.criteria, self.filter,
+            self.distribution,
+        )
+
+
+@dataclass
+class SemiJoinNode(PlanNode):
+    """source rows marked by key membership in filtering source (reference:
+    sql/planner/plan/SemiJoinNode.java).  negate=True -> anti join mark."""
+
+    source: PlanNode
+    filtering: PlanNode
+    source_key: Symbol
+    filtering_key: Symbol
+    mark: Symbol  # boolean output symbol
+    filter: Optional[Expr] = None  # extra correlated filter (over both sides)
+
+    @property
+    def outputs(self):
+        return self.source.outputs + [self.mark]
+
+    @property
+    def children(self):
+        return [self.source, self.filtering]
+
+    def with_children(self, children):
+        return SemiJoinNode(
+            children[0], children[1], self.source_key, self.filtering_key,
+            self.mark, self.filter,
+        )
+
+
+@dataclass
+class SortNode(PlanNode):
+    source: PlanNode
+    orderings: list  # [(Symbol, ascending, nulls_first)]
+
+    @property
+    def outputs(self):
+        return self.source.outputs
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return SortNode(children[0], self.orderings)
+
+
+@dataclass
+class TopNNode(PlanNode):
+    source: PlanNode
+    orderings: list
+    count: int
+
+    @property
+    def outputs(self):
+        return self.source.outputs
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return TopNNode(children[0], self.orderings, self.count)
+
+
+@dataclass
+class LimitNode(PlanNode):
+    source: PlanNode
+    count: int
+
+    @property
+    def outputs(self):
+        return self.source.outputs
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return LimitNode(children[0], self.count)
+
+
+@dataclass
+class ValuesNode(PlanNode):
+    symbols: list
+    rows: list  # python values in logical units
+
+    @property
+    def outputs(self):
+        return self.symbols
+
+    def with_children(self, children):
+        assert not children
+        return self
+
+
+@dataclass
+class UnionNode(PlanNode):
+    sources: list
+    symbols: list  # output symbols
+    #: per-source mapping: list of symbol lists aligned with `symbols`
+    source_symbols: list = field(default_factory=list)
+
+    @property
+    def outputs(self):
+        return self.symbols
+
+    @property
+    def children(self):
+        return list(self.sources)
+
+    def with_children(self, children):
+        return UnionNode(list(children), self.symbols, self.source_symbols)
+
+
+@dataclass
+class EnforceSingleRowNode(PlanNode):
+    """Scalar subquery guard (reference: plan/EnforceSingleRowNode.java)."""
+
+    source: PlanNode
+
+    @property
+    def outputs(self):
+        return self.source.outputs
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return EnforceSingleRowNode(children[0])
+
+
+@dataclass
+class OutputNode(PlanNode):
+    source: PlanNode
+    column_names: list
+    symbols: list
+
+    @property
+    def outputs(self):
+        return self.symbols
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return OutputNode(children[0], self.column_names, self.symbols)
+
+
+@dataclass
+class ExchangeNode(PlanNode):
+    """Data redistribution boundary (reference: plan/ExchangeNode.java).
+    Inserted by the distributed planner; scope 'remote' fragments the plan."""
+
+    source: PlanNode
+    kind: str  # repartition | broadcast | gather | merge
+    partition_symbols: list = field(default_factory=list)
+    orderings: list = field(default_factory=list)  # for merge exchanges
+
+    @property
+    def outputs(self):
+        return self.source.outputs
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return ExchangeNode(
+            children[0], self.kind, self.partition_symbols, self.orderings
+        )
+
+
+def walk(node: PlanNode):
+    yield node
+    for c in node.children:
+        yield from walk(c)
+
+
+def plan_text(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN rendering (reference role: planprinter/PlanPrinter.java)."""
+    pad = "  " * indent
+    name = type(node).__name__.replace("Node", "")
+    detail = ""
+    if isinstance(node, TableScanNode):
+        h = node.handle
+        cols = ", ".join(c for _, c in node.assignments)
+        detail = f"[{h.catalog}.{h.schema}.{h.table}] columns=[{cols}]"
+        if node.pushed_predicate is not None:
+            detail += f" pushed={node.pushed_predicate!r}"
+    elif isinstance(node, FilterNode):
+        detail = f"[{node.predicate!r}]"
+    elif isinstance(node, ProjectNode):
+        detail = "[" + ", ".join(f"{s.name} := {e!r}" for s, e in node.assignments) + "]"
+    elif isinstance(node, AggregationNode):
+        keys = ", ".join(s.name for s in node.group_symbols)
+        aggs = ", ".join(
+            f"{s.name} := {a.function}({', '.join(map(repr, a.args))})"
+            for s, a in node.aggregations
+        )
+        detail = f"[{node.step}] keys=[{keys}] aggs=[{aggs}]"
+    elif isinstance(node, JoinNode):
+        crit = ", ".join(f"{l.name} = {r.name}" for l, r in node.criteria)
+        detail = f"[{node.kind}] criteria=[{crit}]"
+        if node.filter is not None:
+            detail += f" filter={node.filter!r}"
+        if node.distribution:
+            detail += f" dist={node.distribution}"
+    elif isinstance(node, SemiJoinNode):
+        detail = f"[{node.source_key.name} in {node.filtering_key.name} -> {node.mark.name}]"
+    elif isinstance(node, (SortNode, TopNNode)):
+        o = ", ".join(
+            f"{s.name} {'ASC' if asc else 'DESC'}" for s, asc, _ in node.orderings
+        )
+        detail = f"[{o}]"
+        if isinstance(node, TopNNode):
+            detail += f" limit={node.count}"
+    elif isinstance(node, LimitNode):
+        detail = f"[{node.count}]"
+    elif isinstance(node, OutputNode):
+        detail = "[" + ", ".join(node.column_names) + "]"
+    elif isinstance(node, ExchangeNode):
+        detail = f"[{node.kind}]" + (
+            f" by=[{', '.join(s.name for s in node.partition_symbols)}]"
+            if node.partition_symbols
+            else ""
+        )
+    lines = [f"{pad}{name}{detail}"]
+    for c in node.children:
+        lines.append(plan_text(c, indent + 1))
+    return "\n".join(lines)

@@ -1,0 +1,10 @@
+"""SQL frontend (reference: core/trino-parser + core/trino-main/.../sql/analyzer).
+
+Hand-written tokenizer + Pratt parser producing an immutable AST
+(reference: SqlParser.java:45 + AstBuilder over SqlBase.g4), then a scoped,
+typed analysis pass (reference: StatementAnalyzer.java:388).
+"""
+
+from trino_tpu.sql.parser import parse_statement
+
+__all__ = ["parse_statement"]

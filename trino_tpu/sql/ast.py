@@ -1,0 +1,340 @@
+"""SQL AST (reference: core/trino-parser/.../sql/tree — ~200 node classes).
+
+Immutable dataclasses; the analyzer walks these, never mutates them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+class Node:
+    pass
+
+
+# -- expressions -------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Identifier(Node):
+    parts: tuple  # qualified name parts, e.g. ('l', 'orderkey')
+
+
+@dataclass(frozen=True)
+class NumberLiteral(Node):
+    text: str
+
+
+@dataclass(frozen=True)
+class StringLiteral(Node):
+    value: str
+
+
+@dataclass(frozen=True)
+class BooleanLiteral(Node):
+    value: bool
+
+
+@dataclass(frozen=True)
+class NullLiteral(Node):
+    pass
+
+
+@dataclass(frozen=True)
+class DateLiteral(Node):
+    text: str
+
+
+@dataclass(frozen=True)
+class TimestampLiteral(Node):
+    text: str
+
+
+@dataclass(frozen=True)
+class IntervalLiteral(Node):
+    value: str
+    unit: str  # day/month/year/hour/minute/second
+    sign: int = 1
+
+
+@dataclass(frozen=True)
+class BinaryOp(Node):
+    op: str  # + - * / % = <> < <= > >= and or ||
+    left: Node
+    right: Node
+
+
+@dataclass(frozen=True)
+class UnaryOp(Node):
+    op: str  # - + not
+    operand: Node
+
+
+@dataclass(frozen=True)
+class FunctionCall(Node):
+    name: str
+    args: tuple
+    distinct: bool = False
+    is_star: bool = False  # count(*)
+    window: object = None  # Window spec or None
+    filter: object = None  # FILTER (WHERE ...) expression
+
+
+@dataclass(frozen=True)
+class WindowSpec(Node):
+    partition_by: tuple
+    order_by: tuple  # of SortItem
+    frame: object = None
+
+
+@dataclass(frozen=True)
+class CastExpr(Node):
+    operand: Node
+    type_name: str
+    safe: bool = False  # TRY_CAST
+
+
+@dataclass(frozen=True)
+class CaseExpr(Node):
+    operand: Optional[Node]  # simple CASE has operand
+    whens: tuple  # of (cond, value)
+    default: Optional[Node]
+
+
+@dataclass(frozen=True)
+class InList(Node):
+    value: Node
+    items: tuple
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InSubquery(Node):
+    value: Node
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Exists(Node):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Node):
+    query: "Query"
+
+
+@dataclass(frozen=True)
+class QuantifiedComparison(Node):
+    op: str
+    value: Node
+    quantifier: str  # all/any/some
+    query: "Query"
+
+
+@dataclass(frozen=True)
+class Between(Node):
+    value: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Like(Node):
+    value: Node
+    pattern: Node
+    escape: Optional[Node] = None
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull(Node):
+    value: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsDistinctFrom(Node):
+    left: Node
+    right: Node
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Extract(Node):
+    unit: str
+    operand: Node
+
+
+@dataclass(frozen=True)
+class Star(Node):
+    qualifier: tuple = ()  # e.g. ('t',) for t.*
+
+
+@dataclass(frozen=True)
+class Placeholder(Node):
+    index: int
+
+
+@dataclass(frozen=True)
+class ArrayConstructor(Node):
+    items: tuple
+
+
+@dataclass(frozen=True)
+class Subscript(Node):
+    base: Node
+    index: Node
+
+
+# -- relations ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TableRef(Node):
+    name: tuple  # (catalog, schema, table) suffix-qualified
+
+
+@dataclass(frozen=True)
+class AliasedRelation(Node):
+    relation: Node
+    alias: str
+    column_aliases: tuple = ()
+
+
+@dataclass(frozen=True)
+class SubqueryRelation(Node):
+    query: "Query"
+
+
+@dataclass(frozen=True)
+class Join(Node):
+    kind: str  # inner/left/right/full/cross
+    left: Node
+    right: Node
+    on: Optional[Node] = None
+    using: tuple = ()
+
+
+@dataclass(frozen=True)
+class Unnest(Node):
+    exprs: tuple
+    with_ordinality: bool = False
+
+
+@dataclass(frozen=True)
+class ValuesRelation(Node):
+    rows: tuple  # of tuples of expressions
+
+
+# -- query structure ---------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SortItem(Node):
+    expr: Node
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None = default for direction
+
+
+@dataclass(frozen=True)
+class QuerySpec(Node):
+    items: tuple  # SelectItem | Star
+    relation: Optional[Node]
+    where: Optional[Node]
+    group_by: tuple
+    having: Optional[Node]
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class SetOp(Node):
+    op: str  # union/intersect/except
+    left: Node
+    right: Node
+    all: bool = False
+
+
+@dataclass(frozen=True)
+class WithQuery(Node):
+    name: str
+    query: "Query"
+    column_names: tuple = ()
+
+
+@dataclass(frozen=True)
+class Query(Node):
+    body: Node  # QuerySpec | SetOp | ValuesRelation | TableRef
+    order_by: tuple = ()
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    ctes: tuple = ()  # of WithQuery
+
+
+# -- statements --------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SelectStatement(Node):
+    query: Query
+
+
+@dataclass(frozen=True)
+class ExplainStatement(Node):
+    statement: Node
+    analyze: bool = False
+
+
+@dataclass(frozen=True)
+class CreateTableAs(Node):
+    name: tuple
+    query: Query
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class CreateTable(Node):
+    name: tuple
+    columns: tuple  # of (name, type_name)
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class DropTable(Node):
+    name: tuple
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class InsertStatement(Node):
+    name: tuple
+    query: Query
+    columns: tuple = ()
+
+
+@dataclass(frozen=True)
+class ShowStatement(Node):
+    what: str  # tables/schemas/catalogs/columns
+    target: tuple = ()
+
+
+@dataclass(frozen=True)
+class SetSession(Node):
+    name: str
+    value: Node
+
+
+@dataclass(frozen=True)
+class UseStatement(Node):
+    catalog: Optional[str]
+    schema: str

@@ -1,0 +1,750 @@
+"""Recursive-descent / Pratt SQL parser.
+
+Reference role: core/trino-parser/.../SqlParser.java:45 + AstBuilder.java over
+SqlBase.g4 (1,233 grammar lines).  Covers the engine's SQL subset: queries
+with CTEs/joins/subqueries/set-ops/window-functions, DML (INSERT), DDL
+(CREATE/DROP TABLE, CTAS), EXPLAIN [ANALYZE], SHOW/DESCRIBE/USE, SET SESSION.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from trino_tpu.sql import ast
+from trino_tpu.sql.tokenizer import Token, tokenize
+
+
+class ParseError(ValueError):
+    def __init__(self, message: str, token: Token):
+        super().__init__(f"{message} (at position {token.pos}: {token.value!r})")
+        self.token = token
+
+
+# binding powers for binary operators (Pratt)
+_PRECEDENCE = {
+    "or": 1,
+    "and": 2,
+    # NOT handled as prefix at 3 in boolean context
+    "=": 4, "<>": 4, "!=": 4, "<": 4, "<=": 4, ">": 4, ">=": 4,
+    "between": 4, "in": 4, "like": 4, "is": 4,
+    "||": 5,
+    "+": 6, "-": 6,
+    "*": 7, "/": 7, "%": 7,
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -------------------------------------------------------
+
+    def peek(self, ahead: int = 0) -> Token:
+        return self.tokens[min(self.i + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> Optional[Token]:
+        if self.peek().is_kw(*kws):
+            return self.next()
+        return None
+
+    def expect_kw(self, *kws: str) -> Token:
+        t = self.next()
+        if not t.is_kw(*kws):
+            raise ParseError(f"expected {'/'.join(kws).upper()}", t)
+        return t
+
+    def accept_op(self, *ops: str) -> Optional[Token]:
+        t = self.peek()
+        if t.kind == "op" and t.value in ops:
+            return self.next()
+        return None
+
+    def expect_op(self, op: str) -> Token:
+        t = self.next()
+        if t.kind != "op" or t.value != op:
+            raise ParseError(f"expected {op!r}", t)
+        return t
+
+    def ident(self) -> str:
+        t = self.next()
+        if t.kind in ("ident", "qident"):
+            return t.value
+        if t.kind == "keyword":  # non-reserved keywords usable as names
+            return t.value
+        raise ParseError("expected identifier", t)
+
+    def qualified_name(self) -> tuple:
+        parts = [self.ident()]
+        while self.accept_op("."):
+            parts.append(self.ident())
+        return tuple(parts)
+
+    # -- entry ---------------------------------------------------------------
+
+    def parse_statement(self) -> ast.Node:
+        stmt = self._statement()
+        self.accept_op(";")
+        t = self.peek()
+        if t.kind != "eof":
+            raise ParseError("unexpected trailing input", t)
+        return stmt
+
+    def _statement(self) -> ast.Node:
+        t = self.peek()
+        if t.is_kw("select", "with", "values") or (t.kind == "op" and t.value == "("):
+            return ast.SelectStatement(self._query())
+        if t.is_kw("explain"):
+            self.next()
+            analyze = self.accept_kw("analyze") is not None
+            # optional (TYPE ...) options are accepted and ignored
+            if self.accept_op("("):
+                depth = 1
+                while depth:
+                    tk = self.next()
+                    if tk.kind == "eof":
+                        raise ParseError("unterminated EXPLAIN options", tk)
+                    if tk.kind == "op" and tk.value == "(":
+                        depth += 1
+                    elif tk.kind == "op" and tk.value == ")":
+                        depth -= 1
+            return ast.ExplainStatement(self._statement(), analyze=analyze)
+        if t.is_kw("create"):
+            return self._create()
+        if t.is_kw("drop"):
+            self.next()
+            self.expect_kw("table")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropTable(self.qualified_name(), if_exists)
+        if t.is_kw("insert"):
+            self.next()
+            self.expect_kw("into")
+            name = self.qualified_name()
+            columns = ()
+            if self.peek().kind == "op" and self.peek().value == "(":
+                # could be column list or the query in parens; look ahead
+                save = self.i
+                self.next()
+                first = self.peek()
+                if first.kind in ("ident", "qident") and self.peek(1).kind == "op" and self.peek(1).value in (",", ")"):
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                    columns = tuple(cols)
+                else:
+                    self.i = save
+            return ast.InsertStatement(name, self._query(), columns)
+        if t.is_kw("show"):
+            self.next()
+            what = self.next()
+            if what.is_kw("tables"):
+                target = ()
+                if self.accept_kw("from", "in"):
+                    target = self.qualified_name()
+                return ast.ShowStatement("tables", target)
+            if what.is_kw("schemas"):
+                target = ()
+                if self.accept_kw("from", "in"):
+                    target = self.qualified_name()
+                return ast.ShowStatement("schemas", target)
+            if what.is_kw("catalogs"):
+                return ast.ShowStatement("catalogs")
+            if what.is_kw("columns"):
+                self.expect_kw("from", "in")
+                return ast.ShowStatement("columns", self.qualified_name())
+            raise ParseError("unsupported SHOW", what)
+        if t.is_kw("describe"):
+            self.next()
+            return ast.ShowStatement("columns", self.qualified_name())
+        if t.is_kw("set"):
+            self.next()
+            self.expect_kw("session")
+            name_parts = [self.ident()]
+            while self.accept_op("."):
+                name_parts.append(self.ident())
+            self.expect_op("=")
+            value = self._expr()
+            return ast.SetSession(".".join(name_parts), value)
+        if t.is_kw("use"):
+            self.next()
+            name = self.qualified_name()
+            if len(name) == 2:
+                return ast.UseStatement(name[0], name[1])
+            return ast.UseStatement(None, name[0])
+        raise ParseError("unsupported statement", t)
+
+    def _create(self) -> ast.Node:
+        self.expect_kw("create")
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.qualified_name()
+        if self.accept_kw("as"):
+            return ast.CreateTableAs(name, self._query(), if_not_exists)
+        self.expect_op("(")
+        cols = []
+        while True:
+            cname = self.ident()
+            ctype = self._type_name()
+            cols.append((cname, ctype))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return ast.CreateTable(name, tuple(cols), if_not_exists)
+
+    def _type_name(self) -> str:
+        parts = [self.ident()]
+        # multi-word types: double precision, interval day to second, etc.
+        while self.peek().kind in ("ident", "keyword") and self.peek().value in (
+            "precision", "varying", "day", "month", "year", "to", "second",
+            "with", "without", "zone", "local",
+        ):
+            parts.append(self.next().value)
+        base = " ".join(parts)
+        if base == "double precision":
+            base = "double"
+        if self.accept_op("("):
+            args = [self.next().value]
+            while self.accept_op(","):
+                args.append(self.next().value)
+            self.expect_op(")")
+            base += "(" + ",".join(args) + ")"
+        return base
+
+    # -- queries -------------------------------------------------------------
+
+    def _query(self) -> ast.Query:
+        ctes = ()
+        if self.accept_kw("with"):
+            self.accept_kw("recursive")
+            lst = []
+            while True:
+                name = self.ident()
+                col_aliases = ()
+                if self.accept_op("("):
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                    col_aliases = tuple(cols)
+                self.expect_kw("as")
+                self.expect_op("(")
+                q = self._query()
+                self.expect_op(")")
+                lst.append(ast.WithQuery(name, q, col_aliases))
+                if not self.accept_op(","):
+                    break
+            ctes = tuple(lst)
+        body = self._query_body()
+        order_by, limit, offset = self._order_limit()
+        return ast.Query(body, order_by, limit, offset, ctes)
+
+    def _order_limit(self):
+        order_by = ()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            items = [self._sort_item()]
+            while self.accept_op(","):
+                items.append(self._sort_item())
+            order_by = tuple(items)
+        limit = offset = None
+        if self.accept_kw("offset"):
+            offset = int(self.next().value)
+            self.accept_kw("row", "rows")
+        if self.accept_kw("limit"):
+            t = self.next()
+            limit = None if t.is_kw("all") else int(t.value)
+        elif self.accept_kw("fetch"):
+            self.expect_kw("first", "next")
+            limit = int(self.next().value)
+            self.accept_kw("row", "rows")
+            self.expect_kw("only")
+        return order_by, limit, offset
+
+    def _sort_item(self) -> ast.SortItem:
+        e = self._expr()
+        ascending = True
+        if self.accept_kw("asc"):
+            pass
+        elif self.accept_kw("desc"):
+            ascending = False
+        nulls_first = None
+        if self.accept_kw("nulls"):
+            t = self.expect_kw("first", "last")
+            nulls_first = t.value == "first"
+        return ast.SortItem(e, ascending, nulls_first)
+
+    def _query_body(self) -> ast.Node:
+        left = self._query_term()
+        while True:
+            t = self.peek()
+            if t.is_kw("union", "intersect", "except"):
+                self.next()
+                all_ = self.accept_kw("all") is not None
+                if not all_:
+                    self.accept_kw("distinct")
+                right = self._query_term()
+                left = ast.SetOp(t.value, left, right, all_)
+            else:
+                return left
+
+    def _query_term(self) -> ast.Node:
+        t = self.peek()
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            q = self._query()
+            self.expect_op(")")
+            # parenthesized query may itself carry order/limit; wrap
+            return q
+        if t.is_kw("values"):
+            self.next()
+            rows = []
+            while True:
+                if self.accept_op("("):
+                    row = [self._expr()]
+                    while self.accept_op(","):
+                        row.append(self._expr())
+                    self.expect_op(")")
+                    rows.append(tuple(row))
+                else:
+                    rows.append((self._expr(),))
+                if not self.accept_op(","):
+                    break
+            return ast.ValuesRelation(tuple(rows))
+        if t.is_kw("table"):
+            self.next()
+            return ast.TableRef(self.qualified_name())
+        return self._query_spec()
+
+    def _query_spec(self) -> ast.QuerySpec:
+        self.expect_kw("select")
+        distinct = False
+        if self.accept_kw("distinct"):
+            distinct = True
+        else:
+            self.accept_kw("all")
+        items = [self._select_item()]
+        while self.accept_op(","):
+            items.append(self._select_item())
+        relation = None
+        if self.accept_kw("from"):
+            relation = self._relation()
+            while self.accept_op(","):
+                right = self._relation()
+                relation = ast.Join("cross", relation, right)
+        where = self._expr() if self.accept_kw("where") else None
+        group_by = ()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            exprs = [self._expr()]
+            while self.accept_op(","):
+                exprs.append(self._expr())
+            group_by = tuple(exprs)
+        having = self._expr() if self.accept_kw("having") else None
+        return ast.QuerySpec(tuple(items), relation, where, group_by, having, distinct)
+
+    def _select_item(self):
+        t = self.peek()
+        if t.kind == "op" and t.value == "*":
+            self.next()
+            return ast.Star()
+        # qualified star: ident(.ident)*.*
+        save = self.i
+        if t.kind in ("ident", "qident"):
+            parts = [self.ident()]
+            star = False
+            while self.accept_op("."):
+                if self.accept_op("*"):
+                    star = True
+                    break
+                parts.append(self.ident())
+            if star:
+                return ast.Star(tuple(parts))
+            self.i = save
+        e = self._expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind in ("ident", "qident"):
+            alias = self.ident()
+        return ast.SelectItem(e, alias)
+
+    # -- relations -----------------------------------------------------------
+
+    def _relation(self) -> ast.Node:
+        left = self._aliased_relation()
+        while True:
+            t = self.peek()
+            if t.is_kw("cross"):
+                self.next()
+                self.expect_kw("join")
+                right = self._aliased_relation()
+                left = ast.Join("cross", left, right)
+            elif t.is_kw("join", "inner", "left", "right", "full"):
+                kind = "inner"
+                if t.is_kw("inner"):
+                    self.next()
+                elif t.is_kw("left", "right", "full"):
+                    kind = t.value
+                    self.next()
+                    self.accept_kw("outer")
+                self.expect_kw("join")
+                right = self._aliased_relation()
+                if self.accept_kw("on"):
+                    cond = self._expr()
+                    left = ast.Join(kind, left, right, on=cond)
+                elif self.accept_kw("using"):
+                    self.expect_op("(")
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                    left = ast.Join(kind, left, right, using=tuple(cols))
+                else:
+                    raise ParseError("expected ON or USING", self.peek())
+            else:
+                return left
+
+    def _aliased_relation(self) -> ast.Node:
+        r = self._relation_primary()
+        alias = None
+        column_aliases = ()
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind in ("ident", "qident"):
+            alias = self.ident()
+        if alias is not None and self.peek().kind == "op" and self.peek().value == "(":
+            # column aliases t(a, b)
+            self.next()
+            cols = [self.ident()]
+            while self.accept_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+            column_aliases = tuple(cols)
+        if alias is not None:
+            return ast.AliasedRelation(r, alias, column_aliases)
+        return r
+
+    def _relation_primary(self) -> ast.Node:
+        t = self.peek()
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            inner = self.peek()
+            if inner.is_kw("select", "with", "values"):
+                q = self._query()
+                self.expect_op(")")
+                return ast.SubqueryRelation(q)
+            r = self._relation()
+            self.expect_op(")")
+            return r
+        if t.is_kw("unnest"):
+            self.next()
+            self.expect_op("(")
+            exprs = [self._expr()]
+            while self.accept_op(","):
+                exprs.append(self._expr())
+            self.expect_op(")")
+            with_ord = False
+            if self.accept_kw("with"):
+                self.expect_kw("ordinality")
+                with_ord = True
+            return ast.Unnest(tuple(exprs), with_ord)
+        if t.is_kw("lateral"):
+            self.next()
+            self.expect_op("(")
+            q = self._query()
+            self.expect_op(")")
+            return ast.SubqueryRelation(q)  # analyzer handles correlation
+        return ast.TableRef(self.qualified_name())
+
+    # -- expressions (Pratt) -------------------------------------------------
+
+    def _expr(self, min_bp: int = 0) -> ast.Node:
+        left = self._prefix()
+        while True:
+            t = self.peek()
+            negated = False
+            if t.is_kw("not") and self.peek(1).is_kw("in", "like", "between"):
+                if _PRECEDENCE["in"] < min_bp:
+                    return left
+                self.next()
+                t = self.peek()
+                negated = True
+            if t.kind == "op" and t.value in _PRECEDENCE:
+                bp = _PRECEDENCE[t.value]
+                if bp < min_bp:
+                    return left
+                self.next()
+                right = self._expr(bp + 1)
+                left = ast.BinaryOp(t.value, left, right)
+                continue
+            if t.is_kw("and", "or"):
+                bp = _PRECEDENCE[t.value]
+                if bp < min_bp:
+                    return left
+                self.next()
+                right = self._expr(bp + 1)
+                left = ast.BinaryOp(t.value, left, right)
+                continue
+            if t.is_kw("is"):
+                if _PRECEDENCE["is"] < min_bp:
+                    return left
+                self.next()
+                neg = self.accept_kw("not") is not None
+                if self.accept_kw("null"):
+                    left = ast.IsNull(left, neg)
+                elif self.accept_kw("distinct"):
+                    self.expect_kw("from")
+                    right = self._expr(_PRECEDENCE["is"] + 1)
+                    left = ast.IsDistinctFrom(left, right, neg)
+                elif self.accept_kw("true"):
+                    # IS TRUE is never NULL: coalesce(x, false)
+                    e = ast.FunctionCall(
+                        "coalesce", (left, ast.BooleanLiteral(False))
+                    )
+                    left = ast.UnaryOp("not", e) if neg else e
+                elif self.accept_kw("false"):
+                    e = ast.FunctionCall(
+                        "coalesce",
+                        (ast.UnaryOp("not", left), ast.BooleanLiteral(False)),
+                    )
+                    left = ast.UnaryOp("not", e) if neg else e
+                else:
+                    raise ParseError("expected NULL/DISTINCT FROM", self.peek())
+                continue
+            if t.is_kw("between"):
+                if _PRECEDENCE["between"] < min_bp:
+                    return left
+                self.next()
+                low = self._expr(_PRECEDENCE["between"] + 1)
+                self.expect_kw("and")
+                high = self._expr(_PRECEDENCE["between"] + 1)
+                left = ast.Between(left, low, high, negated)
+                continue
+            if t.is_kw("in"):
+                if _PRECEDENCE["in"] < min_bp:
+                    return left
+                self.next()
+                self.expect_op("(")
+                if self.peek().is_kw("select", "with"):
+                    q = self._query()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, q, negated)
+                else:
+                    items = [self._expr()]
+                    while self.accept_op(","):
+                        items.append(self._expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, tuple(items), negated)
+                continue
+            if t.is_kw("like"):
+                if _PRECEDENCE["like"] < min_bp:
+                    return left
+                self.next()
+                pattern = self._expr(_PRECEDENCE["like"] + 1)
+                escape = None
+                if self.accept_kw("escape"):
+                    escape = self._expr(_PRECEDENCE["like"] + 1)
+                left = ast.Like(left, pattern, escape, negated)
+                continue
+            return left
+
+    def _prefix(self) -> ast.Node:
+        t = self.next()
+        if t.kind == "number":
+            e: ast.Node = ast.NumberLiteral(t.value)
+        elif t.kind == "string":
+            e = ast.StringLiteral(t.value)
+        elif t.is_kw("null"):
+            e = ast.NullLiteral()
+        elif t.is_kw("true"):
+            e = ast.BooleanLiteral(True)
+        elif t.is_kw("false"):
+            e = ast.BooleanLiteral(False)
+        elif t.is_kw("date"):
+            if self.peek().kind == "string":
+                e = ast.DateLiteral(self.next().value)
+            else:
+                e = ast.Identifier(("date",))
+        elif t.is_kw("timestamp"):
+            if self.peek().kind == "string":
+                e = ast.TimestampLiteral(self.next().value)
+            else:
+                e = ast.Identifier(("timestamp",))
+        elif t.is_kw("interval"):
+            sign = 1
+            if self.accept_op("-"):
+                sign = -1
+            else:
+                self.accept_op("+")
+            val = self.next()
+            unit = self.next()
+            e = ast.IntervalLiteral(val.value, unit.value.lower(), sign)
+        elif t.is_kw("case"):
+            e = self._case()
+        elif t.is_kw("cast", "try_cast"):
+            self.expect_op("(")
+            operand = self._expr()
+            self.expect_kw("as")
+            tn = self._type_name()
+            self.expect_op(")")
+            e = ast.CastExpr(operand, tn, safe=t.value == "try_cast")
+        elif t.is_kw("exists"):
+            self.expect_op("(")
+            q = self._query()
+            self.expect_op(")")
+            e = ast.Exists(q)
+        elif t.is_kw("extract"):
+            self.expect_op("(")
+            unit = self.next().value.lower()
+            self.expect_kw("from")
+            operand = self._expr()
+            self.expect_op(")")
+            e = ast.Extract(unit, operand)
+        elif t.is_kw("substring"):
+            # substring(x FROM a [FOR b]) or substring(x, a, b)
+            self.expect_op("(")
+            operand = self._expr()
+            if self.accept_kw("from"):
+                start = self._expr()
+                length = self._expr() if self.accept_kw("for") else None
+            else:
+                self.expect_op(",")
+                start = self._expr()
+                length = self._expr() if self.accept_op(",") else None
+            self.expect_op(")")
+            args = (operand, start) + ((length,) if length is not None else ())
+            e = ast.FunctionCall("substr", args)
+        elif t.is_kw("position"):
+            self.expect_op("(")
+            sub = self._expr()
+            self.expect_kw("in")
+            operand = self._expr()
+            self.expect_op(")")
+            e = ast.FunctionCall("strpos", (operand, sub))
+        elif t.is_kw("current_date"):
+            e = ast.FunctionCall("current_date", ())
+        elif t.is_kw("current_timestamp", "localtimestamp"):
+            e = ast.FunctionCall("current_timestamp", ())
+        elif t.is_kw("not"):
+            e = ast.UnaryOp("not", self._expr(3))
+        elif t.is_kw("array"):
+            self.expect_op("[")
+            items = []
+            if not self.accept_op("]"):
+                items.append(self._expr())
+                while self.accept_op(","):
+                    items.append(self._expr())
+                self.expect_op("]")
+            e = ast.ArrayConstructor(tuple(items))
+        elif t.kind == "op" and t.value == "-":
+            e = ast.UnaryOp("-", self._expr(8))
+        elif t.kind == "op" and t.value == "+":
+            e = self._expr(8)
+        elif t.kind == "op" and t.value == "(":
+            if self.peek().is_kw("select", "with"):
+                q = self._query()
+                self.expect_op(")")
+                e = ast.ScalarSubquery(q)
+            else:
+                e = self._expr()
+                self.expect_op(")")
+        elif t.kind == "op" and t.value == "?":
+            e = ast.Placeholder(0)
+        elif t.kind in ("ident", "qident") or t.kind == "keyword":
+            # function call or (qualified) identifier
+            if self.peek().kind == "op" and self.peek().value == "(":
+                e = self._function_call(t.value if t.kind != "qident" else t.value)
+            else:
+                parts = [t.value]
+                while self.accept_op("."):
+                    parts.append(self.ident())
+                e = ast.Identifier(tuple(parts))
+        else:
+            raise ParseError("unexpected token in expression", t)
+        # postfix subscript
+        while self.accept_op("["):
+            idx = self._expr()
+            self.expect_op("]")
+            e = ast.Subscript(e, idx)
+        return e
+
+    def _case(self) -> ast.CaseExpr:
+        operand = None
+        if not self.peek().is_kw("when"):
+            operand = self._expr()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self._expr()
+            self.expect_kw("then")
+            val = self._expr()
+            whens.append((cond, val))
+        default = None
+        if self.accept_kw("else"):
+            default = self._expr()
+        self.expect_kw("end")
+        return ast.CaseExpr(operand, tuple(whens), default)
+
+    def _function_call(self, name: str) -> ast.Node:
+        self.expect_op("(")
+        distinct = False
+        is_star = False
+        args: list[ast.Node] = []
+        if self.accept_op("*"):
+            is_star = True
+        elif not (self.peek().kind == "op" and self.peek().value == ")"):
+            if self.accept_kw("distinct"):
+                distinct = True
+            else:
+                self.accept_kw("all")
+            args.append(self._expr())
+            while self.accept_op(","):
+                args.append(self._expr())
+        self.expect_op(")")
+        filt = None
+        if self.accept_kw("filter"):
+            self.expect_op("(")
+            self.expect_kw("where")
+            filt = self._expr()
+            self.expect_op(")")
+        window = None
+        if self.accept_kw("over"):
+            self.expect_op("(")
+            partition_by: list[ast.Node] = []
+            order_by: list[ast.SortItem] = []
+            if self.accept_kw("partition"):
+                self.expect_kw("by")
+                partition_by.append(self._expr())
+                while self.accept_op(","):
+                    partition_by.append(self._expr())
+            if self.accept_kw("order"):
+                self.expect_kw("by")
+                order_by.append(self._sort_item())
+                while self.accept_op(","):
+                    order_by.append(self._sort_item())
+            # frame clause accepted and ignored (default frames only)
+            if self.peek().is_kw("rows", "range"):
+                while not (self.peek().kind == "op" and self.peek().value == ")"):
+                    if self.peek().kind == "eof":
+                        raise ParseError("unterminated window frame", self.peek())
+                    self.next()
+            self.expect_op(")")
+            window = ast.WindowSpec(tuple(partition_by), tuple(order_by))
+        return ast.FunctionCall(name.lower(), tuple(args), distinct, is_star, window, filt)
+
+
+def parse_statement(sql: str) -> ast.Node:
+    return Parser(sql).parse_statement()

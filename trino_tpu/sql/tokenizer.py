@@ -1,0 +1,136 @@
+"""SQL tokenizer (reference role: the ANTLR lexer of SqlBase.g4)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "exists", "between", "like",
+    "escape", "is", "null", "true", "false", "case", "when", "then", "else",
+    "end", "cast", "try_cast", "extract", "interval", "date", "time",
+    "timestamp", "distinct", "all", "any", "some", "union", "intersect",
+    "except", "join", "inner", "left", "right", "full", "outer", "cross",
+    "on", "using", "natural", "with", "recursive", "values", "asc", "desc",
+    "nulls", "first", "last", "create", "table", "drop", "insert", "into",
+    "delete", "update", "set", "session", "show", "tables", "schemas",
+    "catalogs", "columns", "describe", "explain", "analyze", "if",
+    "row", "rows", "fetch", "next", "only", "array", "map", "grouping",
+    "rollup", "cube", "over", "partition", "range", "unbounded", "preceding",
+    "following", "current", "filter", "within", "ordinality", "unnest",
+    "lateral", "tablesample", "bernoulli", "system", "substring", "for",
+    "position", "localtime", "localtimestamp", "current_date",
+    "current_time", "current_timestamp", "exec", "execute", "prepare",
+    "deallocate", "commit", "rollback", "start", "transaction", "use",
+    "year", "month", "day", "hour", "minute", "second", "quarter", "week",
+    "to",
+}
+
+_MULTI_OPS = ("<=", ">=", "<>", "!=", "||", "->", "=>")
+_SINGLE_OPS = "+-*/%(),.;=<>[]?:"
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # keyword | ident | qident | number | string | op | eof
+    value: str
+    pos: int
+
+    def is_kw(self, *kws: str) -> bool:
+        return self.kind == "keyword" and self.value in kws
+
+
+class TokenizeError(ValueError):
+    pass
+
+
+def tokenize(sql: str) -> list[Token]:
+    out: list[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        ch = sql[i]
+        if ch.isspace():
+            i += 1
+            continue
+        if sql.startswith("--", i):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise TokenizeError(f"unterminated comment at {i}")
+            i = j + 2
+            continue
+        if ch == "'":
+            j = i + 1
+            buf = []
+            while True:
+                if j >= n:
+                    raise TokenizeError(f"unterminated string at {i}")
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        buf.append("'")
+                        j += 2
+                        continue
+                    break
+                buf.append(sql[j])
+                j += 1
+            out.append(Token("string", "".join(buf), i))
+            i = j + 1
+            continue
+        if ch == '"':
+            j = sql.find('"', i + 1)
+            if j < 0:
+                raise TokenizeError(f"unterminated identifier at {i}")
+            out.append(Token("qident", sql[i + 1 : j], i))
+            i = j + 1
+            continue
+        if ch.isdigit() or (ch == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = seen_exp = False
+            while j < n:
+                c = sql[j]
+                if c.isdigit():
+                    j += 1
+                elif c == "." and not seen_dot and not seen_exp:
+                    seen_dot = True
+                    j += 1
+                elif c in "eE" and not seen_exp and j + 1 < n and (
+                    sql[j + 1].isdigit()
+                    or (sql[j + 1] in "+-" and j + 2 < n and sql[j + 2].isdigit())
+                ):
+                    seen_exp = True
+                    j += 2 if sql[j + 1] in "+-" else 1
+                else:
+                    break
+            out.append(Token("number", sql[i:j], i))
+            i = j
+            continue
+        if ch.isalpha() or ch == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            word = sql[i:j].lower()
+            if word in KEYWORDS:
+                out.append(Token("keyword", word, i))
+            else:
+                out.append(Token("ident", word, i))
+            i = j
+            continue
+        matched = False
+        for op in _MULTI_OPS:
+            if sql.startswith(op, i):
+                out.append(Token("op", op, i))
+                i += len(op)
+                matched = True
+                break
+        if matched:
+            continue
+        if ch in _SINGLE_OPS:
+            out.append(Token("op", ch, i))
+            i += 1
+            continue
+        raise TokenizeError(f"unexpected character {ch!r} at {i}")
+    out.append(Token("eof", "", n))
+    return out
