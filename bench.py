@@ -1,0 +1,116 @@
+"""Benchmark harness: TPC-H on the engine, one JSON line for the driver.
+
+Reference role: testing/trino-benchmark (AbstractOperatorBenchmark /
+HandTpchQuery1.java:48 print rows/s on a LocalQueryRunner) + the benchto
+tpch.yaml workload definitions.  Runs on whatever jax.devices() provides
+(the real TPU chip under the driver; CPU elsewhere).
+
+Usage: python bench.py [--sf SF] [--query N] [--runs N]
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline: speedup of the engine's device pipeline over a single-host
+pandas implementation of the same query on the same data (the stand-in for
+the reference's single-node Java CPU engine until a measured Java number is
+recorded in BASELINE.json "published").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/trino_tpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+def _engine_time(runner, sql: str, runs: int) -> float:
+    # one untimed run to compile every fragment kernel (XLA warm-up,
+    # mirroring benchto's prewarm runs)
+    runner.execute(sql)
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        runner.execute(sql)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _pandas_q1_time(schema: str, runs: int) -> float:
+    """Single-node columnar CPU baseline of Q1 (pandas on the same data)."""
+    import pandas as pd
+
+    from tests.tpch_oracle import ORACLES
+    from trino_tpu.testing import tpch_pandas
+
+    t = lambda name: tpch_pandas(schema, name)
+    for tbl in ("lineitem",):
+        t(tbl)  # materialize outside the timed region
+    best = float("inf")
+    for _ in range(max(1, runs)):
+        t0 = time.perf_counter()
+        ORACLES[1](t)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=1.0)
+    ap.add_argument("--query", type=int, default=1)
+    ap.add_argument("--runs", type=int, default=3)
+    args = ap.parse_args()
+
+    from trino_tpu.connectors.api import CatalogManager
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.connectors.tpch.schema import SCHEMAS
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    # pick the named schema matching --sf (tiny=0.01, sf1=1.0, ...)
+    schema = next((k for k, v in SCHEMAS.items() if v == args.sf), None)
+    if schema is None:
+        schema = "tiny" if args.sf <= 0.01 else "sf1"
+
+    catalogs = CatalogManager()
+    catalogs.register("tpch", TpchConnector())
+    runner = LocalQueryRunner(catalogs, catalog="tpch", schema=schema, target_splits=8)
+
+    sql = QUERIES[args.query]
+    from trino_tpu.connectors.tpch.generator import TpchGenerator
+
+    nrows = TpchGenerator(SCHEMAS.get(schema, args.sf)).row_count("lineitem")
+
+    wall = _engine_time(runner, sql, args.runs)
+    rows_per_sec = nrows / wall
+
+    vs = None
+    if args.query == 1:
+        try:
+            base = _pandas_q1_time(schema, 1)
+            vs = base / wall
+        except Exception:
+            vs = None
+
+    print(
+        json.dumps(
+            {
+                "metric": f"tpch_{schema}_q{args.query}_lineitem_rows_per_sec_per_chip",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(vs, 3) if vs is not None else None,
+                "wall_s": round(wall, 4),
+                "device": str(jax.devices()[0].platform),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
+# persistent XLA compile cache: the suite is compile-bound (many multi-second
+# sort/agg programs); caching makes repeat runs execution-bound
+jax.config.update("jax_compilation_cache_dir", "/tmp/trino_tpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import pytest  # noqa: E402
 

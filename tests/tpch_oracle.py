@@ -1,0 +1,448 @@
+"""Pandas reference implementations of the 22 TPC-H queries.
+
+Reference role: H2QueryRunner + QueryAssertions (testing/trino-testing/...):
+expected results come from an independent implementation over identical data.
+Each qN(t) takes a table accessor `t(name) -> DataFrame` (from
+trino_tpu.testing.tpch_pandas) and returns a DataFrame whose column ORDER
+matches the query output; comparison is positional with float tolerance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+def ts(s: str) -> pd.Timestamp:
+    return pd.Timestamp(s)
+
+
+def _rev(df):
+    return df.l_extendedprice * (1 - df.l_discount)
+
+
+def q1(t):
+    l = t("lineitem")
+    f = l[l.l_shipdate <= ts("1998-09-02")].assign(
+        disc_price=_rev(l), charge=_rev(l) * (1 + l.l_tax)
+    )
+    g = (
+        f.groupby(["l_returnflag", "l_linestatus"])
+        .agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"),
+            sum_charge=("charge", "sum"),
+            avg_qty=("l_quantity", "mean"),
+            avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"),
+            count_order=("l_returnflag", "size"),
+        )
+        .reset_index()
+        .sort_values(["l_returnflag", "l_linestatus"])
+    )
+    return g
+
+
+def q2(t):
+    p, s, ps, n, r = t("part"), t("supplier"), t("partsupp"), t("nation"), t("region")
+    eu = n.merge(r[r.r_name == "EUROPE"], left_on="n_regionkey", right_on="r_regionkey")
+    sup = s.merge(eu, left_on="s_nationkey", right_on="n_nationkey")
+    j = ps.merge(sup, left_on="ps_suppkey", right_on="s_suppkey")
+    pp = p[(p.p_size == 15) & p.p_type.str.endswith("BRASS")]
+    j = j.merge(pp, left_on="ps_partkey", right_on="p_partkey")
+    mins = j.groupby("p_partkey").ps_supplycost.transform("min")
+    j = j[j.ps_supplycost == mins]
+    out = j[
+        ["s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr",
+         "s_address", "s_phone", "s_comment"]
+    ].sort_values(
+        ["s_acctbal", "n_name", "s_name", "p_partkey"],
+        ascending=[False, True, True, True],
+    )
+    return out.head(100)
+
+
+def q3(t):
+    c, o, l = t("customer"), t("orders"), t("lineitem")
+    j = (
+        c[c.c_mktsegment == "BUILDING"]
+        .merge(o[o.o_orderdate < ts("1995-03-15")], left_on="c_custkey", right_on="o_custkey")
+        .merge(l[l.l_shipdate > ts("1995-03-15")], left_on="o_orderkey", right_on="l_orderkey")
+    )
+    j = j.assign(rev=_rev(j))
+    g = (
+        j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"], as_index=False)
+        .rev.sum()
+        .rename(columns={"rev": "revenue"})
+    )
+    g = g.sort_values(["revenue", "o_orderdate"], ascending=[False, True]).head(10)
+    return g[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
+
+
+def q4(t):
+    o, l = t("orders"), t("lineitem")
+    f = o[(o.o_orderdate >= ts("1993-07-01")) & (o.o_orderdate < ts("1993-10-01"))]
+    keys = l[l.l_commitdate < l.l_receiptdate].l_orderkey.unique()
+    f = f[f.o_orderkey.isin(keys)]
+    return (
+        f.groupby("o_orderpriority", as_index=False)
+        .size()
+        .rename(columns={"size": "order_count"})
+        .sort_values("o_orderpriority")
+    )
+
+
+def q5(t):
+    c, o, l, s, n, r = (
+        t("customer"), t("orders"), t("lineitem"), t("supplier"), t("nation"), t("region")
+    )
+    j = (
+        c.merge(o, left_on="c_custkey", right_on="o_custkey")
+        .merge(l, left_on="o_orderkey", right_on="l_orderkey")
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+        .merge(r[r.r_name == "ASIA"], left_on="n_regionkey", right_on="r_regionkey")
+    )
+    j = j[
+        (j.c_nationkey == j.s_nationkey)
+        & (j.o_orderdate >= ts("1994-01-01"))
+        & (j.o_orderdate < ts("1995-01-01"))
+    ]
+    j = j.assign(rev=_rev(j))
+    return (
+        j.groupby("n_name", as_index=False)
+        .rev.sum()
+        .rename(columns={"rev": "revenue"})
+        .sort_values("revenue", ascending=False)
+    )
+
+
+def q6(t):
+    l = t("lineitem")
+    f = l[
+        (l.l_shipdate >= ts("1994-01-01"))
+        & (l.l_shipdate < ts("1995-01-01"))
+        & (l.l_discount__cents >= 5)
+        & (l.l_discount__cents <= 7)
+        & (l.l_quantity < 24)
+    ]
+    return pd.DataFrame({"revenue": [(f.l_extendedprice * f.l_discount).sum()]})
+
+
+def q7(t):
+    s, l, o, c, n = t("supplier"), t("lineitem"), t("orders"), t("customer"), t("nation")
+    j = (
+        s.merge(l, left_on="s_suppkey", right_on="l_suppkey")
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(n.add_prefix("s_n_"), left_on="s_nationkey", right_on="s_n_n_nationkey")
+        .merge(n.add_prefix("c_n_"), left_on="c_nationkey", right_on="c_n_n_nationkey")
+    )
+    j = j[
+        (
+            ((j.s_n_n_name == "FRANCE") & (j.c_n_n_name == "GERMANY"))
+            | ((j.s_n_n_name == "GERMANY") & (j.c_n_n_name == "FRANCE"))
+        )
+        & (j.l_shipdate >= ts("1995-01-01"))
+        & (j.l_shipdate <= ts("1996-12-31"))
+    ]
+    j = j.assign(volume=_rev(j), l_year=j.l_shipdate.dt.year)
+    g = (
+        j.groupby(["s_n_n_name", "c_n_n_name", "l_year"], as_index=False)
+        .volume.sum()
+        .rename(
+            columns={"s_n_n_name": "supp_nation", "c_n_n_name": "cust_nation", "volume": "revenue"}
+        )
+        .sort_values(["supp_nation", "cust_nation", "l_year"])
+    )
+    return g[["supp_nation", "cust_nation", "l_year", "revenue"]]
+
+
+def q8(t):
+    p, s, l, o, c, n, r = (
+        t("part"), t("supplier"), t("lineitem"), t("orders"), t("customer"),
+        t("nation"), t("region"),
+    )
+    j = (
+        p[p.p_type == "ECONOMY ANODIZED STEEL"]
+        .merge(l, left_on="p_partkey", right_on="l_partkey")
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(n.add_prefix("c_n_"), left_on="c_nationkey", right_on="c_n_n_nationkey")
+        .merge(
+            r[r.r_name == "AMERICA"], left_on="c_n_n_regionkey", right_on="r_regionkey"
+        )
+        .merge(n.add_prefix("s_n_"), left_on="s_nationkey", right_on="s_n_n_nationkey")
+    )
+    j = j[(j.o_orderdate >= ts("1995-01-01")) & (j.o_orderdate <= ts("1996-12-31"))]
+    j = j.assign(volume=_rev(j), o_year=j.o_orderdate.dt.year)
+    j = j.assign(brazil=np.where(j.s_n_n_name == "BRAZIL", j.volume, 0.0))
+    g = j.groupby("o_year", as_index=False).agg(num=("brazil", "sum"), den=("volume", "sum"))
+    g = g.assign(mkt_share=g.num / g.den).sort_values("o_year")
+    return g[["o_year", "mkt_share"]]
+
+
+def q9(t):
+    p, s, l, ps, o, n = (
+        t("part"), t("supplier"), t("lineitem"), t("partsupp"), t("orders"), t("nation")
+    )
+    j = (
+        p[p.p_name.str.contains("green")]
+        .merge(l, left_on="p_partkey", right_on="l_partkey")
+        .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+        .merge(
+            ps,
+            left_on=["l_partkey", "l_suppkey"],
+            right_on=["ps_partkey", "ps_suppkey"],
+        )
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    )
+    j = j.assign(
+        amount=_rev(j) - j.ps_supplycost * j.l_quantity, o_year=j.o_orderdate.dt.year
+    )
+    g = (
+        j.groupby(["n_name", "o_year"], as_index=False)
+        .amount.sum()
+        .rename(columns={"n_name": "nation", "amount": "sum_profit"})
+        .sort_values(["nation", "o_year"], ascending=[True, False])
+    )
+    return g[["nation", "o_year", "sum_profit"]]
+
+
+def q10(t):
+    c, o, l, n = t("customer"), t("orders"), t("lineitem"), t("nation")
+    j = (
+        c.merge(
+            o[(o.o_orderdate >= ts("1993-10-01")) & (o.o_orderdate < ts("1994-01-01"))],
+            left_on="c_custkey",
+            right_on="o_custkey",
+        )
+        .merge(l[l.l_returnflag == "R"], left_on="o_orderkey", right_on="l_orderkey")
+        .merge(n, left_on="c_nationkey", right_on="n_nationkey")
+    )
+    j = j.assign(rev=_rev(j))
+    g = (
+        j.groupby(
+            ["c_custkey", "c_name", "c_acctbal", "c_phone", "n_name", "c_address", "c_comment"],
+            as_index=False,
+        )
+        .rev.sum()
+        .rename(columns={"rev": "revenue"})
+        .sort_values("revenue", ascending=False)
+        .head(20)
+    )
+    return g[
+        ["c_custkey", "c_name", "revenue", "c_acctbal", "n_name", "c_address", "c_phone", "c_comment"]
+    ]
+
+
+def _q11_base(t):
+    ps, s, n = t("partsupp"), t("supplier"), t("nation")
+    return ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey").merge(
+        n[n.n_name == "GERMANY"], left_on="s_nationkey", right_on="n_nationkey"
+    )
+
+
+def q11(t):
+    j = _q11_base(t).assign(v=lambda d: d.ps_supplycost * d.ps_availqty)
+    total = j.v.sum() * 0.0001
+    g = j.groupby("ps_partkey", as_index=False).v.sum().rename(columns={"v": "value"})
+    return g[g.value > total].sort_values("value", ascending=False)
+
+
+def q12(t):
+    o, l = t("orders"), t("lineitem")
+    f = l[
+        l.l_shipmode.isin(["MAIL", "SHIP"])
+        & (l.l_commitdate < l.l_receiptdate)
+        & (l.l_shipdate < l.l_commitdate)
+        & (l.l_receiptdate >= ts("1994-01-01"))
+        & (l.l_receiptdate < ts("1995-01-01"))
+    ]
+    j = o.merge(f, left_on="o_orderkey", right_on="l_orderkey")
+    hi = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    j = j.assign(high=hi.astype(np.int64), low=(~hi).astype(np.int64))
+    return (
+        j.groupby("l_shipmode", as_index=False)
+        .agg(high_line_count=("high", "sum"), low_line_count=("low", "sum"))
+        .sort_values("l_shipmode")
+    )
+
+
+def q13(t):
+    c, o = t("customer"), t("orders")
+    keep = o[~o.o_comment.str.contains("special.*requests")]
+    j = c.merge(keep, left_on="c_custkey", right_on="o_custkey", how="left")
+    per = j.groupby("c_custkey").o_orderkey.count().rename("c_count").reset_index()
+    g = (
+        per.groupby("c_count", as_index=False)
+        .size()
+        .rename(columns={"size": "custdist"})
+        .sort_values(["custdist", "c_count"], ascending=[False, False])
+    )
+    return g[["c_count", "custdist"]]
+
+
+def q14(t):
+    l, p = t("lineitem"), t("part")
+    f = l[(l.l_shipdate >= ts("1995-09-01")) & (l.l_shipdate < ts("1995-10-01"))]
+    j = f.merge(p, left_on="l_partkey", right_on="p_partkey")
+    rev = _rev(j)
+    promo = np.where(j.p_type.str.startswith("PROMO"), rev, 0.0)
+    return pd.DataFrame({"promo_revenue": [100.0 * promo.sum() / rev.sum()]})
+
+
+def q15(t):
+    l, s = t("lineitem"), t("supplier")
+    f = l[(l.l_shipdate >= ts("1996-01-01")) & (l.l_shipdate < ts("1996-04-01"))]
+    f = f.assign(rev=_rev(f))
+    r = f.groupby("l_suppkey", as_index=False).rev.sum().rename(
+        columns={"l_suppkey": "supplier_no", "rev": "total_revenue"}
+    )
+    top = r[np.isclose(r.total_revenue, r.total_revenue.max())]
+    j = s.merge(top, left_on="s_suppkey", right_on="supplier_no").sort_values("s_suppkey")
+    return j[["s_suppkey", "s_name", "s_address", "s_phone", "total_revenue"]]
+
+
+def q16(t):
+    ps, p, s = t("partsupp"), t("part"), t("supplier")
+    bad = s[s.s_comment.str.contains("Customer.*Complaints")].s_suppkey
+    pp = p[
+        (p.p_brand != "Brand#45")
+        & ~p.p_type.str.startswith("MEDIUM POLISHED")
+        & p.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])
+    ]
+    j = ps[~ps.ps_suppkey.isin(bad)].merge(pp, left_on="ps_partkey", right_on="p_partkey")
+    g = (
+        j.groupby(["p_brand", "p_type", "p_size"], as_index=False)
+        .ps_suppkey.nunique()
+        .rename(columns={"ps_suppkey": "supplier_cnt"})
+        .sort_values(
+            ["supplier_cnt", "p_brand", "p_type", "p_size"],
+            ascending=[False, True, True, True],
+        )
+    )
+    return g[["p_brand", "p_type", "p_size", "supplier_cnt"]]
+
+
+def q17(t):
+    l, p = t("lineitem"), t("part")
+    pp = p[(p.p_brand == "Brand#23") & (p.p_container == "MED BOX")]
+    j = l.merge(pp, left_on="l_partkey", right_on="p_partkey")
+    avg_q = l.groupby("l_partkey").l_quantity.mean().rename("avg_q")
+    j = j.join(avg_q, on="l_partkey")
+    f = j[j.l_quantity < 0.2 * j.avg_q]
+    return pd.DataFrame({"avg_yearly": [f.l_extendedprice.sum() / 7.0]})
+
+
+def q18(t):
+    c, o, l = t("customer"), t("orders"), t("lineitem")
+    big = l.groupby("l_orderkey").l_quantity.sum()
+    keys = big[big > 300].index
+    j = (
+        c.merge(o[o.o_orderkey.isin(keys)], left_on="c_custkey", right_on="o_custkey")
+        .merge(l, left_on="o_orderkey", right_on="l_orderkey")
+    )
+    g = (
+        j.groupby(
+            ["c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice"],
+            as_index=False,
+        )
+        .l_quantity.sum()
+        .sort_values(["o_totalprice", "o_orderdate"], ascending=[False, True])
+        .head(100)
+    )
+    return g[["c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice", "l_quantity"]]
+
+
+def q19(t):
+    l, p = t("lineitem"), t("part")
+    j = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    common = j.l_shipmode.isin(["AIR", "AIR REG"]) & (j.l_shipinstruct == "DELIVER IN PERSON")
+    b1 = (
+        (j.p_brand == "Brand#12")
+        & j.p_container.isin(["SM CASE", "SM BOX", "SM PACK", "SM PKG"])
+        & (j.l_quantity >= 1) & (j.l_quantity <= 11)
+        & (j.p_size >= 1) & (j.p_size <= 5)
+    )
+    b2 = (
+        (j.p_brand == "Brand#23")
+        & j.p_container.isin(["MED BAG", "MED BOX", "MED PKG", "MED PACK"])
+        & (j.l_quantity >= 10) & (j.l_quantity <= 20)
+        & (j.p_size >= 1) & (j.p_size <= 10)
+    )
+    b3 = (
+        (j.p_brand == "Brand#34")
+        & j.p_container.isin(["LG CASE", "LG BOX", "LG PACK", "LG PKG"])
+        & (j.l_quantity >= 20) & (j.l_quantity <= 30)
+        & (j.p_size >= 1) & (j.p_size <= 15)
+    )
+    f = j[common & (b1 | b2 | b3)]
+    return pd.DataFrame({"revenue": [_rev(f).sum()]})
+
+
+def q20(t):
+    s, n, ps, p, l = t("supplier"), t("nation"), t("partsupp"), t("part"), t("lineitem")
+    forest = p[p.p_name.str.startswith("forest")].p_partkey
+    lf = l[
+        (l.l_shipdate >= ts("1994-01-01")) & (l.l_shipdate < ts("1995-01-01"))
+    ]
+    qty = (
+        lf.groupby(["l_partkey", "l_suppkey"]).l_quantity.sum().rename("half_qty") * 0.5
+    ).reset_index()
+    j = ps[ps.ps_partkey.isin(forest)].merge(
+        qty, left_on=["ps_partkey", "ps_suppkey"], right_on=["l_partkey", "l_suppkey"]
+    )
+    good = j[j.ps_availqty > j.half_qty].ps_suppkey.unique()
+    out = s[s.s_suppkey.isin(good)].merge(
+        n[n.n_name == "CANADA"], left_on="s_nationkey", right_on="n_nationkey"
+    )
+    return out.sort_values("s_name")[["s_name", "s_address"]]
+
+
+def q21(t):
+    s, l, o, n = t("supplier"), t("lineitem"), t("orders"), t("nation")
+    late = l[l.l_receiptdate > l.l_commitdate]
+    # multi-supplier orders
+    nsupp = l.groupby("l_orderkey").l_suppkey.nunique()
+    multi = set(nsupp[nsupp > 1].index)
+    # orders where >1 supplier was late
+    nlate = late.groupby("l_orderkey").l_suppkey.nunique()
+    multi_late = set(nlate[nlate > 1].index)
+    j = (
+        s.merge(late, left_on="s_suppkey", right_on="l_suppkey")
+        .merge(o[o.o_orderstatus == "F"], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(n[n.n_name == "SAUDI ARABIA"], left_on="s_nationkey", right_on="n_nationkey")
+    )
+    j = j[j.l_orderkey.isin(multi) & ~j.l_orderkey.isin(multi_late)]
+    g = (
+        j.groupby("s_name", as_index=False)
+        .size()
+        .rename(columns={"size": "numwait"})
+        .sort_values(["numwait", "s_name"], ascending=[False, True])
+        .head(100)
+    )
+    return g[["s_name", "numwait"]]
+
+
+def q22(t):
+    c, o = t("customer"), t("orders")
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    cc = c.assign(cntrycode=c.c_phone.str[:2])
+    cc = cc[cc.cntrycode.isin(codes)]
+    avg_bal = cc[cc.c_acctbal > 0.0].c_acctbal.mean()
+    f = cc[(cc.c_acctbal > avg_bal) & ~cc.c_custkey.isin(o.o_custkey)]
+    g = (
+        f.groupby("cntrycode", as_index=False)
+        .agg(numcust=("c_custkey", "size"), totacctbal=("c_acctbal", "sum"))
+        .sort_values("cntrycode")
+    )
+    return g[["cntrycode", "numcust", "totacctbal"]]
+
+
+ORACLES = {i: fn for i, fn in enumerate(
+    [q1, q2, q3, q4, q5, q6, q7, q8, q9, q10, q11, q12, q13, q14, q15, q16,
+     q17, q18, q19, q20, q21, q22], start=1)}

@@ -58,6 +58,9 @@ def column_from_values(values: Sequence, t: Type) -> Column:
 
 
 def batch_from_rows(types: Sequence[Type], rows: Sequence[Sequence]) -> Batch:
+    if not types:
+        # zero-column batch (e.g. SELECT without FROM): row count rides the mask
+        return Batch([], np.ones(len(rows), dtype=bool))
     cols = []
     for ch, t in enumerate(types):
         cols.append(column_from_values([r[ch] for r in rows], t))

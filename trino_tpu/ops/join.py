@@ -1,0 +1,416 @@
+"""Join operators (reference: operator/join/* — HashBuilderOperator.java,
+LookupJoinOperator.java + JoinProbe, NestedLoopJoinOperator.java,
+HashSemiJoinOperator via SetBuilderOperator).
+
+TPU substitution (SURVEY.md §7): no per-row open-addressing probe.  The build
+side is materialized dense; each probe batch is joined by a *combined
+lexicographic sort* of build+probe keys (side as the least-significant key so
+build rows lead each key group), group-boundary detection, and a cumsum-based
+row expansion — all static-shape XLA.  Output capacity is data-dependent, so
+the match count is computed in a first jitted phase, pulled to host, bucketed
+to a power of two, and the expansion phase is jitted per bucket (the analog of
+the reference's page-size-bounded join output building).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.columnar.batch import concat_batches
+from trino_tpu.ops.common import SortKey, group_ids_from_sorted, multi_key_sort_perm, next_pow2
+
+
+def _dense_build(batches: list[Batch], types: Sequence[T.Type]) -> tuple[Batch, int]:
+    """Materialize the build side: concat + compact to pow2(live)."""
+    if not batches:
+        cols = [Column(np.zeros(1, dtype=t.np_dtype), t, np.zeros(1, dtype=bool)) for t in types]
+        return Batch(cols, np.zeros(1, dtype=bool)), 0
+    big = batches[0] if len(batches) == 1 else concat_batches(batches)
+    n = big.num_rows_host()
+    cap = next_pow2(max(n, 1), floor=1)
+    return jax.jit(Batch.compact_device, static_argnames=("out_capacity",))(
+        big, out_capacity=cap
+    ), n
+
+
+def _match_live(batch: Batch, key_channels) -> jnp.ndarray:
+    """Rows eligible for equi-matching: live AND no NULL key (SQL `=` never
+    matches NULL)."""
+    live = batch.mask()
+    for ch in key_channels:
+        v = batch.columns[ch].valid
+        if v is not None:
+            live = jnp.logical_and(live, v)
+    return live
+
+
+class _CombinedSortJoinBase:
+    """Shared machinery: locate, for every probe row, the contiguous run of
+    matching build rows via one combined sort."""
+
+    def __init__(self, probe_key_channels, build_key_channels):
+        self.probe_keys = list(probe_key_channels)
+        self.build_keys = list(build_key_channels)
+        self._locate = jax.jit(self._locate_step, static_argnames=("cap_b",))
+
+    def _combined_keys(self, build: Batch, probe: Batch) -> Batch:
+        """Host-side: key columns of both sides under one (union) dictionary."""
+        bk = Batch([build.columns[c] for c in self.build_keys], _match_live(build, self.build_keys))
+        pk = Batch([probe.columns[c] for c in self.probe_keys], _match_live(probe, self.probe_keys))
+        return concat_batches([bk, pk])
+
+    def _locate_step(self, combined: Batch, cap_b: int):
+        """Returns, per probe slot: (match_start, match_count) in combined
+        space, plus the sort permutation mapping sorted pos -> combined row."""
+        total = combined.capacity
+        nkeys = len(self.build_keys)
+        side = (jnp.arange(total, dtype=jnp.int64) >= cap_b).astype(jnp.int8)
+        sortable = combined.append_column(Column(side, T.TINYINT, None))
+        keys = [SortKey(i) for i in range(nkeys)] + [SortKey(nkeys)]
+        perm = multi_key_sort_perm(sortable, keys)
+        gid, _, _ = group_ids_from_sorted(combined, perm, list(range(nkeys)))
+        live_sorted = jnp.take(combined.mask(), perm, mode="clip")
+        is_build = jnp.logical_and(live_sorted, jnp.take(side, perm, mode="clip") == 0)
+        pos = jnp.arange(total, dtype=jnp.int64)
+        cnt_b = jax.ops.segment_sum(is_build.astype(jnp.int64), gid, total)
+        first = jax.ops.segment_min(jnp.where(live_sorted, pos, total), gid, total)
+        inv = jnp.zeros(total, dtype=jnp.int64).at[perm].set(pos)
+        probe_pos = inv[cap_b:]
+        g = gid[probe_pos]
+        probe_live = combined.mask()[cap_b:]
+        count = jnp.where(probe_live, cnt_b[g], 0)
+        start = jnp.where(probe_live, first[g], 0)
+        return start, count, perm
+
+
+class HashJoinOperator(_CombinedSortJoinBase):
+    """Equi join. Probe = left side (streamed), build = right (materialized);
+    output columns = probe columns ++ build columns (reference: JoinNode output
+    = left ++ right, build on right per LocalExecutionPlanner.visitJoin).
+
+    kind: inner | left | full.  (right joins are planned as flipped left
+    joins; cross joins use NestedLoopJoinOperator.)
+    """
+
+    def __init__(
+        self,
+        kind: str,
+        probe_key_channels: Sequence[int],
+        build_key_channels: Sequence[int],
+        build_types: Sequence[T.Type],
+        probe_types: Sequence[T.Type] = (),
+        residual=None,
+    ):
+        """`residual`: optional fn(candidate Batch: probe++build cols) -> bool
+        mask, the non-equi join conjuncts (reference: JoinNode.filter /
+        JoinFilterFunctionCompiler).  Outer-join semantics: a probe row whose
+        matches all fail the residual still emits one null-padded row."""
+        assert kind in ("inner", "left", "full")
+        super().__init__(probe_key_channels, build_key_channels)
+        self.kind = kind
+        self.build_types = list(build_types)
+        self._probe_types_cache = list(probe_types)
+        self.residual = residual
+        self.build: Optional[Batch] = None
+        self._build_rows = 0
+        self._build_matched = None  # bool[cap_b], for full outer
+        self._expand = jax.jit(self._expand_step, static_argnames=("out_cap", "cap_b"))
+
+    def set_build(self, batches: list[Batch]) -> None:
+        self.build, self._build_rows = _dense_build(batches, self.build_types)
+        if self.kind == "full":
+            self._build_matched = jnp.zeros(self.build.capacity, dtype=bool)
+
+    def _expand_step(
+        self, probe: Batch, start, count, perm, build_matched,
+        out_cap: int, cap_b: int, total_emit
+    ):
+        emit = count if self.kind == "inner" else jnp.where(probe.mask(), jnp.maximum(count, 1), 0)
+        offsets = jnp.cumsum(emit) - emit
+        cap_p = probe.capacity
+        has = emit > 0
+        seed = (
+            jnp.zeros(out_cap, dtype=jnp.int64)
+            .at[jnp.where(has, offsets, out_cap)]
+            .max(jnp.arange(cap_p, dtype=jnp.int64), mode="drop")
+        )
+        ids = jax.lax.cummax(seed)  # out slot -> probe slot
+        j = jnp.arange(out_cap, dtype=jnp.int64) - offsets[ids]
+        matched = j < count[ids]
+        build_pos = jnp.clip(start[ids] + j, 0, perm.shape[0] - 1)
+        build_row = jnp.clip(perm[build_pos], 0, cap_b - 1)
+        out_live = jnp.arange(out_cap, dtype=jnp.int64) < total_emit
+        pcols = [
+            Column(
+                jnp.take(c.data, ids, mode="clip"),
+                c.type,
+                None if c.valid is None else jnp.take(c.valid, ids, mode="clip"),
+                c.dictionary,
+            )
+            for c in probe.columns
+        ]
+        bvalid_base = jnp.logical_and(matched, out_live)
+        bcols = [
+            Column(
+                jnp.take(c.data, build_row, mode="clip"),
+                c.type,
+                bvalid_base
+                if c.valid is None
+                else jnp.logical_and(bvalid_base, jnp.take(c.valid, build_row, mode="clip")),
+                c.dictionary,
+            )
+            for c in self.build.columns
+        ]
+        keep_match = jnp.logical_and(matched, out_live)
+        if self.residual is not None:
+            candidate = Batch(list(pcols) + list(bcols), out_live)
+            keep_match = jnp.logical_and(keep_match, self.residual(candidate))
+            if self.kind == "inner":
+                out_live = keep_match
+            else:
+                # probe rows with emitted matches but zero residual survivors
+                # degrade their first slot to an unmatched (null-build) row
+                surv = jax.ops.segment_sum(
+                    keep_match.astype(jnp.int64), ids, probe.capacity
+                )
+                to_null = jnp.logical_and(
+                    jnp.logical_and(j == 0, surv[ids] == 0), out_live
+                )
+                out_live = jnp.logical_and(out_live, jnp.logical_or(keep_match, to_null))
+                bcols = [
+                    Column(
+                        c.data,
+                        c.type,
+                        jnp.logical_and(
+                            keep_match, c.valid if c.valid is not None else True
+                        ),
+                        c.dictionary,
+                    )
+                    for c in bcols
+                ]
+        new_matched = None
+        if self.kind == "full":
+            new_matched = build_matched.at[
+                jnp.where(keep_match, build_row, cap_b)
+            ].set(True, mode="drop")
+        return Batch(list(pcols) + list(bcols), out_live), new_matched
+
+    def _join_batch(self, probe: Batch) -> Batch:
+        cap_b = self.build.capacity
+        combined = self._combined_keys(self.build, probe)
+        start, count, perm = self._locate(combined, cap_b=cap_b)
+        if self.kind == "inner":
+            total = int(jnp.sum(count))
+        else:
+            total = int(jnp.sum(jnp.where(probe.mask(), jnp.maximum(count, 1), 0)))
+        out_cap = next_pow2(max(total, 1), floor=1024)
+        out, new_matched = self._expand(
+            probe, start, count, perm, self._build_matched,
+            out_cap=out_cap, cap_b=cap_b, total_emit=total,
+        )
+        if new_matched is not None:
+            self._build_matched = new_matched
+        return out
+
+    def process(self, stream):
+        assert self.build is not None, "set_build() before process()"
+        for probe in stream:
+            yield self._join_batch(probe)
+        if self.kind == "full":
+            yield self._unmatched_build()
+
+    def _unmatched_build(self) -> Batch:
+        """FULL OUTER tail: build rows never matched, probe columns NULL."""
+        b = self.build
+        live = jnp.logical_and(b.mask(), jnp.logical_not(self._build_matched))
+        ncols = []
+        for t in self._probe_types_cache:
+            ncols.append(
+                Column(
+                    jnp.zeros(b.capacity, dtype=t.np_dtype),
+                    t,
+                    jnp.zeros(b.capacity, dtype=bool),
+                    None,
+                )
+            )
+        return Batch(ncols + list(b.columns), live)
+
+
+class NestedLoopJoinOperator:
+    """Cross join (reference: NestedLoopJoinOperator.java): every probe row ×
+    every build row, via the same cumsum expansion with constant counts."""
+
+    def __init__(self, build_types: Sequence[T.Type]):
+        self.build_types = list(build_types)
+        self.build: Optional[Batch] = None
+        self._nb = 0
+        self._step = jax.jit(self._expand, static_argnames=("out_cap", "nb"))
+
+    def set_build(self, batches: list[Batch]) -> None:
+        self.build, self._nb = _dense_build(batches, self.build_types)
+
+    def _expand(self, probe: Batch, out_cap: int, nb: int, total_emit):
+        cap_p = probe.capacity
+        emit = jnp.where(probe.mask(), nb, 0)
+        offsets = jnp.cumsum(emit) - emit
+        has = emit > 0
+        seed = (
+            jnp.zeros(out_cap, dtype=jnp.int64)
+            .at[jnp.where(has, offsets, out_cap)]
+            .max(jnp.arange(cap_p, dtype=jnp.int64), mode="drop")
+        )
+        ids = jax.lax.cummax(seed)
+        j = jnp.arange(out_cap, dtype=jnp.int64) - offsets[ids]
+        out_live = jnp.arange(out_cap, dtype=jnp.int64) < total_emit
+        pcols = [
+            Column(
+                jnp.take(c.data, ids, mode="clip"),
+                c.type,
+                None if c.valid is None else jnp.take(c.valid, ids, mode="clip"),
+                c.dictionary,
+            )
+            for c in probe.columns
+        ]
+        bcols = [
+            Column(
+                jnp.take(c.data, j, mode="clip"),
+                c.type,
+                None if c.valid is None else jnp.take(c.valid, j, mode="clip"),
+                c.dictionary,
+            )
+            for c in self.build.columns
+        ]
+        return Batch(list(pcols) + list(bcols), out_live)
+
+    def process(self, stream):
+        assert self.build is not None
+        for probe in stream:
+            if self._nb == 0:
+                continue
+            total = probe.num_rows_host() * self._nb
+            out_cap = next_pow2(max(total, 1), floor=1024)
+            yield self._step(probe, out_cap=out_cap, nb=self._nb, total_emit=total)
+
+
+class SemiJoinOperator(_CombinedSortJoinBase):
+    """Appends a boolean `mark` column: source key ∈ filtering-side keys.
+
+    null_aware=True gives SQL IN null semantics — mark is NULL when the
+    source key is NULL, or when there is no match but the filtering side
+    contains a NULL (reference: HashSemiJoinOperator + SetBuilderOperator's
+    containsNull handling).  null_aware=False is EXISTS: plain boolean.
+
+    `residual`: optional fn(candidate Batch: source++filtering cols) -> bool
+    mask for correlated EXISTS conjuncts (reference: the filter function of
+    JoinNode produced for correlated exists, e.g. TPC-H Q21's
+    l2.l_suppkey <> l1.l_suppkey); a row is marked iff some key-matching
+    filtering row also passes the residual.
+    """
+
+    def __init__(
+        self,
+        source_key_channel: int,
+        filtering_key_channel: int,
+        filtering_types: Sequence[T.Type],
+        null_aware: bool = True,
+        residual=None,
+    ):
+        super().__init__([source_key_channel], [filtering_key_channel])
+        self.filtering_types = list(filtering_types)
+        self.null_aware = null_aware
+        self.residual = residual
+        self.build: Optional[Batch] = None
+        self._filter_has_null = False
+        self._mark = jax.jit(self._mark_step, static_argnames=("cap_b",))
+        self._mark_res = jax.jit(
+            self._mark_residual_step, static_argnames=("cap_b", "out_cap")
+        )
+
+    def set_build(self, batches: list[Batch]) -> None:
+        self.build, _ = _dense_build(batches, self.filtering_types)
+        col = self.build.columns[self.build_keys[0]]
+        if col.valid is not None:
+            has_null = jnp.any(jnp.logical_and(self.build.mask(), jnp.logical_not(col.valid)))
+            self._filter_has_null = bool(has_null)
+
+    def _mark_from_matched(self, probe: Batch, matched) -> Batch:
+        key = probe.columns[self.probe_keys[0]]
+        key_valid = key.valid if key.valid is not None else jnp.ones(probe.capacity, bool)
+        if not self.null_aware:
+            mark_valid = None
+        elif self._filter_has_null:
+            mark_valid = jnp.logical_and(key_valid, matched)
+        else:
+            mark_valid = key_valid
+        return probe.append_column(Column(matched, T.BOOLEAN, mark_valid))
+
+    def _mark_step(self, probe: Batch, combined: Batch, cap_b: int) -> Batch:
+        _, count, _ = self._locate_step(combined, cap_b)
+        return self._mark_from_matched(probe, count > 0)
+
+    def _mark_residual_step(
+        self, probe: Batch, combined: Batch, start, count, perm,
+        cap_b: int, out_cap: int, total_emit
+    ) -> Batch:
+        """Expand key-matching candidates, apply residual, any() per row."""
+        offsets = jnp.cumsum(count) - count
+        cap_p = probe.capacity
+        has = count > 0
+        seed = (
+            jnp.zeros(out_cap, dtype=jnp.int64)
+            .at[jnp.where(has, offsets, out_cap)]
+            .max(jnp.arange(cap_p, dtype=jnp.int64), mode="drop")
+        )
+        ids = jax.lax.cummax(seed)
+        j = jnp.arange(out_cap, dtype=jnp.int64) - offsets[ids]
+        in_range = jnp.logical_and(
+            j < count[ids], jnp.arange(out_cap, dtype=jnp.int64) < total_emit
+        )
+        build_pos = jnp.clip(start[ids] + j, 0, perm.shape[0] - 1)
+        build_row = jnp.clip(perm[build_pos], 0, cap_b - 1)
+        pcols = [
+            Column(
+                jnp.take(c.data, ids, mode="clip"),
+                c.type,
+                None if c.valid is None else jnp.take(c.valid, ids, mode="clip"),
+                c.dictionary,
+            )
+            for c in probe.columns
+        ]
+        bcols = [
+            Column(
+                jnp.take(c.data, build_row, mode="clip"),
+                c.type,
+                in_range
+                if c.valid is None
+                else jnp.logical_and(in_range, jnp.take(c.valid, build_row, mode="clip")),
+                c.dictionary,
+            )
+            for c in self.build.columns
+        ]
+        candidate = Batch(list(pcols) + list(bcols), in_range)
+        keep = jnp.logical_and(in_range, self.residual(candidate))
+        surv = jax.ops.segment_sum(keep.astype(jnp.int64), ids, cap_p)
+        return self._mark_from_matched(probe, surv > 0)
+
+    def process(self, stream):
+        assert self.build is not None
+        cap_b = self.build.capacity
+        for probe in stream:
+            combined = self._combined_keys(self.build, probe)
+            if self.residual is None:
+                yield self._mark(probe, combined, cap_b=cap_b)
+            else:
+                start, count, perm = self._locate(combined, cap_b=cap_b)
+                total = int(jnp.sum(count))
+                out_cap = next_pow2(max(total, 1), floor=1024)
+                yield self._mark_res(
+                    probe, combined, start, count, perm,
+                    cap_b=cap_b, out_cap=out_cap, total_emit=total,
+                )

@@ -1,0 +1,218 @@
+"""Cross-join elimination + predicate pushdown into join criteria.
+
+Reference roles: sql/planner/optimizations/PredicatePushDown.java,
+iterative/rule/EliminateCrossJoins.java, and the join-distribution side of
+ReorderJoins — comma-list FROM clauses plan as cross joins under one big
+filter; this pass flattens the cross tree, classifies conjuncts
+(single-source / equi-pair / residual), pushes single-source predicates down,
+and greedily rebuilds an equi-join tree ordered by estimated cardinality
+(largest relation stays the streamed probe side; smaller connected relations
+become materialized build sides, matching the TPU hash-join operator which
+fully materializes its build input in HBM).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from trino_tpu.expr.ir import Call, Expr, Form, SpecialForm, SymbolRef, and_
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.stats import estimate_rows
+
+
+def split_conjuncts_ir(e: Expr) -> list:
+    if isinstance(e, SpecialForm) and e.form == Form.AND:
+        out = []
+        for a in e.args:
+            out.extend(split_conjuncts_ir(a))
+        return out
+    return [e]
+
+
+def collect_symbol_names(e: Expr, acc=None) -> set:
+    if acc is None:
+        acc = set()
+    if isinstance(e, SymbolRef):
+        acc.add(e.name)
+    for k in e.children():
+        collect_symbol_names(k, acc)
+    return acc
+
+
+def _flatten_cross(node: P.PlanNode, sources: list) -> None:
+    if isinstance(node, P.JoinNode) and node.kind == "cross" and node.filter is None:
+        _flatten_cross(node.left, sources)
+        _flatten_cross(node.right, sources)
+    else:
+        sources.append(node)
+
+
+def _equi_edge(c: Expr, sym2src: dict):
+    """(src_i, sym_i, src_j, sym_j) if c is `a = b` with a,b plain symbols of
+    two different sources."""
+    if not (isinstance(c, Call) and c.name == "$eq" and len(c.args) == 2):
+        return None
+    a, b = c.args
+    if not (isinstance(a, SymbolRef) and isinstance(b, SymbolRef)):
+        return None
+    sa, sb = sym2src.get(a.name), sym2src.get(b.name)
+    if sa is None or sb is None or sa == sb:
+        return None
+    return (sa, P.Symbol(a.name, a.type), sb, P.Symbol(b.name, b.type))
+
+
+def eliminate_cross_joins(node: P.PlanNode, catalogs=None):
+    """Filter(cross-join tree) -> pushed filters + greedy equi-join tree.
+    Returns a replacement node or None."""
+    if not isinstance(node, P.FilterNode):
+        return None
+    if not (
+        isinstance(node.source, P.JoinNode)
+        and node.source.kind == "cross"
+        and node.source.filter is None
+    ):
+        return None
+    sources: list = []
+    _flatten_cross(node.source, sources)
+    if len(sources) < 2:
+        return None
+    sym2src = {
+        s.name: i for i, src in enumerate(sources) for s in src.outputs
+    }
+    single = defaultdict(list)
+    edges = []  # (i, sym_i, j, sym_j, conjunct)
+    residual = []
+    for c in split_conjuncts_ir(node.predicate):
+        refs = collect_symbol_names(c)
+        srcs = {sym2src[r] for r in refs if r in sym2src}
+        if not srcs:
+            residual.append(c)
+            continue
+        if len(srcs) == 1:
+            single[next(iter(srcs))].append(c)
+            continue
+        edge = _equi_edge(c, sym2src)
+        if edge is not None:
+            edges.append(edge)
+        else:
+            residual.append(c)
+    if not single and not edges:
+        # nothing to push or join on — rebuilding would be a no-op and the
+        # rewrite loop would never terminate
+        return None
+    for i, cs in single.items():
+        sources[i] = P.FilterNode(sources[i], and_(*cs))
+    est = [estimate_rows(s, catalogs) for s in sources]
+
+    # greedy: largest relation is the probe spine; repeatedly join the
+    # smallest relation connected to the joined set
+    start = max(range(len(sources)), key=est.__getitem__)
+    joined = {start}
+    tree = sources[start]
+    pending = list(edges)
+    while len(joined) < len(sources):
+        connected = set()
+        for (i, _, j, _) in [(e[0], e[1], e[2], e[3]) for e in pending]:
+            if (i in joined) != (j in joined):
+                connected.add(j if i in joined else i)
+        if connected:
+            cand = min(connected, key=est.__getitem__)
+        else:
+            cand = min(
+                (k for k in range(len(sources)) if k not in joined),
+                key=est.__getitem__,
+            )
+        criteria = []
+        rest_edges = []
+        for e in pending:
+            i, si, j, sj = e
+            if i in joined and j == cand:
+                criteria.append((si, sj))
+            elif j in joined and i == cand:
+                criteria.append((sj, si))
+            else:
+                rest_edges.append(e)
+        pending = rest_edges
+        if criteria:
+            tree = P.JoinNode("inner", tree, sources[cand], criteria)
+        else:
+            tree = P.JoinNode("cross", tree, sources[cand], [])
+        joined.add(cand)
+    # every edge is consumed when its second endpoint joins the tree
+    assert not pending, f"unconsumed join edges: {pending}"
+    out: P.PlanNode = tree
+    if residual:
+        out = P.FilterNode(out, and_(*residual))
+    return out
+
+
+def push_filter_through_semijoin(node: P.PlanNode):
+    """Filter conjuncts not referencing the semi-join mark move below the
+    SemiJoinNode onto its source (reference: PredicatePushDown's semi-join
+    handling) — unlocking cross-join elimination underneath."""
+    if not (isinstance(node, P.FilterNode) and isinstance(node.source, P.SemiJoinNode)):
+        return None
+    semi = node.source
+    src_names = {s.name for s in semi.source.outputs}
+    below, above = [], []
+    for c in split_conjuncts_ir(node.predicate):
+        refs = collect_symbol_names(c)
+        if semi.mark.name not in refs and refs <= src_names:
+            below.append(c)
+        else:
+            above.append(c)
+    if not below:
+        return None
+    new_semi = P.SemiJoinNode(
+        P.FilterNode(semi.source, and_(*below)),
+        semi.filtering,
+        semi.source_key,
+        semi.filtering_key,
+        semi.mark,
+        semi.filter,
+        semi.null_aware,
+    )
+    if above:
+        return P.FilterNode(new_semi, and_(*above))
+    return new_semi
+
+
+def push_filter_through_join(node: P.PlanNode):
+    """Filter(inner Join) -> push single-side conjuncts into the inputs and
+    plain equi conjuncts into the criteria (PredicatePushDown for already-
+    formed joins, e.g. JOIN ... ON plus WHERE conjuncts)."""
+    if not (isinstance(node, P.FilterNode) and isinstance(node.source, P.JoinNode)):
+        return None
+    join = node.source
+    if join.kind not in ("inner", "cross"):
+        return None
+    left_names = {s.name for s in join.left.outputs}
+    right_names = {s.name for s in join.right.outputs}
+    to_left, to_right, criteria, keep = [], [], [], []
+    for c in split_conjuncts_ir(node.predicate):
+        refs = collect_symbol_names(c)
+        if refs <= left_names:
+            to_left.append(c)
+        elif refs <= right_names:
+            to_right.append(c)
+        else:
+            sym2src = {n: 0 for n in left_names}
+            sym2src.update({n: 1 for n in right_names})
+            edge = _equi_edge(c, sym2src)
+            if edge is not None:
+                i, si, j, sj = edge
+                criteria.append((si, sj) if i == 0 else (sj, si))
+            else:
+                keep.append(c)
+    if not (to_left or to_right or criteria):
+        return None
+    left = P.FilterNode(join.left, and_(*to_left)) if to_left else join.left
+    right = P.FilterNode(join.right, and_(*to_right)) if to_right else join.right
+    kind = "inner" if (join.criteria or criteria) else join.kind
+    new_join = P.JoinNode(
+        kind, left, right, list(join.criteria) + criteria, join.filter,
+        join.distribution,
+    )
+    if keep:
+        return P.FilterNode(new_join, and_(*keep))
+    return new_join

@@ -560,7 +560,9 @@ class LogicalPlanner:
             ]
             if parts:
                 filt = ir.and_(*parts)
-            node = P.SemiJoinNode(rp.node, sub.node, osym, isym, mark, filt)
+            node = P.SemiJoinNode(
+                rp.node, sub.node, osym, isym, mark, filt, null_aware=False
+            )
             out = RelationPlan(node, rp.fields + [Field(mark.name, mark)])
             val = mark.ref()
             return out, (ir.not_(val) if negated else val)

@@ -1,0 +1,126 @@
+"""Logical plan optimizer (reference: sql/planner/PlanOptimizers.java:267 and
+the iterative rules under sql/planner/iterative/rule/).
+
+Round-1 scope: a bottom-up rewrite driver with the rules that matter most for
+the TPU execution model — constant folding, filter merging/pushdown into
+scans, and identity-projection removal.  Cost-based join ordering and
+distribution selection land with the distributed planner.
+"""
+
+from __future__ import annotations
+
+from trino_tpu.expr.constant_folding import try_fold as fold
+from trino_tpu.expr.ir import and_
+from trino_tpu.planner import plan as P
+
+
+def _rewrite_bottom_up(node: P.PlanNode, rules) -> P.PlanNode:
+    kids = node.children
+    if kids:
+        node = node.with_children([_rewrite_bottom_up(c, rules) for c in kids])
+    changed = True
+    while changed:
+        changed = False
+        for rule in rules:
+            out = rule(node)
+            if out is not None:
+                node = out
+                changed = True
+    return node
+
+
+def rule_fold_constants(node: P.PlanNode):
+    """Constant-fold expressions in filters/projections (reference:
+    iterative/rule/SimplifyExpressions.java)."""
+    if isinstance(node, P.FilterNode):
+        folded = fold(node.predicate)
+        if folded is not node.predicate and folded != node.predicate:
+            return P.FilterNode(node.source, folded)
+    if isinstance(node, P.ProjectNode):
+        out = [(s, fold(e)) for s, e in node.assignments]
+        if any(a is not b for (_, a), (_, b) in zip(out, node.assignments)):
+            if [e.key() for _, e in out] != [e.key() for _, e in node.assignments]:
+                return P.ProjectNode(node.source, out)
+    return None
+
+
+def rule_merge_filters(node: P.PlanNode):
+    """Filter(Filter(x)) -> Filter(x) with AND (reference:
+    iterative/rule/MergeFilters.java)."""
+    if isinstance(node, P.FilterNode) and isinstance(node.source, P.FilterNode):
+        return P.FilterNode(
+            node.source.source, and_(node.source.predicate, node.predicate)
+        )
+    return None
+
+
+def rule_push_filter_into_scan(node: P.PlanNode):
+    """Filter(TableScan) -> TableScan with pushed predicate (reference:
+    iterative/rule/PushPredicateIntoTableScan.java).  The scan operator fuses
+    the predicate into its first device step, so filtering happens in the
+    same XLA program as the host->device feed."""
+    if isinstance(node, P.FilterNode) and isinstance(node.source, P.TableScanNode):
+        scan = node.source
+        pred = (
+            node.predicate
+            if scan.pushed_predicate is None
+            else and_(scan.pushed_predicate, node.predicate)
+        )
+        return P.TableScanNode(
+            scan.handle, scan.table_meta, scan.assignments, pred
+        )
+    return None
+
+
+def rule_remove_identity_project(node: P.PlanNode):
+    """Drop no-op projections (reference: iterative/rule/
+    RemoveRedundantIdentityProjections.java)."""
+    if isinstance(node, P.ProjectNode) and node.is_identity():
+        src = node.source.outputs
+        if [s.name for s in src] == [s.name for s, _ in node.assignments]:
+            return node.source
+    return None
+
+
+def optimize(plan: P.OutputNode, rules=None, catalogs=None) -> P.OutputNode:
+    from trino_tpu.planner.join_planning import (
+        eliminate_cross_joins,
+        push_filter_through_join,
+        push_filter_through_semijoin,
+    )
+
+    if rules is None:
+        rules = [
+            rule_fold_constants,
+            rule_merge_filters,
+            push_filter_through_semijoin,
+            lambda n: eliminate_cross_joins(n, catalogs),
+            push_filter_through_join,
+            rule_push_filter_into_scan,
+            rule_remove_identity_project,
+        ]
+    # iterate whole-tree passes to fixpoint: rules unlock each other (e.g.
+    # cross-join elimination creates filters that then push into scans),
+    # mirroring IterativeOptimizer's exploration loop.  Each iteration first
+    # normalizes (merges the planner's cascaded single-conjunct filters) so
+    # whole-predicate rules see the complete conjunct set.
+    normalize = [rule_fold_constants, rule_merge_filters]
+    prev = None
+    for _ in range(10):
+        plan = _rewrite_bottom_up(plan, normalize)
+        plan = _rewrite_bottom_up(plan, rules)
+        fp = plan_fingerprint(plan)
+        if fp == prev:
+            break
+        prev = fp
+    from trino_tpu.planner.pruning import prune
+
+    plan = prune(plan)
+    assert isinstance(plan, P.OutputNode)
+    return plan
+
+
+def plan_fingerprint(node: P.PlanNode) -> str:
+    from trino_tpu.planner.plan import plan_text
+
+    return plan_text(node)

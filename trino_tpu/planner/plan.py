@@ -185,6 +185,9 @@ class SemiJoinNode(PlanNode):
     filtering_key: Symbol
     mark: Symbol  # boolean output symbol
     filter: Optional[Expr] = None  # extra correlated filter (over both sides)
+    #: IN-subquery null semantics (mark NULL on null key / null in filtering
+    #: side); False for EXISTS, whose mark is plain boolean
+    null_aware: bool = True
 
     @property
     def outputs(self):
@@ -197,7 +200,7 @@ class SemiJoinNode(PlanNode):
     def with_children(self, children):
         return SemiJoinNode(
             children[0], children[1], self.source_key, self.filtering_key,
-            self.mark, self.filter,
+            self.mark, self.filter, self.null_aware,
         )
 
 

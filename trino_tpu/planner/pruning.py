@@ -1,0 +1,134 @@
+"""Column pruning: drop unreferenced symbols from every node top-down.
+
+Reference role: iterative/rule/PruneUnreferencedOutputs / Prune*Columns rule
+family.  Matters doubly on TPU: narrower scans shrink the host->device feed
+(HBM bandwidth is the bottleneck, SURVEY.md §7) and narrower join inputs
+shrink the gather expansion the sort-based join performs per output row.
+"""
+
+from __future__ import annotations
+
+from trino_tpu.expr.ir import Expr
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.join_planning import collect_symbol_names
+
+
+def _refs(*exprs) -> set:
+    acc: set = set()
+    for e in exprs:
+        if isinstance(e, Expr):
+            collect_symbol_names(e, acc)
+    return acc
+
+
+def prune(node: P.PlanNode) -> P.PlanNode:
+    if isinstance(node, P.OutputNode):
+        return P.OutputNode(
+            _prune(node.source, {s.name for s in node.symbols}),
+            node.column_names,
+            node.symbols,
+        )
+    return _prune(node, {s.name for s in node.outputs})
+
+
+def _keep(symbols, needed: set) -> list:
+    kept = [s for s in symbols if s.name in needed]
+    return kept
+
+
+def _prune(node: P.PlanNode, needed: set) -> P.PlanNode:
+    if isinstance(node, P.TableScanNode):
+        pred_refs = _refs(node.pushed_predicate)
+        assigns = [
+            (s, c) for s, c in node.assignments if s.name in needed | pred_refs
+        ]
+        if not assigns:  # keep one column for row counting
+            assigns = node.assignments[:1]
+        return P.TableScanNode(node.handle, node.table_meta, assigns, node.pushed_predicate)
+
+    if isinstance(node, P.FilterNode):
+        child = _prune(node.source, needed | _refs(node.predicate))
+        return P.FilterNode(child, node.predicate)
+
+    if isinstance(node, P.ProjectNode):
+        assigns = [(s, e) for s, e in node.assignments if s.name in needed]
+        if not assigns:
+            assigns = node.assignments[:1]
+        child = _prune(node.source, _refs(*(e for _, e in assigns)))
+        return P.ProjectNode(child, assigns)
+
+    if isinstance(node, P.AggregationNode):
+        aggs = [(s, a) for s, a in node.aggregations if s.name in needed]
+        child_needed = {s.name for s in node.group_symbols}
+        for _, a in aggs:
+            child_needed |= _refs(*a.args, a.filter)
+        return P.AggregationNode(
+            _prune(node.source, child_needed), node.group_symbols, aggs, node.step
+        )
+
+    if isinstance(node, P.JoinNode):
+        crit_l = {l.name for l, _ in node.criteria}
+        crit_r = {r.name for _, r in node.criteria}
+        filt = _refs(node.filter)
+        lnames = {s.name for s in node.left.outputs}
+        rnames = {s.name for s in node.right.outputs}
+        left = _prune(node.left, (needed | filt | crit_l) & lnames)
+        right = _prune(node.right, (needed | filt | crit_r) & rnames)
+        return P.JoinNode(
+            node.kind, left, right, node.criteria, node.filter, node.distribution
+        )
+
+    if isinstance(node, P.SemiJoinNode):
+        filt = _refs(node.filter)
+        snames = {s.name for s in node.source.outputs}
+        fnames = {s.name for s in node.filtering.outputs}
+        source = _prune(
+            node.source, ((needed | filt) & snames) | {node.source_key.name}
+        )
+        filtering = _prune(
+            node.filtering, (filt & fnames) | {node.filtering_key.name}
+        )
+        return P.SemiJoinNode(
+            source, filtering, node.source_key, node.filtering_key, node.mark,
+            node.filter, node.null_aware,
+        )
+
+    if isinstance(node, (P.SortNode, P.TopNNode)):
+        child_needed = needed | {s.name for s, _, _ in node.orderings}
+        child = _prune(node.source, child_needed)
+        if isinstance(node, P.SortNode):
+            return P.SortNode(child, node.orderings)
+        return P.TopNNode(child, node.orderings, node.count)
+
+    if isinstance(node, P.UnionNode):
+        idx = [i for i, s in enumerate(node.symbols) if s.name in needed]
+        if not idx:
+            idx = [0]
+        symbols = [node.symbols[i] for i in idx]
+        sources, source_symbols = [], []
+        for child, mapping in zip(node.sources, node.source_symbols):
+            kept = [mapping[i] for i in idx]
+            sources.append(_prune(child, {m.name for m in kept}))
+            source_symbols.append(kept)
+        return P.UnionNode(sources, symbols, source_symbols)
+
+    if isinstance(node, P.ExchangeNode):
+        child_needed = needed | {s.name for s in node.partition_symbols}
+        child_needed |= {s.name for s, _, _ in node.orderings}
+        return P.ExchangeNode(
+            _prune(node.source, child_needed), node.kind,
+            node.partition_symbols, node.orderings,
+        )
+
+    if isinstance(node, (P.LimitNode, P.EnforceSingleRowNode)):
+        child = _prune(node.children[0], needed)
+        return node.with_children([child])
+
+    if isinstance(node, P.ValuesNode):
+        return node
+
+    # default: require everything from children
+    kids = [
+        _prune(c, needed | {s.name for s in c.outputs}) for c in node.children
+    ]
+    return node.with_children(kids) if kids else node

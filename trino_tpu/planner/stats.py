@@ -1,0 +1,67 @@
+"""Cardinality estimation for optimizer decisions.
+
+Reference role: cost/ (FilterStatsCalculator.java, JoinStatsRule.java) — here
+reduced to the row-count heuristics the join-order and build-side choices
+need.  Connector-provided table statistics anchor the estimates (the tpch
+connector knows exact row counts, mirroring plugin/trino-tpch/.../statistics).
+"""
+
+from __future__ import annotations
+
+from trino_tpu.planner import plan as P
+
+FILTER_SELECTIVITY = 0.25
+AGG_GROUP_RATIO = 0.1
+
+
+def estimate_rows(node: P.PlanNode, catalogs=None) -> float:
+    if isinstance(node, P.TableScanNode):
+        rows = _scan_rows(node, catalogs)
+        if node.pushed_predicate is not None:
+            rows *= FILTER_SELECTIVITY
+        return rows
+    if isinstance(node, P.FilterNode):
+        return FILTER_SELECTIVITY * estimate_rows(node.source, catalogs)
+    if isinstance(node, P.ProjectNode):
+        return estimate_rows(node.source, catalogs)
+    if isinstance(node, P.AggregationNode):
+        if not node.group_symbols:
+            return 1.0
+        return max(1.0, AGG_GROUP_RATIO * estimate_rows(node.source, catalogs))
+    if isinstance(node, P.JoinNode):
+        l = estimate_rows(node.left, catalogs)
+        r = estimate_rows(node.right, catalogs)
+        if node.kind == "cross":
+            return l * r
+        if node.criteria:
+            # equi join: assume FK-PK-ish — output near the larger input
+            return max(l, r)
+        return l * r * FILTER_SELECTIVITY
+    if isinstance(node, P.SemiJoinNode):
+        return estimate_rows(node.source, catalogs)
+    if isinstance(node, (P.LimitNode, P.TopNNode)):
+        return min(node.count, estimate_rows(node.source, catalogs))
+    if isinstance(node, P.ValuesNode):
+        return float(len(node.rows))
+    if isinstance(node, P.UnionNode):
+        return sum(estimate_rows(s, catalogs) for s in node.sources)
+    if isinstance(node, P.EnforceSingleRowNode):
+        return 1.0
+    kids = node.children
+    if kids:
+        return estimate_rows(kids[0], catalogs)
+    return 1000.0
+
+
+def _scan_rows(node: P.TableScanNode, catalogs) -> float:
+    if catalogs is not None:
+        try:
+            conn = catalogs.get(node.handle.catalog)
+            stats = conn.metadata().table_statistics(
+                node.handle.schema, node.handle.table
+            )
+            if stats is not None and stats.row_count is not None:
+                return float(stats.row_count)
+        except Exception:
+            pass
+    return 10000.0

@@ -1,0 +1,343 @@
+"""Local execution planner: logical PlanNode tree -> operator pipelines.
+
+Reference role: sql/planner/LocalExecutionPlanner.java:516,600 (the seam where
+plan nodes become OperatorFactory chains and symbols are laid out as channels).
+Here each plan node becomes a (batch-stream, symbol-layout) pair; symbol
+references inside expressions are rewritten to positional InputRef channels
+exactly like the reference's layout mapping, and join build sides are
+materialized by draining their subplan (HashBuilderOperator's role).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.connectors.api import CatalogManager
+from trino_tpu.expr.ir import (
+    Call,
+    Expr,
+    Form,
+    InputRef,
+    Literal,
+    SpecialForm,
+    SymbolRef,
+    visit,
+)
+from trino_tpu.expr import ExprCompiler
+from trino_tpu.ops.aggregation import AggregationOperator, AggSpec
+from trino_tpu.ops.common import SortKey
+from trino_tpu.ops.filter_project import FilterProjectOperator
+from trino_tpu.ops.join import HashJoinOperator, NestedLoopJoinOperator, SemiJoinOperator
+from trino_tpu.ops.scan import ScanOperator
+from trino_tpu.ops.sort import LimitOperator, OrderByOperator, TopNOperator
+from trino_tpu.ops.values import ValuesOperator
+from trino_tpu.planner import plan as P
+
+
+class PhysicalPlan:
+    """A batch stream plus the symbol layout of its channels."""
+
+    def __init__(self, stream: Iterable[Batch], symbols: list):
+        self.stream = stream
+        self.symbols = list(symbols)
+
+    def channel(self, name: str) -> int:
+        for i, s in enumerate(self.symbols):
+            if s.name == name:
+                return i
+        raise KeyError(f"symbol {name} not in layout {[s.name for s in self.symbols]}")
+
+    def rewrite(self, expr: Expr) -> Expr:
+        """SymbolRef -> InputRef against this layout."""
+
+        def fn(e: Expr) -> Expr:
+            if isinstance(e, SymbolRef):
+                return InputRef(self.channel(e.name), e.type)
+            return e
+
+        return visit(expr, fn)
+
+    def identity_projections(self) -> list:
+        return [InputRef(i, s.type) for i, s in enumerate(self.symbols)]
+
+    def types(self) -> list:
+        return [s.type for s in self.symbols]
+
+
+class LocalExecutionPlanner:
+    def __init__(self, catalogs: CatalogManager, target_splits: int = 4):
+        self.catalogs = catalogs
+        self.target_splits = target_splits
+
+    def plan(self, node: P.PlanNode) -> PhysicalPlan:
+        method = getattr(self, "_visit_" + type(node).__name__, None)
+        if method is None:
+            raise NotImplementedError(f"no local plan for {type(node).__name__}")
+        return method(node)
+
+    # -- leaves ---------------------------------------------------------------
+
+    def _visit_TableScanNode(self, node: P.TableScanNode) -> PhysicalPlan:
+        connector = self.catalogs.get(node.handle.catalog)
+        names = [c for _, c in node.assignments]
+        types = [s.type for s, _ in node.assignments]
+        splits = list(connector.splits(node.handle, target_splits=self.target_splits))
+
+        def stream():
+            for split in splits:
+                op = ScanOperator(connector, split, names, types)
+                yield from op.batches()
+
+        plan = PhysicalPlan(stream(), [s for s, _ in node.assignments])
+        if node.pushed_predicate is not None:
+            pred = plan.rewrite(node.pushed_predicate)
+            fp = FilterProjectOperator(pred, plan.identity_projections())
+            plan = PhysicalPlan(fp.process(plan.stream), plan.symbols)
+        return plan
+
+    def _visit_ValuesNode(self, node: P.ValuesNode) -> PhysicalPlan:
+        op = ValuesOperator([s.type for s in node.symbols], node.rows)
+        return PhysicalPlan(op.batches(), node.symbols)
+
+    # -- row transforms -------------------------------------------------------
+
+    def _visit_FilterNode(self, node: P.FilterNode) -> PhysicalPlan:
+        src = self.plan(node.source)
+        op = FilterProjectOperator(src.rewrite(node.predicate), src.identity_projections())
+        return PhysicalPlan(op.process(src.stream), src.symbols)
+
+    def _visit_ProjectNode(self, node: P.ProjectNode) -> PhysicalPlan:
+        src = self.plan(node.source)
+        if node.is_identity():
+            return PhysicalPlan(src.stream, [s for s, _ in node.assignments])
+        exprs = [src.rewrite(e) for _, e in node.assignments]
+        op = FilterProjectOperator(None, exprs)
+        return PhysicalPlan(op.process(src.stream), [s for s, _ in node.assignments])
+
+    # -- aggregation ----------------------------------------------------------
+
+    def _visit_AggregationNode(self, node: P.AggregationNode) -> PhysicalPlan:
+        src = self.plan(node.source)
+        if any(agg.distinct for _, agg in node.aggregations):
+            src = self._distinct_preagg(node, src)
+        ngroups = len(node.group_symbols)
+        # input projection: group keys, then one computed arg per aggregate
+        # (FILTER folded as IF(filter, arg, NULL) — null-skipping aggregates
+        # make this exact; reference role: AggregationOperator's mask channel)
+        proj: list[Expr] = [src.rewrite(s.ref()) for s in node.group_symbols]
+        specs: list[AggSpec] = []
+        input_types = [s.type for s in node.group_symbols]
+        for i, (out_sym, agg) in enumerate(node.aggregations):
+            name = agg.function
+            arg: Optional[Expr]
+            arg = src.rewrite(agg.args[0]) if agg.args else None
+            if agg.filter is not None:
+                f = src.rewrite(agg.filter)
+                if name == "count_star":
+                    name = "count"
+                    arg = SpecialForm(
+                        Form.IF,
+                        [f, Literal(1, T.BIGINT), Literal(None, T.BIGINT)],
+                        T.BIGINT,
+                    )
+                else:
+                    arg = SpecialForm(
+                        Form.IF, [f, arg, Literal(None, arg.type)], arg.type
+                    )
+            if arg is None:
+                specs.append(AggSpec(name, None, out_sym.type))
+            else:
+                proj.append(arg)
+                input_types.append(arg.type)
+                specs.append(AggSpec(name, ngroups + len(specs_args(specs)), out_sym.type))
+
+        pre = FilterProjectOperator(None, proj)
+        op = AggregationOperator(
+            list(range(ngroups)),
+            specs,
+            input_types,
+            mode=node.step,
+            streaming=True,
+        )
+        stream = op.process(pre.process(src.stream))
+        return PhysicalPlan(stream, node.outputs)
+
+    def _distinct_preagg(self, node: P.AggregationNode, src: PhysicalPlan) -> PhysicalPlan:
+        """DISTINCT aggregates via pre-grouping (reference role: the
+        MarkDistinct/pre-aggregation rewrites in AddExchanges/optimizer).
+        Supported: every distinct aggregate shares the same argument list and
+        non-distinct aggregates are absent."""
+        distinct_args = {tuple(a.key() for a in agg.args) for _, agg in node.aggregations if agg.distinct}
+        if len(distinct_args) > 1 or any(not agg.distinct for _, agg in node.aggregations):
+            raise NotImplementedError("mixed DISTINCT aggregate shapes")
+        keys = [src.rewrite(s.ref()) for s in node.group_symbols]
+        args0 = next(agg for _, agg in node.aggregations if agg.distinct).args
+        arg_exprs = [src.rewrite(a) for a in args0]
+        proj = keys + arg_exprs
+        dedupe = AggregationOperator(
+            list(range(len(proj))), [], [e.type for e in proj], mode="single", streaming=True
+        )
+        pre = FilterProjectOperator(None, proj)
+        stream = dedupe.process(pre.process(src.stream))
+        # layout: group symbols then the distinct arg values under their
+        # original symbol names (args are SymbolRefs by construction)
+        symbols = list(node.group_symbols) + [P.Symbol(a.name, a.type) for a in args0]
+        return PhysicalPlan(stream, symbols)
+
+    # -- joins ----------------------------------------------------------------
+
+    def _visit_JoinNode(self, node: P.JoinNode) -> PhysicalPlan:
+        if node.kind == "cross":
+            left = self.plan(node.left)
+            right = self.plan(node.right)
+            op = NestedLoopJoinOperator(right.types())
+            op.set_build(list(right.stream))
+            return PhysicalPlan(op.process(left.stream), left.symbols + right.symbols)
+        if node.kind == "right":
+            flipped = P.JoinNode(
+                "left", node.right, node.left,
+                [(r, l) for l, r in node.criteria], node.filter, node.distribution,
+            )
+            out = self._visit_JoinNode(flipped)
+            # restore left ++ right symbol order
+            order = [out.channel(s.name) for s in node.outputs]
+            proj = FilterProjectOperator(
+                None, [InputRef(c, out.symbols[c].type) for c in order]
+            )
+            return PhysicalPlan(proj.process(out.stream), node.outputs)
+
+        probe = self.plan(node.left)
+        build = self.plan(node.right)
+        out_symbols = probe.symbols + build.symbols
+        probe_keys = [probe.channel(l.name) for l, _ in node.criteria]
+        build_keys = [build.channel(r.name) for _, r in node.criteria]
+        residual = None
+        if node.filter is not None:
+            combined = PhysicalPlan(iter(()), out_symbols)
+            res_expr = combined.rewrite(node.filter)
+
+            def residual(batch: Batch, _e=res_expr):
+                return ExprCompiler(batch).filter_mask(_e)
+
+        op = HashJoinOperator(
+            node.kind,
+            probe_keys,
+            build_keys,
+            build.types(),
+            probe_types=probe.types(),
+            residual=residual,
+        )
+        op.set_build(list(build.stream))
+        return PhysicalPlan(op.process(probe.stream), out_symbols)
+
+    def _visit_SemiJoinNode(self, node: P.SemiJoinNode) -> PhysicalPlan:
+        src = self.plan(node.source)
+        filt = self.plan(node.filtering)
+        residual = None
+        if node.filter is not None:
+            combined = PhysicalPlan(iter(()), src.symbols + filt.symbols)
+            res_expr = combined.rewrite(node.filter)
+
+            def residual(batch: Batch, _e=res_expr):
+                return ExprCompiler(batch).filter_mask(_e)
+
+        op = SemiJoinOperator(
+            src.channel(node.source_key.name),
+            filt.channel(node.filtering_key.name),
+            filt.types(),
+            null_aware=node.null_aware,
+            residual=residual,
+        )
+        op.set_build(list(filt.stream))
+        return PhysicalPlan(op.process(src.stream), src.symbols + [node.mark])
+
+    # -- ordering / limiting --------------------------------------------------
+
+    def _sort_keys(self, plan: PhysicalPlan, orderings) -> list:
+        return [
+            SortKey(plan.channel(sym.name), ascending, nulls_first)
+            for sym, ascending, nulls_first in orderings
+        ]
+
+    def _visit_SortNode(self, node: P.SortNode) -> PhysicalPlan:
+        src = self.plan(node.source)
+        op = OrderByOperator(self._sort_keys(src, node.orderings))
+        return PhysicalPlan(op.process(src.stream), src.symbols)
+
+    def _visit_TopNNode(self, node: P.TopNNode) -> PhysicalPlan:
+        src = self.plan(node.source)
+        op = TopNOperator(self._sort_keys(src, node.orderings), node.count)
+        return PhysicalPlan(op.process(src.stream), src.symbols)
+
+    def _visit_LimitNode(self, node: P.LimitNode) -> PhysicalPlan:
+        src = self.plan(node.source)
+        op = LimitOperator(node.count)
+        return PhysicalPlan(op.process(src.stream), src.symbols)
+
+    # -- shape nodes ----------------------------------------------------------
+
+    def _visit_UnionNode(self, node: P.UnionNode) -> PhysicalPlan:
+        def stream():
+            for child, mapping in zip(node.sources, node.source_symbols):
+                sub = self.plan(child)
+                proj = FilterProjectOperator(
+                    None, [InputRef(sub.channel(m.name), m.type) for m in mapping]
+                )
+                yield from proj.process(sub.stream)
+
+        return PhysicalPlan(stream(), node.symbols)
+
+    def _visit_EnforceSingleRowNode(self, node: P.EnforceSingleRowNode) -> PhysicalPlan:
+        src = self.plan(node.source)
+
+        def stream():
+            total = 0
+            emitted = False
+            for b in src.stream:
+                n = b.num_rows_host()
+                total += n
+                if total > 1:
+                    raise RuntimeError("Scalar sub-query has returned multiple rows")
+                if n:
+                    emitted = True
+                    yield b
+            if not emitted:
+                import numpy as np
+
+                cols = [
+                    Column(
+                        np.zeros(1, dtype=s.type.np_dtype),
+                        s.type,
+                        np.zeros(1, dtype=bool),
+                    )
+                    for s in src.symbols
+                ]
+                yield Batch(cols, np.ones(1, dtype=bool))
+
+        return PhysicalPlan(stream(), src.symbols)
+
+    def _visit_ExchangeNode(self, node: P.ExchangeNode) -> PhysicalPlan:
+        # single-process execution: exchanges are pass-through; merge
+        # exchanges re-sort to restore global order
+        src = self.plan(node.source)
+        if node.kind == "merge" and node.orderings:
+            op = OrderByOperator(self._sort_keys(src, node.orderings))
+            return PhysicalPlan(op.process(src.stream), src.symbols)
+        return PhysicalPlan(src.stream, src.symbols)
+
+    def _visit_OutputNode(self, node: P.OutputNode) -> PhysicalPlan:
+        src = self.plan(node.source)
+        if [s.name for s in src.symbols] != [s.name for s in node.symbols]:
+            proj = FilterProjectOperator(
+                None,
+                [InputRef(src.channel(s.name), s.type) for s in node.symbols],
+            )
+            return PhysicalPlan(proj.process(src.stream), node.symbols)
+        return PhysicalPlan(src.stream, node.symbols)
+
+
+def specs_args(specs: list) -> list:
+    """Channels already consumed by aggregate args (for layout allocation)."""
+    return [s for s in specs if s.arg is not None]

@@ -1,0 +1,85 @@
+"""In-process query runner: SQL text -> materialized results.
+
+Reference role: testing/LocalQueryRunner.java:260 — the full
+parse -> analyze -> plan -> execute pipeline in one process, no RPC; results
+captured the way PageConsumerOperator captures pages.  This is both the test
+harness entry point and the kernel of the single-node engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from trino_tpu.connectors.api import CatalogManager, default_catalogs
+from trino_tpu.planner.logical_planner import LogicalPlanner, Session
+from trino_tpu.planner.plan import OutputNode, plan_text
+from trino_tpu.runtime.local_planner import LocalExecutionPlanner
+from trino_tpu.sql import ast
+from trino_tpu.sql.parser import parse_statement
+
+
+@dataclass
+class MaterializedResult:
+    """Reference role: testing/MaterializedResult.java."""
+
+    column_names: list
+    rows: list  # list of tuples of python values
+    types: list
+
+    @property
+    def row_count(self) -> int:
+        return len(self.rows)
+
+    def only_value(self):
+        assert len(self.rows) == 1 and len(self.rows[0]) == 1, self.rows
+        return self.rows[0][0]
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.rows, columns=self.column_names)
+
+
+class LocalQueryRunner:
+    def __init__(
+        self,
+        catalogs: Optional[CatalogManager] = None,
+        catalog: str = "tpch",
+        schema: str = "tiny",
+        target_splits: int = 4,
+    ):
+        self.catalogs = catalogs or default_catalogs()
+        self.session = Session(catalog, schema)
+        self.target_splits = target_splits
+
+    # -- planning -------------------------------------------------------------
+
+    def create_plan(self, sql: str) -> OutputNode:
+        stmt = parse_statement(sql)
+        if not isinstance(stmt, ast.SelectStatement):
+            raise NotImplementedError(f"statement: {type(stmt).__name__}")
+        plan = LogicalPlanner(self.catalogs, self.session).plan(stmt.query)
+        return self.optimize(plan)
+
+    def optimize(self, plan: OutputNode) -> OutputNode:
+        from trino_tpu.planner.optimizer import optimize
+
+        return optimize(plan, catalogs=self.catalogs)
+
+    def explain(self, sql: str) -> str:
+        return plan_text(self.create_plan(sql))
+
+    # -- execution ------------------------------------------------------------
+
+    def execute(self, sql: str) -> MaterializedResult:
+        plan = self.create_plan(sql)
+        physical = LocalExecutionPlanner(
+            self.catalogs, target_splits=self.target_splits
+        ).plan(plan)
+        rows = []
+        for batch in physical.stream:
+            rows.extend(tuple(r) for r in batch.to_pylist())
+        return MaterializedResult(
+            list(plan.column_names), rows, [s.type for s in plan.symbols]
+        )
