@@ -7,6 +7,7 @@ bench.py.
 """
 
 import os
+import sys
 
 # Must be set before jax initializes its backends.  FORCE cpu: the ambient
 # environment points JAX_PLATFORMS at the real TPU (axon), which tests must
@@ -17,15 +18,17 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Drop the axon TPU-tunnel plugin from the import path: it proxies EVERY XLA
+# compile (including CPU) through its remote helper, which is both slow and a
+# hang risk for the test suite; tests must be pure local CPU.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
+)
 
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
-# persistent XLA compile cache: the suite is compile-bound (many multi-second
-# sort/agg programs); caching makes repeat runs execution-bound
-jax.config.update("jax_compilation_cache_dir", "/tmp/trino_tpu_xla_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import pytest  # noqa: E402
 

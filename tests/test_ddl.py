@@ -1,0 +1,77 @@
+"""DDL / DML / utility statement tests (reference style: TestMemoryConnector
++ AbstractTestEngineOnlyQueries' SHOW/EXPLAIN coverage)."""
+
+import pytest
+
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    return LocalQueryRunner(catalog="memory", schema="default")
+
+
+def test_create_insert_select(runner):
+    runner.execute("create table t (a bigint, b varchar)")
+    r = runner.execute("insert into t values (1, 'x'), (2, 'y'), (null, 'z')")
+    assert r.rows == [(3,)]
+    out = runner.execute("select a, b from t order by b")
+    assert out.rows == [(1, "x"), (2, "y"), (None, "z")]
+    runner.execute("insert into t values (4, 'w')")
+    out = runner.execute("select count(*), sum(a) from t")
+    assert out.rows == [(4, 7)]
+
+
+def test_ctas_from_tpch(runner):
+    runner.execute("create table nations as select n_name, n_regionkey from tpch.tiny.nation")
+    out = runner.execute("select count(*) from nations")
+    assert out.rows == [(25,)]
+    out = runner.execute(
+        "select n_regionkey, count(*) from nations group by n_regionkey order by 1"
+    )
+    assert out.rows == [(i, 5) for i in range(5)]
+
+
+def test_insert_column_list(runner):
+    runner.execute("create table t2 (a bigint, b varchar, c double)")
+    runner.execute("insert into t2 (b, a) select 'v', 9")
+    out = runner.execute("select a, b, c from t2")
+    assert out.rows == [(9, "v", None)]
+
+
+def test_drop_table(runner):
+    runner.execute("create table gone (x bigint)")
+    assert "gone" in [r[0] for r in runner.execute("show tables").rows]
+    runner.execute("drop table gone")
+    assert "gone" not in [r[0] for r in runner.execute("show tables").rows]
+    runner.execute("drop table if exists gone")
+
+
+def test_show_statements(runner):
+    cats = [r[0] for r in runner.execute("show catalogs").rows]
+    assert "tpch" in cats and "memory" in cats
+    tables = [r[0] for r in runner.execute("show tables from tpch.tiny").rows]
+    assert "lineitem" in tables and "orders" in tables
+    cols = runner.execute("describe tpch.tiny.region").rows
+    assert ("r_regionkey", "bigint") in cols
+
+
+def test_use_and_set_session(runner):
+    runner.execute("use tpch.tiny")
+    assert runner.execute("select count(*) from region").rows == [(5,)]
+    runner.execute("set session target_splits = 2")
+    assert runner.properties.get("target_splits") == 2
+    with pytest.raises(KeyError):
+        runner.execute("set session no_such_knob = 1")
+
+
+def test_explain(runner):
+    out = runner.execute("explain select count(*) from tpch.tiny.region")
+    text = "\n".join(r[0] for r in out.rows)
+    assert "Aggregation" in text and "TableScan" in text
+
+
+def test_explain_analyze(runner):
+    out = runner.execute("explain analyze select count(*) from tpch.tiny.nation")
+    text = "\n".join(r[0] for r in out.rows)
+    assert "rows=" in text and "TableScan" in text

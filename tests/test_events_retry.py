@@ -1,0 +1,55 @@
+"""Event listener + failure injection/retry tests (reference style:
+TestEventListener + BaseFailureRecoveryTest)."""
+
+import pytest
+
+from trino_tpu.runtime.events import CollectingEventListener
+from trino_tpu.runtime.retry import FAILURE_INJECTOR, InjectedFailure
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    FAILURE_INJECTOR.clear()
+    r = LocalQueryRunner()
+    yield r
+    FAILURE_INJECTOR.clear()
+
+
+def test_events_on_success(runner):
+    listener = CollectingEventListener()
+    runner.events.add(listener)
+    runner.execute("select count(*) from region")
+    assert len(listener.created) == 1
+    done = listener.completed[0]
+    assert done.state == "FINISHED" and done.rows == 1
+    assert done.wall_s >= 0
+
+
+def test_events_on_failure(runner):
+    listener = CollectingEventListener()
+    runner.events.add(listener)
+    with pytest.raises(Exception):
+        runner.execute("select bogus_col from region")
+    assert listener.completed[0].state == "FAILED"
+    assert "bogus_col" in listener.completed[0].error
+
+
+def test_injected_failure_fails_without_retry(runner):
+    FAILURE_INJECTOR.inject("scan:tiny.nation", times=1)
+    with pytest.raises(InjectedFailure):
+        runner.execute("select count(*) from nation")
+
+
+def test_query_retry_recovers(runner):
+    FAILURE_INJECTOR.inject("scan:tiny.nation", times=2)
+    runner.execute("set session retry_policy = 'QUERY'")
+    res = runner.execute("select count(*) from nation")
+    assert res.rows == [(25,)]
+
+
+def test_retry_exhaustion(runner):
+    FAILURE_INJECTOR.inject("scan:tiny.nation", times=100)
+    runner.execute("set session retry_policy = 'QUERY'")
+    with pytest.raises(InjectedFailure):
+        runner.execute("select count(*) from nation")

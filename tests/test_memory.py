@@ -1,0 +1,61 @@
+"""Memory accounting tests (reference: TestAggregatedMemoryContext +
+TestMemoryPools)."""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.runtime.memory import (
+    ExceededMemoryLimitException,
+    MemoryContext,
+    MemoryPool,
+    batch_bytes,
+)
+
+
+def test_reservation_tree():
+    pool = MemoryPool()
+    q = pool.query_context("q1")
+    op1, op2 = q.child("op1"), q.child("op2")
+    op1.set_bytes(100)
+    op2.set_bytes(50)
+    assert q.reserved == 150 and pool.root.reserved == 150
+    op1.set_bytes(20)
+    assert pool.root.reserved == 70
+    op1.close()
+    op2.close()
+    assert pool.root.reserved == 0
+    assert pool.root.peak == 150
+
+
+def test_limit_enforced_and_consistent():
+    pool = MemoryPool(limit_bytes=100)
+    q = pool.query_context("q1")
+    op = q.child("op")
+    op.set_bytes(90)
+    with pytest.raises(ExceededMemoryLimitException):
+        op.add_bytes(20)
+    # failed reservation must leave the tree unchanged
+    assert op.reserved == 90 and pool.root.reserved == 90
+    op.add_bytes(5)
+    assert pool.root.reserved == 95
+
+
+def test_query_limit():
+    pool = MemoryPool()
+    q = pool.query_context("q1", limit_bytes=10)
+    with pytest.raises(ExceededMemoryLimitException):
+        q.child("op").set_bytes(11)
+    assert pool.root.reserved == 0
+
+
+def test_batch_bytes():
+    b = Batch(
+        [
+            Column(np.zeros(8, np.int64), T.BIGINT, np.ones(8, bool)),
+            Column(np.zeros(8, np.int32), T.INTEGER),
+        ],
+        np.ones(8, bool),
+    )
+    assert batch_bytes(b) == 8 * 8 + 8 + 8 * 4 + 8

@@ -1,0 +1,89 @@
+"""Window function tests vs pandas (reference style: TestWindowOperator +
+AbstractTestWindowQueries)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.test_e2e import assert_rows_match
+from trino_tpu.runtime.runner import LocalQueryRunner
+from trino_tpu.testing import tpch_pandas
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+def test_row_number_rank(runner):
+    n = tpch_pandas("tiny", "nation")
+    df = n.sort_values(["n_regionkey", "n_name"])
+    df = df.assign(
+        rn=df.groupby("n_regionkey").cumcount() + 1,
+    )
+    expected = [
+        (r.n_name, int(r.n_regionkey), int(r.rn)) for r in df.itertuples()
+    ]
+    res = runner.execute(
+        "select n_name, n_regionkey, row_number() over "
+        "(partition by n_regionkey order by n_name) rn from nation"
+    )
+    assert_rows_match(res.rows, expected, ordered=False)
+
+
+def test_rank_with_ties(runner):
+    res = runner.execute(
+        "select x, rank() over (order by x), dense_rank() over (order by x) "
+        "from (select 1 x union all select 1 union all select 2 union all select 3) t"
+    )
+    assert sorted(res.rows) == [(1, 1, 1), (1, 1, 1), (2, 3, 2), (3, 4, 3)]
+
+
+def test_running_sum(runner):
+    res = runner.execute(
+        "select x, sum(x) over (order by x) from "
+        "(select 1 x union all select 2 union all select 2 union all select 3) t"
+    )
+    # RANGE frame: peers share the running total
+    assert sorted(res.rows) == [(1, 1), (2, 5), (2, 5), (3, 8)]
+
+
+def test_partition_total(runner):
+    o = tpch_pandas("tiny", "orders")
+    per = o.groupby("o_custkey").o_orderkey.count()
+    expected_pairs = {(int(k), int(v)) for k, v in per.items()}
+    res = runner.execute(
+        "select distinct o_custkey, count(*) over (partition by o_custkey) from orders"
+    )
+    assert set((int(a), int(b)) for a, b in res.rows) == expected_pairs
+
+
+def test_lag_lead(runner):
+    res = runner.execute(
+        "select x, lag(x) over (order by x), lead(x, 1, 99) over (order by x) "
+        "from (select 1 x union all select 2 union all select 3) t"
+    )
+    assert sorted(res.rows, key=lambda r: r[0]) == [
+        (1, None, 2), (2, 1, 3), (3, 2, 99)
+    ]
+
+
+def test_ntile(runner):
+    res = runner.execute(
+        "select x, ntile(2) over (order by x) from "
+        "(select 1 x union all select 2 union all select 3) t"
+    )
+    assert sorted(res.rows) == [(1, 1), (2, 1), (3, 2)]
+
+
+def test_avg_over_partition(runner):
+    s = tpch_pandas("tiny", "supplier")
+    expected = s.groupby("s_nationkey").s_acctbal.mean()
+    res = runner.execute(
+        "select distinct s_nationkey, avg(s_acctbal) over (partition by s_nationkey) "
+        "from supplier"
+    )
+    got = {int(k): float(v) for k, v in res.rows}
+    for k, v in expected.items():
+        # window avg over decimal rounds to the decimal scale
+        assert abs(got[int(k)] - float(v)) < 0.0051

@@ -381,7 +381,8 @@ def q19(t):
         & (j.p_size >= 1) & (j.p_size <= 15)
     )
     f = j[common & (b1 | b2 | b3)]
-    return pd.DataFrame({"revenue": [_rev(f).sum()]})
+    # SQL sum over zero rows is NULL, not 0 (tiny matches no rows)
+    return pd.DataFrame({"revenue": [_rev(f).sum() if len(f) else None]})
 
 
 def q20(t):

@@ -1,0 +1,47 @@
+"""HTTP protocol client (reference: client/trino-client —
+StatementClientV1.java:65; advance() follows nextUri at :334-340)."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Optional
+
+from trino_tpu.server import protocol
+
+
+class QueryFailed(RuntimeError):
+    def __init__(self, error: dict):
+        super().__init__(error.get("message", "query failed"))
+        self.error = error
+
+
+class Client:
+    def __init__(self, base_url: str = "http://127.0.0.1:8080"):
+        self.base_url = base_url.rstrip("/")
+
+    def _request(self, method: str, path: str, body: Optional[bytes] = None) -> dict:
+        req = urllib.request.Request(
+            self.base_url + path, data=body, method=method
+        )
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read().decode())
+
+    def execute(self, sql: str):
+        """Submit and drain a statement; returns (column_names, rows)."""
+        out = self._request("POST", "/v1/statement", sql.encode())
+        columns: list = []
+        rows: list = []
+        while True:
+            if out.get("error"):
+                raise QueryFailed(out["error"])
+            if "columns" in out:
+                columns = out["columns"]
+            if "data" in out:
+                rows.extend(protocol.decode_rows(out["data"], columns))
+            nxt = out.get("nextUri")
+            if nxt is None:
+                break
+            out = self._request("GET", nxt)
+        return [c["name"] for c in columns], rows

@@ -1,0 +1,97 @@
+"""TPC-DS connector plumbing (reference: plugin/trino-tpcds —
+TpcdsConnectorFactory.java / TpcdsMetadata.java / TpcdsSplitManager;
+row-range splits mirror TpcdsSplitManager's per-node partitioning)."""
+
+from __future__ import annotations
+
+import math
+
+from trino_tpu.connectors.api import (
+    ColumnMeta,
+    ColumnStatistics,
+    Connector,
+    ConnectorMetadata,
+    PageSource,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+from trino_tpu.connectors.tpcds import schema as ds_schema
+from trino_tpu.connectors.tpcds.generator import TpcdsGenerator, generator
+
+
+class TpcdsMetadata(ConnectorMetadata):
+    def list_schemas(self):
+        return sorted(ds_schema.SCHEMAS)
+
+    def list_tables(self, schema: str):
+        ds_schema.schema_scale(schema)
+        return sorted(ds_schema.TABLES)
+
+    def table_metadata(self, schema: str, table: str) -> TableMetadata:
+        ds_schema.schema_scale(schema)
+        if table not in ds_schema.TABLES:
+            raise KeyError(f"tpcds table not found: {table}")
+        cols = tuple(
+            ColumnMeta(name, t) for name, t in ds_schema.column_types(table)
+        )
+        return TableMetadata(schema, table, cols)
+
+    def table_statistics(self, schema: str, table: str) -> TableStatistics:
+        sf = ds_schema.schema_scale(schema)
+        gen = generator(sf)
+        rows = gen.row_count(table)
+        cols = {}
+        pk = ds_schema.TABLES[table][0][0]
+        if pk.endswith("_sk"):
+            cols[pk] = ColumnStatistics(distinct_count=rows, low=1, high=rows)
+        return TableStatistics(row_count=rows, columns=cols)
+
+
+class TpcdsPageSource(PageSource):
+    def __init__(self, gen: TpcdsGenerator, split: Split, columns, page_rows: int):
+        self.gen = gen
+        self.split = split
+        self.columns = list(columns)
+        self.page_rows = page_rows
+
+    def row_count(self) -> int:
+        return self.split.row_count
+
+    def pages(self):
+        t = self.split.table.table
+        start, remaining = self.split.row_start, self.split.row_count
+        while remaining > 0:
+            n = min(self.page_rows, remaining)
+            yield [self.gen.column(t, c, start, n) for c in self.columns]
+            start += n
+            remaining -= n
+
+
+class TpcdsConnector(Connector):
+    name = "tpcds"
+
+    def __init__(self):
+        self._metadata = TpcdsMetadata()
+
+    def metadata(self) -> TpcdsMetadata:
+        return self._metadata
+
+    def splits(self, handle: TableHandle, target_splits: int, predicate=None):
+        sf = ds_schema.schema_scale(handle.schema)
+        n = generator(sf).row_count(handle.table)
+        nsplits = max(1, min(target_splits, math.ceil(n / 1024)))
+        per = math.ceil(n / nsplits)
+        out = []
+        for i in range(nsplits):
+            a = i * per
+            b = min(n, a + per)
+            if a >= b:
+                break
+            out.append(Split(handle, i, row_start=a, row_count=b - a))
+        return out
+
+    def page_source(self, split: Split, columns, max_rows_per_page: int = 1 << 20):
+        sf = ds_schema.schema_scale(split.table.schema)
+        return TpcdsPageSource(generator(sf), split, columns, max_rows_per_page)

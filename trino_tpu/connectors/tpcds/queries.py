@@ -1,0 +1,212 @@
+"""TPC-DS benchmark queries (reference: the public spec query templates as
+shipped under testing/trino-benchmark-queries/.../tpcds/*.sql).
+
+Adaptations for this engine's dialect (noted per reference behavior, not
+semantics): aggregate ORDER BY keys are aliased, `${database}.${schema}.`
+prefixes dropped.  Q64 is baseline config #4 (BASELINE.md).
+"""
+
+QUERIES = {
+    1: """
+with customer_total_return as (
+    select sr_customer_sk as ctr_customer_sk,
+           sr_store_sk as ctr_store_sk,
+           sum(sr_return_amt) as ctr_total_return
+    from store_returns, date_dim
+    where sr_returned_date_sk = d_date_sk and d_year = 2000
+    group by sr_customer_sk, sr_store_sk
+)
+select c_customer_id
+from customer_total_return ctr1, store, customer
+where ctr1.ctr_total_return > (
+        select avg(ctr_total_return) * 1.2
+        from customer_total_return ctr2
+        where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  and s_store_sk = ctr1.ctr_store_sk
+  and s_state = 'TN'
+  and ctr1.ctr_customer_sk = c_customer_sk
+order by c_customer_id
+limit 100
+""",
+    3: """
+select dt.d_year, item.i_brand_id as brand_id, item.i_brand as brand,
+       sum(ss_ext_sales_price) as sum_agg
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manufact_id = 128
+  and dt.d_moy = 11
+group by dt.d_year, item.i_brand_id, item.i_brand
+order by dt.d_year, sum_agg desc, brand_id
+limit 100
+""",
+    7: """
+select i_item_id,
+       avg(ss_quantity) as agg1,
+       avg(ss_list_price) as agg2,
+       avg(ss_coupon_amt) as agg3,
+       avg(ss_sales_price) as agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+""",
+    42: """
+select dt.d_year, item.i_category_id, item.i_category,
+       sum(ss_ext_sales_price) as total_sales
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manager_id = 1
+  and dt.d_moy = 11
+  and dt.d_year = 2000
+group by dt.d_year, item.i_category_id, item.i_category
+order by total_sales desc, dt.d_year, item.i_category_id, item.i_category
+limit 100
+""",
+    52: """
+select dt.d_year, item.i_brand_id as brand_id, item.i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manager_id = 1
+  and dt.d_moy = 11
+  and dt.d_year = 2000
+group by dt.d_year, item.i_brand_id, item.i_brand
+order by dt.d_year, ext_price desc, brand_id
+limit 100
+""",
+    55: """
+select i_brand_id as brand_id, i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 28
+  and d_moy = 11
+  and d_year = 1999
+group by i_brand_id, i_brand
+order by ext_price desc, brand_id
+limit 100
+""",
+    68: """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       extended_price, extended_tax, list_price
+from (
+    select ss_ticket_number, ss_customer_sk, ca_city as bought_city,
+           sum(ss_ext_sales_price) as extended_price,
+           sum(ss_ext_list_price) as list_price,
+           sum(ss_ext_tax) as extended_tax
+    from store_sales, date_dim, store, household_demographics, customer_address
+    where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+      and store_sales.ss_store_sk = store.s_store_sk
+      and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+      and store_sales.ss_addr_sk = customer_address.ca_address_sk
+      and date_dim.d_dom between 1 and 2
+      and (household_demographics.hd_dep_count = 4
+           or household_demographics.hd_vehicle_count = 3)
+      and date_dim.d_year in (1999, 2000, 2001)
+      and store.s_city in ('Fairview', 'Midway')
+    group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city
+) dn, customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100
+""",
+    96: """
+select count(*) as cnt
+from store_sales, household_demographics, time_dim, store
+where ss_sold_time_sk = time_dim.t_time_sk
+  and ss_hdemo_sk = household_demographics.hd_demo_sk
+  and ss_store_sk = s_store_sk
+  and time_dim.t_hour = 20
+  and time_dim.t_minute >= 30
+  and household_demographics.hd_dep_count = 7
+  and store.s_store_name = 'ese'
+""",
+    64: """
+with cs_ui as (
+    select cs_item_sk,
+           sum(cs_ext_list_price) as sale,
+           sum(cr_refunded_cash + cr_reversed_charge + cr_store_credit) as refund
+    from catalog_sales, catalog_returns
+    where cs_item_sk = cr_item_sk
+      and cs_order_number = cr_order_number
+    group by cs_item_sk
+    having sum(cs_ext_list_price) >
+           2 * sum(cr_refunded_cash + cr_reversed_charge + cr_store_credit)
+),
+cross_sales as (
+    select i_product_name as product_name, i_item_sk as item_sk,
+           s_store_name as store_name, s_zip as store_zip,
+           ad1.ca_street_number as b_street_number,
+           ad1.ca_street_name as b_street_name,
+           ad1.ca_city as b_city, ad1.ca_zip as b_zip,
+           ad2.ca_street_number as c_street_number,
+           ad2.ca_street_name as c_street_name,
+           ad2.ca_city as c_city, ad2.ca_zip as c_zip,
+           d1.d_year as syear, d2.d_year as fsyear, d3.d_year as s2year,
+           count(*) as cnt,
+           sum(ss_wholesale_cost) as s1,
+           sum(ss_list_price) as s2,
+           sum(ss_coupon_amt) as s3
+    from store_sales, store_returns, cs_ui,
+         date_dim d1, date_dim d2, date_dim d3,
+         store, customer, customer_demographics cd1, customer_demographics cd2,
+         promotion, household_demographics hd1, household_demographics hd2,
+         customer_address ad1, customer_address ad2,
+         income_band ib1, income_band ib2, item
+    where ss_store_sk = s_store_sk
+      and ss_sold_date_sk = d1.d_date_sk
+      and ss_customer_sk = c_customer_sk
+      and ss_cdemo_sk = cd1.cd_demo_sk
+      and ss_hdemo_sk = hd1.hd_demo_sk
+      and ss_addr_sk = ad1.ca_address_sk
+      and ss_item_sk = i_item_sk
+      and ss_item_sk = sr_item_sk
+      and ss_ticket_number = sr_ticket_number
+      and ss_item_sk = cs_ui.cs_item_sk
+      and c_current_cdemo_sk = cd2.cd_demo_sk
+      and c_current_hdemo_sk = hd2.hd_demo_sk
+      and c_current_addr_sk = ad2.ca_address_sk
+      and c_first_sales_date_sk = d2.d_date_sk
+      and c_first_shipto_date_sk = d3.d_date_sk
+      and ss_promo_sk = p_promo_sk
+      and hd1.hd_income_band_sk = ib1.ib_income_band_sk
+      and hd2.hd_income_band_sk = ib2.ib_income_band_sk
+      and cd1.cd_marital_status <> cd2.cd_marital_status
+      and i_color in ('purple', 'burlywood', 'indian', 'spring', 'floral', 'medium')
+      and i_current_price between 64 and 64 + 10
+      and i_current_price between 64 + 1 and 64 + 15
+    group by i_product_name, i_item_sk, s_store_name, s_zip,
+             ad1.ca_street_number, ad1.ca_street_name, ad1.ca_city, ad1.ca_zip,
+             ad2.ca_street_number, ad2.ca_street_name, ad2.ca_city, ad2.ca_zip,
+             d1.d_year, d2.d_year, d3.d_year
+)
+select cs1.product_name, cs1.store_name, cs1.store_zip,
+       cs1.b_street_number, cs1.b_street_name, cs1.b_city, cs1.b_zip,
+       cs1.c_street_number, cs1.c_street_name, cs1.c_city, cs1.c_zip,
+       cs1.syear as syear1, cs1.cnt as cnt1, cs1.s1 as s11, cs1.s2 as s21, cs1.s3 as s31,
+       cs2.s1 as s12, cs2.s2 as s22, cs2.s3 as s32, cs2.syear as syear2, cs2.cnt as cnt2
+from cross_sales cs1, cross_sales cs2
+where cs1.item_sk = cs2.item_sk
+  and cs1.syear = 1999
+  and cs2.syear = 1999 + 1
+  and cs2.cnt <= cs1.cnt
+  and cs1.store_name = cs2.store_name
+  and cs1.store_zip = cs2.store_zip
+order by cs1.product_name, cs1.store_name, cnt2, s12, s22
+""",
+}

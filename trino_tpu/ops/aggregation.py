@@ -36,6 +36,12 @@ from trino_tpu.ops.common import (
 )
 
 
+#: process-level jitted-step cache: instances are per-query but configs
+#: recur, so identical aggregation programs share one jit wrapper (the
+#: AccumulatorCompiler class-cache analog)
+_STEP_CACHE: dict = {}
+
+
 @dataclass(frozen=True)
 class AggSpec:
     """One SQL aggregate: name in {count, count_star, sum, min, max, avg,
@@ -171,7 +177,17 @@ class AggregationOperator:
         self.mode = mode
         self.streaming = streaming
         self._acc: list[Batch] = []
-        self._step = jax.jit(self._reduce_step, static_argnames=("out_cap",))
+        key = (
+            tuple(self.group_channels),
+            tuple(self.aggregates),
+            tuple(t.name for t in self.input_types),
+            mode,
+        )
+        cached = _STEP_CACHE.get(key)
+        if cached is None:
+            cached = jax.jit(self._reduce_step, static_argnames=("out_cap",))
+            _STEP_CACHE[key] = cached
+        self._step = cached
 
     # -- the jitted kernel ---------------------------------------------------
 

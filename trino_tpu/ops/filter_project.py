@@ -19,11 +19,27 @@ from trino_tpu.expr import ExprCompiler
 from trino_tpu.expr.ir import Expr
 
 
+#: process-level jitted-step cache, keyed by expression structure — operator
+#: instances are per-query, but identical programs (same exprs) reuse one jit
+#: wrapper so repeated queries skip retracing (reference analog: the
+#: PageFunctionCompiler's generated-class cache, sql/gen/PageFunctionCompiler
+#: .java:103)
+_STEP_CACHE: dict = {}
+
+
 class FilterProjectOperator:
     def __init__(self, predicate: Optional[Expr], projections: Sequence[Expr]):
         self.predicate = predicate
         self.projections = list(projections)
-        self._step = jax.jit(self._make_step())
+        key = (
+            None if predicate is None else predicate.key(),
+            tuple(e.key() for e in projections),
+        )
+        cached = _STEP_CACHE.get(key)
+        if cached is None:
+            cached = jax.jit(self._make_step())
+            _STEP_CACHE[key] = cached
+        self._step = cached
 
     def _make_step(self):
         pred, projs = self.predicate, self.projections

@@ -50,6 +50,21 @@ def _match_live(batch: Batch, key_channels) -> jnp.ndarray:
     return live
 
 
+#: process-level jitted-step cache (cross-query reuse; see filter_project).
+#: CONTRACT: a cached step must read NO per-query state off `self` — only
+#: configuration captured in its cache key; per-query data (the build batch,
+#: null flags) is passed as explicit arguments.
+_STEP_CACHE: dict = {}
+
+
+def _jit_cached(key, factory):
+    if key is None:
+        return factory()
+    if key not in _STEP_CACHE:
+        _STEP_CACHE[key] = factory()
+    return _STEP_CACHE[key]
+
+
 class _CombinedSortJoinBase:
     """Shared machinery: locate, for every probe row, the contiguous run of
     matching build rows via one combined sort."""
@@ -57,7 +72,10 @@ class _CombinedSortJoinBase:
     def __init__(self, probe_key_channels, build_key_channels):
         self.probe_keys = list(probe_key_channels)
         self.build_keys = list(build_key_channels)
-        self._locate = jax.jit(self._locate_step, static_argnames=("cap_b",))
+        self._locate = _jit_cached(
+            ("locate", len(self.build_keys)),
+            lambda: jax.jit(self._locate_step, static_argnames=("cap_b",)),
+        )
 
     def _combined_keys(self, build: Batch, probe: Batch) -> Batch:
         """Host-side: key columns of both sides under one (union) dictionary."""
@@ -106,11 +124,14 @@ class HashJoinOperator(_CombinedSortJoinBase):
         build_types: Sequence[T.Type],
         probe_types: Sequence[T.Type] = (),
         residual=None,
+        residual_key=None,
     ):
         """`residual`: optional fn(candidate Batch: probe++build cols) -> bool
         mask, the non-equi join conjuncts (reference: JoinNode.filter /
         JoinFilterFunctionCompiler).  Outer-join semantics: a probe row whose
-        matches all fail the residual still emits one null-padded row."""
+        matches all fail the residual still emits one null-padded row.
+        `residual_key`: hashable identity of the residual (e.g. the expr key)
+        enabling cross-query reuse of the jitted expand step."""
         assert kind in ("inner", "left", "full")
         super().__init__(probe_key_channels, build_key_channels)
         self.kind = kind
@@ -120,7 +141,17 @@ class HashJoinOperator(_CombinedSortJoinBase):
         self.build: Optional[Batch] = None
         self._build_rows = 0
         self._build_matched = None  # bool[cap_b], for full outer
-        self._expand = jax.jit(self._expand_step, static_argnames=("out_cap", "cap_b"))
+        cache_key = None
+        if residual is None or residual_key is not None:
+            cache_key = (
+                "expand", kind, tuple(self.probe_keys), tuple(self.build_keys),
+                tuple(t.name for t in self.build_types), residual_key,
+            )
+        self._expand = _jit_cached(
+            cache_key, lambda: jax.jit(
+                self._expand_step, static_argnames=("out_cap", "cap_b")
+            )
+        )
 
     def set_build(self, batches: list[Batch]) -> None:
         self.build, self._build_rows = _dense_build(batches, self.build_types)
@@ -128,7 +159,7 @@ class HashJoinOperator(_CombinedSortJoinBase):
             self._build_matched = jnp.zeros(self.build.capacity, dtype=bool)
 
     def _expand_step(
-        self, probe: Batch, start, count, perm, build_matched,
+        self, probe: Batch, build: Batch, start, count, perm, build_matched,
         out_cap: int, cap_b: int, total_emit
     ):
         emit = count if self.kind == "inner" else jnp.where(probe.mask(), jnp.maximum(count, 1), 0)
@@ -165,7 +196,7 @@ class HashJoinOperator(_CombinedSortJoinBase):
                 else jnp.logical_and(bvalid_base, jnp.take(c.valid, build_row, mode="clip")),
                 c.dictionary,
             )
-            for c in self.build.columns
+            for c in build.columns
         ]
         keep_match = jnp.logical_and(matched, out_live)
         if self.residual is not None:
@@ -211,7 +242,7 @@ class HashJoinOperator(_CombinedSortJoinBase):
             total = int(jnp.sum(jnp.where(probe.mask(), jnp.maximum(count, 1), 0)))
         out_cap = next_pow2(max(total, 1), floor=1024)
         out, new_matched = self._expand(
-            probe, start, count, perm, self._build_matched,
+            probe, self.build, start, count, perm, self._build_matched,
             out_cap=out_cap, cap_b=cap_b, total_emit=total,
         )
         if new_matched is not None:
@@ -250,12 +281,15 @@ class NestedLoopJoinOperator:
         self.build_types = list(build_types)
         self.build: Optional[Batch] = None
         self._nb = 0
-        self._step = jax.jit(self._expand, static_argnames=("out_cap", "nb"))
+        self._step = _jit_cached(
+            ("nested", tuple(t.name for t in self.build_types)),
+            lambda: jax.jit(self._expand, static_argnames=("out_cap", "nb")),
+        )
 
     def set_build(self, batches: list[Batch]) -> None:
         self.build, self._nb = _dense_build(batches, self.build_types)
 
-    def _expand(self, probe: Batch, out_cap: int, nb: int, total_emit):
+    def _expand(self, probe: Batch, build: Batch, out_cap: int, nb: int, total_emit):
         cap_p = probe.capacity
         emit = jnp.where(probe.mask(), nb, 0)
         offsets = jnp.cumsum(emit) - emit
@@ -284,7 +318,7 @@ class NestedLoopJoinOperator:
                 None if c.valid is None else jnp.take(c.valid, j, mode="clip"),
                 c.dictionary,
             )
-            for c in self.build.columns
+            for c in build.columns
         ]
         return Batch(list(pcols) + list(bcols), out_live)
 
@@ -295,7 +329,9 @@ class NestedLoopJoinOperator:
                 continue
             total = probe.num_rows_host() * self._nb
             out_cap = next_pow2(max(total, 1), floor=1024)
-            yield self._step(probe, out_cap=out_cap, nb=self._nb, total_emit=total)
+            yield self._step(
+                probe, self.build, out_cap=out_cap, nb=self._nb, total_emit=total
+            )
 
 
 class SemiJoinOperator(_CombinedSortJoinBase):
@@ -320,6 +356,7 @@ class SemiJoinOperator(_CombinedSortJoinBase):
         filtering_types: Sequence[T.Type],
         null_aware: bool = True,
         residual=None,
+        residual_key=None,
     ):
         super().__init__([source_key_channel], [filtering_key_channel])
         self.filtering_types = list(filtering_types)
@@ -327,9 +364,24 @@ class SemiJoinOperator(_CombinedSortJoinBase):
         self.residual = residual
         self.build: Optional[Batch] = None
         self._filter_has_null = False
-        self._mark = jax.jit(self._mark_step, static_argnames=("cap_b",))
-        self._mark_res = jax.jit(
-            self._mark_residual_step, static_argnames=("cap_b", "out_cap")
+        self._mark = _jit_cached(
+            ("mark", null_aware, source_key_channel, filtering_key_channel),
+            lambda: jax.jit(
+                self._mark_step, static_argnames=("cap_b", "has_null")
+            ),
+        )
+        res_key = (
+            None
+            if (residual is not None and residual_key is None)
+            else ("mark_res", null_aware, source_key_channel, filtering_key_channel,
+                  tuple(t.name for t in self.filtering_types), residual_key)
+        )
+        self._mark_res = _jit_cached(
+            res_key,
+            lambda: jax.jit(
+                self._mark_residual_step,
+                static_argnames=("cap_b", "out_cap", "has_null"),
+            ),
         )
 
     def set_build(self, batches: list[Batch]) -> None:
@@ -339,24 +391,26 @@ class SemiJoinOperator(_CombinedSortJoinBase):
             has_null = jnp.any(jnp.logical_and(self.build.mask(), jnp.logical_not(col.valid)))
             self._filter_has_null = bool(has_null)
 
-    def _mark_from_matched(self, probe: Batch, matched) -> Batch:
+    def _mark_from_matched(self, probe: Batch, matched, has_null: bool) -> Batch:
         key = probe.columns[self.probe_keys[0]]
         key_valid = key.valid if key.valid is not None else jnp.ones(probe.capacity, bool)
         if not self.null_aware:
             mark_valid = None
-        elif self._filter_has_null:
+        elif has_null:
             mark_valid = jnp.logical_and(key_valid, matched)
         else:
             mark_valid = key_valid
         return probe.append_column(Column(matched, T.BOOLEAN, mark_valid))
 
-    def _mark_step(self, probe: Batch, combined: Batch, cap_b: int) -> Batch:
+    def _mark_step(
+        self, probe: Batch, combined: Batch, cap_b: int, has_null: bool
+    ) -> Batch:
         _, count, _ = self._locate_step(combined, cap_b)
-        return self._mark_from_matched(probe, count > 0)
+        return self._mark_from_matched(probe, count > 0, has_null)
 
     def _mark_residual_step(
-        self, probe: Batch, combined: Batch, start, count, perm,
-        cap_b: int, out_cap: int, total_emit
+        self, probe: Batch, build: Batch, start, count, perm,
+        cap_b: int, out_cap: int, total_emit, has_null: bool
     ) -> Batch:
         """Expand key-matching candidates, apply residual, any() per row."""
         offsets = jnp.cumsum(count) - count
@@ -392,12 +446,12 @@ class SemiJoinOperator(_CombinedSortJoinBase):
                 else jnp.logical_and(in_range, jnp.take(c.valid, build_row, mode="clip")),
                 c.dictionary,
             )
-            for c in self.build.columns
+            for c in build.columns
         ]
         candidate = Batch(list(pcols) + list(bcols), in_range)
         keep = jnp.logical_and(in_range, self.residual(candidate))
         surv = jax.ops.segment_sum(keep.astype(jnp.int64), ids, cap_p)
-        return self._mark_from_matched(probe, surv > 0)
+        return self._mark_from_matched(probe, surv > 0, has_null)
 
     def process(self, stream):
         assert self.build is not None
@@ -405,12 +459,15 @@ class SemiJoinOperator(_CombinedSortJoinBase):
         for probe in stream:
             combined = self._combined_keys(self.build, probe)
             if self.residual is None:
-                yield self._mark(probe, combined, cap_b=cap_b)
+                yield self._mark(
+                    probe, combined, cap_b=cap_b, has_null=self._filter_has_null
+                )
             else:
                 start, count, perm = self._locate(combined, cap_b=cap_b)
                 total = int(jnp.sum(count))
                 out_cap = next_pow2(max(total, 1), floor=1024)
                 yield self._mark_res(
-                    probe, combined, start, count, perm,
+                    probe, self.build, start, count, perm,
                     cap_b=cap_b, out_cap=out_cap, total_emit=total,
+                    has_null=self._filter_has_null,
                 )

@@ -20,13 +20,20 @@ from trino_tpu.ops.common import SortKey, multi_key_sort_perm, next_pow2
 from trino_tpu.ops.aggregation import _pad_device
 
 
+#: shared jitted steps across per-query instances (see filter_project)
+_STEP_CACHE: dict = {}
+
+
 class OrderByOperator:
     """Full materialized sort; emits one sorted, compacted batch."""
 
     def __init__(self, keys: Sequence[SortKey]):
         self.keys = list(keys)
         self._acc: list[Batch] = []
-        self._step = jax.jit(self._sort_step)
+        key = ("orderby", tuple(keys))
+        if key not in _STEP_CACHE:
+            _STEP_CACHE[key] = jax.jit(self._sort_step)
+        self._step = _STEP_CACHE[key]
 
     def _sort_step(self, batch: Batch) -> Batch:
         perm = multi_key_sort_perm(batch, self.keys)
@@ -48,7 +55,10 @@ class TopNOperator:
         self.keys = list(keys)
         self.n = n
         self._state: Optional[Batch] = None
-        self._step = jax.jit(self._merge_step, static_argnames=("out_cap",))
+        key = ("topn", tuple(keys), n)
+        if key not in _STEP_CACHE:
+            _STEP_CACHE[key] = jax.jit(self._merge_step, static_argnames=("out_cap",))
+        self._step = _STEP_CACHE[key]
 
     def _merge_step(self, batch: Batch, out_cap: int) -> Batch:
         perm = multi_key_sort_perm(batch, self.keys)

@@ -1,0 +1,341 @@
+"""Window function operator (reference: operator/WindowOperator.java +
+operator/window/* — rank family, value family, aggregate-over-frame).
+
+TPU substitution: one materialized sort by (partition keys, order keys), then
+every window function is a closed-form computation over partition/peer
+boundary flags — prefix sums (`cumsum`), segment reductions, and shifted
+gathers — a single static-shape XLA program instead of the reference's
+per-partition imperative loops (WindowPartition.processNextRow).
+
+Supported frames: the SQL default RANGE BETWEEN UNBOUNDED PRECEDING AND
+CURRENT ROW (running, peer-inclusive), ROWS UNBOUNDED PRECEDING..CURRENT ROW,
+and the whole-partition frame (no ORDER BY, or UNBOUNDED..UNBOUNDED).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.columnar.batch import concat_batches
+from trino_tpu.ops.aggregation import _pad_device
+from trino_tpu.ops.common import (
+    SortKey,
+    _max_sentinel,
+    _min_sentinel,
+    multi_key_sort_perm,
+    next_pow2,
+)
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    """One window function: rank family (no arg) or aggregate/value family
+    (arg = input channel).  frame: 'range' (default running, peer-aware),
+    'rows' (running, row-exact), 'full' (whole partition)."""
+
+    name: str  # row_number | rank | dense_rank | ntile | percent_rank |
+    #            cume_dist | lag | lead | first_value | last_value |
+    #            sum | count | avg | min | max
+    arg: Optional[int]
+    out_type: T.Type
+    offset: int = 1  # lag/lead offset (literal)
+    default_channel: Optional[int] = None  # lag/lead default value column
+    n_buckets: int = 1  # ntile
+    frame: str = "range"
+
+
+class WindowOperator:
+    def __init__(
+        self,
+        partition_channels: Sequence[int],
+        order_keys: Sequence[SortKey],
+        specs: Sequence[WindowSpec],
+    ):
+        self.partition_channels = list(partition_channels)
+        self.order_keys = list(order_keys)
+        self.specs = list(specs)
+        self._acc: list[Batch] = []
+        self._step = jax.jit(self._window_step)
+
+    # -- the jitted kernel ----------------------------------------------------
+
+    def _window_step(self, batch: Batch) -> Batch:
+        cap = batch.capacity
+        keys = [SortKey(ch) for ch in self.partition_channels] + self.order_keys
+        perm = multi_key_sort_perm(batch, keys) if keys else jnp.arange(cap, dtype=jnp.int64)
+        live = jnp.take(batch.mask(), perm, mode="clip")
+        pos = jnp.arange(cap, dtype=jnp.int64)
+
+        # partition boundaries (null-safe equality over partition keys)
+        new_part = jnp.zeros(cap, dtype=bool)
+        first_live = jnp.logical_and(live, jnp.cumsum(live) == 1)
+        for ch in self.partition_channels:
+            col = batch.columns[ch]
+            d = jnp.take(col.data, perm, mode="clip")
+            neq = d != jnp.roll(d, 1)
+            if col.valid is not None:
+                v = jnp.take(col.valid, perm, mode="clip")
+                pv = jnp.roll(v, 1)
+                neq = jnp.logical_or(
+                    jnp.logical_and(neq, jnp.logical_and(v, pv)), v != pv
+                )
+            new_part = jnp.logical_or(new_part, neq)
+        new_part = jnp.logical_or(jnp.logical_and(live, new_part), first_live)
+        pid = jnp.cumsum(new_part) - 1  # partition id per sorted row
+        pid = jnp.where(live, pid, cap)
+        nseg = cap + 1
+        part_start = jax.ops.segment_min(jnp.where(live, pos, cap), pid, nseg)
+        part_size = jax.ops.segment_sum(live.astype(jnp.int64), pid, nseg)
+        idx_in_part = pos - part_start[jnp.clip(pid, 0, cap)]
+
+        # peer boundaries (order-key ties within a partition)
+        new_peer = new_part
+        for k in self.order_keys:
+            col = batch.columns[k.channel]
+            d = jnp.take(col.data, perm, mode="clip")
+            neq = d != jnp.roll(d, 1)
+            if col.valid is not None:
+                v = jnp.take(col.valid, perm, mode="clip")
+                pv = jnp.roll(v, 1)
+                neq = jnp.logical_or(
+                    jnp.logical_and(neq, jnp.logical_and(v, pv)), v != pv
+                )
+            new_peer = jnp.logical_or(new_peer, jnp.logical_and(live, neq))
+        peer_gid = jnp.cumsum(new_peer) - 1
+        peer_gid = jnp.where(live, peer_gid, cap)
+        # last row index of each peer group (for RANGE running frames)
+        peer_last = jax.ops.segment_max(jnp.where(live, pos, -1), peer_gid, nseg)
+
+        out_cols = []
+        for spec in self.specs:
+            vals = self._compute(
+                spec, batch, perm, live, pid, nseg, part_start, part_size,
+                idx_in_part, new_peer, peer_gid, peer_last, pos, cap,
+            )
+            out_cols.append(vals)
+        # scatter back to original row order
+        inv = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(pos)
+        final_cols = list(batch.columns)
+        for c in out_cols:
+            data = jnp.take(c.data, inv, mode="clip")
+            valid = None if c.valid is None else jnp.take(c.valid, inv, mode="clip")
+            final_cols.append(Column(data, c.type, valid, c.dictionary))
+        return Batch(final_cols, batch.row_mask)
+
+    def _compute(
+        self, spec, batch, perm, live, pid, nseg, part_start, part_size,
+        idx_in_part, new_peer, peer_gid, peer_last, pos, cap,
+    ) -> Column:
+        name = spec.name
+        safe_pid = jnp.clip(pid, 0, cap)
+        n_in_part = part_size[safe_pid]
+        if name == "row_number":
+            return Column(idx_in_part + 1, T.BIGINT, None)
+        if name in ("rank", "dense_rank", "percent_rank", "cume_dist", "ntile"):
+            # rank = index of first peer row in partition + 1
+            first_peer = jax.ops.segment_min(jnp.where(live, pos, cap), peer_gid, nseg)
+            rank = first_peer[jnp.clip(peer_gid, 0, cap)] - part_start[safe_pid] + 1
+            if name == "rank":
+                return Column(rank, T.BIGINT, None)
+            if name == "dense_rank":
+                dense = jnp.cumsum(new_peer) - jnp.take(
+                    jnp.cumsum(new_peer), part_start[safe_pid], mode="clip"
+                ) + 1
+                return Column(dense, T.BIGINT, None)
+            if name == "percent_rank":
+                den = jnp.maximum(n_in_part - 1, 1)
+                return Column((rank - 1) / den, T.DOUBLE, None)
+            if name == "cume_dist":
+                last = peer_last[jnp.clip(peer_gid, 0, cap)]
+                covered = last - part_start[safe_pid] + 1
+                return Column(covered / jnp.maximum(n_in_part, 1), T.DOUBLE, None)
+            if name == "ntile":
+                n = spec.n_buckets
+                sz = n_in_part
+                base, rem = sz // n, sz % n
+                big = (base + 1) * rem  # rows covered by the larger buckets
+                in_big = idx_in_part < big
+                bucket = jnp.where(
+                    in_big,
+                    idx_in_part // jnp.maximum(base + 1, 1),
+                    rem + (idx_in_part - big) // jnp.maximum(base, 1),
+                )
+                return Column(bucket + 1, T.BIGINT, None)
+        if name in ("lag", "lead"):
+            col = batch.columns[spec.arg]
+            d = jnp.take(col.data, perm, mode="clip")
+            v = jnp.take(col.valid, perm, mode="clip") if col.valid is not None else jnp.ones(cap, bool)
+            off = spec.offset if name == "lag" else -spec.offset
+            src = pos - off
+            in_part = jnp.logical_and(
+                src >= part_start[safe_pid], src < part_start[safe_pid] + n_in_part
+            )
+            src_c = jnp.clip(src, 0, cap - 1)
+            data = jnp.take(d, src_c, mode="clip")
+            valid = jnp.logical_and(in_part, jnp.take(v, src_c, mode="clip"))
+            if spec.default_channel is not None:
+                dc = batch.columns[spec.default_channel]
+                dd = jnp.take(dc.data, perm, mode="clip")
+                dv = (
+                    jnp.take(dc.valid, perm, mode="clip")
+                    if dc.valid is not None
+                    else jnp.ones(cap, bool)
+                )
+                data = jnp.where(in_part, data, dd)
+                valid = jnp.where(in_part, valid, dv)
+            return Column(data.astype(spec.out_type.np_dtype), spec.out_type, valid, col.dictionary)
+        if name in ("first_value", "last_value"):
+            col = batch.columns[spec.arg]
+            d = jnp.take(col.data, perm, mode="clip")
+            v = jnp.take(col.valid, perm, mode="clip") if col.valid is not None else jnp.ones(cap, bool)
+            if name == "first_value":
+                src = part_start[safe_pid]
+            elif spec.frame == "full":
+                src = part_start[safe_pid] + n_in_part - 1
+            else:  # running frame: last peer row
+                src = peer_last[jnp.clip(peer_gid, 0, cap)]
+            src = jnp.clip(src, 0, cap - 1)
+            return Column(
+                jnp.take(d, src, mode="clip").astype(spec.out_type.np_dtype),
+                spec.out_type,
+                jnp.take(v, src, mode="clip"),
+                col.dictionary,
+            )
+        # aggregates over the frame
+        if name == "count" and spec.arg is None:  # count(*) over (...)
+            if spec.frame == "full" or not self.order_keys:
+                return Column(n_in_part, T.BIGINT, None)
+            if spec.frame == "rows":
+                return Column(idx_in_part + 1, T.BIGINT, None)
+            last = peer_last[jnp.clip(peer_gid, 0, cap)]
+            return Column(last - part_start[safe_pid] + 1, T.BIGINT, None)
+        col = batch.columns[spec.arg]
+        d = jnp.take(col.data, perm, mode="clip")
+        v = live
+        if col.valid is not None:
+            v = jnp.logical_and(v, jnp.take(col.valid, perm, mode="clip"))
+        whole = spec.frame == "full" or not self.order_keys
+        if name in ("sum", "avg", "count"):
+            st = T.DOUBLE if d.dtype == jnp.float64 else jnp.int64
+            dd = jnp.where(v, d, 0).astype(
+                jnp.float64 if jnp.issubdtype(d.dtype, jnp.floating) else jnp.int64
+            )
+            cnt_inc = v.astype(jnp.int64)
+            if whole:
+                ssum = jax.ops.segment_sum(dd, pid, nseg)[safe_pid]
+                scnt = jax.ops.segment_sum(cnt_inc, pid, nseg)[safe_pid]
+            else:
+                run = jnp.cumsum(dd)
+                runc = jnp.cumsum(cnt_inc)
+                if spec.frame == "rows":
+                    upto = pos
+                else:
+                    upto = peer_last[jnp.clip(peer_gid, 0, cap)]
+                base_idx = part_start[safe_pid]
+                run_at = lambda r, i: jnp.take(r, jnp.clip(i, 0, cap - 1), mode="clip")
+                before = jnp.where(base_idx > 0, run_at(run, base_idx - 1), 0)
+                beforec = jnp.where(base_idx > 0, run_at(runc, base_idx - 1), 0)
+                ssum = run_at(run, upto) - before
+                scnt = run_at(runc, upto) - beforec
+            if name == "count":
+                return Column(scnt, T.BIGINT, None)
+            if name == "sum":
+                return Column(
+                    ssum.astype(spec.out_type.np_dtype), spec.out_type, scnt > 0, col.dictionary
+                )
+            if isinstance(spec.out_type, T.DecimalType):
+                # exact integer half-away-from-zero, matching the grouped
+                # aggregate's _finalize (jnp.round is half-to-even)
+                den = jnp.maximum(scnt, 1)
+                sign = jnp.sign(ssum)
+                q = jnp.abs(ssum) // den
+                r = jnp.abs(ssum) - q * den
+                avg = sign * (q + jnp.where(2 * r >= den, 1, 0))
+            else:
+                avg = ssum.astype(jnp.float64) / jnp.maximum(scnt, 1)
+            return Column(avg.astype(spec.out_type.np_dtype), spec.out_type, scnt > 0)
+        if name in ("min", "max"):
+            sent = _max_sentinel(d.dtype) if name == "min" else _min_sentinel(d.dtype)
+            dd = jnp.where(v, d, sent)
+            if whole:
+                red = (
+                    jax.ops.segment_min(dd, pid, nseg)
+                    if name == "min"
+                    else jax.ops.segment_max(dd, pid, nseg)
+                )[safe_pid]
+                cnt = jax.ops.segment_sum(v.astype(jnp.int64), pid, nseg)[safe_pid]
+                return Column(red, spec.out_type, cnt > 0, col.dictionary)
+            # running min/max: prefix scan reset at partition starts — use
+            # cummax over (partition-tagged) values via associative_scan
+            op = jnp.minimum if name == "min" else jnp.maximum
+            def scan_fn(a, b):
+                a_pid, a_val = a
+                b_pid, b_val = b
+                merged = jnp.where(a_pid == b_pid, op(a_val, b_val), b_val)
+                return (b_pid, merged)
+            _, red = jax.lax.associative_scan(scan_fn, (pid, dd))
+            if spec.frame != "rows":
+                last = jnp.clip(peer_last[jnp.clip(peer_gid, 0, cap)], 0, cap - 1)
+                red = jnp.take(red, last, mode="clip")
+            runc = jnp.cumsum(v.astype(jnp.int64))
+            base_idx = part_start[safe_pid]
+            before = jnp.where(
+                base_idx > 0,
+                jnp.take(runc, jnp.clip(base_idx - 1, 0, cap - 1), mode="clip"),
+                0,
+            )
+            upto = pos if spec.frame == "rows" else peer_last[jnp.clip(peer_gid, 0, cap)]
+            cnt = jnp.take(runc, jnp.clip(upto, 0, cap - 1), mode="clip") - before
+            return Column(red, spec.out_type, cnt > 0, col.dictionary)
+        raise NotImplementedError(f"window function {name}")
+
+    # -- host-side ------------------------------------------------------------
+
+    def _unify_default_dicts(self, batch: Batch) -> Batch:
+        """lag/lead defaults must share the argument's dictionary: the kernel
+        merges raw codes with jnp.where, so mixed dictionaries would decode
+        wrongly (host-side recode, the DictionaryBlock-compaction analog)."""
+        from trino_tpu.columnar.dictionary import union_many
+
+        cols = list(batch.columns)
+        for spec in self.specs:
+            if spec.name not in ("lag", "lead") or spec.default_channel is None:
+                continue
+            a, d = cols[spec.arg], cols[spec.default_channel]
+            if a.dictionary is None and d.dictionary is None:
+                continue
+            if a.dictionary is d.dictionary or a.dictionary == d.dictionary:
+                continue
+            if a.dictionary is None or d.dictionary is None:
+                raise NotImplementedError(
+                    "lag/lead default mixes dictionary and non-dictionary strings"
+                )
+            merged, (ta, td) = union_many([a.dictionary, d.dictionary])
+            for ch, col, table in ((spec.arg, a, ta), (spec.default_channel, d, td)):
+                if table is None:
+                    cols[ch] = Column(col.data, col.type, col.valid, merged)
+                else:
+                    cols[ch] = Column(
+                        jnp.take(
+                            jnp.asarray(table), jnp.asarray(col.data, jnp.int64),
+                            mode="clip",
+                        ),
+                        col.type, col.valid, merged,
+                    )
+        return batch.with_columns(cols)
+
+    def process(self, stream):
+        for b in stream:
+            self._acc.append(b)
+        if not self._acc:
+            return
+        big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
+        big = self._unify_default_dicts(big)
+        big = _pad_device(big, next_pow2(big.capacity, floor=1))
+        yield self._step(big)

@@ -1,0 +1,11 @@
+"""Distributed execution over a jax device mesh.
+
+Reference layer: execution/scheduler + operator/exchange + execution/buffer —
+Trino's stage/task/exchange machinery.  Here a "worker" is a mesh device;
+stages are SPMD programs over stacked per-worker batches; exchanges are XLA
+collectives over ICI (all_to_all repartition, all_gather broadcast, gather to
+the coordinator host) instead of HTTP page buffers (SURVEY.md §5.8).
+"""
+
+from trino_tpu.parallel.spmd import WorkerMesh, stack_batches, unstack_batch
+from trino_tpu.parallel.runner import DistributedQueryRunner

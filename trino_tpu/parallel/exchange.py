@@ -1,0 +1,156 @@
+"""Collective exchange kernels (the data plane).
+
+Reference roles (SURVEY.md §5.8): PartitionedOutputOperator/PagePartitioner +
+ExchangeOperator/DirectExchangeClient become a hash-bucketize + all_to_all;
+BroadcastOutputBuffer becomes all_gather; the final gather to the coordinator
+is a host device_get.  Wire format: none needed — batches stay device-resident
+columnar arrays; only dictionary codes must be pre-unified (stack_batches).
+
+Shape discipline: all_to_all needs a static per-destination slot capacity.
+A first jitted phase counts rows per (worker, destination); the host takes
+the max and picks the pow2 slot capacity; the second jitted phase performs
+the exchange (the reference's two-step "reserve then append" PagePartitioner
+pattern, with the host sync standing in for buffer backpressure).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.ops.common import next_pow2
+from trino_tpu.parallel.spmd import WorkerMesh, spmd_collective_step
+
+_MIX = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _hash_rows(batch: Batch, key_channels: Sequence[int]) -> jnp.ndarray:
+    """64-bit row hash over key columns; NULL hashes as a distinct constant
+    (nulls group together, SQL GROUP BY semantics)."""
+    cap = batch.capacity
+    h = jnp.full(cap, 1469598103934665603, dtype=jnp.uint64)
+    for ch in key_channels:
+        c = batch.columns[ch]
+        v = c.data
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.int8)
+        bits = v.astype(jnp.int64).astype(jnp.uint64)
+        if c.valid is not None:
+            bits = jnp.where(c.valid, bits, jnp.uint64(0xDEADBEEF))
+        x = (bits ^ (bits >> 33)) * _MIX
+        x = x ^ (x >> 29)
+        h = (h ^ x) * _MIX
+    return h
+
+
+def _counts_kernel(key_channels, n_workers):
+    def kernel(stacked: Batch):
+        b = jax.tree.map(lambda x: x[0], stacked)
+        h = _hash_rows(b, key_channels)
+        dest = (h % jnp.uint64(n_workers)).astype(jnp.int64)
+        dest = jnp.where(b.mask(), dest, n_workers)
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(dest), dest, n_workers + 1
+        )[:n_workers]
+        return counts[None]
+
+    return kernel
+
+
+def _exchange_kernel(key_channels, n_workers, slot_cap):
+    def kernel(stacked: Batch):
+        b = jax.tree.map(lambda x: x[0], stacked)
+        cap = b.capacity
+        h = _hash_rows(b, key_channels)
+        dest = (h % jnp.uint64(n_workers)).astype(jnp.int64)
+        dest = jnp.where(b.mask(), dest, n_workers)
+        # stable sort rows by destination; dead rows last
+        order = jnp.argsort(dest, stable=True)
+        d_sorted = dest[order]
+        # slot within destination = position - first position of that dest
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        first = jax.ops.segment_min(pos, d_sorted, n_workers + 1)
+        slot = pos - first[jnp.clip(d_sorted, 0, n_workers)]
+        valid_slot = jnp.logical_and(d_sorted < n_workers, slot < slot_cap)
+        flat = jnp.where(valid_slot, d_sorted * slot_cap + slot, n_workers * slot_cap)
+
+        def scatter(col_1d, fill):
+            out = jnp.full((n_workers * slot_cap + 1,), fill, dtype=col_1d.dtype)
+            out = out.at[flat].set(col_1d[order], mode="drop")
+            return out[:-1].reshape(n_workers, slot_cap)
+
+        sent_mask = scatter(b.mask(), False)
+        sent_cols = [
+            (
+                scatter(c.data, jnp.asarray(0, c.data.dtype)),
+                None if c.valid is None else scatter(c.valid, False),
+            )
+            for c in b.columns
+        ]
+        # the collective: piece d goes to worker d; received[w] = from worker w
+        recv_mask = jax.lax.all_to_all(
+            sent_mask, "workers", split_axis=0, concat_axis=0
+        ).reshape(-1)
+        out_cols = []
+        for (data, valid), c in zip(sent_cols, b.columns):
+            rd = jax.lax.all_to_all(data, "workers", split_axis=0, concat_axis=0)
+            rv = (
+                None
+                if valid is None
+                else jax.lax.all_to_all(valid, "workers", split_axis=0, concat_axis=0).reshape(-1)
+            )
+            out_cols.append(Column(rd.reshape(-1), c.type, rv, c.dictionary))
+        out = Batch(out_cols, recv_mask)
+        return jax.tree.map(lambda x: x[None], out)
+
+    return kernel
+
+
+def repartition(stacked: Batch, key_channels: Sequence[int], wm: WorkerMesh) -> Batch:
+    """Hash-repartition a stacked [W, cap] batch so equal keys land on the
+    same worker.  Returns a stacked [W, W*slot_cap] batch."""
+    from jax.sharding import PartitionSpec as P
+
+    from trino_tpu.parallel.spmd import shard_map_compat
+
+    counts_fn = jax.jit(
+        shard_map_compat(
+            _counts_kernel(key_channels, wm.n), wm.mesh, P("workers"), P("workers")
+        )
+    )
+    counts = np.asarray(counts_fn(stacked))  # [W, W]
+    slot_cap = next_pow2(max(1, int(counts.max())), floor=64)
+    fn = spmd_collective_step(wm, _exchange_kernel(key_channels, wm.n, slot_cap))
+    return fn(stacked)
+
+
+def broadcast(stacked: Batch, wm: WorkerMesh) -> Batch:
+    """Replicate every worker's rows to all workers (FIXED_BROADCAST /
+    BroadcastOutputBuffer role): stacked [W, cap] -> stacked [W, W*cap]."""
+
+    def kernel(st: Batch):
+        b = jax.tree.map(lambda x: x[0], st)
+
+        def bcast(x):
+            g = jax.lax.all_gather(x, "workers")  # [W, cap, ...]
+            return g.reshape((-1,) + g.shape[2:])
+
+        cols = [
+            Column(
+                bcast(c.data),
+                c.type,
+                None if c.valid is None else bcast(c.valid),
+                c.dictionary,
+            )
+            for c in b.columns
+        ]
+        out = Batch(cols, bcast(b.mask()))
+        return jax.tree.map(lambda x: x[None], out)
+
+    fn = spmd_collective_step(wm, kernel)
+    return fn(stacked)

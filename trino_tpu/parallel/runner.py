@@ -1,0 +1,399 @@
+"""Distributed query runner: plans execute SPMD over the worker mesh.
+
+Reference roles: SqlQueryExecution.planDistribution + PipelinedQueryScheduler
+(stage orchestration) + AddExchanges' distribution choices, collapsed into a
+recursive executor because stages here are jitted SPMD programs, not remote
+tasks: the host *is* the coordinator, device collectives *are* the shuffle
+(SURVEY.md §5.8 TPU mapping).
+
+Distribution strategy per node (AddExchanges.java:139 analog):
+- TableScan: splits round-robin across workers (SOURCE_DISTRIBUTION)
+- Filter/Project: inherit child distribution (no exchange)
+- Aggregation: per-worker partial -> hash repartition on group keys ->
+  final merge (FIXED_HASH); global aggregates all_gather their single
+  state row (SINGLE_DISTRIBUTION via collective instead of gather stage)
+- Join: build side broadcast when small (all_gather), else both sides
+  hash-repartitioned on the join keys (partitioned join)
+- SemiJoin: filtering side broadcast
+- Sort/TopN/Limit/Output: gathered to the coordinator and finished with the
+  local operators (COORDINATOR_ONLY final fragment)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.columnar.batch import concat_batches
+from trino_tpu.connectors.api import CatalogManager, default_catalogs
+from trino_tpu.expr import ExprCompiler
+from trino_tpu.expr.ir import InputRef
+from trino_tpu.ops.aggregation import AggregationOperator, AggSpec
+from trino_tpu.ops.common import next_pow2
+from trino_tpu.ops.filter_project import FilterProjectOperator
+from trino_tpu.ops.join import HashJoinOperator, SemiJoinOperator
+from trino_tpu.ops.scan import page_to_batch
+from trino_tpu.parallel import exchange as ex
+from trino_tpu.parallel.spmd import WorkerMesh, spmd_step, stack_batches, unstack_batch
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.stats import estimate_rows
+from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
+from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
+
+#: build sides estimated smaller than this broadcast; larger repartition
+BROADCAST_ROWS = 50_000
+
+
+class _Dist:
+    """A distributed intermediate: stacked [W, cap] batch + symbol layout."""
+
+    def __init__(self, stacked: Batch, symbols: list):
+        self.stacked = stacked
+        self.symbols = list(symbols)
+
+    def channel(self, name: str) -> int:
+        for i, s in enumerate(self.symbols):
+            if s.name == name:
+                return i
+        raise KeyError(name)
+
+    def rewrite(self, expr):
+        return PhysicalPlan(iter(()), self.symbols).rewrite(expr)
+
+
+class DistributedQueryRunner(LocalQueryRunner):
+    def __init__(
+        self,
+        catalogs: Optional[CatalogManager] = None,
+        catalog: str = "tpch",
+        schema: str = "tiny",
+        n_workers: Optional[int] = None,
+        devices=None,
+    ):
+        super().__init__(catalogs, catalog=catalog, schema=schema)
+        self.wm = WorkerMesh(devices, n_workers)
+
+    # -- public ---------------------------------------------------------------
+
+    def execute(self, sql: str) -> MaterializedResult:
+        plan = self.create_plan(sql)
+        host = self._to_host_plan(plan)
+        rows = []
+        for batch in host.stream:
+            rows.extend(tuple(r) for r in batch.to_pylist())
+        return MaterializedResult(
+            list(plan.column_names), rows, [s.type for s in plan.symbols]
+        )
+
+    # -- recursion ------------------------------------------------------------
+
+    def _to_host_plan(self, node: P.PlanNode) -> PhysicalPlan:
+        """Execute `node`, gathering to the coordinator (host batches)."""
+        out = self._dexec(node)
+        if isinstance(out, _Dist):
+            host_batch = unstack_batch(jax.device_get(out.stacked))
+            return PhysicalPlan(iter([host_batch]), out.symbols)
+        return out
+
+    def _local(self) -> LocalExecutionPlanner:
+        return LocalExecutionPlanner(self.catalogs, target_splits=self.target_splits)
+
+    def _dexec(self, node: P.PlanNode):
+        """Returns a _Dist (still distributed) or PhysicalPlan (coordinator)."""
+        m = getattr(self, "_d_" + type(node).__name__, None)
+        if m is not None:
+            out = m(node)
+            if out is not None:
+                return out
+        # coordinator fallback: gather distributed children, run local operator
+        lp = self._local()
+        saved = lp.plan
+        dexec = self._dexec
+
+        def plan_hook(n: P.PlanNode) -> PhysicalPlan:
+            if n is not node:
+                d = dexec(n)
+                if isinstance(d, _Dist):
+                    host_batch = unstack_batch(jax.device_get(d.stacked))
+                    return PhysicalPlan(iter([host_batch]), d.symbols)
+                return d
+            return saved(n)
+
+        lp.plan = plan_hook
+        return saved(node)
+
+    # -- distributed node handlers (return None to fall back) -----------------
+
+    def _d_TableScanNode(self, node: P.TableScanNode):
+        connector = self.catalogs.get(node.handle.catalog)
+        names = [c for _, c in node.assignments]
+        types = [s.type for s, _ in node.assignments]
+        splits = list(connector.splits(node.handle, target_splits=self.wm.n))
+        per_worker: list = [[] for _ in range(self.wm.n)]
+        for i, split in enumerate(splits):
+            src = connector.page_source(split, names)
+            for page in src.pages():
+                per_worker[i % self.wm.n].append(page_to_batch(page, types))
+        host_batches = [
+            (concat_batches(bs) if bs else None) for bs in per_worker
+        ]
+        if all(b is None for b in host_batches):
+            # degenerate: an empty 1-row dead batch so the stack has a shape
+            cols = [
+                Column(np.zeros(1, dtype=t.np_dtype), t, np.zeros(1, bool))
+                for t in types
+            ]
+            host_batches[0] = Batch(cols, np.zeros(1, bool))
+        stacked = stack_batches(host_batches, self.wm)
+        out = _Dist(stacked, [s for s, _ in node.assignments])
+        if node.pushed_predicate is not None:
+            pred = out.rewrite(node.pushed_predicate)
+            step = FilterProjectOperator(
+                pred, [InputRef(i, s.type) for i, s in enumerate(out.symbols)]
+            )._make_step()
+            out = _Dist(spmd_step(self.wm, step)(out.stacked), out.symbols)
+        return out
+
+    def _d_FilterNode(self, node: P.FilterNode):
+        src = self._dexec(node.source)
+        if not isinstance(src, _Dist):
+            return None
+        pred = src.rewrite(node.predicate)
+        step = FilterProjectOperator(
+            pred, [InputRef(i, s.type) for i, s in enumerate(src.symbols)]
+        )._make_step()
+        return _Dist(spmd_step(self.wm, step)(src.stacked), src.symbols)
+
+    def _d_ProjectNode(self, node: P.ProjectNode):
+        src = self._dexec(node.source)
+        if not isinstance(src, _Dist):
+            return None
+        exprs = [src.rewrite(e) for _, e in node.assignments]
+        step = FilterProjectOperator(None, exprs)._make_step()
+        return _Dist(
+            spmd_step(self.wm, step)(src.stacked), [s for s, _ in node.assignments]
+        )
+
+    def _d_AggregationNode(self, node: P.AggregationNode):
+        if any(a.distinct for _, a in node.aggregations):
+            return None  # coordinator fallback for distinct shapes
+        src = self._dexec(node.source)
+        if not isinstance(src, _Dist):
+            return None
+        ngroups = len(node.group_symbols)
+        # pre-projection (same construction as the local planner)
+        from trino_tpu.expr.ir import Form, Literal, SpecialForm
+
+        proj = [src.rewrite(s.ref()) for s in node.group_symbols]
+        specs: list = []
+        input_types = [s.type for s in node.group_symbols]
+        for out_sym, agg in node.aggregations:
+            name = agg.function
+            arg = src.rewrite(agg.args[0]) if agg.args else None
+            if agg.filter is not None:
+                f = src.rewrite(agg.filter)
+                if name == "count_star":
+                    name, arg = "count", SpecialForm(
+                        Form.IF, [f, Literal(1, T.BIGINT), Literal(None, T.BIGINT)], T.BIGINT
+                    )
+                else:
+                    arg = SpecialForm(Form.IF, [f, arg, Literal(None, arg.type)], arg.type)
+            if arg is None:
+                specs.append(AggSpec(name, None, out_sym.type))
+            else:
+                nargs = len([s for s in specs if s.arg is not None])
+                proj.append(arg)
+                input_types.append(arg.type)
+                specs.append(AggSpec(name, ngroups + nargs, out_sym.type))
+        pre = FilterProjectOperator(None, proj)._make_step()
+        partial_op = AggregationOperator(
+            list(range(ngroups)), specs, input_types, mode="partial"
+        )
+        cap = _trailing_cap(src.stacked)
+        part_cap = next_pow2(cap, floor=1)
+
+        def partial_step(b: Batch) -> Batch:
+            return partial_op._reduce_step(pre(b), out_cap=part_cap)
+
+        states = spmd_step(self.wm, partial_step)(src.stacked)
+        state_types = [c.type for c in jax.tree.map(lambda x: x[0], states).columns]
+        merge_specs = [
+            AggSpec(s.name, partial_op._state_channel(i), s.out_type)
+            for i, s in enumerate(specs)
+        ]
+        final_op = AggregationOperator(
+            list(range(ngroups)), merge_specs, state_types, mode="final"
+        )
+        if ngroups:
+            exchanged = ex.repartition(states, list(range(ngroups)), self.wm)
+            fcap = _trailing_cap(exchanged)
+
+            def final_step(b: Batch) -> Batch:
+                return final_op._reduce_step(b, out_cap=fcap)
+
+            out = spmd_step(self.wm, final_step)(exchanged)
+            return _Dist(out, node.outputs)
+        # global aggregation: single state row per worker -> all_gather ->
+        # replicated final merge; coordinator reads one replica
+        gathered = ex.broadcast(states, self.wm)
+
+        def final_step(b: Batch) -> Batch:
+            return final_op._reduce_step(b, out_cap=1)
+
+        out = spmd_step(self.wm, final_step)(gathered)
+        host = jax.device_get(out)
+        first = jax.tree.map(lambda x: x[:1], host)
+        one = unstack_batch(first)
+        return PhysicalPlan(iter([one]), node.outputs)
+
+    def _d_JoinNode(self, node: P.JoinNode):
+        if node.kind not in ("inner", "left") or not node.criteria:
+            return None
+        probe = self._dexec(node.left)
+        build = self._dexec(node.right)
+        if not (isinstance(probe, _Dist) and isinstance(build, _Dist)):
+            return None
+        pk = [probe.channel(l.name) for l, _ in node.criteria]
+        bk = [build.channel(r.name) for _, r in node.criteria]
+        # keys must be dictionary-free for cross-worker comparability
+        for d, chans in ((probe, pk), (build, bk)):
+            for ch in chans:
+                if d.stacked.columns[ch].dictionary is not None:
+                    return None
+        out_symbols = probe.symbols + build.symbols
+        residual = None
+        if node.filter is not None:
+            expr = PhysicalPlan(iter(()), out_symbols).rewrite(node.filter)
+
+            def residual(batch: Batch, _e=expr):
+                return ExprCompiler(batch).filter_mask(_e)
+
+        if estimate_rows(node.right, self.catalogs) <= BROADCAST_ROWS:
+            build_stacked = ex.broadcast(build.stacked, self.wm)
+        else:
+            build_stacked = ex.repartition(build.stacked, bk, self.wm)
+            probe = _Dist(ex.repartition(probe.stacked, pk, self.wm), probe.symbols)
+
+        op = HashJoinOperator(
+            node.kind, pk, bk,
+            [s.type for s in build.symbols],
+            probe_types=[s.type for s in probe.symbols],
+            residual=residual,
+        )
+        cap_b = _trailing_cap(build_stacked)
+
+        def locate_step(pb: Batch, bb: Batch):
+            combined = _concat_keys(bb, bk, pb, pk)
+            return op._locate_step(combined, cap_b)
+
+        start, count, perm = spmd_step(self.wm, locate_step)(
+            probe.stacked, build_stacked
+        )
+        # per-worker emit totals (host sync fixes the static output capacity)
+        count_h = np.asarray(jax.device_get(count))  # [W, cap_p]
+        mask_h = np.asarray(jax.device_get(probe.stacked.mask()))
+        emit_h = (
+            np.where(mask_h, np.maximum(count_h, 1), 0)
+            if node.kind == "left"
+            else np.where(mask_h, count_h, 0)
+        )
+        totals = emit_h.sum(axis=-1)  # [W]
+        out_cap = next_pow2(max(1, int(totals.max())), floor=1024)
+
+        def expand_step(pb: Batch, bb: Batch, st, ct, pm, total):
+            out, _ = op._expand_step(
+                pb, bb, st, ct, pm, None, out_cap=out_cap,
+                cap_b=cap_b, total_emit=total,
+            )
+            return out
+
+        out = spmd_step(self.wm, expand_step)(
+            probe.stacked, build_stacked, start, count, perm,
+            jax.device_put(totals, self.wm.sharding()),
+        )
+        return _Dist(out, out_symbols)
+
+    def _d_SemiJoinNode(self, node: P.SemiJoinNode):
+        src = self._dexec(node.source)
+        if not isinstance(src, _Dist):
+            return None
+        filt = self._dexec(node.filtering)
+        if isinstance(filt, _Dist):
+            filt_stacked = filt.stacked
+            filt_symbols = filt.symbols
+        else:
+            batches = list(filt.stream)
+            if not batches:
+                return None
+            host = concat_batches(batches)
+            filt_stacked = stack_batches(
+                [host] + [None] * (self.wm.n - 1), self.wm
+            )
+            filt_symbols = filt.symbols
+        fk_name = node.filtering_key.name
+        fk = next(i for i, s in enumerate(filt_symbols) if s.name == fk_name)
+        sk = src.channel(node.source_key.name)
+        if (
+            src.stacked.columns[sk].dictionary is not None
+            or filt_stacked.columns[fk].dictionary is not None
+            or node.filter is not None
+        ):
+            return None
+        op = SemiJoinOperator(sk, fk, [s.type for s in filt_symbols],
+                              null_aware=node.null_aware)
+        bcast = ex.broadcast(filt_stacked, self.wm)
+        cap_b = _trailing_cap(bcast)
+        # containsNull on the filtering key (computed host-side once)
+        fcol = bcast.columns[fk]
+        has_null = False
+        if fcol.valid is not None:
+            has_null = bool(
+                np.any(
+                    np.asarray(jax.device_get(bcast.mask()))
+                    & ~np.asarray(jax.device_get(fcol.valid))
+                )
+            )
+
+        def mark_step(pb: Batch, bb: Batch) -> Batch:
+            combined = _concat_keys(bb, [fk], pb, [sk])
+            return op._mark_step(pb, combined, cap_b, has_null)
+
+        out = spmd_step(self.wm, mark_step)(src.stacked, bcast)
+        return _Dist(out, src.symbols + [node.mark])
+
+    def _d_OutputNode(self, node: P.OutputNode):
+        return None  # coordinator
+
+    def _d_ExchangeNode(self, node: P.ExchangeNode):
+        return self._dexec(node.source)
+
+
+def _trailing_cap(stacked: Batch) -> int:
+    """Row capacity of a stacked [W, cap] batch (Batch.capacity would report
+    the leading worker axis)."""
+    if stacked.columns:
+        return stacked.columns[0].data.shape[-1]
+    return stacked.row_mask.shape[-1]
+
+
+def _concat_keys(build: Batch, bk, probe: Batch, pk) -> Batch:
+    """Device concat of the key columns of both sides (no dictionaries).
+    Rows with NULL keys are masked out (`=` never matches NULL) — the
+    stacked-path twin of _CombinedSortJoinBase._combined_keys."""
+    cols = []
+    bmask, pmask = build.mask(), probe.mask()
+    for cb, cp in zip(bk, pk):
+        b, p = build.columns[cb], probe.columns[cp]
+        data = jnp.concatenate([b.data, p.data.astype(b.data.dtype)])
+        cols.append(Column(data, b.type, None, None))
+        if b.valid is not None:
+            bmask = jnp.logical_and(bmask, b.valid)
+        if p.valid is not None:
+            pmask = jnp.logical_and(pmask, p.valid)
+    mask = jnp.concatenate([bmask, pmask])
+    return Batch(cols, mask)

@@ -1,0 +1,167 @@
+"""Stacked-batch SPMD utilities.
+
+A distributed batch is an ordinary Batch whose leaves carry a leading worker
+axis [W, cap], sharded over the mesh's `workers` axis.  Every per-worker
+operator step runs under shard_map with the same pure step function the local
+engine jits — the reference's "same operator code on every worker task"
+property (SqlTaskExecution), realized as SPMD.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.ops.common import next_pow2
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """jax.shard_map across API versions (check_rep -> check_vma rename)."""
+    from jax import shard_map
+
+    for kw in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+        except TypeError:
+            continue
+    raise RuntimeError("no compatible shard_map signature")
+
+
+class WorkerMesh:
+    """The engine's view of the device mesh (reference role: the worker set
+    managed by DiscoveryNodeManager / NodeScheduler)."""
+
+    def __init__(self, devices: Optional[Sequence] = None, n_workers: Optional[int] = None):
+        devs = list(devices if devices is not None else jax.devices())
+        if n_workers is not None:
+            devs = devs[:n_workers]
+        self.devices = devs
+        self.mesh = Mesh(np.array(devs), ("workers",))
+        self.n = len(devs)
+
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P("workers"))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def _pad_host(col_data: np.ndarray, cap: int, fill=0) -> np.ndarray:
+    if col_data.shape[0] == cap:
+        return col_data
+    pad = np.full((cap - col_data.shape[0],) + col_data.shape[1:], fill, dtype=col_data.dtype)
+    return np.concatenate([col_data, pad])
+
+
+def stack_batches(batches: Sequence[Optional[Batch]], wm: WorkerMesh, cap: Optional[int] = None) -> Batch:
+    """Stack one host Batch per worker (None = empty) into a sharded [W, cap]
+    stacked batch.  Dictionaries are unioned so codes are comparable across
+    workers (exchange serde role)."""
+    from trino_tpu.columnar.batch import concat_batches
+    from trino_tpu.columnar.dictionary import union_many
+
+    real = [b for b in batches if b is not None and b.width]
+    assert real, "stack_batches needs at least one non-empty batch"
+    width = real[0].width
+    types = [c.type for c in real[0].columns]
+    cap = cap or next_pow2(max(b.capacity for b in real), floor=1)
+
+    # union dictionaries per channel
+    dicts_per_ch = []
+    tables_per_ch = []
+    for ch in range(width):
+        dicts = [
+            (b.columns[ch].dictionary if b is not None and b.width else None)
+            for b in batches
+        ]
+        if any(d is not None for d in dicts):
+            # empty workers have no dictionary; give them the first real one
+            # (their slots are dead rows, codes never read)
+            fallback = next(d for d in dicts if d is not None)
+            d, tables = union_many([d if d is not None else fallback for d in dicts])
+        else:
+            d, tables = None, [None] * len(batches)
+        dicts_per_ch.append(d)
+        tables_per_ch.append(tables)
+
+    cols = []
+    for ch in range(width):
+        datas, valids = [], []
+        any_valid = any(
+            b is not None and b.width and b.columns[ch].valid is not None for b in batches
+        )
+        for wi, b in enumerate(batches):
+            if b is None or not b.width:
+                datas.append(np.zeros(cap, dtype=types[ch].np_dtype))
+                valids.append(np.zeros(cap, dtype=bool))
+                continue
+            c = b.columns[ch]
+            data = np.asarray(c.data)
+            table = tables_per_ch[ch][wi]
+            if table is not None:
+                data = np.asarray(table)[data.astype(np.int64)]
+            datas.append(_pad_host(data, cap))
+            v = (
+                np.asarray(c.valid)
+                if c.valid is not None
+                else np.ones(data.shape[0], dtype=bool)
+            )
+            valids.append(_pad_host(v, cap))
+        stacked = np.stack(datas)
+        valid = np.stack(valids) if any_valid else None
+        cols.append(Column(stacked, types[ch], valid, dicts_per_ch[ch]))
+    masks = []
+    for b in batches:
+        if b is None or not b.width:
+            masks.append(np.zeros(cap, dtype=bool))
+        else:
+            masks.append(_pad_host(np.asarray(b.mask()), cap, fill=False))
+    mask = np.stack(masks)
+    out = Batch(cols, mask)
+    return jax.device_put(out, wm.sharding())
+
+
+def unstack_batch(stacked: Batch) -> Batch:
+    """[W, cap] stacked batch -> one flat host Batch [W*cap] (the gather-to-
+    coordinator exchange; reference: final stage output buffer read)."""
+    cols = []
+    for c in stacked.columns:
+        data = np.asarray(c.data).reshape(-1)
+        valid = None if c.valid is None else np.asarray(c.valid).reshape(-1)
+        cols.append(Column(data, c.type, valid, c.dictionary))
+    mask = np.asarray(stacked.mask()).reshape(-1)
+    return Batch(cols, mask)
+
+
+def spmd_step(wm: WorkerMesh, step: Callable, out_replicated: bool = False):
+    """Lift a per-worker pure Batch step into a jitted SPMD program.
+
+    `step` sees a worker-local Batch (no leading axis) and returns one; the
+    wrapper maps it over the mesh with shard_map, squeezing the local [1, cap]
+    shard view to [cap]."""
+
+    def local(*args):
+        squeezed = jax.tree.map(lambda x: x[0], list(args))
+        out = step(*squeezed)
+        return jax.tree.map(lambda x: x[None], out)
+
+    inner = shard_map_compat(
+        local, wm.mesh, P("workers"), P() if out_replicated else P("workers")
+    )
+    return jax.jit(inner)
+
+
+def spmd_collective_step(wm: WorkerMesh, step: Callable, out_replicated: bool = False):
+    """Like spmd_step but `step` may use collectives over axis name
+    'workers' (all_to_all / all_gather / psum); the local shard view keeps
+    its leading axis of 1 so collective outputs shape naturally."""
+    inner = shard_map_compat(
+        step, wm.mesh, P("workers"), P() if out_replicated else P("workers")
+    )
+    return jax.jit(inner)

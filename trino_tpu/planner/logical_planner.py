@@ -86,13 +86,34 @@ class LogicalPlanner:
                 if sym is None and isinstance(item.expr, ast.NumberLiteral):
                     sym = rp.fields[int(item.expr.text) - 1].symbol
                 if sym is None:
-                    e = ExprAnalyzer(scope).analyze(item.expr)
+                    try:
+                        e = ExprAnalyzer(scope).analyze(item.expr)
+                    except AnalysisError:
+                        e = None
                     if isinstance(e, SymbolRef):
                         sym = P.Symbol(e.name, e.type)
-                    else:
-                        raise AnalysisError(
-                            "ORDER BY expression must be an output column here"
-                        )
+                if (
+                    sym is None
+                    and isinstance(item.expr, ast.Identifier)
+                    and len(item.expr.parts) >= 2
+                ):
+                    # qualified ref (dt.d_year) whose qualifier the output
+                    # scope no longer tracks: accept only when an output item
+                    # carries the same source alias + display name (propagated
+                    # by _plan_select_items); never bind a bare-name match to
+                    # a different table's column
+                    qual, name = item.expr.parts[-2], item.expr.parts[-1]
+                    matches = [
+                        f.symbol for f, n in zip(rp.fields, names)
+                        if n == name and f.alias == qual
+                    ]
+                    if len(matches) == 1:
+                        sym = matches[0]
+                if sym is None:
+                    raise AnalysisError(
+                        "ORDER BY expression must be an output column here: "
+                        f"{item.expr!r}"
+                    )
                 nf = item.nulls_first
                 if nf is None:
                     nf = not item.ascending  # reference default: NULLS LAST asc, FIRST desc
@@ -303,7 +324,8 @@ class LogicalPlanner:
         fields = []
         names = []
         graft = _SubqueryGrafter(self, rp, outer, ctes)
-        an = ExprAnalyzer(scope, on_subquery=graft)
+        windows = _WindowExtractor(self, scope)
+        an = ExprAnalyzer(scope, on_subquery=graft, hook=windows.hook)
         for item in spec.items:
             if isinstance(item, ast.Star):
                 for f in rp.fields:
@@ -317,10 +339,13 @@ class LogicalPlanner:
             name = item.alias or _name_hint(item.expr)
             sym = self.alloc.new(name, e.type)
             assignments.append((sym, e))
-            fields.append(Field(name if item.alias else sym.name, sym))
+            fields.append(
+                Field(name if item.alias else sym.name, sym, _source_alias(item))
+            )
             names.append(name)
         rp = graft.plan  # subqueries may have grown the source plan
-        node = P.ProjectNode(rp.node, assignments)
+        node = windows.attach(rp.node, rp.fields)
+        node = P.ProjectNode(node, assignments)
         return RelationPlan(node, fields), names
 
     def _plan_aggregation(self, spec, rp, source_scope, outer, ctes, extra_keys=()):
@@ -451,7 +476,9 @@ class LogicalPlanner:
             name = item.alias or _name_hint(item.expr)
             sym = alloc.new(name, e.type)
             post_assignments.append((sym, e))
-            post_fields.append(Field(name if item.alias else sym.name, sym))
+            post_fields.append(
+                Field(name if item.alias else sym.name, sym, _source_alias(item))
+            )
             names.append(name)
 
         having_ir = None
@@ -668,6 +695,128 @@ def _subquery_spec(q: ast.Query) -> ast.QuerySpec:
     raise AnalysisError("unsupported subquery shape")
 
 
+#: window functions and their result-type rules (reference: the
+#: operator/window/* function registry)
+_WINDOW_RANK = {"row_number", "rank", "dense_rank", "ntile"}
+_WINDOW_DOUBLE = {"percent_rank", "cume_dist"}
+_WINDOW_VALUE = {"lag", "lead", "first_value", "last_value"}
+
+
+class _WindowExtractor:
+    """Collects OVER() calls during select-item translation and attaches a
+    WindowNode below the final projection (reference role: the window planning
+    in QueryPlanner.planWindowFunctions)."""
+
+    def __init__(self, planner: "LogicalPlanner", scope: Scope):
+        self.planner = planner
+        self.scope = scope
+        self.pre_assign: list = []  # [(Symbol, Expr)] computed inputs
+        self.pre_map: dict = {}
+        self.functions: list = []  # [(out Symbol, partition syms, order, fn)]
+
+    def hook(self, node: ast.Node, _an) -> Optional[Expr]:
+        if not (isinstance(node, ast.FunctionCall) and node.window is not None):
+            return None
+        return self._plan_call(node).ref()
+
+    def _pre_symbol(self, e: Expr, hint: str) -> P.Symbol:
+        k = e.key()
+        if k in self.pre_map:
+            return self.pre_map[k]
+        if isinstance(e, SymbolRef):
+            sym = P.Symbol(e.name, e.type)
+        else:
+            sym = self.planner.alloc.new(hint, e.type)
+        self.pre_map[k] = sym
+        self.pre_assign.append((sym, e))
+        return sym
+
+    def _plan_call(self, fc: ast.FunctionCall) -> P.Symbol:
+        an = ExprAnalyzer(self.scope)
+        w = fc.window
+        part = [
+            self._pre_symbol(an.analyze(p), _name_hint(p)) for p in w.partition_by
+        ]
+        order = []
+        for si in w.order_by:
+            e = an.analyze(si.expr)
+            nf = si.nulls_first
+            if nf is None:
+                nf = not si.ascending  # NULLS LAST asc / FIRST desc default
+            order.append(
+                (self._pre_symbol(e, _name_hint(si.expr)), si.ascending, nf)
+            )
+        name = fc.name
+        arg_syms: list = []
+        offset, n_buckets, default_sym = 1, 1, None
+        if name in _WINDOW_RANK or name in _WINDOW_DOUBLE:
+            if name == "ntile":
+                lit = an.analyze(fc.args[0])
+                if not isinstance(lit, Literal):
+                    raise AnalysisError("ntile bucket count must be a literal")
+                n_buckets = int(lit.value)
+            out_t = T.DOUBLE if name in _WINDOW_DOUBLE else T.BIGINT
+        elif name in _WINDOW_VALUE:
+            arg = an.analyze(fc.args[0])
+            arg_syms = [self._pre_symbol(arg, _name_hint(fc.args[0]))]
+            out_t = arg.type
+            if name in ("lag", "lead"):
+                if len(fc.args) > 1:
+                    off = an.analyze(fc.args[1])
+                    if not isinstance(off, Literal):
+                        raise AnalysisError("lag/lead offset must be a literal")
+                    offset = int(off.value)
+                if len(fc.args) > 2:
+                    default_sym = self._pre_symbol(
+                        an.analyze(fc.args[2]), "default"
+                    )
+        elif name in AGG_FUNCS or (fc.is_star and name == "count"):
+            if fc.is_star:
+                name, out_t = "count_star", T.BIGINT
+            else:
+                arg = an.analyze(fc.args[0])
+                arg_syms = [self._pre_symbol(arg, _name_hint(fc.args[0]))]
+                out_t = agg_result_type(AGG_FUNCS[name], arg.type)
+                name = AGG_FUNCS[name]
+        else:
+            raise AnalysisError(f"unknown window function {name}")
+        frame = "range" if order else "full"
+        fn = P.WindowFunction(
+            name,
+            [s.ref() for s in arg_syms],
+            frame=frame,
+            offset=offset,
+            n_buckets_expr=n_buckets,
+            default=None if default_sym is None else default_sym.ref(),
+        )
+        out = self.planner.alloc.new(fc.name, out_t)
+        self.functions.append((out, part, order, fn))
+        return out
+
+    def attach(self, node: P.PlanNode, fields) -> P.PlanNode:
+        if not self.functions:
+            return node
+        # pre-project: every source field plus computed window inputs
+        seen = {f.symbol.name for f in fields}
+        assigns = [(f.symbol, f.symbol.ref()) for f in fields]
+        for sym, e in self.pre_assign:
+            if sym.name not in seen:
+                assigns.append((sym, e))
+                seen.add(sym.name)
+        node = P.ProjectNode(node, assigns)
+        # one WindowNode per distinct (partition, order) spec
+        by_spec: dict = {}
+        for out, part, order, fn in self.functions:
+            key = (
+                tuple(s.name for s in part),
+                tuple((s.name, a, nf) for s, a, nf in order),
+            )
+            by_spec.setdefault(key, (part, order, []))[2].append((out, fn))
+        for part, order, fns in by_spec.values():
+            node = P.WindowNode(node, part, order, fns)
+        return node
+
+
 class _SubqueryGrafter:
     """on_subquery callback: plans subquery expressions against the current
     relation plan, growing it via joins (SubqueryPlanner's apply mechanism)."""
@@ -733,6 +882,15 @@ def _as_equi_pair(e: Expr, left_names, right_names):
         return (P.Symbol(a.name, a.type), P.Symbol(b.name, b.type))
     if b.name in left_names and a.name in right_names:
         return (P.Symbol(b.name, b.type), P.Symbol(a.name, a.type))
+    return None
+
+
+def _source_alias(item) -> Optional[str]:
+    """Qualifier of a plain `t.col` select item, kept on the output Field so
+    ORDER BY `t.col` can re-match it after projection."""
+    e = item.expr
+    if item.alias is None and isinstance(e, ast.Identifier) and len(e.parts) >= 2:
+        return e.parts[-2]
     return None
 
 

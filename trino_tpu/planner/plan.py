@@ -205,6 +205,41 @@ class SemiJoinNode(PlanNode):
 
 
 @dataclass
+class WindowFunction:
+    """One window function call (reference: plan/WindowNode.Function)."""
+
+    name: str
+    args: list  # [Expr] (symbol refs)
+    frame: str = "range"  # range | rows | full
+    offset: int = 1  # lag/lead
+    n_buckets_expr: object = None  # ntile bucket-count literal Expr
+    default: object = None  # lag/lead default Expr
+
+
+@dataclass
+class WindowNode(PlanNode):
+    """reference: sql/planner/plan/WindowNode.java."""
+
+    source: PlanNode
+    partition_by: list  # [Symbol]
+    order_by: list  # [(Symbol, ascending, nulls_first)]
+    functions: list  # [(Symbol, WindowFunction)]
+
+    @property
+    def outputs(self):
+        return self.source.outputs + [s for s, _ in self.functions]
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return WindowNode(
+            children[0], self.partition_by, self.order_by, self.functions
+        )
+
+
+@dataclass
 class SortNode(PlanNode):
     source: PlanNode
     orderings: list  # [(Symbol, ascending, nulls_first)]

@@ -93,6 +93,18 @@ def _prune(node: P.PlanNode, needed: set) -> P.PlanNode:
             node.filter, node.null_aware,
         )
 
+    if isinstance(node, P.WindowNode):
+        fns = [(s, f) for s, f in node.functions if s.name in needed]
+        child_needed = set(needed) & {s.name for s in node.source.outputs}
+        child_needed |= {s.name for s in node.partition_by}
+        child_needed |= {s.name for s, _, _ in node.order_by}
+        for _, f in fns:
+            child_needed |= _refs(*f.args, f.default)
+        return P.WindowNode(
+            _prune(node.source, child_needed), node.partition_by,
+            node.order_by, fns,
+        )
+
     if isinstance(node, (P.SortNode, P.TopNNode)):
         child_needed = needed | {s.name for s, _, _ in node.orderings}
         child = _prune(node.source, child_needed)

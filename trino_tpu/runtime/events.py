@@ -1,0 +1,79 @@
+"""Query event pipeline (reference: event/QueryMonitor.java ->
+eventlistener/EventListenerManager.java -> spi eventlistener plugins).
+
+Listeners receive QueryCreatedEvent / QueryCompletedEvent; failures carry the
+error.  The bundled LoggingEventListener mirrors trino-http-event-listener's
+role as the simplest sink.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class QueryCreatedEvent:
+    query_id: str
+    sql: str
+    create_time: float
+
+
+@dataclass
+class QueryCompletedEvent:
+    query_id: str
+    sql: str
+    state: str  # FINISHED | FAILED
+    create_time: float
+    end_time: float
+    rows: int = 0
+    error: Optional[str] = None
+
+    @property
+    def wall_s(self) -> float:
+        return self.end_time - self.create_time
+
+
+class EventListener:
+    def query_created(self, event: QueryCreatedEvent) -> None:  # pragma: no cover
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:  # pragma: no cover
+        pass
+
+
+class EventListenerManager:
+    def __init__(self):
+        self.listeners: list[EventListener] = []
+
+    def add(self, listener: EventListener) -> None:
+        self.listeners.append(listener)
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        for l in self.listeners:
+            try:
+                l.query_created(event)
+            except Exception:
+                pass  # listeners must not break queries
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        for l in self.listeners:
+            try:
+                l.query_completed(event)
+            except Exception:
+                pass
+
+
+class CollectingEventListener(EventListener):
+    """Test fixture (reference: testing EventsCollector)."""
+
+    def __init__(self):
+        self.created: list[QueryCreatedEvent] = []
+        self.completed: list[QueryCompletedEvent] = []
+
+    def query_created(self, e):
+        self.created.append(e)
+
+    def query_completed(self, e):
+        self.completed.append(e)

@@ -67,15 +67,27 @@ class PhysicalPlan:
 
 
 class LocalExecutionPlanner:
-    def __init__(self, catalogs: CatalogManager, target_splits: int = 4):
+    def __init__(self, catalogs: CatalogManager, target_splits: int = 4, stats=None):
         self.catalogs = catalogs
         self.target_splits = target_splits
+        self.stats = stats  # Optional[StatsCollector] for EXPLAIN ANALYZE
+        self._depth = 0
 
     def plan(self, node: P.PlanNode) -> PhysicalPlan:
         method = getattr(self, "_visit_" + type(node).__name__, None)
         if method is None:
             raise NotImplementedError(f"no local plan for {type(node).__name__}")
-        return method(node)
+        self._depth += 1
+        try:
+            out = method(node)
+        finally:
+            self._depth -= 1
+        if self.stats is not None:
+            st = self.stats.register(
+                type(node).__name__.replace("Node", ""), depth=self._depth
+            )
+            out = PhysicalPlan(self.stats.instrument(st, out.stream), out.symbols)
+        return out
 
     # -- leaves ---------------------------------------------------------------
 
@@ -86,7 +98,12 @@ class LocalExecutionPlanner:
         splits = list(connector.splits(node.handle, target_splits=self.target_splits))
 
         def stream():
+            from trino_tpu.runtime.retry import FAILURE_INJECTOR
+
             for split in splits:
+                FAILURE_INJECTOR.maybe_fail(
+                    f"scan:{node.handle.schema}.{node.handle.table}:{split.seq}"
+                )
                 op = ScanOperator(connector, split, names, types)
                 yield from op.batches()
 
@@ -214,9 +231,11 @@ class LocalExecutionPlanner:
         probe_keys = [probe.channel(l.name) for l, _ in node.criteria]
         build_keys = [build.channel(r.name) for _, r in node.criteria]
         residual = None
+        residual_key = None
         if node.filter is not None:
             combined = PhysicalPlan(iter(()), out_symbols)
             res_expr = combined.rewrite(node.filter)
+            residual_key = res_expr.key()
 
             def residual(batch: Batch, _e=res_expr):
                 return ExprCompiler(batch).filter_mask(_e)
@@ -228,6 +247,7 @@ class LocalExecutionPlanner:
             build.types(),
             probe_types=probe.types(),
             residual=residual,
+            residual_key=residual_key,
         )
         op.set_build(list(build.stream))
         return PhysicalPlan(op.process(probe.stream), out_symbols)
@@ -236,9 +256,11 @@ class LocalExecutionPlanner:
         src = self.plan(node.source)
         filt = self.plan(node.filtering)
         residual = None
+        residual_key = None
         if node.filter is not None:
             combined = PhysicalPlan(iter(()), src.symbols + filt.symbols)
             res_expr = combined.rewrite(node.filter)
+            residual_key = res_expr.key()
 
             def residual(batch: Batch, _e=res_expr):
                 return ExprCompiler(batch).filter_mask(_e)
@@ -249,9 +271,42 @@ class LocalExecutionPlanner:
             filt.types(),
             null_aware=node.null_aware,
             residual=residual,
+            residual_key=residual_key,
         )
         op.set_build(list(filt.stream))
         return PhysicalPlan(op.process(src.stream), src.symbols + [node.mark])
+
+    def _visit_WindowNode(self, node: P.WindowNode) -> PhysicalPlan:
+        from trino_tpu.ops.window import WindowOperator, WindowSpec
+
+        src = self.plan(node.source)
+        part = [src.channel(s.name) for s in node.partition_by]
+        order = [
+            SortKey(src.channel(s.name), asc, nf)
+            for s, asc, nf in node.order_by
+        ]
+        specs = []
+        for out_sym, fn in node.functions:
+            arg = None
+            if fn.args:
+                a0 = fn.args[0]
+                arg = src.channel(a0.name)
+            default_ch = None
+            if fn.default is not None:
+                default_ch = src.channel(fn.default.name)
+            specs.append(
+                WindowSpec(
+                    fn.name if fn.name != "count_star" else "count",
+                    arg,
+                    out_sym.type,
+                    offset=fn.offset,
+                    default_channel=default_ch,
+                    n_buckets=fn.n_buckets_expr or 1,
+                    frame=fn.frame,
+                )
+            )
+        op = WindowOperator(part, order, specs)
+        return PhysicalPlan(op.process(src.stream), node.outputs)
 
     # -- ordering / limiting --------------------------------------------------
 

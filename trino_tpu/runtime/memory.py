@@ -1,0 +1,86 @@
+"""Hierarchical memory accounting.
+
+Reference: lib/trino-memory-context (AggregatedMemoryContext.java — the
+operator -> driver -> pipeline -> task -> pool reservation tree) +
+memory/MemoryPool.java:44.  Device HBM is the scarce resource here; batches
+report their device footprint (capacity x dtype width, masks included) and
+blocking operators reserve before materializing.  Exceeding the pool raises
+ExceededMemoryLimitException — the hook where partition-wave fallback (the
+spill analog, SURVEY.md §5.7) takes over.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class ExceededMemoryLimitException(RuntimeError):
+    pass
+
+
+def batch_bytes(batch) -> int:
+    """Device footprint of a Batch (columns + validity + row mask)."""
+    total = 0
+    for c in batch.columns:
+        total += c.data.size * c.data.dtype.itemsize
+        if c.valid is not None:
+            total += c.valid.size
+    if batch.row_mask is not None:
+        total += np.asarray(batch.row_mask).size
+    return int(total)
+
+
+class MemoryContext:
+    """One node in the reservation tree; reservations aggregate to the root
+    pool (reference: AggregatedMemoryContext.newLocalMemoryContext)."""
+
+    def __init__(self, parent: Optional["MemoryContext"] = None, name: str = "root",
+                 limit_bytes: int = 0):
+        self.parent = parent
+        self.name = name
+        self.limit_bytes = limit_bytes  # 0 = unlimited (checked at this node)
+        self.reserved = 0
+        self.peak = 0
+
+    def child(self, name: str) -> "MemoryContext":
+        return MemoryContext(self, name)
+
+    def set_bytes(self, n: int) -> None:
+        delta = n - self.reserved
+        self.add_bytes(delta)
+
+    def add_bytes(self, delta: int) -> None:
+        visited = []
+        node = self
+        try:
+            while node is not None:
+                node.reserved += delta
+                visited.append(node)
+                if node.limit_bytes and node.reserved > node.limit_bytes:
+                    raise ExceededMemoryLimitException(
+                        f"memory limit exceeded at {node.name}: "
+                        f"{node.reserved} > {node.limit_bytes} bytes"
+                    )
+                node.peak = max(node.peak, node.reserved)
+                node = node.parent
+        except ExceededMemoryLimitException:
+            for v in visited:  # undo so accounting stays consistent
+                v.reserved -= delta
+            raise
+
+    def close(self) -> None:
+        self.add_bytes(-self.reserved)
+
+
+class MemoryPool:
+    """Per-query (or per-process) pool root (reference: MemoryPool.java:44)."""
+
+    def __init__(self, limit_bytes: int = 0):
+        self.root = MemoryContext(None, "pool", limit_bytes)
+
+    def query_context(self, query_id: str, limit_bytes: int = 0) -> MemoryContext:
+        ctx = self.root.child(f"query:{query_id}")
+        ctx.limit_bytes = limit_bytes
+        return ctx

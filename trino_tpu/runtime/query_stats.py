@@ -1,0 +1,71 @@
+"""Per-operator execution statistics (EXPLAIN ANALYZE backing).
+
+Reference roles: operator/OperatorStats.java + OperationTimer (per-call
+timing recorded from the Driver loop, Driver.java:298,340) and the
+planprinter rendering of EXPLAIN ANALYZE.  Host-side generator wrappers time
+each operator's batch production; device work is async under XLA dispatch, so
+wall times are *inclusive* of the subtree's dispatch (noted in the rendering)
+— per-kernel device times come from the XLA profiler, not this layer.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class OperatorStats:
+    node_id: int
+    name: str
+    detail: str = ""
+    output_rows: int = 0
+    output_batches: int = 0
+    wall_s: float = 0.0  # inclusive of upstream dispatch
+    depth: int = 0
+
+    def line(self) -> str:
+        pad = "  " * self.depth
+        return (
+            f"{pad}{self.name}[{self.detail}] rows={self.output_rows} "
+            f"batches={self.output_batches} wall={self.wall_s * 1e3:.1f}ms"
+        )
+
+
+class StatsCollector:
+    def __init__(self):
+        self.operators: list[OperatorStats] = []
+        self._next_id = 0
+
+    def register(self, name: str, detail: str = "", depth: int = 0) -> OperatorStats:
+        st = OperatorStats(self._next_id, name, detail, depth=depth)
+        self._next_id += 1
+        self.operators.append(st)
+        return st
+
+    def instrument(self, st: OperatorStats, stream):
+        """Wrap a batch stream, recording rows/batches/wall per pull."""
+
+        def gen():
+            it = iter(stream)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    st.wall_s += time.perf_counter() - t0
+                    return
+                st.wall_s += time.perf_counter() - t0
+                st.output_batches += 1
+                st.output_rows += b.num_rows_host()
+                yield b
+
+        return gen()
+
+    def render(self) -> str:
+        # operators register in post-order (children first); reverse gives a
+        # root-first rendering like the reference plan printer
+        lines = ["Query execution statistics (wall = inclusive of subtree):"]
+        for st in reversed(self.operators):
+            lines.append(st.line())
+        return "\n".join(lines)

@@ -1,0 +1,65 @@
+"""Failure injection + query retry.
+
+Reference: execution/FailureInjector.java:62,125 (injected task failures for
+fault-tolerance tests) and RetryPolicy (operator/RetryPolicy.java) — NONE
+(fail the query) vs QUERY (transparent re-execution).  Task-level retry with
+spooled intermediates (the Tardigrade scheduler) follows once stages persist
+their outputs; the injection/classification machinery here is shared.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Optional
+
+
+class InjectedFailure(RuntimeError):
+    """Retryable injected fault (reference: TASK_FAILURE injection type)."""
+
+
+@dataclass
+class _Injection:
+    match: str  # substring of the injection point name
+    error: type
+    remaining: int  # fire this many times, then stop
+
+
+class FailureInjector:
+    """Named injection points call `maybe_fail(point)`; tests arm failures."""
+
+    def __init__(self):
+        self._injections: list[_Injection] = []
+
+    def inject(self, match: str, times: int = 1, error: type = InjectedFailure):
+        self._injections.append(_Injection(match, error, times))
+
+    def maybe_fail(self, point: str) -> None:
+        for inj in self._injections:
+            if inj.remaining > 0 and inj.match in point:
+                inj.remaining -= 1
+                raise inj.error(f"injected failure at {point}")
+
+    def clear(self) -> None:
+        self._injections.clear()
+
+
+#: process-wide injector consulted by execution hooks (tests arm it)
+FAILURE_INJECTOR = FailureInjector()
+
+RETRYABLE = (InjectedFailure, ConnectionError, TimeoutError)
+
+
+def execute_with_retry(fn, retry_policy: str = "NONE", max_attempts: int = 4):
+    """Run fn() under the given retry policy (reference:
+    SqlQueryExecution's retry handling for retry_policy=QUERY)."""
+    if retry_policy == "NONE":
+        return fn()
+    assert retry_policy == "QUERY", retry_policy
+    last: Optional[BaseException] = None
+    for _ in range(max_attempts):
+        try:
+            return fn()
+        except RETRYABLE as e:
+            last = e
+    raise last

@@ -49,9 +49,19 @@ class LocalQueryRunner:
         schema: str = "tiny",
         target_splits: int = 4,
     ):
+        from trino_tpu.runtime.events import EventListenerManager
+        from trino_tpu.runtime.session import SessionProperties
+
         self.catalogs = catalogs or default_catalogs()
         self.session = Session(catalog, schema)
-        self.target_splits = target_splits
+        self.properties = SessionProperties()
+        self.properties.set("target_splits", target_splits)
+        self.events = EventListenerManager()
+        self._query_ids = __import__("itertools").count(1)
+
+    @property
+    def target_splits(self) -> int:
+        return self.properties.get("target_splits")
 
     # -- planning -------------------------------------------------------------
 
@@ -59,7 +69,10 @@ class LocalQueryRunner:
         stmt = parse_statement(sql)
         if not isinstance(stmt, ast.SelectStatement):
             raise NotImplementedError(f"statement: {type(stmt).__name__}")
-        plan = LogicalPlanner(self.catalogs, self.session).plan(stmt.query)
+        return self.plan_query(stmt.query)
+
+    def plan_query(self, query: ast.Query) -> OutputNode:
+        plan = LogicalPlanner(self.catalogs, self.session).plan(query)
         return self.optimize(plan)
 
     def optimize(self, plan: OutputNode) -> OutputNode:
@@ -73,9 +86,43 @@ class LocalQueryRunner:
     # -- execution ------------------------------------------------------------
 
     def execute(self, sql: str) -> MaterializedResult:
-        plan = self.create_plan(sql)
+        """Execute any supported statement (reference role: the statement
+        dispatch of LocalQueryRunner.executeInternal + DDL *Task executors
+        under execution/), with query events and retry-policy handling."""
+        import time as _time
+
+        from trino_tpu.runtime.events import QueryCompletedEvent, QueryCreatedEvent
+        from trino_tpu.runtime.retry import execute_with_retry
+
+        stmt = parse_statement(sql)
+        m = getattr(self, "_exec_" + type(stmt).__name__, None)
+        if m is None:
+            raise NotImplementedError(f"statement: {type(stmt).__name__}")
+        qid = f"query_{next(self._query_ids)}"
+        t0 = _time.time()
+        self.events.query_created(QueryCreatedEvent(qid, sql, t0))
+        try:
+            result = execute_with_retry(
+                lambda: m(stmt), self.properties.get("retry_policy")
+            )
+        except BaseException as e:
+            self.events.query_completed(
+                QueryCompletedEvent(
+                    qid, sql, "FAILED", t0, _time.time(), error=str(e)
+                )
+            )
+            raise
+        self.events.query_completed(
+            QueryCompletedEvent(
+                qid, sql, "FINISHED", t0, _time.time(), rows=result.row_count
+            )
+        )
+        return result
+
+    def _run_query(self, query: ast.Query, stats=None) -> MaterializedResult:
+        plan = self.plan_query(query)
         physical = LocalExecutionPlanner(
-            self.catalogs, target_splits=self.target_splits
+            self.catalogs, target_splits=self.target_splits, stats=stats
         ).plan(plan)
         rows = []
         for batch in physical.stream:
@@ -83,3 +130,179 @@ class LocalQueryRunner:
         return MaterializedResult(
             list(plan.column_names), rows, [s.type for s in plan.symbols]
         )
+
+    def _exec_SelectStatement(self, stmt: ast.SelectStatement) -> MaterializedResult:
+        return self._run_query(stmt.query)
+
+    # -- EXPLAIN --------------------------------------------------------------
+
+    def _exec_ExplainStatement(self, stmt: ast.ExplainStatement) -> MaterializedResult:
+        from trino_tpu import types as T
+
+        inner = stmt.statement
+        if not isinstance(inner, ast.SelectStatement):
+            raise NotImplementedError("EXPLAIN supports queries only")
+        if stmt.analyze:
+            from trino_tpu.runtime.query_stats import StatsCollector
+
+            collector = StatsCollector()
+            self._run_query(inner.query, stats=collector)
+            text = collector.render()
+        else:
+            text = plan_text(self.plan_query(inner.query))
+        return MaterializedResult(
+            ["Query Plan"], [(line,) for line in text.splitlines()], [T.VARCHAR]
+        )
+
+    # -- session statements ---------------------------------------------------
+
+    def _exec_UseStatement(self, stmt: ast.UseStatement) -> MaterializedResult:
+        if stmt.catalog:
+            self.catalogs.get(stmt.catalog)  # validate
+            self.session = Session(stmt.catalog, stmt.schema)
+        else:
+            self.session = Session(self.session.catalog, stmt.schema)
+        return _ok("USE")
+
+    def _exec_SetSession(self, stmt: ast.SetSession) -> MaterializedResult:
+        from trino_tpu.planner.analyzer import ExprAnalyzer, Scope
+        from trino_tpu.expr.ir import Literal
+
+        e = ExprAnalyzer(Scope([])).analyze(stmt.value)
+        if not isinstance(e, Literal):
+            raise ValueError("SET SESSION value must be a literal")
+        value = e.value
+        if e.type.name.startswith("varchar"):
+            value = str(value)
+        self.properties.set(stmt.name, value)
+        return _ok("SET SESSION")
+
+    # -- SHOW / DESCRIBE ------------------------------------------------------
+
+    def _exec_ShowStatement(self, stmt: ast.ShowStatement) -> MaterializedResult:
+        from trino_tpu import types as T
+
+        if stmt.what == "catalogs":
+            return MaterializedResult(
+                ["Catalog"], [(n,) for n in sorted(self.catalogs.names())], [T.VARCHAR]
+            )
+        if stmt.what == "schemas":
+            cat = stmt.target[0] if stmt.target else self.session.catalog
+            conn = self.catalogs.get(cat)
+            return MaterializedResult(
+                ["Schema"],
+                [(s,) for s in sorted(conn.metadata().list_schemas())],
+                [T.VARCHAR],
+            )
+        if stmt.what == "tables":
+            if len(stmt.target) == 2:
+                cat, schema = stmt.target
+            elif len(stmt.target) == 1:
+                cat, schema = self.session.catalog, stmt.target[0]
+            else:
+                cat, schema = self.session.catalog, self.session.schema
+            conn = self.catalogs.get(cat)
+            return MaterializedResult(
+                ["Table"],
+                [(t,) for t in sorted(conn.metadata().list_tables(schema))],
+                [T.VARCHAR],
+            )
+        if stmt.what == "columns":
+            cat, schema, table = self._resolve_table(stmt.target)
+            meta = self.catalogs.get(cat).metadata().table_metadata(schema, table)
+            return MaterializedResult(
+                ["Column", "Type"],
+                [(c.name, c.type.name) for c in meta.columns],
+                [T.VARCHAR, T.VARCHAR],
+            )
+        raise NotImplementedError(f"SHOW {stmt.what}")
+
+    def _resolve_table(self, parts: tuple) -> tuple:
+        if len(parts) == 3:
+            return parts
+        if len(parts) == 2:
+            return (self.session.catalog, parts[0], parts[1])
+        return (self.session.catalog, self.session.schema, parts[0])
+
+    # -- DDL / DML (reference: execution/CreateTableTask, DropTableTask,
+    # InsertStatement via TableWriterOperator -> ConnectorPageSink) ----------
+
+    def _exec_CreateTable(self, stmt: ast.CreateTable) -> MaterializedResult:
+        from trino_tpu import types as T
+        from trino_tpu.connectors.api import ColumnMeta
+
+        cat, schema, table = self._resolve_table(stmt.name)
+        conn = self.catalogs.get(cat)
+        if stmt.if_not_exists and table in conn.metadata().list_tables(schema):
+            return _ok("CREATE TABLE")
+        cols = [ColumnMeta(n, T.parse_type(t)) for n, t in stmt.columns]
+        conn.create_table(schema, table, cols)
+        return _ok("CREATE TABLE")
+
+    def _exec_CreateTableAs(self, stmt: ast.CreateTableAs) -> MaterializedResult:
+        from trino_tpu.connectors.api import ColumnMeta, TableHandle
+
+        cat, schema, table = self._resolve_table(stmt.name)
+        conn = self.catalogs.get(cat)
+        if stmt.if_not_exists and table in conn.metadata().list_tables(schema):
+            return _ok("CREATE TABLE AS")
+        result = self._run_query(stmt.query)
+        cols = [
+            ColumnMeta(n, t) for n, t in zip(result.column_names, result.types)
+        ]
+        conn.create_table(schema, table, cols)
+        self._write_rows(conn, TableHandle(cat, schema, table), result)
+        return MaterializedResult(["rows"], [(result.row_count,)], [])
+
+    def _exec_InsertStatement(self, stmt: ast.InsertStatement) -> MaterializedResult:
+        from trino_tpu.connectors.api import TableHandle
+
+        cat, schema, table = self._resolve_table(stmt.name)
+        conn = self.catalogs.get(cat)
+        meta = conn.metadata().table_metadata(schema, table)
+        result = self._run_query(stmt.query)
+        if stmt.columns:
+            # align provided columns to table order, nulls elsewhere
+            name_to_idx = {n: i for i, n in enumerate(stmt.columns)}
+            reordered = []
+            for r in result.rows:
+                row = []
+                for c in meta.columns:
+                    i = name_to_idx.get(c.name)
+                    row.append(None if i is None else r[i])
+                reordered.append(tuple(row))
+            result = MaterializedResult(
+                [c.name for c in meta.columns], reordered,
+                [c.type for c in meta.columns],
+            )
+        self._write_rows(conn, TableHandle(cat, schema, table), result)
+        return MaterializedResult(["rows"], [(result.row_count,)], [])
+
+    def _exec_DropTable(self, stmt: ast.DropTable) -> MaterializedResult:
+        from trino_tpu.connectors.api import TableHandle
+
+        cat, schema, table = self._resolve_table(stmt.name)
+        conn = self.catalogs.get(cat)
+        if stmt.if_exists and table not in conn.metadata().list_tables(schema):
+            return _ok("DROP TABLE")
+        conn.drop_table(TableHandle(cat, schema, table))
+        return _ok("DROP TABLE")
+
+    def _write_rows(self, conn, handle, result: MaterializedResult) -> None:
+        from trino_tpu.columnar.builders import column_from_values
+        from trino_tpu.connectors.api import ColumnData
+
+        meta = conn.metadata().table_metadata(handle.schema, handle.table)
+        sink = conn.page_sink(
+            handle, [c.name for c in meta.columns], [c.type for c in meta.columns]
+        )
+        if result.rows:
+            cols = []
+            for i, cm in enumerate(meta.columns):
+                col = column_from_values([r[i] for r in result.rows], cm.type)
+                cols.append(ColumnData(col.data, col.valid, col.dictionary))
+            sink.append(cols)
+
+
+def _ok(tag: str) -> MaterializedResult:
+    return MaterializedResult([tag], [(True,)], [])

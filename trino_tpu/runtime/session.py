@@ -1,0 +1,80 @@
+"""Session properties (reference: Session.java + SystemSessionProperties.java
+— the ~200-knob session-level configuration surface, reduced to the knobs
+this engine actually reads).  SET SESSION mutates these per connection."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class PropertyMetadata:
+    name: str
+    description: str
+    type: type
+    default: Any
+
+
+SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
+    p.name: p
+    for p in [
+        PropertyMetadata(
+            "target_splits", "connector splits per table scan", int, 4
+        ),
+        PropertyMetadata(
+            "page_rows", "max rows per scan page (device batch size)", int, 1 << 17
+        ),
+        PropertyMetadata(
+            "broadcast_join_rows",
+            "build sides estimated at or below this are broadcast",
+            int,
+            50_000,
+        ),
+        PropertyMetadata(
+            "join_distribution_type",
+            "AUTOMATIC | BROADCAST | PARTITIONED",
+            str,
+            "AUTOMATIC",
+        ),
+        PropertyMetadata(
+            "agg_fold_batches",
+            "partial-aggregation states folded after this many batches",
+            int,
+            8,
+        ),
+        PropertyMetadata(
+            "query_max_memory_bytes",
+            "per-query device memory budget (0 = unlimited)",
+            int,
+            0,
+        ),
+        PropertyMetadata(
+            "retry_policy", "NONE | QUERY (transparent re-execution)", str, "NONE"
+        ),
+    ]
+}
+
+
+class SessionProperties:
+    def __init__(self):
+        self._values: dict[str, Any] = {}
+
+    def get(self, name: str):
+        meta = SESSION_PROPERTIES.get(name)
+        if meta is None:
+            raise KeyError(f"unknown session property: {name}")
+        return self._values.get(name, meta.default)
+
+    def set(self, name: str, value) -> None:
+        meta = SESSION_PROPERTIES.get(name)
+        if meta is None:
+            raise KeyError(f"unknown session property: {name}")
+        try:
+            self._values[name] = meta.type(value)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"bad value for {name}: {value!r}") from e
+
+    def items(self):
+        for name, meta in SESSION_PROPERTIES.items():
+            yield name, self._values.get(name, meta.default), meta

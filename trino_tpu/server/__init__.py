@@ -1,0 +1,9 @@
+"""Coordinator HTTP server + client protocol.
+
+Reference layer: core/trino-main/.../server + server/protocol — the
+`/v1/statement` REST protocol (QueuedStatementResource.java:102,
+ExecutingStatementResource.java:73): POST submits SQL, the client follows
+`nextUri` long-polls until FINISHED, receiving paged JSON rows.
+"""
+
+from trino_tpu.server.coordinator import CoordinatorServer

@@ -1,0 +1,192 @@
+"""Coordinator HTTP server.
+
+Reference roles: dispatcher/QueuedStatementResource.java:157 (POST
+/v1/statement), server/protocol/ExecutingStatementResource.java:73 (paged
+GET), DispatchManager (query registry/lifecycle), QueryStateMachine states
+QUEUED -> RUNNING -> FINISHED/FAILED (execution/QueryState.java:26-58).
+
+Implementation: stdlib ThreadingHTTPServer; each query runs on a worker
+thread against the shared LocalQueryRunner (execution itself fans out on the
+device); results are paged back RESULT_PAGE_ROWS at a time via nextUri
+tokens, and a client that stops following nextUri leaves the query to a
+DELETE (cancel) or the finished-result GC, like the reference's token-acked
+paging.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from trino_tpu.server import protocol
+
+RESULT_PAGE_ROWS = 4096
+
+
+class _Query:
+    def __init__(self, qid: str, sql: str):
+        self.id = qid
+        self.sql = sql
+        self.state = "QUEUED"
+        self.result = None
+        self.error: Optional[dict] = None
+        self.done = threading.Event()
+
+    def run(self, runner) -> None:
+        self.state = "RUNNING"
+        try:
+            self.result = runner.execute(self.sql)
+            self.state = "FINISHED"
+        except Exception as e:  # surface as protocol error object
+            self.state = "FAILED"
+            self.error = {
+                "message": str(e),
+                "errorName": type(e).__name__,
+                "stack": traceback.format_exc(),
+            }
+        finally:
+            self.done.set()
+
+    def columns_json(self) -> list:
+        r = self.result
+        return [
+            {"name": n, "type": (t.name if t is not None else "unknown")}
+            for n, t in zip(r.column_names, r.types or [None] * len(r.column_names))
+        ]
+
+
+class CoordinatorServer:
+    """serve() blocks; start()/shutdown() for embedded use (tests, CLI)."""
+
+    def __init__(self, runner=None, host: str = "127.0.0.1", port: int = 8080):
+        from trino_tpu.runtime.runner import LocalQueryRunner
+
+        self.runner = runner or LocalQueryRunner()
+        self.host = host
+        self.port = port
+        self._queries: dict[str, _Query] = {}
+        self._qid = itertools.count(1)
+        self._lock = threading.Lock()  # serializes engine execution
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -- query lifecycle ------------------------------------------------------
+
+    def submit(self, sql: str) -> _Query:
+        q = _Query(f"q_{next(self._qid)}", sql)
+        self._queries[q.id] = q
+
+        def work():
+            # one query at a time through the engine (the TaskExecutor's
+            # role of bounding concurrent device work; the chip is the
+            # shared resource here)
+            with self._lock:
+                q.run(self.runner)
+
+        threading.Thread(target=work, daemon=True).start()
+        return q
+
+    def query(self, qid: str) -> Optional[_Query]:
+        return self._queries.get(qid)
+
+    # -- HTTP -----------------------------------------------------------------
+
+    def _handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # silence default stderr noise
+                pass
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/v1/statement":
+                    return self._send(404, {"error": {"message": "not found"}})
+                n = int(self.headers.get("Content-Length", 0))
+                sql = self.rfile.read(n).decode()
+                q = server.submit(sql)
+                self._send(
+                    200,
+                    protocol.query_results(
+                        q.id,
+                        next_uri=f"/v1/statement/executing/{q.id}/0",
+                        state=q.state,
+                    ),
+                )
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                # /v1/statement/executing/{id}/{token}
+                if len(parts) != 5 or parts[:3] != ["v1", "statement", "executing"]:
+                    return self._send(404, {"error": {"message": "not found"}})
+                qid, token = parts[3], int(parts[4])
+                q = server.query(qid)
+                if q is None:
+                    return self._send(404, {"error": {"message": "no such query"}})
+                # long-poll up to 1s like the reference's async responses
+                q.done.wait(timeout=1.0)
+                if q.state == "FAILED":
+                    return self._send(
+                        200, protocol.query_results(q.id, state="FAILED", error=q.error)
+                    )
+                if not q.done.is_set():
+                    return self._send(
+                        200,
+                        protocol.query_results(
+                            q.id,
+                            next_uri=f"/v1/statement/executing/{qid}/{token}",
+                            state=q.state,
+                        ),
+                    )
+                rows = q.result.rows
+                page = rows[token * RESULT_PAGE_ROWS : (token + 1) * RESULT_PAGE_ROWS]
+                has_more = (token + 1) * RESULT_PAGE_ROWS < len(rows)
+                self._send(
+                    200,
+                    protocol.query_results(
+                        q.id,
+                        columns=q.columns_json(),
+                        data=protocol.encode_rows(page),
+                        next_uri=(
+                            f"/v1/statement/executing/{qid}/{token + 1}"
+                            if has_more
+                            else None
+                        ),
+                        state="FINISHED",
+                        stats={"rows": len(rows)},
+                    ),
+                )
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
+                    server._queries.pop(parts[3], None)
+                    return self._send(204, {})
+                self._send(404, {"error": {"message": "not found"}})
+
+        return Handler
+
+    def start(self) -> None:
+        self._httpd = ThreadingHTTPServer((self.host, self.port), self._handler())
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+    def serve(self) -> None:
+        self._httpd = ThreadingHTTPServer((self.host, self.port), self._handler())
+        print(f"trino-tpu coordinator listening on {self.host}:{self.port}")
+        self._httpd.serve_forever()
