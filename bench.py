@@ -25,9 +25,12 @@ import time
 import jax
 
 jax.config.update("jax_enable_x64", True)
-jax.config.update("jax_compilation_cache_dir", "/tmp/trino_tpu_xla_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+if jax.default_backend() != "cpu":
+    # persistent compile cache only on the accelerator: CPU AOT entries are
+    # machine-feature-sensitive (cross-machine reload risks SIGILL)
+    jax.config.update("jax_compilation_cache_dir", "/tmp/trino_tpu_xla_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 
 def _engine_time(runner, sql: str, runs: int) -> float:

@@ -191,10 +191,80 @@ class AggregationOperator:
 
     # -- the jitted kernel ---------------------------------------------------
 
+    #: group-domain cap for the sort-free direct path (positional segments)
+    DIRECT_GROUP_LIMIT = 4096
+
+    def _direct_group_info(self, batch: Batch):
+        """(sizes, prod) when every group key is a small-domain code column
+        (dictionary or boolean) — the BigintGroupByHash analog: group id is
+        the mixed-radix code index, no sort needed (reference:
+        operator/BigintGroupByHash.java's dense small-domain fast path)."""
+        sizes = []
+        for ch in self.group_channels:
+            c = batch.columns[ch]
+            if c.dictionary is not None:
+                n = len(c.dictionary.values)
+            elif c.type is T.BOOLEAN:
+                n = 2
+            else:
+                return None
+            sizes.append(n + 1)  # one extra slot for NULL
+        prod = 1
+        for s in sizes:
+            prod *= s
+        if not 0 < prod <= self.DIRECT_GROUP_LIMIT:
+            return None
+        return sizes, prod
+
+    def _direct_reduce(self, batch: Batch, sizes, prod: int) -> Batch:
+        gch = self.group_channels
+        cap = batch.capacity
+        live = batch.mask()
+        gid = jnp.zeros(cap, dtype=jnp.int64)
+        for ch, size in zip(gch, sizes):
+            c = batch.columns[ch]
+            code = c.data.astype(jnp.int64)
+            if c.valid is not None:
+                code = jnp.where(c.valid, code, size - 1)
+            gid = gid * size + jnp.clip(code, 0, size - 1)
+        gid = jnp.where(live, gid, prod)
+        nseg = prod + 1
+        occupancy = jax.ops.segment_sum(live.astype(jnp.int64), gid, nseg)[:prod]
+        out_live = occupancy > 0
+        # decode positional slot -> group key codes
+        idx = jnp.arange(prod, dtype=jnp.int64)
+        divs = []
+        d = 1
+        for size in reversed(sizes):
+            divs.append(d)
+            d *= size
+        divs.reverse()
+        cols: list[Column] = []
+        for (ch, size), div in zip(zip(gch, sizes), divs):
+            c = batch.columns[ch]
+            code = (idx // div) % size
+            valid = None
+            if c.valid is not None:
+                valid = code < (size - 1)
+            cols.append(
+                Column(code.astype(c.data.dtype), c.type, valid, c.dictionary)
+            )
+        perm = jnp.arange(cap, dtype=jnp.int64)
+        for spec in self.aggregates:
+            state_cols = self._reduce_one(batch, spec, perm, live, gid, nseg, prod)
+            if self.mode in ("partial", "merge"):
+                cols.extend(state_cols)
+            else:
+                cols.append(_finalize(spec, state_cols))
+        return Batch(cols, out_live)
+
     def _reduce_step(self, batch: Batch, out_cap: int) -> Batch:
         gch = self.group_channels
         if not gch:
             return self._global_reduce(batch)
+        direct = self._direct_group_info(batch)
+        if direct is not None:
+            return self._direct_reduce(batch, *direct)
         perm = multi_key_sort_perm(batch, [SortKey(ch) for ch in gch])
         gid, ngroups, new_group = group_ids_from_sorted(batch, perm, gch)
         live = jnp.take(batch.mask(), perm, mode="clip")

@@ -72,6 +72,10 @@ class LocalExecutionPlanner:
         self.target_splits = target_splits
         self.stats = stats  # Optional[StatsCollector] for EXPLAIN ANALYZE
         self._depth = 0
+        #: symbol name -> (lo, hi) host values collected from materialized
+        #: join build sides (reference: server/DynamicFilterService.java:107 +
+        #: DynamicFilterSourceOperator — build-side ranges prune probe scans)
+        self.dynamic_filters: dict = {}
 
     def plan(self, node: P.PlanNode) -> PhysicalPlan:
         method = getattr(self, "_visit_" + type(node).__name__, None)
@@ -108,10 +112,26 @@ class LocalExecutionPlanner:
                 yield from op.batches()
 
         plan = PhysicalPlan(stream(), [s for s, _ in node.assignments])
-        if node.pushed_predicate is not None:
-            pred = plan.rewrite(node.pushed_predicate)
+        pred_expr = node.pushed_predicate
+        # dynamic filters registered by upstream join builds (ranges over this
+        # scan's output symbols) fuse into the scan's first device step
+        dyn = []
+        for s, _ in node.assignments:
+            rng = self.dynamic_filters.get(s.name)
+            if rng is not None:
+                dyn.append(_range_expr(s, *rng))
+        if dyn:
+            from trino_tpu.expr.ir import and_
+
+            pred_expr = and_(*(([pred_expr] if pred_expr is not None else []) + dyn))
+        if pred_expr is not None:
+            pred = plan.rewrite(pred_expr)
             fp = FilterProjectOperator(pred, plan.identity_projections())
             plan = PhysicalPlan(fp.process(plan.stream), plan.symbols)
+        if dyn:
+            # dynamic filters are usually very selective; compact so the
+            # smaller live set shrinks every downstream static shape
+            plan = PhysicalPlan(_compact_stream(plan.stream), plan.symbols)
         return plan
 
     def _visit_ValuesNode(self, node: P.ValuesNode) -> PhysicalPlan:
@@ -225,8 +245,17 @@ class LocalExecutionPlanner:
             )
             return PhysicalPlan(proj.process(out.stream), node.outputs)
 
-        probe = self.plan(node.left)
         build = self.plan(node.right)
+        build_batches = list(build.stream)
+        if node.kind == "inner":
+            # dynamic filtering: build-side key ranges prune the probe scan
+            # (registered before the probe subtree is planned, the
+            # DynamicFilterService ordering)
+            for lsym, rsym in node.criteria:
+                rng = _host_minmax(build_batches, build.channel(rsym.name))
+                if rng is not None:
+                    self.dynamic_filters[lsym.name] = rng
+        probe = self.plan(node.left)
         out_symbols = probe.symbols + build.symbols
         probe_keys = [probe.channel(l.name) for l, _ in node.criteria]
         build_keys = [build.channel(r.name) for _, r in node.criteria]
@@ -249,7 +278,7 @@ class LocalExecutionPlanner:
             residual=residual,
             residual_key=residual_key,
         )
-        op.set_build(list(build.stream))
+        op.set_build(build_batches)
         return PhysicalPlan(op.process(probe.stream), out_symbols)
 
     def _visit_SemiJoinNode(self, node: P.SemiJoinNode) -> PhysicalPlan:
@@ -396,3 +425,70 @@ class LocalExecutionPlanner:
 def specs_args(specs: list) -> list:
     """Channels already consumed by aggregate args (for layout allocation)."""
     return [s for s in specs if s.arg is not None]
+
+
+def _host_minmax(batches, channel: int):
+    """(lo, hi) of a materialized column's live+valid values, or None when
+    the domain is empty/unfilterable (dictionary codes aren't portable
+    across scans)."""
+    import numpy as np
+
+    lo = hi = None
+    for b in batches:
+        c = b.columns[channel]
+        if c.dictionary is not None:
+            return None
+        data = np.asarray(c.data)
+        live = np.asarray(b.mask())
+        if c.valid is not None:
+            live = live & np.asarray(c.valid)
+        if not live.any():
+            continue
+        vals = data[live]
+        blo, bhi = vals.min(), vals.max()
+        lo = blo if lo is None else min(lo, blo)
+        hi = bhi if hi is None else max(hi, bhi)
+    if lo is None:
+        return None
+    return (lo, hi)
+
+
+def _range_expr(sym, lo, hi) -> Expr:
+    from decimal import Decimal
+
+    from trino_tpu.expr.ir import and_, comparison
+
+    t = sym.type
+    if isinstance(t, T.DecimalType):
+        lo_v = Decimal(int(lo)) / t.scale_factor
+        hi_v = Decimal(int(hi)) / t.scale_factor
+    elif t.np_dtype.kind == "f":
+        lo_v, hi_v = float(lo), float(hi)
+    else:
+        lo_v, hi_v = int(lo), int(hi)
+    return and_(
+        comparison(">=", sym.ref(), Literal(lo_v, t)),
+        comparison("<=", sym.ref(), Literal(hi_v, t)),
+    )
+
+
+#: jitted compaction per static output capacity (shape-bucketed)
+_COMPACT_CACHE: dict = {}
+
+
+def _compact_stream(stream):
+    import jax
+
+    from trino_tpu.ops.common import next_pow2
+
+    for b in stream:
+        n = b.num_rows_host()
+        cap = next_pow2(max(n, 1), floor=1024)
+        if cap >= b.capacity:
+            yield b
+            continue
+        fn = _COMPACT_CACHE.get(cap)
+        if fn is None:
+            fn = jax.jit(Batch.compact_device, static_argnames=("out_capacity",))
+            _COMPACT_CACHE[cap] = fn
+        yield fn(b, out_capacity=cap)
