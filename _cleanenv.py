@@ -1,0 +1,39 @@
+"""Shared axon-environment sanitizing for repo-root entry points.
+
+The ambient environment loads the experimental axon TPU plugin through
+`PYTHONPATH=/root/.axon_site` (a sitecustomize that hooks jax on import and
+proxies every XLA compile through a remote helper).  Entry points that need
+pure-local CPU jax (bench fallback, multichip dry run) must scrub it from
+the environment of a FRESH interpreter — scrubbing in-process is too late
+because sitecustomize runs at startup.  tests/conftest.py keeps its own
+inline copy: it must run before any package import, so it cannot import us.
+"""
+
+from __future__ import annotations
+
+import re
+
+AXON_MARKER = ".axon_site"
+
+
+def scrub_pythonpath(pythonpath: str) -> str:
+    return ":".join(
+        p for p in pythonpath.split(":") if p and AXON_MARKER not in p
+    )
+
+
+def cpu_env(env: dict, n_virtual_devices: int | None = None) -> dict:
+    """A copy of `env` forcing pure-local CPU jax for a child interpreter."""
+    env = dict(env)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = scrub_pythonpath(env.get("PYTHONPATH", ""))
+    if n_virtual_devices is not None:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            env.get("XLA_FLAGS", ""),
+        )
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_virtual_devices}"
+        ).strip()
+    return env
