@@ -76,6 +76,78 @@ def test_ntile(runner):
     assert sorted(res.rows) == [(1, 1), (2, 1), (3, 2)]
 
 
+def test_row_number_no_keys_filtered(runner):
+    """row_number() over () on a filtered input must number only surviving
+    rows 1..n (regression: dead rows were counted when no sort keys)."""
+    res = runner.execute(
+        "select n_name, row_number() over () rn from nation where n_regionkey = 2"
+    )
+    n = tpch_pandas("tiny", "nation")
+    keep = set(n[n.n_regionkey == 2].n_name)
+    names = {r[0] for r in res.rows}
+    rns = sorted(r[1] for r in res.rows)
+    assert names == keep
+    assert rns == list(range(1, len(keep) + 1))
+
+
+def test_rows_frame_running_sum_with_ties(runner):
+    """ROWS frame is row-exact even under ties (RANGE would share totals)."""
+    res = runner.execute(
+        "select x, sum(x) over (order by x rows between unbounded preceding "
+        "and current row) from "
+        "(select 1 x union all select 2 union all select 2 union all select 3) t"
+    )
+    assert sorted(res.rows) == [(1, 1), (2, 3), (2, 5), (3, 8)]
+
+
+def test_rows_frame_bounded_avg(runner):
+    """avg over ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING (TPC-DS Q47 shape)."""
+    res = runner.execute(
+        "select x, avg(x) over (order by x rows between 1 preceding and 1 following) "
+        "from (select 1 x union all select 2 union all select 4 union all select 8) t"
+    )
+    got = sorted((a, round(b, 6)) for a, b in res.rows)
+    assert got == [(1, 1.5), (2, round(7 / 3, 6)), (4, round(14 / 3, 6)), (8, 6.0)]
+
+
+def test_rows_frame_count_star_bounded(runner):
+    res = runner.execute(
+        "select x, count(*) over (order by x rows between 1 preceding and current row) "
+        "from (select 1 x union all select 2 union all select 3) t"
+    )
+    assert sorted(res.rows) == [(1, 1), (2, 2), (3, 2)]
+
+
+def test_last_value_rows_running(runner):
+    """last_value with the row-exact running frame is the current row."""
+    res = runner.execute(
+        "select x, last_value(x) over (order by x rows between unbounded "
+        "preceding and current row) from "
+        "(select 1 x union all select 2 union all select 2) t"
+    )
+    assert sorted(res.rows) == [(1, 1), (2, 2), (2, 2)]
+
+
+def test_unsupported_frame_raises(runner):
+    from trino_tpu.planner.analyzer import AnalysisError
+
+    with pytest.raises(AnalysisError):
+        runner.execute(
+            "select sum(x) over (order by x range between 1 preceding and "
+            "current row) from (select 1 x) t"
+        )
+
+
+def test_frame_without_order_raises(runner):
+    from trino_tpu.planner.analyzer import AnalysisError
+
+    with pytest.raises(AnalysisError):
+        runner.execute(
+            "select sum(x) over (rows between unbounded preceding and "
+            "current row) from (select 1 x) t"
+        )
+
+
 def test_avg_over_partition(runner):
     s = tpch_pandas("tiny", "supplier")
     expected = s.groupby("s_nationkey").s_acctbal.mean()

@@ -8,8 +8,9 @@ gathers — a single static-shape XLA program instead of the reference's
 per-partition imperative loops (WindowPartition.processNextRow).
 
 Supported frames: the SQL default RANGE BETWEEN UNBOUNDED PRECEDING AND
-CURRENT ROW (running, peer-inclusive), ROWS UNBOUNDED PRECEDING..CURRENT ROW,
-and the whole-partition frame (no ORDER BY, or UNBOUNDED..UNBOUNDED).
+CURRENT ROW (running, peer-inclusive), ROWS frames with unbounded or literal
+row offsets (reference: operator/window/FrameInfo.java), and the
+whole-partition frame (no ORDER BY, or UNBOUNDED..UNBOUNDED).
 """
 
 from __future__ import annotations
@@ -48,6 +49,10 @@ class WindowSpec:
     default_channel: Optional[int] = None  # lag/lead default value column
     n_buckets: int = 1  # ntile
     frame: str = "range"
+    # ROWS-frame bounds relative to the current row (None = unbounded on that
+    # side); the default running frame is (None, 0).
+    start_off: Optional[int] = None
+    end_off: Optional[int] = 0
 
 
 class WindowOperator:
@@ -60,6 +65,17 @@ class WindowOperator:
         self.partition_channels = list(partition_channels)
         self.order_keys = list(order_keys)
         self.specs = list(specs)
+        for s in self.specs:
+            if (
+                s.name in ("min", "max")
+                and s.frame == "rows"
+                and s.start_off is not None
+            ):
+                # prefix-scan min/max needs an unbounded frame start; a
+                # bounded sliding min/max would need a different kernel
+                raise NotImplementedError(
+                    "min/max over a bounded-start ROWS frame"
+                )
         self._acc: list[Batch] = []
         self._step = jax.jit(self._window_step)
 
@@ -68,7 +84,10 @@ class WindowOperator:
     def _window_step(self, batch: Batch) -> Batch:
         cap = batch.capacity
         keys = [SortKey(ch) for ch in self.partition_channels] + self.order_keys
-        perm = multi_key_sort_perm(batch, keys) if keys else jnp.arange(cap, dtype=jnp.int64)
+        # always sort: even with no keys, multi_key_sort_perm moves dead
+        # (filtered-out) rows last, so positional logic below only sees live
+        # rows in the prefix — `row_number() over ()` must not count dead rows
+        perm = multi_key_sort_perm(batch, keys)
         live = jnp.take(batch.mask(), perm, mode="clip")
         pos = jnp.arange(cap, dtype=jnp.int64)
 
@@ -135,6 +154,29 @@ class WindowOperator:
         name = spec.name
         safe_pid = jnp.clip(pid, 0, cap)
         n_in_part = part_size[safe_pid]
+
+        # frame bounds as sorted-row indices [lo, hi] per row (FrameInfo.java)
+        part_first = part_start[safe_pid]
+        part_last = part_first + n_in_part - 1
+        whole = spec.frame == "full" or not self.order_keys
+        if whole:
+            lo, hi = part_first, part_last
+        elif spec.frame == "rows":
+            lo = (
+                part_first
+                if spec.start_off is None
+                else jnp.maximum(part_first, pos + spec.start_off)
+            )
+            hi = (
+                part_last
+                if spec.end_off is None
+                else jnp.minimum(part_last, pos + spec.end_off)
+            )
+        else:  # default RANGE running frame: start of partition .. last peer
+            lo = part_first
+            hi = peer_last[jnp.clip(peer_gid, 0, cap)]
+        frame_n = jnp.maximum(hi - lo + 1, 0)
+
         if name == "row_number":
             return Column(idx_in_part + 1, T.BIGINT, None)
         if name in ("rank", "dense_rank", "percent_rank", "cume_dist", "ntile"):
@@ -194,35 +236,22 @@ class WindowOperator:
             col = batch.columns[spec.arg]
             d = jnp.take(col.data, perm, mode="clip")
             v = jnp.take(col.valid, perm, mode="clip") if col.valid is not None else jnp.ones(cap, bool)
-            if name == "first_value":
-                src = part_start[safe_pid]
-            elif spec.frame == "full":
-                src = part_start[safe_pid] + n_in_part - 1
-            else:  # running frame: last peer row
-                src = peer_last[jnp.clip(peer_gid, 0, cap)]
-            src = jnp.clip(src, 0, cap - 1)
+            src = jnp.clip(lo if name == "first_value" else hi, 0, cap - 1)
             return Column(
                 jnp.take(d, src, mode="clip").astype(spec.out_type.np_dtype),
                 spec.out_type,
-                jnp.take(v, src, mode="clip"),
+                jnp.logical_and(jnp.take(v, src, mode="clip"), frame_n > 0),
                 col.dictionary,
             )
         # aggregates over the frame
         if name == "count" and spec.arg is None:  # count(*) over (...)
-            if spec.frame == "full" or not self.order_keys:
-                return Column(n_in_part, T.BIGINT, None)
-            if spec.frame == "rows":
-                return Column(idx_in_part + 1, T.BIGINT, None)
-            last = peer_last[jnp.clip(peer_gid, 0, cap)]
-            return Column(last - part_start[safe_pid] + 1, T.BIGINT, None)
+            return Column(frame_n, T.BIGINT, None)
         col = batch.columns[spec.arg]
         d = jnp.take(col.data, perm, mode="clip")
         v = live
         if col.valid is not None:
             v = jnp.logical_and(v, jnp.take(col.valid, perm, mode="clip"))
-        whole = spec.frame == "full" or not self.order_keys
         if name in ("sum", "avg", "count"):
-            st = T.DOUBLE if d.dtype == jnp.float64 else jnp.int64
             dd = jnp.where(v, d, 0).astype(
                 jnp.float64 if jnp.issubdtype(d.dtype, jnp.floating) else jnp.int64
             )
@@ -233,16 +262,11 @@ class WindowOperator:
             else:
                 run = jnp.cumsum(dd)
                 runc = jnp.cumsum(cnt_inc)
-                if spec.frame == "rows":
-                    upto = pos
-                else:
-                    upto = peer_last[jnp.clip(peer_gid, 0, cap)]
-                base_idx = part_start[safe_pid]
                 run_at = lambda r, i: jnp.take(r, jnp.clip(i, 0, cap - 1), mode="clip")
-                before = jnp.where(base_idx > 0, run_at(run, base_idx - 1), 0)
-                beforec = jnp.where(base_idx > 0, run_at(runc, base_idx - 1), 0)
-                ssum = run_at(run, upto) - before
-                scnt = run_at(runc, upto) - beforec
+                before = jnp.where(lo > 0, run_at(run, lo - 1), 0)
+                beforec = jnp.where(lo > 0, run_at(runc, lo - 1), 0)
+                ssum = jnp.where(frame_n > 0, run_at(run, hi) - before, 0)
+                scnt = jnp.where(frame_n > 0, run_at(runc, hi) - beforec, 0)
             if name == "count":
                 return Column(scnt, T.BIGINT, None)
             if name == "sum":
@@ -280,18 +304,15 @@ class WindowOperator:
                 merged = jnp.where(a_pid == b_pid, op(a_val, b_val), b_val)
                 return (b_pid, merged)
             _, red = jax.lax.associative_scan(scan_fn, (pid, dd))
-            if spec.frame != "rows":
-                last = jnp.clip(peer_last[jnp.clip(peer_gid, 0, cap)], 0, cap - 1)
-                red = jnp.take(red, last, mode="clip")
+            hi_c = jnp.clip(hi, 0, cap - 1)
+            red = jnp.take(red, hi_c, mode="clip")
             runc = jnp.cumsum(v.astype(jnp.int64))
-            base_idx = part_start[safe_pid]
             before = jnp.where(
-                base_idx > 0,
-                jnp.take(runc, jnp.clip(base_idx - 1, 0, cap - 1), mode="clip"),
-                0,
+                lo > 0, jnp.take(runc, jnp.clip(lo - 1, 0, cap - 1), mode="clip"), 0
             )
-            upto = pos if spec.frame == "rows" else peer_last[jnp.clip(peer_gid, 0, cap)]
-            cnt = jnp.take(runc, jnp.clip(upto, 0, cap - 1), mode="clip") - before
+            cnt = jnp.where(
+                frame_n > 0, jnp.take(runc, hi_c, mode="clip") - before, 0
+            )
             return Column(red, spec.out_type, cnt > 0, col.dictionary)
         raise NotImplementedError(f"window function {name}")
 

@@ -780,7 +780,12 @@ class _WindowExtractor:
                 name = AGG_FUNCS[name]
         else:
             raise AnalysisError(f"unknown window function {name}")
-        frame = "range" if order else "full"
+        frame, start_off, end_off = _resolve_frame(w.frame, bool(order))
+        if name in ("min", "max") and frame == "rows" and start_off is not None:
+            # the executor's prefix-scan min/max needs an unbounded frame start
+            raise AnalysisError(
+                f"{name} over a bounded-start ROWS frame is not supported"
+            )
         fn = P.WindowFunction(
             name,
             [s.ref() for s in arg_syms],
@@ -788,6 +793,8 @@ class _WindowExtractor:
             offset=offset,
             n_buckets_expr=n_buckets,
             default=None if default_sym is None else default_sym.ref(),
+            start_off=start_off,
+            end_off=end_off,
         )
         out = self.planner.alloc.new(fc.name, out_t)
         self.functions.append((out, part, order, fn))
@@ -900,3 +907,60 @@ def _name_hint(e: ast.Node) -> str:
     if isinstance(e, ast.FunctionCall):
         return e.name
     return "expr"
+
+
+def _frame_offset(bound: ast.FrameBound) -> Optional[int]:
+    """Literal row offset relative to the current row (None = unbounded)."""
+    if bound.kind in ("unbounded_preceding", "unbounded_following"):
+        return None
+    if bound.kind == "current":
+        return 0
+    if not isinstance(bound.value, ast.NumberLiteral):
+        raise AnalysisError("window frame offset must be an integer literal")
+    try:
+        k = int(bound.value.text)
+    except ValueError:
+        raise AnalysisError("window frame offset must be an integer literal")
+    if k < 0:
+        raise AnalysisError("window frame offset must be non-negative")
+    return -k if bound.kind == "preceding" else k
+
+
+def _resolve_frame(wf, has_order: bool):
+    """AST WindowFrame → (frame kind, start_off, end_off) for the executor.
+
+    Reference: operator/window/FrameInfo.java + sql/analyzer checks in
+    StatementAnalyzer.analyzeWindowFrame.  Unsupported frame shapes raise
+    AnalysisError — a frame clause is never silently dropped.
+    """
+    if wf is None:
+        return ("range" if has_order else "full"), None, 0
+    s, e = wf.start.kind, wf.end.kind
+    if s == "unbounded_following" or e == "unbounded_preceding":
+        raise AnalysisError(f"invalid window frame {wf.kind} {s}..{e}")
+    if s == "unbounded_preceding" and e == "unbounded_following":
+        return "full", None, None
+    if not has_order:
+        if wf.kind in ("range", "groups") and s == "unbounded_preceding" and e == "current":
+            # without ORDER BY all rows are peers: the running frame IS the
+            # whole partition
+            return "full", None, 0
+        raise AnalysisError(
+            "window frame requires ORDER BY in the window specification"
+        )
+    if wf.kind == "rows":
+        start_off, end_off = _frame_offset(wf.start), _frame_offset(wf.end)
+        if (
+            start_off is not None
+            and end_off is not None
+            and start_off > end_off
+        ):
+            raise AnalysisError("window frame start is after frame end")
+        return "rows", start_off, end_off
+    # range/groups: only the frames equivalent to the running default are
+    # computable on the peer-group machinery
+    if s == "unbounded_preceding" and e == "current":
+        return "range", None, 0
+    raise AnalysisError(
+        f"unsupported window frame {wf.kind} {s}..{e}"
+    )

@@ -214,6 +214,10 @@ class WindowFunction:
     offset: int = 1  # lag/lead
     n_buckets_expr: object = None  # ntile bucket-count literal Expr
     default: object = None  # lag/lead default Expr
+    # ROWS-frame literal bounds relative to current row (None = unbounded);
+    # the default running frame is (None, 0)
+    start_off: object = None
+    end_off: object = 0
 
 
 @dataclass

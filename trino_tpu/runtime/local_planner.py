@@ -332,6 +332,8 @@ class LocalExecutionPlanner:
                     default_channel=default_ch,
                     n_buckets=fn.n_buckets_expr or 1,
                     frame=fn.frame,
+                    start_off=fn.start_off,
+                    end_off=fn.end_off,
                 )
             )
         op = WindowOperator(part, order, specs)

@@ -82,10 +82,27 @@ class FunctionCall(Node):
 
 
 @dataclass(frozen=True)
+class FrameBound(Node):
+    """One bound of a window frame (reference: sql/tree/FrameBound.java)."""
+
+    kind: str  # unbounded_preceding | preceding | current | following | unbounded_following
+    value: object = None  # offset expression for preceding/following
+
+
+@dataclass(frozen=True)
+class WindowFrame(Node):
+    """reference: sql/tree/WindowFrame.java."""
+
+    kind: str  # rows | range | groups
+    start: FrameBound
+    end: FrameBound
+
+
+@dataclass(frozen=True)
 class WindowSpec(Node):
     partition_by: tuple
     order_by: tuple  # of SortItem
-    frame: object = None
+    frame: object = None  # WindowFrame or None
 
 
 @dataclass(frozen=True)

@@ -735,15 +735,36 @@ class Parser:
                 order_by.append(self._sort_item())
                 while self.accept_op(","):
                     order_by.append(self._sort_item())
-            # frame clause accepted and ignored (default frames only)
-            if self.peek().is_kw("rows", "range"):
-                while not (self.peek().kind == "op" and self.peek().value == ")"):
-                    if self.peek().kind == "eof":
-                        raise ParseError("unterminated window frame", self.peek())
-                    self.next()
+            frame = None
+            if self.peek().is_kw("rows", "range", "groups"):
+                kind = self.next().value.lower()
+                if self.accept_kw("between"):
+                    start = self._frame_bound()
+                    self.expect_kw("and")
+                    end = self._frame_bound()
+                else:
+                    start = self._frame_bound()
+                    end = ast.FrameBound("current")
+                frame = ast.WindowFrame(kind, start, end)
             self.expect_op(")")
-            window = ast.WindowSpec(tuple(partition_by), tuple(order_by))
+            window = ast.WindowSpec(tuple(partition_by), tuple(order_by), frame)
         return ast.FunctionCall(name.lower(), tuple(args), distinct, is_star, window, filt)
+
+    def _frame_bound(self) -> ast.FrameBound:
+        """reference: SqlBase.g4 frameBound / sql/tree/FrameBound.java."""
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return ast.FrameBound("unbounded_preceding")
+            self.expect_kw("following")
+            return ast.FrameBound("unbounded_following")
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return ast.FrameBound("current")
+        value = self._expr()
+        if self.accept_kw("preceding"):
+            return ast.FrameBound("preceding", value)
+        self.expect_kw("following")
+        return ast.FrameBound("following", value)
 
 
 def parse_statement(sql: str) -> ast.Node:

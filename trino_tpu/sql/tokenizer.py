@@ -16,7 +16,7 @@ KEYWORDS = {
     "delete", "update", "set", "session", "show", "tables", "schemas",
     "catalogs", "columns", "describe", "explain", "analyze", "if",
     "row", "rows", "fetch", "next", "only", "array", "map", "grouping",
-    "rollup", "cube", "over", "partition", "range", "unbounded", "preceding",
+    "rollup", "cube", "over", "partition", "range", "groups", "unbounded", "preceding",
     "following", "current", "filter", "within", "ordinality", "unnest",
     "lateral", "tablesample", "bernoulli", "system", "substring", "for",
     "position", "localtime", "localtimestamp", "current_date",
