@@ -1,0 +1,177 @@
+"""Vectorized-numpy CPU baselines for the bench queries.
+
+Reference role: the "competently vectorized single-node CPU engine" stand-in
+requested for an honest `vs_baseline` (there is no JVM on this image, so the
+Java engine cannot run here; pandas is convenience-level, this is
+performance-level).  Each query is implemented straight on the connector's
+columnar data with numpy kernels (boolean masks, argsort, searchsorted,
+bincount) — the same algorithmic class a tuned CPU columnar engine uses.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+#: materialized-column cache — the baseline's analog of the engine's buffer
+#: pool, so warm timed runs measure query compute on both sides
+_CACHE: dict = {}
+
+
+def _columns(conn, schema: str, table: str, names):
+    """Materialize full host columns (concatenated across splits)."""
+    from trino_tpu.connectors.api import TableHandle
+
+    ck = (schema, table, tuple(names))
+    if ck in _CACHE:
+        return _CACHE[ck]
+    handle = TableHandle("tpch", schema, table)
+    parts: dict[str, list] = {n: [] for n in names}
+    valids: dict[str, list] = {n: [] for n in names}
+    dicts: dict[str, object] = {}
+    for split in conn.splits(handle, target_splits=1):
+        src = conn.page_source(split, list(names), max_rows_per_page=1 << 22)
+        for page in src.pages():
+            for n, cd in zip(names, page):
+                parts[n].append(np.asarray(cd.values))
+                if cd.valid is not None:
+                    valids[n].append(np.asarray(cd.valid))
+                dicts[n] = cd.dictionary
+    out = {}
+    for n in names:
+        data = np.concatenate(parts[n]) if len(parts[n]) > 1 else parts[n][0]
+        out[n] = (data, dicts.get(n))
+    _CACHE[ck] = out
+    return out
+
+
+def q1(conn, schema: str) -> list:
+    cols = _columns(
+        conn, schema, "lineitem",
+        ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+         "l_discount", "l_tax", "l_shipdate"],
+    )
+    rf, rf_dict = cols["l_returnflag"]
+    ls, ls_dict = cols["l_linestatus"]
+    qty = cols["l_quantity"][0]
+    price = cols["l_extendedprice"][0]
+    disc = cols["l_discount"][0]
+    tax = cols["l_tax"][0]
+    ship = cols["l_shipdate"][0]
+    cutoff = (np.datetime64("1998-09-02") - np.datetime64("1970-01-01")).astype(int)
+    m = ship <= cutoff
+    rf, ls, qty, price, disc, tax = (a[m] for a in (rf, ls, qty, price, disc, tax))
+    nls = len(ls_dict.values)
+    key = rf.astype(np.int64) * nls + ls.astype(np.int64)
+    nk = len(rf_dict.values) * nls
+    disc_price = price * (10000 - disc * 100) // 10000  # cents math
+    charge = disc_price * (10000 + tax * 100) // 10000
+    out = []
+    cnt = np.bincount(key, minlength=nk)
+    s_qty = np.bincount(key, weights=qty.astype(np.float64), minlength=nk)
+    s_price = np.bincount(key, weights=price.astype(np.float64), minlength=nk)
+    s_disc_price = np.bincount(key, weights=disc_price.astype(np.float64), minlength=nk)
+    s_charge = np.bincount(key, weights=charge.astype(np.float64), minlength=nk)
+    s_disc = np.bincount(key, weights=disc.astype(np.float64), minlength=nk)
+    for k in np.flatnonzero(cnt):
+        out.append(
+            (rf_dict.values[k // nls], ls_dict.values[k % nls],
+             s_qty[k], s_price[k], s_disc_price[k], s_charge[k],
+             s_qty[k] / cnt[k], s_price[k] / cnt[k], s_disc[k] / cnt[k],
+             int(cnt[k]))
+        )
+    out.sort(key=lambda r: (r[0], r[1]))
+    return out
+
+
+def q6(conn, schema: str) -> list:
+    cols = _columns(
+        conn, schema, "lineitem",
+        ["l_extendedprice", "l_discount", "l_quantity", "l_shipdate"],
+    )
+    price = cols["l_extendedprice"][0]
+    disc = cols["l_discount"][0]
+    qty = cols["l_quantity"][0]
+    ship = cols["l_shipdate"][0]
+    lo = (np.datetime64("1994-01-01") - np.datetime64("1970-01-01")).astype(int)
+    hi = (np.datetime64("1995-01-01") - np.datetime64("1970-01-01")).astype(int)
+    m = (ship >= lo) & (ship < hi) & (disc >= 5) & (disc <= 7) & (qty < 2400)
+    return [(float((price[m].astype(np.float64) * disc[m]).sum()),)]
+
+
+def q3(conn, schema: str) -> list:
+    cust = _columns(conn, schema, "customer", ["c_custkey", "c_mktsegment"])
+    orders = _columns(
+        conn, schema, "orders",
+        ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"],
+    )
+    li = _columns(
+        conn, schema, "lineitem",
+        ["l_orderkey", "l_extendedprice", "l_discount", "l_shipdate"],
+    )
+    seg, seg_dict = cust["c_mktsegment"]
+    building = list(seg_dict.values).index("BUILDING")
+    ckeys = cust["c_custkey"][0][seg == building]
+    cutoff = (np.datetime64("1995-03-15") - np.datetime64("1970-01-01")).astype(int)
+    om = orders["o_orderdate"][0] < cutoff
+    om &= np.isin(orders["o_custkey"][0], ckeys, assume_unique=False)
+    okeys = orders["o_orderkey"][0][om]
+    odate = orders["o_orderdate"][0][om]
+    oprio = orders["o_shippriority"][0][om]
+    lm = li["l_shipdate"][0] > cutoff
+    lkey = li["l_orderkey"][0][lm]
+    rev = (
+        li["l_extendedprice"][0][lm].astype(np.float64)
+        * (10000 - li["l_discount"][0][lm] * 100) / 10000
+    )
+    order = np.argsort(okeys, kind="stable")
+    okeys_s, odate_s, oprio_s = okeys[order], odate[order], oprio[order]
+    pos = np.searchsorted(okeys_s, lkey)
+    pos_c = np.clip(pos, 0, len(okeys_s) - 1)
+    hit = (pos < len(okeys_s)) & (okeys_s[pos_c] == lkey)
+    gid = pos_c[hit]
+    revenue = np.bincount(gid, weights=rev[hit], minlength=len(okeys_s))
+    nz = np.flatnonzero(revenue)
+    rows = [
+        (int(okeys_s[i]), revenue[i], int(odate_s[i]), int(oprio_s[i]))
+        for i in nz
+    ]
+    rows.sort(key=lambda r: (-r[1], r[2]))
+    return rows[:10]
+
+
+def q18(conn, schema: str) -> list:
+    li = _columns(conn, schema, "lineitem", ["l_orderkey", "l_quantity"])
+    orders = _columns(
+        conn, schema, "orders",
+        ["o_orderkey", "o_custkey", "o_orderdate", "o_totalprice"],
+    )
+    cust = _columns(conn, schema, "customer", ["c_custkey", "c_name"])
+    lkey = li["l_orderkey"][0]
+    qty = li["l_quantity"][0]
+    maxkey = int(lkey.max()) + 1
+    sums = np.bincount(lkey, weights=qty.astype(np.float64), minlength=maxkey)
+    big = np.flatnonzero(sums > 300 * 100)  # cents
+    okeys = orders["o_orderkey"][0]
+    om = np.isin(okeys, big)
+    sel_ok = okeys[om]
+    sel_ck = orders["o_custkey"][0][om]
+    sel_od = orders["o_orderdate"][0][om]
+    sel_tp = orders["o_totalprice"][0][om]
+    ckeys = cust["c_custkey"][0]
+    cnames, cname_dict = cust["c_name"]
+    order = np.argsort(ckeys, kind="stable")
+    pos = np.searchsorted(ckeys[order], sel_ck)
+    name_codes = cnames[order][np.clip(pos, 0, len(ckeys) - 1)]
+    rows = [
+        (
+            cname_dict.values[int(nc)] if cname_dict is not None else int(nc),
+            int(ck), int(ok), int(od), int(tp), sums[ok] / 100.0,
+        )
+        for nc, ck, ok, od, tp in zip(name_codes, sel_ck, sel_ok, sel_od, sel_tp)
+    ]
+    rows.sort(key=lambda r: (-r[4], r[3]))
+    return rows[:100]
+
+
+BASELINES = {1: q1, 3: q3, 6: q6, 18: q18}

@@ -1,0 +1,142 @@
+"""Aggregate + scalar function breadth (reference: operator/aggregation/*
+moment/approx aggregations, operator/scalar/JoniRegexpFunctions.java)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from trino_tpu.runtime.runner import LocalQueryRunner
+from trino_tpu.testing import tpch_pandas
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+# -- moment aggregates --------------------------------------------------------
+
+
+def test_stddev_variance_global(runner):
+    res = runner.execute(
+        "select var_samp(n_nationkey), var_pop(n_nationkey), "
+        "stddev_samp(n_nationkey), stddev_pop(n_nationkey) from nation"
+    )
+    x = np.arange(25, dtype=np.float64)
+    expect = (
+        x.var(ddof=1), x.var(ddof=0), x.std(ddof=1), x.std(ddof=0)
+    )
+    for got, exp in zip(res.rows[0], expect):
+        assert abs(got - exp) < 1e-9, (got, exp)
+
+
+def test_stddev_grouped(runner):
+    res = runner.execute(
+        "select n_regionkey, stddev(n_nationkey) from nation "
+        "group by n_regionkey order by n_regionkey"
+    )
+    n = tpch_pandas("tiny", "nation")
+    for (k, got), (ek, ev) in zip(
+        res.rows, n.groupby("n_regionkey").n_nationkey.std(ddof=1).items()
+    ):
+        assert k == ek and abs(got - ev) < 1e-9
+
+
+def test_variance_aliases(runner):
+    res = runner.execute(
+        "select variance(n_nationkey), stddev(n_nationkey) from nation"
+    )
+    x = np.arange(25, dtype=np.float64)
+    assert abs(res.rows[0][0] - x.var(ddof=1)) < 1e-9
+    assert abs(res.rows[0][1] - x.std(ddof=1)) < 1e-9
+
+
+def test_variance_single_row_null(runner):
+    res = runner.execute(
+        "select var_samp(x), var_pop(x) from (select 5 x) t"
+    )
+    assert res.rows == [(None, 0.0)]
+
+
+def test_stddev_of_decimal(runner):
+    res = runner.execute("select stddev_pop(s_acctbal) from supplier")
+    s = tpch_pandas("tiny", "supplier")
+    assert abs(res.only_value() - s.s_acctbal.astype(float).std(ddof=0)) < 1e-6
+
+
+# -- approx_distinct / approx_percentile --------------------------------------
+
+
+def test_approx_distinct(runner):
+    res = runner.execute(
+        "select approx_distinct(n_regionkey), approx_distinct(n_name) from nation"
+    )
+    assert res.rows == [(5, 25)]
+
+
+def test_approx_distinct_grouped(runner):
+    res = runner.execute(
+        "select o_orderstatus, approx_distinct(o_custkey) from orders "
+        "group by o_orderstatus"
+    )
+    o = tpch_pandas("tiny", "orders")
+    expected = {
+        k: int(v.o_custkey.nunique()) for k, v in o.groupby("o_orderstatus")
+    }
+    assert {k: v for k, v in res.rows} == expected
+
+
+def test_approx_percentile_global(runner):
+    res = runner.execute(
+        "select approx_percentile(n_nationkey, 0.5), "
+        "approx_percentile(n_nationkey, 0.0), "
+        "approx_percentile(n_nationkey, 1.0) from nation"
+    )
+    assert res.rows == [(12, 0, 24)]
+
+
+def test_approx_percentile_grouped(runner):
+    res = runner.execute(
+        "select n_regionkey, approx_percentile(n_nationkey, 0.5) from nation "
+        "group by n_regionkey order by n_regionkey"
+    )
+    n = tpch_pandas("tiny", "nation")
+    for k, got in res.rows:
+        vals = sorted(n[n.n_regionkey == k].n_nationkey)
+        exp = vals[round(0.5 * (len(vals) - 1))]
+        assert got == exp
+
+
+# -- regexp scalars -----------------------------------------------------------
+
+
+def test_regexp_like(runner):
+    res = runner.execute(
+        "select count(*) from nation where regexp_like(n_name, '^[A-C]')"
+    )
+    n = tpch_pandas("tiny", "nation")
+    assert res.only_value() == int(n.n_name.str.match("[A-C]").sum())
+
+
+def test_regexp_extract(runner):
+    res = runner.execute(
+        "select regexp_extract(n_name, '([A-Z]+)', 1) from nation "
+        "where n_nationkey = 0"
+    )
+    assert res.rows == [("ALGERIA",)]
+
+
+def test_regexp_extract_no_match_is_null(runner):
+    res = runner.execute(
+        "select regexp_extract(n_name, 'zzz') from nation where n_nationkey = 0"
+    )
+    assert res.rows == [(None,)]
+
+
+def test_regexp_replace(runner):
+    res = runner.execute(
+        "select regexp_replace(n_name, '[AEIOU]', '_') from nation "
+        "where n_nationkey = 0"
+    )
+    assert res.rows == [("_LG_R__",)]

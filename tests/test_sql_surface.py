@@ -1,0 +1,169 @@
+"""SQL-surface features: INTERSECT/EXCEPT, OFFSET, EXISTS, correlated IN,
+mixed DISTINCT aggregates (reference: AbstractTestQueries coverage of
+SqlBase.g4:244-245 set ops, OffsetNode, TransformCorrelated* rules,
+MultipleDistinctAggregationToMarkDistinct)."""
+
+import pytest
+
+from tests.test_e2e import assert_rows_match
+from trino_tpu.runtime.runner import LocalQueryRunner
+from trino_tpu.testing import tpch_pandas
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+# -- set operations -----------------------------------------------------------
+
+
+def test_intersect(runner):
+    res = runner.execute(
+        "select n_regionkey from nation intersect select r_regionkey from region"
+    )
+    assert sorted(res.rows) == [(0,), (1,), (2,), (3,), (4,)]
+
+
+def test_intersect_empty(runner):
+    res = runner.execute(
+        "select n_nationkey from nation where n_nationkey > 30 "
+        "intersect select n_nationkey from nation"
+    )
+    assert res.rows == []
+
+
+def test_except(runner):
+    res = runner.execute(
+        "select n_nationkey from nation except "
+        "select n_nationkey from nation where n_nationkey < 20"
+    )
+    assert sorted(res.rows) == [(20,), (21,), (22,), (23,), (24,)]
+
+
+def test_except_distinct_semantics(runner):
+    # EXCEPT removes ALL copies and dedupes the left side
+    res = runner.execute(
+        "select x from (select 1 x union all select 1 union all select 2) t "
+        "except select 3"
+    )
+    assert sorted(res.rows) == [(1,), (2,)]
+
+
+def test_intersect_precedence(runner):
+    # INTERSECT binds tighter than UNION: 1 union (2 intersect 2) = {1, 2}
+    res = runner.execute(
+        "select 1 x union select 2 intersect select 2"
+    )
+    assert sorted(res.rows) == [(1,), (2,)]
+
+
+# -- OFFSET -------------------------------------------------------------------
+
+
+def test_offset_with_order(runner):
+    res = runner.execute(
+        "select n_nationkey from nation order by n_nationkey offset 20"
+    )
+    assert [r[0] for r in res.rows] == [20, 21, 22, 23, 24]
+
+
+def test_offset_with_limit(runner):
+    res = runner.execute(
+        "select n_nationkey from nation order by n_nationkey offset 3 limit 4"
+    )
+    assert [r[0] for r in res.rows] == [3, 4, 5, 6]
+
+
+def test_offset_without_order(runner):
+    res = runner.execute("select n_nationkey from nation offset 22")
+    assert res.row_count == 3
+
+
+# -- EXISTS -------------------------------------------------------------------
+
+
+def test_uncorrelated_exists_true(runner):
+    res = runner.execute(
+        "select count(*) from nation where exists (select 1 from region where r_regionkey = 3)"
+    )
+    assert res.only_value() == 25
+
+
+def test_uncorrelated_exists_false(runner):
+    res = runner.execute(
+        "select count(*) from nation where exists "
+        "(select 1 from region where r_regionkey > 99)"
+    )
+    assert res.only_value() == 0
+
+
+def test_uncorrelated_not_exists(runner):
+    res = runner.execute(
+        "select count(*) from nation where not exists "
+        "(select 1 from region where r_regionkey > 99)"
+    )
+    assert res.only_value() == 25
+
+
+def test_correlated_exists_still_works(runner):
+    res = runner.execute(
+        "select count(*) from customer c where exists "
+        "(select 1 from orders o where o.o_custkey = c.c_custkey)"
+    )
+    o = tpch_pandas("tiny", "orders")
+    assert res.only_value() == o.o_custkey.nunique()
+
+
+# -- correlated IN ------------------------------------------------------------
+
+
+def test_correlated_in(runner):
+    # orders whose orderkey appears in lineitem rows of the same order with
+    # quantity above a threshold (correlation + IN value)
+    res = runner.execute(
+        "select count(*) from orders o where o.o_orderkey in "
+        "(select l.l_orderkey from lineitem l where l.l_orderkey = o.o_orderkey "
+        "and l.l_quantity > 49)"
+    )
+    li = tpch_pandas("tiny", "lineitem")
+    expected = li[li.l_quantity > 49].l_orderkey.nunique()
+    assert res.only_value() == expected
+
+
+# -- mixed DISTINCT aggregates ------------------------------------------------
+
+
+def test_mixed_distinct_and_plain(runner):
+    res = runner.execute(
+        "select count(distinct n_regionkey), count(*), sum(n_nationkey) from nation"
+    )
+    assert res.rows == [(5, 25, 300)]
+
+
+def test_two_distinct_args(runner):
+    res = runner.execute(
+        "select count(distinct o_orderstatus), count(distinct o_orderpriority) from orders"
+    )
+    o = tpch_pandas("tiny", "orders")
+    assert res.rows == [(o.o_orderstatus.nunique(), o.o_orderpriority.nunique())]
+
+
+def test_grouped_mixed_distinct(runner):
+    res = runner.execute(
+        "select n_regionkey, count(distinct n_name), count(*) from nation "
+        "group by n_regionkey order by n_regionkey"
+    )
+    n = tpch_pandas("tiny", "nation")
+    g = n.groupby("n_regionkey")
+    expected = [
+        (int(k), int(v.n_name.nunique()), int(len(v))) for k, v in g
+    ]
+    assert res.rows == expected
+
+
+def test_sum_distinct(runner):
+    res = runner.execute(
+        "select sum(distinct n_regionkey), count(*) from nation"
+    )
+    assert res.rows == [(10, 25)]

@@ -72,10 +72,59 @@ def test_demographics_crossproduct(runner):
     assert g == [(2,)]
 
 
+def _norm(v):
+    import datetime
+    import decimal
+    import math
+
+    if isinstance(v, decimal.Decimal):
+        return float(v)
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()
+    if isinstance(v, float) and math.isnan(v):
+        return None
+    return v
+
+
+def _approx(a, b, atol=0.02):
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        try:
+            fa, fb = float(a), float(b)
+        except (TypeError, ValueError):
+            return False
+        return abs(fa - fb) <= atol + 1e-6 * max(abs(fa), abs(fb))
+    return a == b
+
+
+def assert_same_rows(actual, expected):
+    actual = [tuple(_norm(v) for v in r) for r in actual]
+    expected = [tuple(_norm(v) for v in r) for r in expected]
+    assert len(actual) == len(expected), (
+        f"row count {len(actual)} != {len(expected)}\n"
+        f"actual[:3]={actual[:3]}\nexpected[:3]={expected[:3]}"
+    )
+    key = lambda r: tuple("\0" if v is None else str(v) for v in r)
+    for i, (ra, re_) in enumerate(
+        zip(sorted(actual, key=key), sorted(expected, key=key))
+    ):
+        assert len(ra) == len(re_), f"row {i} width"
+        for j, (va, ve) in enumerate(zip(ra, re_)):
+            assert _approx(va, ve), (
+                f"row {i} col {j}: {va!r} != {ve!r}\n{ra}\n{re_}"
+            )
+
+
 @pytest.mark.parametrize("qid", sorted(QUERIES))
-def test_tpcds_queries_run(runner, qid):
-    res = runner.execute(QUERIES[qid])
-    assert res.row_count >= 0  # executes end-to-end; cardinality checked below
+def test_tpcds_query_vs_oracle(runner, qid):
+    """Every workload query executes end-to-end AND matches the independent
+    sqlite3 oracle (reference style: H2QueryRunner assertQuery)."""
+    from tests.tpcds_oracle import run_sqlite
+
+    engine = runner.execute(QUERIES[qid])
+    oracle = run_sqlite(QUERIES[qid])
+    assert_same_rows(engine.rows, oracle)
 
 
 def test_q96_matches_pandas(runner):

@@ -1,0 +1,117 @@
+"""TPC-DS correctness oracle backed by sqlite3 (stdlib).
+
+Reference role: H2QueryRunner + QueryAssertions.assertQuery
+(testing/trino-testing/.../H2QueryRunner.java) — an independent SQL engine
+executes the same workload text over the same data and the results are
+compared.  sqlite3 plays H2's part; the generated tables are loaded once per
+schema with logical values (dictionary codes decoded, decimal cents scaled
+to floats, dates as ISO strings).
+
+A tiny rewrite layer bridges dialect gaps the way H2QueryRunner rewrites
+types: DATE casts/literals become strings, `+ interval 'n' day` becomes
+sqlite's date(x, '+n day').
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+
+import numpy as np
+
+_CONNS: dict = {}
+
+
+def _logical_values(cd, col_type):
+    from trino_tpu import types as T
+
+    vals = np.asarray(cd.values)
+    if cd.dictionary is not None:
+        dec = np.asarray(cd.dictionary.values, dtype=object)[
+            vals.astype(np.int64)
+        ]
+        out = dec.tolist()
+    elif isinstance(col_type, T.DecimalType):
+        out = (vals.astype(np.float64) / (10.0 ** col_type.scale)).tolist()
+    elif col_type is T.DATE:
+        import datetime
+
+        epoch = datetime.date(1970, 1, 1)
+        out = [
+            (epoch + datetime.timedelta(days=int(v))).isoformat() for v in vals
+        ]
+    elif vals.dtype == np.bool_:
+        out = vals.astype(np.int64).tolist()
+    else:
+        out = vals.tolist()
+    if cd.valid is not None:
+        valid = np.asarray(cd.valid)
+        out = [v if ok else None for v, ok in zip(out, valid)]
+    return out
+
+
+def tpcds_sqlite(schema: str = "tiny") -> sqlite3.Connection:
+    if schema in _CONNS:
+        return _CONNS[schema]
+    from trino_tpu.connectors.api import TableHandle
+    from trino_tpu.connectors.tpcds import TpcdsConnector
+    from trino_tpu.connectors.tpcds.schema import TABLES
+
+    conn = sqlite3.connect(":memory:")
+    c = TpcdsConnector()
+    meta = c.metadata()
+    for table in TABLES:
+        tm = meta.table_metadata(schema, table)
+        names = [cm.name for cm in tm.columns]
+        conn.execute(
+            f"create table {table} ({', '.join(names)})"
+        )
+        handle = TableHandle("tpcds", schema, table)
+        rows_cols = None
+        for split in c.splits(handle, target_splits=1):
+            src = c.page_source(split, names, max_rows_per_page=1 << 22)
+            for page in src.pages():
+                cols = [
+                    _logical_values(cd, cm.type)
+                    for cd, cm in zip(page, tm.columns)
+                ]
+                rows = list(zip(*cols)) if cols else []
+                if rows:
+                    ph = ", ".join("?" * len(names))
+                    conn.executemany(
+                        f"insert into {table} values ({ph})", rows
+                    )
+    conn.commit()
+    _CONNS[schema] = conn
+    return conn
+
+
+def _sqlite_dialect(sql: str) -> str:
+    """Engine dialect -> sqlite dialect (the H2QueryRunner-rewrite role)."""
+    # cast(col as date) -> col ; cast('lit' as date) -> 'lit'
+    sql = re.sub(
+        r"cast\(\s*([\w.]+|'[^']*')\s+as\s+date\s*\)", r"\1", sql,
+        flags=re.IGNORECASE,
+    )
+    # date 'x' -> 'x'
+    sql = re.sub(r"\bdate\s+('[^']*')", r"\1", sql, flags=re.IGNORECASE)
+    # X + interval 'n' day -> date(X, '+n day')
+    sql = re.sub(
+        r"('[^']*'|[\w.]+)\s*\+\s*interval\s*'(\d+)'\s*day",
+        r"date(\1, '+\2 day')",
+        sql,
+        flags=re.IGNORECASE,
+    )
+    sql = re.sub(
+        r"('[^']*'|[\w.]+)\s*-\s*interval\s*'(\d+)'\s*day",
+        r"date(\1, '-\2 day')",
+        sql,
+        flags=re.IGNORECASE,
+    )
+    return sql
+
+
+def run_sqlite(sql: str, schema: str = "tiny") -> list[tuple]:
+    conn = tpcds_sqlite(schema)
+    cur = conn.execute(_sqlite_dialect(sql))
+    return [tuple(r) for r in cur.fetchall()]

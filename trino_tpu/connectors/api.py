@@ -154,6 +154,15 @@ class Connector:
     ) -> PageSource:
         raise NotImplementedError
 
+    def scan_version(self, handle: TableHandle):
+        """Cache token for scan results of `handle`: scans of the same split
+        + columns + version may be served from the engine's buffer pool.
+        Return None (default) if the data can change without a version bump
+        — such tables are never cached.  Immutable/generated tables return a
+        constant.  (Reference role: the split-level caching contract file
+        connectors get from immutable files + OS page cache.)"""
+        return None
+
     # -- write path (memory/blackhole connectors; reference: ConnectorPageSink)
 
     def supports_writes(self) -> bool:

@@ -78,6 +78,9 @@ class TpcdsConnector(Connector):
     def metadata(self) -> TpcdsMetadata:
         return self._metadata
 
+    def scan_version(self, handle):
+        return 0  # generated data is immutable per (schema, table)
+
     def splits(self, handle: TableHandle, target_splits: int, predicate=None):
         sf = ds_schema.schema_scale(handle.schema)
         n = generator(sf).row_count(handle.table)

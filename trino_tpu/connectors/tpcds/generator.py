@@ -207,11 +207,11 @@ class TpcdsGenerator:
             return self._date_fk(table, stream, idx)
         if col.endswith("_time_sk"):
             vals = randint(stream, idx, 0, 86_399)
-            return self._nullable(stream, vals, table)
+            return self._nullable(stream, vals, table, idx)
         for suffix, ref in _FK_SUFFIX:
             if col.endswith(suffix):
                 vals = randint(stream, idx, 1, self.row_count(ref))
-                return self._nullable(stream, vals, table)
+                return self._nullable(stream, vals, table, idx)
         if col.endswith("_id"):
             prefix = col[: col.index("_")].upper() + "-"
             d = _pat(prefix, 12, max(n, 1))
@@ -244,16 +244,20 @@ class TpcdsGenerator:
         # ss_sold_date_sk)
         return table not in _FACTS and TABLES[table][0][0] == col
 
-    def _nullable(self, stream: str, vals, table: str, pct: int = 25):
-        """Fact-table FKs are ~4% NULL (spec allows nulls in fact FKs)."""
+    def _nullable(self, stream: str, vals, table: str, idx, pct: int = 25):
+        """Fact-table FKs are ~4% NULL (spec allows nulls in fact FKs).
+        The null stream MUST be driven by the global row index `idx`, never a
+        slice-local arange — generated data has to be identical under any
+        split slicing (round-3 fix: multi-split scans produced different
+        masks than single-split scans)."""
         if table not in _FACTS:
             return ColumnData(vals, None)
-        valid = randint(stream + ".null", np.arange(len(vals)) + vals, 0, pct) != 0
+        valid = randint(stream + ".null", idx + vals, 0, pct) != 0
         return ColumnData(vals, valid)
 
     def _date_fk(self, table: str, stream: str, idx) -> ColumnData:
         vals = SALES_START + randint(stream, idx, 0, SALES_DAYS - 1)
-        return self._nullable(stream, vals, table)
+        return self._nullable(stream, vals, table, idx)
 
     # -- calendar dimensions --------------------------------------------------
 

@@ -209,4 +209,1123 @@ where cs1.item_sk = cs2.item_sk
   and cs1.store_zip = cs2.store_zip
 order by cs1.product_name, cs1.store_name, cnt2, s12, s22
 """,
+    6: """
+SELECT
+  a.ca_state STATE
+, count(*) cnt
+FROM
+  customer_address a
+, customer c
+, store_sales s
+, date_dim d
+, item i
+WHERE (a.ca_address_sk = c.c_current_addr_sk)
+   AND (c.c_customer_sk = s.ss_customer_sk)
+   AND (s.ss_sold_date_sk = d.d_date_sk)
+   AND (s.ss_item_sk = i.i_item_sk)
+   AND (d.d_month_seq = (
+      SELECT DISTINCT d_month_seq
+      FROM
+        date_dim
+      WHERE (d_year = 2001)
+         AND (d_moy = 1)
+   ))
+   AND (i.i_current_price > (1.2 * (
+         SELECT avg(j.i_current_price)
+         FROM
+           item j
+         WHERE (j.i_category = i.i_category)
+      )))
+GROUP BY a.ca_state
+HAVING (count(*) >= 10)
+ORDER BY cnt ASC, a.ca_state ASC
+LIMIT 100
+""",
+    12: """
+SELECT
+  i_item_id
+, i_item_desc
+, i_category
+, i_class
+, i_current_price
+, sum(ws_ext_sales_price) itemrevenue
+, ((sum(ws_ext_sales_price) * 100) / sum(sum(ws_ext_sales_price)) OVER (PARTITION BY i_class)) revenueratio
+FROM
+  web_sales
+, item
+, date_dim
+WHERE (ws_item_sk = i_item_sk)
+   AND (i_category IN ('Sports', 'Books', 'Home'))
+   AND (ws_sold_date_sk = d_date_sk)
+   AND (CAST(d_date AS DATE) BETWEEN CAST('1999-02-22' AS DATE) AND (CAST('1999-02-22' AS DATE) + INTERVAL  '30' DAY))
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category ASC, i_class ASC, i_item_id ASC, i_item_desc ASC, revenueratio ASC
+LIMIT 100
+""",
+    13: """
+SELECT
+  avg(ss_quantity)
+, avg(ss_ext_sales_price)
+, avg(ss_ext_wholesale_cost)
+, sum(ss_ext_wholesale_cost)
+FROM
+  store_sales
+, store
+, customer_demographics
+, household_demographics
+, customer_address
+, date_dim
+WHERE (s_store_sk = ss_store_sk)
+   AND (ss_sold_date_sk = d_date_sk)
+   AND (d_year = 2001)
+   AND (((ss_hdemo_sk = hd_demo_sk)
+         AND (cd_demo_sk = ss_cdemo_sk)
+         AND (cd_marital_status = 'M')
+         AND (cd_education_status = 'Advanced Degree')
+         AND (ss_sales_price BETWEEN 100.00 AND 150.00)
+         AND (hd_dep_count = 3))
+      OR ((ss_hdemo_sk = hd_demo_sk)
+         AND (cd_demo_sk = ss_cdemo_sk)
+         AND (cd_marital_status = 'S')
+         AND (cd_education_status = 'College')
+         AND (ss_sales_price BETWEEN 50.00 AND 100.00)
+         AND (hd_dep_count = 1))
+      OR ((ss_hdemo_sk = hd_demo_sk)
+         AND (cd_demo_sk = ss_cdemo_sk)
+         AND (cd_marital_status = 'W')
+         AND (cd_education_status = '2 yr Degree')
+         AND (ss_sales_price BETWEEN 150.00 AND 200.00)
+         AND (hd_dep_count = 1)))
+   AND (((ss_addr_sk = ca_address_sk)
+         AND (ca_country = 'United States')
+         AND (ca_state IN ('TX'      , 'OH'      , 'TX'))
+         AND (ss_net_profit BETWEEN 100 AND 200))
+      OR ((ss_addr_sk = ca_address_sk)
+         AND (ca_country = 'United States')
+         AND (ca_state IN ('OR'      , 'NM'      , 'KY'))
+         AND (ss_net_profit BETWEEN 150 AND 300))
+      OR ((ss_addr_sk = ca_address_sk)
+         AND (ca_country = 'United States')
+         AND (ca_state IN ('VA'      , 'TX'      , 'MS'))
+         AND (ss_net_profit BETWEEN 50 AND 250)))
+""",
+    15: """
+SELECT
+  ca_zip
+, sum(cs_sales_price)
+FROM
+  catalog_sales
+, customer
+, customer_address
+, date_dim
+WHERE (cs_bill_customer_sk = c_customer_sk)
+   AND (c_current_addr_sk = ca_address_sk)
+   AND ((substr(ca_zip, 1, 5) IN ('85669'   , '86197'   , '88274'   , '83405'   , '86475'   , '85392'   , '85460'   , '80348'   , '81792'))
+      OR (ca_state IN ('CA'   , 'WA'   , 'GA'))
+      OR (cs_sales_price > 500))
+   AND (cs_sold_date_sk = d_date_sk)
+   AND (d_qoy = 2)
+   AND (d_year = 2001)
+GROUP BY ca_zip
+ORDER BY ca_zip ASC
+LIMIT 100
+""",
+    19: """
+SELECT
+  i_brand_id brand_id
+, i_brand brand
+, i_manufact_id
+, i_manufact
+, sum(ss_ext_sales_price) ext_price
+FROM
+  date_dim
+, store_sales
+, item
+, customer
+, customer_address
+, store
+WHERE (d_date_sk = ss_sold_date_sk)
+   AND (ss_item_sk = i_item_sk)
+   AND (i_manager_id = 8)
+   AND (d_moy = 11)
+   AND (d_year = 1998)
+   AND (ss_customer_sk = c_customer_sk)
+   AND (c_current_addr_sk = ca_address_sk)
+   AND (substr(ca_zip, 1, 5) <> substr(s_zip, 1, 5))
+   AND (ss_store_sk = s_store_sk)
+GROUP BY i_brand, i_brand_id, i_manufact_id, i_manufact
+ORDER BY ext_price DESC, i_brand ASC, i_brand_id ASC, i_manufact_id ASC, i_manufact ASC
+LIMIT 100
+""",
+    20: """
+SELECT
+  i_item_id
+, i_item_desc
+, i_category
+, i_class
+, i_current_price
+, sum(cs_ext_sales_price) itemrevenue
+, ((sum(cs_ext_sales_price) * 100) / sum(sum(cs_ext_sales_price)) OVER (PARTITION BY i_class)) revenueratio
+FROM
+  catalog_sales
+, item
+, date_dim
+WHERE (cs_item_sk = i_item_sk)
+   AND (i_category IN ('Sports', 'Books', 'Home'))
+   AND (cs_sold_date_sk = d_date_sk)
+   AND (CAST(d_date AS DATE) BETWEEN CAST('1999-02-22' AS DATE) AND (CAST('1999-02-22' AS DATE) + INTERVAL  '30' DAY))
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category ASC, i_class ASC, i_item_id ASC, i_item_desc ASC, revenueratio ASC
+LIMIT 100
+""",
+    21: """
+SELECT *
+FROM
+  (
+   SELECT
+     w_warehouse_name
+   , i_item_id
+   , sum((CASE WHEN (CAST(d_date AS DATE) < CAST('2000-03-11' AS DATE)) THEN inv_quantity_on_hand ELSE 0 END)) inv_before
+   , sum((CASE WHEN (CAST(d_date AS DATE) >= CAST('2000-03-11' AS DATE)) THEN inv_quantity_on_hand ELSE 0 END)) inv_after
+   FROM
+     inventory
+   , warehouse
+   , item
+   , date_dim
+   WHERE (i_current_price BETWEEN 0.99 AND 1.49)
+      AND (i_item_sk = inv_item_sk)
+      AND (inv_warehouse_sk = w_warehouse_sk)
+      AND (inv_date_sk = d_date_sk)
+      AND (d_date BETWEEN (CAST('2000-03-11' AS DATE) - INTERVAL  '30' DAY) AND (CAST('2000-03-11' AS DATE) + INTERVAL  '30' DAY))
+   GROUP BY w_warehouse_name, i_item_id
+)  x
+WHERE ((CASE WHEN (inv_before > 0) THEN (CAST(inv_after AS DECIMAL(7,2)) / inv_before) ELSE null END) BETWEEN (2.00 / 3.00) AND (3.00 / 2.00))
+ORDER BY w_warehouse_name ASC, i_item_id ASC
+LIMIT 100
+""",
+    25: """
+SELECT
+  i_item_id
+, i_item_desc
+, s_store_id
+, s_store_name
+, sum(ss_net_profit) store_sales_profit
+, sum(sr_net_loss) store_returns_loss
+, sum(cs_net_profit) catalog_sales_profit
+FROM
+  store_sales
+, store_returns
+, catalog_sales
+, date_dim d1
+, date_dim d2
+, date_dim d3
+, store
+, item
+WHERE (d1.d_moy = 4)
+   AND (d1.d_year = 2001)
+   AND (d1.d_date_sk = ss_sold_date_sk)
+   AND (i_item_sk = ss_item_sk)
+   AND (s_store_sk = ss_store_sk)
+   AND (ss_customer_sk = sr_customer_sk)
+   AND (ss_item_sk = sr_item_sk)
+   AND (ss_ticket_number = sr_ticket_number)
+   AND (sr_returned_date_sk = d2.d_date_sk)
+   AND (d2.d_moy BETWEEN 4 AND 10)
+   AND (d2.d_year = 2001)
+   AND (sr_customer_sk = cs_bill_customer_sk)
+   AND (sr_item_sk = cs_item_sk)
+   AND (cs_sold_date_sk = d3.d_date_sk)
+   AND (d3.d_moy BETWEEN 4 AND 10)
+   AND (d3.d_year = 2001)
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id ASC, i_item_desc ASC, s_store_id ASC, s_store_name ASC
+LIMIT 100
+""",
+    26: """
+SELECT
+  i_item_id
+, avg(cs_quantity) agg1
+, avg(cs_list_price) agg2
+, avg(cs_coupon_amt) agg3
+, avg(cs_sales_price) agg4
+FROM
+  catalog_sales
+, customer_demographics
+, date_dim
+, item
+, promotion
+WHERE (cs_sold_date_sk = d_date_sk)
+   AND (cs_item_sk = i_item_sk)
+   AND (cs_bill_cdemo_sk = cd_demo_sk)
+   AND (cs_promo_sk = p_promo_sk)
+   AND (cd_gender = 'M')
+   AND (cd_marital_status = 'S')
+   AND (cd_education_status = 'College')
+   AND ((p_channel_email = 'N')
+      OR (p_channel_event = 'N'))
+   AND (d_year = 2000)
+GROUP BY i_item_id
+ORDER BY i_item_id ASC
+LIMIT 100
+""",
+    29: """
+SELECT
+  i_item_id
+, i_item_desc
+, s_store_id
+, s_store_name
+, sum(ss_quantity) store_sales_quantity
+, sum(sr_return_quantity) store_returns_quantity
+, sum(cs_quantity) catalog_sales_quantity
+FROM
+  store_sales
+, store_returns
+, catalog_sales
+, date_dim d1
+, date_dim d2
+, date_dim d3
+, store
+, item
+WHERE (d1.d_moy = 9)
+   AND (d1.d_year = 1999)
+   AND (d1.d_date_sk = ss_sold_date_sk)
+   AND (i_item_sk = ss_item_sk)
+   AND (s_store_sk = ss_store_sk)
+   AND (ss_customer_sk = sr_customer_sk)
+   AND (ss_item_sk = sr_item_sk)
+   AND (ss_ticket_number = sr_ticket_number)
+   AND (sr_returned_date_sk = d2.d_date_sk)
+   AND (d2.d_moy BETWEEN 9 AND (9 + 3))
+   AND (d2.d_year = 1999)
+   AND (sr_customer_sk = cs_bill_customer_sk)
+   AND (sr_item_sk = cs_item_sk)
+   AND (cs_sold_date_sk = d3.d_date_sk)
+   AND (d3.d_year IN (1999, (1999 + 1), (1999 + 2)))
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id ASC, i_item_desc ASC, s_store_id ASC, s_store_name ASC
+LIMIT 100
+""",
+    37: """
+SELECT
+  i_item_id
+, i_item_desc
+, i_current_price
+FROM
+  item
+, inventory
+, date_dim
+, catalog_sales
+WHERE (i_current_price BETWEEN 68 AND (68 + 30))
+   AND (inv_item_sk = i_item_sk)
+   AND (d_date_sk = inv_date_sk)
+   AND (CAST(d_date AS DATE) BETWEEN CAST('2000-02-01' AS DATE) AND (CAST('2000-02-01' AS DATE) + INTERVAL  '60' DAY))
+   AND (i_manufact_id IN (677, 940, 694, 808))
+   AND (inv_quantity_on_hand BETWEEN 100 AND 500)
+   AND (cs_item_sk = i_item_sk)
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id ASC
+LIMIT 100
+""",
+    43: """
+SELECT
+  s_store_name
+, s_store_id
+, sum((CASE WHEN (d_day_name = 'Sunday') THEN ss_sales_price ELSE null END)) sun_sales
+, sum((CASE WHEN (d_day_name = 'Monday') THEN ss_sales_price ELSE null END)) mon_sales
+, sum((CASE WHEN (d_day_name = 'Tuesday') THEN ss_sales_price ELSE null END)) tue_sales
+, sum((CASE WHEN (d_day_name = 'Wednesday') THEN ss_sales_price ELSE null END)) wed_sales
+, sum((CASE WHEN (d_day_name = 'Thursday') THEN ss_sales_price ELSE null END)) thu_sales
+, sum((CASE WHEN (d_day_name = 'Friday') THEN ss_sales_price ELSE null END)) fri_sales
+, sum((CASE WHEN (d_day_name = 'Saturday') THEN ss_sales_price ELSE null END)) sat_sales
+FROM
+  date_dim
+, store_sales
+, store
+WHERE (d_date_sk = ss_sold_date_sk)
+   AND (s_store_sk = ss_store_sk)
+   AND (s_gmt_offset = -5)
+   AND (d_year = 2000)
+GROUP BY s_store_name, s_store_id
+ORDER BY s_store_name ASC, s_store_id ASC, sun_sales ASC, mon_sales ASC, tue_sales ASC, wed_sales ASC, thu_sales ASC, fri_sales ASC, sat_sales ASC
+LIMIT 100
+""",
+    46: """
+SELECT
+  c_last_name
+, c_first_name
+, ca_city
+, bought_city
+, ss_ticket_number
+, amt
+, profit
+FROM
+  (
+   SELECT
+     ss_ticket_number
+   , ss_customer_sk
+   , ca_city bought_city
+   , sum(ss_coupon_amt) amt
+   , sum(ss_net_profit) profit
+   FROM
+     store_sales
+   , date_dim
+   , store
+   , household_demographics
+   , customer_address
+   WHERE (store_sales.ss_sold_date_sk = date_dim.d_date_sk)
+      AND (store_sales.ss_store_sk = store.s_store_sk)
+      AND (store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (store_sales.ss_addr_sk = customer_address.ca_address_sk)
+      AND ((household_demographics.hd_dep_count = 4)
+         OR (household_demographics.hd_vehicle_count = 3))
+      AND (date_dim.d_dow IN (6   , 0))
+      AND (date_dim.d_year IN (1999   , (1999 + 1)   , (1999 + 2)))
+      AND (store.s_city IN ('Fairview'   , 'Midway'   , 'Fairview'   , 'Fairview'   , 'Fairview'))
+   GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city
+)  dn
+, customer
+, customer_address current_addr
+WHERE (ss_customer_sk = c_customer_sk)
+   AND (customer.c_current_addr_sk = current_addr.ca_address_sk)
+   AND (current_addr.ca_city <> bought_city)
+ORDER BY c_last_name ASC, c_first_name ASC, ca_city ASC, bought_city ASC, ss_ticket_number ASC
+LIMIT 100
+""",
+    48: """
+SELECT sum(ss_quantity)
+FROM
+  store_sales
+, store
+, customer_demographics
+, customer_address
+, date_dim
+WHERE (s_store_sk = ss_store_sk)
+   AND (ss_sold_date_sk = d_date_sk)
+   AND (d_year = 2000)
+   AND (((cd_demo_sk = ss_cdemo_sk)
+         AND (cd_marital_status = 'M')
+         AND (cd_education_status = '4 yr Degree')
+         AND (ss_sales_price BETWEEN 100.00 AND 150.00))
+      OR ((cd_demo_sk = ss_cdemo_sk)
+         AND (cd_marital_status = 'D')
+         AND (cd_education_status = '2 yr Degree')
+         AND (ss_sales_price BETWEEN 50.00 AND 100.00))
+      OR ((cd_demo_sk = ss_cdemo_sk)
+         AND (cd_marital_status = 'S')
+         AND (cd_education_status = 'College')
+         AND (ss_sales_price BETWEEN 150.00 AND 200.00)))
+   AND (((ss_addr_sk = ca_address_sk)
+         AND (ca_country = 'United States')
+         AND (ca_state IN ('CO'      , 'OH'      , 'TX'))
+         AND (ss_net_profit BETWEEN 0 AND 2000))
+      OR ((ss_addr_sk = ca_address_sk)
+         AND (ca_country = 'United States')
+         AND (ca_state IN ('OR'      , 'MN'      , 'KY'))
+         AND (ss_net_profit BETWEEN 150 AND 3000))
+      OR ((ss_addr_sk = ca_address_sk)
+         AND (ca_country = 'United States')
+         AND (ca_state IN ('VA'      , 'CA'      , 'MS'))
+         AND (ss_net_profit BETWEEN 50 AND 25000)))
+""",
+    50: """
+SELECT
+  s_store_name
+, s_company_id
+, s_street_number
+, s_street_name
+, s_street_type
+, s_suite_number
+, s_city
+, s_county
+, s_state
+, s_zip
+, sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) <= 30) THEN 1 ELSE 0 END)) 30 days
+, sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) > 30)
+   AND ((sr_returned_date_sk - ss_sold_date_sk) <= 60) THEN 1 ELSE 0 END)) 31-60 days
+, sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) > 60)
+   AND ((sr_returned_date_sk - ss_sold_date_sk) <= 90) THEN 1 ELSE 0 END)) 61-90 days
+, sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) > 90)
+   AND ((sr_returned_date_sk - ss_sold_date_sk) <= 120) THEN 1 ELSE 0 END)) 91-120 days
+, sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) > 120) THEN 1 ELSE 0 END)) >120 days
+FROM
+  store_sales
+, store_returns
+, store
+, date_dim d1
+, date_dim d2
+WHERE (d2.d_year = 2001)
+   AND (d2.d_moy = 8)
+   AND (ss_ticket_number = sr_ticket_number)
+   AND (ss_item_sk = sr_item_sk)
+   AND (ss_sold_date_sk = d1.d_date_sk)
+   AND (sr_returned_date_sk = d2.d_date_sk)
+   AND (ss_customer_sk = sr_customer_sk)
+   AND (ss_store_sk = s_store_sk)
+GROUP BY s_store_name, s_company_id, s_street_number, s_street_name, s_street_type, s_suite_number, s_city, s_county, s_state, s_zip
+ORDER BY s_store_name ASC, s_company_id ASC, s_street_number ASC, s_street_name ASC, s_street_type ASC, s_suite_number ASC, s_city ASC, s_county ASC, s_state ASC, s_zip ASC
+LIMIT 100
+""",
+    53: """
+SELECT *
+FROM
+  (
+   SELECT
+     i_manufact_id
+   , sum(ss_sales_price) sum_sales
+   , avg(sum(ss_sales_price)) OVER (PARTITION BY i_manufact_id) avg_quarterly_sales
+   FROM
+     item
+   , store_sales
+   , date_dim
+   , store
+   WHERE (ss_item_sk = i_item_sk)
+      AND (ss_sold_date_sk = d_date_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (d_month_seq IN (1200   , (1200 + 1)   , (1200 + 2)   , (1200 + 3)   , (1200 + 4)   , (1200 + 5)   , (1200 + 6)   , (1200 + 7)   , (1200 + 8)   , (1200 + 9)   , (1200 + 10)   , (1200 + 11)))
+      AND (((i_category IN ('Books'         , 'Children'         , 'Electronics'))
+            AND (i_class IN ('personal'         , 'portable'         , 'reference'         , 'self-help'))
+            AND (i_brand IN ('scholaramalgamalg #14'         , 'scholaramalgamalg #7'         , 'exportiunivamalg #9'         , 'scholaramalgamalg #9')))
+         OR ((i_category IN ('Women'         , 'Music'         , 'Men'))
+            AND (i_class IN ('accessories'         , 'classical'         , 'fragrances'         , 'pants'))
+            AND (i_brand IN ('amalgimporto #1'         , 'edu packscholar #1'         , 'exportiimporto #1'         , 'importoamalg #1'))))
+   GROUP BY i_manufact_id, d_qoy
+)  tmp1
+WHERE ((CASE WHEN (avg_quarterly_sales > 0) THEN (abs((CAST(sum_sales AS DECIMAL(38,4)) - avg_quarterly_sales)) / avg_quarterly_sales) ELSE null END) > 0.1)
+ORDER BY avg_quarterly_sales ASC, sum_sales ASC, i_manufact_id ASC
+LIMIT 100
+""",
+    59: """
+WITH
+  wss AS (
+   SELECT
+     d_week_seq
+   , ss_store_sk
+   , sum((CASE WHEN (d_day_name = 'Sunday') THEN ss_sales_price ELSE null END)) sun_sales
+   , sum((CASE WHEN (d_day_name = 'Monday') THEN ss_sales_price ELSE null END)) mon_sales
+   , sum((CASE WHEN (d_day_name = 'Tuesday') THEN ss_sales_price ELSE null END)) tue_sales
+   , sum((CASE WHEN (d_day_name = 'Wednesday') THEN ss_sales_price ELSE null END)) wed_sales
+   , sum((CASE WHEN (d_day_name = 'Thursday') THEN ss_sales_price ELSE null END)) thu_sales
+   , sum((CASE WHEN (d_day_name = 'Friday') THEN ss_sales_price ELSE null END)) fri_sales
+   , sum((CASE WHEN (d_day_name = 'Saturday') THEN ss_sales_price ELSE null END)) sat_sales
+   FROM
+     store_sales
+   , date_dim
+   WHERE (d_date_sk = ss_sold_date_sk)
+   GROUP BY d_week_seq, ss_store_sk
+)
+SELECT
+  s_store_name1
+, s_store_id1
+, d_week_seq1
+, (sun_sales1 / sun_sales2)
+, (mon_sales1 / mon_sales2)
+, (tue_sales1 / tue_sales2)
+, (wed_sales1 / wed_sales2)
+, (thu_sales1 / thu_sales2)
+, (fri_sales1 / fri_sales2)
+, (sat_sales1 / sat_sales2)
+FROM
+  (
+   SELECT
+     s_store_name s_store_name1
+   , wss.d_week_seq d_week_seq1
+   , s_store_id s_store_id1
+   , sun_sales sun_sales1
+   , mon_sales mon_sales1
+   , tue_sales tue_sales1
+   , wed_sales wed_sales1
+   , thu_sales thu_sales1
+   , fri_sales fri_sales1
+   , sat_sales sat_sales1
+   FROM
+     wss
+   , store
+   , date_dim d
+   WHERE (d.d_week_seq = wss.d_week_seq)
+      AND (ss_store_sk = s_store_sk)
+      AND (d_month_seq BETWEEN 1212 AND (1212 + 11))
+)  y
+, (
+   SELECT
+     s_store_name s_store_name2
+   , wss.d_week_seq d_week_seq2
+   , s_store_id s_store_id2
+   , sun_sales sun_sales2
+   , mon_sales mon_sales2
+   , tue_sales tue_sales2
+   , wed_sales wed_sales2
+   , thu_sales thu_sales2
+   , fri_sales fri_sales2
+   , sat_sales sat_sales2
+   FROM
+     wss
+   , store
+   , date_dim d
+   WHERE (d.d_week_seq = wss.d_week_seq)
+      AND (ss_store_sk = s_store_sk)
+      AND (d_month_seq BETWEEN (1212 + 12) AND (1212 + 23))
+)  x
+WHERE (s_store_id1 = s_store_id2)
+   AND (d_week_seq1 = (d_week_seq2 - 52))
+ORDER BY s_store_name1 ASC, s_store_id1 ASC, d_week_seq1 ASC
+LIMIT 100
+""",
+    62: """
+SELECT
+  substr(w_warehouse_name, 1, 20)
+, sm_type
+, web_name
+, sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) <= 30) THEN 1 ELSE 0 END)) 30 days
+, sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) > 30)
+   AND ((ws_ship_date_sk - ws_sold_date_sk) <= 60) THEN 1 ELSE 0 END)) 31-60 days
+, sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) > 60)
+   AND ((ws_ship_date_sk - ws_sold_date_sk) <= 90) THEN 1 ELSE 0 END)) 61-90 days
+, sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) > 90)
+   AND ((ws_ship_date_sk - ws_sold_date_sk) <= 120) THEN 1 ELSE 0 END)) 91-120 days
+, sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) > 120) THEN 1 ELSE 0 END)) >120 days
+FROM
+  web_sales
+, warehouse
+, ship_mode
+, web_site
+, date_dim
+WHERE (d_month_seq BETWEEN 1200 AND (1200 + 11))
+   AND (ws_ship_date_sk = d_date_sk)
+   AND (ws_warehouse_sk = w_warehouse_sk)
+   AND (ws_ship_mode_sk = sm_ship_mode_sk)
+   AND (ws_web_site_sk = web_site_sk)
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, web_name
+ORDER BY substr(w_warehouse_name, 1, 20) ASC, sm_type ASC, web_name ASC
+LIMIT 100
+""",
+    63: """
+SELECT *
+FROM
+  (
+   SELECT
+     i_manager_id
+   , sum(ss_sales_price) sum_sales
+   , avg(sum(ss_sales_price)) OVER (PARTITION BY i_manager_id) avg_monthly_sales
+   FROM
+     item
+   , store_sales
+   , date_dim
+   , store
+   WHERE (ss_item_sk = i_item_sk)
+      AND (ss_sold_date_sk = d_date_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (d_month_seq IN (1200   , (1200 + 1)   , (1200 + 2)   , (1200 + 3)   , (1200 + 4)   , (1200 + 5)   , (1200 + 6)   , (1200 + 7)   , (1200 + 8)   , (1200 + 9)   , (1200 + 10)   , (1200 + 11)))
+      AND (((i_category IN ('Books'         , 'Children'         , 'Electronics'))
+            AND (i_class IN ('personal'         , 'portable'         , 'refernece'         , 'self-help'))
+            AND (i_brand IN ('scholaramalgamalg #14'         , 'scholaramalgamalg #7'         , 'exportiunivamalg #9'         , 'scholaramalgamalg #9')))
+         OR ((i_category IN ('Women'         , 'Music'         , 'Men'))
+            AND (i_class IN ('accessories'         , 'classical'         , 'fragrances'         , 'pants'))
+            AND (i_brand IN ('amalgimporto #1'         , 'edu packscholar #1'         , 'exportiimporto #1'         , 'importoamalg #1'))))
+   GROUP BY i_manager_id, d_moy
+)  tmp1
+WHERE ((CASE WHEN (avg_monthly_sales > 0) THEN (abs((sum_sales - avg_monthly_sales)) / avg_monthly_sales) ELSE null END) > 0.1)
+ORDER BY i_manager_id ASC, avg_monthly_sales ASC, sum_sales ASC
+LIMIT 100
+""",
+    65: """
+SELECT
+  s_store_name
+, i_item_desc
+, sc.revenue
+, i_current_price
+, i_wholesale_cost
+, i_brand
+FROM
+  store
+, item
+, (
+   SELECT
+     ss_store_sk
+   , avg(revenue) ave
+   FROM
+     (
+      SELECT
+        ss_store_sk
+      , ss_item_sk
+      , sum(ss_sales_price) revenue
+      FROM
+        store_sales
+      , date_dim
+      WHERE (ss_sold_date_sk = d_date_sk)
+         AND (d_month_seq BETWEEN 1176 AND (1176 + 11))
+      GROUP BY ss_store_sk, ss_item_sk
+   )  sa
+   GROUP BY ss_store_sk
+)  sb
+, (
+   SELECT
+     ss_store_sk
+   , ss_item_sk
+   , sum(ss_sales_price) revenue
+   FROM
+     store_sales
+   , date_dim
+   WHERE (ss_sold_date_sk = d_date_sk)
+      AND (d_month_seq BETWEEN 1176 AND (1176 + 11))
+   GROUP BY ss_store_sk, ss_item_sk
+)  sc
+WHERE (sb.ss_store_sk = sc.ss_store_sk)
+   AND (sc.revenue <= (0.1 * sb.ave))
+   AND (s_store_sk = sc.ss_store_sk)
+   AND (i_item_sk = sc.ss_item_sk)
+ORDER BY s_store_name ASC, i_item_desc ASC,
+   -- additional columns to assure results stability for larger scale factors; this is a deviation from TPC-DS specification
+   i_brand ASC, sc.revenue ASC
+LIMIT 100
+""",
+    73: """
+SELECT
+  c_last_name
+, c_first_name
+, c_salutation
+, c_preferred_cust_flag
+, ss_ticket_number
+, cnt
+FROM
+  (
+   SELECT
+     ss_ticket_number
+   , ss_customer_sk
+   , count(*) cnt
+   FROM
+     store_sales
+   , date_dim
+   , store
+   , household_demographics
+   WHERE (store_sales.ss_sold_date_sk = date_dim.d_date_sk)
+      AND (store_sales.ss_store_sk = store.s_store_sk)
+      AND (store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (date_dim.d_dom BETWEEN 1 AND 2)
+      AND ((household_demographics.hd_buy_potential = '>10000')
+         OR (household_demographics.hd_buy_potential = 'Unknown'))
+      AND (household_demographics.hd_vehicle_count > 0)
+      AND ((CASE WHEN (household_demographics.hd_vehicle_count > 0) THEN (CAST(household_demographics.hd_dep_count AS DECIMAL(7,2)) / household_demographics.hd_vehicle_count) ELSE null END) > 1)
+      AND (date_dim.d_year IN (1999   , (1999 + 1)   , (1999 + 2)))
+      AND (store.s_county IN ('Williamson County'   , 'Franklin Parish'   , 'Bronx County'   , 'Orange County'))
+   GROUP BY ss_ticket_number, ss_customer_sk
+)  dj
+, customer
+WHERE (ss_customer_sk = c_customer_sk)
+   AND (cnt BETWEEN 1 AND 5)
+ORDER BY cnt DESC, c_last_name ASC,
+   -- additional column to assure results stability for larger scale factors; this is a deviation from TPC-DS specification
+   ss_ticket_number ASC
+""",
+    79: """
+SELECT
+  c_last_name
+, c_first_name
+, substr(s_city, 1, 30)
+, ss_ticket_number
+, amt
+, profit
+FROM
+  (
+   SELECT
+     ss_ticket_number
+   , ss_customer_sk
+   , store.s_city
+   , sum(ss_coupon_amt) amt
+   , sum(ss_net_profit) profit
+   FROM
+     store_sales
+   , date_dim
+   , store
+   , household_demographics
+   WHERE (store_sales.ss_sold_date_sk = date_dim.d_date_sk)
+      AND (store_sales.ss_store_sk = store.s_store_sk)
+      AND (store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND ((household_demographics.hd_dep_count = 6)
+         OR (household_demographics.hd_vehicle_count > 2))
+      AND (date_dim.d_dow = 1)
+      AND (date_dim.d_year IN (1999   , (1999 + 1)   , (1999 + 2)))
+      AND (store.s_number_employees BETWEEN 200 AND 295)
+   GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk, store.s_city
+)  ms
+, customer
+WHERE (ss_customer_sk = c_customer_sk)
+ORDER BY c_last_name ASC, c_first_name ASC, substr(s_city, 1, 30) ASC, profit ASC
+LIMIT 100
+""",
+    82: """
+SELECT
+  i_item_id
+, i_item_desc
+, i_current_price
+FROM
+  item
+, inventory
+, date_dim
+, store_sales
+WHERE (i_current_price BETWEEN 62 AND (62 + 30))
+   AND (inv_item_sk = i_item_sk)
+   AND (d_date_sk = inv_date_sk)
+   AND (CAST(d_date AS DATE) BETWEEN CAST('2000-05-25' AS DATE) AND (CAST('2000-05-25' AS DATE) + INTERVAL  '60' DAY))
+   AND (i_manufact_id IN (129, 270, 821, 423))
+   AND (inv_quantity_on_hand BETWEEN 100 AND 500)
+   AND (ss_item_sk = i_item_sk)
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id ASC
+LIMIT 100
+""",
+    84: """
+SELECT
+  c_customer_id customer_id
+, concat(concat(c_last_name, ', '), c_first_name) customername
+FROM
+  customer
+, customer_address
+, customer_demographics
+, household_demographics
+, income_band
+, store_returns
+WHERE (ca_city = 'Edgewood')
+   AND (c_current_addr_sk = ca_address_sk)
+   AND (ib_lower_bound >= 38128)
+   AND (ib_upper_bound <= (38128 + 50000))
+   AND (ib_income_band_sk = hd_income_band_sk)
+   AND (cd_demo_sk = c_current_cdemo_sk)
+   AND (hd_demo_sk = c_current_hdemo_sk)
+   AND (sr_cdemo_sk = cd_demo_sk)
+ORDER BY c_customer_id ASC
+LIMIT 100
+""",
+    88: """
+SELECT *
+FROM
+  (
+   SELECT count(*) h8_30_to_9
+   FROM
+     store_sales
+   , household_demographics
+   , time_dim
+   , store
+   WHERE (ss_sold_time_sk = time_dim.t_time_sk)
+      AND (ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (time_dim.t_hour = 8)
+      AND (time_dim.t_minute >= 30)
+      AND (((household_demographics.hd_dep_count = 4)
+            AND (household_demographics.hd_vehicle_count <= (4 + 2)))
+         OR ((household_demographics.hd_dep_count = 2)
+            AND (household_demographics.hd_vehicle_count <= (2 + 2)))
+         OR ((household_demographics.hd_dep_count = 0)
+            AND (household_demographics.hd_vehicle_count <= (0 + 2))))
+      AND (store.s_store_name = 'ese')
+)  s1
+, (
+   SELECT count(*) h9_to_9_30
+   FROM
+     store_sales
+   , household_demographics
+   , time_dim
+   , store
+   WHERE (ss_sold_time_sk = time_dim.t_time_sk)
+      AND (ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (time_dim.t_hour = 9)
+      AND (time_dim.t_minute < 30)
+      AND (((household_demographics.hd_dep_count = 4)
+            AND (household_demographics.hd_vehicle_count <= (4 + 2)))
+         OR ((household_demographics.hd_dep_count = 2)
+            AND (household_demographics.hd_vehicle_count <= (2 + 2)))
+         OR ((household_demographics.hd_dep_count = 0)
+            AND (household_demographics.hd_vehicle_count <= (0 + 2))))
+      AND (store.s_store_name = 'ese')
+)  s2
+, (
+   SELECT count(*) h9_30_to_10
+   FROM
+     store_sales
+   , household_demographics
+   , time_dim
+   , store
+   WHERE (ss_sold_time_sk = time_dim.t_time_sk)
+      AND (ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (time_dim.t_hour = 9)
+      AND (time_dim.t_minute >= 30)
+      AND (((household_demographics.hd_dep_count = 4)
+            AND (household_demographics.hd_vehicle_count <= (4 + 2)))
+         OR ((household_demographics.hd_dep_count = 2)
+            AND (household_demographics.hd_vehicle_count <= (2 + 2)))
+         OR ((household_demographics.hd_dep_count = 0)
+            AND (household_demographics.hd_vehicle_count <= (0 + 2))))
+      AND (store.s_store_name = 'ese')
+)  s3
+, (
+   SELECT count(*) h10_to_10_30
+   FROM
+     store_sales
+   , household_demographics
+   , time_dim
+   , store
+   WHERE (ss_sold_time_sk = time_dim.t_time_sk)
+      AND (ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (time_dim.t_hour = 10)
+      AND (time_dim.t_minute < 30)
+      AND (((household_demographics.hd_dep_count = 4)
+            AND (household_demographics.hd_vehicle_count <= (4 + 2)))
+         OR ((household_demographics.hd_dep_count = 2)
+            AND (household_demographics.hd_vehicle_count <= (2 + 2)))
+         OR ((household_demographics.hd_dep_count = 0)
+            AND (household_demographics.hd_vehicle_count <= (0 + 2))))
+      AND (store.s_store_name = 'ese')
+)  s4
+, (
+   SELECT count(*) h10_30_to_11
+   FROM
+     store_sales
+   , household_demographics
+   , time_dim
+   , store
+   WHERE (ss_sold_time_sk = time_dim.t_time_sk)
+      AND (ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (time_dim.t_hour = 10)
+      AND (time_dim.t_minute >= 30)
+      AND (((household_demographics.hd_dep_count = 4)
+            AND (household_demographics.hd_vehicle_count <= (4 + 2)))
+         OR ((household_demographics.hd_dep_count = 2)
+            AND (household_demographics.hd_vehicle_count <= (2 + 2)))
+         OR ((household_demographics.hd_dep_count = 0)
+            AND (household_demographics.hd_vehicle_count <= (0 + 2))))
+      AND (store.s_store_name = 'ese')
+)  s5
+, (
+   SELECT count(*) h11_to_11_30
+   FROM
+     store_sales
+   , household_demographics
+   , time_dim
+   , store
+   WHERE (ss_sold_time_sk = time_dim.t_time_sk)
+      AND (ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (time_dim.t_hour = 11)
+      AND (time_dim.t_minute < 30)
+      AND (((household_demographics.hd_dep_count = 4)
+            AND (household_demographics.hd_vehicle_count <= (4 + 2)))
+         OR ((household_demographics.hd_dep_count = 2)
+            AND (household_demographics.hd_vehicle_count <= (2 + 2)))
+         OR ((household_demographics.hd_dep_count = 0)
+            AND (household_demographics.hd_vehicle_count <= (0 + 2))))
+      AND (store.s_store_name = 'ese')
+)  s6
+, (
+   SELECT count(*) h11_30_to_12
+   FROM
+     store_sales
+   , household_demographics
+   , time_dim
+   , store
+   WHERE (ss_sold_time_sk = time_dim.t_time_sk)
+      AND (ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (time_dim.t_hour = 11)
+      AND (time_dim.t_minute >= 30)
+      AND (((household_demographics.hd_dep_count = 4)
+            AND (household_demographics.hd_vehicle_count <= (4 + 2)))
+         OR ((household_demographics.hd_dep_count = 2)
+            AND (household_demographics.hd_vehicle_count <= (2 + 2)))
+         OR ((household_demographics.hd_dep_count = 0)
+            AND (household_demographics.hd_vehicle_count <= (0 + 2))))
+      AND (store.s_store_name = 'ese')
+)  s7
+, (
+   SELECT count(*) h12_to_12_30
+   FROM
+     store_sales
+   , household_demographics
+   , time_dim
+   , store
+   WHERE (ss_sold_time_sk = time_dim.t_time_sk)
+      AND (ss_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (time_dim.t_hour = 12)
+      AND (time_dim.t_minute < 30)
+      AND (((household_demographics.hd_dep_count = 4)
+            AND (household_demographics.hd_vehicle_count <= (4 + 2)))
+         OR ((household_demographics.hd_dep_count = 2)
+            AND (household_demographics.hd_vehicle_count <= (2 + 2)))
+         OR ((household_demographics.hd_dep_count = 0)
+            AND (household_demographics.hd_vehicle_count <= (0 + 2))))
+      AND (store.s_store_name = 'ese')
+)  s8
+""",
+    89: """
+SELECT *
+FROM
+  (
+   SELECT
+     i_category
+   , i_class
+   , i_brand
+   , s_store_name
+   , s_company_name
+   , d_moy
+   , sum(ss_sales_price) sum_sales
+   , avg(sum(ss_sales_price)) OVER (PARTITION BY i_category, i_brand, s_store_name, s_company_name) avg_monthly_sales
+   FROM
+     item
+   , store_sales
+   , date_dim
+   , store
+   WHERE (ss_item_sk = i_item_sk)
+      AND (ss_sold_date_sk = d_date_sk)
+      AND (ss_store_sk = s_store_sk)
+      AND (d_year IN (1999))
+      AND (((i_category IN ('Books'         , 'Electronics'         , 'Sports'))
+            AND (i_class IN ('computers'         , 'stereo'         , 'football')))
+         OR ((i_category IN ('Men'         , 'Jewelry'         , 'Women'))
+            AND (i_class IN ('shirts'         , 'birdal'         , 'dresses'))))
+   GROUP BY i_category, i_class, i_brand, s_store_name, s_company_name, d_moy
+)  tmp1
+WHERE ((CASE WHEN (avg_monthly_sales <> 0) THEN (abs((sum_sales - avg_monthly_sales)) / avg_monthly_sales) ELSE null END) > 0.1)
+ORDER BY (sum_sales - avg_monthly_sales) ASC, s_store_name ASC
+LIMIT 100
+""",
+    90: """
+SELECT (CAST(amc AS DECIMAL(15,4)) / CAST(pmc AS DECIMAL(15,4))) am_pm_ratio
+FROM
+  (
+   SELECT count(*) amc
+   FROM
+     web_sales
+   , household_demographics
+   , time_dim
+   , web_page
+   WHERE (ws_sold_time_sk = time_dim.t_time_sk)
+      AND (ws_ship_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (ws_web_page_sk = web_page.wp_web_page_sk)
+      AND (time_dim.t_hour BETWEEN 8 AND (8 + 1))
+      AND (household_demographics.hd_dep_count = 6)
+      AND (web_page.wp_char_count BETWEEN 5000 AND 5200)
+)  at
+, (
+   SELECT count(*) pmc
+   FROM
+     web_sales
+   , household_demographics
+   , time_dim
+   , web_page
+   WHERE (ws_sold_time_sk = time_dim.t_time_sk)
+      AND (ws_ship_hdemo_sk = household_demographics.hd_demo_sk)
+      AND (ws_web_page_sk = web_page.wp_web_page_sk)
+      AND (time_dim.t_hour BETWEEN 19 AND (19 + 1))
+      AND (household_demographics.hd_dep_count = 6)
+      AND (web_page.wp_char_count BETWEEN 5000 AND 5200)
+)  pt
+ORDER BY am_pm_ratio ASC
+LIMIT 100
+""",
+    91: """
+SELECT
+  cc_call_center_id Call_Center
+, cc_name Call_Center_Name
+, cc_manager Manager
+, sum(cr_net_loss) Returns_Loss
+FROM
+  call_center
+, catalog_returns
+, date_dim
+, customer
+, customer_address
+, customer_demographics
+, household_demographics
+WHERE (cr_call_center_sk = cc_call_center_sk)
+   AND (cr_returned_date_sk = d_date_sk)
+   AND (cr_returning_customer_sk = c_customer_sk)
+   AND (cd_demo_sk = c_current_cdemo_sk)
+   AND (hd_demo_sk = c_current_hdemo_sk)
+   AND (ca_address_sk = c_current_addr_sk)
+   AND (d_year = 1998)
+   AND (d_moy = 11)
+   AND (((cd_marital_status = 'M')
+         AND (cd_education_status = 'Unknown'))
+      OR ((cd_marital_status = 'W')
+         AND (cd_education_status = 'Advanced Degree')))
+   AND (hd_buy_potential LIKE 'Unknown%')
+   AND (ca_gmt_offset = -7)
+GROUP BY cc_call_center_id, cc_name, cc_manager, cd_marital_status, cd_education_status
+ORDER BY sum(cr_net_loss) DESC
+""",
+    93: """
+SELECT
+  ss_customer_sk
+, sum(act_sales) sumsales
+FROM
+  (
+   SELECT
+     ss_item_sk
+   , ss_ticket_number
+   , ss_customer_sk
+   , (CASE WHEN (sr_return_quantity IS NOT NULL) THEN ((ss_quantity - sr_return_quantity) * ss_sales_price) ELSE (ss_quantity * ss_sales_price) END) act_sales
+   FROM
+     (store_sales
+   LEFT JOIN store_returns ON (sr_item_sk = ss_item_sk)
+      AND (sr_ticket_number = ss_ticket_number))
+   , reason
+   WHERE (sr_reason_sk = r_reason_sk)
+      AND (r_reason_desc = 'reason 28')
+)  t
+GROUP BY ss_customer_sk
+ORDER BY sumsales ASC, ss_customer_sk ASC
+LIMIT 100
+""",
+    98: """
+SELECT
+  i_item_id
+, i_item_desc
+, i_category
+, i_class
+, i_current_price
+, sum(ss_ext_sales_price) itemrevenue
+, ((sum(ss_ext_sales_price) * 100) / sum(sum(ss_ext_sales_price)) OVER (PARTITION BY i_class)) revenueratio
+FROM
+  store_sales
+, item
+, date_dim
+WHERE (ss_item_sk = i_item_sk)
+   AND (i_category IN ('Sports', 'Books', 'Home'))
+   AND (ss_sold_date_sk = d_date_sk)
+   AND (CAST(d_date AS DATE) BETWEEN CAST('1999-02-22' AS DATE) AND (CAST('1999-02-22' AS DATE) + INTERVAL  '30' DAY))
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category ASC, i_class ASC, i_item_id ASC, i_item_desc ASC, revenueratio ASC
+""",
+    99: """
+SELECT
+  substr(w_warehouse_name, 1, 20)
+, sm_type
+, cc_name
+, sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) <= 30) THEN 1 ELSE 0 END)) 30 days
+, sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) > 30)
+   AND ((cs_ship_date_sk - cs_sold_date_sk) <= 60) THEN 1 ELSE 0 END)) 31-60 days
+, sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) > 60)
+   AND ((cs_ship_date_sk - cs_sold_date_sk) <= 90) THEN 1 ELSE 0 END)) 61-90 days
+, sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) > 90)
+   AND ((cs_ship_date_sk - cs_sold_date_sk) <= 120) THEN 1 ELSE 0 END)) 91-120 days
+, sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) > 120) THEN 1 ELSE 0 END)) >120 days
+FROM
+  catalog_sales
+, warehouse
+, ship_mode
+, call_center
+, date_dim
+WHERE (d_month_seq BETWEEN 1200 AND (1200 + 11))
+   AND (cs_ship_date_sk = d_date_sk)
+   AND (cs_warehouse_sk = w_warehouse_sk)
+   AND (cs_ship_mode_sk = sm_ship_mode_sk)
+   AND (cs_call_center_sk = cc_call_center_sk)
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, cc_name
+ORDER BY substr(w_warehouse_name, 1, 20) ASC, sm_type ASC, cc_name ASC
+LIMIT 100
+""",
+
 }

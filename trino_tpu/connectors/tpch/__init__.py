@@ -107,6 +107,9 @@ class TpchConnector(Connector):
     def metadata(self) -> TpchMetadata:
         return self._metadata
 
+    def scan_version(self, handle):
+        return 0  # generated data is immutable per (schema, table)
+
     def splits(self, handle: TableHandle, target_splits: int, predicate=None):
         sf = tpch_schema.schema_scale(handle.schema)
         gen = generator_for(sf)

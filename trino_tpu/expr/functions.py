@@ -537,6 +537,62 @@ def _like(ctx, call, value, pattern, escape=None):
     return Val(jnp.take(table, codes, mode="clip"), value.valid, T.BOOLEAN)
 
 
+@register("regexp_like")
+def _regexp_like(ctx, call, value, pattern):
+    """reference: operator/scalar/JoniRegexpFunctions.java regexpLike —
+    evaluated once per dictionary entry, broadcast to codes."""
+    import re
+
+    d = _require_dict(value, "regexp_like")
+    rx = re.compile(_literal_str(pattern, "regexp_like"))
+    table = jnp.asarray(d.predicate_table(lambda s: rx.search(s) is not None))
+    codes = jnp.asarray(value.data, jnp.int32)
+    return Val(jnp.take(table, codes, mode="clip"), value.valid, T.BOOLEAN)
+
+
+@register("regexp_extract")
+def _regexp_extract(ctx, call, value, pattern, group=None):
+    """regexp_extract(s, p[, group]); NULL when the pattern has no match."""
+    import re
+
+    d = _require_dict(value, "regexp_extract")
+    rx = re.compile(_literal_str(pattern, "regexp_extract"))
+    g = int(np.asarray(group.data)) if group is not None else 0
+    outs, hits = [], []
+    for s in d.values:
+        m = rx.search(s)
+        if m is None:
+            outs.append("")
+            hits.append(False)
+        else:
+            outs.append(m.group(g) or "")
+            hits.append(True)
+    nd = StringDictionary.from_unsorted(outs)
+    ix = nd.index
+    table = jnp.asarray(
+        np.fromiter((ix[o] for o in outs), dtype=np.int32, count=len(outs))
+    )
+    hit_table = jnp.asarray(np.asarray(hits, dtype=bool))
+    codes = jnp.asarray(value.data, jnp.int32)
+    out_codes = jnp.take(table, codes, mode="clip")
+    hit = jnp.take(hit_table, codes, mode="clip")
+    valid = hit if value.valid is None else jnp.logical_and(value.valid, hit)
+    return Val(out_codes, valid, call.type, nd)
+
+
+@register("regexp_replace")
+def _regexp_replace(ctx, call, value, pattern, repl=None):
+    import re
+
+    rx = re.compile(_literal_str(pattern, "regexp_replace"))
+    r = _literal_str(repl, "regexp_replace") if repl is not None else ""
+    # SQL backreferences use $1; python re uses \1
+    r = re.sub(r"\$(\d+)", r"\\\1", r)
+    return _string_map(
+        ctx, call, value, lambda s: rx.sub(r, s), "regexp_replace"
+    )
+
+
 def _string_map(ctx, call, value: Val, fn, what: str) -> Val:
     """Map a python string fn over the dictionary -> new dictionary + table."""
     d = _require_dict(value, what)

@@ -45,11 +45,17 @@ _STEP_CACHE: dict = {}
 @dataclass(frozen=True)
 class AggSpec:
     """One SQL aggregate: name in {count, count_star, sum, min, max, avg,
-    any_value, bool_and, bool_or}, arg = input channel (None for count_star)."""
+    any_value, bool_and, bool_or, stddev_samp, stddev_pop, var_samp,
+    var_pop, percentile}, arg = input channel (None for count_star)."""
 
     name: str
     arg: Optional[int]
     out_type: T.Type
+    param: object = None  # percentile fraction
+
+
+#: moment family: grouped state is (sum, sum-of-squares, count)
+MOMENT = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
 
 
 # primitive states per SQL aggregate (state kinds: sum/count/min/max/any)
@@ -66,6 +72,10 @@ def _primitives(spec: AggSpec):
         return [("max", spec.arg), ("count", spec.arg)]
     if spec.name == "any_value":
         return [("any", spec.arg), ("count", spec.arg)]
+    if spec.name in MOMENT:
+        # reference: operator/aggregation VarianceState (count/mean/m2 as
+        # merged moments; here the raw-sum formulation merges by addition)
+        return [("sum_f", spec.arg), ("sumsq", spec.arg), ("count", spec.arg)]
     raise NotImplementedError(f"aggregate: {spec.name}")
 
 
@@ -74,6 +84,8 @@ def _state_types(spec: AggSpec, input_types) -> list[T.Type]:
     for kind, arg in _primitives(spec):
         if kind in ("count", "count_star"):
             out.append(T.BIGINT)
+        elif kind in ("sum_f", "sumsq"):
+            out.append(T.DOUBLE)
         elif kind == "sum":
             t = input_types[arg]
             if isinstance(t, T.DecimalType):
@@ -92,7 +104,10 @@ def _merge_primitives(spec: AggSpec):
     prims = _primitives(spec)
     merged = []
     for kind, _ in prims:
-        merged.append("sum" if kind in ("count", "count_star") else kind)
+        # counts and moment sums are already-reduced values: merge by adding
+        merged.append(
+            "sum" if kind in ("count", "count_star", "sum_f", "sumsq") else kind
+        )
     return merged
 
 
@@ -101,6 +116,19 @@ def _finalize(spec: AggSpec, states: list[Column]) -> Column:
     name = spec.name
     if name in ("count", "count_star"):
         return Column(states[0].data, T.BIGINT, None)
+    if name in MOMENT:
+        s, sq, cnt = states[0].data, states[1].data, states[2].data
+        n = cnt.astype(jnp.float64)
+        m2 = sq - jnp.where(cnt > 0, s * s / jnp.maximum(n, 1.0), 0.0)
+        m2 = jnp.maximum(m2, 0.0)  # guard tiny negative rounding residue
+        if name in ("var_pop", "stddev_pop"):
+            var = m2 / jnp.maximum(n, 1.0)
+            valid = cnt > 0
+        else:
+            var = m2 / jnp.maximum(n - 1.0, 1.0)
+            valid = cnt > 1
+        out = jnp.sqrt(var) if name.startswith("stddev") else var
+        return Column(out, T.DOUBLE, valid)
     value, cnt = states[0], states[1]
     nonempty = cnt.data > 0
     valid = nonempty
@@ -122,6 +150,14 @@ def _finalize(spec: AggSpec, states: list[Column]) -> Column:
         valid,
         states[0].dictionary,
     )
+
+
+def _logical_double(d, t: T.Type):
+    """Raw device values -> logical float64 (decimal cents get descaled)."""
+    out = d.astype(jnp.float64)
+    if isinstance(t, T.DecimalType) and t.scale:
+        out = out / (10.0 ** t.scale)
+    return out
 
 
 def _masked_reduce(data, valid, kind: str):
@@ -160,6 +196,43 @@ def _pad_device(batch: Batch, cap: int) -> Batch:
     return Batch(cols, mask)
 
 
+class MarkDistinctOperator:
+    """Appends a boolean column that is True on the first live occurrence of
+    each distinct key combination (reference: operator/MarkDistinctOperator
+    .java + MarkDistinctHash).  TPU substitution: multi-key sort + key-change
+    flags scattered back to row order — one static-shape program, no hash
+    table."""
+
+    def __init__(self, key_channels: Sequence[int]):
+        self.key_channels = list(key_channels)
+        self._acc: list[Batch] = []
+        key = ("mark_distinct", tuple(self.key_channels))
+        if key not in _STEP_CACHE:
+            _STEP_CACHE[key] = jax.jit(self._mark_step)
+        self._step = _STEP_CACHE[key]
+
+    def _mark_step(self, batch: Batch) -> Batch:
+        cap = batch.capacity
+        perm = multi_key_sort_perm(
+            batch, [SortKey(ch) for ch in self.key_channels]
+        )
+        _, _, new_group = group_ids_from_sorted(batch, perm, self.key_channels)
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        inv = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(pos)
+        mark = jnp.take(new_group, inv, mode="clip")
+        cols = list(batch.columns) + [Column(mark, T.BOOLEAN, None)]
+        return Batch(cols, batch.row_mask)
+
+    def process(self, stream):
+        for b in stream:
+            self._acc.append(b)
+        if not self._acc:
+            return
+        big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
+        big = _pad_device(big, next_pow2(big.capacity, floor=1))
+        yield self._step(big)
+
+
 class AggregationOperator:
     def __init__(
         self,
@@ -168,6 +241,8 @@ class AggregationOperator:
         input_types: Sequence[T.Type],
         mode: str = "single",  # single | partial | final | merge
         streaming: bool = False,
+        fold_every: Optional[int] = None,
+        memory_ctx=None,
     ):
         # merge: states in -> states out (used to combine partial outputs)
         assert mode in ("single", "partial", "final", "merge")
@@ -176,6 +251,8 @@ class AggregationOperator:
         self.input_types = list(input_types)
         self.mode = mode
         self.streaming = streaming
+        self.fold_every = fold_every if fold_every is not None else self.FOLD_EVERY
+        self.memory_ctx = memory_ctx
         self._acc: list[Batch] = []
         key = (
             tuple(self.group_channels),
@@ -262,7 +339,10 @@ class AggregationOperator:
         gch = self.group_channels
         if not gch:
             return self._global_reduce(batch)
-        direct = self._direct_group_info(batch)
+        direct = None
+        if not any(s.name == "percentile" for s in self.aggregates):
+            # percentile group ids must come from the sort-based numbering
+            direct = self._direct_group_info(batch)
         if direct is not None:
             return self._direct_reduce(batch, *direct)
         perm = multi_key_sort_perm(batch, [SortKey(ch) for ch in gch])
@@ -293,6 +373,13 @@ class AggregationOperator:
             cols.append(Column(key_out, col.type, valid, col.dictionary))
         # aggregate states/values
         for spec in self.aggregates:
+            if spec.name == "percentile":
+                if self.mode != "single":
+                    raise NotImplementedError(
+                        "percentile requires single-stage aggregation"
+                    )
+                cols.append(self._percentile_one(batch, spec, out_cap))
+                continue
             state_cols = self._reduce_one(
                 batch, spec, perm, live, gid_c, nseg, out_cap
             )
@@ -301,6 +388,38 @@ class AggregationOperator:
             else:
                 cols.append(_finalize(spec, state_cols))
         return Batch(cols, out_live)
+
+    def _percentile_one(self, batch: Batch, spec: AggSpec, out_cap: int) -> Column:
+        """Exact per-group percentile: re-sort by (group keys, value) and
+        pick the nearest-rank row of each group (reference role:
+        ApproximateLongPercentileAggregations via qdigest — a sort-based
+        engine computes the exact rank instead)."""
+        gch = self.group_channels
+        cap = batch.capacity
+        col = batch.columns[spec.arg]
+        keys = [SortKey(ch) for ch in gch] + [SortKey(spec.arg)]
+        perm2 = multi_key_sort_perm(batch, keys)
+        gid2, _, _ = group_ids_from_sorted(batch, perm2, gch)
+        live2 = jnp.take(batch.mask(), perm2, mode="clip")
+        varg = live2
+        if col.valid is not None:
+            varg = jnp.logical_and(varg, jnp.take(col.valid, perm2, mode="clip"))
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        gid_c = jnp.minimum(gid2, out_cap)
+        nseg = out_cap + 1
+        # nulls sort last within the group: the group's first live row starts
+        # the non-null run, whose length is the valid count
+        start = jax.ops.segment_min(jnp.where(varg, pos, cap), gid_c, nseg)
+        nvalid = jax.ops.segment_sum(varg.astype(jnp.int64), gid_c, nseg)
+        p = float(spec.param if spec.param is not None else 0.5)
+        target = start + jnp.round(
+            p * jnp.maximum(nvalid - 1, 0).astype(jnp.float64)
+        ).astype(jnp.int64)
+        d_sorted = jnp.take(col.data, perm2, mode="clip")
+        val = jnp.take(
+            d_sorted, jnp.clip(target[:out_cap], 0, cap - 1), mode="clip"
+        )
+        return Column(val, spec.out_type, nvalid[:out_cap] > 0, col.dictionary)
 
     def _reduce_one(self, batch, spec, perm, live, gid, nseg, out_cap):
         if self.mode in ("final", "merge"):
@@ -332,6 +451,13 @@ class AggregationOperator:
             if col.valid is not None:
                 v = jnp.logical_and(v, jnp.take(col.valid, perm, mode="clip"))
             st = _state_types(spec, self.input_types)[len(out)]
+            if kind in ("sum_f", "sumsq"):
+                dl = _logical_double(d, col.type)
+                if kind == "sumsq":
+                    dl = dl * dl
+                red = segment_reduce(dl, gid, nseg, "sum", valid=v)[:out_cap]
+                out.append(Column(red, T.DOUBLE, None))
+                continue
             if kind == "sum":
                 # widen BEFORE reducing: int32 inputs must accumulate in int64
                 d = d.astype(st.np_dtype)
@@ -346,6 +472,30 @@ class AggregationOperator:
         live = batch.mask()
         cols = []
         for spec in self.aggregates:
+            if spec.name == "percentile":
+                if self.mode != "single":
+                    raise NotImplementedError(
+                        "percentile requires single-stage aggregation"
+                    )
+                col = batch.columns[spec.arg]
+                v = live
+                if col.valid is not None:
+                    v = jnp.logical_and(v, col.valid)
+                # sort values with invalid rows last
+                perm = multi_key_sort_perm(
+                    Batch(list(batch.columns), v), [SortKey(spec.arg)]
+                )
+                n = jnp.sum(v)
+                p = float(spec.param if spec.param is not None else 0.5)
+                idx = jnp.round(
+                    p * jnp.maximum(n - 1, 0).astype(jnp.float64)
+                ).astype(jnp.int64)
+                d_sorted = jnp.take(col.data, perm, mode="clip")
+                val = jnp.take(d_sorted, jnp.clip(idx, 0, batch.capacity - 1))
+                cols.append(
+                    Column(val[None], spec.out_type, (n > 0)[None], col.dictionary)
+                )
+                continue
             states = []
             if self.mode in ("final", "merge"):
                 ch = spec.arg
@@ -376,7 +526,12 @@ class AggregationOperator:
                         v = jnp.logical_and(v, col.valid)
                     st = _state_types(spec, self.input_types)[len(states)]
                     d = col.data
-                    if kind == "sum":
+                    if kind in ("sum_f", "sumsq"):
+                        d = _logical_double(d, col.type)
+                        if kind == "sumsq":
+                            d = d * d
+                        kind = "sum"
+                    elif kind == "sum":
                         d = d.astype(st.np_dtype)  # widen before reducing
                     states.append(
                         Column(
@@ -410,15 +565,24 @@ class AggregationOperator:
     FOLD_EVERY = 8
 
     def process(self, stream):
+        from trino_tpu.runtime.memory import batch_bytes
+
         per_batch = self._batch_reducer() if self.streaming else None
         for batch in stream:
             if per_batch is not None:
                 self._acc.append(per_batch._step(batch, out_cap=batch.capacity))
-                if len(self._acc) >= self.FOLD_EVERY:
+                if len(self._acc) >= self.fold_every:
                     self._fold_states()
             else:
                 self._acc.append(batch)
-        yield self.finish()
+            if self.memory_ctx is not None:
+                self.memory_ctx.set_bytes(
+                    sum(batch_bytes(b) for b in self._acc)
+                )
+        out = self.finish()
+        if self.memory_ctx is not None:
+            self.memory_ctx.close()
+        yield out
 
     def _fold_states(self) -> None:
         """Merge accumulated state batches into one, compacted to live size."""

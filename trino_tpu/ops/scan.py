@@ -40,7 +40,13 @@ def page_to_batch(page, types: Sequence[Type], capacity: Optional[int] = None) -
 
 
 class ScanOperator:
-    """Streams one split's pages as device batches."""
+    """Streams one split's pages as device batches.
+
+    Immutable splits (connector.scan_version != None) are served through the
+    two-tier buffer pool: repeated scans hit device-resident batches (no
+    host→device transfer at all), second-best is padded host pages (no
+    generation/decode).  Cold scans stream pages while filling both tiers.
+    """
 
     def __init__(
         self,
@@ -50,6 +56,7 @@ class ScanOperator:
         column_types: Sequence[Type],
         page_rows: int = 1 << 17,
         device=None,
+        use_cache: bool = True,
     ):
         self.connector = connector
         self.split = split
@@ -57,11 +64,66 @@ class ScanOperator:
         self.column_types = list(column_types)
         self.page_rows = page_rows
         self.device = device
+        self.use_cache = use_cache
 
-    def batches(self):
+    def _cache_key(self):
+        if not self.use_cache:
+            return None
+        version = self.connector.scan_version(self.split.table)
+        if version is None:
+            return None
+        from trino_tpu.runtime.buffer_pool import BufferPool
+
+        return BufferPool.split_key(
+            self.split, self.column_names, self.page_rows, version
+        )
+
+    def host_batches(self) -> list:
+        """Padded host batches for this split, via the host cache tier."""
+        from trino_tpu.runtime.buffer_pool import POOL
+
+        key = self._cache_key()
+        if key is not None:
+            host = POOL.get_host(key)
+            if host is not None:
+                return host
         src = self.connector.page_source(
             self.split, self.column_names, max_rows_per_page=self.page_rows
         )
+        host = [page_to_batch(p, self.column_types) for p in src.pages()]
+        if key is not None:
+            POOL.put_host(key, host)
+        return host
+
+    def batches(self):
+        from trino_tpu.runtime.buffer_pool import POOL
+
+        key = self._cache_key()
+        if key is not None:
+            cached = POOL.get_device(key)
+            if cached is not None:
+                yield from cached
+                return
+            host = POOL.get_host(key)
+            if host is not None:
+                dev = []
+                for b in host:
+                    d = jax.device_put(b, self.device)
+                    dev.append(d)
+                    yield d
+                POOL.put_device(key, dev)
+                return
+        src = self.connector.page_source(
+            self.split, self.column_names, max_rows_per_page=self.page_rows
+        )
+        host_acc, dev_acc = [], []
         for page in src.pages():
             b = page_to_batch(page, self.column_types)
-            yield jax.device_put(b, self.device)
+            d = jax.device_put(b, self.device)
+            if key is not None:
+                host_acc.append(b)
+                dev_acc.append(d)
+            yield d
+        if key is not None:
+            POOL.put_host(key, host_acc)
+            POOL.put_device(key, dev_acc)

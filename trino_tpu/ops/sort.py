@@ -81,25 +81,37 @@ class TopNOperator:
 
 
 class LimitOperator:
-    """LIMIT without ordering; truncates the stream host-side."""
+    """LIMIT/OFFSET without ordering; truncates the stream host-side
+    (reference: LimitOperator.java + OffsetOperator.java).  count=None
+    means OFFSET-only (skip, keep the rest)."""
 
-    def __init__(self, n: int):
+    def __init__(self, n, offset: int = 0):
         self.n = n
+        self.offset = offset
 
     def process(self, stream):
-        remaining = self.n
+        skip = self.offset
+        remaining = self.n  # None = unlimited
         for b in stream:
-            if remaining <= 0:
+            if remaining is not None and remaining <= 0:
                 break
             cnt = b.num_rows_host()
-            if cnt <= remaining:
-                remaining -= cnt
-                yield b
-            else:
+            if skip >= cnt:
+                skip -= cnt
+                continue
+            if skip > 0 or (remaining is not None and cnt - skip > remaining):
                 live = b.mask()
                 rank = jnp.cumsum(live) - 1
-                yield b.filter(jnp.logical_and(live, rank < remaining))
-                remaining = 0
+                keep = jnp.logical_and(live, rank >= skip)
+                if remaining is not None:
+                    keep = jnp.logical_and(keep, rank < skip + remaining)
+                    remaining -= min(cnt - skip, remaining)
+                yield b.filter(keep)
+                skip = 0
+            else:
+                remaining = None if remaining is None else remaining - (cnt - skip)
+                skip = 0
+                yield b
 
 
 def _truncate(batch: Batch, cap: int) -> Batch:

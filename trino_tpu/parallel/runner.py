@@ -1,22 +1,21 @@
-"""Distributed query runner: plans execute SPMD over the worker mesh.
+"""Distributed query runner: fragmented, stage-based SPMD execution.
 
-Reference roles: SqlQueryExecution.planDistribution + PipelinedQueryScheduler
-(stage orchestration) + AddExchanges' distribution choices, collapsed into a
-recursive executor because stages here are jitted SPMD programs, not remote
-tasks: the host *is* the coordinator, device collectives *are* the shuffle
-(SURVEY.md §5.8 TPU mapping).
+Reference roles: SqlQueryExecution.planDistribution (plan → SubPlan via
+PlanFragmenter) + PipelinedQueryScheduler.start (stage orchestration,
+execution/scheduler/PipelinedQueryScheduler.java:249) + AddExchanges'
+distribution choices.  The plan is first rewritten with explicit
+ExchangeNodes (planner/fragmenter.add_exchanges), cut into PlanFragments
+with partitioning handles (SystemPartitioningHandle.java:41-57 analog), and
+executed bottom-up: fragment bodies are SPMD programs over the worker mesh,
+exchange edges lower to ICI collectives (hash bucketize + all_to_all,
+broadcast = all_gather) or an explicit gather/merge to the coordinator —
+EXPLAIN (explain_distributed) shows every fragment and its distribution, and
+there is no silent per-node fallback: a node without a distributed
+implementation forces an explicit SINGLE fragment at plan time.
 
-Distribution strategy per node (AddExchanges.java:139 analog):
-- TableScan: splits round-robin across workers (SOURCE_DISTRIBUTION)
-- Filter/Project: inherit child distribution (no exchange)
-- Aggregation: per-worker partial -> hash repartition on group keys ->
-  final merge (FIXED_HASH); global aggregates all_gather their single
-  state row (SINGLE_DISTRIBUTION via collective instead of gather stage)
-- Join: build side broadcast when small (all_gather), else both sides
-  hash-repartitioned on the join keys (partitioned join)
-- SemiJoin: filtering side broadcast
-- Sort/TopN/Limit/Output: gathered to the coordinator and finished with the
-  local operators (COORDINATOR_ONLY final fragment)
+Stage value forms: a distributed stage yields a `_Dist` (stacked [W, cap]
+device batch, sharded over the mesh); a SINGLE/COORDINATOR_ONLY stage yields
+materialized host batches via the local engine.
 """
 
 from __future__ import annotations
@@ -30,23 +29,38 @@ import numpy as np
 from trino_tpu import types as T
 from trino_tpu.columnar import Batch, Column
 from trino_tpu.columnar.batch import concat_batches
-from trino_tpu.connectors.api import CatalogManager, default_catalogs
+from trino_tpu.connectors.api import CatalogManager
 from trino_tpu.expr import ExprCompiler
-from trino_tpu.expr.ir import InputRef
+from trino_tpu.expr.ir import Form, InputRef, Literal, SpecialForm
 from trino_tpu.ops.aggregation import AggregationOperator, AggSpec
-from trino_tpu.ops.common import next_pow2
+from trino_tpu.ops.common import SortKey, next_pow2
 from trino_tpu.ops.filter_project import FilterProjectOperator
 from trino_tpu.ops.join import HashJoinOperator, SemiJoinOperator
-from trino_tpu.ops.scan import page_to_batch
+from trino_tpu.ops.sort import OrderByOperator, TopNOperator
 from trino_tpu.parallel import exchange as ex
-from trino_tpu.parallel.spmd import WorkerMesh, spmd_step, stack_batches, unstack_batch
+from trino_tpu.parallel.spmd import (
+    WorkerMesh,
+    spmd_step,
+    stack_batches,
+    unstack_batch,
+)
 from trino_tpu.planner import plan as P
-from trino_tpu.planner.stats import estimate_rows
+from trino_tpu.planner.fragmenter import (
+    COORDINATOR_ONLY,
+    FIXED_ARBITRARY,
+    FIXED_HASH,
+    SINGLE,
+    SOURCE,
+    RemoteSourceNode,
+    SubPlan,
+    add_exchanges,
+    create_subplans,
+    fragment_text,
+)
 from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
 from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
 
-#: build sides estimated smaller than this broadcast; larger repartition
-BROADCAST_ROWS = 50_000
+_DIST_KINDS = (SOURCE, FIXED_HASH, FIXED_ARBITRARY)
 
 
 class _Dist:
@@ -78,11 +92,29 @@ class DistributedQueryRunner(LocalQueryRunner):
         super().__init__(catalogs, catalog=catalog, schema=schema)
         self.wm = WorkerMesh(devices, n_workers)
 
-    # -- public ---------------------------------------------------------------
+    # -- planning -------------------------------------------------------------
 
-    def execute(self, sql: str) -> MaterializedResult:
-        plan = self.create_plan(sql)
-        host = self._to_host_plan(plan)
+    def create_subplan(self, plan: P.OutputNode) -> SubPlan:
+        dplan = add_exchanges(
+            plan, self.catalogs, self.properties, n_workers=self.wm.n
+        )
+        return create_subplans(dplan)
+
+    def explain_distributed(self, sql: str) -> str:
+        return fragment_text(self.create_subplan(self.create_plan(sql)))
+
+    # -- execution (all statements inherit LocalQueryRunner.execute dispatch;
+    # queries run through the stage executor) ---------------------------------
+
+    def _run_query(self, query, stats=None) -> MaterializedResult:
+        if stats is not None:
+            # EXPLAIN ANALYZE instrumentation hooks the local operator
+            # streams; run it through the local engine
+            return super()._run_query(query, stats=stats)
+        plan = self.plan_query(query)
+        sub = self.create_subplan(plan)
+        executor = StageExecutor(self.catalogs, self.wm, self.properties)
+        host = executor.run(sub)
         rows = []
         for batch in host.stream:
             rows.extend(tuple(r) for r in batch.to_pylist())
@@ -90,60 +122,187 @@ class DistributedQueryRunner(LocalQueryRunner):
             list(plan.column_names), rows, [s.type for s in plan.symbols]
         )
 
-    # -- recursion ------------------------------------------------------------
 
-    def _to_host_plan(self, node: P.PlanNode) -> PhysicalPlan:
-        """Execute `node`, gathering to the coordinator (host batches)."""
-        out = self._dexec(node)
-        if isinstance(out, _Dist):
-            host_batch = unstack_batch(jax.device_get(out.stacked))
-            return PhysicalPlan(iter([host_batch]), out.symbols)
+class StageExecutor:
+    """Executes a SubPlan tree bottom-up (reference role: StageManager +
+    SqlStage inside PipelinedQueryScheduler, with collectives as the data
+    plane instead of HTTP output buffers)."""
+
+    def __init__(self, catalogs, wm: WorkerMesh, properties):
+        self.catalogs = catalogs
+        self.wm = wm
+        self.properties = properties
+        self._subplans: dict[int, SubPlan] = {}
+        self._results: dict[int, object] = {}
+
+    # -- public ---------------------------------------------------------------
+
+    def run(self, sub: SubPlan) -> PhysicalPlan:
+        self._register(sub)
+        out = self._fragment_result(sub.fragment.id)
+        if isinstance(out, _Dist):  # defensive: root should be SINGLE
+            return PhysicalPlan(
+                iter([unstack_batch(jax.device_get(out.stacked))]), out.symbols
+            )
         return out
 
-    def _local(self) -> LocalExecutionPlanner:
-        return LocalExecutionPlanner(self.catalogs, target_splits=self.target_splits)
+    # -- stage orchestration --------------------------------------------------
 
-    def _dexec(self, node: P.PlanNode):
-        """Returns a _Dist (still distributed) or PhysicalPlan (coordinator)."""
-        m = getattr(self, "_d_" + type(node).__name__, None)
-        if m is not None:
-            out = m(node)
-            if out is not None:
-                return out
-        # coordinator fallback: gather distributed children, run local operator
-        lp = self._local()
+    def _register(self, sub: SubPlan) -> None:
+        self._subplans[sub.fragment.id] = sub
+        for c in sub.children:
+            self._register(c)
+
+    def _fragment_result(self, fid: int):
+        """Stage output: a _Dist, or ('host', batches, symbols) for SINGLE
+        fragments (materialized so multiple consumers can re-read)."""
+        if fid not in self._results:
+            sub = self._subplans[fid]
+            if sub.fragment.partitioning.kind in _DIST_KINDS:
+                res = self._exec(sub.fragment.root)
+            else:
+                out = self._local_fragment(sub)
+                res = ("host", list(out.stream), out.symbols)
+            self._results[fid] = res
+        res = self._results[fid]
+        if isinstance(res, tuple):
+            return PhysicalPlan(iter(res[1]), res[2])
+        return res
+
+    def _local_fragment(self, sub: SubPlan) -> PhysicalPlan:
+        """SINGLE/COORDINATOR_ONLY fragment: run the local engine over
+        gathered inputs (the final/coordinator stage of the reference)."""
+        lp = LocalExecutionPlanner(
+            self.catalogs,
+            target_splits=self.properties.get("target_splits"),
+            properties=self.properties,
+        )
         saved = lp.plan
-        dexec = self._dexec
+        executor = self
 
-        def plan_hook(n: P.PlanNode) -> PhysicalPlan:
-            if n is not node:
-                d = dexec(n)
-                if isinstance(d, _Dist):
-                    host_batch = unstack_batch(jax.device_get(d.stacked))
-                    return PhysicalPlan(iter([host_batch]), d.symbols)
-                return d
-            return saved(n)
+        def plan_hook(node: P.PlanNode) -> PhysicalPlan:
+            if isinstance(node, RemoteSourceNode):
+                return executor._remote_as_host(node)
+            if (
+                isinstance(node, P.AggregationNode)
+                and isinstance(node.source, RemoteSourceNode)
+                and node.source.exchange_kind == "gather"
+                and not node.group_symbols
+                and not any(
+                    a.distinct or a.function == "percentile"
+                    for _, a in node.aggregations
+                )
+            ):
+                # global aggregation over a distributed child: partial states
+                # per worker, gather the single state rows, merge — never
+                # gather raw rows (PushPartialAggregationThroughExchange)
+                child = executor._raw_remote(node.source)
+                if isinstance(child, _Dist):
+                    return executor._global_agg(node, child)
+            return saved(node)
 
         lp.plan = plan_hook
-        return saved(node)
+        return lp.plan(sub.fragment.root)
 
-    # -- distributed node handlers (return None to fall back) -----------------
+    # -- exchanges ------------------------------------------------------------
 
-    def _d_TableScanNode(self, node: P.TableScanNode):
+    def _raw_remote(self, node: RemoteSourceNode):
+        """Child fragment result WITHOUT the exchange applied."""
+        return self._fragment_result(node.fragment_id)
+
+    def _remote_as_host(self, node: RemoteSourceNode) -> PhysicalPlan:
+        """Apply a gather/merge exchange into host batches."""
+        child = self._raw_remote(node)
+        if isinstance(child, PhysicalPlan):
+            return child
+        if node.exchange_kind == "merge":
+            batch = self._merge_gather(child, node)
+        else:
+            batch = unstack_batch(jax.device_get(child.stacked))
+        return PhysicalPlan(iter([batch]), child.symbols)
+
+    def _merge_gather(self, child: _Dist, node: RemoteSourceNode) -> Batch:
+        """Merge exchange: per-worker sorted shards -> one ordered host batch
+        (MergeOperator/MergeSortedPages role)."""
+        from trino_tpu.ops.merge import merge_sorted_shards
+
+        host = jax.device_get(child.stacked)
+        keys = [
+            SortKey(child.channel(s.name), asc, nf)
+            for s, asc, nf in node.orderings
+        ]
+        shards = []
+        for w in range(self.wm.n):
+            shard = jax.tree.map(lambda x: np.asarray(x)[w], host)
+            n_live = int(np.asarray(shard.mask()).sum())
+            # partial sort puts dead rows last: the live prefix is the shard
+            shards.append(_slice_host(shard, n_live))
+        return merge_sorted_shards(shards, keys)
+
+    def _remote_as_dist(self, node: RemoteSourceNode) -> _Dist:
+        """Apply a repartition/broadcast exchange into a stacked batch."""
+        child = self._raw_remote(node)
+        stacked = self._to_stacked(child)
+        if node.exchange_kind == "broadcast":
+            return _Dist(ex.broadcast(stacked.stacked, self.wm), stacked.symbols)
+        if node.exchange_kind == "repartition":
+            chans = [stacked.channel(s.name) for s in node.partition_symbols]
+            return _Dist(
+                ex.repartition(stacked.stacked, chans, self.wm), stacked.symbols
+            )
+        raise NotImplementedError(
+            f"exchange {node.exchange_kind} feeding a distributed fragment"
+        )
+
+    def _to_stacked(self, result) -> _Dist:
+        if isinstance(result, _Dist):
+            return result
+        batches = list(result.stream)
+        host = concat_batches(batches) if batches else None
+        if host is None or not host.width:
+            raise NotImplementedError("empty single-fragment feed")
+        stacked = stack_batches([host] + [None] * (self.wm.n - 1), self.wm)
+        return _Dist(stacked, result.symbols)
+
+    # -- distributed node execution -------------------------------------------
+
+    def _exec(self, node: P.PlanNode):
+        m = getattr(self, "_x_" + type(node).__name__, None)
+        if m is None:
+            raise NotImplementedError(
+                f"no distributed executor for {type(node).__name__} — "
+                "the exchange placer should have made this a SINGLE fragment"
+            )
+        return m(node)
+
+    def _x_RemoteSourceNode(self, node: RemoteSourceNode) -> _Dist:
+        return self._remote_as_dist(node)
+
+    def _x_TableScanNode(self, node: P.TableScanNode) -> _Dist:
+        from trino_tpu.ops.scan import ScanOperator
+        from trino_tpu.runtime.retry import FAILURE_INJECTOR
+
         connector = self.catalogs.get(node.handle.catalog)
         names = [c for _, c in node.assignments]
         types = [s.type for s, _ in node.assignments]
         splits = list(connector.splits(node.handle, target_splits=self.wm.n))
+        page_rows = self.properties.get("page_rows")
+        use_cache = self.properties.get("scan_cache")
+
         per_worker: list = [[] for _ in range(self.wm.n)]
         for i, split in enumerate(splits):
-            src = connector.page_source(split, names)
-            for page in src.pages():
-                per_worker[i % self.wm.n].append(page_to_batch(page, types))
+            FAILURE_INJECTOR.maybe_fail(
+                f"scan:{node.handle.schema}.{node.handle.table}:{split.seq}"
+            )
+            op = ScanOperator(
+                connector, split, names, types,
+                page_rows=page_rows, use_cache=use_cache,
+            )
+            per_worker[i % self.wm.n].extend(op.host_batches())
         host_batches = [
             (concat_batches(bs) if bs else None) for bs in per_worker
         ]
         if all(b is None for b in host_batches):
-            # degenerate: an empty 1-row dead batch so the stack has a shape
             cols = [
                 Column(np.zeros(1, dtype=t.np_dtype), t, np.zeros(1, bool))
                 for t in types
@@ -159,36 +318,28 @@ class DistributedQueryRunner(LocalQueryRunner):
             out = _Dist(spmd_step(self.wm, step)(out.stacked), out.symbols)
         return out
 
-    def _d_FilterNode(self, node: P.FilterNode):
-        src = self._dexec(node.source)
-        if not isinstance(src, _Dist):
-            return None
+    def _x_FilterNode(self, node: P.FilterNode) -> _Dist:
+        src = self._exec(node.source)
         pred = src.rewrite(node.predicate)
         step = FilterProjectOperator(
             pred, [InputRef(i, s.type) for i, s in enumerate(src.symbols)]
         )._make_step()
         return _Dist(spmd_step(self.wm, step)(src.stacked), src.symbols)
 
-    def _d_ProjectNode(self, node: P.ProjectNode):
-        src = self._dexec(node.source)
-        if not isinstance(src, _Dist):
-            return None
+    def _x_ProjectNode(self, node: P.ProjectNode) -> _Dist:
+        src = self._exec(node.source)
         exprs = [src.rewrite(e) for _, e in node.assignments]
         step = FilterProjectOperator(None, exprs)._make_step()
         return _Dist(
-            spmd_step(self.wm, step)(src.stacked), [s for s, _ in node.assignments]
+            spmd_step(self.wm, step)(src.stacked),
+            [s for s, _ in node.assignments],
         )
 
-    def _d_AggregationNode(self, node: P.AggregationNode):
-        if any(a.distinct for _, a in node.aggregations):
-            return None  # coordinator fallback for distinct shapes
-        src = self._dexec(node.source)
-        if not isinstance(src, _Dist):
-            return None
-        ngroups = len(node.group_symbols)
-        # pre-projection (same construction as the local planner)
-        from trino_tpu.expr.ir import Form, Literal, SpecialForm
+    # -- aggregation ----------------------------------------------------------
 
+    def _agg_partial(self, node: P.AggregationNode, src: _Dist):
+        """Per-worker PARTIAL step; returns (stacked states, specs, op)."""
+        ngroups = len(node.group_symbols)
         proj = [src.rewrite(s.ref()) for s in node.group_symbols]
         specs: list = []
         input_types = [s.type for s in node.group_symbols]
@@ -199,10 +350,14 @@ class DistributedQueryRunner(LocalQueryRunner):
                 f = src.rewrite(agg.filter)
                 if name == "count_star":
                     name, arg = "count", SpecialForm(
-                        Form.IF, [f, Literal(1, T.BIGINT), Literal(None, T.BIGINT)], T.BIGINT
+                        Form.IF,
+                        [f, Literal(1, T.BIGINT), Literal(None, T.BIGINT)],
+                        T.BIGINT,
                     )
                 else:
-                    arg = SpecialForm(Form.IF, [f, arg, Literal(None, arg.type)], arg.type)
+                    arg = SpecialForm(
+                        Form.IF, [f, arg, Literal(None, arg.type)], arg.type
+                    )
             if arg is None:
                 specs.append(AggSpec(name, None, out_sym.type))
             else:
@@ -221,50 +376,108 @@ class DistributedQueryRunner(LocalQueryRunner):
             return partial_op._reduce_step(pre(b), out_cap=part_cap)
 
         states = spmd_step(self.wm, partial_step)(src.stacked)
-        state_types = [c.type for c in jax.tree.map(lambda x: x[0], states).columns]
+        return states, specs, partial_op
+
+    def _final_op(self, specs, partial_op, states) -> AggregationOperator:
+        state_types = [
+            c.type for c in jax.tree.map(lambda x: x[0], states).columns
+        ]
         merge_specs = [
             AggSpec(s.name, partial_op._state_channel(i), s.out_type)
             for i, s in enumerate(specs)
         ]
-        final_op = AggregationOperator(
+        ngroups = len(partial_op.group_channels)
+        return AggregationOperator(
             list(range(ngroups)), merge_specs, state_types, mode="final"
         )
-        if ngroups:
-            exchanged = ex.repartition(states, list(range(ngroups)), self.wm)
-            fcap = _trailing_cap(exchanged)
 
-            def final_step(b: Batch) -> Batch:
-                return final_op._reduce_step(b, out_cap=fcap)
-
-            out = spmd_step(self.wm, final_step)(exchanged)
-            return _Dist(out, node.outputs)
-        # global aggregation: single state row per worker -> all_gather ->
-        # replicated final merge; coordinator reads one replica
-        gathered = ex.broadcast(states, self.wm)
+    def _x_AggregationNode(self, node: P.AggregationNode) -> _Dist:
+        if not isinstance(node.source, RemoteSourceNode):
+            raise NotImplementedError("aggregation without an exchange below")
+        src = self._raw_remote(node.source)
+        src = self._to_stacked(src)
+        ngroups = len(node.group_symbols)
+        assert ngroups, "grouped aggregation expected in distributed fragment"
+        states, specs, partial_op = self._agg_partial(node, src)
+        exchanged = ex.repartition(states, list(range(ngroups)), self.wm)
+        final_op = self._final_op(specs, partial_op, states)
+        fcap = _trailing_cap(exchanged)
 
         def final_step(b: Batch) -> Batch:
-            return final_op._reduce_step(b, out_cap=1)
+            return final_op._reduce_step(b, out_cap=fcap)
 
-        out = spmd_step(self.wm, final_step)(gathered)
-        host = jax.device_get(out)
-        first = jax.tree.map(lambda x: x[:1], host)
-        one = unstack_batch(first)
-        return PhysicalPlan(iter([one]), node.outputs)
+        out = spmd_step(self.wm, final_step)(exchanged)
+        return _Dist(out, node.outputs)
 
-    def _d_JoinNode(self, node: P.JoinNode):
-        if node.kind not in ("inner", "left") or not node.criteria:
-            return None
-        probe = self._dexec(node.left)
-        build = self._dexec(node.right)
-        if not (isinstance(probe, _Dist) and isinstance(build, _Dist)):
-            return None
+    def _global_agg(self, node: P.AggregationNode, src: _Dist) -> PhysicalPlan:
+        """Global aggregation over a distributed child: partial per worker,
+        gather the per-worker state rows, final merge on the coordinator."""
+        states, specs, partial_op = self._agg_partial(node, src)
+        final_op = self._final_op(specs, partial_op, states)
+        gathered = unstack_batch(jax.device_get(states))
+        from trino_tpu.ops.aggregation import _pad_device
+
+        cap = next_pow2(gathered.capacity, floor=1)
+        final = final_op._step(_pad_device(gathered, cap), out_cap=1)
+        return PhysicalPlan(iter([final]), node.outputs)
+
+    # -- joins ----------------------------------------------------------------
+
+    def _unify_key_dicts(self, a: _Dist, ak, b: _Dist, bk):
+        """Key columns compared across the two sides must share a dictionary
+        (codes are ranks; mixed dictionaries would compare wrongly).  Host
+        unions the dictionaries, a jitted take recodes each side."""
+        from trino_tpu.columnar.dictionary import union_dictionaries
+
+        def recode(dist: _Dist, ch: int, table, merged):
+            col = dist.stacked.columns[ch]
+            tbl = jnp.asarray(table)
+
+            def step(batch: Batch) -> Batch:
+                cols = list(batch.columns)
+                c = cols[ch]
+                cols[ch] = Column(
+                    jnp.take(tbl, c.data.astype(jnp.int64), mode="clip"),
+                    c.type,
+                    c.valid,
+                    merged,
+                )
+                return Batch(cols, batch.row_mask)
+
+            return _Dist(
+                spmd_step(self.wm, step)(dist.stacked), dist.symbols
+            )
+
+        for ca, cb in zip(ak, bk):
+            da = a.stacked.columns[ca].dictionary
+            db = b.stacked.columns[cb].dictionary
+            if da is None and db is None:
+                continue
+            if da is db or da == db:
+                continue
+            if da is None or db is None:
+                raise NotImplementedError(
+                    "join key mixes dictionary and plain strings"
+                )
+            merged, ta, tb = union_dictionaries(da, db)
+            a = recode(a, ca, ta, merged)
+            b = recode(b, cb, tb, merged)
+        return a, b
+
+    def _x_JoinNode(self, node: P.JoinNode) -> _Dist:
+        assert node.distribution in ("broadcast", "partitioned"), node
+        probe_node, build_node = node.left, node.right
+        assert isinstance(build_node, RemoteSourceNode)
+        if node.distribution == "partitioned":
+            assert isinstance(probe_node, RemoteSourceNode)
+            probe = self._to_stacked(self._raw_remote(probe_node))
+            build = self._to_stacked(self._raw_remote(build_node))
+        else:
+            probe = self._exec(probe_node)
+            build = self._to_stacked(self._raw_remote(build_node))
         pk = [probe.channel(l.name) for l, _ in node.criteria]
         bk = [build.channel(r.name) for _, r in node.criteria]
-        # keys must be dictionary-free for cross-worker comparability
-        for d, chans in ((probe, pk), (build, bk)):
-            for ch in chans:
-                if d.stacked.columns[ch].dictionary is not None:
-                    return None
+        probe, build = self._unify_key_dicts(probe, pk, build, bk)
         out_symbols = probe.symbols + build.symbols
         residual = None
         if node.filter is not None:
@@ -273,11 +486,13 @@ class DistributedQueryRunner(LocalQueryRunner):
             def residual(batch: Batch, _e=expr):
                 return ExprCompiler(batch).filter_mask(_e)
 
-        if estimate_rows(node.right, self.catalogs) <= BROADCAST_ROWS:
+        if node.distribution == "broadcast":
             build_stacked = ex.broadcast(build.stacked, self.wm)
         else:
             build_stacked = ex.repartition(build.stacked, bk, self.wm)
-            probe = _Dist(ex.repartition(probe.stacked, pk, self.wm), probe.symbols)
+            probe = _Dist(
+                ex.repartition(probe.stacked, pk, self.wm), probe.symbols
+            )
 
         op = HashJoinOperator(
             node.kind, pk, bk,
@@ -294,7 +509,6 @@ class DistributedQueryRunner(LocalQueryRunner):
         start, count, perm = spmd_step(self.wm, locate_step)(
             probe.stacked, build_stacked
         )
-        # per-worker emit totals (host sync fixes the static output capacity)
         count_h = np.asarray(jax.device_get(count))  # [W, cap_p]
         mask_h = np.asarray(jax.device_get(probe.stacked.mask()))
         emit_h = (
@@ -318,37 +532,21 @@ class DistributedQueryRunner(LocalQueryRunner):
         )
         return _Dist(out, out_symbols)
 
-    def _d_SemiJoinNode(self, node: P.SemiJoinNode):
-        src = self._dexec(node.source)
-        if not isinstance(src, _Dist):
-            return None
-        filt = self._dexec(node.filtering)
-        if isinstance(filt, _Dist):
-            filt_stacked = filt.stacked
-            filt_symbols = filt.symbols
-        else:
-            batches = list(filt.stream)
-            if not batches:
-                return None
-            host = concat_batches(batches)
-            filt_stacked = stack_batches(
-                [host] + [None] * (self.wm.n - 1), self.wm
-            )
-            filt_symbols = filt.symbols
-        fk_name = node.filtering_key.name
-        fk = next(i for i, s in enumerate(filt_symbols) if s.name == fk_name)
-        sk = src.channel(node.source_key.name)
-        if (
-            src.stacked.columns[sk].dictionary is not None
-            or filt_stacked.columns[fk].dictionary is not None
-            or node.filter is not None
-        ):
-            return None
-        op = SemiJoinOperator(sk, fk, [s.type for s in filt_symbols],
-                              null_aware=node.null_aware)
-        bcast = ex.broadcast(filt_stacked, self.wm)
+    def _x_SemiJoinNode(self, node: P.SemiJoinNode) -> _Dist:
+        src = self._exec(node.source)
+        assert isinstance(node.filtering, RemoteSourceNode)
+        filt = self._to_stacked(self._raw_remote(node.filtering))
+        fk = [filt.channel(node.filtering_key.name)]
+        sk = [src.channel(node.source_key.name)]
+        src, filt = self._unify_key_dicts(src, sk, filt, fk)
+        sk, fk = sk[0], fk[0]
+        if node.filter is not None:
+            raise NotImplementedError("correlated semi-join filter distributed")
+        op = SemiJoinOperator(
+            sk, fk, [s.type for s in filt.symbols], null_aware=node.null_aware
+        )
+        bcast = ex.broadcast(filt.stacked, self.wm)
         cap_b = _trailing_cap(bcast)
-        # containsNull on the filtering key (computed host-side once)
         fcol = bcast.columns[fk]
         has_null = False
         if fcol.valid is not None:
@@ -366,11 +564,103 @@ class DistributedQueryRunner(LocalQueryRunner):
         out = spmd_step(self.wm, mark_step)(src.stacked, bcast)
         return _Dist(out, src.symbols + [node.mark])
 
-    def _d_OutputNode(self, node: P.OutputNode):
-        return None  # coordinator
+    def _x_MarkDistinctNode(self, node: P.MarkDistinctNode) -> _Dist:
+        from trino_tpu.ops.aggregation import MarkDistinctOperator
 
-    def _d_ExchangeNode(self, node: P.ExchangeNode):
-        return self._dexec(node.source)
+        src = self._exec(node.source)
+        op = MarkDistinctOperator(
+            [src.channel(s.name) for s in node.key_symbols]
+        )
+        out = spmd_step(self.wm, op._mark_step)(src.stacked)
+        return _Dist(out, node.outputs)
+
+    # -- window ---------------------------------------------------------------
+
+    def _x_WindowNode(self, node: P.WindowNode) -> _Dist:
+        from trino_tpu.ops.window import WindowOperator, WindowSpec
+
+        src = self._exec(node.source)
+        part = [src.channel(s.name) for s in node.partition_by]
+        order = [
+            SortKey(src.channel(s.name), asc, nf)
+            for s, asc, nf in node.order_by
+        ]
+        specs = []
+        for out_sym, fn in node.functions:
+            arg = src.channel(fn.args[0].name) if fn.args else None
+            default_ch = (
+                src.channel(fn.default.name) if fn.default is not None else None
+            )
+            specs.append(
+                WindowSpec(
+                    fn.name if fn.name != "count_star" else "count",
+                    arg,
+                    out_sym.type,
+                    offset=fn.offset,
+                    default_channel=default_ch,
+                    n_buckets=fn.n_buckets_expr or 1,
+                    frame=fn.frame,
+                    start_off=fn.start_off,
+                    end_off=fn.end_off,
+                )
+            )
+        op = WindowOperator(part, order, specs)
+        # per-worker window over hash-partitioned rows: every partition is
+        # wholly on one worker after the repartition exchange below this node
+        out = spmd_step(self.wm, op._window_step)(src.stacked)
+        return _Dist(out, node.outputs)
+
+    # -- ordering / limiting (partial steps; merge happens at the exchange) ---
+
+    def _x_SortNode(self, node: P.SortNode) -> _Dist:
+        src = self._exec(node.source)
+        keys = [
+            SortKey(src.channel(s.name), asc, nf)
+            for s, asc, nf in node.orderings
+        ]
+        op = OrderByOperator(keys)
+        out = spmd_step(self.wm, op._sort_step)(src.stacked)
+        return _Dist(out, src.symbols)
+
+    def _x_TopNNode(self, node: P.TopNNode) -> _Dist:
+        src = self._exec(node.source)
+        keys = [
+            SortKey(src.channel(s.name), asc, nf)
+            for s, asc, nf in node.orderings
+        ]
+        op = TopNOperator(keys, node.count)
+        out_cap = next_pow2(node.count, floor=1)
+
+        def step(b: Batch) -> Batch:
+            return op._merge_step(b, out_cap=out_cap)
+
+        out = spmd_step(self.wm, step)(src.stacked)
+        return _Dist(out, src.symbols)
+
+    def _x_LimitNode(self, node: P.LimitNode) -> _Dist:
+        src = self._exec(node.source)
+        n = node.count
+
+        def step(b: Batch) -> Batch:
+            live = b.mask()
+            rank = jnp.cumsum(live) - 1
+            return b.filter(jnp.logical_and(live, rank < n))
+
+        out = spmd_step(self.wm, step)(src.stacked)
+        return _Dist(out, src.symbols)
+
+
+def _slice_host(batch: Batch, n: int) -> Batch:
+    cols = [
+        Column(
+            np.asarray(c.data)[:n],
+            c.type,
+            None if c.valid is None else np.asarray(c.valid)[:n],
+            c.dictionary,
+        )
+        for c in batch.columns
+    ]
+    return Batch(cols, np.asarray(batch.mask())[:n])
 
 
 def _trailing_cap(stacked: Batch) -> int:
@@ -382,9 +672,9 @@ def _trailing_cap(stacked: Batch) -> int:
 
 
 def _concat_keys(build: Batch, bk, probe: Batch, pk) -> Batch:
-    """Device concat of the key columns of both sides (no dictionaries).
-    Rows with NULL keys are masked out (`=` never matches NULL) — the
-    stacked-path twin of _CombinedSortJoinBase._combined_keys."""
+    """Device concat of the key columns of both sides (shared dictionaries
+    only).  Rows with NULL keys are masked out (`=` never matches NULL) —
+    the stacked-path twin of _CombinedSortJoinBase._combined_keys."""
     cols = []
     bmask, pmask = build.mask(), probe.mask()
     for cb, cp in zip(bk, pk):

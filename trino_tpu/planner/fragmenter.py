@@ -1,0 +1,391 @@
+"""Exchange placement + plan fragmentation.
+
+Reference: sql/planner/optimizations/AddExchanges.java:139 (distribution
+choice), sql/planner/PlanFragmenter.java:116 (createSubPlans — cut the plan
+at remote-exchange boundaries), SystemPartitioningHandle.java:41-57 (the
+partitioning vocabulary), plan/RemoteSourceNode.java.
+
+`add_exchanges` rewrites an optimized logical plan into a distributed form
+with explicit ExchangeNodes; `create_subplans` cuts it into a SubPlan tree of
+PlanFragments, each with a partitioning handle.  The distributed runner
+executes fragments bottom-up: fragment bodies are SPMD programs over the
+worker mesh, exchanges lower to ICI collectives (all_to_all / all_gather) or
+a gather to the coordinator — never a silent fallback: every
+coordinator-side fragment is explicit in the plan (EXPLAIN shows it).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from trino_tpu.planner import plan as P
+
+# -- partitioning handles (SystemPartitioningHandle.java:41-57) ---------------
+
+SOURCE = "SOURCE"  # leaf: split-parallel scans
+FIXED_HASH = "FIXED_HASH"  # rows hash-distributed on keys
+FIXED_ARBITRARY = "FIXED_ARBITRARY"  # distributed, no key guarantee
+SINGLE = "SINGLE"  # one task (the coordinator here)
+COORDINATOR_ONLY = "COORDINATOR_ONLY"  # must run on the coordinator
+
+
+@dataclass(frozen=True)
+class PartitioningHandle:
+    kind: str
+    keys: tuple = ()  # Symbol names for FIXED_HASH
+
+    def __str__(self):
+        if self.keys:
+            return f"{self.kind}[{', '.join(self.keys)}]"
+        return self.kind
+
+
+@dataclass
+class RemoteSourceNode(P.PlanNode):
+    """Consumer-side stand-in for a child fragment's output
+    (reference: sql/planner/plan/RemoteSourceNode.java)."""
+
+    fragment_id: int
+    symbols: list  # output symbols (child fragment's root outputs)
+    exchange_kind: str  # repartition | broadcast | gather | merge
+    partition_symbols: list = field(default_factory=list)
+    orderings: list = field(default_factory=list)  # merge exchanges
+
+    @property
+    def outputs(self):
+        return list(self.symbols)
+
+    @property
+    def children(self):
+        return []
+
+    def with_children(self, children):
+        return self
+
+
+@dataclass
+class PlanFragment:
+    """reference: sql/planner/plan/PlanFragment.java."""
+
+    id: int
+    root: P.PlanNode
+    partitioning: PartitioningHandle
+
+
+@dataclass
+class SubPlan:
+    """reference: sql/planner/SubPlan.java — fragment tree."""
+
+    fragment: PlanFragment
+    children: list
+
+    def all_fragments(self):
+        yield self.fragment
+        for c in self.children:
+            yield from c.all_fragments()
+
+
+# -- AddExchanges -------------------------------------------------------------
+
+
+class _Distribution:
+    """Bottom-up distribution property of a subtree (PropertyDerivations
+    analog): 'distributed' (rows spread over workers) or 'single'."""
+
+    DISTRIBUTED = "distributed"
+    SINGLE = "single"
+
+
+class ExchangePlacer:
+    """Insert ExchangeNodes so every operator's distribution requirement is
+    met, choosing broadcast vs partitioned joins by stats (AddExchanges)."""
+
+    def __init__(self, catalogs, properties=None, n_workers: int = 8):
+        from trino_tpu.runtime.session import SessionProperties
+
+        self.catalogs = catalogs
+        self.properties = properties or SessionProperties()
+        self.n_workers = n_workers
+
+    def place(self, node: P.PlanNode):
+        out, dist = self._visit(node)
+        return out
+
+    # returns (node, distribution)
+    def _visit(self, node: P.PlanNode):
+        m = getattr(self, "_p_" + type(node).__name__, None)
+        if m is not None:
+            return m(node)
+        # unknown node: run on the coordinator over gathered children
+        return self._coordinator_only(node)
+
+    def _coordinator_only(self, node: P.PlanNode):
+        kids = []
+        for c in node.children:
+            child, dist = self._visit(c)
+            kids.append(self._gathered(child, dist))
+        return node.with_children(kids) if kids else node, _Distribution.SINGLE
+
+    def _gathered(self, node: P.PlanNode, dist: str) -> P.PlanNode:
+        if dist == _Distribution.SINGLE:
+            return node
+        return P.ExchangeNode(node, "gather")
+
+    # -- leaves --
+
+    def _p_TableScanNode(self, node):
+        return node, _Distribution.DISTRIBUTED
+
+    def _p_ValuesNode(self, node):
+        return node, _Distribution.SINGLE
+
+    # -- distribution-preserving unaries --
+
+    def _inherit(self, node):
+        child, dist = self._visit(node.children[0])
+        return node.with_children([child]), dist
+
+    _p_FilterNode = _inherit
+    _p_ProjectNode = _inherit
+
+    def _p_OutputNode(self, node):
+        child, dist = self._visit(node.source)
+        return node.with_children([self._gathered(child, dist)]), _Distribution.SINGLE
+
+    def _p_EnforceSingleRowNode(self, node):
+        child, dist = self._visit(node.source)
+        return node.with_children([self._gathered(child, dist)]), _Distribution.SINGLE
+
+    # -- aggregation: partial below exchange, final above --
+
+    def _p_AggregationNode(self, node: P.AggregationNode):
+        child, dist = self._visit(node.source)
+        if dist == _Distribution.SINGLE:
+            return node.with_children([child]), _Distribution.SINGLE
+        if any(
+            a.distinct or a.function == "percentile"
+            for _, a in node.aggregations
+        ):
+            # DISTINCT / percentile aggregates need the whole group on one
+            # node; the local engine handles them after a gather
+            return (
+                node.with_children([self._gathered(child, dist)]),
+                _Distribution.SINGLE,
+            )
+        if node.group_symbols:
+            # the executor pushes the PARTIAL step to the producing side of
+            # the exchange and runs FINAL above it (the
+            # PushPartialAggregationThroughExchange effect)
+            ex = P.ExchangeNode(child, "repartition", list(node.group_symbols))
+            return node.with_children([ex]), _Distribution.DISTRIBUTED
+        # global aggregation: partial states per worker, gathered + merged
+        ex = P.ExchangeNode(child, "gather")
+        return node.with_children([ex]), _Distribution.SINGLE
+
+    # -- joins --
+
+    def _p_JoinNode(self, node: P.JoinNode):
+        from trino_tpu.planner.stats import estimate_rows
+
+        left, ldist = self._visit(node.left)
+        right, rdist = self._visit(node.right)
+        supported = node.kind in ("inner", "left") and node.criteria
+        if not supported or ldist == _Distribution.SINGLE:
+            return (
+                node.with_children(
+                    [self._gathered(left, ldist), self._gathered(right, rdist)]
+                ),
+                _Distribution.SINGLE,
+            )
+        pref = self.properties.get("join_distribution_type").upper()
+        limit = self.properties.get("broadcast_join_rows")
+        est = estimate_rows(node.right, self.catalogs)
+        broadcast = pref == "BROADCAST" or (
+            pref == "AUTOMATIC" and est is not None and est <= limit
+        )
+        if broadcast:
+            ex = P.ExchangeNode(right, "broadcast")
+            out = P.JoinNode(
+                node.kind, left, ex, node.criteria, node.filter, "broadcast"
+            )
+        else:
+            lex = P.ExchangeNode(
+                left, "repartition", [l for l, _ in node.criteria]
+            )
+            rex = P.ExchangeNode(
+                right, "repartition", [r for _, r in node.criteria]
+            )
+            out = P.JoinNode(
+                node.kind, lex, rex, node.criteria, node.filter, "partitioned"
+            )
+        return out, _Distribution.DISTRIBUTED
+
+    def _p_SemiJoinNode(self, node: P.SemiJoinNode):
+        src, sdist = self._visit(node.source)
+        filt, fdist = self._visit(node.filtering)
+        if sdist == _Distribution.SINGLE or node.filter is not None:
+            # correlated semi-join filters run on the local operator
+            return (
+                node.with_children(
+                    [self._gathered(src, sdist), self._gathered(filt, fdist)]
+                ),
+                _Distribution.SINGLE,
+            )
+        ex = P.ExchangeNode(filt, "broadcast")
+        return node.with_children([src, ex]), _Distribution.DISTRIBUTED
+
+    # -- sorting / limiting: partial per worker + merge/gather + final --
+
+    def _p_SortNode(self, node: P.SortNode):
+        child, dist = self._visit(node.source)
+        if dist == _Distribution.SINGLE:
+            return node.with_children([child]), _Distribution.SINGLE
+        partial = P.SortNode(child, node.orderings)
+        ex = P.ExchangeNode(partial, "merge", [], list(node.orderings))
+        return ex, _Distribution.SINGLE
+
+    def _p_TopNNode(self, node: P.TopNNode):
+        child, dist = self._visit(node.source)
+        if dist == _Distribution.SINGLE:
+            return node.with_children([child]), _Distribution.SINGLE
+        partial = P.TopNNode(child, node.orderings, node.count)
+        ex = P.ExchangeNode(partial, "merge", [], list(node.orderings))
+        return P.TopNNode(ex, node.orderings, node.count), _Distribution.SINGLE
+
+    def _p_LimitNode(self, node: P.LimitNode):
+        child, dist = self._visit(node.source)
+        if dist == _Distribution.SINGLE:
+            return node.with_children([child]), _Distribution.SINGLE
+        if node.count is None:  # OFFSET-only: no partial-limit benefit
+            return (
+                P.LimitNode(self._gathered(child, dist), None, node.offset),
+                _Distribution.SINGLE,
+            )
+        # per-worker partial limit keeps offset+count rows; final applies both
+        partial = P.LimitNode(child, node.count + node.offset)
+        ex = P.ExchangeNode(partial, "gather")
+        return P.LimitNode(ex, node.count, node.offset), _Distribution.SINGLE
+
+    # -- window: repartition on partition keys --
+
+    def _p_WindowNode(self, node: P.WindowNode):
+        child, dist = self._visit(node.source)
+        if dist == _Distribution.SINGLE:
+            return node.with_children([child]), _Distribution.SINGLE
+        if not node.partition_by:
+            # whole-input window: single partition must see every row
+            return (
+                node.with_children([self._gathered(child, dist)]),
+                _Distribution.SINGLE,
+            )
+        ex = P.ExchangeNode(child, "repartition", list(node.partition_by))
+        return node.with_children([ex]), _Distribution.DISTRIBUTED
+
+    def _p_MarkDistinctNode(self, node):
+        child, dist = self._visit(node.source)
+        if dist == _Distribution.SINGLE:
+            return node.with_children([child]), _Distribution.SINGLE
+        # repartition on the full key set: every distinct combination lands
+        # wholly on one worker, so first-occurrence marks are globally unique
+        ex = P.ExchangeNode(child, "repartition", list(node.key_symbols))
+        return node.with_children([ex]), _Distribution.DISTRIBUTED
+
+    # -- set operations --
+
+    def _p_UnionNode(self, node: P.UnionNode):
+        kids = []
+        dists = []
+        for c in node.children:
+            k, d = self._visit(c)
+            kids.append(k)
+            dists.append(d)
+        if all(d == _Distribution.SINGLE for d in dists):
+            return node.with_children(kids), _Distribution.SINGLE
+        # mixed: gather everything (UNION semantics are arbitrary-ordered, a
+        # distributed union would also be fine; coordinator concat is exact)
+        kids = [self._gathered(k, d) for k, d in zip(kids, dists)]
+        return node.with_children(kids), _Distribution.SINGLE
+
+    def _p_ExchangeNode(self, node: P.ExchangeNode):
+        return self._inherit(node)
+
+
+def add_exchanges(plan: P.OutputNode, catalogs, properties=None, n_workers: int = 8):
+    placer = ExchangePlacer(catalogs, properties, n_workers)
+    out = placer.place(plan)
+    assert isinstance(out, P.OutputNode)
+    return out
+
+
+# -- PlanFragmenter -----------------------------------------------------------
+
+
+class _Fragmenter:
+    def __init__(self):
+        self.next_id = 0
+
+    def fragment(self, root: P.PlanNode) -> SubPlan:
+        """Cut at every ExchangeNode; the subtree below each exchange becomes
+        a child fragment, replaced by a RemoteSourceNode in the parent."""
+        children: list[SubPlan] = []
+
+        def cut(node: P.PlanNode) -> P.PlanNode:
+            if isinstance(node, P.ExchangeNode):
+                child_sub = self.fragment(node.source)
+                children.append(child_sub)
+                return RemoteSourceNode(
+                    child_sub.fragment.id,
+                    list(node.source.outputs),
+                    node.kind,
+                    list(node.partition_symbols),
+                    list(node.orderings),
+                )
+            kids = node.children
+            if not kids:
+                return node
+            return node.with_children([cut(c) for c in kids])
+
+        body = cut(root)
+        fid = self.next_id
+        self.next_id += 1
+        part = _fragment_partitioning(body)
+        sub = SubPlan(PlanFragment(fid, body, part), children)
+        return sub
+
+
+def _fragment_partitioning(body: P.PlanNode) -> PartitioningHandle:
+    """Derive the fragment's partitioning handle from its body."""
+    has_scan = any(isinstance(n, P.TableScanNode) for n in P.walk(body))
+    remotes = [n for n in P.walk(body) if isinstance(n, RemoteSourceNode)]
+    if has_scan:
+        return PartitioningHandle(SOURCE)
+    for r in remotes:
+        if r.exchange_kind == "repartition":
+            return PartitioningHandle(
+                FIXED_HASH, tuple(s.name for s in r.partition_symbols)
+            )
+    for r in remotes:
+        if r.exchange_kind in ("gather", "merge"):
+            return PartitioningHandle(SINGLE)
+        if r.exchange_kind == "broadcast":
+            return PartitioningHandle(FIXED_ARBITRARY)
+    return PartitioningHandle(COORDINATOR_ONLY)
+
+
+def create_subplans(distributed_plan: P.PlanNode) -> SubPlan:
+    return _Fragmenter().fragment(distributed_plan)
+
+
+def fragment_text(sub: SubPlan) -> str:
+    """EXPLAIN (TYPE DISTRIBUTED) rendering (planprinter role)."""
+    lines = []
+
+    def render(s: SubPlan):
+        lines.append(f"Fragment {s.fragment.id} [{s.fragment.partitioning}]")
+        body = P.plan_text(s.fragment.root, indent=1)
+        lines.append(body.rstrip("\n"))
+        for c in s.children:
+            render(c)
+
+    render(sub)
+    return "\n".join(lines) + "\n"

@@ -23,7 +23,23 @@ AGG_FUNCS = {
     "bool_and": "bool_and",
     "bool_or": "bool_or",
     "every": "bool_and",
+    # moment family (reference: operator/aggregation/ Variance/StdDev states)
+    "stddev": "stddev_samp",
+    "stddev_samp": "stddev_samp",
+    "stddev_pop": "stddev_pop",
+    "variance": "var_samp",
+    "var_samp": "var_samp",
+    "var_pop": "var_pop",
+    # approx_percentile computes the exact percentile (sort-based engines get
+    # exactness cheaper than a qdigest; "approximate" permits exact answers)
+    "approx_percentile": "percentile",
+    # exact distinct count satisfies the approx contract (agg_symbol rewrites
+    # this to a DISTINCT count before planning)
+    "approx_distinct": "count",
 }
+
+#: aggregates whose grouped state is the (count, sum, sum-of-squares) triple
+MOMENT_AGGS = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
 
 
 def agg_result_type(name: str, arg_type: T.Type | None) -> T.Type:
@@ -45,6 +61,10 @@ def agg_result_type(name: str, arg_type: T.Type | None) -> T.Type:
         return arg_type
     if name in ("bool_and", "bool_or"):
         return T.BOOLEAN
+    if name in MOMENT_AGGS:
+        return T.DOUBLE
+    if name == "percentile":
+        return arg_type
     raise TypeError(f"unknown aggregate {name}")
 
 
@@ -116,6 +136,9 @@ SCALAR_RESULT = {
     "position": _fixed(T.BIGINT),
     "starts_with": _fixed(T.BOOLEAN),
     "like": _fixed(T.BOOLEAN),
+    "regexp_like": _fixed(T.BOOLEAN),
+    "regexp_extract": _fixed(T.VARCHAR),
+    "regexp_replace": _fixed(T.VARCHAR),
     "abs": _same_as_first,
     "sign": _same_as_first,
     "sqrt": _fixed(T.DOUBLE),

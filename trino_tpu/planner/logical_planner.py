@@ -122,14 +122,10 @@ class LogicalPlanner:
                 node = P.TopNNode(node, orderings, q.limit)
             else:
                 node = P.SortNode(node, orderings)
-                if q.offset:
-                    raise AnalysisError("OFFSET not supported yet")
-                if q.limit is not None:
-                    node = P.LimitNode(node, q.limit)
+                if q.limit is not None or q.offset:
+                    node = P.LimitNode(node, q.limit, q.offset or 0)
         elif q.limit is not None or q.offset:
-            if q.offset:
-                raise AnalysisError("OFFSET not supported yet")
-            node = P.LimitNode(node, q.limit)
+            node = P.LimitNode(node, q.limit, q.offset or 0)
         return RelationPlan(node, rp.fields), names
 
     def plan_query_body(self, body: ast.Node, outer, ctes):
@@ -148,25 +144,86 @@ class LogicalPlanner:
         raise AnalysisError(f"unsupported query body {type(body).__name__}")
 
     def plan_set_op(self, s: ast.SetOp, outer, ctes):
-        if s.op != "union":
-            raise AnalysisError(f"{s.op.upper()} not supported yet")
         lrp, lnames = self.plan_query_body(s.left, outer, ctes)
         rrp, rnames = self.plan_query_body(s.right, outer, ctes)
         if len(lrp.fields) != len(rrp.fields):
-            raise AnalysisError("UNION inputs must have the same arity")
+            raise AnalysisError(
+                f"{s.op.upper()} inputs must have the same arity"
+            )
+        if s.op == "union":
+            out_syms = []
+            for lf, rf in zip(lrp.fields, rrp.fields):
+                t = T.common_super_type(lf.symbol.type, rf.symbol.type)
+                out_syms.append(self.alloc.new(lf.name, t))
+            node = P.UnionNode(
+                [lrp.node, rrp.node],
+                out_syms,
+                [[f.symbol for f in lrp.fields], [f.symbol for f in rrp.fields]],
+            )
+            if not s.all:
+                node = P.AggregationNode(node, list(out_syms), [])
+            fields = [Field(n, s_) for n, s_ in zip(lnames, out_syms)]
+            return RelationPlan(node, fields), lnames
+        # INTERSECT / EXCEPT (distinct semantics): lowered to a tagged UNION
+        # ALL + per-side counts + filter (reference: the
+        # ImplementIntersectAsUnion / ImplementExceptAsUnion rules under
+        # sql/planner/iterative/rule/ + SqlBase.g4:244-245)
+        if s.all:
+            raise AnalysisError(f"{s.op.upper()} ALL not supported yet")
+        sides = []
+        for rp in (lrp, rrp):
+            side = self.alloc.new("side", T.BIGINT)
+            tag = P.ProjectNode(
+                rp.node,
+                [(f.symbol, f.symbol.ref()) for f in rp.fields]
+                + [(side, Literal(len(sides), T.BIGINT))],
+            )
+            sides.append((tag, [f.symbol for f in rp.fields] + [side]))
         out_syms = []
         for lf, rf in zip(lrp.fields, rrp.fields):
             t = T.common_super_type(lf.symbol.type, rf.symbol.type)
             out_syms.append(self.alloc.new(lf.name, t))
-        node = P.UnionNode(
-            [lrp.node, rrp.node],
-            out_syms,
-            [[f.symbol for f in lrp.fields], [f.symbol for f in rrp.fields]],
+        side_sym = self.alloc.new("side", T.BIGINT)
+        union = P.UnionNode(
+            [n for n, _ in sides],
+            out_syms + [side_sym],
+            [syms for _, syms in sides],
         )
-        if not s.all:
-            node = P.AggregationNode(node, list(out_syms), [])
+        lcnt = self.alloc.new("lcnt", T.BIGINT)
+        rcnt = self.alloc.new("rcnt", T.BIGINT)
+        aggs = [
+            (
+                lcnt,
+                P.Aggregation(
+                    "count_star",
+                    [],
+                    filter=ir.comparison(
+                        "=", side_sym.ref(), Literal(0, T.BIGINT)
+                    ),
+                ),
+            ),
+            (
+                rcnt,
+                P.Aggregation(
+                    "count_star",
+                    [],
+                    filter=ir.comparison(
+                        "=", side_sym.ref(), Literal(1, T.BIGINT)
+                    ),
+                ),
+            ),
+        ]
+        agg = P.AggregationNode(union, list(out_syms), aggs)
+        both = ir.comparison(">", lcnt.ref(), Literal(0, T.BIGINT))
+        other = (
+            ir.comparison(">", rcnt.ref(), Literal(0, T.BIGINT))
+            if s.op == "intersect"
+            else ir.comparison("=", rcnt.ref(), Literal(0, T.BIGINT))
+        )
+        filt = P.FilterNode(agg, ir.and_(both, other))
+        proj = P.ProjectNode(filt, [(sym, sym.ref()) for sym in out_syms])
         fields = [Field(n, s_) for n, s_ in zip(lnames, out_syms)]
-        return RelationPlan(node, fields), lnames
+        return RelationPlan(proj, fields), lnames
 
     def plan_values(self, v: ast.ValuesRelation) -> RelationPlan:
         scope = Scope([])
@@ -406,20 +463,48 @@ class LogicalPlanner:
                 )
                 filter_ir = filter_sym.ref()
                 filter_key = filter_ir.key()
-            if fc.is_star and fc.name == "count":
+            distinct = fc.distinct
+            param = None
+            fn_args = list(fc.args)
+            sql_name = fc.name
+            if sql_name == "approx_distinct":
+                # exact distinct count satisfies the approx contract
+                # (reference role: ApproximateCountDistinctAggregation)
+                sql_name, distinct = "count", True
+                fn_args = fn_args[:1]  # drop max-standard-error argument
+            if fc.is_star and sql_name == "count":
                 key = ("count_star", (), False, filter_key)
                 fname, arg_syms, arg_t = "count_star", [], None
             else:
-                arg_irs = [src_an.analyze(a) for a in fc.args]
+                fname = AGG_FUNCS[sql_name]
+                if fname == "percentile":
+                    if len(fn_args) != 2:
+                        # weighted / accuracy signatures would silently give
+                        # wrong numbers — reject anything but (value, frac)
+                        raise AnalysisError(
+                            "approx_percentile supports exactly "
+                            "(value, percentile)"
+                        )
+                    p_ir = src_an.analyze(fn_args[-1])
+                    from trino_tpu.expr.constant_folding import try_fold
+
+                    p_ir = try_fold(p_ir)
+                    if not isinstance(p_ir, Literal):
+                        raise AnalysisError(
+                            "approx_percentile fraction must be a literal"
+                        )
+                    param = float(p_ir.value)
+                    fn_args = fn_args[:1]
+                arg_irs = [src_an.analyze(a) for a in fn_args]
                 key = (
-                    AGG_FUNCS[fc.name],
+                    fname,
                     tuple(a.key() for a in arg_irs),
-                    fc.distinct,
+                    distinct,
                     filter_key,
+                    param,
                 )
-                fname = AGG_FUNCS[fc.name]
                 arg_syms = [
-                    pre_symbol(a, _name_hint(fc.args[i]))
+                    pre_symbol(a, _name_hint(fn_args[i]))
                     for i, a in enumerate(arg_irs)
                 ]
                 arg_t = arg_irs[0].type if arg_irs else None
@@ -431,7 +516,11 @@ class LogicalPlanner:
                 (
                     sym,
                     P.Aggregation(
-                        fname, [s.ref() for s in arg_syms], fc.distinct, filter_ir
+                        fname,
+                        [s.ref() for s in arg_syms],
+                        distinct,
+                        filter_ir,
+                        param,
                     ),
                 )
             )
@@ -578,8 +667,22 @@ class LogicalPlanner:
         # ---- EXISTS / IN ----------------------------------------------------
         if kind == "exists":
             mark = self.alloc.new("exists", T.BOOLEAN)
+            if not crit and not correlated:
+                # uncorrelated EXISTS: one global count over the subquery,
+                # cross-joined (reference: TransformUncorrelatedSubqueryToJoin)
+                cnt = self.alloc.new("cnt", T.BIGINT)
+                agg = P.AggregationNode(
+                    sub.node, [], [(cnt, P.Aggregation("count_star", []))]
+                )
+                node = P.JoinNode("cross", rp.node, agg, [])
+                out = RelationPlan(node, rp.fields + [Field(cnt.name, cnt)])
+                val = ir.comparison(">", cnt.ref(), Literal(0, T.BIGINT))
+                return out, (ir.not_(val) if negated else val)
             if not crit:
-                raise AnalysisError("uncorrelated EXISTS not supported yet")
+                raise AnalysisError(
+                    "correlated EXISTS without an equi-join predicate "
+                    "not supported yet"
+                )
             (osym, isym), extra = crit[0], crit[1:]
             filt = None
             parts = correlated + [
@@ -606,12 +709,42 @@ class LogicalPlanner:
                 sub_full, _ = self.plan_query_spec(spec, sub_outer, ctes)
                 inner_sym = sub_full.fields[0].symbol
                 sub_node = sub_full.node
+            elif crit or correlated:
+                # correlated IN: keep the correlation's inner symbols in the
+                # filtering side so the semi join's filter can see them
+                item = spec.items[0]
+                if len(spec.items) != 1 or not isinstance(item, ast.SelectItem):
+                    raise AnalysisError("IN subquery must return one column")
+                val_e = ExprAnalyzer(sub_scope).analyze(item.expr)
+                if isinstance(val_e, SymbolRef):
+                    inner_sym = P.Symbol(val_e.name, val_e.type)
+                else:
+                    inner_sym = self.alloc.new("in_inner", val_e.type)
+                needed: dict = {}
+                for _, isym in crit:
+                    needed[isym.name] = isym
+                corr_names: set = set()
+                for e in correlated:
+                    _collect_ref_names(e, corr_names)
+                for f in sub.fields:
+                    if f.symbol.name in corr_names:
+                        needed.setdefault(f.symbol.name, f.symbol)
+                assigns = [(inner_sym, val_e)] + [
+                    (sym, sym.ref())
+                    for name, sym in needed.items()
+                    if name != inner_sym.name
+                ]
+                sub_node = P.ProjectNode(sub.node, assigns)
             else:
                 inner_sym = sub_proj.fields[0].symbol
                 sub_node = sub_proj.node
             mark = self.alloc.new("in_mark", T.BOOLEAN)
-            if crit or correlated:
-                raise AnalysisError("correlated IN subquery not supported yet")
+            if (crit or correlated) and (
+                spec.group_by or item_aggs or spec.having is not None
+            ):
+                raise AnalysisError(
+                    "correlated grouped IN subquery not supported yet"
+                )
             assert in_value is not None
             if isinstance(in_value, SymbolRef):
                 src_sym = P.Symbol(in_value.name, in_value.type)
@@ -625,7 +758,18 @@ class LogicalPlanner:
                     + [(src_sym, in_value)],
                 )
                 out_fields = rp.fields + [Field(src_sym.name, src_sym)]
-            node = P.SemiJoinNode(src_node, sub_node, src_sym, inner_sym, mark)
+            # correlated IN: the correlation predicates become the semi
+            # join's extra filter over (source ++ filtering) symbols
+            # (reference: TransformCorrelatedInPredicateToJoin)
+            filt = None
+            parts = correlated + [
+                ir.comparison("=", o.ref(), i.ref()) for o, i in crit
+            ]
+            if parts:
+                filt = ir.and_(*parts)
+            node = P.SemiJoinNode(
+                src_node, sub_node, src_sym, inner_sym, mark, filt
+            )
             out = RelationPlan(node, out_fields + [Field(mark.name, mark)])
             val = mark.ref()
             return out, (ir.not_(val) if negated else val)
@@ -679,6 +823,18 @@ class LogicalPlanner:
         node = P.JoinNode("cross", rp.node, rp2.node, [])
         out = RelationPlan(node, rp.fields + rp2.fields)
         return out, rp2.fields[0].symbol.ref()
+
+
+def _collect_ref_names(e: Expr, out: set) -> None:
+    """Names of every SymbolRef inside `e`."""
+    from trino_tpu.expr.ir import visit
+
+    def fn(x):
+        if isinstance(x, SymbolRef):
+            out.add(x.name)
+        return x
+
+    visit(e, fn)
 
 
 def _is_bare_count(spec: ast.QuerySpec) -> bool:
@@ -771,9 +927,19 @@ class _WindowExtractor:
                         an.analyze(fc.args[2]), "default"
                     )
         elif name in AGG_FUNCS or (fc.is_star and name == "count"):
+            if fc.distinct:
+                raise AnalysisError(
+                    "DISTINCT aggregates are not supported as window functions"
+                )
             if fc.is_star:
                 name, out_t = "count_star", T.BIGINT
             else:
+                if AGG_FUNCS.get(name) not in (
+                    "count", "sum", "avg", "min", "max",
+                ) or name == "approx_distinct":
+                    raise AnalysisError(
+                        f"{name} is not supported as a window function"
+                    )
                 arg = an.analyze(fc.args[0])
                 arg_syms = [self._pre_symbol(arg, _name_hint(fc.args[0]))]
                 out_t = agg_result_type(AGG_FUNCS[name], arg.type)

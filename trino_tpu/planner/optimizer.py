@@ -72,6 +72,51 @@ def rule_push_filter_into_scan(node: P.PlanNode):
     return None
 
 
+_MD_COUNTER = __import__("itertools").count()
+
+
+def rule_mixed_distinct(node: P.PlanNode):
+    """Rewrite mixed/multi-argument DISTINCT aggregates into MarkDistinct +
+    FILTERed plain aggregates (reference: plan/MarkDistinctNode.java and
+    the MultipleDistinctAggregationToMarkDistinct rule)."""
+    from trino_tpu import types as T
+    from trino_tpu.expr.ir import SymbolRef
+
+    if not isinstance(node, P.AggregationNode) or node.step != "single":
+        return None
+    distincts = [(s, a) for s, a in node.aggregations if a.distinct]
+    if not distincts:
+        return None
+    arg_sets = {tuple(x.key() for x in a.args) for _, a in distincts}
+    if len(arg_sets) == 1 and all(a.distinct for _, a in node.aggregations):
+        return None  # uniform shape: the execution-level pre-agg handles it
+    if any(a.filter is not None for _, a in distincts):
+        return None  # DISTINCT + FILTER: unsupported downstream
+    if any(
+        not all(isinstance(x, SymbolRef) for x in a.args) for _, a in distincts
+    ):
+        return None
+    src = node.source
+    marks: dict = {}
+    new_aggs = []
+    for s, a in node.aggregations:
+        if not a.distinct:
+            new_aggs.append((s, a))
+            continue
+        k = tuple(x.key() for x in a.args)
+        if k not in marks:
+            mark = P.Symbol(f"$distinct_{next(_MD_COUNTER)}", T.BOOLEAN)
+            keys = list(node.group_symbols) + [
+                P.Symbol(x.name, x.type) for x in a.args
+            ]
+            src = P.MarkDistinctNode(src, keys, mark)
+            marks[k] = mark
+        new_aggs.append(
+            (s, P.Aggregation(a.function, a.args, False, marks[k].ref()))
+        )
+    return P.AggregationNode(src, node.group_symbols, new_aggs, node.step)
+
+
 def rule_remove_identity_project(node: P.PlanNode):
     """Drop no-op projections (reference: iterative/rule/
     RemoveRedundantIdentityProjections.java)."""
@@ -98,6 +143,7 @@ def optimize(plan: P.OutputNode, rules=None, catalogs=None) -> P.OutputNode:
             push_filter_through_join,
             rule_push_filter_into_scan,
             rule_remove_identity_project,
+            rule_mixed_distinct,
         ]
     # iterate whole-tree passes to fixpoint: rules unlock each other (e.g.
     # cross-join elimination creates filters that then push into scans),

@@ -126,6 +126,7 @@ class Aggregation:
     args: list  # [Expr]; empty for count_star
     distinct: bool = False
     filter: Optional[Expr] = None
+    param: object = None  # literal parameter (approx_percentile fraction)
 
 
 @dataclass
@@ -147,6 +148,29 @@ class AggregationNode(PlanNode):
         return AggregationNode(
             children[0], self.group_symbols, self.aggregations, self.step
         )
+
+
+@dataclass
+class MarkDistinctNode(PlanNode):
+    """Adds a boolean column marking the first occurrence of each distinct
+    key combination (reference: plan/MarkDistinctNode.java +
+    operator/MarkDistinctOperator.java).  Used to rewrite mixed DISTINCT
+    aggregates into FILTERed plain aggregates."""
+
+    source: PlanNode
+    key_symbols: list  # [Symbol] (group keys + the distinct argument)
+    mark: Symbol  # boolean output
+
+    @property
+    def outputs(self):
+        return self.source.outputs + [self.mark]
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return MarkDistinctNode(children[0], self.key_symbols, self.mark)
 
 
 @dataclass
@@ -281,7 +305,8 @@ class TopNNode(PlanNode):
 @dataclass
 class LimitNode(PlanNode):
     source: PlanNode
-    count: int
+    count: object  # int, or None for OFFSET without LIMIT
+    offset: int = 0  # rows skipped before counting (reference: OffsetNode)
 
     @property
     def outputs(self):
@@ -292,7 +317,7 @@ class LimitNode(PlanNode):
         return [self.source]
 
     def with_children(self, children):
-        return LimitNode(children[0], self.count)
+        return LimitNode(children[0], self.count, self.offset)
 
 
 @dataclass
@@ -438,6 +463,12 @@ def plan_text(node: PlanNode, indent: int = 0) -> str:
         detail = "[" + ", ".join(node.column_names) + "]"
     elif isinstance(node, ExchangeNode):
         detail = f"[{node.kind}]" + (
+            f" by=[{', '.join(s.name for s in node.partition_symbols)}]"
+            if node.partition_symbols
+            else ""
+        )
+    elif hasattr(node, "exchange_kind"):  # RemoteSourceNode (fragmenter)
+        detail = f"[fragment {node.fragment_id}, {node.exchange_kind}]" + (
             f" by=[{', '.join(s.name for s in node.partition_symbols)}]"
             if node.partition_symbols
             else ""

@@ -67,10 +67,26 @@ class PhysicalPlan:
 
 
 class LocalExecutionPlanner:
-    def __init__(self, catalogs: CatalogManager, target_splits: int = 4, stats=None):
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        target_splits: int = 4,
+        stats=None,
+        properties=None,
+    ):
+        from trino_tpu.runtime.memory import MemoryPool
+        from trino_tpu.runtime.session import SessionProperties
+
         self.catalogs = catalogs
         self.target_splits = target_splits
         self.stats = stats  # Optional[StatsCollector] for EXPLAIN ANALYZE
+        self.properties = properties or SessionProperties()
+        #: per-query device-memory budget tree (reference:
+        #: lib/trino-memory-context AggregatedMemoryContext + MemoryPool);
+        #: blocking operators reserve through children of this context
+        self.memory = MemoryPool().query_context(
+            "query", self.properties.get("query_max_memory_bytes")
+        )
         self._depth = 0
         #: symbol name -> (lo, hi) host values collected from materialized
         #: join build sides (reference: server/DynamicFilterService.java:107 +
@@ -100,6 +116,9 @@ class LocalExecutionPlanner:
         names = [c for _, c in node.assignments]
         types = [s.type for s, _ in node.assignments]
         splits = list(connector.splits(node.handle, target_splits=self.target_splits))
+        page_rows = self.properties.get("page_rows")
+        use_cache = self.properties.get("scan_cache")
+        prefetch_depth = self.properties.get("scan_prefetch_depth")
 
         def stream():
             from trino_tpu.runtime.retry import FAILURE_INJECTOR
@@ -108,10 +127,18 @@ class LocalExecutionPlanner:
                 FAILURE_INJECTOR.maybe_fail(
                     f"scan:{node.handle.schema}.{node.handle.table}:{split.seq}"
                 )
-                op = ScanOperator(connector, split, names, types)
+                op = ScanOperator(
+                    connector, split, names, types,
+                    page_rows=page_rows, use_cache=use_cache,
+                )
                 yield from op.batches()
 
-        plan = PhysicalPlan(stream(), [s for s, _ in node.assignments])
+        feed = stream()
+        if prefetch_depth > 0:
+            from trino_tpu.runtime.prefetch import prefetch_iter
+
+            feed = prefetch_iter(feed, depth=prefetch_depth)
+        plan = PhysicalPlan(feed, [s for s, _ in node.assignments])
         pred_expr = node.pushed_predicate
         # dynamic filters registered by upstream join builds (ranges over this
         # scan's output symbols) fuse into the scan's first device step
@@ -188,18 +215,38 @@ class LocalExecutionPlanner:
             else:
                 proj.append(arg)
                 input_types.append(arg.type)
-                specs.append(AggSpec(name, ngroups + len(specs_args(specs)), out_sym.type))
+                specs.append(
+                    AggSpec(
+                        name,
+                        ngroups + len(specs_args(specs)),
+                        out_sym.type,
+                        param=getattr(agg, "param", None),
+                    )
+                )
 
         pre = FilterProjectOperator(None, proj)
+        # percentile needs every group row at once: no streaming partials
+        streaming = not any(s.name == "percentile" for s in specs)
         op = AggregationOperator(
             list(range(ngroups)),
             specs,
             input_types,
             mode=node.step,
-            streaming=True,
+            streaming=streaming,
+            fold_every=self.properties.get("agg_fold_batches"),
+            memory_ctx=self.memory.child("aggregation"),
         )
         stream = op.process(pre.process(src.stream))
         return PhysicalPlan(stream, node.outputs)
+
+    def _visit_MarkDistinctNode(self, node: P.MarkDistinctNode) -> PhysicalPlan:
+        from trino_tpu.ops.aggregation import MarkDistinctOperator
+
+        src = self.plan(node.source)
+        op = MarkDistinctOperator(
+            [src.channel(s.name) for s in node.key_symbols]
+        )
+        return PhysicalPlan(op.process(src.stream), node.outputs)
 
     def _distinct_preagg(self, node: P.AggregationNode, src: PhysicalPlan) -> PhysicalPlan:
         """DISTINCT aggregates via pre-grouping (reference role: the
@@ -245,6 +292,11 @@ class LocalExecutionPlanner:
             )
             return PhysicalPlan(proj.process(out.stream), node.outputs)
 
+        from trino_tpu.runtime.memory import (
+            ExceededMemoryLimitException,
+            batch_bytes,
+        )
+
         build = self.plan(node.right)
         build_batches = list(build.stream)
         if node.kind == "inner":
@@ -269,17 +321,44 @@ class LocalExecutionPlanner:
             def residual(batch: Batch, _e=res_expr):
                 return ExprCompiler(batch).filter_mask(_e)
 
-        op = HashJoinOperator(
-            node.kind,
-            probe_keys,
-            build_keys,
-            build.types(),
-            probe_types=probe.types(),
-            residual=residual,
-            residual_key=residual_key,
-        )
+        def make_op():
+            return HashJoinOperator(
+                node.kind,
+                probe_keys,
+                build_keys,
+                build.types(),
+                probe_types=probe.types(),
+                residual=residual,
+                residual_key=residual_key,
+            )
+
+        # reserve the dense build footprint; on budget overflow fall back to
+        # hash-partitioned waves (the HBM analog of build-side spill:
+        # HashBuilderOperator.startMemoryRevoke + SpillingJoinProcessor)
+        ctx = self.memory.child("join_build")
+        build_bytes = sum(batch_bytes(b) for b in build_batches)
+        try:
+            ctx.add_bytes(2 * build_bytes)  # raw batches + compacted copy
+        except ExceededMemoryLimitException:
+            limit = self.memory.limit_bytes
+            n_waves = max(2, -(-2 * build_bytes // max(limit // 2, 1)))
+            return PhysicalPlan(
+                _wave_join_stream(
+                    make_op, build_batches, probe.stream,
+                    probe_keys, build_keys, n_waves, ctx,
+                ),
+                out_symbols,
+            )
+        op = make_op()
         op.set_build(build_batches)
-        return PhysicalPlan(op.process(probe.stream), out_symbols)
+
+        def stream():
+            yield from op.process(probe.stream)
+            ctx.close()
+
+        return PhysicalPlan(stream(), out_symbols)
+
+    # -- memory-pressure join waves (spill analog) ----------------------------
 
     def _visit_SemiJoinNode(self, node: P.SemiJoinNode) -> PhysicalPlan:
         src = self.plan(node.source)
@@ -359,7 +438,7 @@ class LocalExecutionPlanner:
 
     def _visit_LimitNode(self, node: P.LimitNode) -> PhysicalPlan:
         src = self.plan(node.source)
-        op = LimitOperator(node.count)
+        op = LimitOperator(node.count, getattr(node, "offset", 0))
         return PhysicalPlan(op.process(src.stream), src.symbols)
 
     # -- shape nodes ----------------------------------------------------------
@@ -422,6 +501,67 @@ class LocalExecutionPlanner:
             )
             return PhysicalPlan(proj.process(src.stream), node.symbols)
         return PhysicalPlan(src.stream, node.symbols)
+
+
+def _wave_join_stream(
+    make_op, build_batches, probe_stream, probe_keys, build_keys,
+    n_waves: int, ctx,
+):
+    """k-pass partition-wave join under memory pressure (reference:
+    operator/join/SpillingJoinProcessor.java + HashBuilderOperator
+    .startMemoryRevoke:372).  Both sides are hash-partitioned on the join
+    keys into `n_waves` partitions; each wave builds only its slice of the
+    build side on device while both sides re-feed from host RAM — host
+    memory is the spill tier of a TPU engine.  Partitioning both sides by
+    the same key hash preserves exact results for inner/left/full joins:
+    every potential match pair lands in the same wave, and each row is
+    emitted by exactly one wave."""
+    import jax
+    import jax.numpy as jnp
+
+    from trino_tpu.parallel.exchange import _hash_rows
+    from trino_tpu.runtime.memory import batch_bytes
+
+    # spill both sides to host RAM (device_get frees HBM references)
+    build_host = [jax.device_get(b) for b in build_batches]
+    probe_host = [jax.device_get(b) for b in probe_stream]
+    build_batches.clear()
+
+    def make_filter(key_channels):
+        def step(batch: Batch, wave):
+            h = _hash_rows(batch, key_channels)
+            sel = (h % jnp.uint64(n_waves)).astype(jnp.int64) == wave
+            return batch.filter(jnp.logical_and(batch.mask(), sel))
+
+        return jax.jit(step)
+
+    bf = make_filter(build_keys)
+    pf = make_filter(probe_keys)
+    compact = jax.jit(Batch.compact_device, static_argnames=("out_capacity",))
+    from trino_tpu.ops.common import next_pow2
+
+    for wave in range(n_waves):
+        w = jnp.asarray(wave, jnp.int64)
+        # compact each filtered build batch immediately so peak HBM per wave
+        # is one full batch + this wave's (small) slice, not the whole build
+        wave_build = []
+        wave_bytes = 0
+        for b in build_host:
+            fb = bf(jax.device_put(b), w)
+            n = fb.num_rows_host()
+            fb = compact(fb, out_capacity=next_pow2(max(n, 1), floor=1))
+            wave_build.append(fb)
+            wave_bytes += batch_bytes(fb)
+        ctx.set_bytes(2 * wave_bytes)
+        op = make_op()
+        op.set_build(wave_build)
+
+        def probe_feed():
+            for hb in probe_host:
+                yield pf(jax.device_put(hb), w)
+
+        yield from op.process(probe_feed())
+    ctx.close()
 
 
 def specs_args(specs: list) -> list:

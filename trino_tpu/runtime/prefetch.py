@@ -1,0 +1,53 @@
+"""Background prefetch for the scan feed.
+
+Reference role: execution/executor/TaskExecutor.java's overlap of IO-bound
+split reads with compute — here a feed thread runs host-side page decode,
+padding, and `jax.device_put` of batch k+1 while the main thread's XLA step
+for batch k executes (device dispatch is async, so the two genuinely
+overlap).  SURVEY.md §7's feed/step/drain pipeline.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+_SENTINEL = object()
+
+
+def prefetch_iter(source: Iterable, depth: int = 2) -> Iterator:
+    """Iterate `source` in a daemon thread, keeping up to `depth` results
+    ready.  Exceptions in the producer re-raise at the consuming point."""
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def run():
+        try:
+            for item in source:
+                if stop.is_set():
+                    return
+                q.put(item)
+        except BaseException as e:  # propagate to consumer
+            q.put((_SENTINEL, e))
+            return
+        q.put(_SENTINEL)
+
+    t = threading.Thread(target=run, daemon=True, name="scan-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is _SENTINEL:
+                raise item[1]
+            yield item
+    finally:
+        stop.set()
+        # drain so a blocked producer can observe `stop` and exit
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass

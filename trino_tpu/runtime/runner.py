@@ -122,7 +122,10 @@ class LocalQueryRunner:
     def _run_query(self, query: ast.Query, stats=None) -> MaterializedResult:
         plan = self.plan_query(query)
         physical = LocalExecutionPlanner(
-            self.catalogs, target_splits=self.target_splits, stats=stats
+            self.catalogs,
+            target_splits=self.target_splits,
+            stats=stats,
+            properties=self.properties,
         ).plan(plan)
         rows = []
         for batch in physical.stream:

@@ -52,6 +52,18 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
         PropertyMetadata(
             "retry_policy", "NONE | QUERY (transparent re-execution)", str, "NONE"
         ),
+        PropertyMetadata(
+            "scan_cache",
+            "serve immutable splits from the host/device buffer pool",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
+            "scan_prefetch_depth",
+            "scan batches decoded+transferred ahead of compute (0 = off)",
+            int,
+            2,
+        ),
     ]
 }
 

@@ -288,18 +288,30 @@ class Parser:
         return ast.SortItem(e, ascending, nulls_first)
 
     def _query_body(self) -> ast.Node:
-        left = self._query_term()
+        # INTERSECT binds tighter than UNION/EXCEPT (SqlBase.g4:244-245)
+        left = self._intersect_term()
         while True:
             t = self.peek()
-            if t.is_kw("union", "intersect", "except"):
+            if t.is_kw("union", "except"):
                 self.next()
                 all_ = self.accept_kw("all") is not None
                 if not all_:
                     self.accept_kw("distinct")
-                right = self._query_term()
+                right = self._intersect_term()
                 left = ast.SetOp(t.value, left, right, all_)
             else:
                 return left
+
+    def _intersect_term(self) -> ast.Node:
+        left = self._query_term()
+        while self.peek().is_kw("intersect"):
+            self.next()
+            all_ = self.accept_kw("all") is not None
+            if not all_:
+                self.accept_kw("distinct")
+            right = self._query_term()
+            left = ast.SetOp("intersect", left, right, all_)
+        return left
 
     def _query_term(self) -> ast.Node:
         t = self.peek()
