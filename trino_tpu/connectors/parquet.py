@@ -1,0 +1,318 @@
+"""Parquet connector: columnar files -> device batches.
+
+Reference roles: lib/trino-parquet (vectorized ParquetReader) +
+plugin/trino-hive/.../parquet/ParquetPageSourceFactory.java:106 + the
+filesystem SPI (lib/trino-filesystem).  The host-side decode is pyarrow's
+vectorized reader; pages are row-group slices projected to the requested
+columns and converted to the engine's columnar form (numerics as numpy,
+strings dictionary-encoded, short decimals as scaled int64, dates as day
+numbers) — which then ride the same buffer-pool/prefetch feed as generated
+tables (BASELINE config #5's PageSource -> scan path).
+
+Layout: root_dir/<schema>/<table>.parquet or root_dir/<schema>/<table>/
+(directory of part files).  Files are immutable while registered: the scan
+version is the (path, mtime, size) set, so the device buffer pool may cache
+row groups.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.columnar import StringDictionary
+from trino_tpu.connectors.api import (
+    ColumnData,
+    ColumnMeta,
+    Connector,
+    ConnectorMetadata,
+    PageSource,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+
+
+def _arrow_to_type(at) -> T.Type:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(at):
+        return T.BOOLEAN
+    if pa.types.is_int8(at) or pa.types.is_int16(at):
+        return T.SMALLINT
+    if pa.types.is_int32(at):
+        return T.INTEGER
+    if pa.types.is_int64(at):
+        return T.BIGINT
+    if pa.types.is_float32(at):
+        return T.REAL
+    if pa.types.is_float64(at):
+        return T.DOUBLE
+    if pa.types.is_decimal(at):
+        if at.precision > 18:
+            raise NotImplementedError(
+                f"decimal({at.precision},{at.scale}) exceeds the engine's "
+                "short-decimal (i64) range"
+            )
+        return T.DecimalType(at.precision, at.scale)
+    if pa.types.is_date(at):
+        return T.DATE
+    if pa.types.is_timestamp(at):
+        return T.TIMESTAMP
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return T.VARCHAR
+    if pa.types.is_dictionary(at):
+        return _arrow_to_type(at.value_type)
+    raise NotImplementedError(f"parquet/arrow type {at}")
+
+
+def _array_to_column_data(arr, t: T.Type) -> ColumnData:
+    """One arrow chunk -> engine host column."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    valid = None
+    if arr.null_count:
+        valid = np.asarray(pc.is_valid(arr))
+    if T.is_string_kind(t):
+        # dictionary-encode on host: device kernels operate on rank codes
+        dict_arr = arr.dictionary_encode() if not pa.types.is_dictionary(arr.type) else arr
+        values = [
+            "" if v is None else str(v) for v in dict_arr.dictionary.to_pylist()
+        ]
+        d = StringDictionary.from_unsorted(values)
+        remap = np.fromiter(
+            (d.index[v] for v in values), dtype=np.int32, count=len(values)
+        )
+        codes = np.asarray(dict_arr.indices.fill_null(0))
+        return ColumnData(remap[codes.astype(np.int64)], valid, d)
+    if isinstance(t, T.DecimalType):
+        # arrow decimal -> unscaled int64 (the engine's cents representation)
+        if t.precision <= 15:
+            # scaled values stay within float64's exact-integer range
+            ints = pc.multiply(
+                pc.cast(arr.fill_null(0), pa.float64()), 10.0 ** t.scale
+            )
+            data = np.rint(np.asarray(ints)).astype(np.int64)
+        else:
+            # exact path: Decimal objects -> unscaled ints (float64 would
+            # corrupt >15-digit values)
+            data = np.fromiter(
+                (
+                    0 if d is None else int(d.scaleb(t.scale))
+                    for d in arr.to_pylist()
+                ),
+                dtype=np.int64,
+                count=len(arr),
+            )
+        return ColumnData(data, valid)
+    if t is T.DATE:
+        data = np.asarray(arr.fill_null(0).cast(pa.int32()))
+        return ColumnData(data.astype(np.int32), valid)
+    if t is T.TIMESTAMP:
+        us = arr.fill_null(0).cast(pa.timestamp("us")).cast(pa.int64())
+        return ColumnData(np.asarray(us), valid)
+    data = np.asarray(arr.fill_null(0))
+    return ColumnData(np.ascontiguousarray(data), valid)
+
+
+class _ParquetMetadata(ConnectorMetadata):
+    def __init__(self, conn: "ParquetConnector"):
+        self.conn = conn
+
+    def list_schemas(self) -> Sequence[str]:
+        root = self.conn.root
+        return sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+
+    def list_tables(self, schema: str) -> Sequence[str]:
+        out = []
+        base = os.path.join(self.conn.root, schema)
+        if not os.path.isdir(base):
+            return []
+        for name in os.listdir(base):
+            p = os.path.join(base, name)
+            if name.endswith(".parquet") and os.path.isfile(p):
+                out.append(name[: -len(".parquet")])
+            elif os.path.isdir(p):
+                out.append(name)
+        return sorted(out)
+
+    def table_metadata(self, schema: str, table: str) -> TableMetadata:
+        import pyarrow.parquet as pq
+
+        files = self.conn._files(schema, table)
+        if not files:
+            raise KeyError(f"parquet table not found: {schema}.{table}")
+        arrow_schema = pq.read_schema(files[0])
+        cols = tuple(
+            ColumnMeta(f.name, _arrow_to_type(f.type)) for f in arrow_schema
+        )
+        return TableMetadata(schema, table, cols)
+
+    def table_statistics(self, schema: str, table: str) -> TableStatistics:
+        import pyarrow.parquet as pq
+
+        rows = 0
+        for f in self.conn._files(schema, table):
+            rows += pq.ParquetFile(f).metadata.num_rows
+        return TableStatistics(row_count=rows)
+
+
+class _ParquetPageSource(PageSource):
+    def __init__(self, split: Split, columns, types, page_rows: int):
+        self.split = split
+        self.columns = list(columns)
+        self.types = list(types)
+        self.page_rows = page_rows
+
+    def row_count(self) -> int:
+        return self.split.row_count
+
+    def pages(self):
+        import pyarrow.parquet as pq
+
+        path, row_group = self.split.info
+        pf = pq.ParquetFile(path)
+        tbl = pf.read_row_group(row_group, columns=self.columns)
+        n = tbl.num_rows
+        for start in range(0, max(n, 1), self.page_rows):
+            chunk = tbl.slice(start, self.page_rows)
+            if chunk.num_rows == 0 and start > 0:
+                break
+            yield [
+                _array_to_column_data(chunk.column(i), t)
+                for i, t in enumerate(self.types)
+            ]
+
+
+class ParquetConnector(Connector):
+    """reference roles: plugin/trino-hive's parquet read path, minus the
+    metastore — tables are files under a root directory."""
+
+    name = "parquet"
+
+    def __init__(self, root: str):
+        self.root = root
+        self._metadata = _ParquetMetadata(self)
+
+    def metadata(self) -> _ParquetMetadata:
+        return self._metadata
+
+    def _files(self, schema: str, table: str) -> list:
+        base = os.path.join(self.root, schema)
+        single = os.path.join(base, table + ".parquet")
+        if os.path.isfile(single):
+            return [single]
+        d = os.path.join(base, table)
+        if os.path.isdir(d):
+            return sorted(
+                os.path.join(d, f)
+                for f in os.listdir(d)
+                if f.endswith(".parquet")
+            )
+        return []
+
+    def scan_version(self, handle: TableHandle):
+        files = self._files(handle.schema, handle.table)
+        try:
+            return tuple(
+                (f, int(os.path.getmtime(f)), os.path.getsize(f))
+                for f in files
+            )
+        except OSError:
+            return None
+
+    def splits(self, handle: TableHandle, target_splits: int, predicate=None):
+        """One split per row group (the reference's parquet split unit)."""
+        import pyarrow.parquet as pq
+
+        out = []
+        seq = 0
+        row_start = 0
+        for path in self._files(handle.schema, handle.table):
+            meta = pq.ParquetFile(path).metadata
+            for rg in range(meta.num_row_groups):
+                nrows = meta.row_group(rg).num_rows
+                out.append(
+                    Split(
+                        handle,
+                        seq,
+                        row_start=row_start,
+                        row_count=nrows,
+                        info=(path, rg),
+                    )
+                )
+                seq += 1
+                row_start += nrows
+        return out
+
+    def page_source(
+        self, split: Split, columns: Sequence[str], max_rows_per_page: int = 1 << 20
+    ) -> PageSource:
+        meta = self._metadata.table_metadata(
+            split.table.schema, split.table.table
+        )
+        tmap = {c.name: c.type for c in meta.columns}
+        types = [tmap[c] for c in columns]
+        return _ParquetPageSource(split, columns, types, max_rows_per_page)
+
+
+def write_table_to_parquet(
+    connector: Connector,
+    schema: str,
+    table: str,
+    out_dir: str,
+    row_group_rows: int = 1 << 20,
+) -> str:
+    """Export any connector table to a parquet file (test/bench fixture
+    helper; reference role: the writers in lib/trino-parquet)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from trino_tpu.connectors.api import TableHandle
+
+    meta = connector.metadata().table_metadata(schema, table)
+    handle = TableHandle("src", schema, table)
+    names = [c.name for c in meta.columns]
+    arrays: dict = {n: [] for n in names}
+    for split in connector.splits(handle, target_splits=1):
+        src = connector.page_source(split, names, max_rows_per_page=row_group_rows)
+        for page in src.pages():
+            for n, cd, cm in zip(names, page, meta.columns):
+                arrays[n].append(_column_data_to_arrow(cd, cm.type))
+    cols = [pa.concat_arrays(arrays[n]) for n in names]
+    tbl = pa.table(dict(zip(names, cols)))
+    os.makedirs(os.path.join(out_dir, schema), exist_ok=True)
+    path = os.path.join(out_dir, schema, table + ".parquet")
+    pq.write_table(tbl, path, row_group_size=row_group_rows)
+    return path
+
+
+def _column_data_to_arrow(cd: ColumnData, t: T.Type):
+    import pyarrow as pa
+
+    vals = np.asarray(cd.values)
+    mask = None if cd.valid is None else ~np.asarray(cd.valid)
+    if cd.dictionary is not None:
+        strings = np.asarray(cd.dictionary.values, dtype=object)[
+            vals.astype(np.int64)
+        ]
+        return pa.array(strings.tolist(), type=pa.string(), mask=mask)
+    if isinstance(t, T.DecimalType):
+        import decimal
+
+        q = decimal.Decimal(1).scaleb(-t.scale)
+        dec = [decimal.Decimal(int(v)).scaleb(-t.scale) for v in vals]
+        return pa.array(dec, type=pa.decimal128(t.precision, t.scale), mask=mask)
+    if t is T.DATE:
+        return pa.array(vals.astype(np.int32), type=pa.date32(), mask=mask)
+    return pa.array(vals, mask=mask)

@@ -89,8 +89,16 @@ class DistributedQueryRunner(LocalQueryRunner):
         n_workers: Optional[int] = None,
         devices=None,
     ):
+        from trino_tpu.runtime.fte import HeartbeatFailureDetector
+
         super().__init__(catalogs, catalog=catalog, schema=schema)
         self.wm = WorkerMesh(devices, n_workers)
+        #: coordinator-side worker liveness (HeartbeatFailureDetector.java:78);
+        #: in-process mesh workers share our liveness, so they are refreshed
+        #: at query start — server-mode remote workers heartbeat over HTTP
+        self.failure_detector = HeartbeatFailureDetector()
+        for i in range(self.wm.n):
+            self.failure_detector.register(f"worker-{i}")
 
     # -- planning -------------------------------------------------------------
 
@@ -111,9 +119,20 @@ class DistributedQueryRunner(LocalQueryRunner):
             # EXPLAIN ANALYZE instrumentation hooks the local operator
             # streams; run it through the local engine
             return super()._run_query(query, stats=stats)
+        # in-process mesh workers share this process's liveness: refresh them
+        # BEFORE the dead check, so only genuinely remote/stale registrations
+        # (server-mode workers) can fail it
+        for i in range(self.wm.n):
+            self.failure_detector.heartbeat(f"worker-{i}")
+        dead = self.failure_detector.failed_workers()
+        if dead:
+            raise RuntimeError(f"workers failed heartbeat: {sorted(dead)}")
         plan = self.plan_query(query)
         sub = self.create_subplan(plan)
-        executor = StageExecutor(self.catalogs, self.wm, self.properties)
+        executor = StageExecutor(
+            self.catalogs, self.wm, self.properties,
+            query_id=getattr(self, "_current_qid", "q"),
+        )
         host = executor.run(sub)
         rows = []
         for batch in host.stream:
@@ -128,23 +147,40 @@ class StageExecutor:
     SqlStage inside PipelinedQueryScheduler, with collectives as the data
     plane instead of HTTP output buffers)."""
 
-    def __init__(self, catalogs, wm: WorkerMesh, properties):
+    #: attempts per stage under retry_policy=TASK (reference:
+    #: EventDrivenFaultTolerantQueryScheduler task retry budget)
+    TASK_ATTEMPTS = 4
+
+    def __init__(self, catalogs, wm: WorkerMesh, properties, query_id: str = "q"):
         self.catalogs = catalogs
         self.wm = wm
         self.properties = properties
+        self.query_id = query_id
         self._subplans: dict[int, SubPlan] = {}
         self._results: dict[int, object] = {}
+        self.retry_task = properties.get("retry_policy") == "TASK"
+        self.spool = None
+        self._spool_meta: dict[int, tuple] = {}
+        if self.retry_task:
+            from trino_tpu.runtime.fte import SpoolManager
+
+            self.spool = SpoolManager()
 
     # -- public ---------------------------------------------------------------
 
     def run(self, sub: SubPlan) -> PhysicalPlan:
-        self._register(sub)
-        out = self._fragment_result(sub.fragment.id)
-        if isinstance(out, _Dist):  # defensive: root should be SINGLE
-            return PhysicalPlan(
-                iter([unstack_batch(jax.device_get(out.stacked))]), out.symbols
-            )
-        return out
+        try:
+            self._register(sub)
+            out = self._fragment_result(sub.fragment.id)
+            if isinstance(out, _Dist):  # defensive: root should be SINGLE
+                return PhysicalPlan(
+                    iter([unstack_batch(jax.device_get(out.stacked))]),
+                    out.symbols,
+                )
+            return out
+        finally:
+            if self.spool is not None:
+                self.spool.close()
 
     # -- stage orchestration --------------------------------------------------
 
@@ -155,19 +191,83 @@ class StageExecutor:
 
     def _fragment_result(self, fid: int):
         """Stage output: a _Dist, or ('host', batches, symbols) for SINGLE
-        fragments (materialized so multiple consumers can re-read)."""
+        fragments (materialized so multiple consumers can re-read).  Under
+        retry_policy=TASK each stage is a retryable unit: its output is
+        spooled host-side, a failed stage re-executes alone, and finished
+        children are never re-run (the Tardigrade property)."""
         if fid not in self._results:
-            sub = self._subplans[fid]
-            if sub.fragment.partitioning.kind in _DIST_KINDS:
-                res = self._exec(sub.fragment.root)
+            res = self._run_stage(fid)
+            if isinstance(res, _Dist) and self.spool is not None:
+                # under TASK retry the spool IS the stage-output store (the
+                # spooled-exchange property: outputs live host-side, device
+                # memory is released, consumers rehydrate on demand)
+                self._results[fid] = ("spooled",)
             else:
-                out = self._local_fragment(sub)
-                res = ("host", list(out.stream), out.symbols)
-            self._results[fid] = res
+                self._results[fid] = res
         res = self._results[fid]
+        if res == ("spooled",):
+            return self._load_spooled(fid)
         if isinstance(res, tuple):
             return PhysicalPlan(iter(res[1]), res[2])
         return res
+
+    def _run_stage(self, fid: int):
+        from trino_tpu.runtime.retry import (
+            FAILURE_INJECTOR,
+            RETRYABLE,
+            StageFailedException,
+        )
+
+        sub = self._subplans[fid]
+        attempts = self.TASK_ATTEMPTS if self.retry_task else 1
+        last = None
+        for _ in range(attempts):
+            try:
+                FAILURE_INJECTOR.maybe_fail(f"stage:{fid}")
+                if sub.fragment.partitioning.kind in _DIST_KINDS:
+                    res = self._exec(sub.fragment.root)
+                else:
+                    out = self._local_fragment(sub)
+                    res = ("host", list(out.stream), out.symbols)
+                # fires after the body ran (children memoized/spooled): a
+                # failure here retries ONLY this stage
+                FAILURE_INJECTOR.maybe_fail(f"stage:{fid}:finish")
+                self._spool(fid, res)
+                return res
+            except RETRYABLE as e:
+                last = e
+        if not self.retry_task:
+            raise last  # keep the original (QUERY-level-retryable) error
+        raise StageFailedException(
+            f"stage {fid} failed after {attempts} attempts: {last}"
+        ) from last
+
+    # -- spooled stage outputs (ExchangeManager role) -------------------------
+
+    def _spool(self, fid: int, res) -> None:
+        """Persist a distributed stage's output host-side.  Only _Dist
+        results spool: a stacked batch shares one dictionary per column
+        across workers, so rehydration is exact; SINGLE-fragment host
+        results already live host-side and stay in the memo."""
+        if self.spool is None or not isinstance(res, _Dist):
+            return
+        host = jax.device_get(res.stacked)
+        # full-capacity per-worker shards, masks included (the spooled
+        # page files of FileSystemExchangeSink)
+        shards = [
+            jax.tree.map(lambda x, w=w: np.asarray(x)[w], host)
+            for w in range(self.wm.n)
+        ]
+        dicts = (
+            [c.dictionary for c in shards[0].columns] if shards else []
+        )
+        self.spool.save(self.query_id, fid, shards, res.symbols)
+        self._spool_meta[fid] = (res.symbols, dicts)
+
+    def _load_spooled(self, fid: int) -> "_Dist":
+        symbols, dicts = self._spool_meta[fid]
+        shards = self.spool.load(self.query_id, fid, symbols, dicts)
+        return _Dist(stack_batches(shards, self.wm), symbols)
 
     def _local_fragment(self, sub: SubPlan) -> PhysicalPlan:
         """SINGLE/COORDINATOR_ONLY fragment: run the local engine over

@@ -1,0 +1,122 @@
+"""Fault-tolerant execution: spooled stage outputs, task retry, heartbeats.
+
+Reference: execution/scheduler/EventDrivenFaultTolerantQueryScheduler.java
+(stage-by-stage execution with replayable intermediates),
+core/trino-spi/.../spi/exchange/ExchangeManager.java:42 +
+plugin/trino-exchange-filesystem (spooled exchange storage),
+failuredetector/HeartbeatFailureDetector.java:78.
+
+TPU mapping: a "task" is one fragment execution over the mesh; its output
+(a stacked device batch or host batches) is the replayable unit.  The spool
+persists fragment outputs host-side (npz files), so a failed downstream
+fragment retries WITHOUT re-running its finished children — the
+EventDriven scheduler's core property.  The heartbeat detector watches
+worker liveness the coordinator-side way; with in-process mesh workers it
+guards the host feeder threads and remote (server-mode) workers.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class SpoolManager:
+    """Persist per-fragment outputs to local files (reference role:
+    FileSystemExchangeManager / LocalFileSystemExchangeStorage)."""
+
+    def __init__(self, directory: Optional[str] = None):
+        self._own = directory is None
+        self.dir = directory or tempfile.mkdtemp(prefix="trino_tpu_spool_")
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _path(self, query_id: str, fragment_id: int) -> str:
+        return os.path.join(self.dir, f"{query_id}_f{fragment_id}.npz")
+
+    def save(self, query_id: str, fragment_id: int, batches, symbols) -> str:
+        """Spool host batches (list of Batch) for one fragment."""
+        arrays: dict = {"__nbatches__": np.asarray(len(batches))}
+        for bi, b in enumerate(batches):
+            arrays[f"b{bi}_mask"] = np.asarray(b.mask())
+            for ci, c in enumerate(b.columns):
+                arrays[f"b{bi}_c{ci}_data"] = np.asarray(c.data)
+                if c.valid is not None:
+                    arrays[f"b{bi}_c{ci}_valid"] = np.asarray(c.valid)
+        path = self._path(query_id, fragment_id)
+        with open(path, "wb") as f:
+            np.savez(f, **arrays)
+        return path
+
+    def load(self, query_id: str, fragment_id: int, symbols, dictionaries):
+        """Rehydrate spooled batches (schema from the fragment's symbols)."""
+        from trino_tpu.columnar import Batch, Column
+
+        path = self._path(query_id, fragment_id)
+        if not os.path.exists(path):
+            return None
+        z = np.load(path, allow_pickle=False)
+        out = []
+        for bi in range(int(z["__nbatches__"])):
+            cols = []
+            for ci, sym in enumerate(symbols):
+                data = z[f"b{bi}_c{ci}_data"]
+                valid = z.get(f"b{bi}_c{ci}_valid")
+                cols.append(
+                    Column(data, sym.type, valid, dictionaries[ci])
+                )
+            out.append(Batch(cols, z[f"b{bi}_mask"]))
+        return out
+
+    def exists(self, query_id: str, fragment_id: int) -> bool:
+        return os.path.exists(self._path(query_id, fragment_id))
+
+    def close(self) -> None:
+        """Remove spooled intermediates (query finished); only directories
+        this manager created are deleted."""
+        if self._own:
+            import shutil
+
+            shutil.rmtree(self.dir, ignore_errors=True)
+
+
+class HeartbeatFailureDetector:
+    """Coordinator-side liveness tracking (reference:
+    failuredetector/HeartbeatFailureDetector.java:78, ping():350): workers
+    heartbeat; ones silent past the threshold are marked failed and excluded
+    from scheduling."""
+
+    def __init__(self, timeout_s: float = 10.0, clock: Callable[[], float] = time.monotonic):
+        self.timeout_s = timeout_s
+        self.clock = clock
+        self._last: dict[str, float] = {}
+        self._failed: set[str] = set()
+
+    def register(self, worker: str) -> None:
+        self._last[worker] = self.clock()
+        self._failed.discard(worker)
+
+    def heartbeat(self, worker: str) -> None:
+        self._last[worker] = self.clock()
+        self._failed.discard(worker)
+
+    def refresh(self) -> None:
+        now = self.clock()
+        for w, t in self._last.items():
+            if now - t > self.timeout_s:
+                self._failed.add(w)
+
+    def failed_workers(self) -> set:
+        self.refresh()
+        return set(self._failed)
+
+    def active_workers(self) -> list:
+        self.refresh()
+        return sorted(w for w in self._last if w not in self._failed)
+
+    def is_alive(self, worker: str) -> bool:
+        self.refresh()
+        return worker in self._last and worker not in self._failed

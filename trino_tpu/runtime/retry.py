@@ -18,6 +18,13 @@ class InjectedFailure(RuntimeError):
     """Retryable injected fault (reference: TASK_FAILURE injection type)."""
 
 
+class StageFailedException(RuntimeError):
+    """A stage exhausted its task-retry budget.  Deliberately NOT retryable:
+    consuming stages must propagate it instead of burning their own budgets
+    (task budgets are per-task, not multiplicative — the reference fails the
+    query when any task exceeds task_retry_attempts_per_task)."""
+
+
 @dataclass
 class _Injection:
     match: str  # substring of the injection point name
@@ -30,11 +37,15 @@ class FailureInjector:
 
     def __init__(self):
         self._injections: list[_Injection] = []
+        #: visit counter per injection point (lets fault-tolerance tests
+        #: assert which stages re-ran and which were served from the spool)
+        self.visits: dict[str, int] = {}
 
     def inject(self, match: str, times: int = 1, error: type = InjectedFailure):
         self._injections.append(_Injection(match, error, times))
 
     def maybe_fail(self, point: str) -> None:
+        self.visits[point] = self.visits.get(point, 0) + 1
         for inj in self._injections:
             if inj.remaining > 0 and inj.match in point:
                 inj.remaining -= 1
@@ -42,6 +53,7 @@ class FailureInjector:
 
     def clear(self) -> None:
         self._injections.clear()
+        self.visits.clear()
 
 
 #: process-wide injector consulted by execution hooks (tests arm it)
@@ -52,10 +64,16 @@ RETRYABLE = (InjectedFailure, ConnectionError, TimeoutError)
 
 def execute_with_retry(fn, retry_policy: str = "NONE", max_attempts: int = 4):
     """Run fn() under the given retry policy (reference:
-    SqlQueryExecution's retry handling for retry_policy=QUERY)."""
+    SqlQueryExecution's retry handling for retry_policy=QUERY).  TASK-level
+    retry happens inside the stage executor (parallel/runner.py); at this
+    outer level it degrades to a final QUERY-style safety net."""
     if retry_policy == "NONE":
         return fn()
-    assert retry_policy == "QUERY", retry_policy
+    assert retry_policy in ("QUERY", "TASK"), retry_policy
+    if retry_policy == "TASK":
+        # stage-level retry happens inside the stage executor; no outer
+        # whole-query retries on top (reference: RetryPolicy.TASK)
+        return fn()
     last: Optional[BaseException] = None
     for _ in range(max_attempts):
         try:

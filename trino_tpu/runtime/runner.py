@@ -99,6 +99,7 @@ class LocalQueryRunner:
         if m is None:
             raise NotImplementedError(f"statement: {type(stmt).__name__}")
         qid = f"query_{next(self._query_ids)}"
+        self._current_qid = qid  # correlates events with executor/spool ids
         t0 = _time.time()
         self.events.query_created(QueryCreatedEvent(qid, sql, t0))
         try:

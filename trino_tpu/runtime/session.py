@@ -50,7 +50,11 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             0,
         ),
         PropertyMetadata(
-            "retry_policy", "NONE | QUERY (transparent re-execution)", str, "NONE"
+            "retry_policy",
+            "NONE | QUERY (re-execute the query) | TASK (per-stage retry "
+            "with spooled intermediates)",
+            str,
+            "NONE",
         ),
         PropertyMetadata(
             "scan_cache",
