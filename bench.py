@@ -139,6 +139,10 @@ def _run(args) -> dict:
         except Exception as exc:
             walls[q] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
 
+    extras: dict = {}
+    if not args.query_only:
+        extras.update(_extra_configs(args))
+
     head = walls[headline]
     wall = head.get("warm_s")
     if wall is None:
@@ -174,9 +178,54 @@ def _run(args) -> dict:
             }
             for q, w in walls.items()
         },
+        "extras": extras,
         "pool": POOL.stats(),
         "device": str(jax.devices()[0].platform),
     }
+
+
+def _extra_configs(args) -> dict:
+    """BASELINE configs beyond TPC-H: TPC-DS Q64 (config #4) and the
+    parquet scan path (config #5's PageSource -> scan shape)."""
+    out: dict = {}
+    try:
+        from trino_tpu.connectors.tpcds.queries import QUERIES as DS
+        from trino_tpu.runtime.runner import LocalQueryRunner
+
+        ds = LocalQueryRunner(catalog="tpcds", schema="tiny", target_splits=8)
+        w = _engine_time(ds, DS[64], max(1, args.runs))
+        out["tpcds_tiny_q64"] = {k: round(v, 4) for k, v in w.items()}
+    except Exception as exc:
+        out["tpcds_tiny_q64"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    try:
+        import tempfile
+
+        from trino_tpu.connectors.api import CatalogManager
+        from trino_tpu.connectors.parquet import (
+            ParquetConnector,
+            write_table_to_parquet,
+        )
+        from trino_tpu.connectors.tpch import TpchConnector
+        from trino_tpu.connectors.tpch.queries import QUERIES as H
+        from trino_tpu.runtime.runner import LocalQueryRunner
+
+        root = tempfile.mkdtemp(prefix="bench_pq_")
+        try:
+            tpch = TpchConnector()
+            for t in ("lineitem",):
+                write_table_to_parquet(tpch, "tiny", t, root)
+            cm = CatalogManager()
+            cm.register("pq", ParquetConnector(root))
+            pq = LocalQueryRunner(cm, catalog="pq", schema="tiny", target_splits=8)
+            w = _engine_time(pq, H[6], max(1, args.runs))
+            out["parquet_tiny_q6"] = {k: round(v, 4) for k, v in w.items()}
+        finally:
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)
+    except Exception as exc:
+        out["parquet_tiny_q6"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    return out
 
 
 def _schema_for_sf(sf: float) -> str:

@@ -1,0 +1,208 @@
+"""DB-API driver, transactions, resource groups (reference roles:
+client/trino-jdbc, transaction/InMemoryTransactionManager.java,
+execution/resourcegroups/InternalResourceGroup.java)."""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+# -- DB-API (the JDBC-driver role) ---------------------------------------------
+
+
+def test_dbapi_embedded_roundtrip():
+    from trino_tpu import dbapi
+
+    conn = dbapi.connect(runner=LocalQueryRunner())
+    cur = conn.cursor()
+    cur.execute("select n_name, n_nationkey from nation order by n_nationkey limit 3")
+    assert cur.rowcount == 3
+    assert [d[0] for d in cur.description] == ["n_name", "n_nationkey"]
+    assert cur.fetchone() == ("ALGERIA", 0)
+    rest = cur.fetchall()
+    assert len(rest) == 2
+    assert cur.fetchone() is None
+
+
+def test_dbapi_parameters():
+    from trino_tpu import dbapi
+
+    conn = dbapi.connect(runner=LocalQueryRunner())
+    cur = conn.cursor()
+    cur.execute(
+        "select count(*) from nation where n_regionkey = ? and n_name like ?",
+        (2, "J%"),
+    )
+    assert cur.fetchone() == (1,)  # JAPAN
+
+
+def test_dbapi_string_escaping():
+    from trino_tpu import dbapi
+
+    conn = dbapi.connect(runner=LocalQueryRunner())
+    cur = conn.cursor()
+    cur.execute("select ?", ("it''s",))
+    # round-trips without breaking the literal
+    assert "it" in cur.fetchone()[0]
+
+
+def test_dbapi_over_http():
+    from trino_tpu import dbapi
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    srv = CoordinatorServer(port=0)
+    srv.start()
+    try:
+        conn = dbapi.connect(f"http://127.0.0.1:{srv.port}")
+        cur = conn.cursor()
+        cur.execute("select 1 + 1")
+        assert cur.fetchall() == [(2,)]
+    finally:
+        srv.shutdown()
+
+
+def test_dbapi_error_maps_to_database_error():
+    from trino_tpu import dbapi
+
+    conn = dbapi.connect(runner=LocalQueryRunner())
+    with pytest.raises(dbapi.DatabaseError):
+        conn.cursor().execute("select no_such_column from nation")
+
+
+# -- transactions ---------------------------------------------------------------
+
+
+def _mem_runner():
+    return LocalQueryRunner(catalog="memory", schema="default")
+
+
+def test_rollback_restores_table():
+    r = _mem_runner()
+    r.execute("create table t (x bigint)")
+    r.execute("insert into t select 1")
+    r.execute("start transaction")
+    r.execute("insert into t select 2")
+    assert r.execute("select count(*) from t").only_value() == 2
+    r.execute("rollback")
+    assert r.execute("select count(*) from t").only_value() == 1
+
+
+def test_commit_keeps_changes():
+    r = _mem_runner()
+    r.execute("create table t2 (x bigint)")
+    r.execute("start transaction")
+    r.execute("insert into t2 select 7")
+    r.execute("commit")
+    assert r.execute("select count(*) from t2").only_value() == 1
+
+
+def test_rollback_restores_dropped_table():
+    r = _mem_runner()
+    r.execute("create table t3 (x bigint)")
+    r.execute("start transaction")
+    r.execute("drop table t3")
+    r.execute("rollback")
+    assert r.execute("select count(*) from t3").only_value() == 0  # exists
+
+
+def test_nested_begin_rejected():
+    r = _mem_runner()
+    r.execute("start transaction")
+    with pytest.raises(Exception):
+        r.execute("start transaction")
+    r.execute("rollback")
+
+
+def test_commit_without_begin_rejected():
+    r = _mem_runner()
+    with pytest.raises(Exception):
+        r.execute("commit")
+
+
+# -- resource groups -------------------------------------------------------------
+
+
+def test_admission_concurrency_and_queue():
+    from trino_tpu.runtime.resource_groups import (
+        ResourceGroup,
+        ResourceGroupConfig,
+    )
+
+    g = ResourceGroup(ResourceGroupConfig("g", hard_concurrency=2, max_queued=1))
+    g.acquire()
+    g.acquire()
+    assert g.stats()["running"] == 2
+    # third query queues; it is admitted when a running one releases
+    admitted = threading.Event()
+
+    def queued():
+        g.acquire()
+        admitted.set()
+
+    t = threading.Thread(target=queued, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not admitted.is_set()
+    assert g.stats()["queued"] == 1
+    g.release()
+    assert admitted.wait(timeout=2.0)
+    g.release()
+    g.release()
+
+
+def test_queue_full_rejects():
+    from trino_tpu.runtime.resource_groups import (
+        QueryQueueFullError,
+        ResourceGroup,
+        ResourceGroupConfig,
+    )
+
+    g = ResourceGroup(ResourceGroupConfig("g", hard_concurrency=1, max_queued=0))
+    g.acquire()
+    with pytest.raises(QueryQueueFullError):
+        g.acquire()
+    g.release()
+
+
+def test_user_selector():
+    from trino_tpu.runtime.resource_groups import (
+        ResourceGroupConfig,
+        ResourceGroupManager,
+    )
+
+    m = ResourceGroupManager()
+    m.add(ResourceGroupConfig("etl", hard_concurrency=4))
+    m.add_user_rule("batch", "etl")
+    assert m.select("batch").config.name == "etl"
+    assert m.select("adhoc").config.name == "global"
+
+
+def test_server_rejects_when_queue_full():
+    from trino_tpu.client import Client
+    from trino_tpu.runtime.resource_groups import (
+        ResourceGroupConfig,
+        ResourceGroupManager,
+    )
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    rg = ResourceGroupManager(
+        ResourceGroupConfig("global", hard_concurrency=1, max_queued=0)
+    )
+    srv = CoordinatorServer(port=0, resource_groups=rg)
+    srv.start()
+    try:
+        # hold the only slot
+        rg.default.acquire()
+        q = srv.submit("select 1")
+        q.done.wait(timeout=5)
+        assert q.state == "FAILED" and q.error["errorName"] == "QUERY_QUEUE_FULL"
+        rg.default.release()
+        # slot free again: queries run
+        q2 = srv.submit("select 1")
+        q2.done.wait(timeout=30)
+        assert q2.state == "FINISHED"
+    finally:
+        srv.shutdown()

@@ -1,0 +1,58 @@
+"""system.runtime observability tables (reference: connector/system/
+QuerySystemTable.java + NodeSystemTable + system.runtime schema)."""
+
+import pytest
+
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(catalog="tpch", schema="tiny")
+
+
+def test_query_history(runner):
+    runner.execute("select count(*) from nation")
+    rows = runner.execute(
+        "select query_id, state, rows from system.runtime.queries "
+        "where state = 'FINISHED'"
+    ).rows
+    assert rows, "query history must record finished queries"
+    assert any(r[2] == 1 for r in rows)
+
+
+def test_failed_query_recorded(runner):
+    try:
+        runner.execute("select no_such from nation")
+    except Exception:
+        pass
+    rows = runner.execute(
+        "select state, error from system.runtime.queries where state = 'FAILED'"
+    ).rows
+    assert rows and rows[-1][1] is not None
+
+
+def test_nodes(runner):
+    rows = runner.execute("select node_id, state from system.runtime.nodes").rows
+    assert rows and all(r[1] == "ACTIVE" for r in rows)
+
+
+def test_session_properties_reflect_set_session(runner):
+    runner.execute("set session agg_fold_batches = 3")
+    rows = dict(
+        runner.execute(
+            "select name, value from system.runtime.session_properties"
+        ).rows[:0]
+    )
+    val = runner.execute(
+        "select value from system.runtime.session_properties "
+        "where name = 'agg_fold_batches'"
+    ).only_value()
+    assert val == "3"
+
+
+def test_caches_table(runner):
+    rows = runner.execute(
+        "select tier, bytes from system.runtime.caches order by tier"
+    ).rows
+    assert [r[0] for r in rows] == ["device", "host"]

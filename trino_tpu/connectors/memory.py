@@ -74,6 +74,26 @@ class _MemoryPageSource(PageSource):
         a = self.split.row_start
         b = a + self.split.row_count
         ix = [self.stored.meta.column_index(c) for c in self.columns]
+        if not self.stored.columns:  # created but never written: zero rows
+            from trino_tpu import types as T
+            from trino_tpu.columnar import StringDictionary
+
+            out = []
+            for i in ix:
+                t = self.stored.meta.columns[i].type
+                if T.is_string_kind(t):
+                    # string columns always carry a dictionary, even empty
+                    out.append(
+                        ColumnData(
+                            np.zeros(0, dtype=np.int32),
+                            None,
+                            StringDictionary.from_unsorted([""]),
+                        )
+                    )
+                else:
+                    out.append(ColumnData(np.zeros(0, dtype=t.np_dtype)))
+            yield out
+            return
         yield [
             ColumnData(
                 self.stored.columns[i].values[a:b],
@@ -179,3 +199,18 @@ class MemoryConnector(Connector):
     def page_source(self, split: Split, columns, max_rows_per_page: int = 1 << 20):
         st = self.store[(split.table.schema, split.table.table)]
         return _MemoryPageSource(st, split, list(columns))
+
+    # -- transaction snapshots (InMemoryTransactionManager role) -------------
+
+    def snapshot(self):
+        """Shallow store snapshot: _MemorySink.append replaces column lists
+        (never mutates arrays in place), so copying the table map and each
+        table's column list captures a consistent point-in-time view."""
+        return {
+            key: _Stored(st.meta, list(st.columns))
+            for key, st in self.store.items()
+        }
+
+    def restore(self, snap) -> None:
+        self.store.clear()
+        self.store.update(snap)

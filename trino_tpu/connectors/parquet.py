@@ -86,12 +86,14 @@ def _array_to_column_data(arr, t: T.Type) -> ColumnData:
         values = [
             "" if v is None else str(v) for v in dict_arr.dictionary.to_pylist()
         ]
+        if not values:  # all-null (or empty) column: one placeholder entry
+            values = [""]
         d = StringDictionary.from_unsorted(values)
         remap = np.fromiter(
             (d.index[v] for v in values), dtype=np.int32, count=len(values)
         )
         codes = np.asarray(dict_arr.indices.fill_null(0))
-        return ColumnData(remap[codes.astype(np.int64)], valid, d)
+        return ColumnData(remap[np.clip(codes.astype(np.int64), 0, len(remap) - 1)], valid, d)
     if isinstance(t, T.DecimalType):
         # arrow decimal -> unscaled int64 (the engine's cents representation)
         if t.precision <= 15:
@@ -315,4 +317,8 @@ def _column_data_to_arrow(cd: ColumnData, t: T.Type):
         return pa.array(dec, type=pa.decimal128(t.precision, t.scale), mask=mask)
     if t is T.DATE:
         return pa.array(vals.astype(np.int32), type=pa.date32(), mask=mask)
+    if t is T.TIMESTAMP:
+        return pa.array(
+            vals.astype(np.int64), type=pa.timestamp("us"), mask=mask
+        )
     return pa.array(vals, mask=mask)

@@ -1,0 +1,211 @@
+"""System connector: engine state queryable as SQL tables.
+
+Reference: core/trino-main/.../connector/system/ (QuerySystemTable.java,
+NodeSystemTable, system.runtime schema) — the observability surface that
+makes the engine inspectable from its own SQL prompt.
+
+Tables (schema `runtime`):
+  queries          — query history from the event pipeline
+  nodes            — mesh workers and their liveness
+  session_properties — property values in effect
+  caches           — buffer-pool tiers (bytes, hits, misses)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.columnar import StringDictionary
+from trino_tpu.connectors.api import (
+    ColumnData,
+    ColumnMeta,
+    Connector,
+    ConnectorMetadata,
+    PageSource,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+from trino_tpu.runtime.events import EventListener
+
+
+class QueryHistory(EventListener):
+    """Bounded in-memory query log fed by the event pipeline."""
+
+    def __init__(self, limit: int = 1000):
+        self.limit = limit
+        self.entries: list[dict] = []
+        self._running: dict[str, dict] = {}
+
+    def query_created(self, e):
+        row = {
+            "query_id": e.query_id,
+            "state": "RUNNING",
+            "query": e.sql,
+            "create_time": e.create_time,
+            "end_time": None,
+            "rows": None,
+            "error": None,
+        }
+        self._running[e.query_id] = row
+        self.entries.append(row)
+        if len(self.entries) > self.limit:
+            self.entries = self.entries[-self.limit :]
+
+    def query_completed(self, e):
+        row = self._running.pop(e.query_id, None)
+        if row is None:
+            return
+        row["state"] = e.state
+        row["end_time"] = e.end_time
+        row["rows"] = e.rows
+        row["error"] = e.error
+
+
+_TABLES = {
+    "queries": [
+        ("query_id", T.VARCHAR),
+        ("state", T.VARCHAR),
+        ("query", T.VARCHAR),
+        ("create_time", T.DOUBLE),
+        ("end_time", T.DOUBLE),
+        ("rows", T.BIGINT),
+        ("error", T.VARCHAR),
+    ],
+    "nodes": [
+        ("node_id", T.VARCHAR),
+        ("state", T.VARCHAR),
+    ],
+    "session_properties": [
+        ("name", T.VARCHAR),
+        ("value", T.VARCHAR),
+        ("description", T.VARCHAR),
+    ],
+    "caches": [
+        ("tier", T.VARCHAR),
+        ("bytes", T.BIGINT),
+        ("hits", T.BIGINT),
+        ("misses", T.BIGINT),
+    ],
+}
+
+
+class _SystemMetadata(ConnectorMetadata):
+    def list_schemas(self):
+        return ["runtime"]
+
+    def list_tables(self, schema: str):
+        return sorted(_TABLES) if schema == "runtime" else []
+
+    def table_metadata(self, schema: str, table: str) -> TableMetadata:
+        if schema != "runtime" or table not in _TABLES:
+            raise KeyError(f"system table not found: {schema}.{table}")
+        return TableMetadata(
+            schema, table, tuple(ColumnMeta(n, t) for n, t in _TABLES[table])
+        )
+
+    def table_statistics(self, schema: str, table: str) -> TableStatistics:
+        return TableStatistics(row_count=100)
+
+
+class _RowsPageSource(PageSource):
+    def __init__(self, rows: list, types: list, columns: list, all_names: list):
+        self.rows = rows
+        self.types = types
+        self.columns = columns
+        self.all_names = all_names
+
+    def row_count(self) -> int:
+        return len(self.rows)
+
+    def pages(self):
+        ix = [self.all_names.index(c) for c in self.columns]
+        out = []
+        for i, t in zip(ix, self.types):
+            vals = [r[i] for r in self.rows]
+            valid = np.asarray([v is not None for v in vals])
+            if T.is_string_kind(t):
+                strs = ["" if v is None else str(v) for v in vals]
+                d = StringDictionary.from_unsorted(strs or [""])
+                codes = np.asarray(
+                    [d.index[s] for s in strs], dtype=np.int32
+                )
+                out.append(
+                    ColumnData(codes, None if valid.all() else valid, d)
+                )
+            else:
+                data = np.asarray(
+                    [0 if v is None else v for v in vals], dtype=t.np_dtype
+                )
+                out.append(ColumnData(data, None if valid.all() else valid))
+        yield out
+
+
+class SystemConnector(Connector):
+    name = "system"
+
+    def __init__(self, runner=None):
+        self.runner = runner  # bound after runner construction
+        self._metadata = _SystemMetadata()
+
+    def metadata(self):
+        return self._metadata
+
+    def splits(self, handle: TableHandle, target_splits: int, predicate=None):
+        n = len(self._rows(handle.table))
+        return [Split(handle, 0, row_start=0, row_count=n)]
+
+    def page_source(self, split: Split, columns, max_rows_per_page: int = 1 << 20):
+        table = split.table.table
+        schema = _TABLES[table]
+        all_names = [n for n, _ in schema]
+        tmap = dict(schema)
+        return _RowsPageSource(
+            self._rows(table), [tmap[c] for c in columns], list(columns), all_names
+        )
+
+    def _rows(self, table: str) -> list:
+        r = self.runner
+        if table == "queries":
+            hist = getattr(r, "query_history", None)
+            if hist is None:
+                return []
+            return [
+                (
+                    e["query_id"], e["state"], e["query"], e["create_time"],
+                    e["end_time"], e["rows"], e["error"],
+                )
+                for e in hist.entries
+            ]
+        if table == "nodes":
+            det = getattr(r, "failure_detector", None)
+            if det is not None:
+                failed = det.failed_workers()
+                return [
+                    (w, "FAILED" if w in failed else "ACTIVE")
+                    for w in sorted(det._last)
+                ]
+            import jax
+
+            return [(str(d.id), "ACTIVE") for d in jax.devices()]
+        if table == "session_properties":
+            return [
+                (name, str(value), meta.description)
+                for name, value, meta in r.properties.items()
+            ]
+        if table == "caches":
+            from trino_tpu.runtime.buffer_pool import POOL
+
+            s = POOL.stats()
+            return [
+                ("host", s["host_bytes"], s["host_hits"], s["host_misses"]),
+                (
+                    "device",
+                    s["device_bytes"],
+                    s["device_hits"],
+                    s["device_misses"],
+                ),
+            ]
+        raise KeyError(table)

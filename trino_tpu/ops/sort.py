@@ -27,8 +27,9 @@ _STEP_CACHE: dict = {}
 class OrderByOperator:
     """Full materialized sort; emits one sorted, compacted batch."""
 
-    def __init__(self, keys: Sequence[SortKey]):
+    def __init__(self, keys: Sequence[SortKey], memory_ctx=None):
         self.keys = list(keys)
+        self.memory_ctx = memory_ctx
         self._acc: list[Batch] = []
         key = ("orderby", tuple(keys))
         if key not in _STEP_CACHE:
@@ -41,13 +42,22 @@ class OrderByOperator:
         return batch.gather(perm, valid=live)
 
     def process(self, stream):
+        from trino_tpu.runtime.memory import batch_bytes
+
+        total = 0
         for b in stream:
             self._acc.append(b)
+            if self.memory_ctx is not None:
+                total += batch_bytes(b)
+                self.memory_ctx.set_bytes(total)
         if not self._acc:
             return
         big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
         big = _pad_device(big, next_pow2(big.capacity, floor=1))
-        yield self._step(big)
+        out = self._step(big)
+        if self.memory_ctx is not None:
+            self.memory_ctx.close()
+        yield out
 
 
 class TopNOperator:

@@ -75,7 +75,16 @@ class LogicalPlanner:
         node = rp.node
         if q.order_by:
             orderings = []
-            scope = rp.scope(outer)
+            hidden: list = []  # (Symbol, Expr) computed sort keys
+            # expressions in ORDER BY see the output columns under their
+            # display names (reference: Scope of the query's output)
+            scope = Scope(
+                [
+                    Field(n, f.symbol, f.alias)
+                    for f, n in zip(rp.fields, names)
+                ],
+                outer,
+            )
             by_alias = {}
             for f, n in zip(rp.fields, names):
                 by_alias.setdefault(n, f.symbol)
@@ -92,6 +101,12 @@ class LogicalPlanner:
                         e = None
                     if isinstance(e, SymbolRef):
                         sym = P.Symbol(e.name, e.type)
+                    elif e is not None:
+                        # computed sort key over output columns: pre-project
+                        # a hidden symbol, sort on it, drop it afterwards
+                        # (reference: QueryPlanner ORDER BY synthetic symbols)
+                        sym = self.alloc.new("orderby", e.type)
+                        hidden.append((sym, e))
                 if (
                     sym is None
                     and isinstance(item.expr, ast.Identifier)
@@ -118,12 +133,21 @@ class LogicalPlanner:
                 if nf is None:
                     nf = not item.ascending  # reference default: NULLS LAST asc, FIRST desc
                 orderings.append((sym, item.ascending, nf))
+            if hidden:
+                node = P.ProjectNode(
+                    node,
+                    [(f.symbol, f.symbol.ref()) for f in rp.fields] + hidden,
+                )
             if q.limit is not None and not q.offset:
                 node = P.TopNNode(node, orderings, q.limit)
             else:
                 node = P.SortNode(node, orderings)
                 if q.limit is not None or q.offset:
                     node = P.LimitNode(node, q.limit, q.offset or 0)
+            if hidden:
+                node = P.ProjectNode(
+                    node, [(f.symbol, f.symbol.ref()) for f in rp.fields]
+                )
         elif q.limit is not None or q.offset:
             node = P.LimitNode(node, q.limit, q.offset or 0)
         return RelationPlan(node, rp.fields), names

@@ -87,6 +87,8 @@ class LocalExecutionPlanner:
         self.memory = MemoryPool().query_context(
             "query", self.properties.get("query_max_memory_bytes")
         )
+        if stats is not None:
+            stats.memory = self.memory
         self._depth = 0
         #: symbol name -> (lo, hi) host values collected from materialized
         #: join build sides (reference: server/DynamicFilterService.java:107 +
@@ -428,7 +430,10 @@ class LocalExecutionPlanner:
 
     def _visit_SortNode(self, node: P.SortNode) -> PhysicalPlan:
         src = self.plan(node.source)
-        op = OrderByOperator(self._sort_keys(src, node.orderings))
+        op = OrderByOperator(
+            self._sort_keys(src, node.orderings),
+            memory_ctx=self.memory.child("sort"),
+        )
         return PhysicalPlan(op.process(src.stream), src.symbols)
 
     def _visit_TopNNode(self, node: P.TopNNode) -> PhysicalPlan:

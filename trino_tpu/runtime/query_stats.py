@@ -36,6 +36,9 @@ class StatsCollector:
     def __init__(self):
         self.operators: list[OperatorStats] = []
         self._next_id = 0
+        #: per-query MemoryContext set by the execution planner so peak
+        #: reservations render with the stats (MemoryPool visibility)
+        self.memory = None
 
     def register(self, name: str, detail: str = "", depth: int = 0) -> OperatorStats:
         st = OperatorStats(self._next_id, name, detail, depth=depth)
@@ -68,4 +71,16 @@ class StatsCollector:
         lines = ["Query execution statistics (wall = inclusive of subtree):"]
         for st in reversed(self.operators):
             lines.append(st.line())
+        if self.memory is not None:
+            lines.append(
+                f"peak device memory reserved: {self.memory.peak} bytes"
+            )
+            from trino_tpu.runtime.buffer_pool import POOL
+
+            s = POOL.stats()
+            lines.append(
+                "buffer pool: "
+                f"device={s['device_bytes']}B hits={s['device_hits']} "
+                f"misses={s['device_misses']}; host={s['host_bytes']}B"
+            )
         return "\n".join(lines)

@@ -1,0 +1,116 @@
+"""Resource groups: admission control and fair queuing.
+
+Reference: execution/resourcegroups/InternalResourceGroup.java +
+InternalResourceGroupManager.java — queries are admitted into a tree of
+groups, each with hard concurrency and queue limits; queued queries start
+as running ones finish.
+
+Engine mapping: the scarce resource is the device, so `hard_concurrency`
+bounds concurrent engine executions per group and `max_queued` bounds the
+backlog.  A selector picks the group by user/source (the resource-group
+manager plugin's role, reduced to prefix rules)."""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class QueryQueueFullError(RuntimeError):
+    """Reference: QUERY_QUEUE_FULL error code."""
+
+
+@dataclass
+class ResourceGroupConfig:
+    name: str
+    hard_concurrency: int = 1
+    max_queued: int = 100
+
+
+class ResourceGroup:
+    def __init__(self, config: ResourceGroupConfig):
+        self.config = config
+        self.running = 0
+        self.queued: deque = deque()
+        self.lock = threading.Lock()
+        #: peak/telemetry counters (system.runtime-style observability)
+        self.total_admitted = 0
+        self.total_queued = 0
+
+    def acquire(self, timeout: Optional[float] = None) -> None:
+        """Block until admitted; raise QueryQueueFullError when the queue
+        is at max_queued (reference: InternalResourceGroup.run)."""
+        gate = None
+        with self.lock:
+            if self.running < self.config.hard_concurrency:
+                self.running += 1
+                self.total_admitted += 1
+                return
+            if len(self.queued) >= self.config.max_queued:
+                raise QueryQueueFullError(
+                    f"resource group {self.config.name} queue is full "
+                    f"({self.config.max_queued})"
+                )
+            gate = threading.Event()
+            self.queued.append(gate)
+            self.total_queued += 1
+        if not gate.wait(timeout=timeout):
+            with self.lock:
+                try:
+                    self.queued.remove(gate)
+                except ValueError:
+                    # raced with release(): the slot was granted
+                    return
+            raise TimeoutError(
+                f"queued in resource group {self.config.name} past timeout"
+            )
+
+    def release(self) -> None:
+        with self.lock:
+            if self.queued:
+                gate = self.queued.popleft()
+                self.total_admitted += 1
+                gate.set()  # hand the slot to the next queued query
+            else:
+                self.running = max(0, self.running - 1)
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "name": self.config.name,
+                "running": self.running,
+                "queued": len(self.queued),
+                "hard_concurrency": self.config.hard_concurrency,
+                "total_admitted": self.total_admitted,
+                "total_queued": self.total_queued,
+            }
+
+
+class ResourceGroupManager:
+    """Selector + group registry (InternalResourceGroupManager role).
+    Selection: exact user match first, then the default group."""
+
+    def __init__(self, default: Optional[ResourceGroupConfig] = None):
+        self.groups: dict[str, ResourceGroup] = {}
+        self.default = self.add(
+            default or ResourceGroupConfig("global", hard_concurrency=1)
+        )
+        self._user_rules: dict[str, str] = {}
+
+    def add(self, config: ResourceGroupConfig) -> ResourceGroup:
+        g = ResourceGroup(config)
+        self.groups[config.name] = g
+        return g
+
+    def add_user_rule(self, user: str, group_name: str) -> None:
+        self._user_rules[user] = group_name
+
+    def select(self, user: Optional[str] = None) -> ResourceGroup:
+        if user is not None and user in self._user_rules:
+            return self.groups[self._user_rules[user]]
+        return self.default
+
+    def stats(self) -> list:
+        return [g.stats() for g in self.groups.values()]

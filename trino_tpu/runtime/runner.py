@@ -51,13 +51,32 @@ class LocalQueryRunner:
     ):
         from trino_tpu.runtime.events import EventListenerManager
         from trino_tpu.runtime.session import SessionProperties
+        from trino_tpu.runtime.transactions import TransactionManager
 
         self.catalogs = catalogs or default_catalogs()
         self.session = Session(catalog, schema)
         self.properties = SessionProperties()
         self.properties.set("target_splits", target_splits)
         self.events = EventListenerManager()
+        self.transactions = TransactionManager(self.catalogs)
         self._query_ids = __import__("itertools").count(1)
+        # system.runtime observability (connector/system/ role): query
+        # history + nodes + session properties queryable via SQL
+        from trino_tpu.connectors.system import QueryHistory, SystemConnector
+
+        self.query_history = QueryHistory()
+        self.events.add(self.query_history)
+        if "system" not in self.catalogs.names():
+            sysconn = SystemConnector(self)
+            self.catalogs.register("system", sysconn)
+        else:
+            sysconn = self.catalogs.get("system")
+        if getattr(sysconn, "runner", None) is None:
+            sysconn.runner = self
+
+    @property
+    def in_transaction(self) -> bool:
+        return self.transactions.active
 
     @property
     def target_splits(self) -> int:
@@ -180,6 +199,16 @@ class LocalQueryRunner:
             value = str(value)
         self.properties.set(stmt.name, value)
         return _ok("SET SESSION")
+
+    def _exec_TransactionStatement(self, stmt: ast.TransactionStatement) -> MaterializedResult:
+        if stmt.action == "start":
+            self.transactions.begin()
+            return _ok("START TRANSACTION")
+        if stmt.action == "commit":
+            self.transactions.commit()
+            return _ok("COMMIT")
+        self.transactions.rollback()
+        return _ok("ROLLBACK")
 
     # -- SHOW / DESCRIBE ------------------------------------------------------
 

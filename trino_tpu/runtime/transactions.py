@@ -1,0 +1,50 @@
+"""Transaction manager (reference: transaction/InMemoryTransactionManager
+.java — per-connector isolation contexts created at BEGIN, committed or
+aborted atomically per connector).
+
+The engine's write-capable connectors are host-side stores, so transaction
+isolation is snapshot/restore: BEGIN snapshots every write-capable catalog,
+ROLLBACK restores the snapshots, COMMIT discards them.  Connector data
+structures are replace-on-write (appends build new column arrays), so a
+shallow store snapshot is sufficient and O(tables)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class TransactionError(RuntimeError):
+    pass
+
+
+class TransactionManager:
+    def __init__(self, catalogs):
+        self.catalogs = catalogs
+        self._snapshots: Optional[dict] = None
+
+    @property
+    def active(self) -> bool:
+        return self._snapshots is not None
+
+    def begin(self) -> None:
+        if self.active:
+            raise TransactionError("transaction already in progress")
+        snaps = {}
+        for name in self.catalogs.names():
+            conn = self.catalogs.get(name)
+            snap = getattr(conn, "snapshot", None)
+            if snap is not None and conn.supports_writes():
+                snaps[name] = conn.snapshot()
+        self._snapshots = snaps
+
+    def commit(self) -> None:
+        if not self.active:
+            raise TransactionError("no transaction in progress")
+        self._snapshots = None
+
+    def rollback(self) -> None:
+        if not self.active:
+            raise TransactionError("no transaction in progress")
+        for name, snap in self._snapshots.items():
+            self.catalogs.get(name).restore(snap)
+        self._snapshots = None

@@ -62,7 +62,14 @@ class _Query:
 class CoordinatorServer:
     """serve() blocks; start()/shutdown() for embedded use (tests, CLI)."""
 
-    def __init__(self, runner=None, host: str = "127.0.0.1", port: int = 8080):
+    def __init__(
+        self,
+        runner=None,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        resource_groups=None,
+    ):
+        from trino_tpu.runtime.resource_groups import ResourceGroupManager
         from trino_tpu.runtime.runner import LocalQueryRunner
 
         self.runner = runner or LocalQueryRunner()
@@ -70,21 +77,41 @@ class CoordinatorServer:
         self.port = port
         self._queries: dict[str, _Query] = {}
         self._qid = itertools.count(1)
-        self._lock = threading.Lock()  # serializes engine execution
+        #: admission control (resource-group tree): the engine/device is the
+        #: shared resource, hard_concurrency bounds concurrent executions
+        #: (reference: InternalResourceGroupManager)
+        self.resource_groups = resource_groups or ResourceGroupManager()
+        #: engine-wide serialization: resource groups bound ADMISSION, but
+        #: the shared LocalQueryRunner (session state, query ids, device) is
+        #: not concurrency-safe — one execution at a time regardless of group
+        self._engine_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     # -- query lifecycle ------------------------------------------------------
 
-    def submit(self, sql: str) -> _Query:
+    def submit(self, sql: str, user: Optional[str] = None) -> _Query:
+        from trino_tpu.runtime.resource_groups import QueryQueueFullError
+
         q = _Query(f"q_{next(self._qid)}", sql)
         self._queries[q.id] = q
+        group = self.resource_groups.select(user)
 
         def work():
-            # one query at a time through the engine (the TaskExecutor's
-            # role of bounding concurrent device work; the chip is the
-            # shared resource here)
-            with self._lock:
-                q.run(self.runner)
+            try:
+                group.acquire()
+            except QueryQueueFullError as e:
+                q.state = "FAILED"
+                q.error = {
+                    "message": str(e),
+                    "errorName": "QUERY_QUEUE_FULL",
+                }
+                q.done.set()
+                return
+            try:
+                with self._engine_lock:
+                    q.run(self.runner)
+            finally:
+                group.release()
 
         threading.Thread(target=work, daemon=True).start()
         return q
@@ -114,7 +141,8 @@ class CoordinatorServer:
                     return self._send(404, {"error": {"message": "not found"}})
                 n = int(self.headers.get("Content-Length", 0))
                 sql = self.rfile.read(n).decode()
-                q = server.submit(sql)
+                user = self.headers.get("X-Trino-User")
+                q = server.submit(sql, user=user)
                 self._send(
                     200,
                     protocol.query_results(

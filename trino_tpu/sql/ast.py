@@ -355,3 +355,11 @@ class SetSession(Node):
 class UseStatement(Node):
     catalog: Optional[str]
     schema: str
+
+
+@dataclass(frozen=True)
+class TransactionStatement(Node):
+    """START TRANSACTION / COMMIT / ROLLBACK (reference: sql/tree/
+    StartTransaction.java, Commit.java, Rollback.java)."""
+
+    action: str  # start | commit | rollback

@@ -181,6 +181,23 @@ class Parser:
             if len(name) == 2:
                 return ast.UseStatement(name[0], name[1])
             return ast.UseStatement(None, name[0])
+        if t.is_kw("start"):
+            self.next()
+            self.expect_kw("transaction")
+            # isolation/access-mode modifiers accepted and ignored
+            while self.peek().kind != "eof" and not (
+                self.peek().kind == "op" and self.peek().value == ";"
+            ):
+                self.next()
+            return ast.TransactionStatement("start")
+        if t.is_kw("commit"):
+            self.next()
+            self.accept_kw("work")
+            return ast.TransactionStatement("commit")
+        if t.is_kw("rollback"):
+            self.next()
+            self.accept_kw("work")
+            return ast.TransactionStatement("rollback")
         raise ParseError("unsupported statement", t)
 
     def _create(self) -> ast.Node:

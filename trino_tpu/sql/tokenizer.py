@@ -21,7 +21,7 @@ KEYWORDS = {
     "lateral", "tablesample", "bernoulli", "system", "substring", "for",
     "position", "localtime", "localtimestamp", "current_date",
     "current_time", "current_timestamp", "exec", "execute", "prepare",
-    "deallocate", "commit", "rollback", "start", "transaction", "use",
+    "deallocate", "commit", "rollback", "start", "transaction", "work", "use",
     "year", "month", "day", "hour", "minute", "second", "quarter", "week",
     "to",
 }
