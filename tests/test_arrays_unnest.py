@@ -1,0 +1,200 @@
+"""Arrays, UNNEST, and JSON functions (reference: operator/unnest/
+UnnestOperator.java, operator/scalar/Array*Function.java, SplitFunction.java,
+JsonExtract.java)."""
+
+from decimal import Decimal
+
+import pytest
+
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+@pytest.fixture(scope="module")
+def drunner():
+    from trino_tpu.parallel.runner import DistributedQueryRunner
+
+    return DistributedQueryRunner(catalog="tpch", schema="tiny")
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+# -- array constructor / subscript -------------------------------------------
+
+
+def test_array_constructor_and_subscript(runner):
+    assert q(runner, "SELECT ARRAY[1,2,3][2]") == [(2,)]
+    assert q(runner, "SELECT ARRAY['a','b'][1]") == [("a",)]
+
+
+def test_subscript_out_of_range_null(runner):
+    assert q(runner, "SELECT ARRAY[1,2][5]") == [(None,)]
+    assert q(runner, "SELECT ARRAY[1,2][0]") == [(None,)]
+
+
+def test_array_output_materialization(runner):
+    assert q(runner, "SELECT ARRAY[1,2,3]") == [([1, 2, 3],)]
+    assert q(runner, "SELECT array_sort(ARRAY[3,1,2])") == [([1, 2, 3],)]
+
+
+def test_cardinality_element_at_contains(runner):
+    assert q(
+        runner,
+        "SELECT cardinality(ARRAY[1,2,3]), element_at(ARRAY[10,20], 3), "
+        "contains(ARRAY[1,2], 2), contains(ARRAY['x','y'], 'z')",
+    ) == [(3, None, True, False)]
+
+
+def test_element_at_negative_index(runner):
+    assert q(
+        runner,
+        "SELECT element_at(ARRAY[10,20,30], -1), "
+        "element_at(ARRAY[10,20,30], -3), element_at(ARRAY[10,20,30], -4)",
+    ) == [(30, 10, None)]
+
+
+def test_json_nonfinite_returns_null(runner):
+    assert q(
+        runner, """SELECT json_extract_scalar('{"a": Infinity}', '$.a')"""
+    ) == [(None,)]
+
+
+def test_array_position_minmax_distinct(runner):
+    assert q(
+        runner,
+        "SELECT array_position(ARRAY[5,7,9], 9), array_max(ARRAY[3,1,2]), "
+        "array_min(ARRAY[3,1,2]), array_distinct(ARRAY[3,1,3,2])",
+    ) == [(3, 3, 1, [1, 2, 3])]
+
+
+def test_sequence_repeat(runner):
+    assert q(runner, "SELECT sequence(1,5)") == [([1, 2, 3, 4, 5],)]
+    assert q(runner, "SELECT sequence(5,1,-2)") == [([5, 3, 1],)]
+    assert q(runner, "SELECT repeat(7, 3)") == [([7, 7, 7],)]
+
+
+def test_split(runner):
+    assert q(runner, "SELECT split('a,b,c', ',')") == [(["a", "b", "c"],)]
+    assert q(runner, "SELECT split('a,b,c', ',')[2]") == [("b",)]
+    assert q(runner, "SELECT split('abc', 'x')") == [(["abc"],)]
+
+
+def test_array_column_through_project(runner):
+    # array built per row from table columns, then subscripted
+    res = q(
+        runner,
+        "SELECT n_nationkey k, ARRAY[n_nationkey, n_regionkey][2] FROM nation "
+        "WHERE n_nationkey < 3 ORDER BY k",
+    )
+    assert res == [(0, 0), (1, 1), (2, 1)]
+
+
+# -- UNNEST -------------------------------------------------------------------
+
+
+def test_unnest_standalone(runner):
+    assert q(runner, "SELECT * FROM UNNEST(ARRAY[1,2,3])") == [(1,), (2,), (3,)]
+
+
+def test_unnest_zip_and_ordinality(runner):
+    res = q(
+        runner,
+        "SELECT * FROM UNNEST(ARRAY[1,2], ARRAY[10,20,30]) WITH ORDINALITY",
+    )
+    assert res == [(1, 10, 1), (2, 20, 2), (None, 30, 3)]
+
+
+def test_unnest_correlated_cross_join(runner):
+    res = q(
+        runner,
+        "SELECT t.x, u.e FROM (VALUES (1), (2)) t(x) "
+        "CROSS JOIN UNNEST(sequence(1, 2)) u(e) ORDER BY t.x, u.e",
+    )
+    assert res == [(1, 1), (1, 2), (2, 1), (2, 2)]
+
+
+def test_unnest_split_correlated(runner):
+    res = q(
+        runner,
+        "SELECT s, e FROM (VALUES ('a,b'), ('c')) t(s) "
+        "CROSS JOIN UNNEST(split(s, ',')) u(e) ORDER BY s, e",
+    )
+    assert res == [("a,b", "a"), ("a,b", "b"), ("c", "c")]
+
+
+def test_unnest_aggregation(runner):
+    assert q(runner, "SELECT sum(e) FROM UNNEST(sequence(1,100)) u(e)") == [
+        (5050,)
+    ]
+
+
+def test_unnest_over_table(runner):
+    res = q(
+        runner,
+        "SELECT count(*) FROM nation CROSS JOIN UNNEST(ARRAY[1,2,3]) u(e)",
+    )
+    assert res == [(75,)]
+
+
+def test_unnest_distributed_matches_local(runner, drunner):
+    sql = (
+        "SELECT sum(e * l_quantity) FROM lineitem "
+        "CROSS JOIN UNNEST(ARRAY[1,2]) u(e) WHERE l_orderkey < 100"
+    )
+    assert q(drunner, sql) == q(runner, sql)
+
+
+def test_unnest_rows_distributed(runner, drunner):
+    sql = (
+        "SELECT l_orderkey, e FROM lineitem "
+        "CROSS JOIN UNNEST(ARRAY[1,2]) u(e) WHERE l_orderkey < 10"
+    )
+    assert sorted(q(drunner, sql)) == sorted(q(runner, sql))
+
+
+# -- JSON ---------------------------------------------------------------------
+
+
+def test_json_extract_scalar(runner):
+    assert q(
+        runner,
+        """SELECT json_extract_scalar('{"a": {"b": 7}}', '$.a.b')""",
+    ) == [("7",)]
+    assert q(
+        runner,
+        """SELECT json_extract_scalar('{"a": [10, 20]}', '$.a[1]')""",
+    ) == [("20",)]
+    assert q(
+        runner,
+        """SELECT json_extract_scalar('{"a": 1}', '$.missing')""",
+    ) == [(None,)]
+
+
+def test_json_extract(runner):
+    assert q(
+        runner,
+        """SELECT json_extract('{"a": {"b": [1, 2]}}', '$.a.b')""",
+    ) == [("[1,2]",)]
+
+
+def test_json_array_length_and_size(runner):
+    assert q(runner, "SELECT json_array_length('[1,2,3]')") == [(3,)]
+    assert q(runner, "SELECT json_array_length('{}')") == [(None,)]
+    assert q(
+        runner, """SELECT json_size('{"a": {"x": 1, "y": 2}}', '$.a')"""
+    ) == [(2,)]
+
+
+def test_json_over_column(runner):
+    res = q(
+        runner,
+        """SELECT json_extract_scalar(j, '$.k') FROM """
+        """(VALUES ('{"k": "v1"}'), ('{"k": "v2"}'), ('broken')) t(j)""",
+    )
+    assert res == [("v1",), ("v2",), (None,)]

@@ -49,6 +49,21 @@ def test_dbapi_string_escaping():
     assert "it" in cur.fetchone()[0]
 
 
+def test_dbapi_question_mark_inside_literal():
+    from trino_tpu import dbapi
+
+    conn = dbapi.connect(runner=LocalQueryRunner())
+    cur = conn.cursor()
+    # the '?' inside the string literal is not a placeholder
+    cur.execute("select ?, 'a?b'", (7,))
+    assert cur.fetchone() == (7, "a?b")
+    # '?' inside comments is not a placeholder either
+    cur.execute("select ? -- valid?\n", (1,))
+    assert cur.fetchone() == (1,)
+    cur.execute("select ? /* really? */", (2,))
+    assert cur.fetchone() == (2,)
+
+
 def test_dbapi_over_http():
     from trino_tpu import dbapi
     from trino_tpu.server.coordinator import CoordinatorServer

@@ -127,11 +127,19 @@ def test_parser_no_hang_on_malformed(catalogs):
         parse_statement("select sum(x) over (order by y rows unbounded")
 
 
-def test_offset_rejected_loudly(catalogs):
-    with pytest.raises(AnalysisError, match="OFFSET"):
-        _plan("select r_name from region offset 2", catalogs)
-    with pytest.raises(AnalysisError, match="OFFSET"):
-        _plan("select r_name from region order by r_name offset 2 limit 1", catalogs)
+def test_offset_plans_as_limit_node(catalogs):
+    # OFFSET support landed in round 3: it plans as a LimitNode with offset
+    out = _plan("select r_name from region offset 2", catalogs)
+    assert any(
+        isinstance(n, P.LimitNode) and n.offset == 2 for n in walk(out)
+    )
+    out = _plan(
+        "select r_name from region order by r_name offset 2 limit 1", catalogs
+    )
+    assert any(
+        isinstance(n, P.LimitNode) and n.offset == 2 and n.count == 1
+        for n in walk(out)
+    )
 
 
 def test_scalar_count_subquery_coalesced(catalogs):

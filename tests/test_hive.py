@@ -1,0 +1,112 @@
+"""Hive-style partitioned connector: parquet + ORC, partition pruning
+(reference: plugin/trino-hive HivePartitionManager + page source factories)."""
+
+import os
+
+import pytest
+
+from trino_tpu.connectors.api import CatalogManager, TableHandle
+from trino_tpu.connectors.hive import HiveConnector, write_partitioned
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module", params=["parquet", "orc"])
+def hive_root(request, tmp_path_factory):
+    root = str(tmp_path_factory.mktemp(f"hive_{request.param}"))
+    nparts = write_partitioned(
+        TpchConnector(), "tiny", "nation", root,
+        partition_by=["n_regionkey"], fmt=request.param,
+    )
+    assert nparts == 5
+    return root
+
+
+@pytest.fixture(scope="module")
+def runner(hive_root):
+    cm = CatalogManager()
+    cm.register("hive", HiveConnector(hive_root))
+    cm.register("tpch", TpchConnector())
+    return LocalQueryRunner(cm, catalog="hive", schema="tiny", target_splits=4)
+
+
+def test_hive_metadata(hive_root):
+    conn = HiveConnector(hive_root)
+    meta = conn.metadata().table_metadata("tiny", "nation")
+    names = [c.name for c in meta.columns]
+    assert "n_regionkey" in names and "n_name" in names
+    assert conn.metadata().list_tables("tiny") == ["nation"]
+
+
+def test_hive_full_scan_matches_generator(runner):
+    hive_rows = runner.execute(
+        "SELECT n_nationkey, n_name, n_regionkey FROM nation ORDER BY n_nationkey"
+    ).rows
+    tpch_rows = runner.execute(
+        "SELECT n_nationkey, n_name, n_regionkey FROM tpch.tiny.nation "
+        "ORDER BY n_nationkey"
+    ).rows
+    assert hive_rows == tpch_rows
+    assert len(hive_rows) == 25
+
+
+def test_hive_partition_pruning(runner, hive_root):
+    conn = HiveConnector(hive_root)
+    handle = TableHandle("hive", "tiny", "nation")
+    all_splits = conn.splits(handle, target_splits=4)
+    pruned = conn.splits(
+        handle, target_splits=4, predicate=[("n_regionkey", "=", 2)]
+    )
+    assert len(pruned) < len(all_splits)
+    # every pruned split carries only the matching partition value
+    assert all(s.info[2]["n_regionkey"] == "2" for s in pruned)
+    # and the engine gets correct results through the pruned scan
+    rows = runner.execute(
+        "SELECT count(*) FROM nation WHERE n_regionkey = 2"
+    ).rows
+    assert rows == [(5,)]
+
+
+def test_hive_partition_range_pruning(runner, hive_root):
+    conn = HiveConnector(hive_root)
+    handle = TableHandle("hive", "tiny", "nation")
+    pruned = conn.splits(
+        handle, target_splits=4, predicate=[("n_regionkey", ">=", 3)]
+    )
+    vals = {s.info[2]["n_regionkey"] for s in pruned}
+    assert vals == {"3", "4"}
+    rows = runner.execute(
+        "SELECT count(*) FROM nation WHERE n_regionkey >= 3"
+    ).rows
+    assert rows == [(10,)]
+
+
+def test_hive_aggregation(runner):
+    rows = runner.execute(
+        "SELECT n_regionkey, count(*) FROM nation GROUP BY n_regionkey "
+        "ORDER BY n_regionkey"
+    ).rows
+    assert rows == [(0, 5), (1, 5), (2, 5), (3, 5), (4, 5)]
+
+
+def test_predicate_triples_extraction():
+    from trino_tpu import types as T
+    from trino_tpu.connectors.api import extract_predicate_triples
+    from trino_tpu.expr import ir
+    from trino_tpu.expr.ir import Form, Literal, SpecialForm, SymbolRef
+
+    a = SymbolRef("a_0", T.BIGINT)
+    b = SymbolRef("b_0", T.BIGINT)
+    e = ir.and_(
+        ir.comparison("=", a, Literal(3, T.BIGINT)),
+        ir.comparison("<", Literal(5, T.BIGINT), b),
+        SpecialForm(Form.IN, [a, Literal(1, T.BIGINT), Literal(2, T.BIGINT)]),
+        SpecialForm(
+            Form.BETWEEN, [b, Literal(0, T.BIGINT), Literal(9, T.BIGINT)]
+        ),
+    )
+    triples = extract_predicate_triples(e, {"a_0": "a", "b_0": "b"})
+    assert ("a", "=", 3) in triples
+    assert ("b", ">", 5) in triples
+    assert ("a", "in", (1, 2)) in triples
+    assert ("b", ">=", 0) in triples and ("b", "<=", 9) in triples

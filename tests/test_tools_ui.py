@@ -1,0 +1,161 @@
+"""Table functions, SHOW FUNCTIONS/SESSION, web UI, proxy, verifier
+(reference: spi/function/table + SequenceFunction/ExcludeColumnsFunction,
+webapp UI resources, client/trino-proxy, service/trino-verifier)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+# -- table functions ----------------------------------------------------------
+
+
+def test_table_function_sequence(runner):
+    assert q(runner, "SELECT count(*), sum(sequential_number) FROM TABLE(sequence(1, 100))") == [
+        (100, 5050)
+    ]
+    assert q(runner, "SELECT * FROM TABLE(sequence(1, 10, 3))") == [
+        (1,), (4,), (7,), (10,)
+    ]
+
+
+def test_table_function_named_args(runner):
+    assert q(
+        runner, "SELECT s FROM TABLE(sequence(start => 1, stop => 3)) t(s)"
+    ) == [(1,), (2,), (3,)]
+
+
+def test_table_function_exclude_columns(runner):
+    res = q(
+        runner,
+        "SELECT * FROM TABLE(exclude_columns(TABLE(nation), "
+        "DESCRIPTOR(n_comment, n_regionkey))) LIMIT 2",
+    )
+    assert res == [(0, "ALGERIA"), (1, "ARGENTINA")]
+
+
+def test_table_function_unknown(runner):
+    from trino_tpu.planner.analyzer import AnalysisError
+
+    with pytest.raises(AnalysisError, match="table function not found"):
+        q(runner, "SELECT * FROM TABLE(nope(1))")
+
+
+# -- SHOW FUNCTIONS / SESSION -------------------------------------------------
+
+
+def test_show_functions(runner):
+    rows = q(runner, "SHOW FUNCTIONS")
+    names = {r[0] for r in rows}
+    kinds = {r[0]: r[3] for r in rows}
+    assert {"sum", "split", "row_number", "sequence"} <= names
+    assert kinds["sum"] == "aggregate"
+    assert kinds["row_number"] == "window"
+    assert kinds["sequence"] == "table"
+    assert kinds["split"] == "scalar"
+
+
+def test_show_functions_like(runner):
+    rows = q(runner, "SHOW FUNCTIONS LIKE 'json%'")
+    assert {r[0] for r in rows} == {
+        "json_array_length", "json_extract", "json_extract_scalar",
+        "json_format", "json_parse", "json_size",
+    }
+
+
+def test_show_session(runner):
+    rows = q(runner, "SHOW SESSION")
+    names = {r[0] for r in rows}
+    assert {"target_splits", "retry_policy", "scan_cache"} <= names
+
+
+# -- web UI -------------------------------------------------------------------
+
+
+def test_web_ui():
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    srv = CoordinatorServer(port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        qq = srv.submit("select count(*) from nation")
+        qq.done.wait(timeout=60)
+        page = urllib.request.urlopen(f"{base}/ui/", timeout=10).read()
+        assert b"trino_tpu coordinator" in page
+        stats = json.load(urllib.request.urlopen(f"{base}/ui/api/stats", timeout=10))
+        assert stats["totalQueries"] >= 1
+        queries = json.load(urllib.request.urlopen(f"{base}/ui/api/query", timeout=10))
+        assert any(x["queryId"] == qq.id for x in queries)
+        one = json.load(
+            urllib.request.urlopen(f"{base}/ui/api/query/{qq.id}", timeout=10)
+        )
+        assert one["state"] == "FINISHED" and one["rowCount"] == 1
+    finally:
+        srv.shutdown()
+
+
+# -- proxy --------------------------------------------------------------------
+
+
+def test_proxy_roundtrip():
+    from trino_tpu.client import Client
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.proxy import ProxyServer
+
+    srv = CoordinatorServer(port=0)
+    srv.start()
+    proxy = ProxyServer(f"http://127.0.0.1:{srv.port}", port=0).start()
+    try:
+        cols, rows = Client(proxy.url).execute("select 41 + 1")
+        assert rows == [(42,)]
+    finally:
+        proxy.shutdown()
+        srv.shutdown()
+
+
+# -- verifier -----------------------------------------------------------------
+
+
+def test_verifier_match_and_mismatch(runner):
+    from trino_tpu.testing.verifier import Verifier
+
+    class Broken:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def execute(self, sql):
+            res = self.inner.execute(sql)
+            if "n_regionkey" in sql:
+                res = type(res)(
+                    res.column_names, [tuple(r) for r in res.rows[:-1]], res.types
+                )
+            return res
+
+    control = LocalQueryRunner(catalog="tpch", schema="tiny")
+    v = Verifier(control, runner)
+    rep = v.run({"a": "select count(*) from nation", "b": "select 1.5"})
+    assert rep.matched == 2 and not rep.failed
+
+    v2 = Verifier(control, Broken(runner))
+    rep2 = v2.run(
+        {
+            "ok": "select n_name from nation where n_nationkey = 0",
+            "bad": "select n_regionkey from nation",
+            "err": "select no_such_column from nation",
+        }
+    )
+    st = {r.query_id: r.status for r in rep2.results}
+    assert st == {"ok": "MATCH", "bad": "MISMATCH", "err": "CONTROL_ERROR"}

@@ -11,6 +11,23 @@ from trino_tpu.runtime.runner import LocalQueryRunner
 from trino_tpu.testing import connector_table_to_pandas
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_caches():
+    """The TPC-DS module compiles hundreds of fragment kernels; entering it
+    with the whole suite's accumulated executables has hit allocator-level
+    XLA crashes late in the run.  Start from a clean compile cache and an
+    empty buffer pool (everything recompiles on demand)."""
+    import jax
+
+    from trino_tpu.runtime.buffer_pool import POOL
+
+    jax.clear_caches()
+    POOL.clear()
+    yield
+    jax.clear_caches()
+    POOL.clear()
+
+
 @pytest.fixture(scope="module")
 def runner():
     return LocalQueryRunner(catalog="tpcds", schema="tiny", target_splits=2)

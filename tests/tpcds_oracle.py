@@ -50,6 +50,52 @@ def _logical_values(cd, col_type):
     return out
 
 
+class _Moment:
+    """Welford-free moment aggregate for sqlite (sum/sumsq/count)."""
+
+    kind = "stddev_samp"
+
+    def __init__(self):
+        self.n = 0
+        self.s = 0.0
+        self.ss = 0.0
+
+    def step(self, v):
+        if v is None:
+            return
+        v = float(v)
+        self.n += 1
+        self.s += v
+        self.ss += v * v
+
+    def finalize(self):
+        import math
+
+        n, s, ss = self.n, self.s, self.ss
+        if self.kind.endswith("_samp") and n < 2:
+            return None
+        if n == 0:
+            return None
+        m2 = max(ss - s * s / n, 0.0)
+        div = n - 1 if self.kind.endswith("_samp") else n
+        var = m2 / div
+        if self.kind.startswith("stddev"):
+            return math.sqrt(var)
+        return var
+
+
+def _register_stats_aggregates(conn: sqlite3.Connection) -> None:
+    for kind in ("stddev_samp", "stddev_pop", "var_samp", "var_pop"):
+        cls = type(f"_M_{kind}", (_Moment,), {"kind": kind})
+        conn.create_aggregate(kind, 1, cls)
+    conn.create_aggregate(
+        "stddev", 1, type("_M_stddev", (_Moment,), {"kind": "stddev_samp"})
+    )
+    conn.create_aggregate(
+        "variance", 1, type("_M_variance", (_Moment,), {"kind": "var_samp"})
+    )
+
+
 def tpcds_sqlite(schema: str = "tiny") -> sqlite3.Connection:
     if schema in _CONNS:
         return _CONNS[schema]
@@ -58,6 +104,7 @@ def tpcds_sqlite(schema: str = "tiny") -> sqlite3.Connection:
     from trino_tpu.connectors.tpcds.schema import TABLES
 
     conn = sqlite3.connect(":memory:")
+    _register_stats_aggregates(conn)
     c = TpcdsConnector()
     meta = c.metadata()
     for table in TABLES:
@@ -81,6 +128,17 @@ def tpcds_sqlite(schema: str = "tiny") -> sqlite3.Connection:
                     conn.executemany(
                         f"insert into {table} values ({ph})", rows
                     )
+    # join-key indexes: sqlite's planner nested-loops the 6-table OR-filter
+    # queries (Q13/Q48) into hours without them
+    for table in TABLES:
+        tm = meta.table_metadata(schema, table)
+        for cm in tm.columns:
+            if cm.name.endswith("_sk"):
+                conn.execute(
+                    f"create index if not exists idx_{table}_{cm.name} "
+                    f"on {table} ({cm.name})"
+                )
+    conn.execute("analyze")
     conn.commit()
     _CONNS[schema] = conn
     return conn
@@ -88,6 +146,8 @@ def tpcds_sqlite(schema: str = "tiny") -> sqlite3.Connection:
 
 def _sqlite_dialect(sql: str) -> str:
     """Engine dialect -> sqlite dialect (the H2QueryRunner-rewrite role)."""
+    # DECIMAL '1.23' typed literal -> bare numeric literal
+    sql = re.sub(r"\bdecimal\s+'([^']+)'", r"\1", sql, flags=re.IGNORECASE)
     # cast(col as date) -> col ; cast('lit' as date) -> 'lit'
     sql = re.sub(
         r"cast\(\s*([\w.]+|'[^']*')\s+as\s+date\s*\)", r"\1", sql,

@@ -167,13 +167,39 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
                 )
                 for p, table in zip(parts, tables)
             ]
+        lengths = None
+        if any(p.lengths is not None for p in parts):
+            # array columns: right-pad every part to the widest K
+            k = max(p.data.shape[1] for p in parts)
+            parts = [
+                p
+                if p.data.shape[1] == k
+                else Column(
+                    jnp.pad(p.data, ((0, 0), (0, k - p.data.shape[1]))),
+                    p.type,
+                    p.valid,
+                    p.dictionary,
+                    p.lengths,
+                )
+                for p in parts
+            ]
+            lengths = jnp.concatenate(
+                [
+                    (
+                        p.lengths
+                        if p.lengths is not None
+                        else jnp.zeros(p.capacity, jnp.int32)
+                    )
+                    for p in parts
+                ]
+            )
         data = jnp.concatenate([p.data for p in parts])
         if any(p.valid is not None for p in parts):
             valid = jnp.concatenate([p.valid_mask() for p in parts])
         else:
             valid = None
         c0 = parts[0]
-        cols.append(Column(data, c0.type, valid, dictionary))
+        cols.append(Column(data, c0.type, valid, dictionary, lengths))
     if any(b.row_mask is not None for b in batches):
         mask = jnp.concatenate([b.mask() for b in batches])
     else:

@@ -25,7 +25,7 @@ from trino_tpu.columnar.dictionary import StringDictionary
 
 
 class Column:
-    __slots__ = ("data", "valid", "type", "dictionary")
+    __slots__ = ("data", "valid", "type", "dictionary", "lengths")
 
     def __init__(
         self,
@@ -33,11 +33,17 @@ class Column:
         type: Type,
         valid=None,
         dictionary: Optional[StringDictionary] = None,
+        lengths=None,
     ):
         self.data = data
         self.type = type
         self.valid = valid  # None => no nulls
         self.dictionary = dictionary
+        # array(T) columns: data is [capacity, K] (K = padded element slots),
+        # `lengths` is the per-row element count (int32 [capacity]).  The
+        # reference's ArrayBlock offsets (spi/block/ArrayBlock.java) become a
+        # rectangular padded layout so XLA keeps static shapes.
+        self.lengths = lengths
 
     # -- shape ---------------------------------------------------------------
 
@@ -78,7 +84,7 @@ class Column:
     # -- transforms (device-safe, shape preserving) --------------------------
 
     def with_valid(self, valid) -> "Column":
-        return Column(self.data, self.type, valid, self.dictionary)
+        return Column(self.data, self.type, valid, self.dictionary, self.lengths)
 
     def gather(self, indices) -> "Column":
         data = jnp.take(self.data, indices, axis=0, mode="clip")
@@ -87,7 +93,12 @@ class Column:
             if self.valid is None
             else jnp.take(self.valid, indices, axis=0, mode="clip")
         )
-        return Column(data, self.type, valid, self.dictionary)
+        lengths = (
+            None
+            if self.lengths is None
+            else jnp.take(self.lengths, indices, axis=0, mode="clip")
+        )
+        return Column(data, self.type, valid, self.dictionary, lengths)
 
     def valid_mask(self):
         """Always-materialized bool mask (shape [capacity])."""
@@ -114,6 +125,20 @@ class Column:
             rows = np.nonzero(np.asarray(row_mask))[0]
         out = []
         t = self.type
+        if self.lengths is not None:
+            from trino_tpu.types import ArrayType
+
+            lens = np.asarray(self.lengths)
+            elem = t.element if isinstance(t, ArrayType) else t
+            for i in rows:
+                if valid is not None and not valid[i]:
+                    out.append(None)
+                else:
+                    row = Column(
+                        data[i, : int(lens[i])], elem, None, self.dictionary
+                    )
+                    out.append(row.to_pylist())
+            return out
         is_dec = isinstance(t, DecimalType)
         for i in rows:
             if valid is not None and not valid[i]:
@@ -150,13 +175,13 @@ class Column:
 
 
 def _column_flatten(c: Column):
-    return (c.data, c.valid), (c.type, c.dictionary)
+    return (c.data, c.valid, c.lengths), (c.type, c.dictionary)
 
 
 def _column_unflatten(aux, children):
     type_, dictionary = aux
-    data, valid = children
-    return Column(data, type_, valid, dictionary)
+    data, valid, lengths = children
+    return Column(data, type_, valid, dictionary, lengths)
 
 
 jax.tree_util.register_pytree_node(Column, _column_flatten, _column_unflatten)

@@ -178,6 +178,71 @@ class Connector:
         raise NotImplementedError
 
 
+def scan_predicate_triples(node) -> "Optional[list]":
+    """Connector-pruning triples for a TableScanNode's pushed predicate
+    (None when nothing is pushed) — the one conversion both the local and
+    the SPMD planner feed into `Connector.splits(predicate=...)`."""
+    if node.pushed_predicate is None:
+        return None
+    return extract_predicate_triples(
+        node.pushed_predicate, {s.name: c for s, c in node.assignments}
+    )
+
+
+def extract_predicate_triples(expr, sym_to_col: dict) -> list:
+    """Pushed-down predicate -> [(column, op, literal-value)] conjunct
+    triples a connector can prune splits/partitions with (reference role:
+    TupleDomain extraction in HivePartitionManager).  Conjuncts that don't
+    fit the shape are simply omitted — they still filter on device."""
+    from trino_tpu.expr.ir import Call, Form, Literal, SpecialForm, SymbolRef, InputRef
+
+    def colname(e):
+        if isinstance(e, SymbolRef):
+            return sym_to_col.get(e.name)
+        return None
+
+    def litval(e):
+        if isinstance(e, Literal) and e.value is not None:
+            return e.value
+        return None
+
+    ops = {"$eq": "=", "$lt": "<", "$le": "<=", "$gt": ">", "$ge": ">="}
+    flipped = {"=": "=", "<": ">", "<=": ">=", ">": "<", ">=": "<="}
+    out = []
+
+    def walk(e):
+        if isinstance(e, SpecialForm) and e.form == Form.AND:
+            for a in e.args:
+                walk(a)
+            return
+        if isinstance(e, SpecialForm) and e.form == Form.BETWEEN:
+            c = colname(e.args[0])
+            lo, hi = litval(e.args[1]), litval(e.args[2])
+            if c is not None and lo is not None:
+                out.append((c, ">=", lo))
+            if c is not None and hi is not None:
+                out.append((c, "<=", hi))
+            return
+        if isinstance(e, SpecialForm) and e.form == Form.IN:
+            c = colname(e.args[0])
+            vals = [litval(a) for a in e.args[1:]]
+            if c is not None and all(v is not None for v in vals):
+                out.append((c, "in", tuple(vals)))
+            return
+        if isinstance(e, Call) and e.name in ops and len(e.args) == 2:
+            l, r = e.args
+            c, v = colname(l), litval(r)
+            if c is not None and v is not None:
+                out.append((c, ops[e.name], v))
+                return
+            c, v = colname(r), litval(l)
+            if c is not None and v is not None:
+                out.append((c, flipped[ops[e.name]], v))
+
+    walk(expr)
+    return out
+
+
 class CatalogManager:
     """catalog name -> Connector (reference: connector/StaticCatalogManager.java)."""
 

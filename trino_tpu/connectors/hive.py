@@ -1,0 +1,421 @@
+"""Hive-style partitioned file connector (parquet + ORC).
+
+Reference roles: plugin/trino-hive (HiveMetadata / HivePartitionManager
+partition pruning, BackgroundHiveSplitLoader's directory walk,
+ParquetPageSourceFactory + OrcPageSourceFactory) and lib/trino-orc's reader
+role — the host decode is pyarrow (parquet row groups, ORC stripes), the
+metastore is the directory layout itself:
+
+    root/<schema>/<table>/<pcol>=<val>/.../part-*.parquet|.orc
+
+Partition columns live in directory names (values typed by inference:
+int-looking -> bigint, date-looking -> date, else varchar).  Split
+enumeration prunes partitions against pushed-down predicate conjuncts
+(HivePartitionManager.getPartitions analog) BEFORE any file IO, then splits
+per parquet row group / per ORC stripe group.  Partition values surface as
+constant columns welded onto each page (HivePageSource's prefilled blocks).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import re
+from typing import Optional, Sequence
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.columnar import StringDictionary
+from trino_tpu.connectors.api import (
+    ColumnData,
+    ColumnMeta,
+    Connector,
+    ConnectorMetadata,
+    PageSource,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+from trino_tpu.connectors.parquet import _array_to_column_data, _arrow_to_type
+
+_DATA_EXT = (".parquet", ".orc")
+_INT_RE = re.compile(r"^-?\d+$")
+_DATE_RE = re.compile(r"^\d{4}-\d{2}-\d{2}$")
+
+
+def _infer_partition_type(values: Sequence[str]) -> T.Type:
+    if all(_INT_RE.match(v) for v in values):
+        return T.BIGINT
+    if all(_DATE_RE.match(v) for v in values):
+        return T.DATE
+    return T.VARCHAR
+
+
+def _partition_value(raw: str, t: T.Type):
+    """Directory-name string -> logical python value."""
+    if t is T.BIGINT:
+        return int(raw)
+    if t is T.DATE:
+        y, m, d = (int(x) for x in raw.split("-"))
+        return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+    return raw
+
+
+class _HiveMetadata(ConnectorMetadata):
+    def __init__(self, conn: "HiveConnector"):
+        self.conn = conn
+
+    def list_schemas(self) -> Sequence[str]:
+        root = self.conn.root
+        if not os.path.isdir(root):
+            return []
+        return sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+
+    def list_tables(self, schema: str) -> Sequence[str]:
+        base = os.path.join(self.conn.root, schema)
+        if not os.path.isdir(base):
+            return []
+        return sorted(
+            d for d in os.listdir(base) if os.path.isdir(os.path.join(base, d))
+        )
+
+    def table_metadata(self, schema: str, table: str) -> TableMetadata:
+        parts = self.conn._partitions(schema, table)
+        if not parts:
+            raise KeyError(f"hive table not found or empty: {schema}.{table}")
+        pcols = parts[0].keys_in_order
+        sample = parts[0].files[0]
+        file_cols = self.conn._file_schema(sample)
+        ptypes = {}
+        for k in pcols:
+            ptypes[k] = _infer_partition_type(
+                [p.values[k] for p in parts]
+            )
+        cols = tuple(
+            list(file_cols)
+            + [ColumnMeta(k, ptypes[k]) for k in pcols]
+        )
+        return TableMetadata(schema, table, cols)
+
+    def table_statistics(self, schema: str, table: str) -> TableStatistics:
+        rows = 0
+        for p in self.conn._partitions(schema, table):
+            for f in p.files:
+                rows += _file_rows(f)
+        return TableStatistics(row_count=rows)
+
+
+class _Partition:
+    __slots__ = ("keys_in_order", "values", "files")
+
+    def __init__(self, keys_in_order, values, files):
+        self.keys_in_order = keys_in_order
+        self.values = values  # {pcol: raw string}
+        self.files = files
+
+
+def _file_rows(path: str) -> int:
+    if path.endswith(".parquet"):
+        import pyarrow.parquet as pq
+
+        return pq.ParquetFile(path).metadata.num_rows
+    import pyarrow.orc as po
+
+    return po.ORCFile(path).nrows
+
+
+class _HivePageSource(PageSource):
+    def __init__(self, split: Split, columns, types, page_rows: int):
+        self.split = split
+        self.columns = list(columns)
+        self.types = list(types)
+        self.page_rows = page_rows
+
+    def row_count(self) -> int:
+        return self.split.row_count
+
+    def pages(self):
+        path, piece, pvals, ptypes = self.split.info
+        file_cols = [c for c in self.columns if c not in pvals]
+        if not file_cols:
+            # partition-columns-only projection: no file read at all, emit
+            # constant pages sized by the piece's row count (a zero-column
+            # arrow table cannot carry the count)
+            n = self.split.row_count
+            for start in range(0, max(n, 1), self.page_rows):
+                rows = min(self.page_rows, n - start)
+                if rows <= 0 and start > 0:
+                    break
+                yield [
+                    _constant_column(pvals[c], ptypes[c], max(rows, 0))
+                    for c in self.columns
+                ]
+            return
+        tbl = _read_piece(path, piece, file_cols)
+        n = tbl.num_rows
+        for start in range(0, max(n, 1), self.page_rows):
+            chunk = tbl.slice(start, self.page_rows)
+            if chunk.num_rows == 0 and start > 0:
+                break
+            out = []
+            for c, t in zip(self.columns, self.types):
+                if c in pvals:
+                    out.append(
+                        _constant_column(pvals[c], ptypes[c], chunk.num_rows)
+                    )
+                else:
+                    out.append(
+                        _array_to_column_data(
+                            chunk.column(file_cols.index(c)), t
+                        )
+                    )
+            yield out
+
+
+def _read_piece(path: str, piece, columns):
+    if path.endswith(".parquet"):
+        import pyarrow.parquet as pq
+
+        pf = pq.ParquetFile(path)
+        return pf.read_row_group(piece, columns=columns)
+    import pyarrow.orc as po
+
+    f = po.ORCFile(path)
+    return f.read_stripe(piece, columns=columns)
+
+
+def _constant_column(raw: str, t: T.Type, n: int) -> ColumnData:
+    """Partition value as a constant column (HivePageSource prefilled
+    blocks; RLE on device is just a broadcast)."""
+    v = _partition_value(raw, t)
+    if t is T.VARCHAR:
+        d = StringDictionary.from_unsorted([v])
+        return ColumnData(np.zeros(n, np.int32), None, d)
+    return ColumnData(np.full(n, v, dtype=t.np_dtype), None)
+
+
+class HiveConnector(Connector):
+    name = "hive"
+
+    def __init__(self, root: str):
+        self.root = root
+        self._metadata = _HiveMetadata(self)
+
+    def metadata(self) -> _HiveMetadata:
+        return self._metadata
+
+    # -- directory walk (BackgroundHiveSplitLoader role) ---------------------
+
+    def _file_schema(self, path: str):
+        if path.endswith(".parquet"):
+            import pyarrow.parquet as pq
+
+            schema = pq.read_schema(path)
+        else:
+            import pyarrow.orc as po
+
+            schema = po.ORCFile(path).schema
+        return [ColumnMeta(f.name, _arrow_to_type(f.type)) for f in schema]
+
+    def _partitions(self, schema: str, table: str) -> list:
+        base = os.path.join(self.root, schema, table)
+        if not os.path.isdir(base):
+            return []
+        out = []
+
+        def walk(d, keys, vals):
+            files = []
+            subdirs = []
+            for name in sorted(os.listdir(d)):
+                p = os.path.join(d, name)
+                if os.path.isfile(p) and name.endswith(_DATA_EXT):
+                    files.append(p)
+                elif os.path.isdir(p) and "=" in name:
+                    subdirs.append((name, p))
+            if files:
+                out.append(
+                    _Partition(tuple(keys), dict(zip(keys, vals)), files)
+                )
+            for name, p in subdirs:
+                k, _, v = name.partition("=")
+                walk(p, keys + [k], vals + [v])
+
+        walk(base, [], [])
+        return out
+
+    def scan_version(self, handle: TableHandle):
+        try:
+            sig = []
+            for p in self._partitions(handle.schema, handle.table):
+                for f in p.files:
+                    sig.append((f, int(os.path.getmtime(f)), os.path.getsize(f)))
+            return tuple(sig)
+        except OSError:
+            return None
+
+    # -- partition pruning (HivePartitionManager.getPartitions) --------------
+
+    def _prune(self, partitions: list, predicate, ptypes: dict) -> list:
+        """`predicate` is a list of (column, op, value) conjunct triples the
+        engine extracted from the pushed-down predicate; conjuncts on
+        non-partition columns are ignored (they filter on device later)."""
+        if not predicate:
+            return partitions
+        kept = []
+        for part in partitions:
+            ok = True
+            for col, op, val in predicate:
+                if col not in part.values:
+                    continue
+                pv = _partition_value(part.values[col], ptypes[col])
+                if op == "=":
+                    ok = pv == val
+                elif op == "in":
+                    ok = pv in val
+                elif op == "<":
+                    ok = pv < val
+                elif op == "<=":
+                    ok = pv <= val
+                elif op == ">":
+                    ok = pv > val
+                elif op == ">=":
+                    ok = pv >= val
+                if not ok:
+                    break
+            if ok:
+                kept.append(part)
+        return kept
+
+    def splits(self, handle: TableHandle, target_splits: int, predicate=None):
+        parts = self._partitions(handle.schema, handle.table)
+        if not parts:
+            return []
+        meta = self._metadata.table_metadata(handle.schema, handle.table)
+        tmap = {c.name: c.type for c in meta.columns}
+        ptypes = {k: tmap[k] for k in parts[0].keys_in_order}
+        keep = {
+            id(p) for p in self._prune(parts, predicate, ptypes)
+        }
+        out = []
+        seq = 0
+        row_start = 0
+        # seq numbers come from the UNPRUNED enumeration so a split's
+        # identity (and therefore its buffer-pool cache key) is stable no
+        # matter which predicate selected it
+        for part in parts:
+            for path in part.files:
+                for piece, nrows in _pieces(path):
+                    if id(part) in keep:
+                        out.append(
+                            Split(
+                                handle,
+                                seq,
+                                row_start=row_start,
+                                row_count=nrows,
+                                info=(path, piece, part.values, ptypes),
+                            )
+                        )
+                    seq += 1
+                    row_start += nrows
+        return out
+
+    def page_source(
+        self, split: Split, columns: Sequence[str], max_rows_per_page: int = 1 << 20
+    ) -> PageSource:
+        meta = self._metadata.table_metadata(
+            split.table.schema, split.table.table
+        )
+        tmap = {c.name: c.type for c in meta.columns}
+        types = [tmap[c] for c in columns]
+        return _HivePageSource(split, columns, types, max_rows_per_page)
+
+
+def _pieces(path: str):
+    """(piece_index, rows) per split unit: parquet row group / ORC stripe."""
+    if path.endswith(".parquet"):
+        import pyarrow.parquet as pq
+
+        meta = pq.ParquetFile(path).metadata
+        return [
+            (rg, meta.row_group(rg).num_rows)
+            for rg in range(meta.num_row_groups)
+        ]
+    import pyarrow.orc as po
+
+    f = po.ORCFile(path)
+    return [(i, f.read_stripe(i).num_rows) for i in range(f.nstripes)]
+
+
+# -- partitioned export helper (writer role of plugin/trino-hive) ------------
+
+
+def write_partitioned(
+    connector: Connector,
+    schema: str,
+    table: str,
+    out_root: str,
+    partition_by: Sequence[str],
+    fmt: str = "parquet",
+    row_group_rows: int = 1 << 20,
+) -> int:
+    """Export a connector table into hive layout, partitioned by
+    `partition_by` columns.  Returns partition count."""
+    import pyarrow as pa
+
+    from trino_tpu.connectors.parquet import _column_data_to_arrow
+
+    meta = connector.metadata().table_metadata(schema, table)
+    handle = TableHandle("src", schema, table)
+    names = [c.name for c in meta.columns]
+    tmap = {c.name: c.type for c in meta.columns}
+    chunks = []
+    for split in connector.splits(handle, target_splits=1):
+        src = connector.page_source(split, names, max_rows_per_page=row_group_rows)
+        for page in src.pages():
+            arrays = {
+                n: _column_data_to_arrow(cd, tmap[n])
+                for n, cd in zip(names, page)
+            }
+            chunks.append(pa.table(arrays))
+    tbl = pa.concat_tables(chunks)
+    data_cols = [n for n in names if n not in partition_by]
+    # group by partition values host-side
+    import pyarrow.compute as pc
+
+    keys = tbl.select(list(partition_by))
+    combos = keys.group_by(list(partition_by)).aggregate([])
+    nparts = 0
+    for row in combos.to_pylist():
+        mask = None
+        for k, v in row.items():
+            m = pc.equal(tbl.column(k), pa.scalar(v, tbl.column(k).type))
+            mask = m if mask is None else pc.and_(mask, m)
+        sub = tbl.filter(mask).select(data_cols)
+        d = os.path.join(
+            out_root, schema, table,
+            *[f"{k}={_render(v)}" for k, v in row.items()],
+        )
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"part-0.{fmt}")
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            pq.write_table(sub, path, row_group_size=row_group_rows)
+        elif fmt == "orc":
+            import pyarrow.orc as po
+
+            po.write_table(sub, path)
+        else:
+            raise ValueError(f"unsupported format {fmt}")
+        nparts += 1
+    return nparts
+
+
+def _render(v) -> str:
+    if isinstance(v, datetime.date):
+        return v.isoformat()
+    return str(v)

@@ -214,3 +214,21 @@ class MemoryConnector(Connector):
     def restore(self, snap) -> None:
         self.store.clear()
         self.store.update(snap)
+
+    def snapshot_table(self, schema: str, table: str):
+        """Table-granular snapshot (lazy transaction isolation: rollback
+        touches only written tables)."""
+        from trino_tpu.runtime.transactions import MISSING
+
+        st = self.store.get((schema, table))
+        if st is None:
+            return MISSING
+        return _Stored(st.meta, list(st.columns))
+
+    def restore_table(self, schema: str, table: str, snap) -> None:
+        from trino_tpu.runtime.transactions import MISSING
+
+        if snap is MISSING:
+            self.store.pop((schema, table), None)
+        else:
+            self.store[(schema, table)] = snap

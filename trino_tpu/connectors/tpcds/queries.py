@@ -639,14 +639,14 @@ SELECT
 , s_county
 , s_state
 , s_zip
-, sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) <= 30) THEN 1 ELSE 0 END)) 30 days
+, sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) <= 30) THEN 1 ELSE 0 END)) "30 days"
 , sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) > 30)
-   AND ((sr_returned_date_sk - ss_sold_date_sk) <= 60) THEN 1 ELSE 0 END)) 31-60 days
+   AND ((sr_returned_date_sk - ss_sold_date_sk) <= 60) THEN 1 ELSE 0 END)) "31-60 days"
 , sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) > 60)
-   AND ((sr_returned_date_sk - ss_sold_date_sk) <= 90) THEN 1 ELSE 0 END)) 61-90 days
+   AND ((sr_returned_date_sk - ss_sold_date_sk) <= 90) THEN 1 ELSE 0 END)) "61-90 days"
 , sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) > 90)
-   AND ((sr_returned_date_sk - ss_sold_date_sk) <= 120) THEN 1 ELSE 0 END)) 91-120 days
-, sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) > 120) THEN 1 ELSE 0 END)) >120 days
+   AND ((sr_returned_date_sk - ss_sold_date_sk) <= 120) THEN 1 ELSE 0 END)) "91-120 days"
+, sum((CASE WHEN ((sr_returned_date_sk - ss_sold_date_sk) > 120) THEN 1 ELSE 0 END)) ">120 days"
 FROM
   store_sales
 , store_returns
@@ -775,14 +775,14 @@ SELECT
   substr(w_warehouse_name, 1, 20)
 , sm_type
 , web_name
-, sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) <= 30) THEN 1 ELSE 0 END)) 30 days
+, sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) <= 30) THEN 1 ELSE 0 END)) "30 days"
 , sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) > 30)
-   AND ((ws_ship_date_sk - ws_sold_date_sk) <= 60) THEN 1 ELSE 0 END)) 31-60 days
+   AND ((ws_ship_date_sk - ws_sold_date_sk) <= 60) THEN 1 ELSE 0 END)) "31-60 days"
 , sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) > 60)
-   AND ((ws_ship_date_sk - ws_sold_date_sk) <= 90) THEN 1 ELSE 0 END)) 61-90 days
+   AND ((ws_ship_date_sk - ws_sold_date_sk) <= 90) THEN 1 ELSE 0 END)) "61-90 days"
 , sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) > 90)
-   AND ((ws_ship_date_sk - ws_sold_date_sk) <= 120) THEN 1 ELSE 0 END)) 91-120 days
-, sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) > 120) THEN 1 ELSE 0 END)) >120 days
+   AND ((ws_ship_date_sk - ws_sold_date_sk) <= 120) THEN 1 ELSE 0 END)) "91-120 days"
+, sum((CASE WHEN ((ws_ship_date_sk - ws_sold_date_sk) > 120) THEN 1 ELSE 0 END)) ">120 days"
 FROM
   web_sales
 , warehouse
@@ -971,28 +971,6 @@ WHERE (i_current_price BETWEEN 62 AND (62 + 30))
    AND (ss_item_sk = i_item_sk)
 GROUP BY i_item_id, i_item_desc, i_current_price
 ORDER BY i_item_id ASC
-LIMIT 100
-""",
-    84: """
-SELECT
-  c_customer_id customer_id
-, concat(concat(c_last_name, ', '), c_first_name) customername
-FROM
-  customer
-, customer_address
-, customer_demographics
-, household_demographics
-, income_band
-, store_returns
-WHERE (ca_city = 'Edgewood')
-   AND (c_current_addr_sk = ca_address_sk)
-   AND (ib_lower_bound >= 38128)
-   AND (ib_upper_bound <= (38128 + 50000))
-   AND (ib_income_band_sk = hd_income_band_sk)
-   AND (cd_demo_sk = c_current_cdemo_sk)
-   AND (hd_demo_sk = c_current_hdemo_sk)
-   AND (sr_cdemo_sk = cd_demo_sk)
-ORDER BY c_customer_id ASC
 LIMIT 100
 """,
     88: """
@@ -1304,14 +1282,14 @@ SELECT
   substr(w_warehouse_name, 1, 20)
 , sm_type
 , cc_name
-, sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) <= 30) THEN 1 ELSE 0 END)) 30 days
+, sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) <= 30) THEN 1 ELSE 0 END)) "30 days"
 , sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) > 30)
-   AND ((cs_ship_date_sk - cs_sold_date_sk) <= 60) THEN 1 ELSE 0 END)) 31-60 days
+   AND ((cs_ship_date_sk - cs_sold_date_sk) <= 60) THEN 1 ELSE 0 END)) "31-60 days"
 , sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) > 60)
-   AND ((cs_ship_date_sk - cs_sold_date_sk) <= 90) THEN 1 ELSE 0 END)) 61-90 days
+   AND ((cs_ship_date_sk - cs_sold_date_sk) <= 90) THEN 1 ELSE 0 END)) "61-90 days"
 , sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) > 90)
-   AND ((cs_ship_date_sk - cs_sold_date_sk) <= 120) THEN 1 ELSE 0 END)) 91-120 days
-, sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) > 120) THEN 1 ELSE 0 END)) >120 days
+   AND ((cs_ship_date_sk - cs_sold_date_sk) <= 120) THEN 1 ELSE 0 END)) "91-120 days"
+, sum((CASE WHEN ((cs_ship_date_sk - cs_sold_date_sk) > 120) THEN 1 ELSE 0 END)) ">120 days"
 FROM
   catalog_sales
 , warehouse
@@ -1328,4 +1306,549 @@ ORDER BY substr(w_warehouse_name, 1, 20) ASC, sm_type ASC, cc_name ASC
 LIMIT 100
 """,
 
+    2: """
+with wscs as (
+    select ws_sold_date_sk as sold_date_sk, ws_ext_sales_price as sales_price
+    from web_sales
+    union all
+    select cs_sold_date_sk as sold_date_sk, cs_ext_sales_price as sales_price
+    from catalog_sales
+),
+wswscs as (
+    select d_week_seq,
+           sum(case when d_day_name = 'Sunday' then sales_price else null end) sun_sales,
+           sum(case when d_day_name = 'Monday' then sales_price else null end) mon_sales,
+           sum(case when d_day_name = 'Tuesday' then sales_price else null end) tue_sales,
+           sum(case when d_day_name = 'Wednesday' then sales_price else null end) wed_sales,
+           sum(case when d_day_name = 'Thursday' then sales_price else null end) thu_sales,
+           sum(case when d_day_name = 'Friday' then sales_price else null end) fri_sales,
+           sum(case when d_day_name = 'Saturday' then sales_price else null end) sat_sales
+    from wscs, date_dim
+    where d_date_sk = sold_date_sk
+    group by d_week_seq
+)
+select d_week_seq1,
+       round(sun_sales1 / sun_sales2, 2),
+       round(mon_sales1 / mon_sales2, 2),
+       round(tue_sales1 / tue_sales2, 2),
+       round(wed_sales1 / wed_sales2, 2),
+       round(thu_sales1 / thu_sales2, 2),
+       round(fri_sales1 / fri_sales2, 2),
+       round(sat_sales1 / sat_sales2, 2)
+from (select wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
+             mon_sales mon_sales1, tue_sales tue_sales1, wed_sales wed_sales1,
+             thu_sales thu_sales1, fri_sales fri_sales1, sat_sales sat_sales1
+      from wswscs, date_dim
+      where date_dim.d_week_seq = wswscs.d_week_seq and d_year = 2001) y,
+     (select wswscs.d_week_seq d_week_seq2, sun_sales sun_sales2,
+             mon_sales mon_sales2, tue_sales tue_sales2, wed_sales wed_sales2,
+             thu_sales thu_sales2, fri_sales fri_sales2, sat_sales sat_sales2
+      from wswscs, date_dim
+      where date_dim.d_week_seq = wswscs.d_week_seq and d_year = 2002) z
+where d_week_seq1 = d_week_seq2 - 53
+order by d_week_seq1
+""",
+    4: """
+WITH
+  year_total AS (
+   SELECT
+     c_customer_id customer_id
+   , c_first_name customer_first_name
+   , c_last_name customer_last_name
+   , c_preferred_cust_flag customer_preferred_cust_flag
+   , c_birth_country customer_birth_country
+   , c_login customer_login
+   , c_email_address customer_email_address
+   , d_year dyear
+   , sum(((((ss_ext_list_price - ss_ext_wholesale_cost) - ss_ext_discount_amt) + ss_ext_sales_price) / 2)) year_total
+   , 's' sale_type
+   FROM
+     customer
+   , store_sales
+   , date_dim
+   WHERE (c_customer_sk = ss_customer_sk)
+      AND (ss_sold_date_sk = d_date_sk)
+   GROUP BY c_customer_id, c_first_name, c_last_name, c_preferred_cust_flag, c_birth_country, c_login, c_email_address, d_year
+UNION ALL    SELECT
+     c_customer_id customer_id
+   , c_first_name customer_first_name
+   , c_last_name customer_last_name
+   , c_preferred_cust_flag customer_preferred_cust_flag
+   , c_birth_country customer_birth_country
+   , c_login customer_login
+   , c_email_address customer_email_address
+   , d_year dyear
+   , sum(((((cs_ext_list_price - cs_ext_wholesale_cost) - cs_ext_discount_amt) + cs_ext_sales_price) / 2)) year_total
+   , 'c' sale_type
+   FROM
+     customer
+   , catalog_sales
+   , date_dim
+   WHERE (c_customer_sk = cs_bill_customer_sk)
+      AND (cs_sold_date_sk = d_date_sk)
+   GROUP BY c_customer_id, c_first_name, c_last_name, c_preferred_cust_flag, c_birth_country, c_login, c_email_address, d_year
+UNION ALL    SELECT
+     c_customer_id customer_id
+   , c_first_name customer_first_name
+   , c_last_name customer_last_name
+   , c_preferred_cust_flag customer_preferred_cust_flag
+   , c_birth_country customer_birth_country
+   , c_login customer_login
+   , c_email_address customer_email_address
+   , d_year dyear
+   , sum(((((ws_ext_list_price - ws_ext_wholesale_cost) - ws_ext_discount_amt) + ws_ext_sales_price) / 2)) year_total
+   , 'w' sale_type
+   FROM
+     customer
+   , web_sales
+   , date_dim
+   WHERE (c_customer_sk = ws_bill_customer_sk)
+      AND (ws_sold_date_sk = d_date_sk)
+   GROUP BY c_customer_id, c_first_name, c_last_name, c_preferred_cust_flag, c_birth_country, c_login, c_email_address, d_year
+) 
+SELECT
+  t_s_secyear.customer_id
+, t_s_secyear.customer_first_name
+, t_s_secyear.customer_last_name
+, t_s_secyear.customer_preferred_cust_flag
+FROM
+  year_total t_s_firstyear
+, year_total t_s_secyear
+, year_total t_c_firstyear
+, year_total t_c_secyear
+, year_total t_w_firstyear
+, year_total t_w_secyear
+WHERE (t_s_secyear.customer_id = t_s_firstyear.customer_id)
+   AND (t_s_firstyear.customer_id = t_c_secyear.customer_id)
+   AND (t_s_firstyear.customer_id = t_c_firstyear.customer_id)
+   AND (t_s_firstyear.customer_id = t_w_firstyear.customer_id)
+   AND (t_s_firstyear.customer_id = t_w_secyear.customer_id)
+   AND (t_s_firstyear.sale_type = 's')
+   AND (t_c_firstyear.sale_type = 'c')
+   AND (t_w_firstyear.sale_type = 'w')
+   AND (t_s_secyear.sale_type = 's')
+   AND (t_c_secyear.sale_type = 'c')
+   AND (t_w_secyear.sale_type = 'w')
+   AND (t_s_firstyear.dyear = 2001)
+   AND (t_s_secyear.dyear = (2001 + 1))
+   AND (t_c_firstyear.dyear = 2001)
+   AND (t_c_secyear.dyear = (2001 + 1))
+   AND (t_w_firstyear.dyear = 2001)
+   AND (t_w_secyear.dyear = (2001 + 1))
+   AND (t_s_firstyear.year_total > 0)
+   AND (t_c_firstyear.year_total > 0)
+   AND (t_w_firstyear.year_total > 0)
+   AND ((CASE WHEN (t_c_firstyear.year_total > 0) THEN (t_c_secyear.year_total / t_c_firstyear.year_total) ELSE null END) > (CASE WHEN (t_s_firstyear.year_total > 0) THEN (t_s_secyear.year_total / t_s_firstyear.year_total) ELSE null END))
+   AND ((CASE WHEN (t_c_firstyear.year_total > 0) THEN (t_c_secyear.year_total / t_c_firstyear.year_total) ELSE null END) > (CASE WHEN (t_w_firstyear.year_total > 0) THEN (t_w_secyear.year_total / t_w_firstyear.year_total) ELSE null END))
+ORDER BY t_s_secyear.customer_id ASC, t_s_secyear.customer_first_name ASC, t_s_secyear.customer_last_name ASC, t_s_secyear.customer_preferred_cust_flag ASC
+LIMIT 100
+""",
+    9: """
+SELECT
+  (CASE WHEN ((
+      SELECT count(*)
+      FROM
+        store_sales
+      WHERE (ss_quantity BETWEEN 1 AND 20)
+   ) > 74129) THEN (
+   SELECT avg(ss_ext_discount_amt)
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 1 AND 20)
+) ELSE (
+   SELECT avg(ss_net_paid)
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 1 AND 20)
+) END) bucket1
+, (CASE WHEN ((
+      SELECT count(*)
+      FROM
+        store_sales
+      WHERE (ss_quantity BETWEEN 21 AND 40)
+   ) > 122840) THEN (
+   SELECT avg(ss_ext_discount_amt)
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 21 AND 40)
+) ELSE (
+   SELECT avg(ss_net_paid)
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 21 AND 40)
+) END) bucket2
+, (CASE WHEN ((
+      SELECT count(*)
+      FROM
+        store_sales
+      WHERE (ss_quantity BETWEEN 41 AND 60)
+   ) > 56580) THEN (
+   SELECT avg(ss_ext_discount_amt)
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 41 AND 60)
+) ELSE (
+   SELECT avg(ss_net_paid)
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 41 AND 60)
+) END) bucket3
+, (CASE WHEN ((
+      SELECT count(*)
+      FROM
+        store_sales
+      WHERE (ss_quantity BETWEEN 61 AND 80)
+   ) > 10097) THEN (
+   SELECT avg(ss_ext_discount_amt)
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 61 AND 80)
+) ELSE (
+   SELECT avg(ss_net_paid)
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 61 AND 80)
+) END) bucket4
+, (CASE WHEN ((
+      SELECT count(*)
+      FROM
+        store_sales
+      WHERE (ss_quantity BETWEEN 81 AND 100)
+   ) > 165306) THEN (
+   SELECT avg(ss_ext_discount_amt)
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 81 AND 100)
+) ELSE (
+   SELECT avg(ss_net_paid)
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 81 AND 100)
+) END) bucket5
+FROM
+  reason
+WHERE (r_reason_sk = 1)
+""",
+    11: """
+WITH
+  year_total AS (
+   SELECT
+     c_customer_id customer_id
+   , c_first_name customer_first_name
+   , c_last_name customer_last_name
+   , c_preferred_cust_flag customer_preferred_cust_flag
+   , c_birth_country customer_birth_country
+   , c_login customer_login
+   , c_email_address customer_email_address
+   , d_year dyear
+   , sum((ss_ext_list_price - ss_ext_discount_amt)) year_total
+   , 's' sale_type
+   FROM
+     customer
+   , store_sales
+   , date_dim
+   WHERE (c_customer_sk = ss_customer_sk)
+      AND (ss_sold_date_sk = d_date_sk)
+   GROUP BY c_customer_id, c_first_name, c_last_name, c_preferred_cust_flag, c_birth_country, c_login, c_email_address, d_year
+UNION ALL    SELECT
+     c_customer_id customer_id
+   , c_first_name customer_first_name
+   , c_last_name customer_last_name
+   , c_preferred_cust_flag customer_preferred_cust_flag
+   , c_birth_country customer_birth_country
+   , c_login customer_login
+   , c_email_address customer_email_address
+   , d_year dyear
+   , sum((ws_ext_list_price - ws_ext_discount_amt)) year_total
+   , 'w' sale_type
+   FROM
+     customer
+   , web_sales
+   , date_dim
+   WHERE (c_customer_sk = ws_bill_customer_sk)
+      AND (ws_sold_date_sk = d_date_sk)
+   GROUP BY c_customer_id, c_first_name, c_last_name, c_preferred_cust_flag, c_birth_country, c_login, c_email_address, d_year
+) 
+SELECT
+  t_s_secyear.customer_id
+, t_s_secyear.customer_first_name
+, t_s_secyear.customer_last_name
+, t_s_secyear.customer_preferred_cust_flag
+, t_s_secyear.customer_birth_country
+, t_s_secyear.customer_login
+FROM
+  year_total t_s_firstyear
+, year_total t_s_secyear
+, year_total t_w_firstyear
+, year_total t_w_secyear
+WHERE (t_s_secyear.customer_id = t_s_firstyear.customer_id)
+   AND (t_s_firstyear.customer_id = t_w_secyear.customer_id)
+   AND (t_s_firstyear.customer_id = t_w_firstyear.customer_id)
+   AND (t_s_firstyear.sale_type = 's')
+   AND (t_w_firstyear.sale_type = 'w')
+   AND (t_s_secyear.sale_type = 's')
+   AND (t_w_secyear.sale_type = 'w')
+   AND (t_s_firstyear.dyear = 2001)
+   AND (t_s_secyear.dyear = (2001 + 1))
+   AND (t_w_firstyear.dyear = 2001)
+   AND (t_w_secyear.dyear = (2001 + 1))
+   AND (t_s_firstyear.year_total > 0)
+   AND (t_w_firstyear.year_total > 0)
+   AND ((CASE WHEN (t_w_firstyear.year_total > 0) THEN (t_w_secyear.year_total / t_w_firstyear.year_total) ELSE DECIMAL '0.0' END) > (CASE WHEN (t_s_firstyear.year_total > 0) THEN (t_s_secyear.year_total / t_s_firstyear.year_total) ELSE DECIMAL '0.0' END))
+ORDER BY t_s_secyear.customer_id ASC, t_s_secyear.customer_first_name ASC, t_s_secyear.customer_last_name ASC, t_s_secyear.customer_preferred_cust_flag ASC
+LIMIT 100
+""",
+    17: """
+SELECT
+  i_item_id
+, i_item_desc
+, s_state
+, count(ss_quantity) store_sales_quantitycount
+, avg(ss_quantity) store_sales_quantityave
+, stddev_samp(ss_quantity) store_sales_quantitystdev
+, (stddev_samp(ss_quantity) / avg(ss_quantity)) store_sales_quantitycov
+, count(sr_return_quantity) store_returns_quantitycount
+, avg(sr_return_quantity) store_returns_quantityave
+, stddev_samp(sr_return_quantity) store_returns_quantitystdev
+, (stddev_samp(sr_return_quantity) / avg(sr_return_quantity)) store_returns_quantitycov
+, count(cs_quantity) catalog_sales_quantitycount
+, avg(cs_quantity) catalog_sales_quantityave
+, stddev_samp(cs_quantity) catalog_sales_quantitystdev
+, (stddev_samp(cs_quantity) / avg(cs_quantity)) catalog_sales_quantitycov
+FROM
+  store_sales
+, store_returns
+, catalog_sales
+, date_dim d1
+, date_dim d2
+, date_dim d3
+, store
+, item
+WHERE (d1.d_quarter_name = '2001Q1')
+   AND (d1.d_date_sk = ss_sold_date_sk)
+   AND (i_item_sk = ss_item_sk)
+   AND (s_store_sk = ss_store_sk)
+   AND (ss_customer_sk = sr_customer_sk)
+   AND (ss_item_sk = sr_item_sk)
+   AND (ss_ticket_number = sr_ticket_number)
+   AND (sr_returned_date_sk = d2.d_date_sk)
+   AND (d2.d_quarter_name IN ('2001Q1', '2001Q2', '2001Q3'))
+   AND (sr_customer_sk = cs_bill_customer_sk)
+   AND (sr_item_sk = cs_item_sk)
+   AND (cs_sold_date_sk = d3.d_date_sk)
+   AND (d3.d_quarter_name IN ('2001Q1', '2001Q2', '2001Q3'))
+GROUP BY i_item_id, i_item_desc, s_state
+ORDER BY i_item_id ASC, i_item_desc ASC, s_state ASC
+LIMIT 100
+""",
+    23: """
+WITH
+  frequent_ss_items AS (
+   SELECT
+     substr(i_item_desc, 1, 30) itemdesc
+   , i_item_sk item_sk
+   , d_date solddate
+   , count(*) cnt
+   FROM
+     store_sales
+   , date_dim
+   , item
+   WHERE (ss_sold_date_sk = d_date_sk)
+      AND (ss_item_sk = i_item_sk)
+      AND (d_year IN (2000   , (2000 + 1)   , (2000 + 2)   , (2000 + 3)))
+   GROUP BY substr(i_item_desc, 1, 30), i_item_sk, d_date
+   HAVING (count(*) > 4)
+) 
+, max_store_sales AS (
+   SELECT max(csales) tpcds_cmax
+   FROM
+     (
+      SELECT
+        c_customer_sk
+      , sum((ss_quantity * ss_sales_price)) csales
+      FROM
+        store_sales
+      , customer
+      , date_dim
+      WHERE (ss_customer_sk = c_customer_sk)
+         AND (ss_sold_date_sk = d_date_sk)
+         AND (d_year IN (2000      , (2000 + 1)      , (2000 + 2)      , (2000 + 3)))
+      GROUP BY c_customer_sk
+   ) 
+) 
+, best_ss_customer AS (
+   SELECT
+     c_customer_sk
+   , sum((ss_quantity * ss_sales_price)) ssales
+   FROM
+     store_sales
+   , customer
+   WHERE (ss_customer_sk = c_customer_sk)
+   GROUP BY c_customer_sk
+   HAVING (sum((ss_quantity * ss_sales_price)) > ((50 / DECIMAL '100.0') * (
+            SELECT *
+            FROM
+              max_store_sales
+         )))
+) 
+SELECT sum(sales)
+FROM
+  (
+   SELECT (cs_quantity * cs_list_price) sales
+   FROM
+     catalog_sales
+   , date_dim
+   WHERE (d_year = 2000)
+      AND (d_moy = 2)
+      AND (cs_sold_date_sk = d_date_sk)
+      AND (cs_item_sk IN (
+      SELECT item_sk
+      FROM
+        frequent_ss_items
+   ))
+      AND (cs_bill_customer_sk IN (
+      SELECT c_customer_sk
+      FROM
+        best_ss_customer
+   ))
+UNION ALL    SELECT (ws_quantity * ws_list_price) sales
+   FROM
+     web_sales
+   , date_dim
+   WHERE (d_year = 2000)
+      AND (d_moy = 2)
+      AND (ws_sold_date_sk = d_date_sk)
+      AND (ws_item_sk IN (
+      SELECT item_sk
+      FROM
+        frequent_ss_items
+   ))
+      AND (ws_bill_customer_sk IN (
+      SELECT c_customer_sk
+      FROM
+        best_ss_customer
+   ))
+) 
+LIMIT 100
+""",
+    28: """
+SELECT *
+FROM
+  (
+   SELECT
+     avg(ss_list_price) b1_lp
+   , count(ss_list_price) b1_cnt
+   , count(DISTINCT ss_list_price) b1_cntd
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 0 AND 5)
+      AND ((ss_list_price BETWEEN 8 AND (8 + 10))
+         OR (ss_coupon_amt BETWEEN 459 AND (459 + 1000))
+         OR (ss_wholesale_cost BETWEEN 57 AND (57 + 20)))
+)  b1
+, (
+   SELECT
+     avg(ss_list_price) b2_lp
+   , count(ss_list_price) b2_cnt
+   , count(DISTINCT ss_list_price) b2_cntd
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 6 AND 10)
+      AND ((ss_list_price BETWEEN 90 AND (90 + 10))
+         OR (ss_coupon_amt BETWEEN 2323 AND (2323 + 1000))
+         OR (ss_wholesale_cost BETWEEN 31 AND (31 + 20)))
+)  b2
+, (
+   SELECT
+     avg(ss_list_price) b3_lp
+   , count(ss_list_price) b3_cnt
+   , count(DISTINCT ss_list_price) b3_cntd
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 11 AND 15)
+      AND ((ss_list_price BETWEEN 142 AND (142 + 10))
+         OR (ss_coupon_amt BETWEEN 12214 AND (12214 + 1000))
+         OR (ss_wholesale_cost BETWEEN 79 AND (79 + 20)))
+)  b3
+, (
+   SELECT
+     avg(ss_list_price) b4_lp
+   , count(ss_list_price) b4_cnt
+   , count(DISTINCT ss_list_price) b4_cntd
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 16 AND 20)
+      AND ((ss_list_price BETWEEN 135 AND (135 + 10))
+         OR (ss_coupon_amt BETWEEN 6071 AND (6071 + 1000))
+         OR (ss_wholesale_cost BETWEEN 38 AND (38 + 20)))
+)  b4
+, (
+   SELECT
+     avg(ss_list_price) b5_lp
+   , count(ss_list_price) b5_cnt
+   , count(DISTINCT ss_list_price) b5_cntd
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 21 AND 25)
+      AND ((ss_list_price BETWEEN 122 AND (122 + 10))
+         OR (ss_coupon_amt BETWEEN 836 AND (836 + 1000))
+         OR (ss_wholesale_cost BETWEEN 17 AND (17 + 20)))
+)  b5
+, (
+   SELECT
+     avg(ss_list_price) b6_lp
+   , count(ss_list_price) b6_cnt
+   , count(DISTINCT ss_list_price) b6_cntd
+   FROM
+     store_sales
+   WHERE (ss_quantity BETWEEN 26 AND 30)
+      AND ((ss_list_price BETWEEN 154 AND (154 + 10))
+         OR (ss_coupon_amt BETWEEN 7326 AND (7326 + 1000))
+         OR (ss_wholesale_cost BETWEEN 7 AND (7 + 20)))
+)  b6
+LIMIT 100
+""",
+    38: """
+select count(*) from (
+    select distinct c_last_name, c_first_name, d_date
+    from store_sales, date_dim, customer
+    where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+      and store_sales.ss_customer_sk = customer.c_customer_sk
+      and d_month_seq between 1200 and 1200 + 11
+    intersect
+    select distinct c_last_name, c_first_name, d_date
+    from catalog_sales, date_dim, customer
+    where catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+      and catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+      and d_month_seq between 1200 and 1200 + 11
+    intersect
+    select distinct c_last_name, c_first_name, d_date
+    from web_sales, date_dim, customer
+    where web_sales.ws_sold_date_sk = date_dim.d_date_sk
+      and web_sales.ws_bill_customer_sk = customer.c_customer_sk
+      and d_month_seq between 1200 and 1200 + 11
+) hot_cust
+limit 100
+""",
+    87: """
+select count(*) from (
+    select distinct c_last_name, c_first_name, d_date
+    from store_sales, date_dim, customer
+    where store_sales.ss_sold_date_sk = date_dim.d_date_sk
+      and store_sales.ss_customer_sk = customer.c_customer_sk
+      and d_month_seq between 1200 and 1200 + 11
+    except
+    select distinct c_last_name, c_first_name, d_date
+    from catalog_sales, date_dim, customer
+    where catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+      and catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+      and d_month_seq between 1200 and 1200 + 11
+    except
+    select distinct c_last_name, c_first_name, d_date
+    from web_sales, date_dim, customer
+    where web_sales.ws_sold_date_sk = date_dim.d_date_sk
+      and web_sales.ws_bill_customer_sk = customer.c_customer_sk
+      and d_month_seq between 1200 and 1200 + 11
+) cool_cust
+""",
 }

@@ -164,11 +164,49 @@ def connect(url: Optional[str] = None, runner=None) -> Connection:
     return Connection(url, runner)
 
 
+def _split_placeholders(operation: str) -> list:
+    """Split on '?' placeholders, ignoring ones inside '...' string literals
+    (with '' escapes), "..." quoted identifiers, and -- or /* */ comments."""
+    parts, buf, quote = [], [], None
+    i, n = 0, len(operation)
+    while i < n:
+        ch = operation[i]
+        if quote is not None:
+            buf.append(ch)
+            if ch == quote:
+                if i + 1 < n and operation[i + 1] == quote:  # '' escape
+                    buf.append(operation[i + 1])
+                    i += 1
+                else:
+                    quote = None
+        elif ch == "-" and operation.startswith("--", i):
+            j = operation.find("\n", i)
+            j = n if j < 0 else j
+            buf.append(operation[i:j])
+            i = j - 1
+        elif ch == "/" and operation.startswith("/*", i):
+            j = operation.find("*/", i)
+            j = n if j < 0 else j + 2
+            buf.append(operation[i:j])
+            i = j - 1
+        elif ch in ("'", '"'):
+            quote = ch
+            buf.append(ch)
+        elif ch == "?":
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    parts.append("".join(buf))
+    return parts
+
+
 def _substitute(operation: str, parameters: Sequence) -> str:
     """qmark substitution with SQL literal quoting."""
     if not parameters:
         return operation
-    parts = operation.split("?")
+    parts = _split_placeholders(operation)
     if len(parts) - 1 != len(parameters):
         raise InterfaceError(
             f"statement has {len(parts) - 1} placeholders, "

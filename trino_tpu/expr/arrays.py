@@ -1,0 +1,417 @@
+"""Array and JSON scalar functions.
+
+Reference roles: core/trino-main/.../operator/scalar/ArrayFunctions +
+ArrayContains/ArrayPositionFunction/ArrayDistinctFunction/ArraySortFunction,
+scalar/SplitFunction.java, and the json path family (JsonExtract.java,
+operator/scalar/json/*).  Arrays are rectangular [capacity, K] device blocks
+with per-row lengths (see columnar/column.py); string work follows the
+engine's dictionary discipline — computed once per distinct dictionary value
+host-side, gathered on device by code.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.columnar import StringDictionary
+from trino_tpu.expr.compiler import Val, _and_valid
+from trino_tpu.expr.functions import (
+    FUNCTIONS,
+    _literal_str,
+    _require_dict,
+    register,
+)
+
+
+def _arr2d(ctx, v: Val):
+    """Broadcast an array Val to ([cap, K], lengths[cap])."""
+    if v.lengths is None:
+        raise NotImplementedError("expected an array value")
+    cap = ctx.capacity
+    k = v.data.shape[-1]
+    data = jnp.broadcast_to(jnp.asarray(v.data), (cap, k))
+    lens = jnp.broadcast_to(jnp.asarray(v.lengths, jnp.int32), (cap,))
+    return data, lens
+
+
+def _elem_mask(data, lens):
+    """bool [cap, K]: which padded slots hold real elements."""
+    k = data.shape[1]
+    return jnp.arange(k, dtype=jnp.int32)[None, :] < lens[:, None]
+
+
+@register("cardinality")
+def _cardinality(ctx, call, v):
+    if v.lengths is None:
+        raise NotImplementedError("cardinality of non-array value")
+    cap = ctx.capacity
+    lens = jnp.broadcast_to(jnp.asarray(v.lengths, jnp.int64), (cap,))
+    return Val(lens, v.valid, call.type)
+
+
+@register("element_at")
+def _element_at(ctx, call, arr, idx):
+    """element_at(array, i): 1-based, negative i counts from the end, NULL
+    out of range (reference: ElementAtFunction; unlike subscript, which the
+    reference makes throw)."""
+    data, lens = _arr2d(ctx, arr)
+    k = data.shape[1]
+    if k == 0:
+        return Val(jnp.zeros(ctx.capacity, call.type.np_dtype), False, call.type)
+    i = jnp.broadcast_to(jnp.asarray(idx.data, jnp.int64), (ctx.capacity,))
+    ln = lens.astype(jnp.int64)
+    eff = jnp.where(i < 0, ln + i + 1, i)  # -1 -> last element
+    in_range = jnp.logical_and(eff >= 1, eff <= ln)
+    pos = jnp.clip(eff - 1, 0, k - 1)
+    out = jnp.take_along_axis(data, pos[:, None], axis=1)[:, 0]
+    valid = _and_valid(_and_valid(arr.valid, idx.valid), in_range)
+    return Val(out, valid, call.type, arr.dictionary)
+
+
+@register("contains")
+def _contains(ctx, call, arr, needle):
+    data, lens = _arr2d(ctx, arr)
+    em = _elem_mask(data, lens)
+    if arr.dictionary is not None:
+        # resolve the needle against the array's dictionary host-side
+        s = _literal_str(needle, "contains")
+        code = arr.dictionary.index.get(s, -1)
+        hit = jnp.logical_and(em, data == code).any(axis=1)
+    else:
+        nv = jnp.asarray(needle.data)
+        hit = jnp.logical_and(em, data == nv[..., None]).any(axis=1)
+    valid = _and_valid(arr.valid, needle.valid)
+    return Val(hit, valid, call.type)
+
+
+@register("array_position")
+def _array_position(ctx, call, arr, needle):
+    data, lens = _arr2d(ctx, arr)
+    em = _elem_mask(data, lens)
+    if arr.dictionary is not None:
+        s = _literal_str(needle, "array_position")
+        code = arr.dictionary.index.get(s, -1)
+        eq = jnp.logical_and(em, data == code)
+    else:
+        eq = jnp.logical_and(em, data == jnp.asarray(needle.data)[..., None])
+    k = data.shape[1]
+    pos = jnp.arange(1, k + 1, dtype=jnp.int64)[None, :]
+    first = jnp.min(jnp.where(eq, pos, k + 1), axis=1)
+    out = jnp.where(first > k, 0, first)
+    valid = _and_valid(arr.valid, needle.valid)
+    return Val(out, valid, call.type)
+
+
+def _masked_reduce(data, lens, fill, red):
+    em = _elem_mask(data, lens)
+    out = red(jnp.where(em, data, fill), axis=1)
+    return out, lens > 0
+
+
+@register("array_max")
+def _array_max(ctx, call, arr):
+    data, lens = _arr2d(ctx, arr)
+    if arr.dictionary is not None:
+        out, nonempty = _masked_reduce(data, lens, -1, jnp.max)
+    elif np.issubdtype(np.dtype(data.dtype), np.floating):
+        out, nonempty = _masked_reduce(data, lens, -jnp.inf, jnp.max)
+    else:
+        out, nonempty = _masked_reduce(
+            data, lens, jnp.iinfo(data.dtype).min, jnp.max
+        )
+    valid = _and_valid(arr.valid, nonempty)
+    return Val(out, valid, call.type, arr.dictionary)
+
+
+@register("array_min")
+def _array_min(ctx, call, arr):
+    data, lens = _arr2d(ctx, arr)
+    if arr.dictionary is not None:
+        big = len(arr.dictionary.values)
+        out, nonempty = _masked_reduce(data, lens, big, jnp.min)
+    elif np.issubdtype(np.dtype(data.dtype), np.floating):
+        out, nonempty = _masked_reduce(data, lens, jnp.inf, jnp.min)
+    else:
+        out, nonempty = _masked_reduce(
+            data, lens, jnp.iinfo(data.dtype).max, jnp.min
+        )
+    valid = _and_valid(arr.valid, nonempty)
+    return Val(out, valid, call.type, arr.dictionary)
+
+
+def _sorted_rows(data, lens, descending=False):
+    """Per-row sort with padding pushed past the live elements."""
+    em = _elem_mask(data, lens)
+    if np.issubdtype(np.dtype(data.dtype), np.floating):
+        hi = jnp.inf if not descending else -jnp.inf
+    else:
+        hi = (
+            jnp.iinfo(data.dtype).max
+            if not descending
+            else jnp.iinfo(data.dtype).min
+        )
+    keyed = jnp.where(em, data, hi)
+    s = jnp.sort(keyed, axis=1)
+    if descending:
+        s = s[:, ::-1]
+    return s, em
+
+
+@register("array_sort")
+def _array_sort(ctx, call, arr):
+    data, lens = _arr2d(ctx, arr)
+    s, _ = _sorted_rows(data, lens)
+    return Val(s, arr.valid, call.type, arr.dictionary, lens)
+
+
+@register("array_distinct")
+def _array_distinct(ctx, call, arr):
+    """Distinct elements; sorted order (reference keeps first-seen order —
+    documented deviation, element sets are equal)."""
+    data, lens = _arr2d(ctx, arr)
+    s, _ = _sorted_rows(data, lens)
+    k = data.shape[1]
+    pos_in = jnp.arange(k, dtype=jnp.int32)[None, :]
+    live = pos_in < lens[:, None]
+    new = jnp.concatenate(
+        [jnp.ones((s.shape[0], 1), bool), s[:, 1:] != s[:, :-1]], axis=1
+    )
+    keep = jnp.logical_and(live, new)
+    # stable compact within each row
+    target = jnp.cumsum(keep, axis=1) - 1
+    out_lens = keep.sum(axis=1).astype(jnp.int32)
+    idx = jnp.where(keep, target, k)
+    out = jnp.zeros_like(s)
+    rows = jnp.arange(s.shape[0])[:, None]
+    out = out.at[rows, jnp.clip(idx, 0, k - 1)].set(
+        jnp.where(keep, s, 0), mode="drop"
+    )
+    # the scatter above drops idx==k writes only via clip+mode; rewrite dead
+    # slots deterministically to zero
+    em_out = jnp.arange(k, dtype=jnp.int32)[None, :] < out_lens[:, None]
+    out = jnp.where(em_out, out, 0)
+    return Val(out, arr.valid, call.type, arr.dictionary, out_lens)
+
+
+@register("sequence")
+def _sequence(ctx, call, start, stop, step=None):
+    """sequence(start, stop[, step]) with literal bounds (the rectangular
+    layout needs a static K)."""
+    s0 = int(np.asarray(start.data))
+    s1 = int(np.asarray(stop.data))
+    st = int(np.asarray(step.data)) if step is not None else 1
+    if st == 0:
+        raise ValueError("sequence step cannot be zero")
+    vals = np.arange(s0, s1 + (1 if st > 0 else -1), st, dtype=np.int64)
+    k = max(1, len(vals))
+    row = np.zeros(k, np.int64)
+    row[: len(vals)] = vals
+    cap = ctx.capacity
+    data = jnp.broadcast_to(jnp.asarray(row), (cap, k))
+    lens = jnp.full((cap,), len(vals), jnp.int32)
+    return Val(data, _and_valid(start.valid, stop.valid), call.type, None, lens)
+
+
+@register("repeat")
+def _repeat(ctx, call, elem, count):
+    n = int(np.asarray(count.data))
+    if n < 0:
+        n = 0
+    k = max(1, n)
+    cap = ctx.capacity
+    e = jnp.broadcast_to(jnp.asarray(elem.data), (cap,))
+    data = jnp.broadcast_to(e[:, None], (cap, k))
+    em = jnp.arange(k, dtype=jnp.int32)[None, :] < n
+    data = jnp.where(em, data, 0)
+    lens = jnp.full((cap,), n, jnp.int32)
+    return Val(data, elem.valid, call.type, elem.dictionary, lens)
+
+
+@register("split")
+def _split(ctx, call, value, delim, limit=None):
+    """split(string, delimiter[, limit]) -> array(varchar).
+
+    Computed once per dictionary value (SplitFunction.java's row loop becomes
+    a dictionary-table build), gathered on device by code."""
+    d = _require_dict(value, "split")
+    sep = _literal_str(delim, "split")
+    lim = int(np.asarray(limit.data)) if limit is not None else None
+    pieces_per = [
+        (s.split(sep, lim - 1) if lim else s.split(sep)) for s in d.values
+    ]
+    all_pieces = sorted({p for ps in pieces_per for p in ps})
+    nd = StringDictionary(all_pieces)
+    ix = nd.index
+    k = max(1, max((len(ps) for ps in pieces_per), default=1))
+    table = np.zeros((len(d.values), k), np.int32)
+    lens_t = np.zeros(len(d.values), np.int32)
+    for i, ps in enumerate(pieces_per):
+        lens_t[i] = len(ps)
+        for j, p in enumerate(ps):
+            table[i, j] = ix[p]
+    codes = jnp.asarray(value.data, jnp.int32)
+    data = jnp.take(jnp.asarray(table), codes, axis=0, mode="clip")
+    lens = jnp.take(jnp.asarray(lens_t), codes, mode="clip")
+    cap = ctx.capacity
+    data = jnp.broadcast_to(data, (cap, k))
+    lens = jnp.broadcast_to(lens, (cap,))
+    return Val(data, value.valid, call.type, nd, lens)
+
+
+# ---------------------------------------------------------------------------
+# JSON (reference: operator/scalar/json/JsonExtract.java + JsonPath subset)
+
+
+def _parse_json_path(path: str):
+    """Subset of JSONPath the reference's JsonExtract supports: $, .key,
+    ['key'], [index]."""
+    if not path.startswith("$"):
+        raise ValueError(f"invalid JSON path: {path!r}")
+    i, n, steps = 1, len(path), []
+    while i < n:
+        c = path[i]
+        if c == ".":
+            j = i + 1
+            while j < n and path[j] not in ".[":
+                j += 1
+            steps.append(path[i + 1 : j])
+            i = j
+        elif c == "[":
+            j = path.index("]", i)
+            tok = path[i + 1 : j].strip()
+            if tok[:1] in ("'", '"'):
+                steps.append(tok[1:-1])
+            else:
+                steps.append(int(tok))
+            i = j + 1
+        else:
+            raise ValueError(f"invalid JSON path: {path!r}")
+    return steps
+
+
+def _json_walk(doc, steps):
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(doc, list) or s >= len(doc) or s < -len(doc):
+                return None, False
+            doc = doc[s]
+        else:
+            if not isinstance(doc, dict) or s not in doc:
+                return None, False
+            doc = doc[s]
+    return doc, True
+
+
+def _json_table(value: Val, path: Val, name: str, render):
+    """Evaluate a JSON path once per dictionary value; returns (outs, hits)."""
+    d = _require_dict(value, name)
+    steps = _parse_json_path(_literal_str(path, name))
+    outs, hits = [], []
+    for s in d.values:
+        try:
+            doc = json.loads(s)
+            v, ok = _json_walk(doc, steps)
+            r = render(v, ok)
+        except (ValueError, TypeError, OverflowError):
+            r = None
+        if r is None:
+            outs.append("")
+            hits.append(False)
+        else:
+            outs.append(r)
+            hits.append(True)
+    return d, outs, hits
+
+
+def _dict_gather(value: Val, outs, hits, out_type):
+    nd = StringDictionary.from_unsorted(outs)
+    ix = nd.index
+    table = jnp.asarray(
+        np.fromiter((ix[o] for o in outs), dtype=np.int32, count=len(outs))
+    )
+    hit_table = jnp.asarray(np.asarray(hits, dtype=bool))
+    codes = jnp.asarray(value.data, jnp.int32)
+    out_codes = jnp.take(table, codes, mode="clip")
+    hit = jnp.take(hit_table, codes, mode="clip")
+    valid = _and_valid(value.valid, hit)
+    return Val(out_codes, valid, out_type, nd)
+
+
+@register("json_extract_scalar")
+def _json_extract_scalar(ctx, call, value, path):
+    def render(v, ok):
+        if not ok or isinstance(v, (dict, list)) or v is None:
+            return None
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, float) and v == int(v):
+            return json.dumps(v)
+        return str(v)
+
+    _, outs, hits = _json_table(value, path, "json_extract_scalar", render)
+    return _dict_gather(value, outs, hits, call.type)
+
+
+@register("json_extract")
+def _json_extract(ctx, call, value, path):
+    def render(v, ok):
+        if not ok:
+            return None
+        return json.dumps(v, separators=(",", ":"))
+
+    _, outs, hits = _json_table(value, path, "json_extract", render)
+    return _dict_gather(value, outs, hits, call.type)
+
+
+@register("json_array_length")
+def _json_array_length(ctx, call, value):
+    d = _require_dict(value, "json_array_length")
+    lens, hits = [], []
+    for s in d.values:
+        try:
+            doc = json.loads(s)
+        except (ValueError, TypeError):
+            doc = None
+        if isinstance(doc, list):
+            lens.append(len(doc))
+            hits.append(True)
+        else:
+            lens.append(0)
+            hits.append(False)
+    lt = jnp.asarray(np.asarray(lens, np.int64))
+    ht = jnp.asarray(np.asarray(hits, bool))
+    codes = jnp.asarray(value.data, jnp.int32)
+    out = jnp.take(lt, codes, mode="clip")
+    hit = jnp.take(ht, codes, mode="clip")
+    return Val(out, _and_valid(value.valid, hit), call.type)
+
+
+@register("json_size")
+def _json_size(ctx, call, value, path):
+    def render(v, ok):
+        if not ok:
+            return None
+        if isinstance(v, (dict, list)):
+            return str(len(v))
+        return "0"
+
+    _, outs, hits = _json_table(value, path, "json_size", render)
+    v = _dict_gather(value, outs, hits, T.VARCHAR)
+    # decode the small digit dictionary into ints
+    table = jnp.asarray(
+        np.asarray([int(x) if x else 0 for x in v.dictionary.values], np.int64)
+    )
+    out = jnp.take(table, jnp.asarray(v.data, jnp.int32), mode="clip")
+    return Val(out, v.valid, call.type)
+
+
+@register("json_parse")
+@register("json_format")
+def _json_identity(ctx, call, value):
+    """JSON is carried as canonical text (the engine's JSON runtime type is
+    dictionary-encoded varchar), so parse/format are identity on valid text."""
+    return Val(value.data, value.valid, call.type, value.dictionary)

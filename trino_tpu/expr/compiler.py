@@ -38,6 +38,8 @@ class Val:
     valid: object
     type: T.Type
     dictionary: Optional[StringDictionary] = None
+    #: array values: data is [capacity, K], lengths int32 [capacity]
+    lengths: object = None
 
     @property
     def is_literal_null(self) -> bool:
@@ -74,7 +76,7 @@ class ExprCompiler:
     def value(self, expr: Expr) -> Val:
         if isinstance(expr, InputRef):
             c = self.batch.columns[expr.channel]
-            return Val(c.data, c.valid, expr.type, c.dictionary)
+            return Val(c.data, c.valid, expr.type, c.dictionary, c.lengths)
         if isinstance(expr, Literal):
             return self._literal(expr)
         if isinstance(expr, SpecialForm):
@@ -88,6 +90,20 @@ class ExprCompiler:
     def column(self, expr: Expr) -> Column:
         """Evaluate to a full-capacity Column."""
         v = self.value(expr)
+        if v.lengths is not None:
+            k = v.data.shape[-1]
+            data = jnp.broadcast_to(
+                jnp.asarray(v.data, dtype=v.type.np_dtype), (self.capacity, k)
+            )
+            lengths = jnp.broadcast_to(
+                jnp.asarray(v.lengths, jnp.int32), (self.capacity,)
+            )
+            valid = None
+            if v.valid is False:
+                valid = jnp.zeros(self.capacity, dtype=bool)
+            elif v.valid is not None:
+                valid = jnp.broadcast_to(v.valid, (self.capacity,))
+            return Column(data, v.type, valid, v.dictionary, lengths)
         data = jnp.broadcast_to(
             jnp.asarray(v.data, dtype=v.type.np_dtype), (self.capacity,)
         )
@@ -282,3 +298,71 @@ class ExprCompiler:
         # Device arithmetic never traps; TRY is the identity with null-on-error
         # semantics folded into the ops themselves (e.g. div-by-zero -> null).
         return self.value(f.args[0])
+
+    # -- arrays --------------------------------------------------------------
+
+    def _form_array(self, f: SpecialForm) -> Val:
+        """ARRAY[e1, ...] -> padded rectangular [capacity, K] + lengths.
+
+        Reference: spi/block/ArrayBlock.java holds offsets into a flat
+        elements block; the device layout is rectangular so every downstream
+        op stays statically shaped.  NULL elements are not representable in
+        the rectangular layout (tracked per-array, not per-element)."""
+        vals = [self.value(a) for a in f.args]
+        et = f.type.element
+        if any(v.is_literal_null for v in vals):
+            raise NotImplementedError("NULL array elements")
+        dictionary = None
+        if any(v.dictionary is not None for v in vals):
+            from trino_tpu.columnar.dictionary import union_many
+
+            dictionary, tables = union_many([v.dictionary for v in vals])
+            vals = [
+                v
+                if tbl is None
+                else Val(
+                    jnp.take(
+                        jnp.asarray(tbl),
+                        jnp.asarray(v.data, jnp.int32),
+                        mode="clip",
+                    ),
+                    v.valid,
+                    v.type,
+                    dictionary,
+                )
+                for v, tbl in zip(vals, tables)
+            ]
+        cap = self.capacity
+        cols = [
+            jnp.broadcast_to(jnp.asarray(v.data, et.np_dtype), (cap,))
+            for v in vals
+        ]
+        data = jnp.stack(cols, axis=1) if cols else jnp.zeros((cap, 0), et.np_dtype)
+        # a NULL item would need element validity; instead the whole array is
+        # null when any element is null (strict, documented deviation)
+        valid = None
+        for v in vals:
+            valid = _and_valid(valid, v.valid)
+        lengths = jnp.full((cap,), len(vals), jnp.int32)
+        return Val(data, valid, f.type, dictionary, lengths)
+
+    def _form_subscript(self, f: SpecialForm) -> Val:
+        """array[i], 1-based; out-of-range yields NULL (the reference throws;
+        trapping is not expressible in a vectorized XLA program)."""
+        base = self.value(f.args[0])
+        idx = self.value(f.args[1])
+        if base.lengths is None:
+            raise NotImplementedError("subscript on non-array value")
+        cap = self.capacity
+        if base.data.shape[-1] == 0:  # zero-capacity arrays: always NULL
+            return Val(jnp.zeros(cap, f.type.np_dtype), False, f.type)
+        data2 = jnp.broadcast_to(
+            jnp.asarray(base.data), (cap, base.data.shape[-1])
+        )
+        lens = jnp.broadcast_to(jnp.asarray(base.lengths, jnp.int32), (cap,))
+        i = jnp.broadcast_to(jnp.asarray(idx.data, jnp.int64), (cap,))
+        in_range = jnp.logical_and(i >= 1, i <= lens.astype(jnp.int64))
+        pos = jnp.clip(i - 1, 0, max(data2.shape[1] - 1, 0))
+        out = jnp.take_along_axis(data2, pos[:, None], axis=1)[:, 0]
+        valid = _and_valid(_and_valid(base.valid, idx.valid), in_range)
+        return Val(out, valid, f.type, base.dictionary)

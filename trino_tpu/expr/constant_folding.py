@@ -87,4 +87,13 @@ def _from_py(v, t: T.Type) -> Literal:
         return Literal(int(v), t)
     if t.name in ("double", "real"):
         return Literal(float(v), t)
+    if t is T.DATE and isinstance(v, str):
+        import datetime
+
+        y, m, d = (int(x) for x in v.strip().split("-"))
+        return Literal(
+            (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days, t
+        )
+    if t is T.DATE and isinstance(v, int):
+        return Literal(v, t)
     return Literal(v, t)

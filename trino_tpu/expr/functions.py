@@ -842,3 +842,7 @@ def _parse_scalar(s: str, to: T.Type):
     if to is T.BOOLEAN:
         return s.lower() in ("true", "t", "1")
     raise ValueError(f"cannot parse {s!r} as {to.name}")
+
+
+# array/json function handlers register themselves on import
+from trino_tpu.expr import arrays as _arrays  # noqa: E402,F401

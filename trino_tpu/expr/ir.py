@@ -128,6 +128,8 @@ class Form(enum.Enum):
     NULLIF = "nullif"
     ROW = "row"
     DEREFERENCE = "dereference"
+    ARRAY = "array"            # array(e1, e2, ...) constructor
+    SUBSCRIPT = "subscript"    # subscript(array, index) — 1-based
 
 
 class SpecialForm(Expr):

@@ -243,6 +243,7 @@ class AggregationOperator:
         streaming: bool = False,
         fold_every: Optional[int] = None,
         memory_ctx=None,
+        use_pallas: bool = False,
     ):
         # merge: states in -> states out (used to combine partial outputs)
         assert mode in ("single", "partial", "final", "merge")
@@ -253,12 +254,17 @@ class AggregationOperator:
         self.streaming = streaming
         self.fold_every = fold_every if fold_every is not None else self.FOLD_EVERY
         self.memory_ctx = memory_ctx
+        #: opt-in Pallas MXU kernel for eligible direct-path aggregations
+        #: (ops/pallas_agg.py); float32 accumulation, so restricted to
+        #: DOUBLE/REAL sums + counts where f32 matmul precision is acceptable
+        self.use_pallas = use_pallas
         self._acc: list[Batch] = []
         key = (
             tuple(self.group_channels),
             tuple(self.aggregates),
             tuple(t.name for t in self.input_types),
             mode,
+            use_pallas,
         )
         cached = _STEP_CACHE.get(key)
         if cached is None:
@@ -326,6 +332,12 @@ class AggregationOperator:
             cols.append(
                 Column(code.astype(c.data.dtype), c.type, valid, c.dictionary)
             )
+        pallas_sums = None
+        if self.use_pallas and self.mode == "single":
+            pallas_sums = self._pallas_direct_sums(batch, live, gid, prod)
+        if pallas_sums is not None:
+            cols.extend(pallas_sums)
+            return Batch(cols, out_live)
         perm = jnp.arange(cap, dtype=jnp.int64)
         for spec in self.aggregates:
             state_cols = self._reduce_one(batch, spec, perm, live, gid, nseg, prod)
@@ -334,6 +346,89 @@ class AggregationOperator:
             else:
                 cols.append(_finalize(spec, state_cols))
         return Batch(cols, out_live)
+
+    def _pallas_direct_sums(self, batch: Batch, live, gid, prod: int):
+        """MXU one-hot-matmul fast path (ops/pallas_agg.py) when every
+        aggregate is a float sum/avg or a count; returns finalized columns
+        or None when ineligible."""
+        for spec in self.aggregates:
+            if spec.name in ("count_star", "count"):
+                continue
+            if spec.name in ("sum", "avg") and spec.arg is not None:
+                if self.input_types[spec.arg].name in ("double", "real"):
+                    continue
+            return None
+        cap = batch.capacity
+        from trino_tpu.ops.pallas_agg import _BLOCK, grouped_sums_pallas
+
+        block = min(_BLOCK, cap)
+        # f32 accumulation: counts stay exact only below 2^24 increments, so
+        # cap the eligible batch size (beyond it the sort-based path runs)
+        if cap % block != 0 or prod > 512 or cap > (1 << 24):
+            return None
+
+        # value matrix: one column per needed quantity
+        mats = []
+        plan = []  # (spec, kind, col indices into mats)
+        ones = None
+        for spec in self.aggregates:
+            if spec.name == "count_star":
+                if ones is None:
+                    ones = len(mats)
+                    mats.append(jnp.ones(cap, jnp.float32))
+                plan.append((spec, "count", (ones,)))
+                continue
+            c = batch.columns[spec.arg]
+            v = c.valid_mask() if c.valid is not None else None
+            data = c.data.astype(jnp.float32)
+            if v is not None:
+                data = jnp.where(v, data, 0.0)
+            cnt_col = len(mats)
+            mats.append(
+                (v if v is not None else jnp.ones(cap, bool)).astype(jnp.float32)
+            )
+            if spec.name == "count":
+                plan.append((spec, "count", (cnt_col,)))
+                continue
+            val_col = len(mats)
+            mats.append(data)
+            plan.append((spec, spec.name, (val_col, cnt_col)))
+        values = jnp.stack(mats, axis=1)
+        interpret = jax.default_backend() != "tpu"
+        sums = grouped_sums_pallas(
+            gid.astype(jnp.int32),
+            live,
+            values,
+            n_groups=prod,
+            interpret=interpret,
+        )  # [prod, len(mats)]
+        out = []
+        for spec, kind, idx in plan:
+            if kind == "count":
+                out.append(
+                    Column(sums[:, idx[0]].astype(jnp.int64), T.BIGINT)
+                )
+            elif kind == "sum":
+                n = sums[:, idx[1]]
+                out.append(
+                    Column(
+                        sums[:, idx[0]].astype(jnp.float64),
+                        spec.out_type,
+                        n > 0,
+                    )
+                )
+            else:  # avg
+                n = sums[:, idx[1]]
+                out.append(
+                    Column(
+                        (sums[:, idx[0]] / jnp.maximum(n, 1.0)).astype(
+                            jnp.float64
+                        ),
+                        spec.out_type,
+                        n > 0,
+                    )
+                )
+        return out
 
     def _reduce_step(self, batch: Batch, out_cap: int) -> Batch:
         gch = self.group_channels

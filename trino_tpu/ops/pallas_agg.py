@@ -1,0 +1,101 @@
+"""Pallas TPU kernel: fused masked grouped aggregation.
+
+Reference role: the generated accumulator loops of
+operator/aggregation/GroupedAggregator + AccumulatorCompiler — the hottest
+loop of the engine's Q1-shaped workload (low-cardinality GROUP BY over wide
+fact scans).
+
+TPU design: for a small group domain G, grouped sums ARE a matmul — the
+one-hot group matrix [N, G] transposed against the value matrix [N, K] rides
+the MXU instead of scatter hardware the TPU doesn't have.  The Pallas kernel
+streams row blocks HBM->VMEM, builds the one-hot tile in-register, and
+accumulates [G, K] partials in a VMEM scratch across grid steps — one pass
+over the data, no re-materialized one-hot in HBM (which is what the
+equivalent XLA formulation allocates when N is large).
+
+Used by the engine as an optional fast path for sum/count aggregates with
+small integer group ids (session property `pallas_agg`); everything else
+takes the sort-based path in ops/aggregation.py.  On CPU (tests) the kernel
+runs in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK = 2048  # rows per grid step (VMEM: 2048*K*4B + 2048*G*4B)
+
+
+def _agg_kernel(gid_ref, mask_ref, val_ref, out_ref, acc_ref):
+    import jax.experimental.pallas as pl
+
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    gids = gid_ref[:]  # [B] int32
+    mask = mask_ref[:]  # [B] bool
+    vals = val_ref[:]  # [B, K] f32
+    g = acc_ref.shape[0]
+    # one-hot [B, G] with dead rows zeroed; built in VMEM, never in HBM
+    onehot = (
+        gids[:, None] == jax.lax.broadcasted_iota(jnp.int32, (1, g), 1)
+    ) & mask[:, None]
+    acc_ref[:] += jax.lax.dot_general(
+        onehot.astype(jnp.float32),
+        vals,
+        (((0,), (0,)), ((), ())),  # contract over rows: [G, K]
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(step == pl.num_programs(0) - 1)
+    def _flush():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("n_groups", "interpret"))
+def grouped_sums_pallas(
+    gids, mask, values, n_groups: int, interpret: bool = False
+):
+    """sum of values[:, k] per group (masked): [G, K] float32.
+
+    gids int32 [N] in [0, n_groups); mask bool [N]; values float32 [N, K].
+    N must be a multiple of the block size (pad with mask=False rows).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, k = values.shape
+    block = min(_BLOCK, n)
+    assert n % block == 0, f"pad N={n} to a multiple of {block}"
+    grid = (n // block,)
+    return pl.pallas_call(
+        _agg_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block, k), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((n_groups, k), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_groups, k), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((n_groups, k), jnp.float32)],
+        interpret=interpret,
+    )(
+        gids.astype(jnp.int32),
+        mask,
+        values.astype(jnp.float32),
+    )
+
+
+def grouped_sums_xla(gids, mask, values, n_groups: int):
+    """The XLA formulation of the same computation (segment-sum one-hot
+    matmul) — the comparison baseline for the micro-bench."""
+    onehot = jax.nn.one_hot(gids, n_groups, dtype=jnp.float32)
+    onehot = onehot * mask[:, None].astype(jnp.float32)
+    return onehot.T @ values.astype(jnp.float32)

@@ -385,7 +385,15 @@ class StageExecutor:
         connector = self.catalogs.get(node.handle.catalog)
         names = [c for _, c in node.assignments]
         types = [s.type for s, _ in node.assignments]
-        splits = list(connector.splits(node.handle, target_splits=self.wm.n))
+        from trino_tpu.connectors.api import scan_predicate_triples
+
+        splits = list(
+            connector.splits(
+                node.handle,
+                target_splits=self.wm.n,
+                predicate=scan_predicate_triples(node),
+            )
+        )
         page_rows = self.properties.get("page_rows")
         use_cache = self.properties.get("scan_cache")
 
@@ -663,6 +671,20 @@ class StageExecutor:
 
         out = spmd_step(self.wm, mark_step)(src.stacked, bcast)
         return _Dist(out, src.symbols + [node.mark])
+
+    def _x_UnnestNode(self, node: P.UnnestNode) -> _Dist:
+        from trino_tpu.ops.unnest import UnnestOperator
+
+        src = self._exec(node.source)
+        exprs = [src.rewrite(e) for _, e in node.unnest]
+        op = UnnestOperator(exprs, with_ordinality=node.ordinality is not None)
+
+        def step(b: Batch) -> Batch:
+            cols, mask = op.raw_step(b)
+            return Batch(cols, mask)
+
+        out = spmd_step(self.wm, step)(src.stacked)
+        return _Dist(out, node.outputs)
 
     def _x_MarkDistinctNode(self, node: P.MarkDistinctNode) -> _Dist:
         from trino_tpu.ops.aggregation import MarkDistinctOperator

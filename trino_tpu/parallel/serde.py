@@ -1,0 +1,123 @@
+"""Exchange wire format for the multi-host data plane.
+
+Reference role: core/trino-main/.../execution/buffer/PagesSerde.java — the
+page serializer used by HTTP exchanges between worker JVMs.  Here a page set
+is host numpy columns + pickled column metadata (types, dictionary values);
+the consumer rebuilds Batches whose per-producer dictionaries are unioned by
+the engine's normal concat path.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import zlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+from trino_tpu.columnar import Batch, Column, StringDictionary
+
+
+def batches_to_bytes(batches: Sequence[Batch]) -> bytes:
+    """Serialize host batches (device arrays are pulled to host)."""
+    doc = []
+    for b in batches:
+        cols = []
+        for c in b.columns:
+            cols.append(
+                {
+                    "data": np.asarray(c.data),
+                    "valid": None if c.valid is None else np.asarray(c.valid),
+                    "lengths": (
+                        None if c.lengths is None else np.asarray(c.lengths)
+                    ),
+                    "type": c.type,
+                    "dict": (
+                        None
+                        if c.dictionary is None
+                        else tuple(c.dictionary.values)
+                    ),
+                }
+            )
+        doc.append({"cols": cols, "mask": np.asarray(b.mask())})
+    return zlib.compress(pickle.dumps(doc, protocol=pickle.HIGHEST_PROTOCOL), 1)
+
+
+def bytes_to_batches(payload: bytes) -> list:
+    doc = pickle.loads(zlib.decompress(payload))
+    out = []
+    for b in doc:
+        cols = []
+        for c in b["cols"]:
+            d = (
+                None
+                if c["dict"] is None
+                else StringDictionary(list(c["dict"]))
+            )
+            cols.append(
+                Column(c["data"], c["type"], c["valid"], d, c["lengths"])
+            )
+        out.append(Batch(cols, b["mask"]))
+    return out
+
+
+def stable_row_hash(batch: Batch, channels: Sequence[int]) -> np.ndarray:
+    """Process-stable hash of the key columns' VALUES (dictionary codes are
+    producer-local, so strings hash by dictionary value, gathered by code).
+    Reference role: InterpretedHashGenerator for partitioned exchanges."""
+    n = batch.capacity
+    acc = np.full(n, 0x9E3779B97F4A7C15, dtype=np.uint64)
+    for ch in channels:
+        c = batch.columns[ch]
+        data = np.asarray(c.data)
+        if c.dictionary is not None:
+            table = np.fromiter(
+                (
+                    zlib.crc32(v.encode() if isinstance(v, str) else bytes(v))
+                    for v in c.dictionary.values
+                ),
+                dtype=np.uint64,
+                count=len(c.dictionary.values),
+            )
+            h = table[np.clip(data.astype(np.int64), 0, len(table) - 1)]
+        else:
+            h = data.astype(np.int64).view(np.uint64).copy()
+            if data.dtype == np.bool_:
+                h = data.astype(np.uint64)
+            elif data.dtype.kind == "f":
+                h = np.float64(data).view(np.uint64).copy()
+        if c.valid is not None:
+            h = np.where(np.asarray(c.valid), h, np.uint64(0))
+        # splitmix64 finalizer per column, xor-combined
+        h = (h ^ (h >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        h = (h ^ (h >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        h = h ^ (h >> np.uint64(31))
+        acc = acc * np.uint64(31) + h
+    return acc
+
+
+def partition_batches(
+    batches: Sequence[Batch], channels: Sequence[int], n: int
+) -> list:
+    """Split host batches into n bucket-lists by key hash (live rows only)."""
+    buckets: list = [[] for _ in range(n)]
+    for b in batches:
+        h = stable_row_hash(b, channels)
+        mask = np.asarray(b.mask())
+        part = (h % np.uint64(n)).astype(np.int64)
+        for i in range(n):
+            keep = mask & (part == i)
+            if not keep.any():
+                continue
+            idx = np.nonzero(keep)[0]
+            cols = []
+            for c in b.columns:
+                data = np.asarray(c.data)[idx]
+                valid = None if c.valid is None else np.asarray(c.valid)[idx]
+                lens = (
+                    None if c.lengths is None else np.asarray(c.lengths)[idx]
+                )
+                cols.append(Column(data, c.type, valid, c.dictionary, lens))
+            buckets[i].append(Batch(cols, np.ones(len(idx), bool)))
+    return buckets

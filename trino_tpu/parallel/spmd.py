@@ -92,17 +92,34 @@ def stack_batches(batches: Sequence[Optional[Batch]], wm: WorkerMesh, cap: Optio
 
     cols = []
     for ch in range(width):
-        datas, valids = [], []
+        datas, valids, lens = [], [], []
         any_valid = any(
             b is not None and b.width and b.columns[ch].valid is not None for b in batches
         )
+        any_lengths = any(
+            b is not None and b.width and b.columns[ch].lengths is not None
+            for b in batches
+        )
+        # array columns: pad every worker's K to the widest
+        k = 0
+        if any_lengths:
+            k = max(
+                b.columns[ch].data.shape[1]
+                for b in batches
+                if b is not None and b.width
+            )
         for wi, b in enumerate(batches):
             if b is None or not b.width:
-                datas.append(np.zeros(cap, dtype=types[ch].np_dtype))
+                shape = (cap, k) if any_lengths else (cap,)
+                datas.append(np.zeros(shape, dtype=types[ch].np_dtype))
                 valids.append(np.zeros(cap, dtype=bool))
+                if any_lengths:
+                    lens.append(np.zeros(cap, dtype=np.int32))
                 continue
             c = b.columns[ch]
             data = np.asarray(c.data)
+            if any_lengths and data.shape[1] < k:
+                data = np.pad(data, ((0, 0), (0, k - data.shape[1])))
             table = tables_per_ch[ch][wi]
             if table is not None:
                 data = np.asarray(table)[data.astype(np.int64)]
@@ -113,9 +130,18 @@ def stack_batches(batches: Sequence[Optional[Batch]], wm: WorkerMesh, cap: Optio
                 else np.ones(data.shape[0], dtype=bool)
             )
             valids.append(_pad_host(v, cap))
+            if any_lengths:
+                lens.append(
+                    _pad_host(np.asarray(c.lengths), cap)
+                    if c.lengths is not None
+                    else np.zeros(cap, dtype=np.int32)
+                )
         stacked = np.stack(datas)
         valid = np.stack(valids) if any_valid else None
-        cols.append(Column(stacked, types[ch], valid, dicts_per_ch[ch]))
+        lengths = np.stack(lens) if any_lengths else None
+        cols.append(
+            Column(stacked, types[ch], valid, dicts_per_ch[ch], lengths)
+        )
     masks = []
     for b in batches:
         if b is None or not b.width:
@@ -132,9 +158,11 @@ def unstack_batch(stacked: Batch) -> Batch:
     coordinator exchange; reference: final stage output buffer read)."""
     cols = []
     for c in stacked.columns:
-        data = np.asarray(c.data).reshape(-1)
+        d = np.asarray(c.data)
+        data = d.reshape((-1,) + d.shape[2:])  # keep array-element trailing dims
         valid = None if c.valid is None else np.asarray(c.valid).reshape(-1)
-        cols.append(Column(data, c.type, valid, c.dictionary))
+        lengths = None if c.lengths is None else np.asarray(c.lengths).reshape(-1)
+        cols.append(Column(data, c.type, valid, c.dictionary, lengths))
     mask = np.asarray(stacked.mask()).reshape(-1)
     return Batch(cols, mask)
 

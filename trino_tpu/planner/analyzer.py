@@ -29,12 +29,26 @@ class AnalysisError(ValueError):
 
 
 class Field:
-    __slots__ = ("name", "symbol", "alias")
+    __slots__ = ("name", "symbol", "alias", "source_name", "source_expr")
 
-    def __init__(self, name: str, symbol: P.Symbol, alias: Optional[str] = None):
+    def __init__(
+        self,
+        name: str,
+        symbol: P.Symbol,
+        alias: Optional[str] = None,
+        source_name: Optional[str] = None,
+        source_expr=None,
+    ):
         self.name = name
         self.symbol = symbol
         self.alias = alias
+        #: original column name when the item renamed a plain `t.col`
+        #: (ORDER BY `t.col` must still match the renamed output)
+        self.source_name = source_name
+        #: the select item's source AST — ORDER BY may repeat an output
+        #: item's full expression (`ORDER BY substr(s_city, 1, 30)`); frozen
+        #: dataclass equality gives the structural match
+        self.source_expr = source_expr
 
     def __repr__(self):  # pragma: no cover
         return f"{self.alias or ''}.{self.name}->{self.symbol.name}"
@@ -322,6 +336,24 @@ class ExprAnalyzer:
         neither_null_eq = ir.and_(ir.not_(ln), ir.not_(rn), eq)
         same = ir.or_(both_null, neither_null_eq)
         return same if n.negated else ir.not_(same)
+
+    def _a_ArrayConstructor(self, n: ast.ArrayConstructor) -> Expr:
+        items = [self.analyze(i) for i in n.items]
+        et = T.UNKNOWN
+        for i in items:
+            et = T.common_super_type(et, i.type)
+        if et == T.UNKNOWN:
+            et = T.BIGINT
+        return SpecialForm(Form.ARRAY, items, T.ArrayType(et))
+
+    def _a_Subscript(self, n: ast.Subscript) -> Expr:
+        base = self.analyze(n.base)
+        idx = self.analyze(n.index)
+        if not isinstance(base.type, T.ArrayType):
+            raise AnalysisError(
+                f"subscript base must be an array, got {base.type.name}"
+            )
+        return SpecialForm(Form.SUBSCRIPT, [base, idx], base.type.element)
 
     def _a_Extract(self, n: ast.Extract) -> Expr:
         fn = {

@@ -147,6 +147,7 @@ class ExchangePlacer:
 
     _p_FilterNode = _inherit
     _p_ProjectNode = _inherit
+    _p_UnnestNode = _inherit  # elementwise expansion: stays in its fragment
 
     def _p_OutputNode(self, node):
         child, dist = self._visit(node.source)

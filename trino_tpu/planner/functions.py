@@ -167,6 +167,31 @@ SCALAR_RESULT = {
     "round": lambda args: args[0],
     "greatest": _same_as_first,
     "least": _same_as_first,
+    # -- arrays (reference: operator/scalar/Array*Function.java) ------------
+    "cardinality": _fixed(T.BIGINT),
+    "element_at": lambda args: args[0].element
+    if isinstance(args[0], T.ArrayType)
+    else T.UNKNOWN,
+    "contains": _fixed(T.BOOLEAN),
+    "array_position": _fixed(T.BIGINT),
+    "array_max": lambda args: args[0].element
+    if isinstance(args[0], T.ArrayType)
+    else args[0],
+    "array_min": lambda args: args[0].element
+    if isinstance(args[0], T.ArrayType)
+    else args[0],
+    "array_sort": _same_as_first,
+    "array_distinct": _same_as_first,
+    "sequence": _fixed(T.ArrayType(T.BIGINT)),
+    "repeat": lambda args: T.ArrayType(args[0]),
+    "split": _fixed(T.ArrayType(T.VARCHAR)),
+    # -- json (reference: operator/scalar/json/JsonExtract.java) ------------
+    "json_extract_scalar": _fixed(T.VARCHAR),
+    "json_extract": _fixed(T.VARCHAR),
+    "json_array_length": _fixed(T.BIGINT),
+    "json_size": _fixed(T.BIGINT),
+    "json_parse": _fixed(T.VARCHAR),
+    "json_format": _fixed(T.VARCHAR),
 }
 
 

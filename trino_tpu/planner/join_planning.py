@@ -61,6 +61,37 @@ def _equi_edge(c: Expr, sym2src: dict):
     return (sa, P.Symbol(a.name, a.type), sb, P.Symbol(b.name, b.type))
 
 
+def extract_common_or_conjuncts(e: Expr) -> Expr:
+    """OR(a AND b AND x1, a AND b AND x2) -> a AND b AND OR(x1, x2).
+
+    Reference: sql/planner/iterative/rule/ExtractCommonPredicatesExpression
+    Rewriter — without this, TPC-DS Q13/Q48-style predicates keep their join
+    equalities trapped inside OR disjuncts and the comma join list degrades
+    to a cross product."""
+    kids = e.children()
+    if kids:
+        e = e.with_children([extract_common_or_conjuncts(k) for k in kids])
+    if not (isinstance(e, SpecialForm) and e.form == Form.OR):
+        return e
+    arms = [split_conjuncts_ir(a) for a in e.args]
+    common_keys = set(c.key() for c in arms[0])
+    for arm in arms[1:]:
+        common_keys &= {c.key() for c in arm}
+    if not common_keys:
+        return e
+    common = [c for c in arms[0] if c.key() in common_keys]
+    rests = []
+    for arm in arms:
+        rest = [c for c in arm if c.key() not in common_keys]
+        rests.append(and_(*rest) if rest else None)
+    if any(r is None for r in rests):
+        # one arm had ONLY common conjuncts: the OR reduces to them
+        return and_(*common)
+    from trino_tpu.expr.ir import or_
+
+    return and_(*(common + [or_(*rests)]))
+
+
 def eliminate_cross_joins(node: P.PlanNode, catalogs=None):
     """Filter(cross-join tree) -> pushed filters + greedy equi-join tree.
     Returns a replacement node or None."""
@@ -82,7 +113,8 @@ def eliminate_cross_joins(node: P.PlanNode, catalogs=None):
     single = defaultdict(list)
     edges = []  # (i, sym_i, j, sym_j, conjunct)
     residual = []
-    for c in split_conjuncts_ir(node.predicate):
+    predicate = extract_common_or_conjuncts(node.predicate)
+    for c in split_conjuncts_ir(predicate):
         refs = collect_symbol_names(c)
         srcs = {sym2src[r] for r in refs if r in sym2src}
         if not srcs:

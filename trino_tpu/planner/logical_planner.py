@@ -76,6 +76,7 @@ class LogicalPlanner:
         if q.order_by:
             orderings = []
             hidden: list = []  # (Symbol, Expr) computed sort keys
+            hidden_src: list = []  # sort keys over PRE-projection symbols
             # expressions in ORDER BY see the output columns under their
             # display names (reference: Scope of the query's output)
             scope = Scope(
@@ -120,10 +121,58 @@ class LogicalPlanner:
                     qual, name = item.expr.parts[-2], item.expr.parts[-1]
                     matches = [
                         f.symbol for f, n in zip(rp.fields, names)
-                        if n == name and f.alias == qual
+                        if f.alias == qual
+                        and (
+                            n == name
+                            # SELECT a.col AS alias ... ORDER BY a.col: the
+                            # display name moved, but the Field remembers the
+                            # source column
+                            or f.source_name == name
+                        )
                     ]
                     if len(matches) == 1:
                         sym = matches[0]
+                if sym is None:
+                    # ORDER BY repeating an output item's source expression
+                    # (`ORDER BY substr(s_city, 1, 30)`, `ORDER BY sum(x)`) or
+                    # the pre-rename source column of an aliased item —
+                    # frozen-dataclass equality gives the structural match
+                    matches = [
+                        f.symbol
+                        for f in rp.fields
+                        if f.source_expr is not None
+                        and f.source_expr == item.expr
+                    ]
+                    if not matches and isinstance(item.expr, ast.Identifier):
+                        nm = item.expr.parts[-1]
+                        matches = [
+                            f.symbol for f in rp.fields if f.source_name == nm
+                        ]
+                        if len(matches) > 1:
+                            raise AnalysisError(
+                                f"ORDER BY column is ambiguous: {nm}"
+                            )
+                    if len(matches) == 1:
+                        sym = matches[0]
+                if sym is None and getattr(rp, "source_fields", None):
+                    # ORDER BY a source column that is NOT an output item
+                    # (`SELECT o_orderkey FROM orders ORDER BY o_totalprice`):
+                    # resolve against the pre-projection scope and sort on a
+                    # hidden symbol pushed into the final projection
+                    # (reference: QueryPlanner's ORDER BY scope = source +
+                    # output)
+                    try:
+                        e = ExprAnalyzer(
+                            Scope(rp.source_fields, outer)
+                        ).analyze(item.expr)
+                    except AnalysisError:
+                        e = None
+                    if e is not None:
+                        if isinstance(e, SymbolRef):
+                            sym = P.Symbol(e.name, e.type)
+                        else:
+                            sym = self.alloc.new("orderby", e.type)
+                        hidden_src.append((sym, e))
                 if sym is None:
                     raise AnalysisError(
                         "ORDER BY expression must be an output column here: "
@@ -133,10 +182,25 @@ class LogicalPlanner:
                 if nf is None:
                     nf = not item.ascending  # reference default: NULLS LAST asc, FIRST desc
                 orderings.append((sym, item.ascending, nf))
+            if hidden_src:
+                # push hidden source-column sort keys into the output
+                # projection (its source still carries those symbols)
+                assert isinstance(node, P.ProjectNode), node
+                node = P.ProjectNode(
+                    node.source,
+                    list(node.assignments)
+                    + [
+                        (s, e)
+                        for s, e in hidden_src
+                        if not any(s.name == o.name for o, _ in node.assignments)
+                    ],
+                )
             if hidden:
                 node = P.ProjectNode(
                     node,
-                    [(f.symbol, f.symbol.ref()) for f in rp.fields] + hidden,
+                    [(f.symbol, f.symbol.ref()) for f in rp.fields]
+                    + [(s, s.ref()) for s, _ in hidden_src]
+                    + hidden,
                 )
             if q.limit is not None and not q.offset:
                 node = P.TopNNode(node, orderings, q.limit)
@@ -144,7 +208,7 @@ class LogicalPlanner:
                 node = P.SortNode(node, orderings)
                 if q.limit is not None or q.offset:
                     node = P.LimitNode(node, q.limit, q.offset or 0)
-            if hidden:
+            if hidden or hidden_src:
                 node = P.ProjectNode(
                     node, [(f.symbol, f.symbol.ref()) for f in rp.fields]
                 )
@@ -307,7 +371,62 @@ class LogicalPlanner:
             return self.plan_join(rel, outer, ctes)
         if isinstance(rel, ast.ValuesRelation):
             return self.plan_values(rel)
+        if isinstance(rel, ast.Unnest):
+            # standalone FROM UNNEST(...): unnest over a one-row source
+            single = RelationPlan(P.ValuesNode([], [()]), [])
+            return self.plan_unnest(rel, single, outer, ctes, alias=None)
+        if isinstance(rel, ast.TableFunctionCall):
+            from trino_tpu.planner.table_functions import TABLE_FUNCTIONS
+
+            tf = TABLE_FUNCTIONS.get(rel.name)
+            if tf is None:
+                raise AnalysisError(f"table function not found: {rel.name}")
+            return tf.plan(self, list(rel.args), outer, ctes)
         raise AnalysisError(f"unsupported relation {type(rel).__name__}")
+
+    def plan_unnest(
+        self,
+        u: ast.Unnest,
+        left: RelationPlan,
+        outer,
+        ctes,
+        alias: Optional[str] = None,
+        column_aliases: tuple = (),
+        keep_left_fields: bool = True,
+    ) -> RelationPlan:
+        """UNNEST relation, possibly correlated to `left` (the relation that
+        precedes it in the FROM list).  Reference:
+        sql/planner/QueryPlanner.java's planCrossJoinUnnest."""
+        scope = left.scope(outer)
+        an = ExprAnalyzer(scope)
+        unnest = []
+        elem_fields = []
+        for i, e in enumerate(u.exprs):
+            expr = an.analyze(e)
+            if not isinstance(expr.type, T.ArrayType):
+                raise AnalysisError(
+                    f"UNNEST argument must be an array, got {expr.type.name}"
+                )
+            name = (
+                column_aliases[i]
+                if i < len(column_aliases)
+                else _name_hint(e)
+            )
+            sym = self.alloc.new(name, expr.type.element)
+            unnest.append((sym, expr))
+            elem_fields.append(Field(name, sym, alias))
+        ord_sym = None
+        if u.with_ordinality:
+            oname = (
+                column_aliases[len(u.exprs)]
+                if len(column_aliases) > len(u.exprs)
+                else "ordinality"
+            )
+            ord_sym = self.alloc.new(oname, T.BIGINT)
+            elem_fields.append(Field(oname, ord_sym, alias))
+        node = P.UnnestNode(left.node, unnest, ord_sym)
+        fields = (list(left.fields) if keep_left_fields else []) + elem_fields
+        return RelationPlan(node, fields)
 
     def plan_table_scan(self, ref: ast.TableRef) -> RelationPlan:
         parts = ref.name
@@ -332,6 +451,20 @@ class LogicalPlanner:
 
     def plan_join(self, j: ast.Join, outer, ctes) -> RelationPlan:
         left = self.plan_relation(j.left, outer, ctes)
+        # CROSS JOIN UNNEST(expr-over-left) — correlated array expansion
+        inner_rel, u_alias, u_cols = j.right, None, ()
+        if isinstance(inner_rel, ast.AliasedRelation):
+            u_alias, u_cols = inner_rel.alias, inner_rel.column_aliases
+            inner_rel = inner_rel.relation
+        if isinstance(inner_rel, ast.Unnest):
+            if j.kind not in ("cross", "inner") or j.on is not None or j.using:
+                raise AnalysisError(
+                    f"{j.kind.upper()} JOIN UNNEST with condition not supported"
+                )
+            return self.plan_unnest(
+                inner_rel, left, outer, ctes,
+                alias=u_alias, column_aliases=u_cols,
+            )
         right = self.plan_relation(j.right, outer, ctes)
         fields = left.fields + right.fields
         if j.kind == "cross":
@@ -391,7 +524,13 @@ class LogicalPlanner:
         if has_agg:
             rp, names = self._plan_aggregation(spec, rp, source_scope, outer, ctes)
         else:
+            src_fields = rp.fields
             rp, names = self._plan_select_items(spec, rp, source_scope, outer, ctes)
+            if not spec.distinct:
+                # ORDER BY may reference source columns that are not output
+                # items; DISTINCT forbids that (post-dedupe rows have no
+                # source row identity)
+                rp.source_fields = src_fields
 
         if spec.distinct:
             rp = RelationPlan(
@@ -421,7 +560,13 @@ class LogicalPlanner:
             sym = self.alloc.new(name, e.type)
             assignments.append((sym, e))
             fields.append(
-                Field(name if item.alias else sym.name, sym, _source_alias(item))
+                Field(
+                    name if item.alias else sym.name,
+                    sym,
+                    _source_alias(item),
+                    _source_column(item),
+                    item.expr,
+                )
             )
             names.append(name)
         rp = graft.plan  # subqueries may have grown the source plan
@@ -581,16 +726,32 @@ class LogicalPlanner:
             post_assignments.append((gsym, gsym.ref()))
             post_fields.append(Field(gsym.name, gsym))
             names.append(gsym.name)
+        # windows over the aggregation's output (planWindowFunctions runs
+        # after aggregation planning in the reference's QueryPlanner)
+        wx = _WindowExtractor(self, source_scope, an_hook=post_hook)
+
+        def item_hook(node: ast.Node, an) -> Optional[Expr]:
+            got = wx.hook(node, an)
+            if got is not None:
+                return got
+            return post_hook(node, an)
+
         for item in spec.items:
             if isinstance(item, ast.Star):
                 raise AnalysisError("SELECT * not allowed with GROUP BY")
-            post_an = ExprAnalyzer(source_scope, hook=post_hook)
+            post_an = ExprAnalyzer(source_scope, hook=item_hook)
             e = post_an.analyze(item.expr)
             name = item.alias or _name_hint(item.expr)
             sym = alloc.new(name, e.type)
             post_assignments.append((sym, e))
             post_fields.append(
-                Field(name if item.alias else sym.name, sym, _source_alias(item))
+                Field(
+                    name if item.alias else sym.name,
+                    sym,
+                    _source_alias(item),
+                    _source_column(item),
+                    item.expr,
+                )
             )
             names.append(name)
 
@@ -623,18 +784,26 @@ class LogicalPlanner:
                     source_scope, hook=post_hook, on_subquery=g
                 ),
             )
-        node = P.ProjectNode(cur.node, post_assignments)
+        wnode = wx.attach(cur.node, cur.fields)
+        node = P.ProjectNode(wnode, post_assignments)
         return RelationPlan(node, post_fields), names
 
     # -- WHERE + subqueries --------------------------------------------------
 
     def _apply_where(self, rp, where: ast.Node, outer, ctes) -> RelationPlan:
+        # plain conjuncts first: they form the equi-join edges cross-join
+        # elimination needs, and a subquery graft applied over the raw comma
+        # cross tree would otherwise bury those edges under its own joins
+        # (q30-style plans explode into genuine cross products without this)
+        plain = []
+        with_subquery = []
         for conj in split_conjuncts(where):
-            if _contains_subquery(conj):
-                rp = self._apply_conjunct_with_subquery(rp, conj, outer, ctes)
-            else:
-                an = ExprAnalyzer(rp.scope(outer))
-                rp = RelationPlan(P.FilterNode(rp.node, an.analyze(conj)), rp.fields)
+            (with_subquery if _contains_subquery(conj) else plain).append(conj)
+        for conj in plain:
+            an = ExprAnalyzer(rp.scope(outer))
+            rp = RelationPlan(P.FilterNode(rp.node, an.analyze(conj)), rp.fields)
+        for conj in with_subquery:
+            rp = self._apply_conjunct_with_subquery(rp, conj, outer, ctes)
         return rp
 
     def _apply_conjunct_with_subquery(
@@ -810,7 +979,13 @@ class LogicalPlanner:
                     "correlated non-aggregated scalar subquery not supported"
                 )
             sub_proj, _ = self._plan_select_items(spec, sub, sub_scope, sub_outer, ctes)
-            single = P.EnforceSingleRowNode(sub_proj.node)
+            sub_node = sub_proj.node
+            if spec.distinct:
+                # SELECT DISTINCT x: dedupe before the single-row check
+                sub_node = P.AggregationNode(
+                    sub_node, [f.symbol for f in sub_proj.fields], []
+                )
+            single = P.EnforceSingleRowNode(sub_node)
             node = P.JoinNode("cross", rp.node, single, [])
             out = RelationPlan(node, rp.fields + sub_proj.fields)
             return out, sub_proj.fields[0].symbol.ref()
@@ -887,9 +1062,15 @@ class _WindowExtractor:
     WindowNode below the final projection (reference role: the window planning
     in QueryPlanner.planWindowFunctions)."""
 
-    def __init__(self, planner: "LogicalPlanner", scope: Scope):
+    def __init__(self, planner: "LogicalPlanner", scope: Scope, an_hook=None):
         self.planner = planner
         self.scope = scope
+        #: analyzer hook for window args/partition/order — the aggregation
+        #: planner passes its post-agg translation hook so windows OVER
+        #: aggregates (`sum(sum(x)) over (partition by k)`, the reference's
+        #: planWindowFunctions-after-aggregation ordering) resolve inner
+        #: aggregates and group keys to their computed symbols
+        self.an_hook = an_hook
         self.pre_assign: list = []  # [(Symbol, Expr)] computed inputs
         self.pre_map: dict = {}
         self.functions: list = []  # [(out Symbol, partition syms, order, fn)]
@@ -912,7 +1093,7 @@ class _WindowExtractor:
         return sym
 
     def _plan_call(self, fc: ast.FunctionCall) -> P.Symbol:
-        an = ExprAnalyzer(self.scope)
+        an = ExprAnalyzer(self.scope, hook=self.an_hook)
         w = fc.window
         part = [
             self._pre_symbol(an.analyze(p), _name_hint(p)) for p in w.partition_by
@@ -1082,11 +1263,20 @@ def _as_equi_pair(e: Expr, left_names, right_names):
     return None
 
 
+def _source_column(item) -> Optional[str]:
+    """Column part of a plain `t.col` select item."""
+    e = item.expr
+    if isinstance(e, ast.Identifier) and len(e.parts) >= 2:
+        return e.parts[-1]
+    return None
+
+
 def _source_alias(item) -> Optional[str]:
     """Qualifier of a plain `t.col` select item, kept on the output Field so
-    ORDER BY `t.col` can re-match it after projection."""
+    ORDER BY `t.col` can re-match it after projection (also when the item is
+    renamed: `SELECT t.col AS x ... ORDER BY t.col` is valid SQL)."""
     e = item.expr
-    if item.alias is None and isinstance(e, ast.Identifier) and len(e.parts) >= 2:
+    if isinstance(e, ast.Identifier) and len(e.parts) >= 2:
         return e.parts[-2]
     return None
 

@@ -354,6 +354,32 @@ class UnionNode(PlanNode):
 
 
 @dataclass
+class UnnestNode(PlanNode):
+    """Array expansion (reference: sql/planner/plan/UnnestNode.java +
+    operator/unnest/UnnestOperator.java).  Source rows replicate per array
+    element; multiple arrays zip; `ordinality` appends the element index."""
+
+    source: PlanNode
+    #: [(element output Symbol, array Expr over source symbols)]
+    unnest: list
+    ordinality: Optional["Symbol"] = None
+
+    @property
+    def outputs(self):
+        out = list(self.source.outputs) + [s for s, _ in self.unnest]
+        if self.ordinality is not None:
+            out.append(self.ordinality)
+        return out
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return UnnestNode(children[0], self.unnest, self.ordinality)
+
+
+@dataclass
 class EnforceSingleRowNode(PlanNode):
     """Scalar subquery guard (reference: plan/EnforceSingleRowNode.java)."""
 

@@ -1,0 +1,157 @@
+"""Global function registry: one queryable catalog of every function the
+engine resolves.
+
+Reference roles: metadata/GlobalFunctionCatalog.java + FunctionListBuilder
+(the source of SHOW FUNCTIONS and information_schema-style listings) and the
+function SPI registration path (spi/function/FunctionProvider — connectors
+contribute functions at catalog registration).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass(frozen=True)
+class FunctionMetadata:
+    name: str
+    kind: str  # scalar | aggregate | window | table
+    return_type: str
+    argument_types: tuple = ()
+    deterministic: bool = True
+    description: str = ""
+
+
+_DESCRIPTIONS = {
+    "abs": "absolute value",
+    "avg": "arithmetic mean",
+    "cardinality": "number of elements in an array",
+    "coalesce": "first non-null argument",
+    "concat": "string concatenation",
+    "contains": "true if array contains value",
+    "count": "row count",
+    "element_at": "array element at index (NULL out of range)",
+    "json_extract": "JSON subtree at a JSONPath",
+    "json_extract_scalar": "JSON scalar at a JSONPath as varchar",
+    "length": "string length",
+    "lower": "lowercase",
+    "max": "maximum",
+    "min": "minimum",
+    "regexp_like": "true if the string matches the regex",
+    "round": "round to given digits",
+    "sequence": "array of integers from start to stop",
+    "split": "split string by delimiter into an array",
+    "stddev": "sample standard deviation",
+    "substr": "substring",
+    "sum": "sum",
+    "upper": "uppercase",
+}
+
+#: window-only functions (the planner's _WindowExtractor set)
+WINDOW_FUNCS = (
+    "row_number",
+    "rank",
+    "dense_rank",
+    "percent_rank",
+    "cume_dist",
+    "ntile",
+    "lag",
+    "lead",
+    "first_value",
+    "last_value",
+)
+
+
+class FunctionRegistry:
+    """Name -> FunctionMetadata rows; engine built-ins plus connector
+    contributions (register_connector_functions)."""
+
+    def __init__(self):
+        self._functions: dict[tuple, FunctionMetadata] = {}
+        self._load_builtins()
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, meta: FunctionMetadata) -> None:
+        self._functions[(meta.name, meta.argument_types)] = meta
+
+    def register_connector_functions(self, connector) -> None:
+        """SPI hook: connectors may expose `functions() -> [FunctionMetadata]`
+        (reference: spi/function/FunctionProvider.getFunctions)."""
+        fns = getattr(connector, "functions", None)
+        if fns is None:
+            return
+        for meta in fns():
+            self.register(meta)
+
+    # -- queries -------------------------------------------------------------
+
+    def list(self) -> list:
+        return sorted(
+            self._functions.values(), key=lambda m: (m.name, m.argument_types)
+        )
+
+    def lookup(self, name: str) -> list:
+        return [m for m in self.list() if m.name == name]
+
+    # -- built-ins -----------------------------------------------------------
+
+    def _load_builtins(self) -> None:
+        from trino_tpu.planner.functions import AGG_FUNCS, SCALAR_RESULT
+        from trino_tpu import types as T
+
+        for name in sorted(SCALAR_RESULT):
+            if name.startswith("$"):
+                continue  # operators, not callable by name
+            try:
+                rt = SCALAR_RESULT[name]([T.DOUBLE, T.DOUBLE, T.DOUBLE]).name
+            except Exception:
+                rt = "same as input"
+            self.register(
+                FunctionMetadata(
+                    name,
+                    "scalar",
+                    rt,
+                    description=_DESCRIPTIONS.get(name, ""),
+                )
+            )
+        for name in sorted(AGG_FUNCS):
+            self.register(
+                FunctionMetadata(
+                    name,
+                    "aggregate",
+                    "same as input" if name in ("min", "max", "sum") else "bigint/double",
+                    description=_DESCRIPTIONS.get(name, ""),
+                )
+            )
+        for name in WINDOW_FUNCS:
+            self.register(
+                FunctionMetadata(
+                    name,
+                    "window",
+                    "bigint",
+                    description=_DESCRIPTIONS.get(name, ""),
+                )
+            )
+        from trino_tpu.planner.table_functions import TABLE_FUNCTIONS
+
+        for name, tf in sorted(TABLE_FUNCTIONS.items()):
+            self.register(
+                FunctionMetadata(
+                    name,
+                    "table",
+                    "table",
+                    description=tf.description,
+                )
+            )
+
+
+_REGISTRY: Optional[FunctionRegistry] = None
+
+
+def global_registry() -> FunctionRegistry:
+    global _REGISTRY
+    if _REGISTRY is None:
+        _REGISTRY = FunctionRegistry()
+    return _REGISTRY

@@ -1,0 +1,102 @@
+"""Polymorphic table functions (ptf).
+
+Reference roles: spi/function/table/ (ConnectorTableFunction, the TABLE(...)
+invocation SPI) and operator/table/SequenceFunction.java,
+ExcludeColumnsFunction.java — the two built-in ptfs the reference ships.
+
+A table function receives its analyzed arguments and returns a logical plan
+(RelationPlan), so invocation composes with the rest of the planner exactly
+like a named relation: `SELECT * FROM TABLE(sequence(1, 1000))`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass(frozen=True)
+class TableFunction:
+    name: str
+    plan: Callable  # (planner, args: [ast.Node], outer, ctes) -> RelationPlan
+    description: str = ""
+
+
+TABLE_FUNCTIONS: dict = {}
+
+
+def register_table_function(name: str, description: str = ""):
+    def deco(fn):
+        TABLE_FUNCTIONS[name] = TableFunction(name, fn, description)
+        return fn
+
+    return deco
+
+
+@register_table_function(
+    "sequence", "rows of sequential bigints: TABLE(sequence(start, stop[, step]))"
+)
+def _tf_sequence(planner, args, outer, ctes):
+    """SequenceFunction.java:61 — start/stop/step literal rows.  Planned as
+    UNNEST over the sequence array (rectangular device layout, one jitted
+    expansion)."""
+    from trino_tpu.planner.analyzer import AnalysisError
+    from trino_tpu.sql import ast
+
+    if not 2 <= len(args) <= 3:
+        raise AnalysisError("sequence(start, stop[, step])")
+    call = ast.FunctionCall("sequence", tuple(args))
+    return planner.plan_unnest(
+        ast.Unnest((call,), False),
+        _single_row(planner),
+        outer,
+        ctes,
+        alias=None,
+        column_aliases=("sequential_number",),
+        keep_left_fields=False,
+    )
+
+
+@register_table_function(
+    "exclude_columns",
+    "drop columns from a relation: TABLE(exclude_columns(TABLE(t), DESCRIPTOR(a, b)))",
+)
+def _tf_exclude_columns(planner, args, outer, ctes):
+    """ExcludeColumnsFunction.java:71 — pass-through minus the descriptor's
+    columns (planned as pruning projection)."""
+    from trino_tpu.planner.analyzer import AnalysisError
+    from trino_tpu.sql import ast
+
+    if len(args) != 2 or not isinstance(args[0], ast.TableArgument):
+        raise AnalysisError(
+            "exclude_columns(TABLE(relation), DESCRIPTOR(col, ...))"
+        )
+    if not isinstance(args[1], ast.Descriptor):
+        raise AnalysisError("second argument must be DESCRIPTOR(col, ...)")
+    rp = planner.plan_relation(args[0].relation, outer, ctes)
+    drop = {c.lower() for c in args[1].columns}
+    missing = drop - {f.name for f in rp.fields}
+    if missing:
+        raise AnalysisError(
+            f"descriptor columns not in relation: {sorted(missing)}"
+        )
+    kept = [f for f in rp.fields if f.name not in drop]
+    if not kept:
+        raise AnalysisError("exclude_columns would remove every column")
+    from trino_tpu.planner import plan as P
+
+    node = P.ProjectNode(rp.node, [(f.symbol, f.symbol.ref()) for f in kept])
+    return _relation(planner, node, kept)
+
+
+def _single_row(planner):
+    from trino_tpu.planner import plan as P
+    from trino_tpu.planner.logical_planner import RelationPlan
+
+    return RelationPlan(P.ValuesNode([], [()]), [])
+
+
+def _relation(planner, node, fields):
+    from trino_tpu.planner.logical_planner import RelationPlan
+
+    return RelationPlan(node, list(fields))

@@ -117,7 +117,15 @@ class LocalExecutionPlanner:
         connector = self.catalogs.get(node.handle.catalog)
         names = [c for _, c in node.assignments]
         types = [s.type for s, _ in node.assignments]
-        splits = list(connector.splits(node.handle, target_splits=self.target_splits))
+        from trino_tpu.connectors.api import scan_predicate_triples
+
+        splits = list(
+            connector.splits(
+                node.handle,
+                target_splits=self.target_splits,
+                predicate=scan_predicate_triples(node),
+            )
+        )
         page_rows = self.properties.get("page_rows")
         use_cache = self.properties.get("scan_cache")
         prefetch_depth = self.properties.get("scan_prefetch_depth")
@@ -182,6 +190,14 @@ class LocalExecutionPlanner:
         op = FilterProjectOperator(None, exprs)
         return PhysicalPlan(op.process(src.stream), [s for s, _ in node.assignments])
 
+    def _visit_UnnestNode(self, node: P.UnnestNode) -> PhysicalPlan:
+        from trino_tpu.ops.unnest import UnnestOperator
+
+        src = self.plan(node.source)
+        exprs = [src.rewrite(e) for _, e in node.unnest]
+        op = UnnestOperator(exprs, with_ordinality=node.ordinality is not None)
+        return PhysicalPlan(op.process(src.stream), node.outputs)
+
     # -- aggregation ----------------------------------------------------------
 
     def _visit_AggregationNode(self, node: P.AggregationNode) -> PhysicalPlan:
@@ -237,6 +253,7 @@ class LocalExecutionPlanner:
             streaming=streaming,
             fold_every=self.properties.get("agg_fold_batches"),
             memory_ctx=self.memory.child("aggregation"),
+            use_pallas=self.properties.get("pallas_agg"),
         )
         stream = op.process(pre.process(src.stream))
         return PhysicalPlan(stream, node.outputs)

@@ -59,6 +59,12 @@ class LocalQueryRunner:
         self.properties.set("target_splits", target_splits)
         self.events = EventListenerManager()
         self.transactions = TransactionManager(self.catalogs)
+        # security (server/security/ + spi/security/SystemAccessControl):
+        # identity set per statement by the coordinator/dbapi layer
+        from trino_tpu.server.security import AllowAllAccessControl
+
+        self.access_control = AllowAllAccessControl()
+        self.user = "user"
         self._query_ids = __import__("itertools").count(1)
         # system.runtime observability (connector/system/ role): query
         # history + nodes + session properties queryable via SQL
@@ -113,6 +119,7 @@ class LocalQueryRunner:
         from trino_tpu.runtime.events import QueryCompletedEvent, QueryCreatedEvent
         from trino_tpu.runtime.retry import execute_with_retry
 
+        self.access_control.check_can_execute_query(self.user)
         stmt = parse_statement(sql)
         m = getattr(self, "_exec_" + type(stmt).__name__, None)
         if m is None:
@@ -139,8 +146,25 @@ class LocalQueryRunner:
         )
         return result
 
+    def _check_table_access(self, plan) -> None:
+        """check_can_select for every scanned table (the reference checks in
+        the analyzer; checking the optimized plan also covers views/CTEs)."""
+        from trino_tpu.planner.plan import TableScanNode
+
+        def walk(node):
+            if isinstance(node, TableScanNode):
+                h = node.handle
+                self.access_control.check_can_select(
+                    self.user, h.catalog, h.schema, h.table
+                )
+            for c in node.children:
+                walk(c)
+
+        walk(plan)
+
     def _run_query(self, query: ast.Query, stats=None) -> MaterializedResult:
         plan = self.plan_query(query)
+        self._check_table_access(plan)
         physical = LocalExecutionPlanner(
             self.catalogs,
             target_splits=self.target_splits,
@@ -248,6 +272,46 @@ class LocalQueryRunner:
                 [(c.name, c.type.name) for c in meta.columns],
                 [T.VARCHAR, T.VARCHAR],
             )
+        if stmt.what == "functions":
+            from trino_tpu.planner.registry import global_registry
+            from trino_tpu.expr.strings import like_to_regex
+
+            rows = [
+                (
+                    m.name,
+                    m.return_type,
+                    ", ".join(m.argument_types),
+                    m.kind,
+                    m.deterministic,
+                    m.description,
+                )
+                for m in global_registry().list()
+            ]
+            if stmt.target:
+                rx = like_to_regex(stmt.target[0])
+                rows = [r for r in rows if rx.match(r[0])]
+            return MaterializedResult(
+                [
+                    "Function",
+                    "Return Type",
+                    "Argument Types",
+                    "Function Type",
+                    "Deterministic",
+                    "Description",
+                ],
+                rows,
+                [T.VARCHAR, T.VARCHAR, T.VARCHAR, T.VARCHAR, T.BOOLEAN, T.VARCHAR],
+            )
+        if stmt.what == "session":
+            rows = [
+                (name, str(value), meta.type.__name__, meta.description)
+                for name, value, meta in sorted(self.properties.items())
+            ]
+            return MaterializedResult(
+                ["Name", "Value", "Type", "Description"],
+                rows,
+                [T.VARCHAR, T.VARCHAR, T.VARCHAR, T.VARCHAR],
+            )
         raise NotImplementedError(f"SHOW {stmt.what}")
 
     def _resolve_table(self, parts: tuple) -> tuple:
@@ -269,6 +333,8 @@ class LocalQueryRunner:
         if stmt.if_not_exists and table in conn.metadata().list_tables(schema):
             return _ok("CREATE TABLE")
         cols = [ColumnMeta(n, T.parse_type(t)) for n, t in stmt.columns]
+        self.access_control.check_can_write(self.user, cat, schema, table)
+        self.transactions.notify_write(cat, schema, table)
         conn.create_table(schema, table, cols)
         return _ok("CREATE TABLE")
 
@@ -283,6 +349,8 @@ class LocalQueryRunner:
         cols = [
             ColumnMeta(n, t) for n, t in zip(result.column_names, result.types)
         ]
+        self.access_control.check_can_write(self.user, cat, schema, table)
+        self.transactions.notify_write(cat, schema, table)
         conn.create_table(schema, table, cols)
         self._write_rows(conn, TableHandle(cat, schema, table), result)
         return MaterializedResult(["rows"], [(result.row_count,)], [])
@@ -308,6 +376,8 @@ class LocalQueryRunner:
                 [c.name for c in meta.columns], reordered,
                 [c.type for c in meta.columns],
             )
+        self.access_control.check_can_write(self.user, cat, schema, table)
+        self.transactions.notify_write(cat, schema, table)
         self._write_rows(conn, TableHandle(cat, schema, table), result)
         return MaterializedResult(["rows"], [(result.row_count,)], [])
 
@@ -318,6 +388,8 @@ class LocalQueryRunner:
         conn = self.catalogs.get(cat)
         if stmt.if_exists and table not in conn.metadata().list_tables(schema):
             return _ok("DROP TABLE")
+        self.access_control.check_can_write(self.user, cat, schema, table)
+        self.transactions.notify_write(cat, schema, table)
         conn.drop_table(TableHandle(cat, schema, table))
         return _ok("DROP TABLE")
 

@@ -68,6 +68,13 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             int,
             2,
         ),
+        PropertyMetadata(
+            "pallas_agg",
+            "use the Pallas MXU one-hot-matmul kernel for eligible "
+            "small-domain float aggregations",
+            bool,
+            False,
+        ),
     ]
 }
 

@@ -2,11 +2,15 @@
 .java — per-connector isolation contexts created at BEGIN, committed or
 aborted atomically per connector).
 
-The engine's write-capable connectors are host-side stores, so transaction
-isolation is snapshot/restore: BEGIN snapshots every write-capable catalog,
-ROLLBACK restores the snapshots, COMMIT discards them.  Connector data
-structures are replace-on-write (appends build new column arrays), so a
-shallow store snapshot is sufficient and O(tables)."""
+Write-capable connectors are host-side replace-on-write stores, so isolation
+is snapshot/restore — taken LAZILY per written table at first write inside
+the transaction (the reference's ConnectorTransactionHandle created on first
+use).  ROLLBACK restores only tables this transaction wrote, so concurrent
+autocommit writes to OTHER tables survive an unrelated rollback.  Write-write
+conflicts on the SAME table between a transaction and concurrent autocommit
+statements are not detected (last writer wins) — the reference's
+READ_UNCOMMITTED-adjacent behavior for in-memory catalogs, documented here.
+"""
 
 from __future__ import annotations
 
@@ -17,34 +21,65 @@ class TransactionError(RuntimeError):
     pass
 
 
+_MISSING = object()  # table did not exist at first write
+
+
 class TransactionManager:
     def __init__(self, catalogs):
         self.catalogs = catalogs
-        self._snapshots: Optional[dict] = None
+        self._active = False
+        #: (catalog, schema, table) -> pre-write snapshot (or _MISSING)
+        self._table_snaps: Optional[dict] = None
+        #: catalog -> whole-store snapshot (fallback for connectors without
+        #: table-granular snapshot support)
+        self._catalog_snaps: Optional[dict] = None
 
     @property
     def active(self) -> bool:
-        return self._snapshots is not None
+        return self._active
 
     def begin(self) -> None:
-        if self.active:
+        if self._active:
             raise TransactionError("transaction already in progress")
-        snaps = {}
-        for name in self.catalogs.names():
-            conn = self.catalogs.get(name)
-            snap = getattr(conn, "snapshot", None)
-            if snap is not None and conn.supports_writes():
-                snaps[name] = conn.snapshot()
-        self._snapshots = snaps
+        self._active = True
+        self._table_snaps = {}
+        self._catalog_snaps = {}
+
+    def notify_write(self, catalog: str, schema: str, table: str) -> None:
+        """Called by the engine BEFORE any DDL/DML mutation.  First write to
+        a table inside the transaction snapshots just that table."""
+        if not self._active:
+            return
+        conn = self.catalogs.get(catalog)
+        if not conn.supports_writes():
+            return
+        key = (catalog, schema, table)
+        if key in self._table_snaps or catalog in self._catalog_snaps:
+            return
+        snap_table = getattr(conn, "snapshot_table", None)
+        if snap_table is not None:
+            self._table_snaps[key] = snap_table(schema, table)
+        elif getattr(conn, "snapshot", None) is not None:
+            self._catalog_snaps[catalog] = conn.snapshot()
 
     def commit(self) -> None:
-        if not self.active:
+        if not self._active:
             raise TransactionError("no transaction in progress")
-        self._snapshots = None
+        self._active = False
+        self._table_snaps = None
+        self._catalog_snaps = None
 
     def rollback(self) -> None:
-        if not self.active:
+        if not self._active:
             raise TransactionError("no transaction in progress")
-        for name, snap in self._snapshots.items():
-            self.catalogs.get(name).restore(snap)
-        self._snapshots = None
+        for (catalog, schema, table), snap in self._table_snaps.items():
+            conn = self.catalogs.get(catalog)
+            conn.restore_table(schema, table, snap)
+        for catalog, snap in self._catalog_snaps.items():
+            self.catalogs.get(catalog).restore(snap)
+        self._active = False
+        self._table_snaps = None
+        self._catalog_snaps = None
+
+
+MISSING = _MISSING

@@ -18,6 +18,7 @@ from __future__ import annotations
 import itertools
 import json
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -68,11 +69,17 @@ class CoordinatorServer:
         host: str = "127.0.0.1",
         port: int = 8080,
         resource_groups=None,
+        authenticator=None,
+        access_control=None,
     ):
         from trino_tpu.runtime.resource_groups import ResourceGroupManager
         from trino_tpu.runtime.runner import LocalQueryRunner
 
         self.runner = runner or LocalQueryRunner()
+        #: optional PasswordAuthenticator (AuthenticationFilter role)
+        self.authenticator = authenticator
+        if access_control is not None:
+            self.runner.access_control = access_control
         self.host = host
         self.port = port
         self._queries: dict[str, _Query] = {}
@@ -86,6 +93,7 @@ class CoordinatorServer:
         #: not concurrency-safe — one execution at a time regardless of group
         self._engine_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self.started_at = time.monotonic()
 
     # -- query lifecycle ------------------------------------------------------
 
@@ -109,6 +117,9 @@ class CoordinatorServer:
                 return
             try:
                 with self._engine_lock:
+                    # statement identity: the lock serializes executions, so
+                    # the per-statement user is race-free
+                    self.runner.user = user or "user"
                     q.run(self.runner)
             finally:
                 group.release()
@@ -137,11 +148,17 @@ class CoordinatorServer:
                 self.wfile.write(body)
 
             def do_POST(self):
+                from trino_tpu.server.security import AuthenticationError
+
                 if self.path != "/v1/statement":
                     return self._send(404, {"error": {"message": "not found"}})
                 n = int(self.headers.get("Content-Length", 0))
                 sql = self.rfile.read(n).decode()
-                user = self.headers.get("X-Trino-User")
+                try:
+                    auth_user = self._authenticate()
+                except AuthenticationError:
+                    return
+                user = auth_user or self.headers.get("X-Trino-User")
                 q = server.submit(sql, user=user)
                 self._send(
                     200,
@@ -152,7 +169,49 @@ class CoordinatorServer:
                     ),
                 )
 
+            def _authenticate(self):
+                """When an authenticator is configured, EVERY request needs
+                credentials — result paging and the UI expose query text and
+                data, not just statement submission."""
+                if server.authenticator is None:
+                    return None
+                from trino_tpu.server.security import AuthenticationError
+
+                try:
+                    return server.authenticator.authenticate_basic(
+                        self.headers.get("Authorization")
+                    )
+                except AuthenticationError as e:
+                    self._send(
+                        401,
+                        {
+                            "error": {
+                                "message": str(e),
+                                "errorName": "AUTHENTICATION_FAILED",
+                            }
+                        },
+                    )
+                    raise
+
             def do_GET(self):
+                from trino_tpu.server.security import AuthenticationError
+
+                try:
+                    self._authenticate()
+                except AuthenticationError:
+                    return
+                if self.path.startswith("/ui"):
+                    from trino_tpu.server.ui import handle_ui_get
+
+                    out = handle_ui_get(server, self.path)
+                    if out is not None:
+                        status, ctype, body = out
+                        self.send_response(status)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                 parts = self.path.strip("/").split("/")
                 # /v1/statement/executing/{id}/{token}
                 if len(parts) != 5 or parts[:3] != ["v1", "statement", "executing"]:
@@ -196,6 +255,12 @@ class CoordinatorServer:
                 )
 
             def do_DELETE(self):
+                from trino_tpu.server.security import AuthenticationError
+
+                try:
+                    self._authenticate()
+                except AuthenticationError:
+                    return
                 parts = self.path.strip("/").split("/")
                 if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
                     server._queries.pop(parts[3], None)

@@ -1,0 +1,181 @@
+"""Authentication + access control.
+
+Reference roles: server/security/AuthenticationFilter.java (request
+authentication), plugin/trino-password-file (PasswordAuthenticator), and
+spi/security/SystemAccessControl + the file-based access control plugin
+(plugin/trino-file-based-access-control rules: user/catalog/schema/table
+patterns with privilege sets).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import re
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+class AccessDeniedError(PermissionError):
+    pass
+
+
+class AuthenticationError(PermissionError):
+    pass
+
+
+# -- authentication (password-file plugin role) ------------------------------
+
+
+class PasswordAuthenticator:
+    """user -> salted-hash store; constant-time verification."""
+
+    def __init__(self, users: Optional[dict] = None):
+        #: user -> (salt, sha256(salt + password))
+        self._users: dict[str, tuple] = {}
+        for user, password in (users or {}).items():
+            self.set_password(user, password)
+
+    def set_password(self, user: str, password: str) -> None:
+        salt = hashlib.sha256(user.encode()).hexdigest()[:16]
+        digest = hashlib.sha256((salt + password).encode()).hexdigest()
+        self._users[user] = (salt, digest)
+
+    def authenticate(self, user: str, password: str) -> bool:
+        entry = self._users.get(user)
+        if entry is None:
+            return False
+        salt, expect = entry
+        got = hashlib.sha256((salt + password).encode()).hexdigest()
+        return hmac.compare_digest(got, expect)
+
+    @classmethod
+    def from_file(cls, path: str) -> "PasswordAuthenticator":
+        """password file: `user:password` lines (the password-file plugin's
+        format, plaintext variant for tests)."""
+        auth = cls()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                user, _, password = line.partition(":")
+                auth.set_password(user, password)
+        return auth
+
+    def authenticate_basic(self, header: Optional[str]) -> str:
+        """Authorization: Basic ... -> user, or raise."""
+        if not header or not header.startswith("Basic "):
+            raise AuthenticationError("missing basic credentials")
+        try:
+            raw = base64.b64decode(header[6:]).decode()
+            user, _, password = raw.partition(":")
+        except Exception as e:
+            raise AuthenticationError("malformed basic credentials") from e
+        if not self.authenticate(user, password):
+            raise AuthenticationError(f"invalid credentials for {user}")
+        return user
+
+
+# -- access control (SystemAccessControl + file-based rules role) ------------
+
+
+@dataclass(frozen=True)
+class AccessRule:
+    """One rule: patterns + allowed privileges, first match wins."""
+
+    user: str = ".*"
+    catalog: str = ".*"
+    schema: str = ".*"
+    table: str = ".*"
+    privileges: tuple = ("SELECT", "INSERT", "DELETE", "OWNERSHIP")
+
+    def matches(self, user: str, catalog: str, schema: str, table: str) -> bool:
+        return (
+            re.fullmatch(self.user, user) is not None
+            and re.fullmatch(self.catalog, catalog) is not None
+            and re.fullmatch(self.schema, schema) is not None
+            and re.fullmatch(self.table, table) is not None
+        )
+
+
+class AccessControl:
+    """SPI (spi/security/SystemAccessControl)."""
+
+    def check_can_execute_query(self, user: str) -> None:
+        pass
+
+    def check_can_select(
+        self, user: str, catalog: str, schema: str, table: str
+    ) -> None:
+        pass
+
+    def check_can_write(
+        self, user: str, catalog: str, schema: str, table: str
+    ) -> None:
+        pass
+
+    def filter_catalogs(self, user: str, catalogs: Sequence[str]) -> list:
+        return list(catalogs)
+
+
+class AllowAllAccessControl(AccessControl):
+    pass
+
+
+class RuleBasedAccessControl(AccessControl):
+    """File-based access control semantics: first matching rule decides;
+    no matching rule denies."""
+
+    def __init__(self, rules: Sequence[AccessRule], query_users: str = ".*"):
+        self.rules = list(rules)
+        self.query_users = query_users
+
+    @classmethod
+    def from_dicts(cls, rules: Sequence[dict], **kw) -> "RuleBasedAccessControl":
+        return cls(
+            [
+                AccessRule(
+                    user=r.get("user", ".*"),
+                    catalog=r.get("catalog", ".*"),
+                    schema=r.get("schema", ".*"),
+                    table=r.get("table", ".*"),
+                    privileges=tuple(
+                        p.upper() for p in r.get("privileges", ())
+                    ),
+                )
+                for r in rules
+            ],
+            **kw,
+        )
+
+    def check_can_execute_query(self, user: str) -> None:
+        if re.fullmatch(self.query_users, user) is None:
+            raise AccessDeniedError(f"user {user} cannot execute queries")
+
+    def _check(self, priv, user, catalog, schema, table) -> None:
+        for rule in self.rules:
+            if rule.matches(user, catalog, schema, table):
+                if priv in rule.privileges:
+                    return
+                break  # first match decides
+        raise AccessDeniedError(
+            f"user {user} lacks {priv} on {catalog}.{schema}.{table}"
+        )
+
+    def check_can_select(self, user, catalog, schema, table) -> None:
+        self._check("SELECT", user, catalog, schema, table)
+
+    def check_can_write(self, user, catalog, schema, table) -> None:
+        self._check("INSERT", user, catalog, schema, table)
+
+    def filter_catalogs(self, user: str, catalogs: Sequence[str]) -> list:
+        out = []
+        for c in catalogs:
+            if any(
+                re.fullmatch(r.user, user) and re.fullmatch(r.catalog, c)
+                for r in self.rules
+            ):
+                out.append(c)
+        return out

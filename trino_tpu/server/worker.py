@@ -1,0 +1,274 @@
+"""Worker server: remote task execution over HTTP (the multi-host tier).
+
+Reference roles: server/SqlTaskManager + TaskResource (/v1/task REST API) on
+the worker side, TaskExecutor for the execution slot, and the HTTP data
+plane of exchange/ExchangeClient: task outputs are partitioned buckets that
+downstream tasks PULL with GET /v1/task/{id}/results/{bucket}.
+
+The multi-host layer complements the in-mesh SPMD path: intra-host
+parallelism is XLA collectives over the device mesh (parallel/runner.py);
+inter-host distribution is fragments shipped to worker processes with HTTP
+exchanges — the DCN tier, matching the reference's worker-to-worker shuffle.
+
+Wire format: pickled plan fragments (trusted intra-cluster traffic, the role
+of the reference's internal thrift/json codecs) + PagesSerde buckets
+(parallel/serde.py).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import traceback
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+
+@dataclass
+class TaskDescriptor:
+    """One fragment execution on one worker."""
+
+    task_id: str
+    fragment_root: object  # PlanNode
+    output_symbols: list
+    #: RemoteSourceNode inputs: fragment_id -> list of result URLs (one per
+    #: producing task; the bucket for THIS task is already in the URL)
+    inputs: dict = field(default_factory=dict)
+    #: output partitioning: (channels, n_buckets) or None for a single bucket
+    output_partitioning: Optional[tuple] = None
+    #: split assignment for leaf scans: (worker_index, total_workers)
+    split_mod: Optional[tuple] = None
+    #: session properties to apply
+    properties: dict = field(default_factory=dict)
+
+
+class _FilteringConnector:
+    """Delegates to a connector but serves only splits with
+    seq % total == index (the coordinator's split assignment)."""
+
+    def __init__(self, inner, index: int, total: int):
+        self._inner = inner
+        self._index = index
+        self._total = total
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def splits(self, handle, target_splits, predicate=None):
+        out = [
+            s
+            for s in self._inner.splits(
+                handle, target_splits=max(target_splits, self._total),
+                predicate=predicate,
+            )
+            if s.seq % self._total == self._index
+        ]
+        return out
+
+
+class _Task:
+    def __init__(self, desc: TaskDescriptor):
+        self.desc = desc
+        self.state = "RUNNING"
+        self.error: Optional[str] = None
+        self.buckets: list = []
+        self.done = threading.Event()
+
+
+class WorkerServer:
+    """One worker process: accepts tasks, executes fragments, serves
+    result buckets."""
+
+    def __init__(self, catalogs=None, port: int = 0):
+        from trino_tpu.connectors.api import default_catalogs
+
+        self.catalogs = catalogs or default_catalogs()
+        self._tasks: dict[str, _Task] = {}
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _bytes(self, code: int, body: bytes, ctype="application/octet-stream"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/v1/task":
+                    return self._bytes(404, b"not found", "text/plain")
+                n = int(self.headers.get("Content-Length", 0))
+                desc = pickle.loads(self.rfile.read(n))
+                t = worker.submit(desc)
+                self._bytes(200, t.desc.task_id.encode(), "text/plain")
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if parts == ["v1", "info"]:
+                    self._bytes(200, b'{"state": "ACTIVE"}', "application/json")
+                    return
+                if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                    t = worker._tasks.get(parts[2])
+                    if t is None:
+                        return self._bytes(404, b"no such task", "text/plain")
+                    t.done.wait(timeout=1.0)
+                    body = (
+                        t.state
+                        if t.error is None
+                        else f"{t.state}\n{t.error}"
+                    ).encode()
+                    return self._bytes(200, body, "text/plain")
+                if (
+                    len(parts) == 5
+                    and parts[:2] == ["v1", "task"]
+                    and parts[3] == "results"
+                ):
+                    t = worker._tasks.get(parts[2])
+                    if t is None:
+                        return self._bytes(404, b"no such task", "text/plain")
+                    t.done.wait(timeout=600)
+                    if t.state != "FINISHED":
+                        return self._bytes(
+                            500, (t.error or "task failed").encode(), "text/plain"
+                        )
+                    bucket = int(parts[4])
+                    return self._bytes(200, t.buckets[bucket])
+                self._bytes(404, b"not found", "text/plain")
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                    worker._tasks.pop(parts[2], None)
+                self._bytes(200, b"ok", "text/plain")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "WorkerServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="worker"
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- task execution (SqlTaskExecution role) ------------------------------
+
+    def submit(self, desc: TaskDescriptor) -> _Task:
+        t = _Task(desc)
+        self._tasks[desc.task_id] = t
+        threading.Thread(
+            target=self._run, args=(t,), daemon=True, name=desc.task_id
+        ).start()
+        return t
+
+    def _run(self, t: _Task) -> None:
+        try:
+            t.buckets = self._execute(t.desc)
+            t.state = "FINISHED"
+        except Exception:
+            t.state = "FAILED"
+            t.error = traceback.format_exc()
+        finally:
+            t.done.set()
+
+    def _execute(self, desc: TaskDescriptor) -> list:
+        from trino_tpu.columnar.batch import concat_batches
+        from trino_tpu.parallel.serde import (
+            batches_to_bytes,
+            bytes_to_batches,
+            partition_batches,
+        )
+        from trino_tpu.planner.fragmenter import RemoteSourceNode
+        from trino_tpu.runtime.local_planner import (
+            LocalExecutionPlanner,
+            PhysicalPlan,
+        )
+        from trino_tpu.runtime.session import SessionProperties
+
+        catalogs = self.catalogs
+        if desc.split_mod is not None:
+            index, total = desc.split_mod
+            catalogs = _FilteringCatalogs(self.catalogs, index, total)
+
+        props = SessionProperties()
+        for k, v in desc.properties.items():
+            props.set(k, v)
+        lp = LocalExecutionPlanner(
+            catalogs, target_splits=props.get("target_splits"), properties=props
+        )
+        saved = lp.plan
+
+        def hook(node):
+            if isinstance(node, RemoteSourceNode):
+                batches = []
+                for url in desc.inputs.get(node.fragment_id, ()):
+                    batches.extend(bytes_to_batches(_http_get(url)))
+                return PhysicalPlan(iter(batches), node.symbols)
+            return saved(node)
+
+        lp.plan = hook
+        out = lp.plan(desc.fragment_root)
+        batches = [b for b in out.stream]
+        if not batches:
+            return [batches_to_bytes([])] * (
+                desc.output_partitioning[1] if desc.output_partitioning else 1
+            )
+        if desc.output_partitioning is None:
+            return [batches_to_bytes(batches)]
+        channels, n = desc.output_partitioning
+        host = concat_batches(batches)
+        import jax
+
+        host = jax.device_get(host)
+        buckets = partition_batches([host], channels, n)
+        return [batches_to_bytes(bs) for bs in buckets]
+
+
+class _FilteringCatalogs:
+    def __init__(self, inner, index: int, total: int):
+        self._inner = inner
+        self._index = index
+        self._total = total
+
+    def get(self, name: str):
+        return _FilteringConnector(self._inner.get(name), self._index, self._total)
+
+    def names(self):
+        return self._inner.names()
+
+    def register(self, name, connector):
+        self._inner.register(name, connector)
+
+
+def _http_get(url: str, timeout: float = 600.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def main():  # pragma: no cover - manual entry point
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    w = WorkerServer(port=args.port)
+    print(f"worker listening on {w.url}", flush=True)
+    w._httpd.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

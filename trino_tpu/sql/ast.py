@@ -244,6 +244,29 @@ class Unnest(Node):
 
 
 @dataclass(frozen=True)
+class TableArgument(Node):
+    """TABLE(relation) argument to a table function (spi table argument)."""
+
+    relation: Node
+
+
+@dataclass(frozen=True)
+class Descriptor(Node):
+    """DESCRIPTOR(col, ...) argument to a table function."""
+
+    columns: tuple
+
+
+@dataclass(frozen=True)
+class TableFunctionCall(Node):
+    """TABLE(fn(args...)) relation (reference: spi/function/table/
+    ConnectorTableFunction invocation)."""
+
+    name: str
+    args: tuple  # of expression / TableArgument / Descriptor nodes
+
+
+@dataclass(frozen=True)
 class ValuesRelation(Node):
     rows: tuple  # of tuples of expressions
 

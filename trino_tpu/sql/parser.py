@@ -162,6 +162,13 @@ class Parser:
             if what.is_kw("columns"):
                 self.expect_kw("from", "in")
                 return ast.ShowStatement("columns", self.qualified_name())
+            if what.kind == "ident" and what.value.lower() == "functions":
+                target = ()
+                if self.accept_kw("like"):
+                    target = (self.next().value,)
+                return ast.ShowStatement("functions", target)
+            if what.is_kw("session"):
+                return ast.ShowStatement("session")
             raise ParseError("unsupported SHOW", what)
         if t.is_kw("describe"):
             self.next()
@@ -476,6 +483,17 @@ class Parser:
                 q = self._query()
                 self.expect_op(")")
                 return ast.SubqueryRelation(q)
+            if inner.kind == "op" and inner.value == "(":
+                # `((select ...) INTERSECT (select ...))`-style parenthesized
+                # set operation: try a query body first, backtrack to a
+                # plain parenthesized relation on failure
+                save = self.i
+                try:
+                    q = self._query()
+                    self.expect_op(")")
+                    return ast.SubqueryRelation(q)
+                except ParseError:
+                    self.i = save
             r = self._relation()
             self.expect_op(")")
             return r
@@ -491,6 +509,12 @@ class Parser:
                 self.expect_kw("ordinality")
                 with_ord = True
             return ast.Unnest(tuple(exprs), with_ord)
+        if t.is_kw("table"):
+            self.next()
+            self.expect_op("(")
+            r = self._table_arg_body()
+            self.expect_op(")")
+            return r
         if t.is_kw("lateral"):
             self.next()
             self.expect_op("(")
@@ -498,6 +522,57 @@ class Parser:
             self.expect_op(")")
             return ast.SubqueryRelation(q)  # analyzer handles correlation
         return ast.TableRef(self.qualified_name())
+
+    def _table_arg_body(self) -> ast.Node:
+        """Inside TABLE( ... ): either a ptf invocation fn(args) or a plain
+        relation name (the reference's table-argument shorthand)."""
+        t = self.peek()
+        nxt = self.peek(1)
+        if (
+            t.kind in ("ident", "qident")
+            and nxt.kind == "op"
+            and nxt.value == "("
+        ):
+            name = self.ident().lower()
+            self.expect_op("(")
+            args: list = []
+            if not (self.peek().kind == "op" and self.peek().value == ")"):
+                args.append(self._table_fn_arg())
+                while self.accept_op(","):
+                    args.append(self._table_fn_arg())
+            self.expect_op(")")
+            return ast.TableFunctionCall(name, tuple(args))
+        return ast.TableRef(self.qualified_name())
+
+    def _table_fn_arg(self) -> ast.Node:
+        t = self.peek()
+        if t.is_kw("table"):
+            self.next()
+            self.expect_op("(")
+            rel = self._table_arg_body()
+            self.expect_op(")")
+            return ast.TableArgument(rel)
+        if t.kind == "ident" and t.value.lower() == "descriptor":
+            nxt = self.peek(1)
+            if nxt.kind == "op" and nxt.value == "(":
+                self.next()
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                return ast.Descriptor(tuple(cols))
+        # named argument `name => value` (value may itself be TABLE/DESCRIPTOR)
+        nxt = self.peek(1)
+        if (
+            t.kind in ("ident", "qident", "keyword")
+            and nxt.kind == "op"
+            and nxt.value == "=>"
+        ):
+            self.next()
+            self.next()
+            return self._table_fn_arg()
+        return self._expr()
 
     # -- expressions (Pratt) -------------------------------------------------
 
@@ -613,6 +688,13 @@ class Parser:
                 e = ast.TimestampLiteral(self.next().value)
             else:
                 e = ast.Identifier(("timestamp",))
+        elif (
+            t.kind == "ident"
+            and t.value.lower() == "decimal"
+            and self.peek().kind == "string"
+        ):
+            # DECIMAL '1.23' typed literal
+            e = ast.NumberLiteral(self.next().value)
         elif t.is_kw("interval"):
             sign = 1
             if self.accept_op("-"):
