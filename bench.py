@@ -131,17 +131,34 @@ def _run(args) -> dict:
     nrows = TpchGenerator(SCHEMAS.get(schema, args.sf)).row_count("lineitem")
 
     headline = args.query
-    suite = [headline] if args.query_only else sorted({headline} | {1, 3, 6, 18})
+    if args.query_only:
+        suite = [headline]
+    else:
+        # headline first, then cheap-to-expensive so a budget cut drops the
+        # slowest configs, never the headline
+        rest = [q for q in (1, 6, 3, 18) if q != headline]
+        suite = [headline] + rest
     walls: dict = {}
+    try:
+        budget = float(os.environ.get("BENCH_BUDGET_S", 900))
+    except ValueError:
+        budget = 900.0  # a typo in the safety knob must not kill the bench
+    t_start = time.perf_counter()
     for q in suite:
+        if q != headline and time.perf_counter() - t_start > budget:
+            # a partial result beats a driver-killed bench with no JSON line
+            walls[q] = {"skipped": "bench time budget exhausted"}
+            continue
         try:
-            walls[q] = _engine_time(runner, QUERIES[q], args.runs)
+            runs = args.runs if q == headline else max(1, args.runs // 2)
+            walls[q] = _engine_time(runner, QUERIES[q], runs)
         except Exception as exc:
             walls[q] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
 
     extras: dict = {}
     if not args.query_only:
-        extras.update(_extra_configs(args))
+        deadline = t_start + budget
+        extras.update(_extra_configs(args, deadline))
 
     head = walls[headline]
     wall = head.get("warm_s")
@@ -184,10 +201,16 @@ def _run(args) -> dict:
     }
 
 
-def _extra_configs(args) -> dict:
+def _extra_configs(args, deadline: float) -> dict:
     """BASELINE configs beyond TPC-H: TPC-DS Q64 (config #4) and the
-    parquet scan path (config #5's PageSource -> scan shape)."""
+    parquet scan path (config #5's PageSource -> scan shape).  Each config
+    checks the shared deadline before starting — a budget cut skips the
+    remaining configs rather than risking the driver's patience."""
     out: dict = {}
+    if time.perf_counter() > deadline:
+        out["tpcds_tiny_q64"] = {"skipped": "bench time budget exhausted"}
+        out["parquet_tiny_q6"] = {"skipped": "bench time budget exhausted"}
+        return out
     try:
         from trino_tpu.connectors.tpcds.queries import QUERIES as DS
         from trino_tpu.runtime.runner import LocalQueryRunner
@@ -197,6 +220,9 @@ def _extra_configs(args) -> dict:
         out["tpcds_tiny_q64"] = {k: round(v, 4) for k, v in w.items()}
     except Exception as exc:
         out["tpcds_tiny_q64"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    if time.perf_counter() > deadline:
+        out["parquet_tiny_q6"] = {"skipped": "bench time budget exhausted"}
+        return out
     try:
         import tempfile
 
@@ -253,9 +279,10 @@ def main() -> None:
     ap.add_argument(
         "--tpu-timeout",
         type=float,
-        default=float(os.environ.get("BENCH_TPU_TIMEOUT", 2400)),
+        default=float(os.environ.get("BENCH_TPU_TIMEOUT", 1200)),
         help="seconds before a hung TPU run falls back to CPU (the axon "
-        "tunnel can wedge mid-run AFTER a successful probe)",
+        "tunnel can wedge mid-run AFTER a successful probe; a healthy "
+        "warm-cache run completes well under this)",
     )
     args = ap.parse_args()
 
