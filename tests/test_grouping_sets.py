@@ -1,0 +1,151 @@
+"""GROUPING SETS / ROLLUP / CUBE (reference: SqlBase.g4:273-275
+groupingElement, sql/planner/plan/GroupIdNode.java, QueryPlanner
+.planGroupingSets).  Oracle: pandas per-set groupby + concat — grouping sets
+are exactly a union of per-set aggregations."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.test_e2e import assert_rows_match
+from trino_tpu.runtime.runner import LocalQueryRunner
+from trino_tpu.testing import tpch_pandas
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+@pytest.fixture(scope="module")
+def nation():
+    return tpch_pandas("tiny", "nation")
+
+
+def _per_set_counts(df, all_keys, sets, value_col, how):
+    """Expected rows: for each grouping set, aggregate with its keys
+    (non-member key columns NULL) — the definition of grouping sets."""
+    out = []
+    for s in sets:
+        if s:
+            g = df.groupby(list(s))[value_col]
+            agg = (g.size() if how == "count" else getattr(g, how)()).reset_index(
+                name="v"
+            )
+            for _, row in agg.iterrows():
+                out.append(
+                    tuple(row[k] if k in s else None for k in all_keys)
+                    + (row["v"],)
+                )
+        else:
+            v = len(df) if how == "count" else getattr(df[value_col], how)()
+            out.append((None,) * len(all_keys) + (v,))
+    return out
+
+
+def test_rollup(runner, nation):
+    df = nation.assign(g=nation.n_nationkey % 3)
+    sets = [("n_regionkey", "g"), ("n_regionkey",), ()]
+    exp = _per_set_counts(df, ("n_regionkey", "g"), sets, "n_nationkey", "count")
+    got = runner.execute(
+        "select n_regionkey, n_nationkey%3 as g, count(*) c "
+        "from nation group by rollup(n_regionkey, n_nationkey%3)"
+    ).rows
+    assert_rows_match(got, exp, ordered=False)
+
+
+def test_cube(runner, nation):
+    df = nation.assign(g=nation.n_nationkey % 2)
+    sets = [("n_regionkey", "g"), ("n_regionkey",), ("g",), ()]
+    exp = _per_set_counts(df, ("n_regionkey", "g"), sets, "n_nationkey", "sum")
+    got = runner.execute(
+        "select n_regionkey, n_nationkey%2 as g, sum(n_nationkey) s "
+        "from nation group by cube(n_regionkey, n_nationkey%2)"
+    ).rows
+    assert_rows_match(got, exp, ordered=False)
+
+
+def test_grouping_sets_explicit_with_varchar_key(runner, nation):
+    got = runner.execute(
+        "select n_name, n_regionkey, count(*) c from nation "
+        "group by grouping sets ((n_name, n_regionkey), (n_regionkey), ())"
+    ).rows
+    exp = []
+    for _, row in nation.groupby(["n_name", "n_regionkey"]).size().reset_index(
+        name="c"
+    ).iterrows():
+        exp.append((row.n_name, row.n_regionkey, row.c))
+    for _, row in nation.groupby("n_regionkey").size().reset_index(name="c").iterrows():
+        exp.append((None, row.n_regionkey, row.c))
+    exp.append((None, None, len(nation)))
+    assert_rows_match(got, exp, ordered=False)
+
+
+def test_grouping_function(runner):
+    got = runner.execute(
+        "select n_regionkey, grouping(n_regionkey) g, count(*) c "
+        "from nation group by rollup(n_regionkey) order by g, n_regionkey"
+    ).rows
+    # 5 regions with grouping()=0, one total row with grouping()=1
+    assert got[-1][1] == 1 and got[-1][2] == 25
+    assert all(r[1] == 0 for r in got[:-1])
+    assert sum(r[2] for r in got[:-1]) == 25
+
+
+def test_grouping_bitmask_order(runner):
+    rows = runner.execute(
+        "select n_regionkey, n_nationkey%2 as g, "
+        "grouping(n_regionkey, n_nationkey%2) gm, count(*) c "
+        "from nation group by grouping sets ((n_regionkey), (n_nationkey%2))"
+    ).rows
+    # set (n_regionkey): second arg ungrouped -> mask 0b01; set (g): 0b10
+    masks = {r[2] for r in rows}
+    assert masks == {1, 2}
+    for r in rows:
+        if r[2] == 1:
+            assert r[1] is None and r[0] is not None
+        else:
+            assert r[0] is None and r[1] is not None
+
+
+def test_rollup_with_having_on_grouping(runner):
+    rows = runner.execute(
+        "select n_regionkey, count(*) c from nation "
+        "group by rollup(n_regionkey) having grouping(n_regionkey) = 1"
+    ).rows
+    assert rows == [(None, 25)]
+
+
+def test_group_by_mixed_plain_and_rollup(runner, nation):
+    # GROUP BY a, ROLLUP(b) = sets {(a,b), (a)}
+    df = nation.assign(g=nation.n_nationkey % 2)
+    got = runner.execute(
+        "select n_regionkey, n_nationkey%2 as g, count(*) c "
+        "from nation group by n_regionkey, rollup(n_nationkey%2)"
+    ).rows
+    exp = []
+    for _, row in df.groupby(["n_regionkey", "g"]).size().reset_index(
+        name="c"
+    ).iterrows():
+        exp.append((row.n_regionkey, row.g, row.c))
+    for _, row in df.groupby("n_regionkey").size().reset_index(name="c").iterrows():
+        exp.append((row.n_regionkey, None, row.c))
+    assert_rows_match(got, exp, ordered=False)
+
+
+def test_rollup_avg_and_multiple_aggs(runner, nation):
+    got = runner.execute(
+        "select n_regionkey, count(*) c, sum(n_nationkey) s, "
+        "avg(n_nationkey) a from nation group by rollup(n_regionkey)"
+    ).rows
+    df = nation
+    exp = []
+    for _, row in (
+        df.groupby("n_regionkey")
+        .agg(c=("n_nationkey", "size"), s=("n_nationkey", "sum"), a=("n_nationkey", "mean"))
+        .reset_index()
+        .iterrows()
+    ):
+        exp.append((row.n_regionkey, row.c, row.s, row.a))
+    exp.append((None, len(df), df.n_nationkey.sum(), df.n_nationkey.mean()))
+    assert_rows_match(got, exp, ordered=False)

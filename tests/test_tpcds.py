@@ -136,12 +136,23 @@ def assert_same_rows(actual, expected):
 @pytest.mark.parametrize("qid", sorted(QUERIES))
 def test_tpcds_query_vs_oracle(runner, qid):
     """Every workload query executes end-to-end AND matches the independent
-    sqlite3 oracle (reference style: H2QueryRunner assertQuery)."""
+    sqlite3 oracle (reference style: H2QueryRunner assertQuery).
+
+    ROLLUP queries (sqlite has no grouping sets) check through a chain:
+    engine(rollup) == engine(union-expansion) == sqlite(union-expansion) —
+    see tests/tpcds_rollup_equiv.py."""
     from tests.tpcds_oracle import run_sqlite
+    from tests.tpcds_rollup_equiv import EQUIV
 
     engine = runner.execute(QUERIES[qid])
-    oracle = run_sqlite(QUERIES[qid])
-    assert_same_rows(engine.rows, oracle)
+    if qid in EQUIV:
+        expanded = runner.execute(EQUIV[qid])
+        assert_same_rows(engine.rows, expanded.rows)
+        oracle = run_sqlite(EQUIV[qid])
+        assert_same_rows(expanded.rows, oracle)
+    else:
+        oracle = run_sqlite(QUERIES[qid])
+        assert_same_rows(engine.rows, oracle)
 
 
 def test_q96_matches_pandas(runner):

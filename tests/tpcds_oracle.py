@@ -104,6 +104,27 @@ def tpcds_sqlite(schema: str = "tiny") -> sqlite3.Connection:
     from trino_tpu.connectors.tpcds.schema import TABLES
 
     conn = sqlite3.connect(":memory:")
+
+    class _StddevSamp:
+        """stddev_samp for sqlite (absent natively; Welford)."""
+
+        def __init__(self):
+            self.n, self.mean, self.m2 = 0, 0.0, 0.0
+
+        def step(self, v):
+            if v is None:
+                return
+            self.n += 1
+            d = v - self.mean
+            self.mean += d / self.n
+            self.m2 += d * (v - self.mean)
+
+        def finalize(self):
+            if self.n < 2:
+                return None
+            return (self.m2 / (self.n - 1)) ** 0.5
+
+    conn.create_aggregate("stddev_samp", 1, _StddevSamp)
     _register_stats_aggregates(conn)
     c = TpcdsConnector()
     meta = c.metadata()

@@ -1,0 +1,567 @@
+"""UNION ALL expansions of the ROLLUP TPC-DS queries.
+
+sqlite has no GROUPING SETS, so these rollup queries are oracle-checked
+through a chain: engine(rollup) == engine(union-expansion) and
+engine(union-expansion) == sqlite(union-expansion).  The expansion is the
+textbook rollup semantics (one plain GROUP BY per level, masked keys NULL,
+grouping() replaced by per-level literals), so the first equality validates
+the GroupId lowering and the second validates everything else.
+"""
+
+# Q5/Q77/Q80 share the rollup tail `group by rollup(channel, id)` over a
+# derived union `x`; the expansion wraps the SAME x three ways.
+def _channel_id_rollup(body: str) -> str:
+    return f"""
+select channel, id, sum(sales) as sales, sum(returns_) as returns_,
+       sum(profit) as profit
+from ({body}) x
+group by channel, id
+union all
+select channel, null as id, sum(sales), sum(returns_), sum(profit)
+from ({body}) x
+group by channel
+union all
+select null as channel, null as id, sum(sales), sum(returns_), sum(profit)
+from ({body}) x
+order by channel nulls last, id nulls last
+limit 100
+"""
+
+
+_Q5_BODY = """
+    select 'store channel' as channel, 'store' || s_store_id as id,
+           sales, returns_, profit - profit_loss as profit
+    from (select s_store_id,
+                 sum(sales_price) as sales, sum(profit) as profit,
+                 sum(return_amt) as returns_, sum(net_loss) as profit_loss
+          from (select ss_store_sk as store_sk, ss_sold_date_sk as date_sk,
+                       ss_ext_sales_price as sales_price,
+                       ss_net_profit as profit,
+                       cast(0 as double) as return_amt,
+                       cast(0 as double) as net_loss
+                from store_sales
+                union all
+                select sr_store_sk, sr_returned_date_sk,
+                       cast(0 as double), cast(0 as double),
+                       sr_return_amt, sr_net_loss
+                from store_returns) salesreturns, date_dim, store
+          where date_sk = d_date_sk
+            and d_date between cast('2000-08-23' as date)
+                           and cast('2000-08-23' as date) + interval '14' day
+            and store_sk = s_store_sk
+          group by s_store_id) ssr
+    union all
+    select 'catalog channel' as channel,
+           'catalog_page' || cp_catalog_page_id as id,
+           sales, returns_, profit - profit_loss as profit
+    from (select cp_catalog_page_id,
+                 sum(sales_price) as sales, sum(profit) as profit,
+                 sum(return_amt) as returns_, sum(net_loss) as profit_loss
+          from (select cs_catalog_page_sk as page_sk,
+                       cs_sold_date_sk as date_sk,
+                       cs_ext_sales_price as sales_price,
+                       cs_net_profit as profit,
+                       cast(0 as double) as return_amt,
+                       cast(0 as double) as net_loss
+                from catalog_sales
+                union all
+                select cr_catalog_page_sk, cr_returned_date_sk,
+                       cast(0 as double), cast(0 as double),
+                       cr_return_amount, cr_net_loss
+                from catalog_returns) salesreturns, date_dim, catalog_page
+          where date_sk = d_date_sk
+            and d_date between cast('2000-08-23' as date)
+                           and cast('2000-08-23' as date) + interval '14' day
+            and page_sk = cp_catalog_page_sk
+          group by cp_catalog_page_id) csr
+    union all
+    select 'web channel' as channel, 'web_site' || web_site_id as id,
+           sales, returns_, profit - profit_loss as profit
+    from (select web_site_id,
+                 sum(sales_price) as sales, sum(profit) as profit,
+                 sum(return_amt) as returns_, sum(net_loss) as profit_loss
+          from (select ws_web_site_sk as wsr_web_site_sk,
+                       ws_sold_date_sk as date_sk,
+                       ws_ext_sales_price as sales_price,
+                       ws_net_profit as profit,
+                       cast(0 as double) as return_amt,
+                       cast(0 as double) as net_loss
+                from web_sales
+                union all
+                select ws_web_site_sk, wr_returned_date_sk,
+                       cast(0 as double), cast(0 as double),
+                       wr_return_amt, wr_net_loss
+                from web_returns
+                left outer join web_sales
+                  on (wr_item_sk = ws_item_sk
+                      and wr_order_number = ws_order_number)) salesreturns,
+               date_dim, web_site
+          where date_sk = d_date_sk
+            and d_date between cast('2000-08-23' as date)
+                           and cast('2000-08-23' as date) + interval '14' day
+            and wsr_web_site_sk = web_site_sk
+          group by web_site_id) wsr
+"""
+
+_Q77_BODY = """
+    select 'store channel' as channel, ss.s_store_sk as id, sales,
+           coalesce(returns_, 0) as returns_,
+           profit - coalesce(profit_loss, 0) as profit
+    from (select s_store_sk, sum(ss_ext_sales_price) as sales,
+                 sum(ss_net_profit) as profit
+          from store_sales, date_dim, store
+          where ss_sold_date_sk = d_date_sk
+            and d_date between cast('2000-08-23' as date)
+                           and cast('2000-08-23' as date) + interval '30' day
+            and ss_store_sk = s_store_sk
+          group by s_store_sk) ss
+    left join (select s_store_sk, sum(sr_return_amt) as returns_,
+                      sum(sr_net_loss) as profit_loss
+               from store_returns, date_dim, store
+               where sr_returned_date_sk = d_date_sk
+                 and d_date between cast('2000-08-23' as date)
+                                and cast('2000-08-23' as date) + interval '30' day
+                 and sr_store_sk = s_store_sk
+               group by s_store_sk) sr
+      on ss.s_store_sk = sr.s_store_sk
+    union all
+    select 'catalog channel' as channel, cs_call_center_sk as id, sales,
+           returns_, profit - profit_loss as profit
+    from (select cs_call_center_sk, sum(cs_ext_sales_price) as sales,
+                 sum(cs_net_profit) as profit
+          from catalog_sales, date_dim
+          where cs_sold_date_sk = d_date_sk
+            and d_date between cast('2000-08-23' as date)
+                           and cast('2000-08-23' as date) + interval '30' day
+          group by cs_call_center_sk) cs,
+         (select sum(cr_return_amount) as returns_,
+                 sum(cr_net_loss) as profit_loss
+          from catalog_returns, date_dim
+          where cr_returned_date_sk = d_date_sk
+            and d_date between cast('2000-08-23' as date)
+                           and cast('2000-08-23' as date) + interval '30' day) cr
+    union all
+    select 'web channel' as channel, ws.wp_web_page_sk as id, sales,
+           coalesce(returns_, 0) as returns_,
+           profit - coalesce(profit_loss, 0) as profit
+    from (select wp_web_page_sk, sum(ws_ext_sales_price) as sales,
+                 sum(ws_net_profit) as profit
+          from web_sales, date_dim, web_page
+          where ws_sold_date_sk = d_date_sk
+            and d_date between cast('2000-08-23' as date)
+                           and cast('2000-08-23' as date) + interval '30' day
+            and ws_web_page_sk = wp_web_page_sk
+          group by wp_web_page_sk) ws
+    left join (select wp_web_page_sk, sum(wr_return_amt) as returns_,
+                      sum(wr_net_loss) as profit_loss
+               from web_returns, date_dim, web_page
+               where wr_returned_date_sk = d_date_sk
+                 and d_date between cast('2000-08-23' as date)
+                                and cast('2000-08-23' as date) + interval '30' day
+                 and wr_web_page_sk = wp_web_page_sk
+               group by wp_web_page_sk) wr
+      on ws.wp_web_page_sk = wr.wp_web_page_sk
+"""
+
+_Q80_BODY = """
+    select 'store channel' as channel, 'store' || store_id as id,
+           sales, returns_, profit
+    from (select s_store_id as store_id, sum(ss_ext_sales_price) as sales,
+                 sum(coalesce(sr_return_amt, 0)) as returns_,
+                 sum(ss_net_profit - coalesce(sr_net_loss, 0)) as profit
+          from store_sales
+          left outer join store_returns
+            on (ss_item_sk = sr_item_sk
+                and ss_ticket_number = sr_ticket_number),
+          date_dim, store, item, promotion
+          where ss_sold_date_sk = d_date_sk
+            and d_date between cast('2000-08-23' as date)
+                           and cast('2000-08-23' as date) + interval '30' day
+            and ss_store_sk = s_store_sk
+            and ss_item_sk = i_item_sk
+            and i_current_price > 50
+            and ss_promo_sk = p_promo_sk
+            and p_channel_tv = 'N'
+          group by s_store_id) ssr
+    union all
+    select 'catalog channel' as channel,
+           'catalog_page' || catalog_page_id as id, sales, returns_, profit
+    from (select cp_catalog_page_id as catalog_page_id,
+                 sum(cs_ext_sales_price) as sales,
+                 sum(coalesce(cr_return_amount, 0)) as returns_,
+                 sum(cs_net_profit - coalesce(cr_net_loss, 0)) as profit
+          from catalog_sales
+          left outer join catalog_returns
+            on (cs_item_sk = cr_item_sk and cs_order_number = cr_order_number),
+          date_dim, catalog_page, item, promotion
+          where cs_sold_date_sk = d_date_sk
+            and d_date between cast('2000-08-23' as date)
+                           and cast('2000-08-23' as date) + interval '30' day
+            and cs_catalog_page_sk = cp_catalog_page_sk
+            and cs_item_sk = i_item_sk
+            and i_current_price > 50
+            and cs_promo_sk = p_promo_sk
+            and p_channel_tv = 'N'
+          group by cp_catalog_page_id) csr
+    union all
+    select 'web channel' as channel, 'web_site' || web_site_id as id,
+           sales, returns_, profit
+    from (select web_site_id, sum(ws_ext_sales_price) as sales,
+                 sum(coalesce(wr_return_amt, 0)) as returns_,
+                 sum(ws_net_profit - coalesce(wr_net_loss, 0)) as profit
+          from web_sales
+          left outer join web_returns
+            on (ws_item_sk = wr_item_sk and ws_order_number = wr_order_number),
+          date_dim, web_site, item, promotion
+          where ws_sold_date_sk = d_date_sk
+            and d_date between cast('2000-08-23' as date)
+                           and cast('2000-08-23' as date) + interval '30' day
+            and ws_web_site_sk = web_site_sk
+            and ws_item_sk = i_item_sk
+            and i_current_price > 50
+            and ws_promo_sk = p_promo_sk
+            and p_channel_tv = 'N'
+          group by web_site_id) wsr
+"""
+
+_Q18_CORE = """
+from catalog_sales, customer_demographics cd1, customer_demographics cd2,
+     customer, customer_address, date_dim, item
+where cs_sold_date_sk = d_date_sk
+  and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd1.cd_demo_sk
+  and cs_bill_customer_sk = c_customer_sk
+  and cd1.cd_gender = 'F'
+  and cd1.cd_education_status = 'Unknown'
+  and c_current_cdemo_sk = cd2.cd_demo_sk
+  and c_current_addr_sk = ca_address_sk
+  and c_birth_month in (1, 6, 8, 9, 12, 2)
+  and d_year = 1998
+  and ca_state in ('MS', 'IN', 'ND', 'OK', 'NM', 'VA', 'MS')
+"""
+
+_Q18_AGGS = """
+       avg(cast(cs_quantity as double)) as agg1,
+       avg(cast(cs_list_price as double)) as agg2,
+       avg(cast(cs_coupon_amt as double)) as agg3,
+       avg(cast(cs_sales_price as double)) as agg4,
+       avg(cast(cs_net_profit as double)) as agg5,
+       avg(cast(c_birth_year as double)) as agg6,
+       avg(cast(cd1.cd_dep_count as double)) as agg7
+"""
+
+_Q22_CORE = """
+from inventory, date_dim, item
+where inv_date_sk = d_date_sk
+  and inv_item_sk = i_item_sk
+  and d_month_seq between 1200 and 1200 + 11
+"""
+
+_Q27_CORE = """
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2002
+  and s_state = 'TN'
+"""
+
+_Q27_AGGS = """
+       avg(ss_quantity) as agg1, avg(ss_list_price) as agg2,
+       avg(ss_coupon_amt) as agg3, avg(ss_sales_price) as agg4
+"""
+
+_Q36_CORE = """
+from store_sales, date_dim d1, item, store
+where d1.d_year = 2001
+  and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk
+  and s_store_sk = ss_store_sk
+  and s_state = 'TN'
+"""
+
+_Q67_CORE = """
+from store_sales, date_dim, store, item
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and d_month_seq between 1200 and 1200 + 11
+"""
+
+_Q70_CORE = """
+from store_sales, date_dim d1, store
+where d1.d_month_seq between 1200 and 1200 + 11
+  and d1.d_date_sk = ss_sold_date_sk
+  and s_store_sk = ss_store_sk
+  and s_state in (select s_state
+                  from (select s_state,
+                               rank() over (partition by s_state
+                                            order by sum(ss_net_profit) desc) as ranking
+                        from store_sales, store, date_dim
+                        where d_month_seq between 1200 and 1200 + 11
+                          and d_date_sk = ss_sold_date_sk
+                          and s_store_sk = ss_store_sk
+                        group by s_state) tmp1
+                  where ranking <= 5)
+"""
+
+_Q86_CORE = """
+from web_sales, date_dim d1, item
+where d1.d_month_seq between 1200 and 1200 + 11
+  and d1.d_date_sk = ws_sold_date_sk
+  and i_item_sk = ws_item_sk
+"""
+
+
+def _rollup_levels(keys, select_aggs, core, extra_cols_fn=None):
+    """Plain-SQL rollup: one SELECT per level, masked keys as NULL."""
+    parts = []
+    for level in range(len(keys), -1, -1):
+        cols = []
+        for i, k in enumerate(keys):
+            cols.append(f"{k[1]} as {k[0]}" if i < level else f"null as {k[0]}")
+        extra = extra_cols_fn(level) if extra_cols_fn else ""
+        group = ", ".join(k[1] for k in keys[:level])
+        group_clause = f"group by {group}" if group else ""
+        parts.append(
+            f"select {', '.join(cols)}{extra}, {select_aggs} {core} {group_clause}"
+        )
+    return "\nunion all\n".join(parts)
+
+
+_Q14_CTES = """
+with cross_items as (
+    select i_item_sk as ss_item_sk
+    from item,
+         (select iss.i_brand_id as brand_id, iss.i_class_id as class_id,
+                 iss.i_category_id as category_id
+          from store_sales, item iss, date_dim d1
+          where ss_item_sk = iss.i_item_sk
+            and ss_sold_date_sk = d1.d_date_sk
+            and d1.d_year between 1999 and 1999 + 2
+          intersect
+          select ics.i_brand_id, ics.i_class_id, ics.i_category_id
+          from catalog_sales, item ics, date_dim d2
+          where cs_item_sk = ics.i_item_sk
+            and cs_sold_date_sk = d2.d_date_sk
+            and d2.d_year between 1999 and 1999 + 2
+          intersect
+          select iws.i_brand_id, iws.i_class_id, iws.i_category_id
+          from web_sales, item iws, date_dim d3
+          where ws_item_sk = iws.i_item_sk
+            and ws_sold_date_sk = d3.d_date_sk
+            and d3.d_year between 1999 and 1999 + 2) x
+    where i_brand_id = brand_id
+      and i_class_id = class_id
+      and i_category_id = category_id
+), avg_sales as (
+    select avg(quantity * list_price) as average_sales
+    from (select ss_quantity as quantity, ss_list_price as list_price
+          from store_sales, date_dim
+          where ss_sold_date_sk = d_date_sk
+            and d_year between 1999 and 1999 + 2
+          union all
+          select cs_quantity as quantity, cs_list_price as list_price
+          from catalog_sales, date_dim
+          where cs_sold_date_sk = d_date_sk
+            and d_year between 1999 and 1999 + 2
+          union all
+          select ws_quantity as quantity, ws_list_price as list_price
+          from web_sales, date_dim
+          where ws_sold_date_sk = d_date_sk
+            and d_year between 1999 and 1999 + 2) x
+)
+"""
+
+_Q14_Y = """
+    select 'store' as channel, i_brand_id, i_class_id, i_category_id,
+           sum(ss_quantity * ss_list_price) as sales,
+           count(*) as number_sales
+    from store_sales, item, date_dim
+    where ss_item_sk in (select ss_item_sk from cross_items)
+      and ss_item_sk = i_item_sk
+      and ss_sold_date_sk = d_date_sk
+      and d_year = 1999 + 2 and d_moy = 11
+    group by i_brand_id, i_class_id, i_category_id
+    having sum(ss_quantity * ss_list_price)
+           > (select average_sales from avg_sales)
+    union all
+    select 'catalog' as channel, i_brand_id, i_class_id, i_category_id,
+           sum(cs_quantity * cs_list_price) as sales,
+           count(*) as number_sales
+    from catalog_sales, item, date_dim
+    where cs_item_sk in (select ss_item_sk from cross_items)
+      and cs_item_sk = i_item_sk
+      and cs_sold_date_sk = d_date_sk
+      and d_year = 1999 + 2 and d_moy = 11
+    group by i_brand_id, i_class_id, i_category_id
+    having sum(cs_quantity * cs_list_price)
+           > (select average_sales from avg_sales)
+    union all
+    select 'web' as channel, i_brand_id, i_class_id, i_category_id,
+           sum(ws_quantity * ws_list_price) as sales,
+           count(*) as number_sales
+    from web_sales, item, date_dim
+    where ws_item_sk in (select ss_item_sk from cross_items)
+      and ws_item_sk = i_item_sk
+      and ws_sold_date_sk = d_date_sk
+      and d_year = 1999 + 2 and d_moy = 11
+    group by i_brand_id, i_class_id, i_category_id
+    having sum(ws_quantity * ws_list_price)
+           > (select average_sales from avg_sales)
+"""
+
+
+def _q14_equiv() -> str:
+    keys = ["channel", "i_brand_id", "i_class_id", "i_category_id"]
+    parts = []
+    for level in range(len(keys), -1, -1):
+        cols = ", ".join(
+            k if i < level else f"null as {k}" for i, k in enumerate(keys)
+        )
+        grp = ", ".join(keys[:level])
+        grp_clause = f"group by {grp}" if grp else ""
+        parts.append(
+            f"select {cols}, sum(sales) as sum_sales,"
+            f" sum(number_sales) as sum_number_sales from ({_Q14_Y}) y"
+            f" {grp_clause}"
+        )
+    return (
+        _Q14_CTES
+        + "\nunion all\n".join(parts)
+        + "\norder by channel nulls last, i_brand_id nulls last,"
+        " i_class_id nulls last, i_category_id nulls last\nlimit 100\n"
+    )
+
+
+EQUIV = {
+    5: _channel_id_rollup(_Q5_BODY),
+    14: _q14_equiv(),
+    77: _channel_id_rollup(_Q77_BODY),
+    80: _channel_id_rollup(_Q80_BODY),
+    18: f"""
+select i_item_id, ca_country, ca_state, ca_county, agg1, agg2, agg3, agg4,
+       agg5, agg6, agg7
+from (
+{_rollup_levels(
+    [("i_item_id", "i_item_id"), ("ca_country", "ca_country"),
+     ("ca_state", "ca_state"), ("ca_county", "ca_county")],
+    _Q18_AGGS.strip(), _Q18_CORE)}
+) t
+order by ca_country, ca_state, ca_county, i_item_id
+limit 100
+""",
+    22: f"""
+select i_product_name, i_brand, i_class, i_category, qoh
+from (
+{_rollup_levels(
+    [("i_product_name", "i_product_name"), ("i_brand", "i_brand"),
+     ("i_class", "i_class"), ("i_category", "i_category")],
+    "avg(inv_quantity_on_hand) as qoh", _Q22_CORE)}
+) t
+order by qoh nulls last, i_product_name nulls last, i_brand nulls last,
+         i_class nulls last, i_category nulls last
+limit 100
+""",
+    27: f"""
+select i_item_id, s_state, g_state, agg1, agg2, agg3, agg4
+from (
+{_rollup_levels(
+    [("i_item_id", "i_item_id"), ("s_state", "s_state")],
+    _Q27_AGGS.strip(), _Q27_CORE,
+    extra_cols_fn=lambda lvl: ", 0 as g_state" if lvl == 2 else ", 1 as g_state")}
+) t
+order by i_item_id, s_state
+limit 100
+""",
+    36: f"""
+select gross_margin, i_category, i_class, lochierarchy, rank_within_parent
+from (
+    select gross_margin, i_category, i_class, lochierarchy,
+           rank() over (partition by lochierarchy, parent_key
+                        order by gross_margin asc) as rank_within_parent
+    from (
+{_rollup_levels(
+    [("i_category", "i_category"), ("i_class", "i_class")],
+    "sum(ss_net_profit) / sum(ss_ext_sales_price) as gross_margin",
+    _Q36_CORE,
+    extra_cols_fn=lambda lvl: (
+        ", 0 as lochierarchy, i_category as parent_key" if lvl == 2
+        else ", 1 as lochierarchy, null as parent_key" if lvl == 1
+        else ", 2 as lochierarchy, null as parent_key"))}
+    ) base
+) t
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+""",
+    67: f"""
+select *
+from (select i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+             d_moy, s_store_id, sumsales,
+             rank() over (partition by i_category
+                          order by sumsales desc) as rk
+      from (
+{_rollup_levels(
+    [("i_category", "i_category"), ("i_class", "i_class"),
+     ("i_brand", "i_brand"), ("i_product_name", "i_product_name"),
+     ("d_year", "d_year"), ("d_qoy", "d_qoy"), ("d_moy", "d_moy"),
+     ("s_store_id", "s_store_id")],
+    "sum(coalesce(ss_sales_price * ss_quantity, 0)) as sumsales",
+    _Q67_CORE)}
+      ) dw1) dw2
+where rk <= 100
+order by i_category nulls last, i_class nulls last, i_brand nulls last,
+         i_product_name nulls last, d_year nulls last, d_qoy nulls last,
+         d_moy nulls last, s_store_id nulls last, sumsales nulls last,
+         rk nulls last
+limit 100
+""",
+    70: f"""
+select total_sum, s_state, s_county, lochierarchy, rank_within_parent
+from (
+    select total_sum, s_state, s_county, lochierarchy,
+           rank() over (partition by lochierarchy, parent_key
+                        order by total_sum desc) as rank_within_parent
+    from (
+{_rollup_levels(
+    [("s_state", "s_state"), ("s_county", "s_county")],
+    "sum(ss_net_profit) as total_sum", _Q70_CORE,
+    extra_cols_fn=lambda lvl: (
+        ", 0 as lochierarchy, s_state as parent_key" if lvl == 2
+        else ", 1 as lochierarchy, null as parent_key" if lvl == 1
+        else ", 2 as lochierarchy, null as parent_key"))}
+    ) base
+) t
+order by lochierarchy desc,
+         case when lochierarchy = 0 then s_state end,
+         rank_within_parent
+limit 100
+""",
+    86: f"""
+select total_sum, i_category, i_class, lochierarchy, rank_within_parent
+from (
+    select total_sum, i_category, i_class, lochierarchy,
+           rank() over (partition by lochierarchy, parent_key
+                        order by total_sum desc) as rank_within_parent
+    from (
+{_rollup_levels(
+    [("i_category", "i_category"), ("i_class", "i_class")],
+    "sum(ws_net_paid) as total_sum", _Q86_CORE,
+    extra_cols_fn=lambda lvl: (
+        ", 0 as lochierarchy, i_category as parent_key" if lvl == 2
+        else ", 1 as lochierarchy, null as parent_key" if lvl == 1
+        else ", 2 as lochierarchy, null as parent_key"))}
+    ) base
+) t
+order by lochierarchy desc,
+         case when lochierarchy = 0 then i_category end,
+         rank_within_parent
+limit 100
+""",
+}

@@ -169,17 +169,35 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
             ]
         lengths = None
         if any(p.lengths is not None for p in parts):
-            # array columns: right-pad every part to the widest K
-            k = max(p.data.shape[1] for p in parts)
+            # array columns: right-pad every part to the widest K.  Parts
+            # with lengths=None carry 1-D data (no elements) and are lifted
+            # to an all-empty [capacity, k] layout first.
+            k = max(
+                (p.data.shape[1] for p in parts if p.lengths is not None),
+                default=1,
+            )
+            k = max(k, 1)
             parts = [
-                p
-                if p.data.shape[1] == k
-                else Column(
-                    jnp.pad(p.data, ((0, 0), (0, k - p.data.shape[1]))),
-                    p.type,
-                    p.valid,
-                    p.dictionary,
-                    p.lengths,
+                (
+                    Column(
+                        jnp.zeros((p.capacity, k), dtype=p.data.dtype),
+                        p.type,
+                        p.valid,
+                        p.dictionary,
+                        jnp.zeros(p.capacity, jnp.int32),
+                    )
+                    if p.lengths is None
+                    else (
+                        p
+                        if p.data.shape[1] == k
+                        else Column(
+                            jnp.pad(p.data, ((0, 0), (0, k - p.data.shape[1]))),
+                            p.type,
+                            p.valid,
+                            p.dictionary,
+                            p.lengths,
+                        )
+                    )
                 )
                 for p in parts
             ]

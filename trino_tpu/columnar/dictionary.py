@@ -217,15 +217,25 @@ class PatternDictionary(StringDictionary):
 
 def union_many(dicts):
     """Merge N dictionaries; returns (merged, [recode tables]) where table[i]
-    maps dict i's codes -> merged codes (None when already identical)."""
-    first = dicts[0]
-    if all(d is first or d == first for d in dicts):
+    maps dict i's codes -> merged codes (None when already identical).
+
+    A None entry means a dictionary-less varchar column, which under this
+    engine's encoding invariant is ALL-NULL (e.g. a NULL literal branch of a
+    grouping-sets union): it contributes no values and needs no recode —
+    its code payload is masked by the validity bitmap."""
+    present = [d for d in dicts if d is not None]
+    if not present:
+        return None, [None] * len(dicts)
+    first = present[0]
+    if all(d is first or d == first for d in present):
         return first, [None] * len(dicts)
-    merged = StringDictionary.from_unsorted([v for d in dicts for v in d.values])
+    merged = StringDictionary.from_unsorted(
+        [v for d in present for v in d.values]
+    )
     ix = merged.index
     tables = []
     for d in dicts:
-        if d is merged:
+        if d is None or d is merged:
             tables.append(None)
         else:
             tables.append(

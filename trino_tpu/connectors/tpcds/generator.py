@@ -212,7 +212,10 @@ class TpcdsGenerator:
             if col.endswith(suffix):
                 vals = randint(stream, idx, 1, self.row_count(ref))
                 return self._nullable(stream, vals, table, idx)
-        if col.endswith("_id"):
+        if col.endswith("_id") and T.is_string_kind(t):
+            # business identifiers are strings (e.g. i_item_id); integer
+            # *_id columns (s_market_id, s_division_id) fall through to the
+            # numeric branches below
             prefix = col[: col.index("_")].upper() + "-"
             d = _pat(prefix, 12, max(n, 1))
             return ColumnData(idx.astype(np.int32), None, d)

@@ -277,6 +277,11 @@ class AggregationOperator:
     #: group-domain cap for the sort-free direct path (positional segments)
     DIRECT_GROUP_LIMIT = 4096
 
+    #: group-domain cap for the range-positional path (min/max-offset mixed
+    #: radix).  Segment ops at 16M slots are ~0.2s-class; beyond that the
+    #: sort path (or, later, aggregation waves) takes over.
+    POSITIONAL_LIMIT = 1 << 24
+
     def _direct_group_info(self, batch: Batch):
         """(sizes, prod) when every group key is a small-domain code column
         (dictionary or boolean) — the BigintGroupByHash analog: group id is
@@ -429,6 +434,175 @@ class AggregationOperator:
                     )
                 )
         return out
+
+    # -- range-positional (sort-free) path -----------------------------------
+
+    def _positional_static_eligible(self, batch: Batch) -> bool:
+        """Static (type-level) eligibility for the range-positional path:
+        every group key is an int-family scalar (ints, dates, decimals,
+        dictionary codes, bools) — the generalized BigintGroupByHash dense
+        path (reference: operator/BigintGroupByHash.java), with the dense
+        domain discovered from data min/max instead of assumed."""
+        if not self.group_channels:
+            return False
+        if any(s.name == "percentile" for s in self.aggregates):
+            return False
+        for ch in self.group_channels:
+            col = batch.columns[ch]
+            if col.lengths is not None:
+                return False
+            dt = col.data.dtype
+            if not (jnp.issubdtype(dt, jnp.integer) or dt == jnp.bool_):
+                return False
+        return True
+
+    def _key_stats(self, batch: Batch):
+        """Jitted per-key (min, max) over live, non-null key values."""
+        key = ("keystats", tuple(self.group_channels))
+        step = _STEP_CACHE.get(key)
+        if step is None:
+            chans = tuple(self.group_channels)
+
+            def stats(batch: Batch):
+                live = batch.mask()
+                mins, maxs = [], []
+                for ch in chans:
+                    col = batch.columns[ch]
+                    d = col.data.astype(jnp.int64)
+                    v = live
+                    if col.valid is not None:
+                        v = jnp.logical_and(v, col.valid)
+                    big = jnp.iinfo(jnp.int64).max
+                    mins.append(jnp.min(jnp.where(v, d, big)))
+                    maxs.append(jnp.max(jnp.where(v, d, -big)))
+                return jnp.stack(mins), jnp.stack(maxs)
+
+            step = jax.jit(stats)
+            _STEP_CACHE[key] = step
+        return step(batch)
+
+    def _positional_try(self, batch: Batch) -> Optional[Batch]:
+        """Sort-free grouped reduction when the key domain is dense enough:
+        gid = mixed-radix positional code from per-key (min, size), group
+        values decoded back from the slot index.  One host sync for the key
+        stats; sizes/mins stay traced so data changes do not retrace."""
+        import numpy as np
+
+        if not self._positional_static_eligible(batch):
+            return None
+        mins_d, maxs_d = self._key_stats(batch)
+        mins = np.asarray(jax.device_get(mins_d))
+        maxs = np.asarray(jax.device_get(maxs_d))
+        prod = 1
+        sizes = []
+        for i, ch in enumerate(self.group_channels):
+            nullable = batch.columns[ch].valid is not None
+            size = int(maxs[i]) - int(mins[i]) + 1
+            if size < 0:
+                size = 0  # empty/all-null key: only the null slot remains
+            size += 1 if nullable else 0
+            if size <= 0:
+                return None
+            sizes.append(size)
+            prod *= size
+            if prod > self.POSITIONAL_LIMIT:
+                return None
+        # a domain much larger than the input wastes O(prod) segment slots
+        if prod > max(1 << 16, 8 * batch.capacity):
+            return None
+        nseg = next_pow2(prod, floor=16)
+        key = (
+            "range",
+            tuple(self.group_channels),
+            tuple(self.aggregates),
+            tuple(t.name for t in self.input_types),
+            self.mode,
+        )
+        step = _STEP_CACHE.get(key)
+        if step is None:
+            step = jax.jit(self._range_step, static_argnames=("out_cap",))
+            _STEP_CACHE[key] = step
+        out = step(
+            batch,
+            jnp.asarray(mins),
+            jnp.asarray(np.asarray(sizes, dtype=np.int64)),
+            out_cap=int(nseg),
+        )
+        # positional output is sparse (occupancy-masked); compact when the
+        # live groups are far below the domain so downstream sorts stay small
+        ng = out.num_rows_host()
+        cc = next_pow2(max(ng, 1), floor=16)
+        if cc * 2 <= nseg:
+            out = jax.jit(Batch.compact_device, static_argnames=("out_capacity",))(
+                out, out_capacity=cc
+            )
+        return out
+
+    def _range_step(self, batch: Batch, mins, sizes, out_cap: int) -> Batch:
+        gch = self.group_channels
+        cap = batch.capacity
+        live = batch.mask()
+        gid = jnp.zeros(cap, dtype=jnp.int64)
+        for i, ch in enumerate(gch):
+            col = batch.columns[ch]
+            d = col.data.astype(jnp.int64)
+            size_v = sizes[i] - (1 if col.valid is not None else 0)
+            code = jnp.clip(d - mins[i], 0, jnp.maximum(size_v - 1, 0))
+            if col.valid is not None:
+                code = jnp.where(col.valid, code, size_v)
+            gid = gid * sizes[i] + code
+        gid = jnp.where(live, gid, out_cap)
+        nseg = out_cap + 1
+        occupancy = jax.ops.segment_sum(live.astype(jnp.int64), gid, nseg)[:out_cap]
+        out_live = occupancy > 0
+        # decode slot index -> group key values (traced div/mod chain)
+        idx = jnp.arange(out_cap, dtype=jnp.int64)
+        sizes_list = [sizes[i] for i in range(len(gch))]
+        divs = []
+        d = jnp.ones((), dtype=jnp.int64)
+        for size in reversed(sizes_list):
+            divs.append(d)
+            d = d * size
+        divs.reverse()
+        cols: list[Column] = []
+        for i, ch in enumerate(gch):
+            col = batch.columns[ch]
+            code = (idx // divs[i]) % sizes_list[i]
+            valid = None
+            if col.valid is not None:
+                valid = code < (sizes_list[i] - 1)
+            data = (code + mins[i]).astype(col.data.dtype)
+            cols.append(Column(data, col.type, valid, col.dictionary))
+        perm = jnp.arange(cap, dtype=jnp.int64)
+        gid_c = jnp.minimum(gid, out_cap)
+        for spec in self.aggregates:
+            state_cols = self._reduce_one(batch, spec, perm, live, gid_c, nseg, out_cap)
+            if self.mode in ("partial", "merge"):
+                cols.extend(state_cols)
+            else:
+                cols.append(_finalize(spec, state_cols))
+        return Batch(cols, out_live)
+
+    def _reduce_full(self, big: Batch) -> Batch:
+        """One-shot reduction of a batch: compact away dead slack first
+        (join outputs / filtered feeds can be mostly dead), then the
+        positional path if the key domain allows, else the sorted step."""
+        n = big.num_rows_host()
+        cap = next_pow2(max(n, 1), floor=1)
+        if cap < big.capacity:
+            big = jax.jit(Batch.compact_device, static_argnames=("out_capacity",))(
+                big, out_capacity=cap
+            )
+        else:
+            cap = next_pow2(big.capacity, floor=1)
+            big = _pad_device(big, cap)
+        # the in-jit small-domain direct path needs no host sync; prefer it
+        # when statically eligible (dict/bool keys)
+        if self.group_channels and self._direct_group_info(big) is None:
+            out = self._positional_try(big)
+            if out is not None:
+                return out
+        return self._step(big, out_cap=cap)
 
     def _reduce_step(self, batch: Batch, out_cap: int) -> Batch:
         gch = self.group_channels
@@ -665,7 +839,15 @@ class AggregationOperator:
         per_batch = self._batch_reducer() if self.streaming else None
         for batch in stream:
             if per_batch is not None:
-                self._acc.append(per_batch._step(batch, out_cap=batch.capacity))
+                # dict/bool small-domain keys: in-jit direct path, no host
+                # syncs (Q1 shape).  Otherwise _reduce_full compacts dead
+                # slack and tries the positional path (one scalar sync).
+                if per_batch._direct_group_info(batch) is not None:
+                    self._acc.append(
+                        per_batch._step(batch, out_cap=batch.capacity)
+                    )
+                else:
+                    self._acc.append(per_batch._reduce_full(batch))
                 if len(self._acc) >= self.fold_every:
                     self._fold_states()
             else:
@@ -693,8 +875,7 @@ class AggregationOperator:
         if self.streaming:
             out_mode = "merge" if self.mode in ("partial", "merge") else "final"
             return self._combine(big, out_mode)
-        cap = next_pow2(big.capacity, floor=1)
-        return self._step(_pad_device(big, cap), out_cap=cap)
+        return self._reduce_full(big)
 
     def _combine(self, states_batch: Batch, out_mode: str) -> Batch:
         """Re-reduce a batch of state rows (group keys + state columns)."""
@@ -707,8 +888,7 @@ class AggregationOperator:
             [c.type for c in states_batch.columns],
             mode=out_mode,
         )
-        cap = next_pow2(states_batch.capacity, floor=1)
-        return merger._step(_pad_device(states_batch, cap), out_cap=cap)
+        return merger._reduce_full(states_batch)
 
     def _state_channel(self, agg_index: int) -> int:
         ch = len(self.group_channels)

@@ -3,13 +3,22 @@ LookupJoinOperator.java + JoinProbe, NestedLoopJoinOperator.java,
 HashSemiJoinOperator via SetBuilderOperator).
 
 TPU substitution (SURVEY.md §7): no per-row open-addressing probe.  The build
-side is materialized dense; each probe batch is joined by a *combined
-lexicographic sort* of build+probe keys (side as the least-significant key so
-build rows lead each key group), group-boundary detection, and a cumsum-based
-row expansion — all static-shape XLA.  Output capacity is data-dependent, so
-the match count is computed in a first jitted phase, pulled to host, bucketed
-to a power of two, and the expansion phase is jitted per bucket (the analog of
-the reference's page-size-bounded join output building).
+side is materialized dense and *sorted once* by its key columns in
+``set_build`` — the analog of the reference's one-time PagesHash construction
+(operator/join/PagesHash.java: addressing built once, probed many times).
+Each probe batch then locates its contiguous run of matching build rows with a
+vectorized lexicographic *binary search* over the sorted build keys
+(O(P·log B) fully-parallel compares — the streamed-probe analog of
+LookupJoinOperator.java), and a cumsum-based row expansion emits the joined
+rows.  All static-shape XLA: the only host round-trip per probe batch is one
+scalar (the match count) used to pick the pow2-bucketed output capacity, the
+analog of the reference's page-size-bounded join output building.
+
+Dictionary-encoded (varchar) keys: build and probe may carry different
+dictionaries, whose codes are not directly comparable.  The probe codes are
+recoded host-side into the build dictionary's code space through a cached
+i32 table (absent values -> -1, which can never equal a build code, so they
+simply match nothing) — the analog of DictionaryBlock id remapping.
 """
 
 from __future__ import annotations
@@ -23,7 +32,7 @@ import numpy as np
 from trino_tpu import types as T
 from trino_tpu.columnar import Batch, Column
 from trino_tpu.columnar.batch import concat_batches
-from trino_tpu.ops.common import SortKey, group_ids_from_sorted, multi_key_sort_perm, next_pow2
+from trino_tpu.ops.common import next_pow2
 
 
 def _dense_build(batches: list[Batch], types: Sequence[T.Type]) -> tuple[Batch, int]:
@@ -39,15 +48,221 @@ def _dense_build(batches: list[Batch], types: Sequence[T.Type]) -> tuple[Batch, 
     ), n
 
 
-def _match_live(batch: Batch, key_channels) -> jnp.ndarray:
-    """Rows eligible for equi-matching: live AND no NULL key (SQL `=` never
-    matches NULL)."""
-    live = batch.mask()
+def _canon_build_keys(build: Batch, key_channels: Sequence[int]):
+    """Canonical key arrays + combined nomatch mask for a build side."""
+    nomatch = jnp.logical_not(build.mask())
+    canon = []
     for ch in key_channels:
-        v = batch.columns[ch].valid
-        if v is not None:
-            live = jnp.logical_and(live, v)
-    return live
+        col = build.columns[ch]
+        d, nm = _canon_data(col)
+        if col.valid is not None:
+            nomatch = jnp.logical_or(nomatch, jnp.logical_not(col.valid))
+        if nm is not None:
+            nomatch = jnp.logical_or(nomatch, nm)
+        canon.append(d)
+    return canon, nomatch
+
+
+def _lex_sort_perm(canon, nomatch, cap: int):
+    """Stable lexicographic permutation: keys ascending, nomatch rows last."""
+    perm = jnp.arange(cap, dtype=jnp.int64)
+    for d in reversed(canon):
+        order = jnp.argsort(jnp.take(d, perm, mode="clip"), stable=True)
+        perm = perm[order]
+    return perm[jnp.argsort(jnp.take(nomatch, perm, mode="clip"), stable=True)]
+
+
+def _canon_data(col: Column):
+    """(comparable-form data, extra-nomatch mask or None) for one key column.
+
+    SQL `=` never matches NULL, and float NaN keys never equal anything
+    (reference DoubleOperators.equal is IEEE ==), so both are folded into the
+    per-row `nomatch` flag instead of riding sentinel orderings.
+    """
+    d = col.data
+    if d.dtype == jnp.bool_:
+        d = d.astype(jnp.int8)
+    nm = None
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        nm = jnp.isnan(d)
+        d = jnp.where(nm, jnp.zeros_like(d), d)
+    return d, nm
+
+
+def _sort_build_device(build: Batch, key_channels: Sequence[int]):
+    """Device-only build indexing (PagesHash-build analog; vmappable for the
+    per-shard SPMD path).  Returns (sorted build Batch, sorted canonical key
+    arrays, n_match device scalar).  Rows are physically reordered so that
+    key-matchable rows (live, non-NULL, non-NaN keys) occupy [0, n_match)
+    in lexicographic key order; everything else sorts after."""
+    cap = build.capacity
+    canon, nomatch = _canon_build_keys(build, key_channels)
+    perm = _lex_sort_perm(canon, nomatch, cap)
+    n_match = jnp.sum(jnp.logical_not(nomatch), dtype=jnp.int64)
+    sorted_build = build.gather(perm)
+    sorted_canon = [jnp.take(d, perm, mode="clip") for d in canon]
+    return sorted_build, sorted_canon, n_match
+
+
+def _canon_probe_device(probe: Batch, key_channels: Sequence[int], build_canon=None):
+    """Device-only probe canonicalization WITHOUT dictionary recode (the
+    caller guarantees directly comparable codes, e.g. after the SPMD path's
+    up-front dictionary unification).  Returns (key arrays, nomatch mask)."""
+    nomatch = jnp.logical_not(probe.mask())
+    arrs = []
+    for i, ch in enumerate(key_channels):
+        col = probe.columns[ch]
+        if col.valid is not None:
+            nomatch = jnp.logical_or(nomatch, jnp.logical_not(col.valid))
+        d, nm = _canon_data(col)
+        if nm is not None:
+            nomatch = jnp.logical_or(nomatch, nm)
+        if build_canon is not None and d.dtype != build_canon[i].dtype:
+            # promoted dtype, never narrowing (see _probe_canonical)
+            d = d.astype(jnp.promote_types(d.dtype, build_canon[i].dtype))
+        arrs.append(d)
+    return arrs, nomatch
+
+
+def _prepare_sorted_build(build: Batch, key_channels: Sequence[int]):
+    """Host wrapper over the build sort: pulls n_match to host and records
+    per-key build dictionaries for probe recoding.
+
+    Fast path (host-only; set_build runs eagerly so a scalar sync is fine):
+    when every canonical key is int-family and the combined (nomatch, keys)
+    value range fits 62 bits, all sort keys pack into ONE composite int64 —
+    one argsort instead of nkeys+1 stable passes."""
+    cap = build.capacity
+    canon, nomatch = _canon_build_keys(build, key_channels)
+    perm = None
+    table = None
+    n_match = int(jnp.sum(jnp.logical_not(nomatch)))
+    if all(jnp.issubdtype(d.dtype, jnp.integer) for d in canon):
+        imax = jnp.iinfo(jnp.int64).max
+        mins, widths = [], []
+        total = 1
+        for d in canon:
+            d64 = d.astype(jnp.int64)
+            # nomatch rows must not widen the packed range
+            mn = int(jnp.min(jnp.where(nomatch, imax, d64)))
+            mx = int(jnp.max(jnp.where(nomatch, -imax, d64)))
+            mins.append(mn)
+            widths.append(mx - mn + 1)
+            total *= mx - mn + 1
+        if 0 < total <= (1 << 62) and all(w > 0 for w in widths):
+            composite = jnp.zeros(cap, dtype=jnp.int64)
+            for d, mn, w in zip(canon, mins, widths):
+                composite = composite * w + (d.astype(jnp.int64) - mn)
+            composite = jnp.where(nomatch, total, composite)
+            perm = jnp.argsort(composite, stable=True)
+            if total <= TABLE_DOMAIN_LIMIT and total <= 64 * max(n_match, 1):
+                # direct-addressed probe tables over the packed key domain:
+                # start/count per composite code, O(1) gather per probe row
+                # (the PagesHash open-addressing analog, but positional)
+                tcap = next_pow2(total, floor=16)
+                c_sorted = jnp.take(composite, perm, mode="clip")
+                pos = jnp.arange(cap, dtype=jnp.int64)
+                cs = jnp.minimum(c_sorted, tcap)
+                start_t = jax.ops.segment_min(
+                    jnp.where(c_sorted < total, pos, cap), cs, tcap + 1
+                )[:tcap].astype(jnp.int32)
+                count_t = jax.ops.segment_sum(
+                    (c_sorted < total).astype(jnp.int32), cs, tcap + 1
+                )[:tcap]
+                table = (
+                    jnp.asarray(np.asarray(mins, dtype=np.int64)),
+                    jnp.asarray(np.asarray(widths, dtype=np.int64)),
+                    start_t,
+                    count_t,
+                )
+    if perm is None:
+        perm = _lex_sort_perm(canon, nomatch, cap)
+    sorted_build = build.gather(perm)
+    sorted_canon = [jnp.take(d, perm, mode="clip") for d in canon]
+    dicts = [build.columns[ch].dictionary for ch in key_channels]
+    return sorted_build, sorted_canon, n_match, dicts, table
+
+
+def _build_recode_table(probe_dict, build_dict) -> Optional[jnp.ndarray]:
+    """i32[|probe_dict|] mapping probe codes -> build codes (-1 = absent).
+    None means codes are already directly comparable."""
+    if probe_dict is None or build_dict is None:
+        return None
+    if probe_dict is build_dict or probe_dict == build_dict:
+        return None
+    table = np.full(len(probe_dict), -1, dtype=np.int32)
+    # iterate the smaller dictionary (PatternDictionary values are lazy and
+    # potentially huge; code_of stays O(log n) on both kinds)
+    if len(build_dict) <= len(probe_dict):
+        for bc, v in enumerate(build_dict.values):
+            pc = probe_dict.code_of(v)
+            if pc >= 0:
+                table[pc] = bc
+    else:
+        for pc, v in enumerate(probe_dict.values):
+            table[pc] = build_dict.code_of(v)
+    return jnp.asarray(table)
+
+
+#: packed-domain cap for direct-addressed probe tables (2 i32 arrays)
+TABLE_DOMAIN_LIMIT = 1 << 25
+
+
+def _locate_table(probe_canon, probe_nomatch, mins, widths, start_t, count_t):
+    """O(1)-per-row probe: composite code -> (start, count) table gather."""
+    n = probe_canon[0].shape[0]
+    code = jnp.zeros(n, dtype=jnp.int64)
+    nomatch = probe_nomatch
+    for i, pk in enumerate(probe_canon):
+        k = pk.astype(jnp.int64) - mins[i]
+        nomatch = jnp.logical_or(
+            nomatch, jnp.logical_or(k < 0, k >= widths[i])
+        )
+        code = code * widths[i] + jnp.clip(k, 0, jnp.maximum(widths[i] - 1, 0))
+    idx = jnp.clip(code, 0, start_t.shape[0] - 1)
+    start = jnp.take(start_t, idx, mode="clip").astype(jnp.int64)
+    count = jnp.where(
+        nomatch, 0, jnp.take(count_t, idx, mode="clip").astype(jnp.int64)
+    )
+    return jnp.where(nomatch, 0, start), count
+
+
+def _locate_sorted(build_canon, n_match, probe_canon, probe_nomatch, cap_b: int):
+    """Per probe row: (start, count) of its matching run in sorted-build row
+    space.  Two vectorized binary searches (lower/upper bound) over the
+    lexicographically sorted [0, n_match) prefix; log2(cap_b)+1 fixed
+    iterations, no data-dependent control flow."""
+    P = probe_canon[0].shape[0]
+    nm = jnp.asarray(n_match, dtype=jnp.int64)
+    iters = max(1, int(cap_b).bit_length())
+
+    def bounds(le: bool):
+        lo0 = jnp.zeros(P, dtype=jnp.int64)
+        hi0 = jnp.full(P, nm, dtype=jnp.int64)
+
+        def body(_, st):
+            lo, hi = st
+            active = lo < hi
+            mid = (lo + hi) >> 1
+            lt = jnp.zeros(P, dtype=bool)
+            eq = jnp.ones(P, dtype=bool)
+            for bk, pk in zip(build_canon, probe_canon):
+                bv = jnp.take(bk, mid, mode="clip")
+                lt = jnp.logical_or(lt, jnp.logical_and(eq, bv < pk))
+                eq = jnp.logical_and(eq, bv == pk)
+            go_right = jnp.logical_or(lt, eq) if le else lt
+            lo2 = jnp.where(go_right, mid + 1, lo)
+            hi2 = jnp.where(go_right, hi, mid)
+            return jnp.where(active, lo2, lo), jnp.where(active, hi2, hi)
+
+        lo, _ = jax.lax.fori_loop(0, iters, body, (lo0, hi0))
+        return lo
+
+    lo = bounds(False)
+    hi = bounds(True)
+    count = jnp.where(probe_nomatch, 0, hi - lo)
+    start = jnp.where(probe_nomatch, 0, lo)
+    return start, count
 
 
 #: process-level jitted-step cache (cross-query reuse; see filter_project).
@@ -65,49 +280,86 @@ def _jit_cached(key, factory):
     return _STEP_CACHE[key]
 
 
-class _CombinedSortJoinBase:
-    """Shared machinery: locate, for every probe row, the contiguous run of
-    matching build rows via one combined sort."""
+class _SortedBuildJoinBase:
+    """Shared machinery: build-once sorted index + binary-search probe."""
 
     def __init__(self, probe_key_channels, build_key_channels):
         self.probe_keys = list(probe_key_channels)
         self.build_keys = list(build_key_channels)
+        self.build: Optional[Batch] = None
+        self._build_canon = None
+        self._n_match = 0
+        self._key_dicts = [None] * len(self.build_keys)
+        self._table = None
+        self._recode: dict = {}  # key index -> {id(probe_dict): (dict, table)}
         self._locate = _jit_cached(
             ("locate", len(self.build_keys)),
-            lambda: jax.jit(self._locate_step, static_argnames=("cap_b",)),
+            lambda: jax.jit(_locate_sorted, static_argnames=("cap_b",)),
+        )
+        self._locate_t = _jit_cached(
+            ("locate_table", len(self.build_keys)),
+            lambda: jax.jit(_locate_table),
         )
 
-    def _combined_keys(self, build: Batch, probe: Batch) -> Batch:
-        """Host-side: key columns of both sides under one (union) dictionary."""
-        bk = Batch([build.columns[c] for c in self.build_keys], _match_live(build, self.build_keys))
-        pk = Batch([probe.columns[c] for c in self.probe_keys], _match_live(probe, self.probe_keys))
-        return concat_batches([bk, pk])
+    def _index_build(self, build: Batch) -> None:
+        (
+            self.build,
+            self._build_canon,
+            self._n_match,
+            self._key_dicts,
+            self._table,
+        ) = _prepare_sorted_build(build, self.build_keys)
+        self._recode = {}
 
-    def _locate_step(self, combined: Batch, cap_b: int):
-        """Returns, per probe slot: (match_start, match_count) in combined
-        space, plus the sort permutation mapping sorted pos -> combined row."""
-        total = combined.capacity
-        nkeys = len(self.build_keys)
-        side = (jnp.arange(total, dtype=jnp.int64) >= cap_b).astype(jnp.int8)
-        sortable = combined.append_column(Column(side, T.TINYINT, None))
-        keys = [SortKey(i) for i in range(nkeys)] + [SortKey(nkeys)]
-        perm = multi_key_sort_perm(sortable, keys)
-        gid, _, _ = group_ids_from_sorted(combined, perm, list(range(nkeys)))
-        live_sorted = jnp.take(combined.mask(), perm, mode="clip")
-        is_build = jnp.logical_and(live_sorted, jnp.take(side, perm, mode="clip") == 0)
-        pos = jnp.arange(total, dtype=jnp.int64)
-        cnt_b = jax.ops.segment_sum(is_build.astype(jnp.int64), gid, total)
-        first = jax.ops.segment_min(jnp.where(live_sorted, pos, total), gid, total)
-        inv = jnp.zeros(total, dtype=jnp.int64).at[perm].set(pos)
-        probe_pos = inv[cap_b:]
-        g = gid[probe_pos]
-        probe_live = combined.mask()[cap_b:]
-        count = jnp.where(probe_live, cnt_b[g], 0)
-        start = jnp.where(probe_live, first[g], 0)
-        return start, count, perm
+    def _recode_for(self, i: int, probe_dict):
+        cache = self._recode.setdefault(i, {})
+        hit = cache.get(id(probe_dict))
+        if hit is not None:
+            return hit[1]
+        table = _build_recode_table(probe_dict, self._key_dicts[i])
+        cache[id(probe_dict)] = (probe_dict, table)  # pin dict: id stays valid
+        return table
+
+    def _probe_canonical(self, probe: Batch):
+        """Probe key arrays in the build's comparable domain + nomatch mask.
+        Runs eagerly (a handful of gathers) so dictionary recode tables stay
+        out of jit cache keys."""
+        nomatch = jnp.logical_not(probe.mask())
+        arrs = []
+        for i, ch in enumerate(self.probe_keys):
+            col = probe.columns[ch]
+            if col.valid is not None:
+                nomatch = jnp.logical_or(nomatch, jnp.logical_not(col.valid))
+            if col.dictionary is not None and self._key_dicts[i] is not None:
+                table = self._recode_for(i, col.dictionary)
+                d = col.data.astype(jnp.int32)
+                if table is not None:
+                    d = jnp.take(table, d, mode="clip")
+                arrs.append(d)
+                continue
+            d, nm = _canon_data(col)
+            if nm is not None:
+                nomatch = jnp.logical_or(nomatch, nm)
+            # compare in the PROMOTED dtype: narrowing a wide probe key to
+            # the build dtype would wrap out-of-range values onto valid build
+            # keys (e.g. BIGINT 2^32+5 = INTEGER 5) and fabricate matches
+            bd = self._build_canon[i]
+            if d.dtype != bd.dtype:
+                d = d.astype(jnp.promote_types(d.dtype, bd.dtype))
+            arrs.append(d)
+        return arrs, nomatch
+
+    def _locate_batch(self, probe: Batch):
+        pc, pn = self._probe_canonical(probe)
+        if self._table is not None:
+            mins, widths, start_t, count_t = self._table
+            return self._locate_t(pc, pn, mins, widths, start_t, count_t)
+        return self._locate(
+            self._build_canon, self._n_match, pc, pn, cap_b=self.build.capacity
+        )
 
 
-class HashJoinOperator(_CombinedSortJoinBase):
+class HashJoinOperator(_SortedBuildJoinBase):
     """Equi join. Probe = left side (streamed), build = right (materialized);
     output columns = probe columns ++ build columns (reference: JoinNode output
     = left ++ right, build on right per LocalExecutionPlanner.visitJoin).
@@ -138,7 +390,6 @@ class HashJoinOperator(_CombinedSortJoinBase):
         self.build_types = list(build_types)
         self._probe_types_cache = list(probe_types)
         self.residual = residual
-        self.build: Optional[Batch] = None
         self._build_rows = 0
         self._build_matched = None  # bool[cap_b], for full outer
         cache_key = None
@@ -152,14 +403,19 @@ class HashJoinOperator(_CombinedSortJoinBase):
                 self._expand_step, static_argnames=("out_cap", "cap_b")
             )
         )
+        self._expand_unique = _jit_cached(
+            None if cache_key is None else ("uniq",) + cache_key[1:],
+            lambda: jax.jit(self._expand_unique_step, static_argnames=("cap_b",)),
+        )
 
     def set_build(self, batches: list[Batch]) -> None:
-        self.build, self._build_rows = _dense_build(batches, self.build_types)
+        build, self._build_rows = _dense_build(batches, self.build_types)
+        self._index_build(build)
         if self.kind == "full":
             self._build_matched = jnp.zeros(self.build.capacity, dtype=bool)
 
     def _expand_step(
-        self, probe: Batch, build: Batch, start, count, perm, build_matched,
+        self, probe: Batch, build: Batch, start, count, build_matched,
         out_cap: int, cap_b: int, total_emit
     ):
         emit = count if self.kind == "inner" else jnp.where(probe.mask(), jnp.maximum(count, 1), 0)
@@ -174,8 +430,7 @@ class HashJoinOperator(_CombinedSortJoinBase):
         ids = jax.lax.cummax(seed)  # out slot -> probe slot
         j = jnp.arange(out_cap, dtype=jnp.int64) - offsets[ids]
         matched = j < count[ids]
-        build_pos = jnp.clip(start[ids] + j, 0, perm.shape[0] - 1)
-        build_row = jnp.clip(perm[build_pos], 0, cap_b - 1)
+        build_row = jnp.clip(start[ids] + j, 0, cap_b - 1)
         out_live = jnp.arange(out_cap, dtype=jnp.int64) < total_emit
         pcols = [
             Column(
@@ -232,17 +487,84 @@ class HashJoinOperator(_CombinedSortJoinBase):
             ].set(True, mode="drop")
         return Batch(list(pcols) + list(bcols), out_live), new_matched
 
+    def _expand_unique_step(
+        self, probe: Batch, build: Batch, start, count, build_matched, cap_b: int
+    ):
+        """FK->PK fast path: every probe row has at most one match, so output
+        rows are the probe rows IN PLACE (no cumsum expansion, no probe
+        gathers) and only build columns are gathered — the dominant join
+        shape in TPC workloads (reference analog: PagesHash with single-row
+        key runs probed by LookupJoinOperator)."""
+        matched = jnp.logical_and(count > 0, probe.mask())
+        build_row = jnp.clip(start, 0, cap_b - 1)
+        bcols = [
+            Column(
+                jnp.take(c.data, build_row, mode="clip"),
+                c.type,
+                matched
+                if c.valid is None
+                else jnp.logical_and(matched, jnp.take(c.valid, build_row, mode="clip")),
+                c.dictionary,
+            )
+            for c in build.columns
+        ]
+        keep_match = matched
+        out_live = probe.mask() if self.kind != "inner" else matched
+        if self.residual is not None:
+            candidate = Batch(list(probe.columns) + list(bcols), out_live)
+            keep_match = jnp.logical_and(keep_match, self.residual(candidate))
+            if self.kind == "inner":
+                out_live = keep_match
+            else:
+                # non-matching residual degrades the row to null-build
+                bcols = [
+                    Column(
+                        c.data,
+                        c.type,
+                        jnp.logical_and(
+                            keep_match, c.valid if c.valid is not None else True
+                        ),
+                        c.dictionary,
+                    )
+                    for c in bcols
+                ]
+        new_matched = None
+        if self.kind == "full":
+            new_matched = build_matched.at[
+                jnp.where(keep_match, build_row, cap_b)
+            ].set(True, mode="drop")
+        return Batch(list(probe.columns) + list(bcols), out_live), new_matched
+
     def _join_batch(self, probe: Batch) -> Batch:
         cap_b = self.build.capacity
-        combined = self._combined_keys(self.build, probe)
-        start, count, perm = self._locate(combined, cap_b=cap_b)
+        start, count = self._locate_batch(probe)
+        maxc, total_inner, probe_live = (
+            int(x) for x in jax.device_get(
+                (jnp.max(count), jnp.sum(count), probe.count())
+            )
+        )
+        if maxc <= 1:
+            out, new_matched = self._expand_unique(
+                probe, self.build, start, count, self._build_matched, cap_b=cap_b
+            )
+            if new_matched is not None:
+                self._build_matched = new_matched
+            n_out = total_inner if self.kind == "inner" else probe_live
+            cc = next_pow2(max(n_out, 1), floor=1024)
+            if cc * 2 <= out.capacity:
+                # selective join: hand downstream a dense batch, not a
+                # mostly-dead full-capacity one
+                out = jax.jit(
+                    Batch.compact_device, static_argnames=("out_capacity",)
+                )(out, out_capacity=cc)
+            return out
         if self.kind == "inner":
-            total = int(jnp.sum(count))
+            total = total_inner
         else:
             total = int(jnp.sum(jnp.where(probe.mask(), jnp.maximum(count, 1), 0)))
         out_cap = next_pow2(max(total, 1), floor=1024)
         out, new_matched = self._expand(
-            probe, self.build, start, count, perm, self._build_matched,
+            probe, self.build, start, count, self._build_matched,
             out_cap=out_cap, cap_b=cap_b, total_emit=total,
         )
         if new_matched is not None:
@@ -334,7 +656,7 @@ class NestedLoopJoinOperator:
             )
 
 
-class SemiJoinOperator(_CombinedSortJoinBase):
+class SemiJoinOperator(_SortedBuildJoinBase):
     """Appends a boolean `mark` column: source key ∈ filtering-side keys.
 
     null_aware=True gives SQL IN null semantics — mark is NULL when the
@@ -362,13 +684,10 @@ class SemiJoinOperator(_CombinedSortJoinBase):
         self.filtering_types = list(filtering_types)
         self.null_aware = null_aware
         self.residual = residual
-        self.build: Optional[Batch] = None
         self._filter_has_null = False
         self._mark = _jit_cached(
             ("mark", null_aware, source_key_channel, filtering_key_channel),
-            lambda: jax.jit(
-                self._mark_step, static_argnames=("cap_b", "has_null")
-            ),
+            lambda: jax.jit(self._mark_step, static_argnames=("has_null",)),
         )
         res_key = (
             None
@@ -385,11 +704,14 @@ class SemiJoinOperator(_CombinedSortJoinBase):
         )
 
     def set_build(self, batches: list[Batch]) -> None:
-        self.build, _ = _dense_build(batches, self.filtering_types)
-        col = self.build.columns[self.build_keys[0]]
+        build, _ = _dense_build(batches, self.filtering_types)
+        col = build.columns[self.build_keys[0]]
         if col.valid is not None:
-            has_null = jnp.any(jnp.logical_and(self.build.mask(), jnp.logical_not(col.valid)))
+            has_null = jnp.any(
+                jnp.logical_and(build.mask(), jnp.logical_not(col.valid))
+            )
             self._filter_has_null = bool(has_null)
+        self._index_build(build)
 
     def _mark_from_matched(self, probe: Batch, matched, has_null: bool) -> Batch:
         key = probe.columns[self.probe_keys[0]]
@@ -402,14 +724,11 @@ class SemiJoinOperator(_CombinedSortJoinBase):
             mark_valid = key_valid
         return probe.append_column(Column(matched, T.BOOLEAN, mark_valid))
 
-    def _mark_step(
-        self, probe: Batch, combined: Batch, cap_b: int, has_null: bool
-    ) -> Batch:
-        _, count, _ = self._locate_step(combined, cap_b)
+    def _mark_step(self, probe: Batch, count, has_null: bool) -> Batch:
         return self._mark_from_matched(probe, count > 0, has_null)
 
     def _mark_residual_step(
-        self, probe: Batch, build: Batch, start, count, perm,
+        self, probe: Batch, build: Batch, start, count,
         cap_b: int, out_cap: int, total_emit, has_null: bool
     ) -> Batch:
         """Expand key-matching candidates, apply residual, any() per row."""
@@ -426,8 +745,7 @@ class SemiJoinOperator(_CombinedSortJoinBase):
         in_range = jnp.logical_and(
             j < count[ids], jnp.arange(out_cap, dtype=jnp.int64) < total_emit
         )
-        build_pos = jnp.clip(start[ids] + j, 0, perm.shape[0] - 1)
-        build_row = jnp.clip(perm[build_pos], 0, cap_b - 1)
+        build_row = jnp.clip(start[ids] + j, 0, cap_b - 1)
         pcols = [
             Column(
                 jnp.take(c.data, ids, mode="clip"),
@@ -457,17 +775,14 @@ class SemiJoinOperator(_CombinedSortJoinBase):
         assert self.build is not None
         cap_b = self.build.capacity
         for probe in stream:
-            combined = self._combined_keys(self.build, probe)
+            start, count = self._locate_batch(probe)
             if self.residual is None:
-                yield self._mark(
-                    probe, combined, cap_b=cap_b, has_null=self._filter_has_null
-                )
+                yield self._mark(probe, count, has_null=self._filter_has_null)
             else:
-                start, count, perm = self._locate(combined, cap_b=cap_b)
                 total = int(jnp.sum(count))
                 out_cap = next_pow2(max(total, 1), floor=1024)
                 yield self._mark_res(
-                    probe, self.build, start, count, perm,
+                    probe, self.build, start, count,
                     cap_b=cap_b, out_cap=out_cap, total_emit=total,
                     has_null=self._filter_has_null,
                 )

@@ -50,9 +50,15 @@ class RemoteTaskClient:
         self.task_id = task_id
 
     def submit(self, desc: TaskDescriptor) -> None:
+        from trino_tpu.server.worker import cluster_secret, sign_body
+
         body = pickle.dumps(desc, protocol=pickle.HIGHEST_PROTOCOL)
+        headers = {}
+        secret = cluster_secret()
+        if secret is not None:
+            headers["X-Cluster-Auth"] = sign_body(secret, body)
         req = urllib.request.Request(
-            f"{self.worker_url}/v1/task", data=body, method="POST"
+            f"{self.worker_url}/v1/task", data=body, headers=headers, method="POST"
         )
         with urllib.request.urlopen(req, timeout=60) as r:
             r.read()

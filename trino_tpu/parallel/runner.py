@@ -35,7 +35,13 @@ from trino_tpu.expr.ir import Form, InputRef, Literal, SpecialForm
 from trino_tpu.ops.aggregation import AggregationOperator, AggSpec
 from trino_tpu.ops.common import SortKey, next_pow2
 from trino_tpu.ops.filter_project import FilterProjectOperator
-from trino_tpu.ops.join import HashJoinOperator, SemiJoinOperator
+from trino_tpu.ops.join import (
+    HashJoinOperator,
+    SemiJoinOperator,
+    _canon_probe_device,
+    _locate_sorted,
+    _sort_build_device,
+)
 from trino_tpu.ops.sort import OrderByOperator, TopNOperator
 from trino_tpu.parallel import exchange as ex
 from trino_tpu.parallel.spmd import (
@@ -611,10 +617,14 @@ class StageExecutor:
         cap_b = _trailing_cap(build_stacked)
 
         def locate_step(pb: Batch, bb: Batch):
-            combined = _concat_keys(bb, bk, pb, pk)
-            return op._locate_step(combined, cap_b)
+            # per-shard PagesHash analog: sort THIS shard's build once, then
+            # binary-search the probe keys against it (ops/join.py design)
+            sb, canon, n_match = _sort_build_device(bb, bk)
+            pc, pn = _canon_probe_device(pb, pk, canon)
+            start, count = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
+            return start, count, sb
 
-        start, count, perm = spmd_step(self.wm, locate_step)(
+        start, count, sorted_build = spmd_step(self.wm, locate_step)(
             probe.stacked, build_stacked
         )
         count_h = np.asarray(jax.device_get(count))  # [W, cap_p]
@@ -627,15 +637,15 @@ class StageExecutor:
         totals = emit_h.sum(axis=-1)  # [W]
         out_cap = next_pow2(max(1, int(totals.max())), floor=1024)
 
-        def expand_step(pb: Batch, bb: Batch, st, ct, pm, total):
+        def expand_step(pb: Batch, bb: Batch, st, ct, total):
             out, _ = op._expand_step(
-                pb, bb, st, ct, pm, None, out_cap=out_cap,
+                pb, bb, st, ct, None, out_cap=out_cap,
                 cap_b=cap_b, total_emit=total,
             )
             return out
 
         out = spmd_step(self.wm, expand_step)(
-            probe.stacked, build_stacked, start, count, perm,
+            probe.stacked, sorted_build, start, count,
             jax.device_put(totals, self.wm.sharding()),
         )
         return _Dist(out, out_symbols)
@@ -666,8 +676,10 @@ class StageExecutor:
             )
 
         def mark_step(pb: Batch, bb: Batch) -> Batch:
-            combined = _concat_keys(bb, [fk], pb, [sk])
-            return op._mark_step(pb, combined, cap_b, has_null)
+            _, canon, n_match = _sort_build_device(bb, [fk])
+            pc, pn = _canon_probe_device(pb, [sk], canon)
+            _, count = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
+            return op._mark_step(pb, count, has_null)
 
         out = spmd_step(self.wm, mark_step)(src.stacked, bcast)
         return _Dist(out, src.symbols + [node.mark])
@@ -793,19 +805,3 @@ def _trailing_cap(stacked: Batch) -> int:
     return stacked.row_mask.shape[-1]
 
 
-def _concat_keys(build: Batch, bk, probe: Batch, pk) -> Batch:
-    """Device concat of the key columns of both sides (shared dictionaries
-    only).  Rows with NULL keys are masked out (`=` never matches NULL) —
-    the stacked-path twin of _CombinedSortJoinBase._combined_keys."""
-    cols = []
-    bmask, pmask = build.mask(), probe.mask()
-    for cb, cp in zip(bk, pk):
-        b, p = build.columns[cb], probe.columns[cp]
-        data = jnp.concatenate([b.data, p.data.astype(b.data.dtype)])
-        cols.append(Column(data, b.type, None, None))
-        if b.valid is not None:
-            bmask = jnp.logical_and(bmask, b.valid)
-        if p.valid is not None:
-            pmask = jnp.logical_and(pmask, p.valid)
-    mask = jnp.concatenate([bmask, pmask])
-    return Batch(cols, mask)

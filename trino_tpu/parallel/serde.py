@@ -86,7 +86,12 @@ def stable_row_hash(batch: Batch, channels: Sequence[int]) -> np.ndarray:
             if data.dtype == np.bool_:
                 h = data.astype(np.uint64)
             elif data.dtype.kind == "f":
-                h = np.float64(data).view(np.uint64).copy()
+                # canonicalize before viewing bits: -0.0 == 0.0 and all NaN
+                # payloads must land in the same exchange bucket (the
+                # doubleToLongBits-based reference hash does the same)
+                f = np.float64(data) + 0.0  # collapses -0.0 to 0.0
+                f = np.where(np.isnan(f), np.float64(np.nan), f)
+                h = f.view(np.uint64).copy()
         if c.valid is not None:
             h = np.where(np.asarray(c.valid), h, np.uint64(0))
         # splitmix64 finalizer per column, xor-combined

@@ -574,6 +574,38 @@ class LogicalPlanner:
         node = P.ProjectNode(node, assignments)
         return RelationPlan(node, fields), names
 
+    @staticmethod
+    def _expand_grouping_sets(group_by):
+        """Normalize GROUP BY elements into explicit grouping sets
+        (reference: QueryPlanner.planGroupingSets / Analyzer grouping-set
+        cross product).  Returns None for a plain single-set GROUP BY, else
+        the list of sets as tuples of AST exprs (cross product across
+        elements, per the SQL spec)."""
+        import itertools
+
+        if not any(isinstance(g, ast.GroupingElement) for g in group_by):
+            return None
+        per_element = []
+        for g in group_by:
+            if not isinstance(g, ast.GroupingElement):
+                per_element.append([(g,)])
+            elif g.kind == "rollup":
+                per_element.append(
+                    [tuple(g.sets[:i]) for i in range(len(g.sets), -1, -1)]
+                )
+            elif g.kind == "cube":
+                exprs = list(g.sets)
+                subs = []
+                for r in range(len(exprs), -1, -1):
+                    subs.extend(itertools.combinations(exprs, r))
+                per_element.append([tuple(s) for s in subs])
+            else:  # explicit GROUPING SETS
+                per_element.append([tuple(s) for s in g.sets])
+        return [
+            tuple(e for part in combo for e in part)
+            for combo in itertools.product(*per_element)
+        ]
+
     def _plan_aggregation(self, spec, rp, source_scope, outer, ctes, extra_keys=()):
         """`extra_keys`: source symbols injected as group keys and kept in the
         output (used by subquery decorrelation)."""
@@ -596,7 +628,17 @@ class LogicalPlanner:
         graft = _SubqueryGrafter(self, rp, outer, ctes)
         src_an = ExprAnalyzer(source_scope, on_subquery=graft)
 
-        # group-by expressions (ordinals allowed)
+        # grouping sets (ROLLUP/CUBE/GROUPING SETS) normalize to an explicit
+        # set list; plain GROUP BY keeps gsets=None
+        gsets_ast = self._expand_grouping_sets(spec.group_by)
+
+        def _resolve_ordinal(g):
+            if isinstance(g, ast.NumberLiteral):
+                return spec.items[int(g.text) - 1].expr
+            return g
+
+        # group-by expressions (ordinals allowed): the UNION of all keys
+        # across sets, in first-appearance order
         group_irs: list[Expr] = []
         group_syms: list[P.Symbol] = []
         group_keys: dict = {}
@@ -607,10 +649,13 @@ class LogicalPlanner:
             sym = pre_symbol(e, ksym.name)
             group_syms.append(sym)
             group_keys[e.key()] = sym
-        for g in spec.group_by:
-            if isinstance(g, ast.NumberLiteral):
-                item = spec.items[int(g.text) - 1]
-                g = item.expr
+        flat_exprs = (
+            [g for g in spec.group_by]
+            if gsets_ast is None
+            else [e for s in gsets_ast for e in s]
+        )
+        for g in flat_exprs:
+            g = _resolve_ordinal(g)
             e = src_an.analyze(g)
             if e.key() in group_keys:
                 continue
@@ -618,6 +663,17 @@ class LogicalPlanner:
             group_irs.append(e)
             group_syms.append(sym)
             group_keys[e.key()] = sym
+        # per-set membership by analyzed expr key; decorrelation extra_keys
+        # group in EVERY set
+        gid_sym = None
+        set_keys = None
+        if gsets_ast is not None:
+            gid_sym = alloc.new("groupid", T.BIGINT)
+            extra = {k.ref().key() for k in extra_keys}
+            set_keys = [
+                extra | {src_an.analyze(_resolve_ordinal(e)).key() for e in s}
+                for s in gsets_ast
+            ]
 
         # aggregates discovered lazily while translating post-agg expressions
         aggregations: list = []  # [(Symbol, P.Aggregation)]
@@ -696,15 +752,46 @@ class LogicalPlanner:
             agg_map[key] = sym
             return sym
 
+        def grouping_ir(node: ast.FunctionCall) -> Expr:
+            """GROUPING(e1..em): bitmask of which args are NOT grouped in
+            this row's grouping set, decoded from the group-id column
+            (reference: sql/analyzer — GroupingOperationRewriter)."""
+            if set_keys is None:
+                # single grouping set: every argument is grouped
+                return Literal(0, T.BIGINT)
+            arg_keys = [src_an.analyze(a).key() for a in node.args]
+            masks = []
+            for sk in set_keys:
+                bits = 0
+                for j, ak in enumerate(arg_keys):
+                    if ak not in sk:
+                        bits |= 1 << (len(arg_keys) - 1 - j)
+                masks.append(bits)
+            if len(set(masks)) == 1:
+                return Literal(masks[0], T.BIGINT)
+            args: list[Expr] = []
+            for k, bits in enumerate(masks[:-1]):
+                args.append(
+                    ir.comparison("=", gid_sym.ref(), Literal(k, T.BIGINT))
+                )
+                args.append(Literal(bits, T.BIGINT))
+            args.append(Literal(masks[-1], T.BIGINT))
+            return SpecialForm(Form.CASE, args, T.BIGINT)
+
         def post_hook(node: ast.Node, _an) -> Optional[Expr]:
+            if isinstance(node, ast.FunctionCall) and node.name == "grouping":
+                return grouping_ir(node)
             if isinstance(node, ast.FunctionCall) and node.window is None and (
                 node.name in AGG_FUNCS or (node.is_star and node.name == "count")
             ):
                 return agg_symbol(node).ref()
-            # match against group-by expressions
+            # match against group-by expressions.  TypeError covers
+            # speculative analysis of expressions containing functions the
+            # scalar registry doesn't know (e.g. grouping() nested inside
+            # arithmetic — resolved by this hook on recursion, not here).
             try:
                 e = src_an.analyze(node)
-            except AnalysisError:
+            except (AnalysisError, TypeError):
                 return None
             sym = group_keys.get(e.key())
             if sym is not None:
@@ -770,7 +857,81 @@ class LogicalPlanner:
         src_node = graft.plan.node
         # keep any source symbols referenced by pre_assign
         pre_node = P.ProjectNode(src_node, pre_assign)
-        agg_node = P.AggregationNode(pre_node, group_syms, aggregations)
+        if gsets_ast is None:
+            agg_node = P.AggregationNode(pre_node, group_syms, aggregations)
+        else:
+            # GroupIdNode analog (reference: sql/planner/plan/GroupIdNode
+            # .java:40): K-way input duplication — one UNION ALL branch per
+            # grouping set, non-member keys nulled, a group-id literal
+            # appended — then ONE aggregation over (keys..., groupid).
+            # Static-shape friendly: K is a plan constant.
+            pre_syms = [s for s, _ in pre_assign]
+            sym_to_key = {sym.name: k for k, sym in group_keys.items()}
+            agg_syms = [s for s, _ in aggregations]
+            # the () grouping set must yield its row even over EMPTY input
+            # (a global aggregation's semantics) — it cannot ride the keyed
+            # aggregation, which yields no groups for no rows
+            empty_idx = {
+                k for k, s in enumerate(gsets_ast) if not s and not extra_keys
+            }
+            branches = []
+            branch_syms = []
+            for k, sk in enumerate(set_keys):
+                if k in empty_idx:
+                    continue
+                assigns = []
+                bsyms = []
+                for s in pre_syms:
+                    bs = alloc.new(s.name, s.type)
+                    if s.name in sym_to_key and sym_to_key[s.name] not in sk:
+                        assigns.append((bs, Literal(None, s.type)))
+                    else:
+                        assigns.append((bs, s.ref()))
+                    bsyms.append(bs)
+                bgid = alloc.new("groupid", T.BIGINT)
+                assigns.append((bgid, Literal(k, T.BIGINT)))
+                bsyms.append(bgid)
+                branches.append(P.ProjectNode(pre_node, assigns))
+                branch_syms.append(bsyms)
+            main = None
+            if branches:
+                union_node = P.UnionNode(
+                    branches, pre_syms + [gid_sym], branch_syms
+                )
+                main = P.AggregationNode(
+                    union_node, group_syms + [gid_sym], aggregations
+                )
+            pads = []
+            for k in sorted(empty_idx):
+                gaggs = [
+                    (alloc.new(s.name, s.type), spec) for s, spec in aggregations
+                ]
+                gnode = P.AggregationNode(pre_node, [], gaggs)
+                passigns = []
+                psyms = []
+                for s in group_syms:
+                    ns = alloc.new(s.name, s.type)
+                    passigns.append((ns, Literal(None, s.type)))
+                    psyms.append(ns)
+                ngid = alloc.new("groupid", T.BIGINT)
+                passigns.append((ngid, Literal(k, T.BIGINT)))
+                psyms.append(ngid)
+                for gs, _spec in gaggs:
+                    ns = alloc.new(gs.name, gs.type)
+                    passigns.append((ns, gs.ref()))
+                    psyms.append(ns)
+                pads.append((P.ProjectNode(gnode, passigns), psyms))
+            canonical = group_syms + [gid_sym] + agg_syms
+            if main is not None and not pads:
+                agg_node = main
+            else:
+                sources = ([main] if main is not None else []) + [
+                    p for p, _ in pads
+                ]
+                srcsyms = ([canonical] if main is not None else []) + [
+                    ps for _, ps in pads
+                ]
+                agg_node = P.UnionNode(sources, canonical, srcsyms)
         cur = RelationPlan(
             agg_node,
             [Field(s.name, s) for s in agg_node.outputs],
@@ -853,8 +1014,27 @@ class LogicalPlanner:
                 pair = _as_equi_pair(e, outer_refs, sub_syms)
                 if pair is not None:
                     crit.append(pair)
-                else:
-                    correlated.append(e)
+                    continue
+                # correlation buried in a disjunction: factor out equi
+                # conjuncts common to EVERY disjunct (q41 shape:
+                # `(m = i1.m and A) or (m = i1.m and B)` ->
+                # crit gets (m, i1.m), predicate becomes `A or B`)
+                factored = _factor_common_equi(e, outer_refs, sub_syms)
+                if factored is not None:
+                    pairs, rest = factored
+                    crit.extend(pairs)
+                    if rest is not None:
+                        rest_refs: set = set()
+                        _collect_ref_names(rest, rest_refs)
+                        if rest_refs <= sub_syms:
+                            sub = RelationPlan(
+                                P.FilterNode(sub.node, rest), sub.fields
+                            )
+                            sub_scope = sub.scope(sub_outer)
+                        else:
+                            correlated.append(rest)
+                    continue
+                correlated.append(e)
         for c in plain:
             sub = self._apply_where(sub, c, sub_outer, ctes)
         # ---- EXISTS / IN ----------------------------------------------------
@@ -1019,6 +1199,13 @@ class LogicalPlanner:
                 )
             return out, val
         # uncorrelated aggregated scalar: global agg -> single row cross join
+        if correlated:
+            # refusing is mandatory: a dropped correlation silently counts
+            # the WHOLE inner relation per outer row (wrong results)
+            raise AnalysisError(
+                "correlated aggregated scalar subquery without an equi-join "
+                "predicate not supported"
+            )
         node = P.JoinNode("cross", rp.node, rp2.node, [])
         out = RelationPlan(node, rp.fields + rp2.fields)
         return out, rp2.fields[0].symbol.ref()
@@ -1246,6 +1433,44 @@ def _contains_subquery(node: ast.Node) -> bool:
                         if isinstance(sub, ast.Node) and _contains_subquery(sub):
                             return True
     return False
+
+
+def _factor_common_equi(e: Expr, outer_refs, sub_syms):
+    """If `e` is a disjunction whose EVERY disjunct conjoins the same
+    outer=inner equality, hoist those equalities out:
+    `(k = o and A) or (k = o and B)` == `k = o and (A or B)`.
+    Returns (pairs, rest Expr or None), or None when not factorable."""
+    if not (isinstance(e, SpecialForm) and e.form == Form.OR):
+        return None
+    disjuncts = [split_ir_conjuncts(d) for d in e.args]
+    first_keys = {c.key(): c for c in disjuncts[0]}
+    common = []
+    for k, c in first_keys.items():
+        if all(any(x.key() == k for x in d) for d in disjuncts[1:]):
+            pair = _as_equi_pair(c, outer_refs, sub_syms)
+            if pair is not None:
+                common.append((k, pair))
+    if not common:
+        return None
+    common_keys = {k for k, _ in common}
+    rests = []
+    for d in disjuncts:
+        kept = [c for c in d if c.key() not in common_keys]
+        rests.append(ir.and_(*kept) if kept else Literal(True, T.BOOLEAN))
+    if any(isinstance(x, Literal) and x.value is True for x in rests):
+        rest = None  # some disjunct was ONLY the equalities: rest is TRUE
+    else:
+        rest = ir.or_(*rests)
+    return [p for _, p in common], rest
+
+
+def split_ir_conjuncts(e: Expr) -> list:
+    if isinstance(e, SpecialForm) and e.form == Form.AND:
+        out = []
+        for a in e.args:
+            out.extend(split_ir_conjuncts(a))
+        return out
+    return [e]
 
 
 def _as_equi_pair(e: Expr, left_names, right_names):

@@ -469,9 +469,20 @@ class LocalExecutionPlanner:
         def stream():
             for child, mapping in zip(node.sources, node.source_symbols):
                 sub = self.plan(child)
-                proj = FilterProjectOperator(
-                    None, [InputRef(sub.channel(m.name), m.type) for m in mapping]
-                )
+                exprs = []
+                for m, out in zip(mapping, node.symbols):
+                    if m.type.name == "unknown":
+                        # a NULL-literal branch column: no castable values
+                        exprs.append(Literal(None, out.type))
+                        continue
+                    e: Expr = InputRef(sub.channel(m.name), m.type)
+                    if m.type.name != out.type.name:
+                        # branch type narrower than the union's unified type
+                        # (e.g. decimal cents unioned with double): a real
+                        # CAST, not a relabel — decimals must descale
+                        e = SpecialForm(Form.CAST, [e], out.type)
+                    exprs.append(e)
+                proj = FilterProjectOperator(None, exprs)
                 yield from proj.process(sub.stream)
 
         return PhysicalPlan(stream(), node.symbols)

@@ -23,7 +23,7 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "target_splits", "connector splits per table scan", int, 4
         ),
         PropertyMetadata(
-            "page_rows", "max rows per scan page (device batch size)", int, 1 << 17
+            "page_rows", "max rows per scan page (device batch size)", int, 1 << 20
         ),
         PropertyMetadata(
             "broadcast_join_rows",

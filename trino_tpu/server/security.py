@@ -31,15 +31,23 @@ class AuthenticationError(PermissionError):
 class PasswordAuthenticator:
     """user -> salted-hash store; constant-time verification."""
 
+    #: PBKDF2 rounds (reference password-file plugin uses bcrypt/PBKDF2;
+    #: kept modest because tests create many users per run)
+    ROUNDS = 50_000
+
     def __init__(self, users: Optional[dict] = None):
-        #: user -> (salt, sha256(salt + password))
+        #: user -> (random salt bytes, pbkdf2_hmac(sha256) digest)
         self._users: dict[str, tuple] = {}
         for user, password in (users or {}).items():
             self.set_password(user, password)
 
     def set_password(self, user: str, password: str) -> None:
-        salt = hashlib.sha256(user.encode()).hexdigest()[:16]
-        digest = hashlib.sha256((salt + password).encode()).hexdigest()
+        import os
+
+        salt = os.urandom(16)
+        digest = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), salt, self.ROUNDS
+        )
         self._users[user] = (salt, digest)
 
     def authenticate(self, user: str, password: str) -> bool:
@@ -47,7 +55,7 @@ class PasswordAuthenticator:
         if entry is None:
             return False
         salt, expect = entry
-        got = hashlib.sha256((salt + password).encode()).hexdigest()
+        got = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, self.ROUNDS)
         return hmac.compare_digest(got, expect)
 
     @classmethod
@@ -171,11 +179,14 @@ class RuleBasedAccessControl(AccessControl):
         self._check("INSERT", user, catalog, schema, table)
 
     def filter_catalogs(self, user: str, catalogs: Sequence[str]) -> list:
+        """First-match-wins (like _check): the FIRST rule matching
+        user+catalog decides visibility, and only if it grants at least one
+        privilege — a privilege-less rule must not reveal the catalog."""
         out = []
         for c in catalogs:
-            if any(
-                re.fullmatch(r.user, user) and re.fullmatch(r.catalog, c)
-                for r in self.rules
-            ):
-                out.append(c)
+            for r in self.rules:
+                if re.fullmatch(r.user, user) and re.fullmatch(r.catalog, c):
+                    if r.privileges:
+                        out.append(c)
+                    break  # first match decides
         return out

@@ -10,13 +10,21 @@ parallelism is XLA collectives over the device mesh (parallel/runner.py);
 inter-host distribution is fragments shipped to worker processes with HTTP
 exchanges — the DCN tier, matching the reference's worker-to-worker shuffle.
 
-Wire format: pickled plan fragments (trusted intra-cluster traffic, the role
-of the reference's internal thrift/json codecs) + PagesSerde buckets
-(parallel/serde.py).
+Wire format: pickled plan fragments (intra-cluster traffic, the role of the
+reference's internal thrift/json codecs) + PagesSerde buckets
+(parallel/serde.py).  Because unpickling executes code, task submissions are
+authenticated: when TRINO_TPU_CLUSTER_SECRET is set, every POST /v1/task must
+carry an HMAC-SHA256 of the body under X-Cluster-Auth (the internal-
+communication shared-secret analog of the reference's
+internal-communication.shared-secret).  Binding to a non-loopback interface
+REQUIRES the secret; the default loopback bind works without one.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
+import os
 import pickle
 import threading
 import traceback
@@ -24,6 +32,17 @@ import urllib.request
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
+
+
+def cluster_secret() -> Optional[bytes]:
+    """Shared intra-cluster secret (reference:
+    internal-communication.shared-secret)."""
+    s = os.environ.get("TRINO_TPU_CLUSTER_SECRET")
+    return s.encode() if s else None
+
+
+def sign_body(secret: bytes, body: bytes) -> str:
+    return _hmac.new(secret, body, hashlib.sha256).hexdigest()
 
 
 @dataclass
@@ -81,11 +100,18 @@ class WorkerServer:
     """One worker process: accepts tasks, executes fragments, serves
     result buckets."""
 
-    def __init__(self, catalogs=None, port: int = 0):
+    def __init__(self, catalogs=None, port: int = 0, host: str = "127.0.0.1"):
         from trino_tpu.connectors.api import default_catalogs
 
         self.catalogs = catalogs or default_catalogs()
         self._tasks: dict[str, _Task] = {}
+        self._secret = cluster_secret()
+        if host not in ("127.0.0.1", "localhost") and self._secret is None:
+            raise ValueError(
+                "non-loopback worker bind requires TRINO_TPU_CLUSTER_SECRET "
+                "(task submissions are code-executing pickles)"
+            )
+        self._host = host
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -103,7 +129,14 @@ class WorkerServer:
                 if self.path != "/v1/task":
                     return self._bytes(404, b"not found", "text/plain")
                 n = int(self.headers.get("Content-Length", 0))
-                desc = pickle.loads(self.rfile.read(n))
+                body = self.rfile.read(n)
+                secret = worker._secret
+                if secret is not None:
+                    sig = self.headers.get("X-Cluster-Auth", "")
+                    if not _hmac.compare_digest(sig, sign_body(secret, body)):
+                        # reject BEFORE unpickling: the codec executes code
+                        return self._bytes(401, b"bad signature", "text/plain")
+                desc = pickle.loads(body)
                 t = worker.submit(desc)
                 self._bytes(200, t.desc.task_id.encode(), "text/plain")
 
@@ -146,13 +179,13 @@ class WorkerServer:
                     worker._tasks.pop(parts[2], None)
                 self._bytes(200, b"ok", "text/plain")
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd = ThreadingHTTPServer((self._host, port), Handler)
         self.port = self._httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        return f"http://{self._host}:{self.port}"
 
     def start(self) -> "WorkerServer":
         self._thread = threading.Thread(
@@ -264,8 +297,13 @@ def main():  # pragma: no cover - manual entry point
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument(
+        "--host",
+        default="127.0.0.1",
+        help="bind address; non-loopback requires TRINO_TPU_CLUSTER_SECRET",
+    )
     args = ap.parse_args()
-    w = WorkerServer(port=args.port)
+    w = WorkerServer(port=args.port, host=args.host)
     print(f"worker listening on {w.url}", flush=True)
     w._httpd.serve_forever()
 

@@ -193,6 +193,16 @@ class Star(Node):
 
 
 @dataclass(frozen=True)
+class GroupingElement(Node):
+    """One GROUP BY element that expands to multiple grouping sets
+    (reference: SqlBase.g4 groupingElement — ROLLUP / CUBE / GROUPING SETS).
+    kind: rollup | cube | sets; sets: tuple of tuples of exprs."""
+
+    kind: str
+    sets: tuple  # tuple[tuple[Node, ...], ...] for sets; tuple[Node,...] else
+
+
+@dataclass(frozen=True)
 class Placeholder(Node):
     index: int
 

@@ -385,12 +385,58 @@ class Parser:
         group_by = ()
         if self.accept_kw("group"):
             self.expect_kw("by")
-            exprs = [self._expr()]
+            exprs = [self._grouping_element()]
             while self.accept_op(","):
-                exprs.append(self._expr())
+                exprs.append(self._grouping_element())
             group_by = tuple(exprs)
         having = self._expr() if self.accept_kw("having") else None
         return ast.QuerySpec(tuple(items), relation, where, group_by, having, distinct)
+
+    def _grouping_element(self):
+        """groupingElement: ROLLUP '(' ... ')' | CUBE '(' ... ')' |
+        GROUPING SETS '(' groupingSet (',' groupingSet)* ')' | expr
+        (reference: SqlBase.g4:273-275 groupingElement)."""
+        if self.accept_kw("rollup"):
+            self.expect_op("(")
+            exprs = [self._expr()]
+            while self.accept_op(","):
+                exprs.append(self._expr())
+            self.expect_op(")")
+            return ast.GroupingElement("rollup", tuple(exprs))
+        if self.accept_kw("cube"):
+            self.expect_op("(")
+            exprs = [self._expr()]
+            while self.accept_op(","):
+                exprs.append(self._expr())
+            self.expect_op(")")
+            return ast.GroupingElement("cube", tuple(exprs))
+        nxt = self.peek(1)
+        if self.peek().is_kw("grouping") and (
+            # SETS is contextual, not reserved (Trino treats it as a
+            # non-reserved word): match the bare ident after GROUPING
+            nxt.kind == "ident" and nxt.value.lower() == "sets"
+        ):
+            self.next()
+            self.next()
+            self.expect_op("(")
+            sets = [self._grouping_set()]
+            while self.accept_op(","):
+                sets.append(self._grouping_set())
+            self.expect_op(")")
+            return ast.GroupingElement("sets", tuple(sets))
+        return self._expr()
+
+    def _grouping_set(self) -> tuple:
+        """'(' exprs? ')' (incl. the empty set) | single expr."""
+        if self.accept_op("("):
+            if self.accept_op(")"):
+                return ()
+            exprs = [self._expr()]
+            while self.accept_op(","):
+                exprs.append(self._expr())
+            self.expect_op(")")
+            return tuple(exprs)
+        return (self._expr(),)
 
     def _select_item(self):
         t = self.peek()
