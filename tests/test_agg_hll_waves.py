@@ -1,0 +1,101 @@
+"""approx_distinct (HyperLogLog) + aggregation memory waves.
+
+Reference roles: operator/aggregation/ApproximateCountDistinctAggregation
+.java + state/HyperLogLogStateFactory.java:23 (mergeable bounded sketch
+state), HashAggregationOperator.startMemoryRevoke:449 (memory-bounded
+grouped aggregation).
+"""
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=4)
+
+
+def _exact(runner, col, table):
+    return runner.execute(f"select count(distinct {col}) from {table}").rows[0][0]
+
+
+@pytest.mark.parametrize(
+    "col,table",
+    [
+        ("l_orderkey", "lineitem"),   # ~15k distinct at tiny
+        ("l_partkey", "lineitem"),    # ~2k
+        ("l_shipdate", "lineitem"),   # ~2.5k distinct dates
+        ("l_returnflag", "lineitem"), # 3 distinct strings (dictionary hash)
+        ("l_discount", "lineitem"),   # 11 distinct decimals
+    ],
+)
+def test_approx_distinct_within_error(runner, col, table):
+    exact = _exact(runner, col, table)
+    got = runner.execute(f"select approx_distinct({col}) from {table}").rows[0][0]
+    # p=13 registers: standard error ~1.15%; assert 3 sigma + small-N slack
+    assert abs(got - exact) <= max(3, 0.04 * exact), (got, exact)
+
+
+def test_approx_distinct_null_and_empty(runner):
+    # empty input and all-NULL input both count 0 (count-like semantics)
+    assert runner.execute(
+        "select approx_distinct(l_orderkey) from lineitem where l_orderkey < 0"
+    ).rows == [(0,)]
+
+
+def test_approx_distinct_merges_across_batches(runner):
+    # target_splits=4 feeds multiple batches: per-batch register states must
+    # merge by elementwise max into the same estimate a single batch gives
+    one = LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=1)
+    q = "select approx_distinct(l_suppkey) from lineitem"
+    assert runner.execute(q).rows == one.execute(q).rows
+
+
+def test_grouped_approx_distinct_falls_back_exact(runner):
+    got = runner.execute(
+        "select l_returnflag, approx_distinct(l_linenumber) from lineitem "
+        "group by l_returnflag order by l_returnflag"
+    ).rows
+    want = runner.execute(
+        "select l_returnflag, count(distinct l_linenumber) from lineitem "
+        "group by l_returnflag order by l_returnflag"
+    ).rows
+    assert got == want
+
+
+def test_distributed_approx_distinct_matches_local():
+    from trino_tpu.parallel import DistributedQueryRunner
+
+    d = DistributedQueryRunner(catalog="tpch", schema="tiny", n_workers=4)
+    l = LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=4)
+    q = "select approx_distinct(o_custkey) from orders"
+    # the sketch is deterministic and merge is exact max: same registers,
+    # same estimate, regardless of how rows were partitioned
+    assert d.execute(q).rows == l.execute(q).rows
+
+
+def test_agg_waves_exact_under_budget():
+    r = LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=4)
+    q = (
+        "select l_orderkey, sum(l_quantity) q, count(*) c from lineitem "
+        "group by l_orderkey order by q desc, l_orderkey limit 5"
+    )
+    base = r.execute(q).rows
+    r.execute("set session query_max_memory_bytes = 200000")
+    waved = r.execute(q).rows
+    assert base == waved
+
+
+def test_agg_waves_with_having_and_avg():
+    r = LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=4)
+    q = (
+        "select o_custkey, avg(o_totalprice) a from orders "
+        "group by o_custkey having count(*) > 2 order by a desc limit 3"
+    )
+    base = r.execute(q).rows
+    r.execute("set session query_max_memory_bytes = 150000")
+    waved = r.execute(q).rows
+    assert base == waved

@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from trino_tpu import types as T
 from trino_tpu.columnar import (
     Batch,

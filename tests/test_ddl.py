@@ -3,6 +3,8 @@
 
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from trino_tpu.runtime.runner import LocalQueryRunner
 
 

@@ -1,0 +1,75 @@
+"""DELETE / UPDATE DML (reference: sql/tree/Delete.java, Update.java,
+plan/TableDeleteNode.java — realized as exact filtered table rewrites over
+write-capable connectors, sharing INSERT's snapshot semantics)."""
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+from trino_tpu.connectors.api import CatalogManager
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    cm = CatalogManager()
+    cm.register("mem", MemoryConnector())
+    r = LocalQueryRunner(cm, catalog="mem", schema="s")
+    r.execute("create table t (a bigint, b varchar, c double)")
+    r.execute(
+        "insert into t values (1,'x',1.5),(2,'y',2.5),(3,'z',3.5),(4,'x',4.5)"
+    )
+    return r
+
+
+def test_delete_where(runner):
+    assert runner.execute("delete from t where b = 'x'").rows == [(2,)]
+    assert runner.execute("select a from t order by a").rows == [(2,), (3,)]
+
+
+def test_delete_null_predicate_keeps_row(runner):
+    # rows where the predicate is NULL are NOT deleted (SQL semantics)
+    runner.execute("insert into t (a) values (9)")
+    assert runner.execute("delete from t where b = 'nope'").rows == [(0,)]
+    assert runner.execute("select count(*) from t").rows == [(5,)]
+
+
+def test_delete_all(runner):
+    assert runner.execute("delete from t").rows == [(4,)]
+    assert runner.execute("select count(*) from t").rows == [(0,)]
+
+
+def test_update_multi_assign(runner):
+    assert runner.execute(
+        "update t set c = c * 10, b = 'w' where a >= 3"
+    ).rows == [(2,)]
+    assert runner.execute("select b, c from t where a = 3").rows == [("w", 35.0)]
+    assert runner.execute("select b, c from t where a = 1").rows == [("x", 1.5)]
+
+
+def test_update_expression_references_row(runner):
+    runner.execute("update t set a = a + 100 where b = 'x'")
+    assert runner.execute("select a from t order by a").rows == [
+        (2,), (3,), (101,), (104,),
+    ]
+
+
+def test_dml_rollback(runner):
+    runner.execute("start transaction")
+    runner.execute("delete from t")
+    assert runner.execute("select count(*) from t").rows == [(0,)]
+    runner.execute("rollback")
+    assert runner.execute("select count(*) from t").rows == [(4,)]
+
+
+def test_dml_commit(runner):
+    runner.execute("start transaction")
+    runner.execute("update t set c = 0.0 where a = 1")
+    runner.execute("commit")
+    assert runner.execute("select c from t where a = 1").rows == [(0.0,)]
+
+
+def test_update_unknown_column_rejected(runner):
+    with pytest.raises(Exception, match="unknown columns"):
+        runner.execute("update t set nope = 1")

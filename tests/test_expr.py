@@ -8,6 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from trino_tpu import types as T
 from trino_tpu.columnar import batch_from_rows
 from trino_tpu.expr import ExprCompiler, InputRef, Literal, Call, SpecialForm, Form

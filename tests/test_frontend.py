@@ -3,6 +3,8 @@ BasePlanTest plan-shape assertions)."""
 
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from trino_tpu.connectors.api import default_catalogs
 from trino_tpu.connectors.tpch.queries import QUERIES
 from trino_tpu.planner import plan as P

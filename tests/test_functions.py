@@ -6,6 +6,8 @@ import math
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from trino_tpu.runtime.runner import LocalQueryRunner
 from trino_tpu.testing import tpch_pandas
 

@@ -7,6 +7,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from tests.test_e2e import assert_rows_match
 from trino_tpu.runtime.runner import LocalQueryRunner
 from trino_tpu.testing import tpch_pandas

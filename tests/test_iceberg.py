@@ -1,0 +1,84 @@
+"""Iceberg-analog connector: snapshots, metadata tables, time travel
+(reference: plugin/trino-iceberg — IcebergPageSourceProvider.java:192,
+$files/$history/$snapshots metadata tables, snapshot addressing)."""
+
+import tempfile
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+from trino_tpu.connectors.api import CatalogManager
+from trino_tpu.connectors.iceberg import IcebergConnector
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner(tmp_path):
+    cm = CatalogManager()
+    cm.register("ice", IcebergConnector(str(tmp_path)))
+    r = LocalQueryRunner(cm, catalog="ice", schema="s")
+    r.execute("create table t (a bigint, b varchar, c double)")
+    r.execute("insert into t values (1,'x',1.5),(2,'y',2.5)")
+    r.execute("insert into t values (3,'z',3.5)")
+    return r
+
+
+def test_read_current_snapshot(runner):
+    assert runner.execute("select * from t order by a").rows == [
+        (1, "x", 1.5), (2, "y", 2.5), (3, "z", 3.5),
+    ]
+
+
+def test_snapshots_metadata_table(runner):
+    rows = runner.execute(
+        'select snapshot_id, operation, total_records from "t$snapshots" '
+        "order by snapshot_id"
+    ).rows
+    assert rows == [(1, "create", 0), (2, "append", 2), (3, "append", 3)]
+
+
+def test_files_metadata_table(runner):
+    rows = runner.execute(
+        'select record_count from "t$files" order by record_count'
+    ).rows
+    assert rows == [(1,), (2,)]
+
+
+def test_history_metadata_table(runner):
+    rows = runner.execute(
+        'select snapshot_id, operation from "t$history" order by snapshot_id'
+    ).rows
+    assert [r[1] for r in rows] == ["create", "append", "append"]
+
+
+def test_time_travel(runner):
+    # snapshot 2 = after the first insert only
+    assert runner.execute('select * from "t@2" order by a').rows == [
+        (1, "x", 1.5), (2, "y", 2.5),
+    ]
+    assert runner.execute('select count(*) from "t@1"').rows == [(0,)]
+
+
+def test_dml_preserves_history(runner):
+    runner.execute("delete from t where a = 2")
+    assert runner.execute("select a from t order by a").rows == [(1,), (3,)]
+    # pre-delete snapshot still readable (immutable data files)
+    assert runner.execute('select count(*) from "t@3"').rows == [(3,)]
+    runner.execute("update t set c = 99.0 where b = 'z'")
+    assert runner.execute("select c from t where a = 3").rows == [(99.0,)]
+
+
+def test_transaction_rollback(runner):
+    runner.execute("start transaction")
+    runner.execute("delete from t")
+    assert runner.execute("select count(*) from t").rows == [(0,)]
+    runner.execute("rollback")
+    assert runner.execute("select count(*) from t").rows == [(3,)]
+
+
+def test_joins_and_aggregation_over_iceberg(runner):
+    rows = runner.execute(
+        "select b, sum(c) s from t group by b order by b"
+    ).rows
+    assert rows == [("x", 1.5), ("y", 2.5), ("z", 3.5)]

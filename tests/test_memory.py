@@ -4,6 +4,8 @@ TestMemoryPools)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from trino_tpu import types as T
 from trino_tpu.columnar import Batch, Column
 from trino_tpu.runtime.memory import (

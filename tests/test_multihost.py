@@ -111,3 +111,64 @@ def test_stable_hash_cross_dictionary():
 def test_worker_failure_surfaces(workers, mh):
     with pytest.raises(Exception, match="no_such_column|failed"):
         mh.execute("select no_such_column from lineitem")
+
+
+# -- round 4: node scheduling + worker replacement ---------------------------
+
+
+def test_dead_worker_excluded_at_assignment(local):
+    """NodeScheduler role: a worker that fails the liveness probe is never
+    assigned a fragment; live workers absorb its splits."""
+    live = [WorkerServer(port=0).start() for _ in range(2)]
+    try:
+        dead = WorkerServer(port=0).start()
+        dead.shutdown()  # registered URL, nobody listening
+        mh2 = MultiHostQueryRunner(
+            [w.url for w in live] + [dead.url], catalog="tpch", schema="tiny"
+        )
+        q = "select count(*), sum(l_quantity) from lineitem"
+        assert mh2.execute(q).rows == local.execute(q).rows
+    finally:
+        for w in live:
+            w.shutdown()
+
+
+def test_worker_death_mid_query_reassigns(local):
+    """A worker killed AFTER its tasks were submitted: the coordinator
+    reassigns those tasks to live workers and the query completes exactly
+    (EventDrivenFaultTolerantQueryScheduler task-retry role)."""
+    from trino_tpu.parallel import remote as rmod
+
+    ws = [WorkerServer(port=0).start() for _ in range(3)]
+    victim = ws[1]
+    try:
+        mh2 = MultiHostQueryRunner(
+            [w.url for w in ws], catalog="tpch", schema="tiny"
+        )
+        # kill the victim between task submission and result pull by hooking
+        # the first result fetch
+        orig_fetch = rmod._fetch_ok
+        state = {"killed": False}
+
+        def killing_fetch(task):
+            if not state["killed"]:
+                state["killed"] = True
+                victim.shutdown()
+            return orig_fetch(task)
+
+        rmod._fetch_ok = killing_fetch
+        try:
+            q = (
+                "select l_returnflag, count(*) c, sum(l_quantity) q "
+                "from lineitem group by l_returnflag order by l_returnflag"
+            )
+            got = mh2.execute(q).rows
+        finally:
+            rmod._fetch_ok = orig_fetch
+        assert got == local.execute(q).rows
+    finally:
+        for w in ws:
+            try:
+                w.shutdown()
+            except Exception:
+                pass

@@ -8,6 +8,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from trino_tpu import types as T
 from trino_tpu.columnar import batch_from_rows
 from trino_tpu.connectors.api import TableHandle

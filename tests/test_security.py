@@ -8,6 +8,8 @@ import urllib.request
 
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from trino_tpu.runtime.runner import LocalQueryRunner
 from trino_tpu.server.security import (
     AccessDeniedError,

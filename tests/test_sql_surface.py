@@ -5,6 +5,8 @@ MultipleDistinctAggregationToMarkDistinct)."""
 
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from tests.test_e2e import assert_rows_match
 from trino_tpu.runtime.runner import LocalQueryRunner
 from trino_tpu.testing import tpch_pandas

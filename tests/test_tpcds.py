@@ -16,7 +16,12 @@ def _fresh_caches():
     """The TPC-DS module compiles hundreds of fragment kernels; entering it
     with the whole suite's accumulated executables has hit allocator-level
     XLA crashes late in the run.  Start from a clean compile cache and an
-    empty buffer pool (everything recompiles on demand)."""
+    empty buffer pool (everything recompiles on demand).
+
+    (The periodic purge below also keeps the allocator fresh enough that the
+    persistent-cache writer — which segfaulted when hundreds of executables
+    had accumulated — stays safe, and purged kernels RELOAD from disk
+    instead of recompiling.)"""
     import jax
 
     from trino_tpu.runtime.buffer_pool import POOL
@@ -26,6 +31,26 @@ def _fresh_caches():
     yield
     jax.clear_caches()
     POOL.clear()
+
+
+_TEST_TICK = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _periodic_executable_purge():
+    """The allocator corruption above is reached WITHIN this module too
+    (XLA:CPU segfaults compiling around the ~45th query with hundreds of
+    live executables).  Purge every few tests; queries recompile their own
+    kernels, correctness is unaffected."""
+    yield
+    _TEST_TICK["n"] += 1
+    if _TEST_TICK["n"] % 10 == 0:
+        import jax
+
+        from trino_tpu.runtime.buffer_pool import POOL
+
+        jax.clear_caches()
+        POOL.clear()
 
 
 @pytest.fixture(scope="module")

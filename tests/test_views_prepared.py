@@ -1,0 +1,71 @@
+"""Views + prepared statements (reference: sql/tree/CreateView.java,
+StatementAnalyzer view expansion, sql/tree/Prepare.java + the protocol's
+prepared-statement headers)."""
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+from trino_tpu.runtime.runner import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+def test_create_and_query_view(runner):
+    runner.execute(
+        "create view top_n as select n_name, n_regionkey from nation "
+        "where n_nationkey < 10"
+    )
+    assert runner.execute("select count(*) from top_n").rows == [(10,)]
+    # views join like tables (inline expansion)
+    rows = runner.execute(
+        "select r_name, count(*) c from top_n join region "
+        "on n_regionkey = r_regionkey group by r_name order by c desc limit 1"
+    ).rows
+    assert rows[0][1] == 3
+
+
+def test_view_or_replace_and_drop(runner):
+    runner.execute("create view v1 as select 1 as x")
+    with pytest.raises(Exception, match="already exists"):
+        runner.execute("create view v1 as select 2 as x")
+    runner.execute("create or replace view v1 as select 2 as x")
+    assert runner.execute("select x from v1").rows == [(2,)]
+    runner.execute("drop view v1")
+    with pytest.raises(Exception):
+        runner.execute("select * from v1")
+    runner.execute("drop view if exists v1")  # no error
+
+
+def test_view_over_view(runner):
+    runner.execute("create view a_v as select n_nationkey k from nation")
+    runner.execute("create view b_v as select k from a_v where k < 5")
+    assert runner.execute("select count(*) from b_v").rows == [(5,)]
+
+
+def test_create_view_validates_definition(runner):
+    with pytest.raises(Exception):
+        runner.execute("create view bad as select no_such_col from nation")
+
+
+def test_prepare_execute_deallocate(runner):
+    runner.execute(
+        "prepare q1 from select n_name from nation "
+        "where n_nationkey = ? or n_name = ?"
+    )
+    assert runner.execute("execute q1 using 3, 'CANADA'").rows == [("CANADA",)]
+    rows = runner.execute("execute q1 using 0, 'PERU'").rows
+    assert sorted(rows) == [("ALGERIA",), ("PERU",)]
+    runner.execute("deallocate q1")
+    with pytest.raises(Exception, match="not found"):
+        runner.execute("execute q1 using 1, 'x'")
+
+
+def test_prepare_null_and_negative_params(runner):
+    runner.execute(
+        "prepare q2 from select count(*) from nation where n_nationkey > ?"
+    )
+    assert runner.execute("execute q2 using -1").rows == [(25,)]

@@ -127,11 +127,19 @@ class _MemorySink:
                 if old.dictionary is not None:
                     from trino_tpu.columnar.dictionary import union_dictionaries
 
-                    dictionary, ra, rb = union_dictionaries(
-                        old.dictionary, new.dictionary
-                    )
-                    ov = ra[ov.astype(np.int64)]
-                    nv = rb[nv.astype(np.int64)]
+                    if len(new.dictionary) == 0:
+                        # an all-NULL page carries an empty dictionary; its
+                        # code payload is masked, nothing to recode
+                        nv = np.zeros_like(nv)
+                    elif len(old.dictionary) == 0:
+                        dictionary = new.dictionary
+                        ov = np.zeros_like(ov)
+                    else:
+                        dictionary, ra, rb = union_dictionaries(
+                            old.dictionary, new.dictionary
+                        )
+                        ov = ra[ov.astype(np.int64)]
+                        nv = rb[nv.astype(np.int64)]
                 valid = None
                 if old.valid is not None or new.valid is not None:
                     valid = np.concatenate(

@@ -706,8 +706,39 @@ def _concat(ctx, call, *vals):
         s = "".join(_literal_str(v, "concat") for v in vals)
         d = StringDictionary([s])
         return Val(np.int32(0), None, call.type, d)
+    if len(col_ix) == 2:
+        # two dictionary columns: materialize the bounded cross-product
+        # dictionary (|da| x |db| pairs) once at trace time; the row value
+        # is a single table gather (reference role: ConcatFunction, but
+        # amortized over dictionary cardinality, not rows)
+        i0, i1 = col_ix
+        a, b = vals[i0], vals[i1]
+        da, db = a.dictionary, b.dictionary
+        if len(da) * len(db) > (1 << 20):
+            raise NotImplementedError(
+                "concat of two string columns with dictionary product "
+                f"{len(da)}x{len(db)} exceeds the materialization bound"
+            )
+        pre = "".join(_literal_str(v, "concat") for v in vals[:i0])
+        mid = "".join(_literal_str(v, "concat") for v in vals[i0 + 1 : i1])
+        post = "".join(_literal_str(v, "concat") for v in vals[i1 + 1 :])
+        pairs = [
+            pre + va + mid + vb + post for va in da.values for vb in db.values
+        ]
+        merged = StringDictionary.from_unsorted(pairs)
+        ix = merged.index
+        table = np.fromiter(
+            (ix[p] for p in pairs), dtype=np.int32, count=len(pairs)
+        )
+        nb = len(db)
+        flat = jnp.asarray(a.data, jnp.int32) * nb + jnp.asarray(b.data, jnp.int32)
+        data = jnp.take(jnp.asarray(table), flat, mode="clip")
+        valid = None
+        for v in vals:
+            valid = _and_valid(valid, v.valid)
+        return Val(data, valid, call.type, merged)
     if len(col_ix) > 1:
-        raise NotImplementedError("concat of multiple string columns")
+        raise NotImplementedError("concat of 3+ string columns")
     i = col_ix[0]
     pre = "".join(_literal_str(v, "concat") for v in vals[:i])
     post = "".join(_literal_str(v, "concat") for v in vals[i + 1 :])

@@ -23,6 +23,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.columnar import Batch, Column
@@ -58,8 +59,83 @@ class AggSpec:
 MOMENT = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
 
 
+#: HyperLogLog registers per sketch: p=13 -> 8192 buckets, standard error
+#: 1.04/sqrt(8192) ~= 1.15% (reference: ApproximateCountDistinctAggregation
+#: defaults + state/HyperLogLogStateFactory.java:23)
+HLL_P = 13
+HLL_M = 1 << HLL_P
+
+
+def _hll_hash(col: Column):
+    """Per-row 64-bit hash of the column's VALUE — stable across workers
+    (dictionary codes are producer-local, so dict values hash through a
+    trace-time crc table, mirroring parallel/serde.stable_row_hash)."""
+    import zlib
+
+    d = col.data
+    if col.dictionary is not None:
+        table = np.fromiter(
+            (
+                zlib.crc32(v.encode() if isinstance(v, str) else bytes(v))
+                for v in col.dictionary.values
+            ),
+            dtype=np.int64,
+            count=len(col.dictionary.values),
+        )
+        h = jnp.take(jnp.asarray(table), jnp.asarray(d, jnp.int32), mode="clip")
+    elif jnp.issubdtype(d.dtype, jnp.floating):
+        # avoid float bitcasts (TPU x64-rewrite can't lower them): frexp
+        # decomposes exactly; -0.0 collapses to 0.0, NaN to a fixed pattern
+        f = d + 0.0
+        f = jnp.where(jnp.isnan(f), jnp.float64(0.0) / 0.0, f)
+        mant, expo = jnp.frexp(f)
+        h = (mant * (1 << 53)).astype(jnp.int64) ^ (
+            expo.astype(jnp.int64) << 1
+        )
+    else:
+        h = d.astype(jnp.int64)
+    # splitmix64 finalizer (python ints wrap via uint64 numpy constants)
+    u = h.astype(jnp.uint64)
+    u = (u ^ (u >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    u = (u ^ (u >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    u = u ^ (u >> np.uint64(31))
+    return u
+
+
+def _hll_registers(col: Column, valid) -> jnp.ndarray:
+    """[HLL_M] int32 register vector over the valid rows of one column."""
+    u = _hll_hash(col)
+    bucket = (u >> np.uint64(64 - HLL_P)).astype(jnp.int64)
+    rest = (u << np.uint64(HLL_P)) | np.uint64(1)  # sentinel stops rank at max
+    # rank = leading zeros of `rest` + 1, via the float exponent (frexp is
+    # exact for the top bit position)
+    f = rest.astype(jnp.float64)
+    _, expo = jnp.frexp(f)
+    rank = (64 - expo + 1).astype(jnp.int32)
+    bucket = jnp.where(valid, bucket, HLL_M)
+    return jax.ops.segment_max(
+        jnp.where(valid, rank, 0), bucket, HLL_M + 1
+    )[:HLL_M].astype(jnp.int32)
+
+
+def _hll_estimate(registers) -> jnp.ndarray:
+    """Registers [..., M] -> BIGINT cardinality (HLL raw estimator + the
+    small-range linear-counting correction), vectorized over leading axes."""
+    m = float(HLL_M)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    r = jnp.maximum(registers.astype(jnp.float64), 0.0)
+    z = jnp.sum(jnp.power(2.0, -r), axis=-1)
+    raw = alpha * m * m / z
+    v = jnp.sum(registers <= 0, axis=-1)
+    linear = m * jnp.log(m / jnp.maximum(v, 1).astype(jnp.float64))
+    est = jnp.where((raw <= 2.5 * m) & (v > 0), linear, raw)
+    return jnp.round(est).astype(jnp.int64)
+
+
 # primitive states per SQL aggregate (state kinds: sum/count/min/max/any)
 def _primitives(spec: AggSpec):
+    if spec.name == "approx_distinct":
+        return [("hll", spec.arg)]
     if spec.name == "count_star":
         return [("count_star", None)]
     if spec.name == "count":
@@ -82,7 +158,9 @@ def _primitives(spec: AggSpec):
 def _state_types(spec: AggSpec, input_types) -> list[T.Type]:
     out = []
     for kind, arg in _primitives(spec):
-        if kind in ("count", "count_star"):
+        if kind == "hll":
+            out.append(T.ArrayType(T.INTEGER))
+        elif kind in ("count", "count_star"):
             out.append(T.BIGINT)
         elif kind in ("sum_f", "sumsq"):
             out.append(T.DOUBLE)
@@ -104,16 +182,22 @@ def _merge_primitives(spec: AggSpec):
     prims = _primitives(spec)
     merged = []
     for kind, _ in prims:
-        # counts and moment sums are already-reduced values: merge by adding
-        merged.append(
-            "sum" if kind in ("count", "count_star", "sum_f", "sumsq") else kind
-        )
+        # counts and moment sums are already-reduced values: merge by adding;
+        # HLL registers merge by elementwise max
+        if kind == "hll":
+            merged.append("hll")
+        else:
+            merged.append(
+                "sum" if kind in ("count", "count_star", "sum_f", "sumsq") else kind
+            )
     return merged
 
 
 def _finalize(spec: AggSpec, states: list[Column]) -> Column:
     """Combine state columns into the SQL result column."""
     name = spec.name
+    if name == "approx_distinct":
+        return Column(_hll_estimate(states[0].data), T.BIGINT, None)
     if name in ("count", "count_star"):
         return Column(states[0].data, T.BIGINT, None)
     if name in MOMENT:
@@ -247,6 +331,11 @@ class AggregationOperator:
     ):
         # merge: states in -> states out (used to combine partial outputs)
         assert mode in ("single", "partial", "final", "merge")
+        if group_channels and any(s.name == "approx_distinct" for s in aggregates):
+            # grouped sketches would need [groups, HLL_M] register state;
+            # the planner rewrites grouped approx_distinct to exact DISTINCT
+            # count instead, so this is unreachable from SQL
+            raise NotImplementedError("grouped approx_distinct")
         self.group_channels = list(group_channels)
         self.aggregates = list(aggregates)
         self.input_types = list(input_types)
@@ -259,6 +348,7 @@ class AggregationOperator:
         #: DOUBLE/REAL sums + counts where f32 matmul precision is acceptable
         self.use_pallas = use_pallas
         self._acc: list[Batch] = []
+        self._per_batch: Optional["AggregationOperator"] = None
         key = (
             tuple(self.group_channels),
             tuple(self.aggregates),
@@ -773,6 +863,22 @@ class AggregationOperator:
                     v = live
                     if col.valid is not None:
                         v = jnp.logical_and(v, col.valid)
+                    if kind == "hll":
+                        # elementwise max of register rows (mergeable state)
+                        sent = jnp.iinfo(jnp.int32).min
+                        regs = jnp.max(
+                            jnp.where(v[:, None], col.data, sent), axis=0
+                        )
+                        states.append(
+                            Column(
+                                regs[None, :],
+                                T.ArrayType(T.INTEGER),
+                                None,
+                                lengths=jnp.full(1, HLL_M, jnp.int32),
+                            )
+                        )
+                        ch += 1
+                        continue
                     states.append(
                         Column(
                             _masked_reduce(col.data, v, kind)[None],
@@ -793,6 +899,17 @@ class AggregationOperator:
                     v = live
                     if col.valid is not None:
                         v = jnp.logical_and(v, col.valid)
+                    if kind == "hll":
+                        regs = _hll_registers(col, v)
+                        states.append(
+                            Column(
+                                regs[None, :],
+                                T.ArrayType(T.INTEGER),
+                                None,
+                                lengths=jnp.full(1, HLL_M, jnp.int32),
+                            )
+                        )
+                        continue
                     st = _state_types(spec, self.input_types)[len(states)]
                     d = col.data
                     if kind in ("sum_f", "sumsq"):
@@ -833,29 +950,40 @@ class AggregationOperator:
     #: device memory at ~FOLD_EVERY batch capacities, the revoke analog)
     FOLD_EVERY = 8
 
-    def process(self, stream):
+    def reduce_batch(self, batch: Batch) -> Batch:
+        """One input batch -> its partial-state batch.  Dict/bool
+        small-domain keys take the in-jit direct path (no host syncs, the
+        Q1 shape); otherwise _reduce_full compacts dead slack and tries the
+        positional path (one scalar sync)."""
+        if self._per_batch is None:
+            self._per_batch = self._batch_reducer()
+        per_batch = self._per_batch
+        if per_batch._direct_group_info(batch) is not None:
+            return per_batch._step(batch, out_cap=batch.capacity)
+        return per_batch._reduce_full(batch)
+
+    def push(self, batch: Batch) -> None:
+        """Accumulate one input batch (streamed per-batch reduction when
+        `streaming`)."""
         from trino_tpu.runtime.memory import batch_bytes
 
-        per_batch = self._batch_reducer() if self.streaming else None
+        if self.streaming:
+            self._acc.append(self.reduce_batch(batch))
+            if len(self._acc) >= self.fold_every:
+                self._fold_states()
+        else:
+            self._acc.append(batch)
+        if self.memory_ctx is not None:
+            self.memory_ctx.set_bytes(sum(batch_bytes(b) for b in self._acc))
+
+    def state_bytes(self) -> int:
+        from trino_tpu.runtime.memory import batch_bytes
+
+        return sum(batch_bytes(b) for b in self._acc)
+
+    def process(self, stream):
         for batch in stream:
-            if per_batch is not None:
-                # dict/bool small-domain keys: in-jit direct path, no host
-                # syncs (Q1 shape).  Otherwise _reduce_full compacts dead
-                # slack and tries the positional path (one scalar sync).
-                if per_batch._direct_group_info(batch) is not None:
-                    self._acc.append(
-                        per_batch._step(batch, out_cap=batch.capacity)
-                    )
-                else:
-                    self._acc.append(per_batch._reduce_full(batch))
-                if len(self._acc) >= self.fold_every:
-                    self._fold_states()
-            else:
-                self._acc.append(batch)
-            if self.memory_ctx is not None:
-                self.memory_ctx.set_bytes(
-                    sum(batch_bytes(b) for b in self._acc)
-                )
+            self.push(batch)
         out = self.finish()
         if self.memory_ctx is not None:
             self.memory_ctx.close()

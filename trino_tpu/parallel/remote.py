@@ -100,6 +100,10 @@ class MultiHostQueryRunner(LocalQueryRunner):
         super().__init__(catalogs, catalog=catalog, schema=schema)
         self.worker_urls = list(worker_urls)
         self._task_seq = itertools.count(1)
+        #: url -> (monotonic ts, alive) probe cache shared across queries so
+        #: per-query scheduling doesn't pay serial HTTP probes (reference:
+        #: the background HeartbeatFailureDetector, polled not per-query)
+        self._worker_health: dict = {}
 
     # -- execution ------------------------------------------------------------
 
@@ -122,14 +126,137 @@ class MultiHostQueryRunner(LocalQueryRunner):
 
 class _StageScheduler:
     """Bottom-up stage execution (StageManager/PipelinedQueryScheduler role,
-    with every stage ALL_AT_ONCE since exchanges are pull-based)."""
+    with every stage ALL_AT_ONCE since exchanges are pull-based).
+
+    Node scheduling (reference: execution/scheduler/NodeScheduler.java:54 +
+    UniformNodeSelector): fragments are only assigned to workers that answer
+    a liveness probe, and a task whose worker dies is REASSIGNED to a live
+    worker (the task re-reads its splits/inputs — deterministic replay, the
+    EventDrivenFaultTolerantQueryScheduler retry property)."""
 
     def __init__(self, runner: MultiHostQueryRunner):
         self.runner = runner
-        self.workers = runner.worker_urls
+        self._dead: set = set()
+        self.workers = [u for u in runner.worker_urls if self._alive(u)]
+        if not self.workers:
+            raise RuntimeError("no live workers")
         #: fragment_id -> list[RemoteTaskClient] (producing tasks)
         self._stage_tasks: dict[int, list] = {}
         self._subplans: dict[int, SubPlan] = {}
+        #: task_id -> TaskDescriptor (for replacement resubmission)
+        self._descs: dict[str, TaskDescriptor] = {}
+
+    @staticmethod
+    def _is_conn_dead(exc: Exception) -> bool:
+        if isinstance(exc, (ConnectionRefusedError, ConnectionResetError)):
+            return True
+        if isinstance(exc, urllib.error.URLError):
+            return isinstance(
+                exc.reason, (ConnectionRefusedError, ConnectionResetError)
+            )
+        return False
+
+    #: how long a probe verdict stays fresh (dead workers get re-probed too,
+    #: so a restarted worker rejoins)
+    PROBE_TTL_S = 15.0
+
+    def _alive(self, url: str) -> bool:
+        """Liveness = the socket answers.  Only a REFUSED/RESET connection is
+        definitive death; a slow probe (single-core box, a worker thread
+        holding the GIL inside an XLA compile) is BUSY, not dead — treating
+        it as dead cascades into blacklisting the whole cluster
+        (reference: HeartbeatFailureDetector's grace semantics).  Verdicts
+        cache on the runner so healthy clusters pay no per-query probes."""
+        if url in self._dead:
+            return False
+        import time as _time
+
+        now = _time.monotonic()
+        cached = self.runner._worker_health.get(url)
+        if cached is not None and now - cached[0] < self.PROBE_TTL_S:
+            ok = cached[1]
+        else:
+            ok = self._probe(url)
+            self.runner._worker_health[url] = (now, ok)
+        if not ok:
+            self._dead.add(url)
+        return ok
+
+    @staticmethod
+    def _probe(url: str) -> bool:
+        try:
+            with urllib.request.urlopen(f"{url}/v1/info", timeout=5.0) as r:
+                r.read()
+            return True
+        except Exception as exc:
+            if _StageScheduler._is_conn_dead(exc):
+                return False
+            return True  # slow or transient: assume alive
+
+    def _submit_on_live(self, desc: TaskDescriptor, preferred: str):
+        """Submit, falling over to any live worker if the preferred one is
+        gone."""
+        urls = [preferred] + [u for u in self.workers if u != preferred]
+        last: Optional[Exception] = None
+        for url in urls:
+            if url in self._dead:
+                continue
+            client = RemoteTaskClient(url, desc.task_id)
+            try:
+                client.submit(desc)
+                self._descs[desc.task_id] = desc
+                return client
+            except Exception as exc:
+                last = exc
+                if self._is_conn_dead(exc):
+                    import time as _time
+
+                    self._dead.add(url)  # worker gone: try the next one
+                    self.runner._worker_health[url] = (_time.monotonic(), False)
+                    continue
+                raise  # a real error must not masquerade as a dead worker
+        raise RuntimeError(f"no live worker accepted {desc.task_id}: {last}")
+
+    def _replace_task(self, fid: int, idx: int):
+        """Reassign task `idx` of stage `fid` to a live worker.  Producers
+        below are repaired first so the refreshed input URLs resolve."""
+        import dataclasses
+
+        sub = self._subplans[fid]
+        for child in sub.children:
+            self._repair_stage(child.fragment.id)
+        old = self._stage_tasks[fid][idx]
+        # a FAILED task does not imply a dead worker (it may have failed
+        # pulling inputs from one that died): probe before blacklisting —
+        # an alive worker happily re-runs the replacement itself.  The
+        # failure is fresh evidence, so bypass the cached verdict.
+        self.runner._worker_health.pop(old.worker_url, None)
+        self._alive(old.worker_url)
+        desc = self._descs[old.task_id]
+        desc = dataclasses.replace(
+            desc,
+            task_id=f"{desc.task_id}r{next(self.runner._task_seq)}",
+            inputs=self._input_urls(sub, consumer_index=idx),
+        )
+        new = self._submit_on_live(
+            desc, self.workers[idx % len(self.workers)]
+        )
+        self._stage_tasks[fid][idx] = new
+        return new
+
+    def _repair_stage(self, fid: int) -> None:
+        tasks = self._stage_tasks.get(fid)
+        if tasks is None or isinstance(tasks, _LocalResult):
+            return
+        sub = self._subplans[fid]
+        for child in sub.children:
+            self._repair_stage(child.fragment.id)
+        for i, t in enumerate(list(tasks)):
+            # repairs run on failure evidence: cached health is stale by
+            # definition here, probe fresh
+            self.runner._worker_health.pop(t.worker_url, None)
+            if not self._alive(t.worker_url):
+                self._replace_task(fid, i)
 
     def run(self, root: SubPlan) -> PhysicalPlan:
         self._register(root)
@@ -168,9 +295,7 @@ class _StageScheduler:
                 split_mod=(i, w),
                 properties=dict(self.runner.properties._values),
             )
-            client = RemoteTaskClient(url, desc.task_id)
-            client.submit(desc)
-            tasks.append(client)
+            tasks.append(self._submit_on_live(desc, url))
         self._stage_tasks[fid] = tasks
         return tasks
 
@@ -251,8 +376,14 @@ class _StageScheduler:
                     return producers.plan
                 batches = []
                 per_producer = []
-                for t in producers:
-                    bs = bytes_to_batches(_fetch_ok(t))
+                for i, t in enumerate(list(producers)):
+                    try:
+                        bs = bytes_to_batches(_fetch_ok(t))
+                    except Exception:
+                        # worker died (or its task failed) after submission:
+                        # reassign to a live worker and re-read
+                        t2 = sched._replace_task(node.fragment_id, i)
+                        bs = bytes_to_batches(_fetch_ok(t2))
                     per_producer.append(bs)
                     batches.extend(bs)
                 if node.exchange_kind == "merge":

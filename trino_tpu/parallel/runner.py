@@ -31,7 +31,7 @@ from trino_tpu.columnar import Batch, Column
 from trino_tpu.columnar.batch import concat_batches
 from trino_tpu.connectors.api import CatalogManager
 from trino_tpu.expr import ExprCompiler
-from trino_tpu.expr.ir import Form, InputRef, Literal, SpecialForm
+from trino_tpu.expr.ir import Form, InputRef, Literal, SpecialForm, and_
 from trino_tpu.ops.aggregation import AggregationOperator, AggSpec
 from trino_tpu.ops.common import SortKey, next_pow2
 from trino_tpu.ops.filter_project import FilterProjectOperator
@@ -139,6 +139,8 @@ class DistributedQueryRunner(LocalQueryRunner):
             self.catalogs, self.wm, self.properties,
             query_id=getattr(self, "_current_qid", "q"),
         )
+        #: kept for tests / EXPLAIN evidence (dynamic filter pruning counts)
+        self.last_stage_executor = executor
         host = executor.run(sub)
         rows = []
         for batch in host.stream:
@@ -167,6 +169,13 @@ class StageExecutor:
         self.retry_task = properties.get("retry_policy") == "TASK"
         self.spool = None
         self._spool_meta: dict[int, tuple] = {}
+        #: cross-fragment dynamic filters (reference:
+        #: server/DynamicFilterService.java:107): probe symbol name ->
+        #: (lo, hi) build-side key range, registered when a build fragment
+        #: completes, consumed by later probe-side scan fragments
+        self.dynamic_filters: dict[str, tuple] = {}
+        #: EXPLAIN-able evidence: table -> (rows_before, rows_after) pruning
+        self.dynamic_filter_stats: dict[str, tuple] = {}
         if self.retry_task:
             from trino_tpu.runtime.fte import SpoolManager
 
@@ -312,6 +321,35 @@ class StageExecutor:
 
     # -- exchanges ------------------------------------------------------------
 
+    def _register_dynamic_filters(self, criteria, build: "_Dist") -> None:
+        """Record build-side key min/max under the probe symbol names.
+        Dictionary-coded keys are skipped (codes are producer-local).
+        Device-side reductions: only three scalars cross to the host."""
+        for lsym, rsym in criteria:
+            try:
+                col = build.stacked.columns[build.channel(rsym.name)]
+            except KeyError:
+                continue
+            if col.dictionary is not None or jnp.issubdtype(
+                col.data.dtype, jnp.floating
+            ):
+                continue
+            live = build.stacked.mask()
+            if col.valid is not None:
+                live = jnp.logical_and(live, col.valid)
+            d = col.data.astype(jnp.int64)
+            big = jnp.iinfo(jnp.int64).max
+            lo, hi, n = jax.device_get(
+                (
+                    jnp.min(jnp.where(live, d, big)),
+                    jnp.max(jnp.where(live, d, -big)),
+                    jnp.sum(live),
+                )
+            )
+            if int(n) == 0:
+                continue
+            self.dynamic_filters[lsym.name] = (int(lo), int(hi))
+
     def _raw_remote(self, node: RemoteSourceNode):
         """Child fragment result WITHOUT the exchange applied."""
         return self._fragment_result(node.fragment_id)
@@ -430,6 +468,24 @@ class StageExecutor:
                 pred, [InputRef(i, s.type) for i, s in enumerate(out.symbols)]
             )._make_step()
             out = _Dist(spmd_step(self.wm, step)(out.stacked), out.symbols)
+        # dynamic filters from already-completed build fragments prune this
+        # scan's feed (reference: DynamicFilterService -> split pruning)
+        from trino_tpu.runtime.local_planner import _range_expr
+
+        dyn = []
+        for s, _ in node.assignments:
+            rng = self.dynamic_filters.get(s.name)
+            if rng is not None:
+                dyn.append(out.rewrite(_range_expr(s, *rng)))
+        if dyn:
+            before = int(jnp.sum(out.stacked.mask()))
+            step = FilterProjectOperator(
+                and_(*dyn),
+                [InputRef(i, s.type) for i, s in enumerate(out.symbols)],
+            )._make_step()
+            out = _Dist(spmd_step(self.wm, step)(out.stacked), out.symbols)
+            after = int(jnp.sum(out.stacked.mask()))
+            self.dynamic_filter_stats[node.handle.table] = (before, after)
         return out
 
     def _x_FilterNode(self, node: P.FilterNode) -> _Dist:
@@ -582,13 +638,19 @@ class StageExecutor:
         assert node.distribution in ("broadcast", "partitioned"), node
         probe_node, build_node = node.left, node.right
         assert isinstance(build_node, RemoteSourceNode)
+        # BUILD side first: its fragment completes before the probe side is
+        # even pulled, so build-key ranges can prune probe-side scans in
+        # later fragments (reference: DynamicFilterService.java:107,126 —
+        # filters collected from build tasks reach probe scans before
+        # splits feed)
+        build = self._to_stacked(self._raw_remote(build_node))
+        if node.kind == "inner":
+            self._register_dynamic_filters(node.criteria, build)
         if node.distribution == "partitioned":
             assert isinstance(probe_node, RemoteSourceNode)
             probe = self._to_stacked(self._raw_remote(probe_node))
-            build = self._to_stacked(self._raw_remote(build_node))
         else:
             probe = self._exec(probe_node)
-            build = self._to_stacked(self._raw_remote(build_node))
         pk = [probe.channel(l.name) for l, _ in node.criteria]
         bk = [build.channel(r.name) for _, r in node.criteria]
         probe, build = self._unify_key_dicts(probe, pk, build, bk)
@@ -631,17 +693,39 @@ class StageExecutor:
         mask_h = np.asarray(jax.device_get(probe.stacked.mask()))
         emit_h = (
             np.where(mask_h, np.maximum(count_h, 1), 0)
-            if node.kind == "left"
+            if node.kind in ("left", "full")
             else np.where(mask_h, count_h, 0)
         )
         totals = emit_h.sum(axis=-1)  # [W]
         out_cap = next_pow2(max(1, int(totals.max())), floor=1024)
+        probe_types = [s.type for s in probe.symbols]
 
         def expand_step(pb: Batch, bb: Batch, st, ct, total):
-            out, _ = op._expand_step(
-                pb, bb, st, ct, None, out_cap=out_cap,
+            matched0 = (
+                jnp.zeros(cap_b, dtype=bool) if node.kind == "full" else None
+            )
+            out, matched = op._expand_step(
+                pb, bb, st, ct, matched0, out_cap=out_cap,
                 cap_b=cap_b, total_emit=total,
             )
+            if node.kind == "full":
+                # per-shard unmatched-build tail: with PARTITIONED inputs
+                # every build row lives on exactly one shard, so the tail
+                # emits each unmatched build row exactly once globally
+                tail_live = jnp.logical_and(
+                    bb.mask(), jnp.logical_not(matched)
+                )
+                ncols = [
+                    Column(
+                        jnp.zeros(cap_b, dtype=t.np_dtype),
+                        t,
+                        jnp.zeros(cap_b, dtype=bool),
+                        None,
+                    )
+                    for t in probe_types
+                ]
+                tail = Batch(ncols + list(bb.columns), tail_live)
+                out = concat_batches([out, tail])
             return out
 
         out = spmd_step(self.wm, expand_step)(
@@ -651,29 +735,82 @@ class StageExecutor:
         return _Dist(out, out_symbols)
 
     def _x_SemiJoinNode(self, node: P.SemiJoinNode) -> _Dist:
-        src = self._exec(node.source)
+        if isinstance(node.source, RemoteSourceNode):
+            src = self._to_stacked(self._raw_remote(node.source))
+        else:
+            src = self._exec(node.source)
         assert isinstance(node.filtering, RemoteSourceNode)
         filt = self._to_stacked(self._raw_remote(node.filtering))
         fk = [filt.channel(node.filtering_key.name)]
         sk = [src.channel(node.source_key.name)]
         src, filt = self._unify_key_dicts(src, sk, filt, fk)
         sk, fk = sk[0], fk[0]
+
+        def _global_has_null(stacked: Batch) -> bool:
+            fcol = stacked.columns[fk]
+            if fcol.valid is None:
+                return False
+            return bool(
+                np.any(
+                    np.asarray(jax.device_get(stacked.mask()))
+                    & ~np.asarray(jax.device_get(fcol.valid))
+                )
+            )
+
         if node.filter is not None:
-            raise NotImplementedError("correlated semi-join filter distributed")
+            # residual-filtered semi join, PARTITIONED on the key: both
+            # sides were repartitioned by the fragmenter, so key-matching
+            # candidate pairs are co-located per shard; the residual is the
+            # same probe++filtering candidate filter the local operator uses
+            out_symbols = src.symbols + filt.symbols
+            expr = PhysicalPlan(iter(()), out_symbols).rewrite(node.filter)
+
+            def residual(batch: Batch, _e=expr):
+                return ExprCompiler(batch).filter_mask(_e)
+
+            op = SemiJoinOperator(
+                sk,
+                fk,
+                [s.type for s in filt.symbols],
+                null_aware=node.null_aware,
+                residual=residual,
+            )
+            has_null = _global_has_null(filt.stacked)
+            cap_b = _trailing_cap(filt.stacked)
+
+            def locate_step(pb: Batch, bb: Batch):
+                sb, canon, n_match = _sort_build_device(bb, [fk])
+                pc, pn = _canon_probe_device(pb, [sk], canon)
+                st, ct = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
+                return st, ct, sb
+
+            start, count, sorted_b = spmd_step(self.wm, locate_step)(
+                src.stacked, filt.stacked
+            )
+            totals = (
+                np.asarray(jax.device_get(count)).sum(axis=-1)  # [W]
+            )
+            out_cap = next_pow2(max(1, int(totals.max())), floor=1024)
+
+            def mark_step(pb: Batch, bb: Batch, st, ct, total) -> Batch:
+                return op._mark_residual_step(
+                    pb, bb, st, ct,
+                    cap_b=cap_b, out_cap=out_cap, total_emit=total,
+                    has_null=has_null,
+                )
+
+            out = spmd_step(self.wm, mark_step)(
+                src.stacked, sorted_b, start, count,
+                jax.device_put(totals, self.wm.sharding()),
+            )
+            return _Dist(out, src.symbols + [node.mark])
+
         op = SemiJoinOperator(
             sk, fk, [s.type for s in filt.symbols], null_aware=node.null_aware
         )
         bcast = ex.broadcast(filt.stacked, self.wm)
         cap_b = _trailing_cap(bcast)
-        fcol = bcast.columns[fk]
-        has_null = False
-        if fcol.valid is not None:
-            has_null = bool(
-                np.any(
-                    np.asarray(jax.device_get(bcast.mask()))
-                    & ~np.asarray(jax.device_get(fcol.valid))
-                )
-            )
+        has_null = _global_has_null(bcast)
 
         def mark_step(pb: Batch, bb: Batch) -> Batch:
             _, canon, n_match = _sort_build_device(bb, [fk])

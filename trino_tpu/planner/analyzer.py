@@ -89,6 +89,22 @@ class Scope:
 _EPOCH = datetime.date(1970, 1, 1)
 
 
+def _branch_cast(e: Expr, rt: T.Type) -> Expr:
+    """Unify a conditional branch's REPRESENTATION with the result type.
+    Decimal cents sitting next to doubles must descale through a real CAST
+    — relabeling the channel would be off by 10^scale."""
+    if (
+        rt is T.UNKNOWN
+        or e.type is T.UNKNOWN
+        or e.type.name == rt.name
+        or e.type is None
+    ):
+        return e
+    if isinstance(e, Literal) and e.value is None:
+        return Literal(None, rt)
+    return SpecialForm(Form.CAST, [e], rt)
+
+
 def _parse_date(text: str) -> int:
     y, m, d = (int(x) for x in text.strip().split("-"))
     return (datetime.date(y, m, d) - _EPOCH).days
@@ -187,6 +203,7 @@ class ExprAnalyzer:
             return ir.and_(l, r) if op == "and" else ir.or_(l, r)
         if op in _CMP_OPS:
             l, r = self.analyze(n.left), self.analyze(n.right)
+            l, r = self._coerce_temporal(l, r)
             self._check_comparable(l, r)
             return ir.comparison(op, l, r)
         if op == "||":
@@ -226,6 +243,26 @@ class ExprAnalyzer:
             )
         raise AnalysisError(f"unsupported interval unit {interval.unit}")
 
+    @staticmethod
+    def _coerce_temporal(l: Expr, r: Expr):
+        """`date_col = '2000-06-30'` style: a varchar literal compared with
+        a DATE coerces to a date literal (reference: TypeCoercion's
+        varchar->date implicit cast in comparisons)."""
+
+        def lift(e: Expr, other_t):
+            if (
+                other_t is T.DATE
+                and isinstance(e, Literal)
+                and isinstance(e.value, str)
+            ):
+                try:
+                    return Literal(_parse_date(e.value), T.DATE)
+                except ValueError:
+                    raise AnalysisError(f"invalid date literal: {e.value!r}")
+            return e
+
+        return lift(l, r.type), lift(r, l.type)
+
     def _check_comparable(self, l: Expr, r: Expr) -> None:
         lt, rt = l.type, r.type
         if lt == T.UNKNOWN or rt == T.UNKNOWN:
@@ -262,12 +299,15 @@ class ExprAnalyzer:
             )
             if len(args) == 2:
                 args.append(Literal(None, rt))
+            args[1] = _branch_cast(args[1], rt)
+            args[2] = _branch_cast(args[2], rt)
             return SpecialForm(Form.IF, args, rt)
         if n.name == "coalesce":
             args = [self.analyze(a) for a in n.args]
             rt = T.UNKNOWN
             for a in args:
                 rt = T.common_super_type(rt, a.type)
+            args = [_branch_cast(a, rt) for a in args]
             return SpecialForm(Form.COALESCE, args, rt)
         if n.name == "nullif":
             args = [self.analyze(a) for a in n.args]
@@ -300,12 +340,21 @@ class ExprAnalyzer:
             d = self.analyze(n.default)
             rt = T.common_super_type(rt, d.type)
             args.append(d)
-        # retype branch values (literal nulls pick up the result type)
-        return SpecialForm(Form.CASE, args, rt)
+        # unify branch representations: widened branches get REAL casts
+        # (a decimal branch next to a double branch must descale, not relabel)
+        out = []
+        for i, a in enumerate(args):
+            is_value = (i % 2 == 1) or (i == len(args) - 1 and len(args) % 2 == 1)
+            out.append(_branch_cast(a, rt) if is_value else a)
+        return SpecialForm(Form.CASE, out, rt)
 
     def _a_InList(self, n: ast.InList) -> Expr:
         v = self.analyze(n.value)
-        items = [self.analyze(i) for i in n.items]
+        items = []
+        for i in n.items:
+            e = self.analyze(i)
+            _, e = self._coerce_temporal(v, e)
+            items.append(e)
         e = SpecialForm(Form.IN, [v] + items, T.BOOLEAN)
         return ir.not_(e) if n.negated else e
 
@@ -313,6 +362,8 @@ class ExprAnalyzer:
         v = self.analyze(n.value)
         lo = self.analyze(n.low)
         hi = self.analyze(n.high)
+        _, lo = self._coerce_temporal(v, lo)
+        _, hi = self._coerce_temporal(v, hi)
         e = SpecialForm(Form.BETWEEN, [v, lo, hi], T.BOOLEAN)
         return ir.not_(e) if n.negated else e
 

@@ -188,9 +188,21 @@ class ExchangePlacer:
     def _p_JoinNode(self, node: P.JoinNode):
         from trino_tpu.planner.stats import estimate_rows
 
+        if node.kind == "right":
+            # distribute as the flipped LEFT join (the local engine performs
+            # the same flip; symbol resolution is by name, so output order
+            # does not matter at this level)
+            node = P.JoinNode(
+                "left",
+                node.right,
+                node.left,
+                [(r, l) for l, r in node.criteria],
+                node.filter,
+                node.distribution,
+            )
         left, ldist = self._visit(node.left)
         right, rdist = self._visit(node.right)
-        supported = node.kind in ("inner", "left") and node.criteria
+        supported = node.kind in ("inner", "left", "full") and node.criteria
         if not supported or ldist == _Distribution.SINGLE:
             return (
                 node.with_children(
@@ -204,6 +216,12 @@ class ExchangePlacer:
         broadcast = pref == "BROADCAST" or (
             pref == "AUTOMATIC" and est is not None and est <= limit
         )
+        if node.kind == "full":
+            # a broadcast FULL join would emit the unmatched build tail once
+            # PER WORKER; repartitioning keeps every build row on exactly
+            # one worker (reference: AddExchanges forces partitioned for
+            # full/right joins)
+            broadcast = False
         if broadcast:
             ex = P.ExchangeNode(right, "broadcast")
             out = P.JoinNode(
@@ -224,13 +242,23 @@ class ExchangePlacer:
     def _p_SemiJoinNode(self, node: P.SemiJoinNode):
         src, sdist = self._visit(node.source)
         filt, fdist = self._visit(node.filtering)
-        if sdist == _Distribution.SINGLE or node.filter is not None:
-            # correlated semi-join filters run on the local operator
+        if sdist == _Distribution.SINGLE:
             return (
                 node.with_children(
                     [self._gathered(src, sdist), self._gathered(filt, fdist)]
                 ),
                 _Distribution.SINGLE,
+            )
+        if node.filter is not None:
+            # residual-filtered semi join: repartition BOTH sides on the key
+            # so every key-matching candidate pair is co-located; the
+            # residual evaluates per shard (reference: AddExchanges semi join
+            # partitioned distribution)
+            sex = P.ExchangeNode(src, "repartition", [node.source_key])
+            fex = P.ExchangeNode(filt, "repartition", [node.filtering_key])
+            return (
+                node.with_children([sex, fex]),
+                _Distribution.DISTRIBUTED,
             )
         ex = P.ExchangeNode(filt, "broadcast")
         return node.with_children([src, ex]), _Distribution.DISTRIBUTED

@@ -35,7 +35,7 @@ AGG_FUNCS = {
     "approx_percentile": "percentile",
     # exact distinct count satisfies the approx contract (agg_symbol rewrites
     # this to a DISTINCT count before planning)
-    "approx_distinct": "count",
+    "approx_distinct": "approx_distinct",
 }
 
 #: aggregates whose grouped state is the (count, sum, sum-of-squares) triple
@@ -43,7 +43,7 @@ MOMENT_AGGS = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
 
 
 def agg_result_type(name: str, arg_type: T.Type | None) -> T.Type:
-    if name == "count" or name == "count_star":
+    if name in ("count", "count_star", "approx_distinct"):
         return T.BIGINT
     if name == "sum":
         if arg_type is None:

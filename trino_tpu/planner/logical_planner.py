@@ -48,10 +48,17 @@ class Session:
 
 
 class LogicalPlanner:
-    def __init__(self, catalogs: CatalogManager, session: Session):
+    def __init__(self, catalogs: CatalogManager, session: Session, views=None):
         self.catalogs = catalogs
         self.session = session
         self.alloc = P.SymbolAllocator()
+        #: (catalog, schema, name) -> view Query AST; views expand inline at
+        #: plan time (reference: sql/analyzer view expansion in
+        #: StatementAnalyzer.Visitor.visitTable)
+        self.views = views or {}
+        #: views currently being expanded (cycle detection: the reference
+        #: raises VIEW_IS_RECURSIVE instead of recursing to death)
+        self._view_stack: set = set()
 
     # -- statements ----------------------------------------------------------
 
@@ -355,6 +362,24 @@ class LogicalPlanner:
                     for n, f in zip(colnames, rp.fields)
                 ]
                 return RelationPlan(rp.node, fields)
+            vkey = self.resolve_table_name(rel.name)
+            vq = self.views.get(vkey)
+            if vq is not None:
+                # view expansion: plan the stored definition inline
+                if vkey in self._view_stack:
+                    raise AnalysisError(
+                        f"view {'.'.join(rel.name)} is recursive"
+                    )
+                self._view_stack.add(vkey)
+                try:
+                    rp, names = self.plan_query(vq, None, {})
+                finally:
+                    self._view_stack.discard(vkey)
+                fields = [
+                    Field(n, f.symbol, rel.name[-1])
+                    for n, f in zip(names, rp.fields)
+                ]
+                return RelationPlan(rp.node, fields)
             return self.plan_table_scan(rel)
         if isinstance(rel, ast.AliasedRelation):
             rp = self.plan_relation(rel.relation, outer, ctes)
@@ -428,14 +453,17 @@ class LogicalPlanner:
         fields = (list(left.fields) if keep_left_fields else []) + elem_fields
         return RelationPlan(node, fields)
 
+    def resolve_table_name(self, parts: tuple) -> tuple:
+        """Name parts -> (catalog, schema, table) with session defaults."""
+        if len(parts) == 3:
+            return tuple(parts)
+        if len(parts) == 2:
+            return (self.session.catalog,) + tuple(parts)
+        return (self.session.catalog, self.session.schema, parts[0])
+
     def plan_table_scan(self, ref: ast.TableRef) -> RelationPlan:
         parts = ref.name
-        if len(parts) == 3:
-            catalog, schema, table = parts
-        elif len(parts) == 2:
-            catalog, (schema, table) = self.session.catalog, parts
-        else:
-            catalog, schema, table = self.session.catalog, self.session.schema, parts[0]
+        catalog, schema, table = self.resolve_table_name(parts)
         if catalog is None or schema is None:
             raise AnalysisError(f"table {'.'.join(parts)}: no current catalog/schema")
         conn = self.catalogs.get(catalog)
@@ -693,10 +721,13 @@ class LogicalPlanner:
             fn_args = list(fc.args)
             sql_name = fc.name
             if sql_name == "approx_distinct":
-                # exact distinct count satisfies the approx contract
-                # (reference role: ApproximateCountDistinctAggregation)
-                sql_name, distinct = "count", True
+                # reference role: ApproximateCountDistinctAggregation.
+                # Global form: real HyperLogLog (bounded, mergeable per-chip
+                # registers).  Grouped form: exact DISTINCT count rewrite —
+                # per-group register matrices are not materialized.
                 fn_args = fn_args[:1]  # drop max-standard-error argument
+                if spec.group_by or extra_keys:
+                    sql_name, distinct = "count", True
             if fc.is_star and sql_name == "count":
                 key = ("count_star", (), False, filter_key)
                 fname, arg_syms, arg_t = "count_star", [], None

@@ -245,17 +245,27 @@ class LocalExecutionPlanner:
         pre = FilterProjectOperator(None, proj)
         # percentile needs every group row at once: no streaming partials
         streaming = not any(s.name == "percentile" for s in specs)
-        op = AggregationOperator(
-            list(range(ngroups)),
-            specs,
-            input_types,
-            mode=node.step,
-            streaming=streaming,
-            fold_every=self.properties.get("agg_fold_batches"),
-            memory_ctx=self.memory.child("aggregation"),
-            use_pallas=self.properties.get("pallas_agg"),
-        )
-        stream = op.process(pre.process(src.stream))
+
+        def make_op():
+            return AggregationOperator(
+                list(range(ngroups)),
+                specs,
+                input_types,
+                mode=node.step,
+                streaming=streaming,
+                fold_every=self.properties.get("agg_fold_batches"),
+                memory_ctx=self.memory.child("aggregation"),
+                use_pallas=self.properties.get("pallas_agg"),
+            )
+
+        budget = self.properties.get("query_max_memory_bytes")
+        feed = pre.process(src.stream)
+        if budget and ngroups:
+            stream = _agg_wave_stream(
+                make_op, feed, list(range(ngroups)), int(budget)
+            )
+        else:
+            stream = make_op().process(feed)
         return PhysicalPlan(stream, node.outputs)
 
     def _visit_MarkDistinctNode(self, node: P.MarkDistinctNode) -> PhysicalPlan:
@@ -595,6 +605,167 @@ def _wave_join_stream(
 
         yield from op.process(probe_feed())
     ctx.close()
+
+
+def _agg_wave_stream(make_op, feed, key_channels: list, budget: int):
+    """Memory-bounded grouped aggregation: group-hash STATE waves.
+
+    Reference role: HashAggregationOperator.startMemoryRevoke:449.  Input
+    batches reduce to partial states immediately; when accumulated device
+    state crosses a fraction of the budget it SPILLS to host RAM (the spill
+    tier of a TPU engine — only states move, never raw input).  The final
+    merge then runs in group-hash waves over the spilled states: hashing by
+    the full group key keeps every group inside one wave, so per-wave merges
+    are exact and group-disjoint.  Under-budget queries never spill and
+    never copy: one device-side merge, identical to the unbudgeted path.
+
+    Aggregates without streamable partials (percentile) fall back to
+    spooling RAW input and re-feeding each wave — the only shape that needs
+    every group row at once.
+    """
+    import math
+
+    import jax
+
+    from trino_tpu.columnar.batch import concat_batches
+    from trino_tpu.runtime.memory import batch_bytes
+
+    op = make_op()
+    if not op.streaming:
+        yield from _agg_raw_wave_stream(make_op, op, feed, key_channels, budget)
+        return
+    out_mode = "merge" if op.mode in ("partial", "merge") else "final"
+    spill_at = max(budget // 4, 1)
+    device_states: list[Batch] = []
+    host_states: list = []
+    dev_bytes = 0
+    seen_any = False
+    for b in feed:
+        seen_any = True
+        s = op.reduce_batch(b)
+        device_states.append(s)
+        dev_bytes += batch_bytes(s)
+        if op.memory_ctx is not None:
+            op.memory_ctx.set_bytes(dev_bytes)
+        if dev_bytes > spill_at:
+            host_states.extend(jax.device_get(x) for x in device_states)
+            device_states.clear()
+            dev_bytes = 0
+            if op.memory_ctx is not None:
+                op.memory_ctx.set_bytes(0)
+    if not seen_any:
+        op._acc = []
+        yield op.finish()
+        if op.memory_ctx is not None:
+            op.memory_ctx.close()
+        return
+    if not host_states:
+        # under budget: plain device-side merge, no host round-trip
+        yield op._combine(
+            device_states[0]
+            if len(device_states) == 1
+            else concat_batches(device_states),
+            out_mode,
+        )
+        if op.memory_ctx is not None:
+            op.memory_ctx.close()
+        return
+    host_states.extend(jax.device_get(x) for x in device_states)
+    device_states.clear()
+    total = sum(batch_bytes(b) for b in host_states)
+    n_waves = min(64, max(2, math.ceil(2.0 * total / budget)))
+    for wave in range(n_waves):
+        # wave selection happens HOST-side by dictionary VALUE hash
+        # (state batches carry batch-local dictionaries, so device code
+        # hashes would split one group across waves) and each part is
+        # compacted before it returns to the device — per-wave footprint
+        # is ~total/n_waves, which is what the budget bought
+        parts = [
+            jax.device_put(p)
+            for hb in host_states
+            for p in [_host_wave_slice(hb, key_channels, n_waves, wave)]
+            if p is not None
+        ]
+        if not parts:
+            continue
+        yield op._combine(
+            parts[0] if len(parts) == 1 else concat_batches(parts), out_mode
+        )
+    if op.memory_ctx is not None:
+        op.memory_ctx.close()
+
+
+def _host_wave_slice(hb: Batch, key_channels: list, n_waves: int, wave: int):
+    """Rows of a HOST batch whose group-key VALUE hash lands in `wave`,
+    compacted to a dense host batch (None when empty)."""
+    import numpy as np
+
+    from trino_tpu.parallel.serde import stable_row_hash
+
+    h = stable_row_hash(hb, key_channels)
+    keep = np.asarray(hb.mask()) & ((h % np.uint64(n_waves)) == np.uint64(wave))
+    n = int(keep.sum())
+    if n == 0:
+        return None
+    idx = np.nonzero(keep)[0]
+    cols = []
+    for c in hb.columns:
+        cols.append(
+            Column(
+                np.asarray(c.data)[idx],
+                c.type,
+                None if c.valid is None else np.asarray(c.valid)[idx],
+                c.dictionary,
+                None if c.lengths is None else np.asarray(c.lengths)[idx],
+            )
+        )
+    return Batch(cols, np.ones(n, dtype=bool))
+
+
+def _agg_raw_wave_stream(make_op, op, feed, key_channels: list, budget: int):
+    """Raw-input waves for non-streamable aggregates (percentile): spool
+    input to host once the budget is breached, then re-feed per wave."""
+    import math
+
+    import jax
+
+    from trino_tpu.runtime.memory import ExceededMemoryLimitException
+
+    it = iter(feed)
+    spool = []
+    over = False
+    for b in it:
+        spool.append(jax.device_get(b))
+        try:
+            op.push(b)
+            if op.state_bytes() > budget:
+                over = True
+        except ExceededMemoryLimitException:
+            over = True  # the reservation tree is the breach signal
+        if over:
+            break
+    if not over:
+        yield op.finish()
+        if op.memory_ctx is not None:
+            op.memory_ctx.close()
+        return
+    consumed = len(spool)
+    spool.extend(jax.device_get(b) for b in it)
+    frac = consumed / max(len(spool), 1)
+    projected = op.state_bytes() / max(frac, 1e-3)
+    n_waves = min(64, max(2, math.ceil(2.0 * projected / budget)))
+    if op.memory_ctx is not None:
+        op.memory_ctx.close()
+    del op  # free the over-budget device state before wave 1
+    for wave in range(n_waves):
+        wop = make_op()
+        for hb in spool:
+            p = _host_wave_slice(hb, key_channels, n_waves, wave)
+            if p is not None:
+                wop.push(jax.device_put(p))
+        yield wop.finish()
+        if wop.memory_ctx is not None:
+            wop.memory_ctx.close()
 
 
 def specs_args(specs: list) -> list:

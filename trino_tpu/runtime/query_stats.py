@@ -4,8 +4,12 @@ Reference roles: operator/OperatorStats.java + OperationTimer (per-call
 timing recorded from the Driver loop, Driver.java:298,340) and the
 planprinter rendering of EXPLAIN ANALYZE.  Host-side generator wrappers time
 each operator's batch production; device work is async under XLA dispatch, so
-wall times are *inclusive* of the subtree's dispatch (noted in the rendering)
-— per-kernel device times come from the XLA profiler, not this layer.
+wall times are *inclusive* of the subtree's dispatch (noted in the rendering).
+Under EXPLAIN ANALYZE each instrumented operator additionally BLOCKS on its
+output batch (jax.block_until_ready) and records the wait as `device` time —
+the host-feed vs device-compute split is a measured fact, at the cost of
+serializing dispatch (measurement mode only; reference role: OperationTimer's
+per-call CPU vs scheduled split in OperatorStats).
 """
 
 from __future__ import annotations
@@ -22,13 +26,15 @@ class OperatorStats:
     output_rows: int = 0
     output_batches: int = 0
     wall_s: float = 0.0  # inclusive of upstream dispatch
+    device_s: float = 0.0  # blocked-on-device time for THIS op's outputs
     depth: int = 0
 
     def line(self) -> str:
         pad = "  " * self.depth
         return (
             f"{pad}{self.name}[{self.detail}] rows={self.output_rows} "
-            f"batches={self.output_batches} wall={self.wall_s * 1e3:.1f}ms"
+            f"batches={self.output_batches} wall={self.wall_s * 1e3:.1f}ms "
+            f"device={self.device_s * 1e3:.1f}ms"
         )
 
 
@@ -58,7 +64,15 @@ class StatsCollector:
                 except StopIteration:
                     st.wall_s += time.perf_counter() - t0
                     return
-                st.wall_s += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                st.wall_s += t1 - t0
+                # block on THIS op's device work so the host-feed vs
+                # device-compute split is attributed per operator
+                try:
+                    b.block_until_ready()
+                except Exception:
+                    pass
+                st.device_s += time.perf_counter() - t1
                 st.output_batches += 1
                 st.output_rows += b.num_rows_host()
                 yield b
@@ -68,9 +82,14 @@ class StatsCollector:
     def render(self) -> str:
         # operators register in post-order (children first); reverse gives a
         # root-first rendering like the reference plan printer
-        lines = ["Query execution statistics (wall = inclusive of subtree):"]
+        lines = [
+            "Query execution statistics (wall = inclusive of subtree; "
+            "device = blocked-on-device per op):"
+        ]
         for st in reversed(self.operators):
             lines.append(st.line())
+        total_dev = sum(st.device_s for st in self.operators)
+        lines.append(f"total device-blocked: {total_dev * 1e3:.1f}ms")
         if self.memory is not None:
             lines.append(
                 f"peak device memory reserved: {self.memory.peak} bytes"

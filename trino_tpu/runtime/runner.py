@@ -72,6 +72,12 @@ class LocalQueryRunner:
 
         self.query_history = QueryHistory()
         self.events.add(self.query_history)
+        #: (catalog, schema, name) -> view definition Query AST (reference:
+        #: MetadataManager view storage + sql/tree/CreateView.java)
+        self.views: dict[tuple, object] = {}
+        #: prepared-statement name -> statement TEXT with `?` placeholders
+        #: (reference: server/protocol prepared-statement headers)
+        self.prepared: dict[str, str] = {}
         if "system" not in self.catalogs.names():
             sysconn = SystemConnector(self)
             self.catalogs.register("system", sysconn)
@@ -97,7 +103,9 @@ class LocalQueryRunner:
         return self.plan_query(stmt.query)
 
     def plan_query(self, query: ast.Query) -> OutputNode:
-        plan = LogicalPlanner(self.catalogs, self.session).plan(query)
+        plan = LogicalPlanner(
+            self.catalogs, self.session, views=self.views
+        ).plan(query)
         return self.optimize(plan)
 
     def optimize(self, plan: OutputNode) -> OutputNode:
@@ -381,6 +389,169 @@ class LocalQueryRunner:
         self._write_rows(conn, TableHandle(cat, schema, table), result)
         return MaterializedResult(["rows"], [(result.row_count,)], [])
 
+    def _exec_CreateView(self, stmt: ast.CreateView) -> MaterializedResult:
+        key = self._resolve_table(stmt.name)
+        if key in self.views and not stmt.or_replace:
+            raise ValueError(f"view {'.'.join(stmt.name)} already exists")
+        # validate with the NEW definition installed so a self-referencing
+        # replacement trips the planner's recursion check, then roll back
+        # on any validation failure
+        missing = object()
+        prev = self.views.get(key, missing)
+        self.views[key] = stmt.query
+        try:
+            self.plan_query(stmt.query)
+        except BaseException:
+            if prev is missing:
+                del self.views[key]
+            else:
+                self.views[key] = prev
+            raise
+        return _ok("CREATE VIEW")
+
+    def _exec_DropView(self, stmt: ast.DropView) -> MaterializedResult:
+        key = self._resolve_table(stmt.name)
+        if key not in self.views:
+            if stmt.if_exists:
+                return _ok("DROP VIEW")
+            raise KeyError(f"view {'.'.join(stmt.name)} does not exist")
+        del self.views[key]
+        return _ok("DROP VIEW")
+
+    def _exec_PrepareStatement(self, stmt: ast.PrepareStatement) -> MaterializedResult:
+        self.prepared[stmt.name] = stmt.text
+        return _ok("PREPARE")
+
+    def _exec_ExecuteStatement(self, stmt: ast.ExecuteStatement) -> MaterializedResult:
+        from trino_tpu.dbapi import _substitute
+
+        text = self.prepared.get(stmt.name)
+        if text is None:
+            raise KeyError(f"prepared statement {stmt.name} not found")
+        params = [_ast_literal_value(p) for p in stmt.params]
+        return self.execute(_substitute(text, params))
+
+    def _exec_DeallocateStatement(
+        self, stmt: ast.DeallocateStatement
+    ) -> MaterializedResult:
+        self.prepared.pop(stmt.name, None)
+        return _ok("DEALLOCATE")
+
+    def _exec_DeleteStatement(self, stmt: ast.DeleteStatement) -> MaterializedResult:
+        """DELETE = filtered table rewrite (reference roles: sql/tree/Delete
+        .java + plan/TableDeleteNode.java; connector-pushdown deletes become
+        a full rewrite here, exact under the same snapshot semantics as
+        INSERT)."""
+        from trino_tpu.connectors.api import TableHandle
+
+        cat, schema, table = self._resolve_table(stmt.name)
+        conn = self.catalogs.get(cat)
+        if not conn.supports_writes():
+            raise NotImplementedError(f"connector {cat} does not support DELETE")
+        meta = conn.metadata().table_metadata(schema, table)
+        self.access_control.check_can_delete(self.user, cat, schema, table)
+        # rows to KEEP: predicate FALSE or NULL; bare DELETE keeps nothing
+        if stmt.where is None:
+            keep_where: ast.Node = ast.BooleanLiteral(False)
+        else:
+            keep_where = ast.UnaryOp(
+                "not",
+                ast.FunctionCall(
+                    "coalesce", (stmt.where, ast.BooleanLiteral(False))
+                ),
+            )
+        ref = ast.TableRef((cat, schema, table))
+        kept = self._run_query(
+            ast.Query(ast.QuerySpec((ast.Star(),), ref, keep_where, (), None))
+        )
+        total = conn.metadata().table_row_count(schema, table) if hasattr(
+            conn.metadata(), "table_row_count"
+        ) else None
+        if total is None:
+            total = self._run_query(
+                ast.Query(
+                    ast.QuerySpec(
+                        (ast.SelectItem(
+                            ast.FunctionCall("count", (), is_star=True)
+                        ),),
+                        ref, None, (), None,
+                    )
+                )
+            ).rows[0][0]
+        self.transactions.notify_write(cat, schema, table)
+        self._rewrite_table(conn, cat, schema, table, meta, kept)
+        return MaterializedResult(["rows"], [(total - kept.row_count,)], [])
+
+    def _rewrite_table(self, conn, cat, schema, table, meta, result) -> None:
+        """Crash-safe truncate+rewrite: the pre-image is captured first and
+        restored if the write-back fails partway (DML must never leave the
+        table truncated)."""
+        from trino_tpu.connectors.api import TableHandle
+
+        snap_fn = getattr(conn, "snapshot_table", None)
+        snap = snap_fn(schema, table) if snap_fn is not None else None
+        try:
+            conn.create_table(schema, table, list(meta.columns))
+            self._write_rows(conn, TableHandle(cat, schema, table), result)
+        except BaseException:
+            if snap_fn is not None:
+                conn.restore_table(schema, table, snap)
+            raise
+
+    def _exec_UpdateStatement(self, stmt: ast.UpdateStatement) -> MaterializedResult:
+        """UPDATE = per-column conditional rewrite (reference:
+        sql/tree/Update.java + plan/MergeWriterNode.java roles)."""
+        from trino_tpu.connectors.api import TableHandle
+
+        cat, schema, table = self._resolve_table(stmt.name)
+        conn = self.catalogs.get(cat)
+        if not conn.supports_writes():
+            raise NotImplementedError(f"connector {cat} does not support UPDATE")
+        meta = conn.metadata().table_metadata(schema, table)
+        assigns = dict(stmt.assignments)
+        unknown = set(assigns) - {c.name for c in meta.columns}
+        if unknown:
+            raise ValueError(f"unknown columns in UPDATE: {sorted(unknown)}")
+        self.access_control.check_can_update(self.user, cat, schema, table)
+        cond = (
+            ast.FunctionCall("coalesce", (stmt.where, ast.BooleanLiteral(False)))
+            if stmt.where is not None
+            else ast.BooleanLiteral(True)
+        )
+        items = []
+        for c in meta.columns:
+            ref = ast.Identifier((c.name,))
+            if c.name in assigns:
+                # the assigned value is cast to the COLUMN's declared type
+                # (never the other way round: the stored payload must match
+                # the table metadata)
+                val = ast.CastExpr(assigns[c.name], c.type.name)
+                items.append(
+                    ast.SelectItem(
+                        ast.FunctionCall("if", (cond, val, ref)),
+                        alias=c.name,
+                    )
+                )
+            else:
+                items.append(ast.SelectItem(ref, alias=c.name))
+        tref = ast.TableRef((cat, schema, table))
+        rewritten = self._run_query(
+            ast.Query(ast.QuerySpec(tuple(items), tref, None, (), None))
+        )
+        touched = self._run_query(
+            ast.Query(
+                ast.QuerySpec(
+                    (ast.SelectItem(
+                        ast.FunctionCall("count", (), is_star=True)
+                    ),),
+                    tref, stmt.where, (), None,
+                )
+            )
+        ).rows[0][0]
+        self.transactions.notify_write(cat, schema, table)
+        self._rewrite_table(conn, cat, schema, table, meta, rewritten)
+        return MaterializedResult(["rows"], [(touched,)], [])
+
     def _exec_DropTable(self, stmt: ast.DropTable) -> MaterializedResult:
         from trino_tpu.connectors.api import TableHandle
 
@@ -407,6 +578,26 @@ class LocalQueryRunner:
                 col = column_from_values([r[i] for r in result.rows], cm.type)
                 cols.append(ColumnData(col.data, col.valid, col.dictionary))
             sink.append(cols)
+
+
+def _ast_literal_value(node):
+    """EXECUTE ... USING parameter -> python literal value."""
+    if isinstance(node, ast.NumberLiteral):
+        txt = node.text
+        return float(txt) if ("." in txt or "e" in txt.lower()) else int(txt)
+    if isinstance(node, ast.StringLiteral):
+        return node.value
+    if isinstance(node, ast.BooleanLiteral):
+        return node.value
+    if isinstance(node, ast.NullLiteral):
+        return None
+    if isinstance(node, ast.UnaryOp) and node.op == "-":
+        v = _ast_literal_value(node.operand)
+        return -v
+    raise ValueError(
+        f"EXECUTE USING supports literal parameters only, got "
+        f"{type(node).__name__}"
+    )
 
 
 def _ok(tag: str) -> MaterializedResult:

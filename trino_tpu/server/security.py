@@ -97,7 +97,7 @@ class AccessRule:
     catalog: str = ".*"
     schema: str = ".*"
     table: str = ".*"
-    privileges: tuple = ("SELECT", "INSERT", "DELETE", "OWNERSHIP")
+    privileges: tuple = ("SELECT", "INSERT", "UPDATE", "DELETE", "OWNERSHIP")
 
     def matches(self, user: str, catalog: str, schema: str, table: str) -> bool:
         return (
@@ -120,6 +120,16 @@ class AccessControl:
         pass
 
     def check_can_write(
+        self, user: str, catalog: str, schema: str, table: str
+    ) -> None:
+        pass
+
+    def check_can_delete(
+        self, user: str, catalog: str, schema: str, table: str
+    ) -> None:
+        pass
+
+    def check_can_update(
         self, user: str, catalog: str, schema: str, table: str
     ) -> None:
         pass
@@ -177,6 +187,12 @@ class RuleBasedAccessControl(AccessControl):
 
     def check_can_write(self, user, catalog, schema, table) -> None:
         self._check("INSERT", user, catalog, schema, table)
+
+    def check_can_delete(self, user, catalog, schema, table) -> None:
+        self._check("DELETE", user, catalog, schema, table)
+
+    def check_can_update(self, user, catalog, schema, table) -> None:
+        self._check("UPDATE", user, catalog, schema, table)
 
     def filter_catalogs(self, user: str, catalogs: Sequence[str]) -> list:
         """First-match-wins (like _check): the FIRST rule matching

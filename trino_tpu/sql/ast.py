@@ -366,6 +366,60 @@ class DropTable(Node):
 
 
 @dataclass(frozen=True)
+class CreateView(Node):
+    """CREATE [OR REPLACE] VIEW (reference: sql/tree/CreateView.java)."""
+
+    name: tuple
+    query: "Query"
+    or_replace: bool = False
+
+
+@dataclass(frozen=True)
+class DropView(Node):
+    name: tuple
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class PrepareStatement(Node):
+    """PREPARE name FROM <statement text> (reference: sql/tree/Prepare.java;
+    the statement is kept as TEXT so `?` placeholders bind at EXECUTE)."""
+
+    name: str
+    text: str
+
+
+@dataclass(frozen=True)
+class ExecuteStatement(Node):
+    """EXECUTE name [USING literal, ...]."""
+
+    name: str
+    params: tuple = ()
+
+
+@dataclass(frozen=True)
+class DeallocateStatement(Node):
+    name: str
+
+
+@dataclass(frozen=True)
+class DeleteStatement(Node):
+    """DELETE FROM t [WHERE pred] (reference: sql/tree/Delete.java)."""
+
+    name: tuple
+    where: Optional[Node] = None
+
+
+@dataclass(frozen=True)
+class UpdateStatement(Node):
+    """UPDATE t SET c = e, ... [WHERE pred] (reference: sql/tree/Update.java)."""
+
+    name: tuple
+    assignments: tuple  # of (column name str, value Node)
+    where: Optional[Node] = None
+
+
+@dataclass(frozen=True)
 class InsertStatement(Node):
     name: tuple
     query: Query

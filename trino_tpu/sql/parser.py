@@ -35,6 +35,7 @@ _PRECEDENCE = {
 
 class Parser:
     def __init__(self, sql: str):
+        self.sql = sql
         self.tokens = tokenize(sql)
         self.i = 0
 
@@ -119,6 +120,14 @@ class Parser:
             return self._create()
         if t.is_kw("drop"):
             self.next()
+            nxt = self.peek()
+            if nxt.kind == "ident" and nxt.value.lower() == "view":
+                self.next()
+                if_exists = False
+                if self.accept_kw("if"):
+                    self.expect_kw("exists")
+                    if_exists = True
+                return ast.DropView(self.qualified_name(), if_exists)
             self.expect_kw("table")
             if_exists = False
             if self.accept_kw("if"):
@@ -144,6 +153,51 @@ class Parser:
                 else:
                     self.i = save
             return ast.InsertStatement(name, self._query(), columns)
+        if t.is_kw("delete"):
+            self.next()
+            self.expect_kw("from")
+            name = self.qualified_name()
+            where = self._expr() if self.accept_kw("where") else None
+            return ast.DeleteStatement(name, where)
+        if t.is_kw("prepare"):
+            self.next()
+            pname = self.ident()
+            from_tok = self.expect_kw("from")
+            # keep the statement as TEXT: `?` placeholders bind at EXECUTE
+            text = self.sql[from_tok.pos + len("from"):].strip()
+            while self.peek().kind != "eof":
+                self.next()
+            return ast.PrepareStatement(pname, text)
+        if t.is_kw("execute") or t.is_kw("exec"):
+            self.next()
+            pname = self.ident()
+            params: tuple = ()
+            if self.accept_kw("using"):
+                ps = [self._expr()]
+                while self.accept_op(","):
+                    ps.append(self._expr())
+                params = tuple(ps)
+            return ast.ExecuteStatement(pname, params)
+        if t.is_kw("deallocate"):
+            self.next()
+            self.accept_kw("prepare")
+            return ast.DeallocateStatement(self.ident())
+        if t.is_kw("update"):
+            # UPDATE <table> SET col = expr [, ...] [WHERE pred]
+            # ("update" is also a privilege word; the statement form always
+            # has a table name next, so no ambiguity at statement start)
+            self.next()
+            name = self.qualified_name()
+            self.expect_kw("set")
+            assigns = []
+            while True:
+                col = self.ident()
+                self.expect_op("=")
+                assigns.append((col, self._expr()))
+                if not self.accept_op(","):
+                    break
+            where = self._expr() if self.accept_kw("where") else None
+            return ast.UpdateStatement(name, tuple(assigns), where)
         if t.is_kw("show"):
             self.next()
             what = self.next()
@@ -209,6 +263,22 @@ class Parser:
 
     def _create(self) -> ast.Node:
         self.expect_kw("create")
+        or_replace = False
+        if self.accept_kw("or"):
+            t = self.next()
+            if not (t.kind == "ident" and t.value.lower() == "replace"):
+                raise ParseError("expected REPLACE", t)
+            or_replace = True
+        nxt = self.peek()
+        if nxt.kind == "ident" and nxt.value.lower() == "view":
+            # CREATE [OR REPLACE] VIEW v AS query
+            # (reference: sql/tree/CreateView.java; VIEW is contextual)
+            self.next()
+            name = self.qualified_name()
+            self.expect_kw("as")
+            return ast.CreateView(name, self._query(), or_replace)
+        if or_replace:
+            raise ParseError("OR REPLACE applies to views only", nxt)
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
