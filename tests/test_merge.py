@@ -1,0 +1,88 @@
+"""MERGE statement tests (reference: sql/tree/Merge.java semantics;
+io.trino.testing AbstractTestEngineOnlyQueries merge coverage)."""
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture()
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    r = LocalQueryRunner(catalog="memory", schema="default", target_splits=2)
+    r.execute("create table tgt (k bigint, v varchar)")
+    r.execute("insert into tgt values (1,'a'), (2,'b'), (3,'c')")
+    r.execute("create table src (k bigint, v varchar)")
+    r.execute("insert into src values (2,'B'), (3,'DEL'), (4,'d')")
+    return r
+
+
+def test_merge_update_delete_insert(runner):
+    res = runner.execute(
+        "merge into tgt t using src s on t.k = s.k "
+        "when matched and s.v = 'DEL' then delete "
+        "when matched then update set v = s.v "
+        "when not matched then insert (k, v) values (s.k, s.v)"
+    )
+    assert res.rows == [(3,)]  # 1 update + 1 delete + 1 insert
+    assert sorted(runner.execute("select * from tgt").rows) == [
+        (1, "a"), (2, "B"), (4, "d"),
+    ]
+
+
+def test_merge_first_match_wins(runner):
+    # both clauses match k=2; the FIRST must fire (update, not delete)
+    runner.execute(
+        "merge into tgt t using src s on t.k = s.k "
+        "when matched and s.k = 2 then update set v = 'first' "
+        "when matched then delete"
+    )
+    rows = dict(runner.execute("select * from tgt").rows)
+    assert rows[2] == "first"
+    assert 3 not in rows  # second clause handled k=3
+    assert rows[1] == "a"
+
+
+def test_merge_matched_only(runner):
+    res = runner.execute(
+        "merge into tgt t using src s on t.k = s.k "
+        "when matched then update set v = 'm'"
+    )
+    assert res.rows == [(2,)]
+    assert sorted(runner.execute("select * from tgt").rows) == [
+        (1, "a"), (2, "m"), (3, "m"),
+    ]
+
+
+def test_merge_not_matched_only(runner):
+    res = runner.execute(
+        "merge into tgt t using src s on t.k = s.k "
+        "when not matched then insert values (s.k, s.v)"
+    )
+    assert res.rows == [(1,)]
+    assert sorted(runner.execute("select * from tgt").rows) == [
+        (1, "a"), (2, "b"), (3, "c"), (4, "d"),
+    ]
+
+
+def test_merge_subquery_source_and_condition(runner):
+    res = runner.execute(
+        "merge into tgt t using (select k, v from src where k <> 3) s "
+        "on t.k = s.k "
+        "when matched then update set v = s.v "
+        "when not matched and s.k > 3 then insert values (s.k, 'new')"
+    )
+    assert res.rows == [(2,)]
+    assert sorted(runner.execute("select * from tgt").rows) == [
+        (1, "a"), (2, "B"), (3, "c"), (4, "new"),
+    ]
+
+
+def test_merge_insert_condition_filters(runner):
+    res = runner.execute(
+        "merge into tgt t using src s on t.k = s.k "
+        "when not matched and s.v = 'nope' then insert values (s.k, s.v)"
+    )
+    assert res.rows == [(0,)]
+    assert runner.execute("select count(*) from tgt").rows == [(3,)]

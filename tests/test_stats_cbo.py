@@ -1,0 +1,121 @@
+"""Cost-based optimizer statistics tests (reference: TestFilterStatsCalculator,
+TestJoinStatsRule, TestReorderJoins in core/trino-main/src/test/.../cost/)."""
+
+import pytest
+
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.stats import ColStats, PlanStats, compute_stats, filter_stats
+from trino_tpu.expr.ir import Call, Form, Literal, SpecialForm, SymbolRef
+from trino_tpu import types as T
+
+pytestmark = pytest.mark.smoke
+
+
+def _sym(name):
+    return SymbolRef(name, T.BIGINT)
+
+
+def _lit(v):
+    return Literal(v, T.BIGINT)
+
+
+def test_equality_selectivity_uses_ndv():
+    st = PlanStats(1000.0, {"x": ColStats(ndv=50.0, low=0, high=49)})
+    out = filter_stats(st, Call("$eq", [_sym("x"), _lit(7)], T.BOOLEAN))
+    assert out.rows == pytest.approx(20.0)
+    assert out.col("x").ndv == 1.0
+
+
+def test_range_selectivity_from_min_max():
+    st = PlanStats(1000.0, {"x": ColStats(ndv=100.0, low=0.0, high=100.0)})
+    out = filter_stats(st, Call("$lt", [_sym("x"), _lit(25)], T.BOOLEAN))
+    assert out.rows == pytest.approx(250.0)
+    assert out.col("x").high == 25.0
+
+
+def test_between_and_in_selectivity():
+    st = PlanStats(1000.0, {"x": ColStats(ndv=100.0, low=0.0, high=100.0)})
+    btw = SpecialForm(Form.BETWEEN, [_sym("x"), _lit(10), _lit(30)], T.BOOLEAN)
+    assert filter_stats(st, btw).rows == pytest.approx(200.0)
+    inl = SpecialForm(Form.IN, [_sym("x"), _lit(1), _lit(2), _lit(3)], T.BOOLEAN)
+    assert filter_stats(st, inl).rows == pytest.approx(30.0)
+
+
+def test_or_inclusion_exclusion():
+    st = PlanStats(1000.0, {"x": ColStats(ndv=10.0)})
+    disj = SpecialForm(
+        Form.OR,
+        [
+            Call("$eq", [_sym("x"), _lit(1)], T.BOOLEAN),
+            Call("$eq", [_sym("x"), _lit(2)], T.BOOLEAN),
+        ],
+        T.BOOLEAN,
+    )
+    # 0.1 + 0.1 - 0.01 = 0.19
+    assert filter_stats(st, disj).rows == pytest.approx(190.0)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+def test_join_output_uses_key_ndv(runner):
+    """orders JOIN lineitem on orderkey ~ |lineitem| rows, not |o|*|l|."""
+    from trino_tpu.planner.stats import estimate_rows
+
+    plan = runner.create_plan(
+        "select * from orders o, lineitem l where o.o_orderkey = l.l_orderkey"
+    )
+    rows = estimate_rows(plan, runner.catalogs)
+    li = runner.catalogs.get("tpch").metadata().table_statistics(
+        "tiny", "lineitem"
+    ).row_count
+    assert rows == pytest.approx(li, rel=0.3)
+
+
+def test_join_order_small_build_side(runner):
+    """region (5 rows) must be a build (right) side, never the probe spine."""
+    plan = runner.create_plan(
+        "select n_name from nation, region "
+        "where n_regionkey = r_regionkey and r_name = 'ASIA'"
+    )
+
+    joins = []
+
+    def walk(n):
+        if isinstance(n, P.JoinNode):
+            joins.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    assert joins, "expected a join in the plan"
+    j = joins[0]
+    left = compute_stats(j.left, runner.catalogs).rows
+    right = compute_stats(j.right, runner.catalogs).rows
+    assert right <= left
+
+
+def test_show_stats(runner):
+    res = runner.execute("show stats for lineitem")
+    cols = {r[0]: r for r in res.rows}
+    assert None in cols  # summary row
+    assert cols[None][4] is not None and cols[None][4] > 0  # row_count
+    lq = cols["l_quantity"]
+    assert lq[2] == pytest.approx(50.0)  # ndv
+    assert float(lq[5]) == 1.0 and float(lq[6]) == 50.0
+
+
+def test_show_stats_memory_exact(runner):
+    runner.execute("create table memory.default.st (a bigint, b double)")
+    runner.execute(
+        "insert into memory.default.st values (1, 1.5), (2, 2.5), (2, null)"
+    )
+    res = runner.execute("show stats for memory.default.st")
+    cols = {r[0]: r for r in res.rows}
+    assert cols["a"][2] == pytest.approx(2.0)  # ndv {1,2}
+    assert cols["b"][3] == pytest.approx(1.0 / 3.0)  # null fraction
+    assert cols[None][4] == pytest.approx(3.0)

@@ -1,0 +1,115 @@
+"""TIMESTAMP WITH TIME ZONE tests (reference: TestTimestampWithTimeZone.java,
+operator/scalar/DateTimeFunctions.java, spi DateTimeEncoding packing)."""
+
+import datetime
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+def test_tz_literal_roundtrip(runner):
+    rows = runner.execute("select timestamp '2020-03-01 10:30:00 +05:30'").rows
+    v = rows[0][0]
+    assert v == datetime.datetime(
+        2020, 3, 1, 10, 30,
+        tzinfo=datetime.timezone(datetime.timedelta(minutes=330)),
+    )
+
+
+def test_tz_equality_is_by_instant(runner):
+    rows = runner.execute(
+        "select timestamp '2020-01-01 00:00:00 +02:00' = "
+        "timestamp '2019-12-31 22:00:00 +00:00'"
+    ).rows
+    assert rows == [(True,)]
+
+
+def test_at_time_zone(runner):
+    rows = runner.execute(
+        "select timestamp '2020-03-01 10:30:00 +05:30' at time zone 'UTC'"
+    ).rows
+    assert rows[0][0] == datetime.datetime(
+        2020, 3, 1, 5, 0, tzinfo=datetime.timezone.utc
+    )
+
+
+def test_tz_casts(runner):
+    rows = runner.execute(
+        "select cast(timestamp '2020-03-01 10:30:00 +05:30' as timestamp), "
+        "cast(timestamp '2020-03-01 23:30:00 +05:30' as date), "
+        "cast(date '2020-03-01' as timestamp with time zone)"
+    ).rows
+    ts, d, tz = rows[0]
+    assert ts == datetime.datetime(2020, 3, 1, 5, 0)
+    assert d == datetime.date(2020, 3, 1)
+    assert tz == datetime.datetime(2020, 3, 1, tzinfo=datetime.timezone.utc)
+
+
+def test_hour_minute_second(runner):
+    rows = runner.execute(
+        "select hour(t), minute(t), second(t), "
+        "extract(hour from t), extract(minute from t) "
+        "from (select timestamp '2020-03-01 10:30:45' t)"
+    ).rows
+    assert rows == [(10, 30, 45, 10, 30)]
+
+
+def test_tz_hour_respects_zone(runner):
+    rows = runner.execute(
+        "select hour(timestamp '2020-03-01 22:30:00 +05:30')"
+    ).rows
+    assert rows == [(22,)]  # wall-clock hour in the value's zone
+
+
+def test_timezone_hour_minute(runner):
+    rows = runner.execute(
+        "select extract(timezone_hour from timestamp '2020-01-01 00:00:00 -08:30'), "
+        "extract(timezone_minute from timestamp '2020-01-01 00:00:00 -08:30')"
+    ).rows
+    assert rows == [(-8, -30)]
+
+
+def test_unixtime_round_trip(runner):
+    rows = runner.execute(
+        "select to_unixtime(timestamp '1970-01-01 01:00:00 +00:00'), "
+        "from_unixtime(3600, '+01:00')"
+    ).rows
+    secs, tz = rows[0]
+    assert secs == 3600.0
+    assert tz == datetime.datetime(
+        1970, 1, 1, 2, 0,
+        tzinfo=datetime.timezone(datetime.timedelta(hours=1)),
+    )
+
+
+def test_current_timestamp_is_tz(runner):
+    rows = runner.execute("select current_timestamp").rows
+    v = rows[0][0]
+    assert v.tzinfo is not None
+    assert abs((datetime.datetime.now(datetime.timezone.utc) - v).total_seconds()) < 3600
+
+
+def test_named_zone_literal(runner):
+    rows = runner.execute(
+        "select timestamp '2020-06-01 12:00:00 America/New_York'"
+    ).rows
+    v = rows[0][0]
+    assert v.utcoffset() == datetime.timedelta(hours=-4)  # EDT
+
+
+def test_tz_order_by(runner):
+    rows = runner.execute(
+        "select t from (values timestamp '2020-01-01 12:00:00 +05:00', "
+        "timestamp '2020-01-01 10:00:00 +00:00', "
+        "timestamp '2020-01-01 05:00:00 -03:00') as v(t) order by t"
+    ).rows
+    instants = [r[0].astimezone(datetime.timezone.utc) for r in rows]
+    assert instants == sorted(instants)

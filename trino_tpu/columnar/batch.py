@@ -171,36 +171,47 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
         if any(p.lengths is not None for p in parts):
             # array columns: right-pad every part to the widest K.  Parts
             # with lengths=None carry 1-D data (no elements) and are lifted
-            # to an all-empty [capacity, k] layout first.
+            # to an all-empty [capacity, k] layout first.  Map columns pack
+            # keys+values halves, so each half pads separately.
+            from trino_tpu.types import MapType
+
+            is_map = isinstance(parts[0].type, MapType)
             k = max(
                 (p.data.shape[1] for p in parts if p.lengths is not None),
                 default=1,
             )
-            k = max(k, 1)
-            parts = [
-                (
-                    Column(
+            k = max(k, 2 if is_map else 1)
+
+            def _lift(p):
+                if p.lengths is None:
+                    return Column(
                         jnp.zeros((p.capacity, k), dtype=p.data.dtype),
                         p.type,
                         p.valid,
                         p.dictionary,
                         jnp.zeros(p.capacity, jnp.int32),
                     )
-                    if p.lengths is None
-                    else (
-                        p
-                        if p.data.shape[1] == k
-                        else Column(
-                            jnp.pad(p.data, ((0, 0), (0, k - p.data.shape[1]))),
-                            p.type,
-                            p.valid,
-                            p.dictionary,
-                            p.lengths,
-                        )
+                if p.data.shape[1] == k:
+                    return p
+                if is_map:
+                    half = p.data.shape[1] // 2
+                    pad = (k - p.data.shape[1]) // 2
+                    data = jnp.concatenate(
+                        [
+                            jnp.pad(p.data[:, :half], ((0, 0), (0, pad))),
+                            jnp.pad(p.data[:, half:], ((0, 0), (0, pad))),
+                        ],
+                        axis=1,
                     )
+                else:
+                    data = jnp.pad(
+                        p.data, ((0, 0), (0, k - p.data.shape[1]))
+                    )
+                return Column(
+                    data, p.type, p.valid, p.dictionary, p.lengths
                 )
-                for p in parts
-            ]
+
+            parts = [_lift(p) for p in parts]
             lengths = jnp.concatenate(
                 [
                     (

@@ -126,9 +126,22 @@ class Column:
         out = []
         t = self.type
         if self.lengths is not None:
-            from trino_tpu.types import ArrayType
+            from trino_tpu.types import ArrayType, MapType, is_string_kind
 
             lens = np.asarray(self.lengths)
+            if isinstance(t, MapType):
+                k = data.shape[1] // 2
+                kd = self.dictionary if is_string_kind(t.key) else None
+                vd = self.dictionary if is_string_kind(t.value) else None
+                for i in rows:
+                    if valid is not None and not valid[i]:
+                        out.append(None)
+                        continue
+                    n = int(lens[i])
+                    keys = Column(data[i, :k][:n], t.key, None, kd).to_pylist()
+                    vals = Column(data[i, k:][:n], t.value, None, vd).to_pylist()
+                    out.append(dict(zip(keys, vals)))
+                return out
             elem = t.element if isinstance(t, ArrayType) else t
             for i in rows:
                 if valid is not None and not valid[i]:
@@ -161,6 +174,19 @@ class Column:
                 out.append(
                     datetime.datetime(1970, 1, 1)
                     + datetime.timedelta(microseconds=int(data[i]))
+                )
+            elif t.name == "timestamp with time zone":
+                import datetime
+
+                from trino_tpu.types import unpack_tz_millis, unpack_tz_offset
+
+                p = int(data[i])
+                off = int(unpack_tz_offset(p))
+                tz = datetime.timezone(datetime.timedelta(minutes=off))
+                out.append(
+                    datetime.datetime.fromtimestamp(
+                        unpack_tz_millis(p) / 1000.0, tz
+                    )
                 )
             elif np.issubdtype(data.dtype, np.floating):
                 out.append(float(data[i]))

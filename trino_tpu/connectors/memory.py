@@ -55,10 +55,34 @@ class MemoryMetadata(ConnectorMetadata):
         return self.store[key].meta
 
     def table_statistics(self, schema: str, table: str) -> TableStatistics:
+        """Exact column stats computed from the stored data (reference:
+        MemoryMetadata.getTableStatistics + the ANALYZE flow — here stats are
+        always fresh because the data is resident)."""
+        from trino_tpu.connectors.api import ColumnStatistics
+
         key = (schema, table)
         if key not in self.store:
             return TableStatistics()
-        return TableStatistics(row_count=self.store[key].rows)
+        stored = self.store[key]
+        cols = {}
+        for meta, cd in zip(stored.meta.columns, stored.columns):
+            v = np.asarray(cd.values)
+            mask = (
+                np.asarray(cd.valid, dtype=bool)
+                if cd.valid is not None
+                else np.ones(len(v), dtype=bool)
+            )
+            live = v[mask]
+            nullf = 1.0 - (len(live) / len(v)) if len(v) else 0.0
+            if len(live) == 0:
+                cols[meta.name] = ColumnStatistics(0.0, nullf)
+                continue
+            ndv = float(len(np.unique(live)))
+            lo = hi = None
+            if live.dtype.kind in "iuf" and cd.dictionary is None:
+                lo, hi = float(live.min()), float(live.max())
+            cols[meta.name] = ColumnStatistics(ndv, nullf, lo, hi)
+        return TableStatistics(row_count=stored.rows, columns=cols)
 
 
 class _MemoryPageSource(PageSource):

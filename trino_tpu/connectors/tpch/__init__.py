@@ -43,28 +43,82 @@ class TpchMetadata(ConnectorMetadata):
         return tpch_schema.table_metadata(schema, table)
 
     def table_statistics(self, schema: str, table: str) -> TableStatistics:
+        """Analytic column statistics for the generated data (reference:
+        plugin/trino-tpch/.../statistics/*.json — precomputed per-column
+        ndv/min/max the reference ships for the CBO).  Ours derive from the
+        generator's own parameters, so they are exact for keys and tight for
+        derived columns."""
         sf = tpch_schema.schema_scale(schema)
         gen = generator_for(sf)
         rows = gen.row_count(table)
-        cols = {}
-        key_col = {
-            "region": "r_regionkey",
-            "nation": "n_nationkey",
-            "supplier": "s_suppkey",
-            "part": "p_partkey",
-            "customer": "c_custkey",
-            "orders": "o_orderkey",
-        }.get(table)
-        if key_col:
-            cols[key_col] = ColumnStatistics(
-                distinct_count=rows, low=0 if table in ("region", "nation") else 1,
-                high=rows if table not in ("region", "nation") else rows - 1,
+        from trino_tpu.connectors.tpch.generator import ORDER_DATE_SPAN, START_DATE
+
+        def C(ndv=None, low=None, high=None, nulls=0.0):
+            return ColumnStatistics(
+                distinct_count=ndv, low=low, high=high, null_fraction=nulls
             )
-        if table == "lineitem":
-            cols["l_orderkey"] = ColumnStatistics(
-                distinct_count=gen.O, low=1, high=gen.O
-            )
-        return TableStatistics(row_count=rows, columns=cols)
+
+        S, P, Ccust, O = gen.S, gen.P, gen.C, gen.O
+        od_hi = START_DATE + ORDER_DATE_SPAN
+        per_table = {
+            "region": {
+                "r_regionkey": C(5, 0, 4), "r_name": C(5), "r_comment": C(5),
+            },
+            "nation": {
+                "n_nationkey": C(25, 0, 24), "n_name": C(25),
+                "n_regionkey": C(5, 0, 4), "n_comment": C(25),
+            },
+            "supplier": {
+                "s_suppkey": C(S, 1, S), "s_name": C(S), "s_address": C(S),
+                "s_nationkey": C(25, 0, 24), "s_phone": C(S),
+                "s_acctbal": C(min(S, 1_100_000), -999.99, 9999.99),
+                "s_comment": C(S),
+            },
+            "part": {
+                "p_partkey": C(P, 1, P), "p_name": C(P),
+                "p_mfgr": C(5), "p_brand": C(25), "p_type": C(150),
+                "p_size": C(50, 1, 50), "p_container": C(40),
+                "p_retailprice": C(min(P, 120_000), 900.0, 2100.0),
+                "p_comment": C(P),
+            },
+            "partsupp": {
+                "ps_partkey": C(P, 1, P), "ps_suppkey": C(S, 1, S),
+                "ps_availqty": C(9999, 1, 9999),
+                "ps_supplycost": C(100_000, 1.0, 1000.0),
+                "ps_comment": C(rows),
+            },
+            "customer": {
+                "c_custkey": C(Ccust, 1, Ccust), "c_name": C(Ccust),
+                "c_address": C(Ccust), "c_nationkey": C(25, 0, 24),
+                "c_phone": C(Ccust),
+                "c_acctbal": C(min(Ccust, 1_100_000), -999.99, 9999.99),
+                "c_mktsegment": C(5), "c_comment": C(Ccust),
+            },
+            "orders": {
+                "o_orderkey": C(O, 1, O),
+                # 2/3 of customers hold orders (spec 4.2.3)
+                "o_custkey": C(max(1, Ccust * 2 // 3), 1, Ccust),
+                "o_orderstatus": C(3), "o_totalprice": C(O, 800.0, 600_000.0),
+                "o_orderdate": C(ORDER_DATE_SPAN, START_DATE, od_hi),
+                "o_orderpriority": C(5), "o_clerk": C(max(1, O // 1000)),
+                "o_shippriority": C(1, 0, 0), "o_comment": C(O),
+            },
+            "lineitem": {
+                "l_orderkey": C(O, 1, O), "l_partkey": C(P, 1, P),
+                "l_suppkey": C(S, 1, S), "l_linenumber": C(7, 1, 7),
+                "l_quantity": C(50, 1, 50),
+                "l_extendedprice": C(min(rows, 3_800_000), 900.0, 105_000.0),
+                "l_discount": C(11, 0.0, 0.10), "l_tax": C(9, 0.0, 0.08),
+                "l_returnflag": C(3), "l_linestatus": C(2),
+                "l_shipdate": C(ORDER_DATE_SPAN + 121, START_DATE + 1, od_hi + 121),
+                "l_commitdate": C(ORDER_DATE_SPAN + 61, START_DATE + 30, od_hi + 90),
+                "l_receiptdate": C(ORDER_DATE_SPAN + 151, START_DATE + 2, od_hi + 151),
+                "l_shipinstruct": C(4), "l_shipmode": C(7), "l_comment": C(rows),
+            },
+        }
+        return TableStatistics(
+            row_count=rows, columns=per_table.get(table, {})
+        )
 
 
 class TpchPageSource(PageSource):

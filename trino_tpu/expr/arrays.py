@@ -57,7 +57,11 @@ def _cardinality(ctx, call, v):
 def _element_at(ctx, call, arr, idx):
     """element_at(array, i): 1-based, negative i counts from the end, NULL
     out of range (reference: ElementAtFunction; unlike subscript, which the
-    reference makes throw)."""
+    reference makes throw).  Dispatches to the map lookup for map values."""
+    if isinstance(arr.type, T.MapType):
+        from trino_tpu.expr.maps import map_element_at
+
+        return map_element_at(ctx, call, arr, idx)
     data, lens = _arr2d(ctx, arr)
     k = data.shape[1]
     if k == 0:

@@ -351,6 +351,10 @@ class ExprCompiler:
         trapping is not expressible in a vectorized XLA program)."""
         base = self.value(f.args[0])
         idx = self.value(f.args[1])
+        if isinstance(base.type, T.MapType):
+            from trino_tpu.expr.maps import map_element_at
+
+            return map_element_at(self, f, base, idx)
         if base.lengths is None:
             raise NotImplementedError("subscript on non-array value")
         cap = self.capacity

@@ -66,6 +66,22 @@ def try_fold(e: Expr) -> Expr:
             v = kids[0].value
             if v is None:
                 return Literal(None, e.type)
+            frm = kids[0].type
+            if frm is T.TIMESTAMP_TZ or e.type is T.TIMESTAMP_TZ:
+                # packed-tz bits are not interchangeable with plain temporal
+                # encodings; fold the conversions explicitly
+                if frm is T.TIMESTAMP_TZ and e.type is T.TIMESTAMP:
+                    return Literal(T.unpack_tz_millis(int(v)) * 1000, e.type)
+                if frm is T.TIMESTAMP_TZ and e.type is T.DATE:
+                    local = T.unpack_tz_millis(int(v)) + T.unpack_tz_offset(
+                        int(v)
+                    ) * 60_000
+                    return Literal(local // 86_400_000, e.type)
+                if frm is T.TIMESTAMP and e.type is T.TIMESTAMP_TZ:
+                    return Literal(T.pack_tz(int(v) // 1000, 0), e.type)
+                if frm is T.DATE and e.type is T.TIMESTAMP_TZ:
+                    return Literal(T.pack_tz(int(v) * 86_400_000, 0), e.type)
+                return e
             return _from_py(_to_py(kids[0]), e.type)
     except (ValueError, TypeError, ArithmeticError):
         return e

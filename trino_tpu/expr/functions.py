@@ -427,7 +427,26 @@ def _days_from_civil(y, m, d):
 def _as_days(v: Val):
     if v.type is T.TIMESTAMP:
         return jnp.asarray(v.data, jnp.int64) // 86_400_000_000
+    if v.type is T.TIMESTAMP_TZ:
+        return _tz_local_micros(v) // 86_400_000_000
     return jnp.asarray(v.data, jnp.int64)
+
+
+def _tz_local_micros(v: Val):
+    """Wall-clock micros in the value's own zone (packed tz layout)."""
+    p = jnp.asarray(v.data, jnp.int64)
+    millis = T.unpack_tz_millis(p)
+    off = T.unpack_tz_offset(p)
+    return (millis + off * 60_000) * 1000
+
+
+def _day_micros(v: Val):
+    """Micros since local midnight for timestamp / timestamptz values."""
+    if v.type is T.TIMESTAMP_TZ:
+        us = _tz_local_micros(v)
+    else:
+        us = jnp.asarray(v.data, jnp.int64)
+    return us % 86_400_000_000
 
 
 @register("year")
@@ -503,6 +522,108 @@ def _date_trunc_month(ctx, call, a):
 def _date_trunc_year(ctx, call, a):
     y, _, _ = _civil_from_days(_as_days(a))
     return Val(_days_from_civil(y, jnp.asarray(1), jnp.asarray(1)), a.valid, T.DATE)
+
+
+# ---------------------------------------------------------------------------
+# time-of-day + timestamp with time zone
+# (reference: operator/scalar/DateTimeFunctions.java + spi DateTimeEncoding)
+
+
+@register("hour")
+def _hour(ctx, call, a):
+    return Val(_day_micros(a) // 3_600_000_000, a.valid, T.BIGINT)
+
+
+@register("minute")
+def _minute(ctx, call, a):
+    return Val(_day_micros(a) // 60_000_000 % 60, a.valid, T.BIGINT)
+
+
+@register("second")
+def _second(ctx, call, a):
+    return Val(_day_micros(a) // 1_000_000 % 60, a.valid, T.BIGINT)
+
+
+@register("millisecond")
+def _millisecond(ctx, call, a):
+    return Val(_day_micros(a) // 1000 % 1000, a.valid, T.BIGINT)
+
+
+@register("$tz_instant")
+def _tz_instant(ctx, call, a):
+    """packed tz -> UTC instant micros (TIMESTAMP in the UTC session zone)."""
+    millis = T.unpack_tz_millis(jnp.asarray(a.data, jnp.int64))
+    return Val(millis * 1000, a.valid, T.TIMESTAMP)
+
+
+def _zone_offset_of(zone: Val, name: str) -> int:
+    return T.zone_offset_minutes(_literal_str(zone, name))
+
+
+@register("at_timezone")
+def _at_timezone(ctx, call, v, zone):
+    """`v AT TIME ZONE z`: same instant, displayed in zone z (reference:
+    scalar/AtTimeZone.java).  Named-zone offsets resolve at plan time (the
+    offset in force now), fixed offsets are exact."""
+    off = _zone_offset_of(zone, "AT TIME ZONE")
+    if v.type is T.TIMESTAMP_TZ:
+        millis = T.unpack_tz_millis(jnp.asarray(v.data, jnp.int64))
+    elif v.type is T.TIMESTAMP:
+        # session zone is UTC: the local timestamp IS the instant
+        millis = jnp.asarray(v.data, jnp.int64) // 1000
+    elif v.type is T.DATE:
+        millis = jnp.asarray(v.data, jnp.int64) * 86_400_000
+    else:
+        raise TypeError(f"AT TIME ZONE on {v.type.name}")
+    packed = millis * T.TZ_SHIFT + (off + T.TZ_OFFSET_BIAS)
+    return Val(packed, v.valid, T.TIMESTAMP_TZ)
+
+
+@register("with_timezone")
+def _with_timezone(ctx, call, v, zone):
+    """with_timezone(timestamp, zone): wall time v interpreted IN zone
+    (reference: scalar/WithTimeZone.java)."""
+    off = _zone_offset_of(zone, "with_timezone")
+    local_millis = jnp.asarray(v.data, jnp.int64) // 1000
+    utc = local_millis - off * 60_000
+    return Val(
+        utc * T.TZ_SHIFT + (off + T.TZ_OFFSET_BIAS), v.valid, T.TIMESTAMP_TZ
+    )
+
+
+@register("from_unixtime")
+def _from_unixtime(ctx, call, secs, zone=None):
+    off = _zone_offset_of(zone, "from_unixtime") if zone is not None else 0
+    millis = (jnp.asarray(secs.data, jnp.float64) * 1000.0).astype(jnp.int64)
+    if call.type is T.TIMESTAMP:
+        return Val(millis * 1000, secs.valid, T.TIMESTAMP)
+    return Val(
+        millis * T.TZ_SHIFT + (off + T.TZ_OFFSET_BIAS),
+        secs.valid,
+        T.TIMESTAMP_TZ,
+    )
+
+
+@register("to_unixtime")
+def _to_unixtime(ctx, call, v):
+    if v.type is T.TIMESTAMP_TZ:
+        millis = T.unpack_tz_millis(jnp.asarray(v.data, jnp.int64))
+        return Val(millis.astype(jnp.float64) / 1000.0, v.valid, T.DOUBLE)
+    return Val(
+        jnp.asarray(v.data, jnp.float64) / 1_000_000.0, v.valid, T.DOUBLE
+    )
+
+
+@register("timezone_minute")
+def _timezone_minute(ctx, call, v):
+    off = T.unpack_tz_offset(jnp.asarray(v.data, jnp.int64))
+    return Val(jnp.sign(off) * (jnp.abs(off) % 60), v.valid, T.BIGINT)
+
+
+@register("timezone_hour")
+def _timezone_hour(ctx, call, v):
+    off = T.unpack_tz_offset(jnp.asarray(v.data, jnp.int64))
+    return Val(off // 60 + jnp.where(off < 0, (off % 60 != 0), 0), v.valid, T.BIGINT)
 
 
 # ---------------------------------------------------------------------------
@@ -836,6 +957,21 @@ def compile_cast(ctx: ExprCompiler, v: Val, to: T.Type) -> Val:
         return Val(jnp.asarray(v.data, jnp.int64) // 86_400_000_000, v.valid, to)
     if to is T.TIMESTAMP and frm is T.DATE:
         return Val(jnp.asarray(v.data, jnp.int64) * 86_400_000_000, v.valid, to)
+    # timestamptz conversions (session zone = UTC; reference:
+    # DateTimeOperators cast family over packed values)
+    if frm is T.TIMESTAMP_TZ and to is T.TIMESTAMP:
+        millis = T.unpack_tz_millis(jnp.asarray(v.data, jnp.int64))
+        return Val(millis * 1000, v.valid, to)
+    if frm is T.TIMESTAMP_TZ and to is T.DATE:
+        p = jnp.asarray(v.data, jnp.int64)
+        local = (T.unpack_tz_millis(p) + T.unpack_tz_offset(p) * 60_000) * 1000
+        return Val(local // 86_400_000_000, v.valid, to)
+    if to is T.TIMESTAMP_TZ and frm is T.TIMESTAMP:
+        millis = jnp.asarray(v.data, jnp.int64) // 1000
+        return Val(millis * T.TZ_SHIFT + T.TZ_OFFSET_BIAS, v.valid, to)
+    if to is T.TIMESTAMP_TZ and frm is T.DATE:
+        millis = jnp.asarray(v.data, jnp.int64) * 86_400_000
+        return Val(millis * T.TZ_SHIFT + T.TZ_OFFSET_BIAS, v.valid, to)
     if to is T.BOOLEAN:
         return Val(jnp.asarray(v.data) != 0, v.valid, to)
     if frm is T.BOOLEAN:
@@ -875,5 +1011,6 @@ def _parse_scalar(s: str, to: T.Type):
     raise ValueError(f"cannot parse {s!r} as {to.name}")
 
 
-# array/json function handlers register themselves on import
+# array/json/map function handlers register themselves on import
 from trino_tpu.expr import arrays as _arrays  # noqa: E402,F401
+from trino_tpu.expr import maps as _maps  # noqa: E402,F401

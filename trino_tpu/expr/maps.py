@@ -1,0 +1,219 @@
+"""MAP scalar functions.
+
+Reference roles: core/trino-main/.../operator/scalar/MapConstructor.java,
+MapKeys/MapValues/MapCardinality, MapSubscriptOperator.java,
+MapConcatFunction.java, MapElementAtFunction.
+
+Device layout (see types.MapType): a map column is [capacity, 2*K] with the
+key plane in slots [0:K] and the value plane in [K:2K]; `lengths` is the
+per-row entry count.  All lookups are vectorized equality scans over the key
+plane — K is small (pow2-bucketed at construction), so a scan beats building
+per-row hash structures on a systolic-array machine.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.expr.compiler import Val, _and_valid
+from trino_tpu.expr.functions import register
+
+
+def _map2d(ctx, v: Val):
+    """Broadcast a map Val to (keys [cap,K], values [cap,K], lengths[cap])."""
+    if v.lengths is None or not isinstance(v.type, T.MapType):
+        raise NotImplementedError("expected a map value")
+    cap = ctx.capacity
+    two_k = v.data.shape[-1]
+    k = two_k // 2
+    data = jnp.broadcast_to(jnp.asarray(v.data), (cap, two_k))
+    lens = jnp.broadcast_to(jnp.asarray(v.lengths, jnp.int32), (cap,))
+    return data[:, :k], data[:, k:], lens
+
+
+def _entry_mask(k: int, lens):
+    return jnp.arange(k, dtype=jnp.int32)[None, :] < lens[:, None]
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def make_map(ctx, call, keys: Val, values: Val) -> Val:
+    """MAP(ARRAY[...], ARRAY[...]) — reference: MapConstructor.java.
+    Rows where key/value array lengths differ become NULL maps (the
+    reference throws; vectorized programs signal via null)."""
+    mt = call.type
+    cap = ctx.capacity
+    kk = keys.data.shape[-1] if keys.lengths is not None else 0
+    kv = values.data.shape[-1] if values.lengths is not None else 0
+    k = _pow2(max(kk, kv, 1))
+    kd = jnp.broadcast_to(jnp.asarray(keys.data), (cap, kk)) if kk else jnp.zeros((cap, 0), mt.np_dtype)
+    vd = jnp.broadcast_to(jnp.asarray(values.data), (cap, kv)) if kv else jnp.zeros((cap, 0), mt.np_dtype)
+    klens = (
+        jnp.broadcast_to(jnp.asarray(keys.lengths, jnp.int32), (cap,))
+        if keys.lengths is not None
+        else jnp.zeros(cap, jnp.int32)
+    )
+    vlens = (
+        jnp.broadcast_to(jnp.asarray(values.lengths, jnp.int32), (cap,))
+        if values.lengths is not None
+        else jnp.zeros(cap, jnp.int32)
+    )
+    # merge dictionaries when both planes are strings (single shared dict)
+    dictionary = None
+    if keys.dictionary is not None and values.dictionary is not None:
+        from trino_tpu.columnar.dictionary import union_many
+
+        dictionary, (tk, tv) = union_many([keys.dictionary, values.dictionary])
+        if tk is not None:
+            kd = jnp.take(jnp.asarray(tk), jnp.asarray(kd, jnp.int32), mode="clip")
+        if tv is not None:
+            vd = jnp.take(jnp.asarray(tv), jnp.asarray(vd, jnp.int32), mode="clip")
+    elif keys.dictionary is not None:
+        dictionary = keys.dictionary
+    elif values.dictionary is not None:
+        dictionary = values.dictionary
+    dt = mt.np_dtype
+    kd = jnp.pad(jnp.asarray(kd, dt), ((0, 0), (0, k - kk)))
+    vd = jnp.pad(jnp.asarray(vd, dt), ((0, 0), (0, k - kv)))
+    data = jnp.concatenate([kd, vd], axis=1)
+    valid = _and_valid(keys.valid, values.valid)
+    valid = _and_valid(valid, klens == vlens)
+    return Val(data, valid, mt, dictionary, klens)
+
+
+@register("map")
+def _map_ctor(ctx, call, keys, values):
+    return make_map(ctx, call, keys, values)
+
+
+@register("map_keys")
+def _map_keys(ctx, call, m):
+    kd, _, lens = _map2d(ctx, m)
+    d = m.dictionary if T.is_string_kind(m.type.key) else None
+    at = call.type  # array(K)
+    return Val(jnp.asarray(kd, at.element.np_dtype), m.valid, at, d, lens)
+
+
+@register("map_values")
+def _map_values(ctx, call, m):
+    _, vd, lens = _map2d(ctx, m)
+    d = m.dictionary if T.is_string_kind(m.type.value) else None
+    at = call.type
+    return Val(jnp.asarray(vd, at.element.np_dtype), m.valid, at, d, lens)
+
+
+def _encode_key(ctx, m: Val, key: Val):
+    """Key lookup value in the map's key-plane representation."""
+    if T.is_string_kind(m.type.key) and m.dictionary is not None:
+        # resolve the probe key against the map's dictionary
+        if key.dictionary is m.dictionary:
+            return jnp.asarray(key.data, m.data.dtype), key.valid
+        if key.dictionary is not None:
+            table = np.asarray(
+                [m.dictionary.index.get(s, -1) for s in key.dictionary.values],
+                dtype=np.int64,
+            )
+            code = jnp.take(
+                jnp.asarray(table), jnp.asarray(key.data, jnp.int32), mode="clip"
+            )
+            return code, _and_valid(key.valid, code >= 0)
+        raise NotImplementedError("string key without dictionary")
+    return jnp.asarray(key.data, m.data.dtype), key.valid
+
+
+def map_element_at(ctx, call, m: Val, key: Val) -> Val:
+    """element_at(map, key) / map[key] — reference: MapSubscriptOperator
+    (subscript throws on missing key; element_at yields NULL — vectorized,
+    both yield NULL)."""
+    kd, vd, lens = _map2d(ctx, m)
+    k = kd.shape[1]
+    cap = ctx.capacity
+    if k == 0:
+        return Val(jnp.zeros(cap, call.type.np_dtype), False, call.type)
+    probe, pvalid = _encode_key(ctx, m, key)
+    probe = jnp.broadcast_to(probe, (cap,))
+    em = _entry_mask(k, lens)
+    hit = jnp.logical_and(em, kd == probe[:, None])
+    found = jnp.any(hit, axis=1)
+    pos = jnp.argmax(hit, axis=1)
+    out = jnp.take_along_axis(vd, pos[:, None], axis=1)[:, 0]
+    valid = _and_valid(_and_valid(m.valid, pvalid), found)
+    d = m.dictionary if T.is_string_kind(m.type.value) else None
+    return Val(jnp.asarray(out, call.type.np_dtype), valid, call.type, d)
+
+
+@register("map_concat")
+def _map_concat(ctx, call, *maps):
+    """map_concat(m1, m2, ...): later maps win on duplicate keys
+    (reference: MapConcatFunction.java)."""
+    if len(maps) < 2:
+        return maps[0]
+    acc = maps[0]
+    for nxt in maps[1:]:
+        acc = _concat2(ctx, call, acc, nxt)
+    return acc
+
+
+def _concat2(ctx, call, a: Val, b: Val) -> Val:
+    mt = call.type
+    ka, va, la = _map2d(ctx, a)
+    kb, vb, lb = _map2d(ctx, b)
+    # unify dictionaries if string-typed planes are involved
+    dictionary = a.dictionary
+    if a.dictionary is not None or b.dictionary is not None:
+        from trino_tpu.columnar.dictionary import union_many
+
+        dictionary, (ta, tb) = union_many([a.dictionary, b.dictionary])
+        if ta is not None:
+            if T.is_string_kind(mt.key):
+                ka = jnp.take(jnp.asarray(ta), jnp.asarray(ka, jnp.int32), mode="clip")
+            if T.is_string_kind(mt.value):
+                va = jnp.take(jnp.asarray(ta), jnp.asarray(va, jnp.int32), mode="clip")
+        if tb is not None:
+            if T.is_string_kind(mt.key):
+                kb = jnp.take(jnp.asarray(tb), jnp.asarray(kb, jnp.int32), mode="clip")
+            if T.is_string_kind(mt.value):
+                vb = jnp.take(jnp.asarray(tb), jnp.asarray(vb, jnp.int32), mode="clip")
+    na, nb = ka.shape[1], kb.shape[1]
+    ema = _entry_mask(na, la)
+    emb = _entry_mask(nb, lb)
+    # drop entries of `a` whose key also appears (live) in `b` — b wins
+    dup = jnp.any(
+        jnp.logical_and(
+            emb[:, None, :], ka[:, :, None] == kb[:, None, :]
+        ),
+        axis=2,
+    )
+    keep_a = jnp.logical_and(ema, jnp.logical_not(dup))
+    # compact kept `a` entries to the front: stable argsort of ~keep
+    order = jnp.argsort(jnp.logical_not(keep_a), axis=1, stable=True)
+    ka_s = jnp.take_along_axis(ka, order, axis=1)
+    va_s = jnp.take_along_axis(va, order, axis=1)
+    n_keep = jnp.sum(keep_a, axis=1).astype(jnp.int32)
+    k = _pow2(max(na + nb, 1))
+    dt = mt.np_dtype
+    pad_a = ((0, 0), (0, k - na))
+    pad_b = ((0, 0), (0, k - nb))
+    keys = jnp.pad(jnp.asarray(ka_s, dt), pad_a)
+    vals = jnp.pad(jnp.asarray(va_s, dt), pad_a)
+    kb_p = jnp.pad(jnp.asarray(kb, dt), pad_b)
+    vb_p = jnp.pad(jnp.asarray(vb, dt), pad_b)
+    # scatter b's entries right after a's kept prefix, per row
+    idx = jnp.arange(k, dtype=jnp.int32)[None, :]
+    from_b = jnp.logical_and(
+        idx >= n_keep[:, None], idx < (n_keep + lb)[:, None]
+    )
+    b_pos = jnp.clip(idx - n_keep[:, None], 0, k - 1)
+    keys = jnp.where(from_b, jnp.take_along_axis(kb_p, b_pos, axis=1), keys)
+    vals = jnp.where(from_b, jnp.take_along_axis(vb_p, b_pos, axis=1), vals)
+    data = jnp.concatenate([keys, vals], axis=1)
+    lengths = n_keep + lb
+    valid = _and_valid(a.valid, b.valid)
+    return Val(data, valid, mt, dictionary, lengths)

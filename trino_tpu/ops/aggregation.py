@@ -84,13 +84,22 @@ def _hll_hash(col: Column):
         )
         h = jnp.take(jnp.asarray(table), jnp.asarray(d, jnp.int32), mode="clip")
     elif jnp.issubdtype(d.dtype, jnp.floating):
-        # avoid float bitcasts (TPU x64-rewrite can't lower them): frexp
-        # decomposes exactly; -0.0 collapses to 0.0, NaN to a fixed pattern
+        # No float bitcasts (TPU x64-rewrite can't lower them) and no frexp
+        # (it lowers THROUGH a bitcast): decompose via exp2/log2 instead.
+        # The rounding at power-of-two boundaries is deterministic per value,
+        # which is all a hash needs.  -0.0 collapses to 0.0, NaN to 0.
         f = d + 0.0
-        f = jnp.where(jnp.isnan(f), jnp.float64(0.0) / 0.0, f)
-        mant, expo = jnp.frexp(f)
-        h = (mant * (1 << 53)).astype(jnp.int64) ^ (
-            expo.astype(jnp.int64) << 1
+        f = jnp.where(jnp.isnan(f), jnp.float64(0.0), f)
+        a = jnp.abs(f)
+        expo = jnp.where(
+            a > 0.0, jnp.floor(jnp.log2(jnp.where(a > 0.0, a, 1.0))), 0.0
+        )
+        expo = jnp.clip(expo, -1074.0, 1023.0)
+        mant = jnp.where(a > 0.0, a * jnp.exp2(-expo), 0.0)  # in [1, 2)
+        h = (
+            (mant * (1 << 52)).astype(jnp.int64)
+            ^ (expo.astype(jnp.int64) << 1)
+            ^ jnp.where(f < 0.0, jnp.int64(1) << 62, jnp.int64(0))
         )
     else:
         h = d.astype(jnp.int64)
@@ -107,11 +116,18 @@ def _hll_registers(col: Column, valid) -> jnp.ndarray:
     u = _hll_hash(col)
     bucket = (u >> np.uint64(64 - HLL_P)).astype(jnp.int64)
     rest = (u << np.uint64(HLL_P)) | np.uint64(1)  # sentinel stops rank at max
-    # rank = leading zeros of `rest` + 1, via the float exponent (frexp is
-    # exact for the top bit position)
-    f = rest.astype(jnp.float64)
-    _, expo = jnp.frexp(f)
-    rank = (64 - expo + 1).astype(jnp.int32)
+    # rank = leading zeros of `rest` + 1, via a branchless integer
+    # bit-length cascade (pure shifts/compares — nothing the TPU
+    # x64-rewrite can't lower, unlike frexp/bitcast)
+    x = rest
+    bitlen = jnp.zeros(rest.shape, jnp.int32)
+    for s in (32, 16, 8, 4, 2, 1):
+        y = x >> np.uint64(s)
+        gt = y > 0
+        bitlen = jnp.where(gt, bitlen + s, bitlen)
+        x = jnp.where(gt, y, x)
+    bitlen = bitlen + (x > 0).astype(jnp.int32)
+    rank = 64 - bitlen + 1
     bucket = jnp.where(valid, bucket, HLL_M)
     return jax.ops.segment_max(
         jnp.where(valid, rank, 0), bucket, HLL_M + 1

@@ -177,7 +177,21 @@ class ExprAnalyzer:
         return Literal(_parse_date(n.text), T.DATE)
 
     def _a_TimestampLiteral(self, n: ast.TimestampLiteral) -> Expr:
-        s = n.text.strip().replace("t", " ").replace("T", " ")
+        import re as _re
+
+        # normalize only an ISO 'T' separating date and time — a blanket
+        # t->space replace would mangle zone names (UTC, America/Toronto)
+        s = _re.sub(r"(?<=\d)[tT](?=\d)", " ", n.text.strip(), count=1)
+        # trailing zone: '+05:30' / '-08:00' / ' UTC' / ' America/New_York'
+        # (reference: SqlBase.g4 TIMESTAMP WITH TIME ZONE literal parsing)
+        zone = None
+        m = _re.search(r"\s*([+-]\d{2}:?\d{2})\s*$", s)
+        if m:
+            zone, s = m.group(1), s[: m.start()]
+        else:
+            m = _re.search(r"\s+([A-Za-z][A-Za-z_/+-]*(?:/[A-Za-z_]+)*)\s*$", s)
+            if m:
+                zone, s = m.group(1), s[: m.start()]
         if " " in s:
             d, tm = s.split(" ", 1)
         else:
@@ -190,7 +204,16 @@ class ExprAnalyzer:
         micros = days * 86_400_000_000 + (h * 3600 + mi * 60) * 1_000_000 + int(
             sec * 1_000_000
         )
-        return Literal(micros, T.TIMESTAMP)
+        if zone is None:
+            return Literal(micros, T.TIMESTAMP)
+        local_millis = micros // 1000
+        # resolve named zones at the local wall time (close enough for DST)
+        off = T.zone_offset_minutes(zone, local_millis)
+        utc_millis = local_millis - off * 60_000
+        if zone[0] not in "+-" and zone.upper() not in ("UTC", "Z", "GMT"):
+            off = T.zone_offset_minutes(zone, utc_millis)
+            utc_millis = local_millis - off * 60_000
+        return Literal(T.pack_tz(utc_millis, off), T.TIMESTAMP_TZ)
 
     def _a_IntervalLiteral(self, n: ast.IntervalLiteral) -> Expr:
         # stands alone only long enough for date arithmetic to consume it
@@ -261,7 +284,27 @@ class ExprAnalyzer:
                     raise AnalysisError(f"invalid date literal: {e.value!r}")
             return e
 
-        return lift(l, r.type), lift(r, l.type)
+        l, r = lift(l, r.type), lift(r, l.type)
+        # timestamptz compares by UTC instant, not by packed (instant, zone)
+        # bits (reference: TimestampWithTimeZoneOperators unpacks millis);
+        # mixed tz/timestamp comparisons align both sides to instant micros
+        tz_l = l.type is T.TIMESTAMP_TZ
+        tz_r = r.type is T.TIMESTAMP_TZ
+        if tz_l or tz_r:
+
+            def instant(e: Expr) -> Expr:
+                if e.type is T.TIMESTAMP_TZ:
+                    return Call("$tz_instant", [e], T.TIMESTAMP)
+                if e.type is T.DATE:
+                    return Call(
+                        "$mul",
+                        [e, Literal(86_400_000_000, T.BIGINT)],
+                        T.TIMESTAMP,
+                    )
+                return e
+
+            l, r = instant(l), instant(r)
+        return l, r
 
     def _check_comparable(self, l: Expr, r: Expr) -> None:
         lt, rt = l.type, r.type
@@ -292,6 +335,18 @@ class ExprAnalyzer:
         if n.name == "current_date":
             today = (datetime.date.today() - _EPOCH).days
             return Literal(today, T.DATE)
+        if n.name == "current_timestamp":
+            # reference: scalar/CurrentTimestamp.java — session start instant
+            # in the session zone (ours: UTC)
+            import time as _time
+
+            return Literal(
+                T.pack_tz(int(_time.time() * 1000), 0), T.TIMESTAMP_TZ
+            )
+        if n.name == "localtimestamp":
+            import time as _time
+
+            return Literal(int(_time.time() * 1_000_000), T.TIMESTAMP)
         if n.name == "if":
             args = [self.analyze(a) for a in n.args]
             rt = T.common_super_type(
@@ -353,7 +408,10 @@ class ExprAnalyzer:
         items = []
         for i in n.items:
             e = self.analyze(i)
-            _, e = self._coerce_temporal(v, e)
+            # the coercion may rewrite BOTH sides (e.g. timestamptz operands
+            # align to instant micros) — the value rewrite must be kept, not
+            # just the item one
+            v, e = self._coerce_temporal(v, e)
             items.append(e)
         e = SpecialForm(Form.IN, [v] + items, T.BOOLEAN)
         return ir.not_(e) if n.negated else e
@@ -362,8 +420,8 @@ class ExprAnalyzer:
         v = self.analyze(n.value)
         lo = self.analyze(n.low)
         hi = self.analyze(n.high)
-        _, lo = self._coerce_temporal(v, lo)
-        _, hi = self._coerce_temporal(v, hi)
+        v, lo = self._coerce_temporal(v, lo)
+        v, hi = self._coerce_temporal(v, hi)
         e = SpecialForm(Form.BETWEEN, [v, lo, hi], T.BOOLEAN)
         return ir.not_(e) if n.negated else e
 
@@ -400,6 +458,8 @@ class ExprAnalyzer:
     def _a_Subscript(self, n: ast.Subscript) -> Expr:
         base = self.analyze(n.base)
         idx = self.analyze(n.index)
+        if isinstance(base.type, T.MapType):
+            return SpecialForm(Form.SUBSCRIPT, [base, idx], base.type.value)
         if not isinstance(base.type, T.ArrayType):
             raise AnalysisError(
                 f"subscript base must be an array, got {base.type.name}"
@@ -411,6 +471,9 @@ class ExprAnalyzer:
             "year": "year", "month": "month", "day": "day",
             "quarter": "quarter", "week": "week",
             "dow": "day_of_week", "doy": "day_of_year",
+            "hour": "hour", "minute": "minute", "second": "second",
+            "timezone_hour": "timezone_hour",
+            "timezone_minute": "timezone_minute",
         }.get(n.unit)
         if fn is None:
             raise AnalysisError(f"unsupported EXTRACT unit {n.unit}")

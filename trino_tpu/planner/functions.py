@@ -168,10 +168,36 @@ SCALAR_RESULT = {
     "greatest": _same_as_first,
     "least": _same_as_first,
     # -- arrays (reference: operator/scalar/Array*Function.java) ------------
+    "hour": _fixed(T.BIGINT),
+    "minute": _fixed(T.BIGINT),
+    "second": _fixed(T.BIGINT),
+    "millisecond": _fixed(T.BIGINT),
+    "timezone_hour": _fixed(T.BIGINT),
+    "timezone_minute": _fixed(T.BIGINT),
+    "at_timezone": _fixed(T.TIMESTAMP_TZ),
+    "with_timezone": _fixed(T.TIMESTAMP_TZ),
+    "from_unixtime": lambda args: T.TIMESTAMP
+    if len(args) == 1
+    else T.TIMESTAMP_TZ,
+    "to_unixtime": _fixed(T.DOUBLE),
     "cardinality": _fixed(T.BIGINT),
     "element_at": lambda args: args[0].element
     if isinstance(args[0], T.ArrayType)
+    else args[0].value
+    if isinstance(args[0], T.MapType)
     else T.UNKNOWN,
+    # -- maps (reference: operator/scalar/MapConstructor.java etc) ----------
+    "map": lambda args: T.MapType(
+        args[0].element if isinstance(args[0], T.ArrayType) else T.BIGINT,
+        args[1].element if isinstance(args[1], T.ArrayType) else T.BIGINT,
+    ),
+    "map_keys": lambda args: T.ArrayType(
+        args[0].key if isinstance(args[0], T.MapType) else T.BIGINT
+    ),
+    "map_values": lambda args: T.ArrayType(
+        args[0].value if isinstance(args[0], T.MapType) else T.BIGINT
+    ),
+    "map_concat": _same_as_first,
     "contains": _fixed(T.BOOLEAN),
     "array_position": _fixed(T.BIGINT),
     "array_max": lambda args: args[0].element

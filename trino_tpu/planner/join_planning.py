@@ -134,10 +134,123 @@ def eliminate_cross_joins(node: P.PlanNode, catalogs=None):
         return None
     for i, cs in single.items():
         sources[i] = P.FilterNode(sources[i], and_(*cs))
-    est = [estimate_rows(s, catalogs) for s in sources]
 
-    # greedy: largest relation is the probe spine; repeatedly join the
-    # smallest relation connected to the joined set
+    if len(sources) <= MAX_REORDERED_JOINS:
+        tree = _dp_join_order(sources, edges, catalogs)
+    else:
+        tree = _greedy_join_order(sources, edges, catalogs)
+    out: P.PlanNode = tree
+    if residual:
+        out = P.FilterNode(out, and_(*residual))
+    return out
+
+
+#: DP join-order enumeration bound (reference: SystemSessionProperties
+#: MAX_REORDERED_JOINS default 9 — beyond it ReorderJoins bails to the
+#: syntactic order; we bail to the greedy heuristic instead)
+MAX_REORDERED_JOINS = 9
+
+
+def _edge_selectivity(si: str, sj: str, stats_i, stats_j) -> float:
+    """1/max(ndv, ndv) per JoinStatsRule.calculateJoinSelectivity."""
+    ni = stats_i.col(si).ndv
+    nj = stats_j.col(sj).ndv
+    m = max(ni or 0.0, nj or 0.0)
+    if m:
+        return 1.0 / m
+    return 1.0 / max(stats_i.rows, stats_j.rows, 1.0)
+
+
+def _dp_join_order(sources, edges, catalogs):
+    """Bushy-tree DP over connected sub-plans, minimizing C_out (sum of
+    intermediate result rows).  Reference role: iterative/rule/ReorderJoins
+    (JoinEnumerator.chooseJoinOrder over set partitions, pruned by
+    CostComparator) — same search space, simpler additive cost.
+
+    Orientation: bigger side left (streamed probe), smaller side right
+    (materialized build) — matching the TPU hash-join operator, which fully
+    materializes its right input in HBM."""
+    from trino_tpu.planner.stats import compute_stats
+
+    n = len(sources)
+    base = [compute_stats(s, catalogs) for s in sources]
+    # rows per subset computed from base rows x crossing-edge selectivities
+    edge_by_pair: dict = {}
+    for (i, si, j, sj) in edges:
+        sel = _edge_selectivity(si.name, sj.name, base[i], base[j])
+        edge_by_pair.setdefault(frozenset((i, j)), []).append(sel)
+
+    def subset_rows(mask: int) -> float:
+        rows = 1.0
+        mem = []
+        for k in range(n):
+            if mask >> k & 1:
+                rows *= max(base[k].rows, 1.0)
+                mem.append(k)
+        for a_i in range(len(mem)):
+            for b_i in range(a_i + 1, len(mem)):
+                sels = edge_by_pair.get(frozenset((mem[a_i], mem[b_i])))
+                if sels:
+                    # dampen clauses beyond the first (correlated keys)
+                    for x, s in enumerate(sorted(sels)):
+                        rows *= s ** (1.0 if x == 0 else 0.5 ** x)
+        return max(rows, 1.0)
+
+    rows_of = {1 << k: max(base[k].rows, 1.0) for k in range(n)}
+    # best[mask] = (cost, tree)
+    best: dict = {1 << k: (0.0, sources[k]) for k in range(n)}
+
+    def crossing_criteria(amask: int, bmask: int):
+        crit = []
+        for (i, si, j, sj) in edges:
+            if (amask >> i & 1) and (bmask >> j & 1):
+                crit.append((si, sj))
+            elif (amask >> j & 1) and (bmask >> i & 1):
+                crit.append((sj, si))
+        return crit
+
+    full = (1 << n) - 1
+    for mask in range(3, full + 1):
+        if mask & (mask - 1) == 0:  # singleton
+            continue
+        rows = subset_rows(mask)
+        rows_of[mask] = rows
+        best_here = None
+        # enumerate proper sub-splits (canonical: a contains lowest bit)
+        low = mask & -mask
+        sub = (mask - 1) & mask
+        while sub:
+            a = sub
+            b = mask ^ a
+            if a & low and a in best and b in best:
+                crit = crossing_criteria(a, b)
+                # only consider connected splits unless nothing connects
+                ca, ta = best[a]
+                cb, tb = best[b]
+                penalty = 0.0 if crit else rows_of[a] * rows_of[b]
+                # probe = bigger side stays left
+                if rows_of[a] >= rows_of[b]:
+                    lm, rm = a, b
+                else:
+                    lm, rm = b, a
+                    crit = [(sj, si) for si, sj in crit]
+                cost = ca + cb + rows + 0.3 * rows_of[rm] + penalty
+                if best_here is None or cost < best_here[0]:
+                    kind = "inner" if crit else "cross"
+                    best_here = (
+                        cost,
+                        P.JoinNode(kind, best[lm][1], best[rm][1], crit),
+                    )
+            sub = (sub - 1) & mask
+        if best_here is not None:
+            best[mask] = best_here
+    return best[full][1]
+
+
+def _greedy_join_order(sources, edges, catalogs):
+    """Fallback beyond the DP bound: largest relation is the probe spine;
+    repeatedly join the smallest relation connected to the joined set."""
+    est = [estimate_rows(s, catalogs) for s in sources]
     start = max(range(len(sources)), key=est.__getitem__)
     joined = {start}
     tree = sources[start]
@@ -170,12 +283,8 @@ def eliminate_cross_joins(node: P.PlanNode, catalogs=None):
         else:
             tree = P.JoinNode("cross", tree, sources[cand], [])
         joined.add(cand)
-    # every edge is consumed when its second endpoint joins the tree
     assert not pending, f"unconsumed join edges: {pending}"
-    out: P.PlanNode = tree
-    if residual:
-        out = P.FilterNode(out, and_(*residual))
-    return out
+    return tree
 
 
 def push_filter_through_semijoin(node: P.PlanNode):

@@ -36,6 +36,10 @@ _DESCRIPTIONS = {
     "json_extract_scalar": "JSON scalar at a JSONPath as varchar",
     "length": "string length",
     "lower": "lowercase",
+    "map": "map from a key array and a value array",
+    "map_keys": "keys of a map as an array",
+    "map_values": "values of a map as an array",
+    "map_concat": "union of maps (later maps win on duplicate keys)",
     "max": "maximum",
     "min": "minimum",
     "regexp_like": "true if the string matches the regex",

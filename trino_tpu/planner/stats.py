@@ -1,67 +1,352 @@
-"""Cardinality estimation for optimizer decisions.
+"""Cost-based cardinality estimation.
 
-Reference role: cost/ (FilterStatsCalculator.java, JoinStatsRule.java) — here
-reduced to the row-count heuristics the join-order and build-side choices
-need.  Connector-provided table statistics anchor the estimates (the tpch
-connector knows exact row counts, mirroring plugin/trino-tpch/.../statistics).
+Reference role: core/trino-main/.../cost/ — StatsCalculator composed of
+per-node rules (TableScanStatsRule, FilterStatsCalculator.java,
+JoinStatsRule.java, AggregationStatsRule, UnionStatsRule ...), producing
+PlanNodeStatsEstimate {outputRowCount, per-symbol SymbolStatsEstimate
+{lowValue, highValue, nullsFraction, distinctValuesCount}}.
+
+This is the same design, shrunk to the statistics the TPU engine's decisions
+consume: join ordering (join_planning.py), join distribution + build-side
+choice (fragmenter.py), and SHOW STATS.  Estimates flow bottom-up:
+
+  * TableScan   -> connector TableStatistics (row count + column stats);
+  * Filter      -> per-conjunct selectivity from column ndv/min-max/null
+                   fraction (FilterStatsCalculator semantics: equality =
+                   1/ndv, range = overlap fraction, IN = n/ndv, OR =
+                   inclusion-exclusion, AND = product);
+  * Join        -> l*r / max(ndv_left_key, ndv_right_key) per equi clause
+                   (JoinStatsRule.calculateJoinSelectivity);
+  * Aggregation -> min(rows, product of group-key ndv) groups.
+
+Unknown stats degrade to the documented heuristic constants rather than
+poisoning the whole subtree (Trino's UNKNOWN_FILTER_COEFFICIENT analog).
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from trino_tpu.expr.ir import Call, Expr, Form, Literal, SpecialForm, SymbolRef
 from trino_tpu.planner import plan as P
 
+#: selectivity of a conjunct nothing could be derived for
+#: (reference: FilterStatsCalculator.UNKNOWN_FILTER_COEFFICIENT = 0.9 —
+#: we keep the historical 0.25 which benchmarks better for deep TPC-DS
+#: trees where residuals are usually genuinely selective)
 FILTER_SELECTIVITY = 0.25
+#: fallback group-count ratio when group-key ndv is unknown
 AGG_GROUP_RATIO = 0.1
 
 
-def estimate_rows(node: P.PlanNode, catalogs=None) -> float:
-    if isinstance(node, P.TableScanNode):
-        rows = _scan_rows(node, catalogs)
-        if node.pushed_predicate is not None:
-            rows *= FILTER_SELECTIVITY
-        return rows
-    if isinstance(node, P.FilterNode):
-        return FILTER_SELECTIVITY * estimate_rows(node.source, catalogs)
-    if isinstance(node, P.ProjectNode):
-        return estimate_rows(node.source, catalogs)
-    if isinstance(node, P.AggregationNode):
-        if not node.group_symbols:
-            return 1.0
-        return max(1.0, AGG_GROUP_RATIO * estimate_rows(node.source, catalogs))
-    if isinstance(node, P.JoinNode):
-        l = estimate_rows(node.left, catalogs)
-        r = estimate_rows(node.right, catalogs)
-        if node.kind == "cross":
-            return l * r
-        if node.criteria:
-            # equi join: assume FK-PK-ish — output near the larger input
-            return max(l, r)
-        return l * r * FILTER_SELECTIVITY
-    if isinstance(node, P.SemiJoinNode):
-        return estimate_rows(node.source, catalogs)
-    if isinstance(node, (P.LimitNode, P.TopNNode)):
-        return min(node.count, estimate_rows(node.source, catalogs))
-    if isinstance(node, P.ValuesNode):
-        return float(len(node.rows))
-    if isinstance(node, P.UnionNode):
-        return sum(estimate_rows(s, catalogs) for s in node.sources)
-    if isinstance(node, P.EnforceSingleRowNode):
-        return 1.0
-    kids = node.children
-    if kids:
-        return estimate_rows(kids[0], catalogs)
-    return 1000.0
+@dataclass(frozen=True)
+class ColStats:
+    """Per-symbol statistics (reference: cost/SymbolStatsEstimate.java)."""
+
+    ndv: Optional[float] = None
+    low: Optional[float] = None  # numeric-comparable (dates = day numbers)
+    high: Optional[float] = None
+    null_fraction: float = 0.0
+
+    def scaled(self, sel: float) -> "ColStats":
+        """Shrink ndv for a row-count reduction by `sel` (distinct values
+        survive per the birthday-problem cap Trino also applies: ndv can't
+        exceed the new row count, handled by the caller)."""
+        if self.ndv is None:
+            return self
+        return replace(self, ndv=max(1.0, self.ndv * min(1.0, sel * 2.0)))
 
 
-def _scan_rows(node: P.TableScanNode, catalogs) -> float:
+@dataclass
+class PlanStats:
+    """reference: cost/PlanNodeStatsEstimate.java."""
+
+    rows: float
+    columns: dict = field(default_factory=dict)  # name -> ColStats
+
+    def col(self, name: str) -> ColStats:
+        return self.columns.get(name, ColStats())
+
+
+def _as_num(v) -> Optional[float]:
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, float)):
+        return float(v)
+    try:  # Decimal
+        return float(v)
+    except Exception:
+        return None
+
+
+def _range_fraction(cs: ColStats, lo: Optional[float], hi: Optional[float]) -> Optional[float]:
+    """Fraction of [cs.low, cs.high] overlapped by [lo, hi]."""
+    if cs.low is None or cs.high is None:
+        return None
+    width = cs.high - cs.low
+    if width <= 0:
+        # single-valued column: either it's in the range or not
+        v = cs.low
+        ok = (lo is None or v >= lo) and (hi is None or v <= hi)
+        return 1.0 if ok else 1.0 / max(cs.ndv or 1.0, 1.0)
+    a = cs.low if lo is None else max(cs.low, lo)
+    b = cs.high if hi is None else min(cs.high, hi)
+    if b < a:
+        return 0.05  # out-of-range: keep a floor, stats may be stale
+    return max(0.0, min(1.0, (b - a) / width))
+
+
+def _conjunct_selectivity(c: Expr, stats: PlanStats):
+    """-> (selectivity, {symbol: ColStats update}) for one conjunct.
+    Mirrors FilterStatsCalculator's per-expression estimate methods."""
+    # NOT e
+    if isinstance(c, SpecialForm) and c.form == Form.NOT:
+        s, _ = _conjunct_selectivity(c.args[0], stats)
+        return max(0.0, 1.0 - s), {}
+    # a OR b: inclusion-exclusion
+    if isinstance(c, SpecialForm) and c.form == Form.OR:
+        sel = 0.0
+        prod = 1.0
+        for a in c.args:
+            s, _ = _conjunct_selectivity(a, stats)
+            prod *= 1.0 - s
+        sel = 1.0 - prod
+        return min(1.0, sel), {}
+    if isinstance(c, SpecialForm) and c.form == Form.AND:
+        sel = 1.0
+        upd: dict = {}
+        for a in c.args:
+            s, u = _conjunct_selectivity(a, stats)
+            sel *= s
+            upd.update(u)
+        return sel, upd
+    # IS NULL / IS NOT NULL
+    if isinstance(c, SpecialForm) and c.form == Form.IS_NULL:
+        v = c.args[0]
+        if isinstance(v, SymbolRef):
+            return stats.col(v.name).null_fraction or 0.05, {}
+        return 0.05, {}
+    # v IN (a, b, ...)
+    if isinstance(c, SpecialForm) and c.form == Form.IN:
+        v = c.args[0]
+        items = c.args[1:]
+        if isinstance(v, SymbolRef) and all(isinstance(i, Literal) for i in items):
+            cs = stats.col(v.name)
+            if cs.ndv:
+                n = len({i.value for i in items})
+                return min(1.0, n / cs.ndv), {v.name: replace(cs, ndv=float(n))}
+        return min(1.0, 0.25 * max(1, len(items)) ** 0.5), {}
+    # v BETWEEN lo AND hi
+    if isinstance(c, SpecialForm) and c.form == Form.BETWEEN:
+        v, lo, hi = c.args
+        if (
+            isinstance(v, SymbolRef)
+            and isinstance(lo, Literal)
+            and isinstance(hi, Literal)
+        ):
+            cs = stats.col(v.name)
+            a, b = _as_num(lo.value), _as_num(hi.value)
+            f = _range_fraction(cs, a, b)
+            if f is not None:
+                upd = replace(cs, low=a, high=b).scaled(f)
+                return f, {v.name: upd}
+        return FILTER_SELECTIVITY, {}
+    if isinstance(c, Call) and len(c.args) == 2:
+        a, b = c.args
+        # normalize literal-on-left
+        flip = {"$lt": "$gt", "$le": "$ge", "$gt": "$lt", "$ge": "$le",
+                "$eq": "$eq", "$ne": "$ne"}
+        if isinstance(a, Literal) and isinstance(b, SymbolRef) and c.name in flip:
+            a, b = b, a
+            name = flip[c.name]
+        else:
+            name = c.name
+        if isinstance(a, SymbolRef) and isinstance(b, Literal):
+            cs = stats.col(a.name)
+            v = _as_num(b.value)
+            if name == "$eq":
+                if cs.ndv:
+                    sel = 1.0 / cs.ndv
+                    return sel, {a.name: ColStats(1.0, v, v, 0.0)}
+                return FILTER_SELECTIVITY * 0.2, {}
+            if name == "$ne":
+                if cs.ndv:
+                    return 1.0 - 1.0 / cs.ndv, {}
+                return 0.9, {}
+            if name in ("$lt", "$le") and v is not None:
+                f = _range_fraction(cs, None, v)
+                if f is not None:
+                    return f, {a.name: replace(cs, high=v).scaled(f)}
+            if name in ("$gt", "$ge") and v is not None:
+                f = _range_fraction(cs, v, None)
+                if f is not None:
+                    return f, {a.name: replace(cs, low=v).scaled(f)}
+            return FILTER_SELECTIVITY, {}
+        if isinstance(a, SymbolRef) and isinstance(b, SymbolRef) and name == "$eq":
+            # same-relation column equality: 1/max ndv
+            n1, n2 = stats.col(a.name).ndv, stats.col(b.name).ndv
+            m = max(n1 or 0.0, n2 or 0.0)
+            return (1.0 / m if m else FILTER_SELECTIVITY), {}
+    return FILTER_SELECTIVITY, {}
+
+
+def filter_stats(stats: PlanStats, predicate: Expr) -> PlanStats:
+    """reference: cost/FilterStatsCalculator.filterStats."""
+    from trino_tpu.planner.join_planning import split_conjuncts_ir
+
+    sel = 1.0
+    cols = dict(stats.columns)
+    for c in split_conjuncts_ir(predicate):
+        s, upd = _conjunct_selectivity(c, stats)
+        sel *= max(s, 1e-9)
+        cols.update(upd)
+    rows = max(1.0, stats.rows * min(1.0, sel))
+    # cap every ndv at the new row count
+    cols = {
+        k: (replace(v, ndv=min(v.ndv, rows)) if v.ndv else v)
+        for k, v in cols.items()
+    }
+    return PlanStats(rows, cols)
+
+
+def _scan_stats(node: P.TableScanNode, catalogs) -> PlanStats:
+    rows = 10000.0
+    colstats: dict = {}
     if catalogs is not None:
         try:
             conn = catalogs.get(node.handle.catalog)
-            stats = conn.metadata().table_statistics(
-                node.handle.schema, node.handle.table
-            )
-            if stats is not None and stats.row_count is not None:
-                return float(stats.row_count)
+            ts = conn.metadata().table_statistics(node.handle.schema, node.handle.table)
+            if ts is not None and ts.row_count is not None:
+                rows = float(ts.row_count)
+            if ts is not None:
+                for sym, col in node.assignments:
+                    c = ts.columns.get(col)
+                    if c is not None:
+                        colstats[sym.name] = ColStats(
+                            ndv=(float(c.distinct_count) if c.distinct_count else None),
+                            low=_as_num(c.low),
+                            high=_as_num(c.high),
+                            null_fraction=c.null_fraction or 0.0,
+                        )
         except Exception:
             pass
-    return 10000.0
+    st = PlanStats(rows, colstats)
+    if node.pushed_predicate is not None:
+        st = filter_stats(st, node.pushed_predicate)
+    return st
+
+
+def compute_stats(node: P.PlanNode, catalogs=None, _cache=None) -> PlanStats:
+    """Bottom-up stats derivation (reference: cost/ComposableStatsCalculator:
+    one rule per node type, cached per plan node)."""
+    if _cache is None:
+        _cache = {}
+    key = id(node)
+    hit = _cache.get(key)
+    if hit is not None:
+        return hit
+    st = _compute(node, catalogs, _cache)
+    _cache[key] = st
+    return st
+
+
+def _compute(node, catalogs, cache) -> PlanStats:
+    if isinstance(node, P.TableScanNode):
+        return _scan_stats(node, catalogs)
+    if isinstance(node, P.FilterNode):
+        return filter_stats(compute_stats(node.source, catalogs, cache), node.predicate)
+    if isinstance(node, P.ProjectNode):
+        src = compute_stats(node.source, catalogs, cache)
+        cols = {}
+        for sym, e in node.assignments:
+            if isinstance(e, SymbolRef):
+                cols[sym.name] = src.col(e.name)
+        return PlanStats(src.rows, cols)
+    if isinstance(node, P.AggregationNode):
+        src = compute_stats(node.source, catalogs, cache)
+        if not node.group_symbols:
+            return PlanStats(1.0, {})
+        groups = 1.0
+        known = True
+        cols = {}
+        for g in node.group_symbols:
+            cs = src.col(g.name)
+            if cs.ndv:
+                groups *= cs.ndv
+            else:
+                known = False
+            cols[g.name] = cs
+        if known:
+            rows = max(1.0, min(src.rows, groups))
+        else:
+            rows = max(1.0, AGG_GROUP_RATIO * src.rows)
+        cols = {
+            k: (replace(v, ndv=min(v.ndv, rows)) if v.ndv else v)
+            for k, v in cols.items()
+        }
+        return PlanStats(rows, cols)
+    if isinstance(node, P.JoinNode):
+        l = compute_stats(node.left, catalogs, cache)
+        r = compute_stats(node.right, catalogs, cache)
+        cols = dict(l.columns)
+        cols.update(r.columns)
+        if node.kind == "cross" and not node.criteria:
+            return PlanStats(l.rows * r.rows, cols)
+        if node.criteria:
+            # reference: JoinStatsRule.calculateJoinSelectivity — per equi
+            # clause sel = 1/max(ndv_l, ndv_r); clauses beyond the first are
+            # dampened (PlanNodeStatsEstimateMath.UNKNOWN_FILTER dampening)
+            rows = l.rows * r.rows
+            sels = []
+            for lk, rk in node.criteria:
+                nl = l.col(lk.name).ndv
+                nr = r.col(rk.name).ndv
+                m = max(nl or 0.0, nr or 0.0)
+                if m:
+                    sels.append(1.0 / m)
+                else:
+                    sels.append(1.0 / max(l.rows, r.rows, 1.0))
+            sels.sort()
+            damp = 1.0
+            for i, s in enumerate(sels):
+                rows *= s ** (damp if i == 0 else 0.5 ** i)
+            rows = max(1.0, rows)
+            if node.filter is not None:
+                rows = max(1.0, rows * FILTER_SELECTIVITY)
+            if node.kind in ("left", "full"):
+                rows = max(rows, l.rows)
+            if node.kind in ("right", "full"):
+                rows = max(rows, r.rows)
+            cols = {
+                k: (replace(v, ndv=min(v.ndv, rows)) if v.ndv else v)
+                for k, v in cols.items()
+            }
+            return PlanStats(rows, cols)
+        # non-equi join
+        rows = max(1.0, l.rows * r.rows * FILTER_SELECTIVITY)
+        return PlanStats(rows, cols)
+    if isinstance(node, P.SemiJoinNode):
+        src = compute_stats(node.source, catalogs, cache)
+        return PlanStats(src.rows, dict(src.columns))
+    if isinstance(node, (P.LimitNode, P.TopNNode)):
+        src = compute_stats(node.source, catalogs, cache)
+        return PlanStats(min(float(node.count), src.rows), dict(src.columns))
+    if isinstance(node, P.ValuesNode):
+        return PlanStats(float(len(node.rows)), {})
+    if isinstance(node, P.UnionNode):
+        return PlanStats(
+            sum(compute_stats(s, catalogs, cache).rows for s in node.sources), {}
+        )
+    if isinstance(node, P.EnforceSingleRowNode):
+        return PlanStats(1.0, {})
+    kids = node.children
+    if kids:
+        src = compute_stats(kids[0], catalogs, cache)
+        return PlanStats(src.rows, dict(src.columns))
+    return PlanStats(1000.0, {})
+
+
+def estimate_rows(node: P.PlanNode, catalogs=None) -> float:
+    """Row-count-only view (what fragmenter's distribution choice reads)."""
+    return compute_stats(node, catalogs).rows

@@ -61,9 +61,13 @@ class LocalQueryRunner:
         self.transactions = TransactionManager(self.catalogs)
         # security (server/security/ + spi/security/SystemAccessControl):
         # identity set per statement by the coordinator/dbapi layer
-        from trino_tpu.server.security import AllowAllAccessControl
+        from trino_tpu.server.security import AllowAllAccessControl, GrantManager
 
         self.access_control = AllowAllAccessControl()
+        #: SQL-standard grants/roles store consulted by GRANT/REVOKE DDL and
+        #: by SqlStandardAccessControl when installed (reference:
+        #: MetadataManager.grantTablePrivileges)
+        self.grants = GrantManager()
         self.user = "user"
         self._query_ids = __import__("itertools").count(1)
         # system.runtime observability (connector/system/ role): query
@@ -310,6 +314,57 @@ class LocalQueryRunner:
                 rows,
                 [T.VARCHAR, T.VARCHAR, T.VARCHAR, T.VARCHAR, T.BOOLEAN, T.VARCHAR],
             )
+        if stmt.what == "roles":
+            return MaterializedResult(
+                ["Role"], [(r,) for r in self.grants.list_roles()], [T.VARCHAR]
+            )
+        if stmt.what == "grants":
+            if stmt.target:
+                cat, schema, table = self._resolve_table(stmt.target)
+                rows = self.grants.grants_for(cat, schema, table)
+            else:
+                rows = self.grants.grants_for()
+            return MaterializedResult(
+                ["grantee", "privilege", "catalog", "schema", "table"],
+                rows,
+                [T.VARCHAR] * 5,
+            )
+        if stmt.what == "stats":
+            # reference: sql/rewrite/ShowStatsRewrite.java — one row per
+            # column plus a NULL-named summary row carrying row_count
+            cat, schema, table = self._resolve_table(stmt.target)
+            md = self.catalogs.get(cat).metadata()
+            meta = md.table_metadata(schema, table)
+            ts = md.table_statistics(schema, table)
+            rows = []
+            for c in meta.columns:
+                cs = ts.columns.get(c.name)
+                rows.append(
+                    (
+                        c.name,
+                        None,
+                        float(cs.distinct_count) if cs and cs.distinct_count else None,
+                        float(cs.null_fraction) if cs else None,
+                        None,
+                        str(cs.low) if cs and cs.low is not None else None,
+                        str(cs.high) if cs and cs.high is not None else None,
+                    )
+                )
+            rows.append(
+                (
+                    None, None, None, None,
+                    float(ts.row_count) if ts.row_count is not None else None,
+                    None, None,
+                )
+            )
+            return MaterializedResult(
+                [
+                    "column_name", "data_size", "distinct_values_count",
+                    "nulls_fraction", "row_count", "low_value", "high_value",
+                ],
+                rows,
+                [T.VARCHAR, T.DOUBLE, T.DOUBLE, T.DOUBLE, T.DOUBLE, T.VARCHAR, T.VARCHAR],
+            )
         if stmt.what == "session":
             rows = [
                 (name, str(value), meta.type.__name__, meta.description)
@@ -344,6 +399,7 @@ class LocalQueryRunner:
         self.access_control.check_can_write(self.user, cat, schema, table)
         self.transactions.notify_write(cat, schema, table)
         conn.create_table(schema, table, cols)
+        self.grants.set_owner(cat, schema, table, self.user)
         return _ok("CREATE TABLE")
 
     def _exec_CreateTableAs(self, stmt: ast.CreateTableAs) -> MaterializedResult:
@@ -360,6 +416,7 @@ class LocalQueryRunner:
         self.access_control.check_can_write(self.user, cat, schema, table)
         self.transactions.notify_write(cat, schema, table)
         conn.create_table(schema, table, cols)
+        self.grants.set_owner(cat, schema, table, self.user)
         self._write_rows(conn, TableHandle(cat, schema, table), result)
         return MaterializedResult(["rows"], [(result.row_count,)], [])
 
@@ -437,6 +494,31 @@ class LocalQueryRunner:
         self.prepared.pop(stmt.name, None)
         return _ok("DEALLOCATE")
 
+    def _exec_GrantStatement(self, stmt: ast.GrantStatement) -> MaterializedResult:
+        if stmt.roles:
+            for r in stmt.roles:
+                self.grants.grant_role(r, stmt.grantee)
+            return _ok("GRANT ROLE")
+        cat, schema, table = self._resolve_table(stmt.name)
+        self.grants.grant(stmt.grantee, stmt.privileges, cat, schema, table)
+        return _ok("GRANT")
+
+    def _exec_RevokeStatement(self, stmt: ast.RevokeStatement) -> MaterializedResult:
+        if stmt.roles:
+            for r in stmt.roles:
+                self.grants.revoke_role(r, stmt.grantee)
+            return _ok("REVOKE ROLE")
+        cat, schema, table = self._resolve_table(stmt.name)
+        self.grants.revoke(stmt.grantee, stmt.privileges, cat, schema, table)
+        return _ok("REVOKE")
+
+    def _exec_RoleStatement(self, stmt: ast.RoleStatement) -> MaterializedResult:
+        if stmt.action == "create":
+            self.grants.create_role(stmt.role)
+            return _ok("CREATE ROLE")
+        self.grants.drop_role(stmt.role)
+        return _ok("DROP ROLE")
+
     def _exec_DeleteStatement(self, stmt: ast.DeleteStatement) -> MaterializedResult:
         """DELETE = filtered table rewrite (reference roles: sql/tree/Delete
         .java + plan/TableDeleteNode.java; connector-pushdown deletes become
@@ -497,6 +579,194 @@ class LocalQueryRunner:
             if snap_fn is not None:
                 conn.restore_table(schema, table, snap)
             raise
+
+    def _exec_MergeStatement(self, stmt: ast.MergeStatement) -> MaterializedResult:
+        """MERGE = three rewrite queries stitched host-side (reference roles:
+        sql/tree/Merge.java + planner MergeWriterNode + connector merge
+        sinks):
+
+          1. target LEFT-correlated: matched rows run the first WHEN MATCHED
+             clause that fires (UPDATE projects new values, DELETE drops);
+          2. target rows with no source match are kept verbatim;
+          3. WHEN NOT MATCHED INSERT rows come from source rows with no
+             target match.
+
+        First-match-wins across clauses is a nested IF chain, exactly the
+        searched-CASE the reference plans.  A source row matching multiple
+        target rows follows join semantics (the reference raises; detecting
+        that would need a count aggregation per target key)."""
+        cat, schema, table = self._resolve_table(stmt.target)
+        conn = self.catalogs.get(cat)
+        if not conn.supports_writes():
+            raise NotImplementedError(f"connector {cat} does not support MERGE")
+        meta = conn.metadata().table_metadata(schema, table)
+        self.access_control.check_can_update(self.user, cat, schema, table)
+        self.access_control.check_can_write(self.user, cat, schema, table)
+        ta = stmt.target_alias or table
+        tgt_rel: ast.Node = ast.AliasedRelation(
+            ast.TableRef((cat, schema, table)), ta
+        )
+        if isinstance(stmt.source, ast.Query):
+            src_rel: ast.Node = ast.SubqueryRelation(stmt.source)
+        else:
+            src_rel = stmt.source
+        if stmt.source_alias:
+            src_rel = ast.AliasedRelation(src_rel, stmt.source_alias)
+
+        def chain(cases, leaf_fn, else_expr):
+            """First-match-wins nested IF over WHEN clauses."""
+            out = else_expr
+            for c in reversed(cases):
+                cond = c.condition if c.condition is not None else ast.BooleanLiteral(True)
+                out = ast.FunctionCall("if", (cond, leaf_fn(c), out))
+            return out
+
+        matched_cases = [c for c in stmt.cases if c.matched]
+        insert_cases = [c for c in stmt.cases if not c.matched]
+
+        # -- part 1: matched target rows through the WHEN MATCHED chain ------
+        matched_rows: list = []
+        n_matched_actioned = 0
+        if matched_cases:
+            items = []
+            for col in meta.columns:
+                ref = ast.Identifier((ta, col.name))
+
+                def leaf(c, col=col, ref=ref):
+                    if c.action == "delete":
+                        return ast.CastExpr(ast.NullLiteral(), col.type.name)
+                    assigns = dict(c.assignments)
+                    if col.name in assigns:
+                        return ast.CastExpr(assigns[col.name], col.type.name)
+                    return ref
+
+                items.append(ast.SelectItem(chain(matched_cases, leaf, ref), alias=col.name))
+            # __keep: FALSE when the first firing clause is DELETE;
+            # __hit: TRUE when any clause fired (for the affected-row count)
+            items.append(
+                ast.SelectItem(
+                    chain(
+                        matched_cases,
+                        lambda c: ast.BooleanLiteral(c.action != "delete"),
+                        ast.BooleanLiteral(True),
+                    ),
+                    alias="__keep",
+                )
+            )
+            items.append(
+                ast.SelectItem(
+                    chain(
+                        matched_cases,
+                        lambda c: ast.BooleanLiteral(True),
+                        ast.BooleanLiteral(False),
+                    ),
+                    alias="__hit",
+                )
+            )
+            join = ast.Join("inner", tgt_rel, src_rel, stmt.on)
+            res = self._run_query(
+                ast.Query(ast.QuerySpec(tuple(items), join, None, (), None))
+            )
+            for r in res.rows:
+                keep, hit = r[-2], r[-1]
+                if hit:
+                    n_matched_actioned += 1
+                if keep:
+                    matched_rows.append(tuple(r[:-2]))
+        else:
+            # no matched clauses: matched target rows stay unchanged; fold
+            # them into part 2 by keeping ALL target rows there instead
+            pass
+
+        # -- part 2: target rows without any source match ---------------------
+        exists_q = ast.Query(
+            ast.QuerySpec(
+                (ast.SelectItem(ast.NumberLiteral("1")),),
+                src_rel,
+                stmt.on,
+                (),
+                None,
+            )
+        )
+        not_matched_where = (
+            ast.UnaryOp("not", ast.Exists(exists_q)) if matched_cases else None
+        )
+        kept = self._run_query(
+            ast.Query(
+                ast.QuerySpec(
+                    (ast.Star(),), tgt_rel, not_matched_where, (), None
+                )
+            )
+        )
+
+        # -- part 3: WHEN NOT MATCHED inserts ---------------------------------
+        insert_rows: list = []
+        if insert_cases:
+            tgt_exists = ast.Query(
+                ast.QuerySpec(
+                    (ast.SelectItem(ast.NumberLiteral("1")),),
+                    tgt_rel,
+                    stmt.on,
+                    (),
+                    None,
+                )
+            )
+            items = []
+            for col in meta.columns:
+
+                def leaf_ins(c, col=col):
+                    cols = list(c.columns) or [m.name for m in meta.columns]
+                    if col.name in cols:
+                        v = c.assignments[cols.index(col.name)]
+                        return ast.CastExpr(v, col.type.name)
+                    return ast.CastExpr(ast.NullLiteral(), col.type.name)
+
+                items.append(
+                    ast.SelectItem(
+                        chain(
+                            insert_cases,
+                            leaf_ins,
+                            ast.CastExpr(ast.NullLiteral(), col.type.name),
+                        ),
+                        alias=col.name,
+                    )
+                )
+            items.append(
+                ast.SelectItem(
+                    chain(
+                        insert_cases,
+                        lambda c: ast.BooleanLiteral(True),
+                        ast.BooleanLiteral(False),
+                    ),
+                    alias="__hit",
+                )
+            )
+            res = self._run_query(
+                ast.Query(
+                    ast.QuerySpec(
+                        tuple(items),
+                        src_rel,
+                        ast.UnaryOp("not", ast.Exists(tgt_exists)),
+                        (),
+                        None,
+                    )
+                )
+            )
+            for r in res.rows:
+                if r[-1]:
+                    insert_rows.append(tuple(r[:-1]))
+
+        all_rows = matched_rows + list(kept.rows) + insert_rows
+        combined = MaterializedResult(
+            [c.name for c in meta.columns],
+            all_rows,
+            [c.type for c in meta.columns],
+        )
+        self.transactions.notify_write(cat, schema, table)
+        self._rewrite_table(conn, cat, schema, table, meta, combined)
+        return MaterializedResult(
+            ["rows"], [(n_matched_actioned + len(insert_rows),)], []
+        )
 
     def _exec_UpdateStatement(self, stmt: ast.UpdateStatement) -> MaterializedResult:
         """UPDATE = per-column conditional rewrite (reference:

@@ -142,6 +142,144 @@ class AllowAllAccessControl(AccessControl):
     pass
 
 
+class GrantManager:
+    """SQL-standard grants + roles store (reference roles:
+    spi/security/Privilege.java, MetadataManager.grantTablePrivileges, and
+    plugin/trino-hive SqlStandardAccessControl's grant model).
+
+    Grants are keyed by principal (user or role); role membership is
+    transitive (roles may be granted to roles)."""
+
+    PRIVILEGES = ("SELECT", "INSERT", "UPDATE", "DELETE", "OWNERSHIP")
+
+    def __init__(self):
+        #: (principal, catalog, schema, table) -> set of privileges
+        self._grants: dict[tuple, set] = {}
+        #: role -> set of member principals (users or roles)
+        self._roles: dict[str, set] = {}
+        #: (catalog, schema, table) -> owner user
+        self._owners: dict[tuple, str] = {}
+
+    # -- roles ---------------------------------------------------------------
+
+    def create_role(self, role: str) -> None:
+        if role in self._roles:
+            raise ValueError(f"role {role!r} already exists")
+        self._roles[role] = set()
+
+    def drop_role(self, role: str) -> None:
+        if role not in self._roles:
+            raise ValueError(f"role {role!r} does not exist")
+        del self._roles[role]
+        for members in self._roles.values():
+            members.discard(role)
+
+    def list_roles(self) -> list:
+        return sorted(self._roles)
+
+    def grant_role(self, role: str, principal: str) -> None:
+        if role not in self._roles:
+            raise ValueError(f"role {role!r} does not exist")
+        self._roles[role].add(principal)
+
+    def revoke_role(self, role: str, principal: str) -> None:
+        if role not in self._roles:
+            raise ValueError(f"role {role!r} does not exist")
+        self._roles[role].discard(principal)
+
+    def principals_of(self, user: str) -> set:
+        """user + every role reachable through membership (transitive)."""
+        out = {user}
+        changed = True
+        while changed:
+            changed = False
+            for role, members in self._roles.items():
+                if role not in out and members & out:
+                    out.add(role)
+                    changed = True
+        return out
+
+    # -- privileges ----------------------------------------------------------
+
+    def grant(self, principal, privileges, catalog, schema, table) -> None:
+        key = (principal, catalog, schema, table)
+        st = self._grants.setdefault(key, set())
+        for p in privileges:
+            p = p.upper()
+            if p not in self.PRIVILEGES and p != "ALL":
+                raise ValueError(f"unknown privilege {p}")
+            if p == "ALL":
+                st.update(self.PRIVILEGES)
+            else:
+                st.add(p)
+
+    def revoke(self, principal, privileges, catalog, schema, table) -> None:
+        key = (principal, catalog, schema, table)
+        st = self._grants.get(key)
+        if st is None:
+            return
+        for p in privileges:
+            p = p.upper()
+            if p == "ALL":
+                st.clear()
+            else:
+                st.discard(p)
+        if not st:
+            del self._grants[key]
+
+    def set_owner(self, catalog, schema, table, user) -> None:
+        self._owners[(catalog, schema, table)] = user
+
+    def has_privilege(self, user, priv, catalog, schema, table) -> bool:
+        if self._owners.get((catalog, schema, table)) == user:
+            return True
+        principals = self.principals_of(user)
+        for p in principals:
+            st = self._grants.get((p, catalog, schema, table))
+            if st and (priv in st or "OWNERSHIP" in st):
+                return True
+        return False
+
+    def grants_for(self, catalog=None, schema=None, table=None) -> list:
+        """(grantee, privilege, catalog, schema, table) rows for SHOW GRANTS."""
+        out = []
+        for (p, c, s, t), privs in sorted(self._grants.items()):
+            if catalog is not None and (c, s, t) != (catalog, schema, table):
+                continue
+            for pr in sorted(privs):
+                out.append((p, pr, c, s, t))
+        return out
+
+
+class SqlStandardAccessControl(AccessControl):
+    """GRANT-driven enforcement (reference: trino-hive SqlStandardAccessControl
+    semantics: owner or granted privilege required; `admin` bypasses)."""
+
+    def __init__(self, grants: GrantManager, admin: str = "admin"):
+        self.grants = grants
+        self.admin = admin
+
+    def _check(self, priv, user, catalog, schema, table) -> None:
+        if user == self.admin:
+            return
+        if not self.grants.has_privilege(user, priv, catalog, schema, table):
+            raise AccessDeniedError(
+                f"user {user} lacks {priv} on {catalog}.{schema}.{table}"
+            )
+
+    def check_can_select(self, user, catalog, schema, table) -> None:
+        self._check("SELECT", user, catalog, schema, table)
+
+    def check_can_write(self, user, catalog, schema, table) -> None:
+        self._check("INSERT", user, catalog, schema, table)
+
+    def check_can_delete(self, user, catalog, schema, table) -> None:
+        self._check("DELETE", user, catalog, schema, table)
+
+    def check_can_update(self, user, catalog, schema, table) -> None:
+        self._check("UPDATE", user, catalog, schema, table)
+
+
 class RuleBasedAccessControl(AccessControl):
     """File-based access control semantics: first matching rule decides;
     no matching rule denies."""

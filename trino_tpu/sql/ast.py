@@ -420,6 +420,61 @@ class UpdateStatement(Node):
 
 
 @dataclass(frozen=True)
+class GrantStatement(Node):
+    """GRANT privs ON t TO principal / GRANT role TO USER u
+    (reference: sql/tree/Grant.java, sql/tree/GrantRoles.java)."""
+
+    privileges: tuple  # privilege names; empty => role grant
+    name: tuple = ()  # table name (privilege grant)
+    grantee: str = ""
+    grantee_is_role: bool = False
+    roles: tuple = ()  # role names (role grant)
+    grant_option: bool = False
+
+
+@dataclass(frozen=True)
+class RevokeStatement(Node):
+    """reference: sql/tree/Revoke.java, sql/tree/RevokeRoles.java."""
+
+    privileges: tuple
+    name: tuple = ()
+    grantee: str = ""
+    roles: tuple = ()
+
+
+@dataclass(frozen=True)
+class RoleStatement(Node):
+    """CREATE/DROP ROLE (reference: sql/tree/CreateRole.java, DropRole.java)."""
+
+    action: str  # create | drop
+    role: str = ""
+
+
+@dataclass(frozen=True)
+class MergeCase(Node):
+    """One WHEN clause (reference: sql/tree/MergeCase.java subclasses
+    MergeUpdate / MergeDelete / MergeInsert)."""
+
+    matched: bool
+    action: str  # update | delete | insert
+    condition: Optional[Node] = None  # AND <cond>
+    assignments: tuple = ()  # update: (col, expr); insert: exprs
+    columns: tuple = ()  # insert column list (may be empty = all)
+
+
+@dataclass(frozen=True)
+class MergeStatement(Node):
+    """MERGE INTO t USING s ON cond WHEN ... (reference: sql/tree/Merge.java)."""
+
+    target: tuple
+    target_alias: Optional[str]
+    source: Node  # TableRef | AliasedRelation | subquery Query
+    source_alias: Optional[str]
+    on: Node
+    cases: tuple  # of MergeCase
+
+
+@dataclass(frozen=True)
 class InsertStatement(Node):
     name: tuple
     query: Query

@@ -116,8 +116,16 @@ class Parser:
                     elif tk.kind == "op" and tk.value == ")":
                         depth -= 1
             return ast.ExplainStatement(self._statement(), analyze=analyze)
+        if t.is_kw("create") and self._peek_ident(1, "role"):
+            self.next()
+            self.next()
+            return ast.RoleStatement("create", self.ident())
         if t.is_kw("create"):
             return self._create()
+        if t.is_kw("drop") and self._peek_ident(1, "role"):
+            self.next()
+            self.next()
+            return ast.RoleStatement("drop", self.ident())
         if t.is_kw("drop"):
             self.next()
             nxt = self.peek()
@@ -159,6 +167,10 @@ class Parser:
             name = self.qualified_name()
             where = self._expr() if self.accept_kw("where") else None
             return ast.DeleteStatement(name, where)
+        if t.kind == "ident" and t.value.lower() == "merge":
+            return self._merge()
+        if t.kind == "ident" and t.value.lower() in ("grant", "revoke"):
+            return self._grant_revoke(t.value.lower())
         if t.is_kw("prepare"):
             self.next()
             pname = self.ident()
@@ -223,6 +235,17 @@ class Parser:
                 return ast.ShowStatement("functions", target)
             if what.is_kw("session"):
                 return ast.ShowStatement("session")
+            if what.kind == "ident" and what.value.lower() == "stats":
+                self.expect_kw("for")
+                return ast.ShowStatement("stats", self.qualified_name())
+            if what.kind == "ident" and what.value.lower() == "roles":
+                return ast.ShowStatement("roles")
+            if what.kind == "ident" and what.value.lower() == "grants":
+                target = ()
+                if self.accept_kw("on"):
+                    self.accept_kw("table")
+                    target = self.qualified_name()
+                return ast.ShowStatement("grants", target)
             raise ParseError("unsupported SHOW", what)
         if t.is_kw("describe"):
             self.next()
@@ -299,12 +322,156 @@ class Parser:
         self.expect_op(")")
         return ast.CreateTable(name, tuple(cols), if_not_exists)
 
+    def _peek_ident(self, k: int, word: str) -> bool:
+        t = self.peek(k)
+        return t.kind == "ident" and t.value.lower() == word
+
+    def _grant_revoke(self, kind: str) -> ast.Node:
+        """GRANT/REVOKE privileges ON [TABLE] t TO/FROM [USER|ROLE] p, or
+        GRANT/REVOKE role[, ...] TO/FROM USER u (reference: SqlBase.g4
+        grant/revoke rules + sql/tree/Grant.java, GrantRoles.java)."""
+        self.next()  # grant | revoke
+        # role grant: GRANT r1, r2 TO USER u  (first token not a privilege)
+        privset = {"select", "insert", "update", "delete", "all"}
+        first = self.peek()
+        is_priv = (
+            first.value.lower() in privset
+            if first.kind in ("ident", "keyword")
+            else False
+        )
+        names = []
+        if first.kind == "ident" and not is_priv:
+            names.append(self.ident())
+            while self.accept_op(","):
+                names.append(self.ident())
+            self.expect_kw("to" if kind == "grant" else "from")
+            if self._peek_ident(0, "user") and self.peek(1).kind == "ident":
+                self.next()
+            grantee = self.ident()
+            if kind == "grant":
+                return ast.GrantStatement((), (), grantee, roles=tuple(names))
+            return ast.RevokeStatement((), (), grantee, tuple(names))
+        privs = []
+        if self.accept_kw("all"):
+            # ALL [PRIVILEGES]
+            if self._peek_ident(0, "privileges"):
+                self.next()
+            privs.append("ALL")
+        else:
+            while True:
+                privs.append(self.next().value.upper())
+                if not self.accept_op(","):
+                    break
+        self.expect_kw("on")
+        self.accept_kw("table")
+        name = self.qualified_name()
+        self.expect_kw("to" if kind == "grant" else "from")
+        is_role = False
+        if self._peek_ident(0, "user") and self.peek(1).kind == "ident":
+            self.next()
+        elif self._peek_ident(0, "role") and self.peek(1).kind == "ident":
+            self.next()
+            is_role = True
+        grantee = self.ident()
+        grant_option = False
+        if kind == "grant" and self.accept_kw("with"):
+            self.next()  # GRANT
+            self.next()  # OPTION
+            grant_option = True
+        if kind == "grant":
+            return ast.GrantStatement(
+                tuple(privs), name, grantee, is_role, (), grant_option
+            )
+        return ast.RevokeStatement(tuple(privs), name, grantee)
+
+    def _merge(self) -> "ast.MergeStatement":
+        """MERGE INTO t [AS a] USING s [AS b] ON cond WHEN [NOT] MATCHED
+        [AND c] THEN UPDATE SET ... | DELETE | INSERT ...
+        (reference: SqlBase.g4 merge rule + sql/tree/Merge.java)."""
+        self.next()  # merge
+        self.expect_kw("into")
+        target = self.qualified_name()
+        target_alias = None
+        if self.accept_kw("as"):
+            target_alias = self.ident()
+        elif self.peek().kind == "ident" and not self.peek().is_kw("using"):
+            nxt = self.peek()
+            if nxt.value.lower() != "using":
+                target_alias = self.ident()
+        self.expect_kw("using")
+        if self.peek().kind == "op" and self.peek().value == "(":
+            self.next()
+            source: ast.Node = self._query()
+            self.expect_op(")")
+        else:
+            source = ast.TableRef(self.qualified_name())
+        source_alias = None
+        if self.accept_kw("as"):
+            source_alias = self.ident()
+        elif self.peek().kind == "ident" and self.peek(1).is_kw("on"):
+            source_alias = self.ident()
+        self.expect_kw("on")
+        on = self._expr()
+        cases = []
+        while self.peek().is_kw("when"):
+            self.next()
+            matched = True
+            if self.accept_kw("not"):
+                matched = False
+            m = self.next()
+            if not (m.kind == "ident" and m.value.lower() == "matched"):
+                raise ParseError("expected MATCHED", m)
+            condition = self._expr() if self.accept_kw("and") else None
+            self.expect_kw("then")
+            act = self.next()
+            if act.is_kw("update"):
+                self.expect_kw("set")
+                assigns = []
+                while True:
+                    col = self.ident()
+                    self.expect_op("=")
+                    assigns.append((col, self._expr()))
+                    if not self.accept_op(","):
+                        break
+                cases.append(
+                    ast.MergeCase(matched, "update", condition, tuple(assigns))
+                )
+            elif act.is_kw("delete"):
+                cases.append(ast.MergeCase(matched, "delete", condition))
+            elif act.is_kw("insert"):
+                cols: tuple = ()
+                if self.peek().kind == "op" and self.peek().value == "(":
+                    self.next()
+                    lst = [self.ident()]
+                    while self.accept_op(","):
+                        lst.append(self.ident())
+                    self.expect_op(")")
+                    cols = tuple(lst)
+                self.expect_kw("values")
+                self.expect_op("(")
+                vals = [self._expr()]
+                while self.accept_op(","):
+                    vals.append(self._expr())
+                self.expect_op(")")
+                cases.append(
+                    ast.MergeCase(
+                        matched, "insert", condition, tuple(vals), cols
+                    )
+                )
+            else:
+                raise ParseError("expected UPDATE/DELETE/INSERT", act)
+        if not cases:
+            raise ParseError("MERGE requires at least one WHEN clause", self.peek())
+        return ast.MergeStatement(
+            target, target_alias, source, source_alias, on, tuple(cases)
+        )
+
     def _type_name(self) -> str:
         parts = [self.ident()]
         # multi-word types: double precision, interval day to second, etc.
         while self.peek().kind in ("ident", "keyword") and self.peek().value in (
             "precision", "varying", "day", "month", "year", "to", "second",
-            "with", "without", "zone", "local",
+            "with", "without", "time", "zone", "local",
         ):
             parts.append(self.next().value)
         base = " ".join(parts)
@@ -703,6 +870,23 @@ class Parser:
                 self.next()
                 t = self.peek()
                 negated = True
+            if (
+                t.kind == "ident"
+                and t.value.lower() == "at"
+                and self.peek(1).is_kw("time")
+            ):
+                # `e AT TIME ZONE 'x'` postfix (reference: SqlBase.g4
+                # valueExpression AT timeZoneSpecifier) — binds tightest
+                if 8 < min_bp:
+                    return left
+                self.next()
+                self.expect_kw("time")
+                z = self.next()
+                if not (z.kind == "ident" and z.value.lower() == "zone"):
+                    raise ParseError("expected ZONE after AT TIME", z)
+                zone = self._expr(8)
+                left = ast.FunctionCall("at_timezone", (left, zone))
+                continue
             if t.kind == "op" and t.value in _PRECEDENCE:
                 bp = _PRECEDENCE[t.value]
                 if bp < min_bp:
@@ -865,7 +1049,7 @@ class Parser:
         elif t.is_kw("current_date"):
             e = ast.FunctionCall("current_date", ())
         elif t.is_kw("current_timestamp", "localtimestamp"):
-            e = ast.FunctionCall("current_timestamp", ())
+            e = ast.FunctionCall(t.value.lower(), ())
         elif t.is_kw("not"):
             e = ast.UnaryOp("not", self._expr(3))
         elif t.is_kw("array"):
