@@ -28,7 +28,12 @@ __all__ = [
     "DOUBLE",
     "DATE",
     "TIMESTAMP",
+    "TIMESTAMP_TZ",
     "INTERVAL_DAY",
+    "pack_tz",
+    "unpack_tz_millis",
+    "unpack_tz_offset",
+    "zone_offset_minutes",
     "UNKNOWN",
     "DecimalType",
     "VarcharType",
@@ -37,6 +42,7 @@ __all__ = [
     "VARCHAR",
     "VARBINARY",
     "ArrayType",
+    "MapType",
     "RowType",
     "parse_type",
     "common_super_type",
@@ -98,6 +104,30 @@ DOUBLE = _Simple("double", np.float64)
 DATE = _Simple("date", np.int32)
 #: microseconds since epoch, i64 (reference: spi/type/TimestampType.java, p=6)
 TIMESTAMP = _Simple("timestamp", np.int64)
+#: packed UTC-millis + zone offset, i64 (reference: spi/type/
+#: TimestampWithTimeZoneType.java + DateTimeEncoding.packDateTimeWithZone:
+#: millis << 12 | zoneKey).  Our 12-bit zone key is the fixed UTC offset in
+#: minutes biased by +2048 (zone rules are applied host-side when a value is
+#: created, so each device value carries the offset that was in force at its
+#: instant — rendering and extract are pure device arithmetic).
+TIMESTAMP_TZ = _Simple("timestamp with time zone", np.int64)
+#: bias/encoding constants for TIMESTAMP_TZ packing
+TZ_OFFSET_BIAS = 2048
+TZ_SHIFT = 4096  # 12 bits
+
+
+def pack_tz(utc_millis: int, offset_minutes: int) -> int:
+    return utc_millis * TZ_SHIFT + (offset_minutes + TZ_OFFSET_BIAS)
+
+
+def unpack_tz_millis(packed):
+    """UTC instant millis (device-safe: works on arrays)."""
+    return packed // TZ_SHIFT
+
+
+def unpack_tz_offset(packed):
+    """Zone offset minutes (device-safe)."""
+    return packed % TZ_SHIFT - TZ_OFFSET_BIAS
 #: interval day-to-second, microseconds, i64
 INTERVAL_DAY = _Simple("interval day to second", np.int64)
 
@@ -197,6 +227,31 @@ class ArrayType(Type):
         self.comparable = True
 
 
+class MapType(Type):
+    """map(K, V) in a packed rectangular device layout.
+
+    Reference: spi/type/MapType.java + spi/block/MapBlock.java (keys block +
+    values block + per-row offsets).  Device layout: `data` is
+    [capacity, 2*K] with keys in slots [0:K] and values in slots [K:2K];
+    `lengths` counts entries per row (<= K).  Static shapes keep XLA happy;
+    K grows by pow2 buckets at construction.  If both sides are strings they
+    share ONE merged dictionary (so a single Column.dictionary covers both
+    planes); otherwise the dictionary belongs to whichever side is a string.
+    """
+
+    def __init__(self, key: Type, value: Type):
+        self.key = key
+        self.value = value
+        self.name = f"map({key.name}, {value.name})"
+        kd, vd = np.dtype(key.np_dtype), np.dtype(value.np_dtype)
+        if kd.kind == "f" or vd.kind == "f":
+            self.np_dtype = np.dtype(np.float64)
+        else:
+            self.np_dtype = np.dtype(np.int64)
+        self.orderable = False
+        self.comparable = True
+
+
 class RowType(Type):
     def __init__(self, fields: list[tuple[str | None, Type]]):
         self.fields = tuple(fields)
@@ -213,6 +268,40 @@ class RowType(Type):
 # type algebra helpers
 
 
+def zone_offset_minutes(zone: str, utc_millis: int | None = None) -> int:
+    """Resolve a zone name / '+HH:MM' offset to minutes east of UTC.
+
+    Named zones use stdlib zoneinfo when tzdata is present; the offset is
+    evaluated at `utc_millis` (DST-correct for that instant), defaulting to
+    the current time.  Reference: spi/type/TimeZoneKey.java.
+    """
+    z = zone.strip()
+    if z.upper() in ("UTC", "Z", "GMT"):
+        return 0
+    if z and z[0] in "+-":
+        sign = -1 if z[0] == "-" else 1
+        body = z[1:]
+        if ":" in body:
+            h, m = body.split(":")
+        else:
+            h, m = body, "0"
+        return sign * (int(h) * 60 + int(m or 0))
+    import datetime
+
+    try:
+        from zoneinfo import ZoneInfo
+
+        tz = ZoneInfo(z)
+    except Exception as e:  # no tzdata or unknown zone
+        raise ValueError(f"unknown time zone: {zone!r}") from e
+    if utc_millis is None:
+        dt = datetime.datetime.now(tz)
+    else:
+        dt = datetime.datetime.fromtimestamp(utc_millis / 1000.0, tz)
+    off = dt.utcoffset()
+    return int(off.total_seconds() // 60) if off is not None else 0
+
+
 _SIMPLE_BY_NAME = {
     t.name: t
     for t in (
@@ -225,9 +314,11 @@ _SIMPLE_BY_NAME = {
         DOUBLE,
         DATE,
         TIMESTAMP,
+        TIMESTAMP_TZ,
         UNKNOWN,
     )
 }
+_SIMPLE_BY_NAME["timestamptz"] = TIMESTAMP_TZ
 _SIMPLE_BY_NAME["varchar"] = VARCHAR
 _SIMPLE_BY_NAME["varbinary"] = VARBINARY
 _SIMPLE_BY_NAME["string"] = VARCHAR  # convenience alias
@@ -236,6 +327,8 @@ _SIMPLE_BY_NAME["string"] = VARCHAR  # convenience alias
 def parse_type(text: str) -> Type:
     """Parse a SQL type name, e.g. 'decimal(12,2)', 'varchar(25)'."""
     s = text.strip().lower()
+    if s.endswith(" without time zone"):
+        s = s[: -len(" without time zone")].strip()
     if s in _SIMPLE_BY_NAME:
         return _SIMPLE_BY_NAME[s]
     if s.startswith("decimal"):
@@ -254,6 +347,17 @@ def parse_type(text: str) -> Type:
         return CharType(1)
     if s.startswith("array(") or s.startswith("array<"):
         return ArrayType(parse_type(s[6:-1]))
+    if s.startswith("map(") or s.startswith("map<"):
+        inner = s[4:-1]
+        depth = 0
+        for i, ch in enumerate(inner):
+            if ch in "(<":
+                depth += 1
+            elif ch in ")>":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                return MapType(parse_type(inner[:i]), parse_type(inner[i + 1:]))
+        raise ValueError(f"bad map type: {text!r}")
     raise ValueError(f"unknown type: {text!r}")
 
 
@@ -311,4 +415,10 @@ def common_super_type(a: Type, b: Type) -> Type:
         return a if _NUMERIC_ORDER[a.name] >= _NUMERIC_ORDER[b.name] else b
     if {a.name, b.name} == {"date", "timestamp"}:
         return TIMESTAMP
+    if TIMESTAMP_TZ.name in (a.name, b.name) and {a.name, b.name} <= {
+        "date",
+        "timestamp",
+        TIMESTAMP_TZ.name,
+    }:
+        return TIMESTAMP_TZ
     raise TypeError(f"no common type for {a} and {b}")
