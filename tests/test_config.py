@@ -1,0 +1,69 @@
+"""etc/ config-directory loading tests (reference: launcher etc/ layout +
+catalog .properties files with connector.name)."""
+
+import os
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture()
+def etc_dir(tmp_path):
+    etc = tmp_path / "etc"
+    cat = etc / "catalog"
+    cat.mkdir(parents=True)
+    (etc / "config.properties").write_text(
+        "# node config\n"
+        "default.catalog=tpch\n"
+        "default.schema=tiny\n"
+        "session.target_splits=3\n"
+        "http-server.http.port: 8080\n"
+        "long.value=a\\\nb\n"
+    )
+    (cat / "tpch.properties").write_text("connector.name=tpch\n")
+    (cat / "mem.properties").write_text("connector.name=memory\n")
+    pq = tmp_path / "pq"
+    pq.mkdir()
+    (cat / "files.properties").write_text(
+        f"connector.name=parquet\nparquet.dir={pq}\n"
+    )
+    return str(etc)
+
+
+def test_load_properties(etc_dir):
+    from trino_tpu.runtime.config import load_properties
+
+    props = load_properties(os.path.join(etc_dir, "config.properties"))
+    assert props["default.catalog"] == "tpch"
+    assert props["http-server.http.port"] == "8080"  # colon separator
+    assert props["long.value"] == "ab"  # line continuation
+
+
+def test_load_etc_catalogs(etc_dir):
+    from trino_tpu.runtime.config import load_etc
+
+    cfg = load_etc(etc_dir)
+    assert set(cfg.catalogs.names()) >= {"tpch", "mem", "files"}
+    assert cfg.session_defaults == {"target_splits": 3}
+
+
+def test_runner_from_etc(etc_dir):
+    from trino_tpu.runtime.config import runner_from_etc
+
+    r = runner_from_etc(etc_dir)
+    assert r.properties.get("target_splits") == 3
+    assert r.execute("select count(*) from nation").rows == [(25,)]
+    r.execute("create table mem.default.t (x bigint)")
+    r.execute("insert into mem.default.t values (7)")
+    assert r.execute("select * from mem.default.t").rows == [(7,)]
+
+
+def test_unknown_connector_rejected(tmp_path):
+    from trino_tpu.runtime.config import load_etc
+
+    cat = tmp_path / "catalog"
+    cat.mkdir()
+    (cat / "bad.properties").write_text("connector.name=nope\n")
+    with pytest.raises(ValueError, match="unknown connector.name"):
+        load_etc(str(tmp_path))

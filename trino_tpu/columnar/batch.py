@@ -116,9 +116,14 @@ class Batch:
         return int(np.asarray(jnp.sum(self.row_mask)))
 
     def to_pylist(self) -> list[list]:
-        """Rows of python values (live rows only, in order)."""
-        rm = None if self.row_mask is None else np.asarray(self.row_mask)
-        cols = [c.to_pylist(rm) for c in self.columns]
+        """Rows of python values (live rows only, in order).
+
+        The whole batch comes back in ONE device_get: per-column fetches pay
+        a full round trip each, which dominates result rendering when the
+        device is behind a remote tunnel."""
+        host = jax.device_get(self)
+        rm = None if host.row_mask is None else np.asarray(host.row_mask)
+        cols = [c.to_pylist(rm) for c in host.columns]
         return [list(r) for r in zip(*cols)] if cols else []
 
     def __repr__(self) -> str:  # pragma: no cover

@@ -120,7 +120,8 @@ def _array_to_column_data(arr, t: T.Type) -> ColumnData:
     if t is T.TIMESTAMP:
         us = arr.fill_null(0).cast(pa.timestamp("us")).cast(pa.int64())
         return ColumnData(np.asarray(us), valid)
-    data = np.asarray(arr.fill_null(0))
+    fill = False if pa.types.is_boolean(arr.type) else 0
+    data = np.asarray(arr.fill_null(fill))
     return ColumnData(np.ascontiguousarray(data), valid)
 
 

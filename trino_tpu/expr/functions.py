@@ -1011,6 +1011,326 @@ def _parse_scalar(s: str, to: T.Type):
     raise ValueError(f"cannot parse {s!r} as {to.name}")
 
 
+# ---------------------------------------------------------------------------
+# string breadth (reference: operator/scalar/StringFunctions.java,
+# SplitPart, PadFunctions, TranslateFunction)
+
+
+@register("split_part")
+def _split_part(ctx, call, value, delim, index):
+    dl = _literal_str(delim, "split_part")
+    ix = int(np.asarray(index.data))
+
+    def fn(s: str) -> str:
+        if ix < 1:
+            return ""
+        parts = s.split(dl) if dl else [s]
+        return parts[ix - 1] if ix <= len(parts) else ""
+
+    return _string_map(ctx, call, value, fn, "split_part")
+
+
+@register("lpad")
+def _lpad(ctx, call, value, size, pad=None):
+    n = int(np.asarray(size.data))
+    p = _literal_str(pad, "lpad") if pad is not None else " "
+
+    def fn(s: str) -> str:
+        if len(s) >= n:
+            return s[:n]
+        fill = (p * n)[: n - len(s)] if p else ""
+        return fill + s
+
+    return _string_map(ctx, call, value, fn, "lpad")
+
+
+@register("rpad")
+def _rpad(ctx, call, value, size, pad=None):
+    n = int(np.asarray(size.data))
+    p = _literal_str(pad, "rpad") if pad is not None else " "
+
+    def fn(s: str) -> str:
+        if len(s) >= n:
+            return s[:n]
+        fill = (p * n)[: n - len(s)] if p else ""
+        return s + fill
+
+    return _string_map(ctx, call, value, fn, "rpad")
+
+
+@register("translate")
+def _translate(ctx, call, value, frm, to):
+    f = _literal_str(frm, "translate")
+    t = _literal_str(to, "translate")
+    table = {}
+    for i, ch in enumerate(f):
+        if ord(ch) not in table:  # first occurrence wins (TranslateFunction)
+            table[ord(ch)] = t[i] if i < len(t) else None
+    return _string_map(
+        ctx, call, value, lambda s: s.translate(table), "translate"
+    )
+
+
+@register("codepoint")
+def _codepoint(ctx, call, value):
+    d = _require_dict(value, "codepoint")
+    table = jnp.asarray(
+        np.fromiter(
+            (ord(s[0]) if s else 0 for s in d.values),
+            dtype=np.int64,
+            count=len(d.values),
+        )
+    )
+    out = jnp.take(table, jnp.asarray(value.data, jnp.int32), mode="clip")
+    return Val(out, value.valid, call.type)
+
+
+@register("chr")
+def _chr(ctx, call, value):
+    # literal-only: a column of arbitrary codepoints would need a
+    # data-dependent dictionary, which trace-time compilation cannot build
+    if jnp.ndim(value.data) != 0 or isinstance(value.data, jnp.ndarray):
+        raise NotImplementedError("chr() supports only literal arguments")
+    n = int(np.asarray(value.data))
+    d = StringDictionary([chr(n)])
+    return Val(np.int32(0), value.valid, call.type, d)
+
+
+@register("normalize")
+def _normalize(ctx, call, value, form=None):
+    import unicodedata
+
+    f = _literal_str(form, "normalize") if form is not None else "NFC"
+    return _string_map(
+        ctx, call, value, lambda s: unicodedata.normalize(f, s), "normalize"
+    )
+
+
+@register("levenshtein_distance")
+def _levenshtein(ctx, call, value, target):
+    t = _literal_str(target, "levenshtein_distance")
+    d = _require_dict(value, "levenshtein_distance")
+
+    def lev(a: str, b: str) -> int:
+        if len(a) < len(b):
+            a, b = b, a
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(
+                    min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+                )
+            prev = cur
+        return prev[-1]
+
+    table = jnp.asarray(
+        np.fromiter(
+            (lev(s, t) for s in d.values), dtype=np.int64, count=len(d.values)
+        )
+    )
+    out = jnp.take(table, jnp.asarray(value.data, jnp.int32), mode="clip")
+    return Val(out, value.valid, call.type)
+
+
+# -- url family (reference: operator/scalar/UrlFunctions.java) ---------------
+
+
+def _url_part(part: str):
+    from urllib.parse import unquote, urlparse
+
+    def get(s: str) -> str:
+        try:
+            u = urlparse(s)
+            if part == "host":
+                return u.hostname or ""
+            if part == "protocol":
+                return u.scheme or ""
+            if part == "path":
+                return u.path or ""
+            if part == "query":
+                return u.query or ""
+            if part == "fragment":
+                return u.fragment or ""
+            if part == "port":
+                return str(u.port) if u.port is not None else ""
+        except ValueError:
+            return ""
+        return ""
+
+    return get
+
+
+def _make_url_extract(part: str, name: str):
+    @register(name)
+    def fn(ctx, call, value, _part=part, _name=name):
+        if _part == "port":
+            d = _require_dict(value, _name)
+            get = _url_part(_part)
+            vals = [get(s) for s in d.values]
+            table = jnp.asarray(
+                np.fromiter(
+                    (int(v) if v else -1 for v in vals),
+                    dtype=np.int64,
+                    count=len(vals),
+                )
+            )
+            out = jnp.take(table, jnp.asarray(value.data, jnp.int32), mode="clip")
+            return Val(out, _and_valid(value.valid, out >= 0), call.type)
+        return _string_map(ctx, call, value, _url_part(_part), _name)
+
+    return fn
+
+
+for _p in ("host", "protocol", "path", "query", "fragment", "port"):
+    _make_url_extract(_p, f"url_extract_{_p}")
+
+
+@register("url_encode")
+def _url_encode(ctx, call, value):
+    from urllib.parse import quote_plus
+
+    return _string_map(ctx, call, value, lambda s: quote_plus(s), "url_encode")
+
+
+@register("url_decode")
+def _url_decode(ctx, call, value):
+    from urllib.parse import unquote_plus
+
+    return _string_map(ctx, call, value, lambda s: unquote_plus(s), "url_decode")
+
+
+# -- math breadth (reference: operator/scalar/MathFunctions.java) ------------
+
+
+def _unary_math(name, fn):
+    @register(name)
+    def impl(ctx, call, v, _fn=fn):
+        return Val(_fn(_to_float(v)), v.valid, call.type)
+
+    return impl
+
+
+_unary_math("asin", jnp.arcsin)
+_unary_math("acos", jnp.arccos)
+_unary_math("atan", jnp.arctan)
+_unary_math("sinh", jnp.sinh)
+_unary_math("cosh", jnp.cosh)
+_unary_math("tanh", jnp.tanh)
+
+
+@register("atan2")
+def _atan2(ctx, call, y, x):
+    return Val(
+        jnp.arctan2(_to_float(y), _to_float(x)),
+        _and_valid(y.valid, x.valid),
+        call.type,
+    )
+
+
+@register("log")
+def _log(ctx, call, base, x):
+    b = _to_float(base)
+    v = _to_float(x)
+    return Val(
+        jnp.log(v) / jnp.log(b), _and_valid(base.valid, x.valid), call.type
+    )
+
+
+@register("truncate")
+def _truncate(ctx, call, v):
+    f = _to_float(v)
+    return Val(jnp.sign(f) * jnp.floor(jnp.abs(f)), v.valid, call.type)
+
+
+@register("e")
+def _e(ctx, call):
+    return Val(jnp.float64(np.e), None, call.type)
+
+
+@register("pi")
+def _pi(ctx, call):
+    return Val(jnp.float64(np.pi), None, call.type)
+
+
+@register("nan")
+def _nan(ctx, call):
+    return Val(jnp.float64(np.nan), None, call.type)
+
+
+@register("infinity")
+def _infinity(ctx, call):
+    return Val(jnp.float64(np.inf), None, call.type)
+
+
+@register("is_nan")
+def _is_nan(ctx, call, v):
+    return Val(jnp.isnan(_to_float(v)), v.valid, call.type)
+
+
+@register("is_finite")
+def _is_finite(ctx, call, v):
+    return Val(jnp.isfinite(_to_float(v)), v.valid, call.type)
+
+
+@register("is_infinite")
+def _is_infinite(ctx, call, v):
+    return Val(jnp.isinf(_to_float(v)), v.valid, call.type)
+
+
+@register("width_bucket")
+def _width_bucket(ctx, call, v, lo, hi, n):
+    x = _to_float(v)
+    a = _to_float(lo)
+    b = _to_float(hi)
+    k = jnp.asarray(n.data, jnp.float64)
+    # equal bounds / non-positive bucket count -> NULL (the reference
+    # raises; errors are not expressible row-wise in a traced program)
+    ok = jnp.logical_and(b != a, k > 0)
+    denom = jnp.where(ok, b - a, 1.0)
+    raw = jnp.floor((x - a) / denom * k) + 1
+    out = jnp.clip(jnp.where(ok, raw, 0.0), 0, jnp.maximum(k, 0) + 1).astype(jnp.int64)
+    valid = _and_valid(_and_valid(v.valid, lo.valid), _and_valid(hi.valid, n.valid))
+    return Val(out, _and_valid(valid, ok), call.type)
+
+
+# -- bitwise (reference: operator/scalar/BitwiseFunctions.java) --------------
+
+
+def _binary_bitwise(name, fn):
+    @register(name)
+    def impl(ctx, call, a, b, _fn=fn):
+        out = _fn(jnp.asarray(a.data, jnp.int64), jnp.asarray(b.data, jnp.int64))
+        return Val(out, _and_valid(a.valid, b.valid), call.type)
+
+    return impl
+
+
+_binary_bitwise("bitwise_and", jnp.bitwise_and)
+_binary_bitwise("bitwise_or", jnp.bitwise_or)
+_binary_bitwise("bitwise_xor", jnp.bitwise_xor)
+_binary_bitwise("bitwise_left_shift", lambda a, b: a << b)
+_binary_bitwise("bitwise_right_shift_arithmetic", lambda a, b: a >> b)
+
+
+@register("bitwise_not")
+def _bitwise_not(ctx, call, a):
+    return Val(~jnp.asarray(a.data, jnp.int64), a.valid, call.type)
+
+
+@register("bit_count")
+def _bit_count(ctx, call, a, bits=None):
+    x = jnp.asarray(a.data, jnp.uint64)
+    if bits is not None:
+        nb = int(np.asarray(bits.data))
+        if nb < 64:
+            x = x & ((np.uint64(1) << np.uint64(nb)) - np.uint64(1))
+    from jax import lax
+
+    n = lax.population_count(x).astype(jnp.int64)
+    return Val(n, a.valid, call.type)
+
+
 # array/json/map function handlers register themselves on import
 from trino_tpu.expr import arrays as _arrays  # noqa: E402,F401
 from trino_tpu.expr import maps as _maps  # noqa: E402,F401

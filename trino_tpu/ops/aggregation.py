@@ -53,7 +53,13 @@ class AggSpec:
     arg: Optional[int]
     out_type: T.Type
     param: object = None  # percentile fraction
+    arg2: Optional[int] = None  # second input channel (map_agg values)
 
+
+from trino_tpu.planner.functions import HOLISTIC_AGGS
+
+#: collect subset of the holistic aggregates (padded-array group state)
+COLLECT_AGGS = ("array_agg", "map_agg")
 
 #: moment family: grouped state is (sum, sum-of-squares, count)
 MOMENT = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
@@ -285,13 +291,23 @@ def _pad_device(batch: Batch, cap: int) -> Batch:
     pad = cap - n
     cols = []
     for c in batch.columns:
-        data = jnp.concatenate([c.data, jnp.zeros(pad, dtype=c.data.dtype)])
+        if c.data.ndim > 1:  # array/map columns: pad rows, keep width
+            data = jnp.concatenate(
+                [c.data, jnp.zeros((pad, c.data.shape[1]), dtype=c.data.dtype)]
+            )
+        else:
+            data = jnp.concatenate([c.data, jnp.zeros(pad, dtype=c.data.dtype)])
         valid = (
             None
             if c.valid is None
             else jnp.concatenate([c.valid, jnp.zeros(pad, dtype=bool)])
         )
-        cols.append(Column(data, c.type, valid, c.dictionary))
+        lengths = (
+            None
+            if c.lengths is None
+            else jnp.concatenate([c.lengths, jnp.zeros(pad, jnp.int32)])
+        )
+        cols.append(Column(data, c.type, valid, c.dictionary, lengths))
     mask = jnp.concatenate([batch.mask(), jnp.zeros(pad, dtype=bool)])
     return Batch(cols, mask)
 
@@ -702,6 +718,10 @@ class AggregationOperator:
         else:
             cap = next_pow2(big.capacity, floor=1)
             big = _pad_device(big, cap)
+        # collect aggregates (array_agg/map_agg) need a data-dependent padded
+        # width: run the step EAGERLY so the width sync is legal
+        if any(s.name in COLLECT_AGGS for s in self.aggregates):
+            return self._reduce_step(big, out_cap=cap)
         # the in-jit small-domain direct path needs no host sync; prefer it
         # when statically eligible (dict/bool keys)
         if self.group_channels and self._direct_group_info(big) is None:
@@ -715,8 +735,11 @@ class AggregationOperator:
         if not gch:
             return self._global_reduce(batch)
         direct = None
-        if not any(s.name == "percentile" for s in self.aggregates):
-            # percentile group ids must come from the sort-based numbering
+        if not any(
+            s.name in HOLISTIC_AGGS
+            for s in self.aggregates
+        ):
+            # holistic group ids must come from the sort-based numbering
             direct = self._direct_group_info(batch)
         if direct is not None:
             return self._direct_reduce(batch, *direct)
@@ -748,12 +771,17 @@ class AggregationOperator:
             cols.append(Column(key_out, col.type, valid, col.dictionary))
         # aggregate states/values
         for spec in self.aggregates:
-            if spec.name == "percentile":
+            if spec.name in HOLISTIC_AGGS:
                 if self.mode != "single":
                     raise NotImplementedError(
-                        "percentile requires single-stage aggregation"
+                        f"{spec.name} requires single-stage aggregation"
                     )
-                cols.append(self._percentile_one(batch, spec, out_cap))
+                if spec.name == "percentile":
+                    cols.append(self._percentile_one(batch, spec, out_cap))
+                else:
+                    cols.append(
+                        self._collect_one(batch, spec, perm, live, gid_c, nseg, out_cap)
+                    )
                 continue
             state_cols = self._reduce_one(
                 batch, spec, perm, live, gid_c, nseg, out_cap
@@ -763,6 +791,84 @@ class AggregationOperator:
             else:
                 cols.append(_finalize(spec, state_cols))
         return Batch(cols, out_live)
+
+    def _collect_one(
+        self, batch: Batch, spec: AggSpec, perm, live, gid_c, nseg, out_cap
+    ) -> Column:
+        """array_agg / map_agg: scatter each group's run into a padded
+        rectangular array (reference: operator/aggregation/
+        ArrayAggregationFunction + MapAggAggregationFunction group state).
+
+        Runs EAGERLY (outside jit): the padded width K is the max group
+        size, a data-dependent shape that costs one host sync.  NULL inputs
+        are skipped — the rectangular layout tracks nulls per-array, not
+        per-element (documented deviation; the reference keeps them)."""
+        import numpy as np
+
+        cap = batch.capacity
+        col = batch.columns[spec.arg]
+        d = jnp.take(col.data, perm, mode="clip")
+        varg = live
+        if col.valid is not None:
+            varg = jnp.logical_and(varg, jnp.take(col.valid, perm, mode="clip"))
+        vcol = None
+        dictionary = col.dictionary
+        if spec.name == "map_agg":
+            vcol = batch.columns[spec.arg2]
+            vd = jnp.take(vcol.data, perm, mode="clip")
+            if vcol.valid is not None:
+                varg = jnp.logical_and(
+                    varg, jnp.take(vcol.valid, perm, mode="clip")
+                )
+            if col.dictionary is not None and vcol.dictionary is not None:
+                from trino_tpu.columnar.dictionary import union_many
+
+                dictionary, (tk, tv) = union_many(
+                    [col.dictionary, vcol.dictionary]
+                )
+                if tk is not None:
+                    d = jnp.take(jnp.asarray(tk), jnp.asarray(d, jnp.int32), mode="clip")
+                if tv is not None:
+                    vd = jnp.take(jnp.asarray(tv), jnp.asarray(vd, jnp.int32), mode="clip")
+            elif vcol.dictionary is not None:
+                dictionary = vcol.dictionary
+        # within-group rank over kept rows
+        rank_incl = jnp.cumsum(varg.astype(jnp.int64))
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        base = jax.ops.segment_min(
+            jnp.where(varg, rank_incl - 1, cap + 1), gid_c, nseg
+        )
+        pos_in_group = rank_incl - 1 - jnp.take(base, gid_c, mode="clip")
+        counts = jax.ops.segment_sum(varg.astype(jnp.int64), gid_c, nseg)
+        kmax = int(np.asarray(jnp.max(counts[:out_cap])))  # the one host sync
+        k = next_pow2(max(kmax, 1), floor=1)
+        scatter_g = jnp.where(varg, gid_c, nseg)  # drop non-kept rows
+        scatter_p = jnp.clip(pos_in_group, 0, k - 1)
+        lengths = counts[:out_cap].astype(jnp.int32)
+        if spec.name == "array_agg":
+            et = spec.out_type.element
+            out = (
+                jnp.zeros((nseg + 1, k), dtype=et.np_dtype)
+                .at[scatter_g, scatter_p]
+                .set(jnp.asarray(d, et.np_dtype), mode="drop")
+            )
+            return Column(
+                out[:out_cap], spec.out_type, None, dictionary, lengths
+            )
+        mt = spec.out_type  # MapType: packed [out_cap, 2k]
+        dt = mt.np_dtype
+        keys = (
+            jnp.zeros((nseg + 1, k), dtype=dt)
+            .at[scatter_g, scatter_p]
+            .set(jnp.asarray(d, dt), mode="drop")
+        )
+        vals = (
+            jnp.zeros((nseg + 1, k), dtype=dt)
+            .at[scatter_g, scatter_p]
+            .set(jnp.asarray(vd, dt), mode="drop")
+        )
+        packed = jnp.concatenate([keys[:out_cap], vals[:out_cap]], axis=1)
+        return Column(packed, mt, None, dictionary, lengths)
 
     def _percentile_one(self, batch: Batch, spec: AggSpec, out_cap: int) -> Column:
         """Exact per-group percentile: re-sort by (group keys, value) and
@@ -847,6 +953,19 @@ class AggregationOperator:
         live = batch.mask()
         cols = []
         for spec in self.aggregates:
+            if spec.name in COLLECT_AGGS:
+                if self.mode != "single":
+                    raise NotImplementedError(
+                        f"{spec.name} requires single-stage aggregation"
+                    )
+                # one global group: reuse the grouped collect with gid=0
+                cap = batch.capacity
+                perm = jnp.arange(cap, dtype=jnp.int64)
+                gid_c = jnp.zeros(cap, dtype=jnp.int64)
+                cols.append(
+                    self._collect_one(batch, spec, perm, live, gid_c, 2, 1)
+                )
+                continue
             if spec.name == "percentile":
                 if self.mode != "single":
                     raise NotImplementedError(
@@ -1014,6 +1133,8 @@ class AggregationOperator:
     def finish(self) -> Batch:
         if not self._acc:
             empty = self._empty_input()
+            if any(s.name in COLLECT_AGGS for s in self.aggregates):
+                return self._reduce_step(empty, out_cap=max(1, empty.capacity))
             return self._step(empty, out_cap=max(1, empty.capacity))
         big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
         if self.streaming:

@@ -129,7 +129,13 @@ def _truncate(batch: Batch, cap: int) -> Batch:
     from trino_tpu.columnar import Column
 
     cols = [
-        Column(c.data[:cap], c.type, None if c.valid is None else c.valid[:cap], c.dictionary)
+        Column(
+            c.data[:cap],
+            c.type,
+            None if c.valid is None else c.valid[:cap],
+            c.dictionary,
+            None if c.lengths is None else c.lengths[:cap],
+        )
         for c in batch.columns
     ]
     return Batch(cols, batch.mask()[:cap])

@@ -65,6 +65,7 @@ from trino_tpu.planner.fragmenter import (
 )
 from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
 from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
+from trino_tpu.planner.functions import HOLISTIC_AGGS
 
 _DIST_KINDS = (SOURCE, FIXED_HASH, FIXED_ARBITRARY)
 
@@ -304,7 +305,7 @@ class StageExecutor:
                 and node.source.exchange_kind == "gather"
                 and not node.group_symbols
                 and not any(
-                    a.distinct or a.function == "percentile"
+                    a.distinct or a.function in HOLISTIC_AGGS
                     for _, a in node.aggregations
                 )
             ):

@@ -19,6 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from trino_tpu.planner import plan as P
+from trino_tpu.planner.functions import HOLISTIC_AGGS
 
 # -- partitioning handles (SystemPartitioningHandle.java:41-57) ---------------
 
@@ -164,7 +165,7 @@ class ExchangePlacer:
         if dist == _Distribution.SINGLE:
             return node.with_children([child]), _Distribution.SINGLE
         if any(
-            a.distinct or a.function == "percentile"
+            a.distinct or a.function in HOLISTIC_AGGS
             for _, a in node.aggregations
         ):
             # DISTINCT / percentile aggregates need the whole group on one

@@ -36,13 +36,20 @@ AGG_FUNCS = {
     # exact distinct count satisfies the approx contract (agg_symbol rewrites
     # this to a DISTINCT count before planning)
     "approx_distinct": "approx_distinct",
+    # holistic aggregates (whole group materialized on one node; reference:
+    # operator/aggregation/ArrayAggregationFunction, MapAggAggregationFunction)
+    "array_agg": "array_agg",
+    "map_agg": "map_agg",
 }
+
+#: aggregates that need every group row co-located (no partial/merge states)
+HOLISTIC_AGGS = ("percentile", "array_agg", "map_agg")
 
 #: aggregates whose grouped state is the (count, sum, sum-of-squares) triple
 MOMENT_AGGS = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
 
 
-def agg_result_type(name: str, arg_type: T.Type | None) -> T.Type:
+def agg_result_type(name: str, arg_type: T.Type | None, arg_type2: T.Type | None = None) -> T.Type:
     if name in ("count", "count_star", "approx_distinct"):
         return T.BIGINT
     if name == "sum":
@@ -65,6 +72,10 @@ def agg_result_type(name: str, arg_type: T.Type | None) -> T.Type:
         return T.DOUBLE
     if name == "percentile":
         return arg_type
+    if name == "array_agg":
+        return T.ArrayType(arg_type)
+    if name == "map_agg":
+        return T.MapType(arg_type, arg_type2 if arg_type2 is not None else T.BIGINT)
     raise TypeError(f"unknown aggregate {name}")
 
 
@@ -167,6 +178,49 @@ SCALAR_RESULT = {
     "round": lambda args: args[0],
     "greatest": _same_as_first,
     "least": _same_as_first,
+    # -- string breadth (reference: scalar/StringFunctions, UrlFunctions) ---
+    "split_part": _fixed(T.VARCHAR),
+    "lpad": _fixed(T.VARCHAR),
+    "rpad": _fixed(T.VARCHAR),
+    "translate": _fixed(T.VARCHAR),
+    "codepoint": _fixed(T.BIGINT),
+    "chr": _fixed(T.VARCHAR),
+    "normalize": _fixed(T.VARCHAR),
+    "levenshtein_distance": _fixed(T.BIGINT),
+    "url_extract_host": _fixed(T.VARCHAR),
+    "url_extract_protocol": _fixed(T.VARCHAR),
+    "url_extract_path": _fixed(T.VARCHAR),
+    "url_extract_query": _fixed(T.VARCHAR),
+    "url_extract_fragment": _fixed(T.VARCHAR),
+    "url_extract_port": _fixed(T.BIGINT),
+    "url_encode": _fixed(T.VARCHAR),
+    "url_decode": _fixed(T.VARCHAR),
+    # -- math breadth (reference: scalar/MathFunctions) ---------------------
+    "asin": _fixed(T.DOUBLE),
+    "acos": _fixed(T.DOUBLE),
+    "atan": _fixed(T.DOUBLE),
+    "atan2": _fixed(T.DOUBLE),
+    "sinh": _fixed(T.DOUBLE),
+    "cosh": _fixed(T.DOUBLE),
+    "tanh": _fixed(T.DOUBLE),
+    "log": _fixed(T.DOUBLE),
+    "truncate": _fixed(T.DOUBLE),
+    "e": _fixed(T.DOUBLE),
+    "pi": _fixed(T.DOUBLE),
+    "nan": _fixed(T.DOUBLE),
+    "infinity": _fixed(T.DOUBLE),
+    "is_nan": _fixed(T.BOOLEAN),
+    "is_finite": _fixed(T.BOOLEAN),
+    "is_infinite": _fixed(T.BOOLEAN),
+    "width_bucket": _fixed(T.BIGINT),
+    # -- bitwise (reference: scalar/BitwiseFunctions) -----------------------
+    "bitwise_and": _fixed(T.BIGINT),
+    "bitwise_or": _fixed(T.BIGINT),
+    "bitwise_xor": _fixed(T.BIGINT),
+    "bitwise_not": _fixed(T.BIGINT),
+    "bitwise_left_shift": _fixed(T.BIGINT),
+    "bitwise_right_shift_arithmetic": _fixed(T.BIGINT),
+    "bit_count": _fixed(T.BIGINT),
     # -- arrays (reference: operator/scalar/Array*Function.java) ------------
     "hour": _fixed(T.BIGINT),
     "minute": _fixed(T.BIGINT),

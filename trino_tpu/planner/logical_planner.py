@@ -209,6 +209,11 @@ class LogicalPlanner:
                     + [(s, s.ref()) for s, _ in hidden_src]
                     + hidden,
                 )
+            for osym, *_rest in orderings:
+                if not osym.type.orderable:
+                    raise AnalysisError(
+                        f"ORDER BY on non-orderable type {osym.type.name}"
+                    )
             if q.limit is not None and not q.offset:
                 node = P.TopNNode(node, orderings, q.limit)
             else:
@@ -731,6 +736,7 @@ class LogicalPlanner:
             if fc.is_star and sql_name == "count":
                 key = ("count_star", (), False, filter_key)
                 fname, arg_syms, arg_t = "count_star", [], None
+                arg_irs = []
             else:
                 fname = AGG_FUNCS[sql_name]
                 if fname == "percentile":
@@ -766,7 +772,8 @@ class LogicalPlanner:
                 arg_t = arg_irs[0].type if arg_irs else None
             if key in agg_map:
                 return agg_map[key]
-            out_t = agg_result_type(fname, arg_t)
+            arg_t2 = arg_irs[1].type if len(arg_irs) > 1 else None
+            out_t = agg_result_type(fname, arg_t, arg_t2)
             sym = alloc.new(fc.name, out_t)
             aggregations.append(
                 (

@@ -1,0 +1,175 @@
+"""Configuration file loading.
+
+Reference roles: the launcher's etc/ directory layout —
+`etc/config.properties` (node/coordinator config read by
+io.airlift.configuration), `etc/catalog/<name>.properties` (one catalog per
+file, `connector.name=` selects the plugin; server/CatalogManager loading),
+and pointer files for password authentication / access control / resource
+groups.
+
+The properties syntax is the java.util.Properties subset the reference uses:
+`key=value` or `key: value`, `#`/`!` comments, trailing-backslash line
+continuation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def load_properties(path: str) -> dict:
+    """Parse one .properties file into {key: value} (strings)."""
+    out: dict[str, str] = {}
+    with open(path, "r", encoding="utf-8") as fh:
+        pending = ""
+        for raw in fh:
+            line = pending + raw.strip()
+            pending = ""
+            if not line or line[0] in "#!":
+                continue
+            if line.endswith("\\"):
+                pending = line[:-1]
+                continue
+            for sep in ("=", ":"):
+                if sep in line:
+                    k, v = line.split(sep, 1)
+                    out[k.strip()] = v.strip()
+                    break
+            else:
+                out[line] = ""
+    return out
+
+
+#: connector.name -> factory(properties dict) -> Connector
+#: (reference: spi ConnectorFactory registration via Plugin.getConnectorFactories)
+def _factories() -> dict:
+    from trino_tpu.connectors.blackhole import BlackholeConnector
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    reg = {
+        "tpch": lambda p: TpchConnector(),
+        "memory": lambda p: MemoryConnector(),
+        "blackhole": lambda p: BlackholeConnector(),
+    }
+    try:
+        from trino_tpu.connectors.tpcds import TpcdsConnector
+
+        reg["tpcds"] = lambda p: TpcdsConnector()
+    except ImportError:  # pragma: no cover
+        pass
+
+    def hive(p):
+        from trino_tpu.connectors.hive import HiveConnector
+
+        return HiveConnector(p["hive.metastore.catalog.dir"])
+
+    def iceberg(p):
+        from trino_tpu.connectors.iceberg import IcebergConnector
+
+        return IcebergConnector(p["iceberg.catalog.warehouse"])
+
+    def parquet(p):
+        from trino_tpu.connectors.parquet import ParquetConnector
+
+        return ParquetConnector(p["parquet.dir"])
+
+    reg["hive"] = hive
+    reg["iceberg"] = iceberg
+    reg["parquet"] = parquet
+    return reg
+
+
+class EtcConfig:
+    """Everything loaded from an etc/ directory."""
+
+    def __init__(self, node_properties: dict, catalogs, session_defaults: dict):
+        self.node_properties = node_properties
+        self.catalogs = catalogs
+        self.session_defaults = session_defaults
+
+
+def load_etc(etc_dir: str) -> EtcConfig:
+    """Load config.properties + etc/catalog/*.properties into a CatalogManager
+    and node/session settings (reference: the server launcher's config
+    loading + CatalogStore)."""
+    from trino_tpu.connectors.api import CatalogManager
+
+    node_props: dict = {}
+    cfg = os.path.join(etc_dir, "config.properties")
+    if os.path.exists(cfg):
+        node_props = load_properties(cfg)
+    cm = CatalogManager()
+    factories = _factories()
+    cat_dir = os.path.join(etc_dir, "catalog")
+    if os.path.isdir(cat_dir):
+        for fn in sorted(os.listdir(cat_dir)):
+            if not fn.endswith(".properties"):
+                continue
+            name = fn[: -len(".properties")]
+            props = load_properties(os.path.join(cat_dir, fn))
+            conn_name = props.get("connector.name")
+            if conn_name is None:
+                raise ValueError(f"{fn}: missing connector.name")
+            factory = factories.get(conn_name)
+            if factory is None:
+                raise ValueError(f"{fn}: unknown connector.name {conn_name!r}")
+            cm.register(name, factory(props))
+    # session property defaults: `session.<name>=value` entries
+    session_defaults = {}
+    for k, v in node_props.items():
+        if k.startswith("session."):
+            session_defaults[k[len("session."):]] = _coerce(v)
+    return EtcConfig(node_props, cm, session_defaults)
+
+
+def _coerce(v: str):
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        return v
+
+
+def runner_from_etc(etc_dir: str, **kw):
+    """LocalQueryRunner wired from an etc/ directory (catalogs, session
+    defaults, optional access-control and password files)."""
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    cfg = load_etc(etc_dir)
+    catalog = cfg.node_properties.get("default.catalog", "tpch")
+    schema = cfg.node_properties.get("default.schema", "tiny")
+    if catalog not in cfg.catalogs.names():
+        names = cfg.catalogs.names()
+        if names:
+            catalog = sorted(names)[0]
+    r = LocalQueryRunner(
+        catalog=catalog,
+        schema=schema,
+        catalogs=cfg.catalogs,
+        **kw,
+    )
+    for k, v in cfg.session_defaults.items():
+        try:
+            r.properties.set(k, v)
+        except Exception:
+            pass
+    ac_file = cfg.node_properties.get("access-control.config-file")
+    if ac_file:
+        import json
+
+        from trino_tpu.server.security import RuleBasedAccessControl
+
+        with open(ac_file) as fh:
+            doc = json.load(fh)
+        r.access_control = RuleBasedAccessControl.from_dicts(
+            doc.get("tables", doc.get("rules", []))
+        )
+    return r

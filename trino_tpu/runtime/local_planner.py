@@ -34,6 +34,7 @@ from trino_tpu.ops.scan import ScanOperator
 from trino_tpu.ops.sort import LimitOperator, OrderByOperator, TopNOperator
 from trino_tpu.ops.values import ValuesOperator
 from trino_tpu.planner import plan as P
+from trino_tpu.planner.functions import HOLISTIC_AGGS
 
 
 class PhysicalPlan:
@@ -233,18 +234,36 @@ class LocalExecutionPlanner:
             else:
                 proj.append(arg)
                 input_types.append(arg.type)
+                arg2_ch = None
+                if len(agg.args) > 1:
+                    # two-input aggregates (map_agg key, value)
+                    arg2 = src.rewrite(agg.args[1])
+                    if agg.filter is not None:
+                        f2 = src.rewrite(agg.filter)
+                        arg2 = SpecialForm(
+                            Form.IF,
+                            [f2, arg2, Literal(None, arg2.type)],
+                            arg2.type,
+                        )
+                    proj.append(arg2)
+                    input_types.append(arg2.type)
+                    arg2_ch = ngroups + len(specs_args(specs)) + 1
                 specs.append(
                     AggSpec(
                         name,
                         ngroups + len(specs_args(specs)),
                         out_sym.type,
                         param=getattr(agg, "param", None),
+                        arg2=arg2_ch,
                     )
                 )
 
         pre = FilterProjectOperator(None, proj)
-        # percentile needs every group row at once: no streaming partials
-        streaming = not any(s.name == "percentile" for s in specs)
+        # holistic aggregates need every group row at once: no streaming
+        # partials (reference: ArrayAggregationFunction group state)
+        streaming = not any(
+            s.name in HOLISTIC_AGGS for s in specs
+        )
 
         def make_op():
             return AggregationOperator(
@@ -769,29 +788,70 @@ def _agg_raw_wave_stream(make_op, op, feed, key_channels: list, budget: int):
 
 
 def specs_args(specs: list) -> list:
-    """Channels already consumed by aggregate args (for layout allocation)."""
-    return [s for s in specs if s.arg is not None]
+    """Channels already consumed by aggregate args (for layout allocation).
+    Two-input aggregates (map_agg) consume two slots."""
+    out = []
+    for s in specs:
+        if s.arg is not None:
+            out.append(s)
+        if getattr(s, "arg2", None) is not None:
+            out.append(s)
+    return out
+
+
+_MINMAX_STEP_CACHE: dict = {}
 
 
 def _host_minmax(batches, channel: int):
     """(lo, hi) of a materialized column's live+valid values, or None when
     the domain is empty/unfilterable (dictionary codes aren't portable
-    across scans)."""
+    across scans).
+
+    The reduction runs ON DEVICE and only three scalars come back per batch
+    (packed into one array = one host sync).  Pulling the whole column to
+    host — the previous design — costs hundreds of ms per build batch when
+    the device sits behind a remote tunnel (~30 MB/s)."""
     import numpy as np
+
+    import jax
+    import jax.numpy as jnp
 
     lo = hi = None
     for b in batches:
         c = b.columns[channel]
         if c.dictionary is not None:
             return None
-        data = np.asarray(c.data)
-        live = np.asarray(b.mask())
+        dt = np.dtype(c.data.dtype)
+        if dt == np.dtype(bool):
+            return None  # boolean join keys: range pruning is pointless
+        step = _MINMAX_STEP_CACHE.get(dt.str)
+        if step is None:
+
+            def _step(data, live):
+                if jnp.issubdtype(data.dtype, jnp.floating):
+                    big = jnp.asarray(jnp.inf, data.dtype)
+                    small = jnp.asarray(-jnp.inf, data.dtype)
+                else:
+                    info = jnp.iinfo(data.dtype)
+                    big = jnp.asarray(info.max, data.dtype)
+                    small = jnp.asarray(info.min, data.dtype)
+                lo_ = jnp.min(jnp.where(live, data, big))
+                hi_ = jnp.max(jnp.where(live, data, small))
+                # any-live flag, NOT a count: a count cast to a narrow key
+                # dtype (int8/int16) wraps to 0 at 256/65536 live rows and
+                # would silently skip the batch
+                n = jnp.any(live).astype(data.dtype)
+                return jnp.stack([lo_, hi_, n])
+
+            step = jax.jit(_step)
+            _MINMAX_STEP_CACHE[dt.str] = step
+        live = b.mask()
         if c.valid is not None:
-            live = live & np.asarray(c.valid)
-        if not live.any():
+            live = jnp.logical_and(live, c.valid)
+        packed = np.asarray(step(c.data, live))
+        if packed[2] == 0:
             continue
-        vals = data[live]
-        blo, bhi = vals.min(), vals.max()
+        blo, bhi = packed[0], packed[1]
         lo = blo if lo is None else min(lo, blo)
         hi = bhi if hi is None else max(hi, bhi)
     if lo is None:
