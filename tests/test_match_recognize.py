@@ -1,0 +1,190 @@
+"""MATCH_RECOGNIZE tests (reference: the SQL-2016 row pattern examples used
+by TestRowPatternMatching.java — V-shape stock patterns, quantifiers,
+classifier/match_number, skip modes)."""
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    r = LocalQueryRunner(catalog="memory", schema="default", target_splits=2)
+    r.execute("create table stock (sym varchar, day bigint, price double)")
+    r.execute(
+        "insert into stock values "
+        "('A', 1, 10), ('A', 2, 8), ('A', 3, 6), ('A', 4, 9), ('A', 5, 12), "
+        "('A', 6, 11), ('B', 1, 5), ('B', 2, 6), ('B', 3, 4), ('B', 4, 7)"
+    )
+    return r
+
+
+V_QUERY = """
+select * from stock match_recognize (
+  partition by sym
+  order by day
+  measures first(price) as strt,
+           min(down.price) as bottom,
+           last(up.price) as top,
+           match_number() as mno
+  one row per match
+  after match skip past last row
+  pattern (strt down+ up+)
+  define down as price < prev(price),
+         up as price > prev(price)
+)
+"""
+
+
+def test_v_shape_one_row_per_match(runner):
+    rows = sorted(runner.execute(V_QUERY).rows)
+    # MATCH_NUMBER() restarts per partition (SQL-2016)
+    assert rows == [("A", 10.0, 6.0, 12.0, 1), ("B", 6.0, 4.0, 7.0, 1)]
+
+
+def test_all_rows_per_match_classifier(runner):
+    rows = runner.execute(
+        """
+        select sym, day, cls from stock match_recognize (
+          partition by sym order by day
+          measures classifier() as cls
+          all rows per match
+          pattern (strt down+ up+)
+          define down as price < prev(price),
+                 up as price > prev(price)
+        ) where sym = 'A' order by day
+        """
+    ).rows
+    assert rows == [
+        ("A", 1, "strt"), ("A", 2, "down"), ("A", 3, "down"),
+        ("A", 4, "up"), ("A", 5, "up"),
+    ]
+
+
+def test_skip_to_next_row(runner):
+    rows = runner.execute(
+        """
+        select cnt from stock match_recognize (
+          partition by sym order by day
+          measures count(*) as cnt
+          one row per match
+          after match skip to next row
+          pattern (down down)
+          define down as price < prev(price)
+        )
+        """
+    ).rows
+    # A: days 2,3 both falling -> overlapping matches at day2 start only
+    assert rows == [(2,)]
+
+
+def test_quantifier_bounds(runner):
+    rows = runner.execute(
+        """
+        select mno, cnt from stock match_recognize (
+          partition by sym order by day
+          measures match_number() as mno, count(*) as cnt
+          pattern (down{2})
+          define down as price < prev(price)
+        )
+        """
+    ).rows
+    assert rows == [(1, 2)]  # exactly-two falling days (A: days 2-3)
+
+
+def test_alternation(runner):
+    rows = sorted(
+        runner.execute(
+            """
+            select sym, cls from stock match_recognize (
+              partition by sym order by day
+              measures classifier() as cls
+              pattern (big | small)
+              define big as price >= 10,
+                     small as price <= 4
+            )
+            """
+        ).rows
+    )
+    # leftmost rows matching either: A day1 (10 -> big), B day3 (4 -> small)
+    assert ("A", "big") in rows and ("B", "small") in rows
+
+
+def test_undefined_variable_matches_any(runner):
+    rows = runner.execute(
+        """
+        select cnt from stock match_recognize (
+          partition by sym order by day
+          measures count(*) as cnt
+          pattern (anyrow down)
+          define down as price < prev(price)
+        ) order by 1
+        """
+    ).rows
+    assert len(rows) >= 1
+
+
+def test_explain_contains_pattern_node(runner):
+    txt = runner.execute("explain " + V_QUERY).rows
+    flat = "\n".join(r[0] for r in txt)
+    assert "PatternRecognition" in flat
+
+
+def test_string_measure_decodes(runner):
+    rows = runner.execute(
+        """
+        select s from stock match_recognize (
+          partition by sym order by day
+          measures last(sym) as s
+          pattern (down+)
+          define down as price < prev(price)
+        ) order by 1
+        """
+    ).rows
+    # A falls on days 2-3 and again on day 6; B falls on day 3
+    assert rows == [("A",), ("A",), ("B",)]
+
+
+def test_next_navigation_last_row_null(runner):
+    # NEXT at the final row of a partition must be NULL, never a padded row
+    rows = runner.execute(
+        """
+        select cnt from stock match_recognize (
+          partition by sym order by day
+          measures count(*) as cnt
+          pattern (tail)
+          define tail as next(price) is null and price > 10
+        )
+        """
+    ).rows
+    assert rows == [(1,)]  # only A day6 (11 > 10, last of partition)
+
+
+def test_first_offset(runner):
+    rows = runner.execute(
+        """
+        select p from stock match_recognize (
+          partition by sym order by day
+          measures first(price, 1) as p
+          pattern (down down)
+          define down as price < prev(price)
+        )
+        """
+    ).rows
+    assert rows == [(6.0,)]  # second DOWN row of A's (8, 6) run
+
+
+def test_cross_variable_define_rejected(runner):
+    with __import__("pytest").raises(Exception, match="cross-variable"):
+        runner.execute(
+            """
+            select mno from stock match_recognize (
+              partition by sym order by day
+              measures match_number() as mno
+              pattern (strt up)
+              define up as up.price > strt.price
+            )
+            """
+        )

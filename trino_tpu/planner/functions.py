@@ -178,6 +178,10 @@ SCALAR_RESULT = {
     "round": lambda args: args[0],
     "greatest": _same_as_first,
     "least": _same_as_first,
+    # -- row-pattern navigation (valid only inside MATCH_RECOGNIZE DEFINE;
+    # the pattern operator rewrites them to $nav_prev/$nav_next) -----------
+    "prev": _same_as_first,
+    "next": _same_as_first,
     # -- string breadth (reference: scalar/StringFunctions, UrlFunctions) ---
     "split_part": _fixed(T.VARCHAR),
     "lpad": _fixed(T.VARCHAR),

@@ -354,6 +354,50 @@ class UnionNode(PlanNode):
 
 
 @dataclass
+class MeasureSpec:
+    """One MATCH_RECOGNIZE measure (reference: sql/planner/plan/
+    PatternRecognitionNode.Measure — restricted to the navigations the
+    operator evaluates: FIRST/LAST over a variable, CLASSIFIER(),
+    MATCH_NUMBER(), and SQL aggregates over matched rows)."""
+
+    kind: str  # first | last | classifier | match_number | agg
+    var: Optional[str] = None  # pattern variable filter (None = any)
+    source: Optional[Symbol] = None  # source column
+    agg: Optional[str] = None  # count | sum | avg | min | max
+    offset: int = 0  # FIRST/LAST logical offset
+
+
+@dataclass
+class PatternRecognitionNode(PlanNode):
+    """reference: sql/planner/plan/PatternRecognitionNode.java."""
+
+    source: PlanNode
+    partition_by: list  # [Symbol]
+    order_by: list  # [(Symbol, ascending, nulls_first)]
+    defines: list  # [(var name, Expr over source symbols; prev/next Calls)]
+    pattern: str
+    measures: list  # [(Symbol, MeasureSpec)]
+    rows_per_match: str = "one"
+    after_match: str = "past_last"
+
+    @property
+    def outputs(self):
+        if self.rows_per_match == "one":
+            return list(self.partition_by) + [s for s, _ in self.measures]
+        return self.source.outputs + [s for s, _ in self.measures]
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return PatternRecognitionNode(
+            children[0], self.partition_by, self.order_by, self.defines,
+            self.pattern, self.measures, self.rows_per_match, self.after_match,
+        )
+
+
+@dataclass
 class UnnestNode(PlanNode):
     """Array expansion (reference: sql/planner/plan/UnnestNode.java +
     operator/unnest/UnnestOperator.java).  Source rows replicate per array

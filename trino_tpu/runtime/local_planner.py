@@ -199,6 +199,26 @@ class LocalExecutionPlanner:
         op = UnnestOperator(exprs, with_ordinality=node.ordinality is not None)
         return PhysicalPlan(op.process(src.stream), node.outputs)
 
+    def _visit_PatternRecognitionNode(
+        self, node: P.PatternRecognitionNode
+    ) -> PhysicalPlan:
+        from trino_tpu.ops.pattern import PatternRecognitionOperator
+
+        src = self.plan(node.source)
+        # defines rewritten to channel space over the SOURCE layout
+        rewritten = P.PatternRecognitionNode(
+            node.source,
+            node.partition_by,
+            node.order_by,
+            [(v, src.rewrite(e)) for v, e in node.defines],
+            node.pattern,
+            node.measures,
+            node.rows_per_match,
+            node.after_match,
+        )
+        op = PatternRecognitionOperator(rewritten, src.symbols)
+        return PhysicalPlan(op.process(src.stream), node.outputs)
+
     # -- aggregation ----------------------------------------------------------
 
     def _visit_AggregationNode(self, node: P.AggregationNode) -> PhysicalPlan:

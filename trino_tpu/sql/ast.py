@@ -248,6 +248,21 @@ class Join(Node):
 
 
 @dataclass(frozen=True)
+class MatchRecognize(Node):
+    """relation MATCH_RECOGNIZE (...) (reference: SqlBase.g4 patternRecognition
+    + sql/tree/PatternRecognitionRelation.java)."""
+
+    relation: Node
+    partition_by: tuple = ()  # exprs
+    order_by: tuple = ()  # SortItems
+    measures: tuple = ()  # (expr Node, name str)
+    rows_per_match: str = "one"  # one | all
+    after_match: str = "past_last"  # past_last | next_row
+    pattern: str = ""  # raw row-pattern text
+    defines: tuple = ()  # (var name str, condition Node)
+
+
+@dataclass(frozen=True)
 class Unnest(Node):
     exprs: tuple
     with_ordinality: bool = False

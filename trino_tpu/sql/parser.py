@@ -738,12 +738,21 @@ class Parser:
                 return left
 
     def _aliased_relation(self) -> ast.Node:
-        r = self._relation_primary()
+        r = self._maybe_alias(self._relation_primary())
+        if self._peek_ident(0, "match_recognize"):
+            # reference grammar: patternRecognition wraps the ALIASED
+            # relation and may itself be aliased (SqlBase.g4 sampledRelation)
+            r = self._maybe_alias(self._match_recognize(r))
+        return r
+
+    def _maybe_alias(self, r: ast.Node) -> ast.Node:
         alias = None
         column_aliases = ()
         if self.accept_kw("as"):
             alias = self.ident()
-        elif self.peek().kind in ("ident", "qident"):
+        elif self.peek().kind in ("ident", "qident") and not self._peek_ident(
+            0, "match_recognize"
+        ):
             alias = self.ident()
         if alias is not None and self.peek().kind == "op" and self.peek().value == "(":
             # column aliases t(a, b)
@@ -756,6 +765,106 @@ class Parser:
         if alias is not None:
             return ast.AliasedRelation(r, alias, column_aliases)
         return r
+
+    def _match_recognize(self, relation: ast.Node) -> ast.Node:
+        """MATCH_RECOGNIZE (PARTITION BY ... ORDER BY ... MEASURES ...
+        [ONE|ALL] ROW[S] PER MATCH [AFTER MATCH SKIP ...] PATTERN (...)
+        DEFINE v AS cond, ...) — reference: SqlBase.g4 patternRecognition."""
+        self.next()  # match_recognize
+        self.expect_op("(")
+        partition_by: tuple = ()
+        order_by: tuple = ()
+        measures: list = []
+        rows_per_match = "one"
+        after_match = "past_last"
+        pattern = ""
+        defines: list = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            items = [self._expr()]
+            while self.accept_op(","):
+                items.append(self._expr())
+            partition_by = tuple(items)
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            items = [self._sort_item()]
+            while self.accept_op(","):
+                items.append(self._sort_item())
+            order_by = tuple(items)
+        if self._peek_ident(0, "measures"):
+            self.next()
+            while True:
+                e = self._expr()
+                self.expect_kw("as")
+                name = self.ident()
+                measures.append((e, name))
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("all"):
+            self.expect_kw("rows")
+            self._expect_ident("per")
+            self._expect_ident("match")
+            rows_per_match = "all"
+        elif self._peek_ident(0, "one"):
+            self.next()
+            self.expect_kw("row")
+            self._expect_ident("per")
+            self._expect_ident("match")
+        if self._peek_ident(0, "after"):
+            self.next()
+            self._expect_ident("match")
+            self._expect_ident("skip")
+            if self._peek_ident(0, "past"):
+                self.next()
+                self.expect_kw("last")
+                self.expect_kw("row")
+                after_match = "past_last"
+            elif self.accept_kw("to"):
+                self.expect_kw("next")
+                self.expect_kw("row")
+                after_match = "next_row"
+            else:
+                raise ParseError("unsupported AFTER MATCH SKIP", self.peek())
+        self._expect_ident("pattern")
+        open_tok = self.expect_op("(")
+        depth = 1
+        start = open_tok.pos + 1
+        end = start
+        while depth:
+            tk = self.next()
+            if tk.kind == "eof":
+                raise ParseError("unterminated PATTERN", tk)
+            if tk.kind == "op" and tk.value == "(":
+                depth += 1
+            elif tk.kind == "op" and tk.value == ")":
+                depth -= 1
+                end = tk.pos
+        pattern = self.sql[start:end].strip()
+        self._expect_ident("define")
+        while True:
+            var = self.ident()
+            self.expect_kw("as")
+            defines.append((var, self._expr()))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return ast.MatchRecognize(
+            relation,
+            partition_by,
+            order_by,
+            tuple(measures),
+            rows_per_match,
+            after_match,
+            pattern,
+            tuple(defines),
+        )
+
+    def _expect_ident(self, word: str):
+        t = self.next()
+        if not (
+            (t.kind == "ident" and t.value.lower() == word) or t.is_kw(word)
+        ):
+            raise ParseError(f"expected {word.upper()}", t)
 
     def _relation_primary(self) -> ast.Node:
         t = self.peek()

@@ -27,7 +27,9 @@ KEYWORDS = {
 }
 
 _MULTI_OPS = ("<=", ">=", "<>", "!=", "||", "->", "=>")
-_SINGLE_OPS = "+-*/%(),.;=<>[]?:"
+#: `|` `{` `}` appear only inside MATCH_RECOGNIZE row patterns ('||' concat
+#: still wins via the multi-op scan)
+_SINGLE_OPS = "+-*/%(),.;=<>[]?:|{}"
 
 
 @dataclass(frozen=True)
