@@ -50,6 +50,15 @@ def column_from_values(values: Sequence, t: Type) -> Column:
         d = StringDictionary(present)
         codes = d.encode([v if v is not None else None for v in values])
         return Column(codes, t, valid, d)
+    # fast path: plain python numbers convert in one C-level call (also what
+    # makes the scaled-writer thread pool worthwhile — the conversion runs
+    # outside the GIL's per-object churn)
+    # (decimals always go per-value: even plain int/float inputs must scale)
+    if not has_nulls and not isinstance(t, DecimalType):
+        try:
+            return Column(np.asarray(values, dtype=t.np_dtype), t, None)
+        except (TypeError, ValueError):
+            pass  # date/timestamp objects: per-value conversion below
     arr = np.zeros(n, dtype=t.np_dtype)
     for i, v in enumerate(values):
         if v is not None:

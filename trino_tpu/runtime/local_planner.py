@@ -130,11 +130,12 @@ class LocalExecutionPlanner:
         page_rows = self.properties.get("page_rows")
         use_cache = self.properties.get("scan_cache")
         prefetch_depth = self.properties.get("scan_prefetch_depth")
+        concurrency = self.properties.get("task_concurrency")
 
-        def stream():
-            from trino_tpu.runtime.retry import FAILURE_INJECTOR
+        def split_feed(split):
+            def make():
+                from trino_tpu.runtime.retry import FAILURE_INJECTOR
 
-            for split in splits:
                 FAILURE_INJECTOR.maybe_fail(
                     f"scan:{node.handle.schema}.{node.handle.table}:{split.seq}"
                 )
@@ -142,13 +143,31 @@ class LocalExecutionPlanner:
                     connector, split, names, types,
                     page_rows=page_rows, use_cache=use_cache,
                 )
-                yield from op.batches()
+                return op.batches()
 
-        feed = stream()
-        if prefetch_depth > 0:
-            from trino_tpu.runtime.prefetch import prefetch_iter
+            return make
 
-            feed = prefetch_iter(feed, depth=prefetch_depth)
+        if concurrency > 1 and len(splits) > 1:
+            # intra-task parallelism: split readers drain through a local
+            # exchange (host-side decode+feed is the parallelizable part;
+            # the device stream stays single — XLA owns that).  The exchange
+            # is already background-fed + buffered, so no prefetch wrap.
+            from trino_tpu.runtime.local_exchange import parallel_feed
+
+            feed = parallel_feed(
+                [split_feed(s) for s in splits], workers=concurrency
+            )
+        else:
+
+            def stream():
+                for split in splits:
+                    yield from split_feed(split)()
+
+            feed = stream()
+            if prefetch_depth > 0:
+                from trino_tpu.runtime.prefetch import prefetch_iter
+
+                feed = prefetch_iter(feed, depth=prefetch_depth)
         plan = PhysicalPlan(feed, [s for s, _ in node.assignments])
         pred_expr = node.pushed_predicate
         # dynamic filters registered by upstream join builds (ranges over this
@@ -376,6 +395,12 @@ class LocalExecutionPlanner:
                 if rng is not None:
                     self.dynamic_filters[lsym.name] = rng
         probe = self.plan(node.left)
+        # pipeline parallelism (§2.7(4)): the probe feed starts decoding NOW,
+        # overlapping the build side's device-side compaction/indexing.
+        # Planned AFTER the build drain so dynamic filters still apply.
+        from trino_tpu.runtime.prefetch import eager_prefetch
+
+        probe = PhysicalPlan(eager_prefetch(probe.stream, depth=2), probe.symbols)
         out_symbols = probe.symbols + build.symbols
         probe_keys = [probe.channel(l.name) for l, _ in node.criteria]
         build_keys = [build.channel(r.name) for _, r in node.criteria]

@@ -835,6 +835,12 @@ class LocalQueryRunner:
         return _ok("DROP TABLE")
 
     def _write_rows(self, conn, handle, result: MaterializedResult) -> None:
+        """Scaled writers (reference: the scaled-writer operators behind
+        task_writer_count): page building — the host-CPU-heavy part — runs
+        on `writer_count` threads over row chunks; sink commits are
+        serialized (connector sinks need no internal locking)."""
+        from concurrent.futures import ThreadPoolExecutor
+
         from trino_tpu.columnar.builders import column_from_values
         from trino_tpu.connectors.api import ColumnData
 
@@ -842,12 +848,24 @@ class LocalQueryRunner:
         sink = conn.page_sink(
             handle, [c.name for c in meta.columns], [c.type for c in meta.columns]
         )
-        if result.rows:
-            cols = []
-            for i, cm in enumerate(meta.columns):
-                col = column_from_values([r[i] for r in result.rows], cm.type)
-                cols.append(ColumnData(col.data, col.valid, col.dictionary))
-            sink.append(cols)
+        if not result.rows:
+            return
+        writers = max(1, int(self.properties.get("writer_count") or 1))
+
+        def build(i_cm):
+            i, cm = i_cm
+            col = column_from_values([r[i] for r in result.rows], cm.type)
+            return ColumnData(col.data, col.valid, col.dictionary)
+
+        items = list(enumerate(meta.columns))
+        if writers <= 1 or len(items) <= 1 or len(result.rows) < 1024:
+            cols = [build(x) for x in items]
+        else:
+            # column-parallel build keeps dictionaries whole and the commit
+            # single (one sink append = one snapshot, iceberg-compatible)
+            with ThreadPoolExecutor(max_workers=min(writers, len(items))) as pool:
+                cols = list(pool.map(build, items))
+        sink.append(cols)
 
 
 def _ast_literal_value(node):

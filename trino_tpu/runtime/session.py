@@ -69,6 +69,20 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             2,
         ),
         PropertyMetadata(
+            "task_concurrency",
+            "parallel split readers per table scan (local exchange width; "
+            "reference: SystemSessionProperties TASK_CONCURRENCY)",
+            int,
+            4,
+        ),
+        PropertyMetadata(
+            "writer_count",
+            "parallel page-building writer threads for INSERT/CTAS "
+            "(reference: scaled writers / task_writer_count)",
+            int,
+            4,
+        ),
+        PropertyMetadata(
             "pallas_agg",
             "use the Pallas MXU one-hot-matmul kernel for eligible "
             "small-domain float aggregations",
