@@ -193,6 +193,26 @@ class _StageScheduler:
                 return False
             return True  # slow or transient: assume alive
 
+    def _least_loaded_worker(self) -> str:
+        """Replacement placement: the live worker with the fewest tasks this
+        scheduler has placed on it (reference: UniformNodeSelector.java:67's
+        queue-length weighting; here load = submitted-task count)."""
+        from collections import Counter
+
+        load: Counter = Counter()
+        for tasks in self._stage_tasks.values():
+            if isinstance(tasks, list):
+                for t in tasks:
+                    url = getattr(t, "base_url", None) or getattr(
+                        t, "worker_url", None
+                    )
+                    if url:
+                        load[url] += 1
+        live = [u for u in self.workers if u not in self._dead]
+        if not live:
+            live = list(self.workers)
+        return min(live, key=lambda u: load[u])
+
     def _submit_on_live(self, desc: TaskDescriptor, preferred: str):
         """Submit, falling over to any live worker if the preferred one is
         gone."""
@@ -238,9 +258,7 @@ class _StageScheduler:
             task_id=f"{desc.task_id}r{next(self.runner._task_seq)}",
             inputs=self._input_urls(sub, consumer_index=idx),
         )
-        new = self._submit_on_live(
-            desc, self.workers[idx % len(self.workers)]
-        )
+        new = self._submit_on_live(desc, self._least_loaded_worker())
         self._stage_tasks[fid][idx] = new
         return new
 

@@ -177,18 +177,32 @@ class LocalQueryRunner:
     def _run_query(self, query: ast.Query, stats=None) -> MaterializedResult:
         plan = self.plan_query(query)
         self._check_table_access(plan)
-        physical = LocalExecutionPlanner(
-            self.catalogs,
-            target_splits=self.target_splits,
-            stats=stats,
-            properties=self.properties,
-        ).plan(plan)
-        rows = []
-        for batch in physical.stream:
-            rows.extend(tuple(r) for r in batch.to_pylist())
-        return MaterializedResult(
-            list(plan.column_names), rows, [s.type for s in plan.symbols]
-        )
+
+        def run() -> MaterializedResult:
+            physical = LocalExecutionPlanner(
+                self.catalogs,
+                target_splits=self.target_splits,
+                stats=stats,
+                properties=self.properties,
+            ).plan(plan)
+            rows = []
+            for batch in physical.stream:
+                rows.extend(tuple(r) for r in batch.to_pylist())
+            return MaterializedResult(
+                list(plan.column_names), rows, [s.type for s in plan.symbols]
+            )
+
+        profile_dir = self.properties.get("profile_dir")
+        if profile_dir:
+            # device-kernel attribution (reference role: OperatorStats'
+            # per-operator CPU/wall split; here the XLA profiler records the
+            # actual device kernels — open the trace with tensorboard or
+            # xprof)
+            import jax
+
+            with jax.profiler.trace(profile_dir):
+                return run()
+        return run()
 
     def _exec_SelectStatement(self, stmt: ast.SelectStatement) -> MaterializedResult:
         return self._run_query(stmt.query)

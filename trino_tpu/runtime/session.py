@@ -69,6 +69,13 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             2,
         ),
         PropertyMetadata(
+            "profile_dir",
+            "write an XLA/jax profiler trace of each query to this "
+            "directory (device kernel times; '' = off)",
+            str,
+            "",
+        ),
+        PropertyMetadata(
             "task_concurrency",
             "parallel split readers per table scan (local exchange width; "
             "reference: SystemSessionProperties TASK_CONCURRENCY)",

@@ -100,11 +100,22 @@ class WorkerServer:
     """One worker process: accepts tasks, executes fragments, serves
     result buckets."""
 
-    def __init__(self, catalogs=None, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(
+        self,
+        catalogs=None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        max_concurrent_tasks: int = 4,
+    ):
         from trino_tpu.connectors.api import default_catalogs
 
         self.catalogs = catalogs or default_catalogs()
         self._tasks: dict[str, _Task] = {}
+        #: TaskExecutor analog (reference: execution/executor/
+        #: TaskExecutor.java): a bounded number of concurrently RUNNING
+        #: tasks; excess submissions queue on the semaphore instead of
+        #: oversubscribing the host
+        self._slots = threading.Semaphore(max(1, max_concurrent_tasks))
         self._secret = cluster_secret()
         if host not in ("127.0.0.1", "localhost") and self._secret is None:
             raise ValueError(
@@ -209,6 +220,7 @@ class WorkerServer:
         return t
 
     def _run(self, t: _Task) -> None:
+        self._slots.acquire()
         try:
             t.buckets = self._execute(t.desc)
             t.state = "FINISHED"
@@ -216,6 +228,7 @@ class WorkerServer:
             t.state = "FAILED"
             t.error = traceback.format_exc()
         finally:
+            self._slots.release()
             t.done.set()
 
     def _execute(self, desc: TaskDescriptor) -> list:
