@@ -72,3 +72,30 @@ def test_array_agg_skips_nulls(runner):
         "select array_agg(v) from memory.default.aa group by g"
     ).rows
     assert sorted(rows[0][0]) == [10, 30]
+
+
+def test_listagg_ordered(runner):
+    rows = runner.execute(
+        "select listagg(r_name, ', ') within group (order by r_name) "
+        "from region"
+    ).rows
+    assert rows == [("AFRICA, AMERICA, ASIA, EUROPE, MIDDLE EAST",)]
+
+
+def test_listagg_grouped(runner):
+    rows = runner.execute(
+        "select n_regionkey, listagg(n_name, '|') within group (order by n_name) "
+        "from nation where n_nationkey < 6 group by n_regionkey order by 1"
+    ).rows
+    assert rows == [
+        (0, "ALGERIA|ETHIOPIA"),
+        (1, "ARGENTINA|BRAZIL|CANADA"),
+        (4, "EGYPT"),
+    ]
+
+
+def test_listagg_empty_is_null(runner):
+    rows = runner.execute(
+        "select listagg(r_name) from region where r_regionkey > 99"
+    ).rows
+    assert rows == [(None,)]

@@ -59,7 +59,7 @@ class AggSpec:
 from trino_tpu.planner.functions import HOLISTIC_AGGS
 
 #: collect subset of the holistic aggregates (padded-array group state)
-COLLECT_AGGS = ("array_agg", "map_agg")
+COLLECT_AGGS = ("array_agg", "map_agg", "listagg")
 
 #: moment family: grouped state is (sum, sum-of-squares, count)
 MOMENT = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
@@ -778,6 +778,8 @@ class AggregationOperator:
                     )
                 if spec.name == "percentile":
                     cols.append(self._percentile_one(batch, spec, out_cap))
+                elif spec.name == "listagg":
+                    cols.append(self._listagg_one(batch, spec, out_cap))
                 else:
                     cols.append(
                         self._collect_one(batch, spec, perm, live, gid_c, nseg, out_cap)
@@ -870,6 +872,62 @@ class AggregationOperator:
         packed = jnp.concatenate([keys[:out_cap], vals[:out_cap]], axis=1)
         return Column(packed, mt, None, dictionary, lengths)
 
+    def _listagg_one(self, batch: Batch, spec: AggSpec, out_cap: int) -> Column:
+        """listagg(value, sep) WITHIN GROUP (ORDER BY k) — reference:
+        operator/aggregation/listagg/.  Eager: rows sort by
+        (group keys, order key) on device; the per-group string join is
+        host work by nature (strings live in dictionaries)."""
+        import numpy as np
+
+        from trino_tpu.columnar.dictionary import StringDictionary
+
+        gch = self.group_channels
+        col = batch.columns[spec.arg]
+        if col.dictionary is None:
+            raise TypeError("listagg requires a varchar argument")
+        sep, asc, nf = (
+            spec.param
+            if isinstance(spec.param, tuple)
+            else (spec.param or "", True, False)
+        )
+        keys = [SortKey(ch) for ch in gch]
+        if spec.arg2 is not None:
+            keys.append(SortKey(spec.arg2, ascending=asc, nulls_first=nf))
+        perm2 = multi_key_sort_perm(batch, keys)
+        if gch:
+            gid2, _, _ = group_ids_from_sorted(batch, perm2, gch)
+            gid_h = np.asarray(jax.device_get(gid2))
+        else:
+            gid_h = np.zeros(batch.capacity, dtype=np.int64)
+        live = jnp.take(batch.mask(), perm2, mode="clip")
+        if col.valid is not None:
+            live = jnp.logical_and(
+                live, jnp.take(col.valid, perm2, mode="clip")
+            )
+        codes = jnp.take(col.data, perm2, mode="clip")
+        live_h = np.asarray(jax.device_get(live))
+        codes_h = np.asarray(jax.device_get(codes))
+        sep = str(sep)
+        values = col.dictionary.values
+        joined = [""] * out_cap
+        parts: dict = {}
+        for i in np.flatnonzero(live_h):
+            g = int(gid_h[i])
+            if g < out_cap:
+                parts.setdefault(g, []).append(values[int(codes_h[i])])
+        valid_out = np.zeros(out_cap, dtype=bool)
+        for g, vs in parts.items():
+            joined[g] = sep.join(vs)
+            valid_out[g] = True
+        d = StringDictionary.from_unsorted(joined)
+        out_codes = d.encode(joined)
+        return Column(
+            np.asarray(out_codes, dtype=np.int32),
+            spec.out_type,
+            valid_out if not valid_out.all() else None,
+            d,
+        )
+
     def _percentile_one(self, batch: Batch, spec: AggSpec, out_cap: int) -> Column:
         """Exact per-group percentile: re-sort by (group keys, value) and
         pick the nearest-rank row of each group (reference role:
@@ -958,6 +1016,9 @@ class AggregationOperator:
                     raise NotImplementedError(
                         f"{spec.name} requires single-stage aggregation"
                     )
+                if spec.name == "listagg":
+                    cols.append(self._listagg_one(batch, spec, 1))
+                    continue
                 # one global group: reuse the grouped collect with gid=0
                 cap = batch.capacity
                 perm = jnp.arange(cap, dtype=jnp.int64)

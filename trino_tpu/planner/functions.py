@@ -40,10 +40,12 @@ AGG_FUNCS = {
     # operator/aggregation/ArrayAggregationFunction, MapAggAggregationFunction)
     "array_agg": "array_agg",
     "map_agg": "map_agg",
+    "listagg": "listagg",
+    "string_agg": "listagg",
 }
 
 #: aggregates that need every group row co-located (no partial/merge states)
-HOLISTIC_AGGS = ("percentile", "array_agg", "map_agg")
+HOLISTIC_AGGS = ("percentile", "array_agg", "map_agg", "listagg")
 
 #: aggregates whose grouped state is the (count, sum, sum-of-squares) triple
 MOMENT_AGGS = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
@@ -74,6 +76,8 @@ def agg_result_type(name: str, arg_type: T.Type | None, arg_type2: T.Type | None
         return arg_type
     if name == "array_agg":
         return T.ArrayType(arg_type)
+    if name == "listagg":
+        return T.VARCHAR
     if name == "map_agg":
         return T.MapType(arg_type, arg_type2 if arg_type2 is not None else T.BIGINT)
     raise TypeError(f"unknown aggregate {name}")

@@ -909,6 +909,34 @@ class LogicalPlanner:
                         )
                     param = float(p_ir.value)
                     fn_args = fn_args[:1]
+                if fname == "listagg":
+                    # listagg(value [, separator]) [WITHIN GROUP (ORDER BY k)]
+                    # — separator folds to the AggSpec param; the first order
+                    # key rides as a second projected argument
+                    sep = ""  # SQL:2016 default: empty separator
+                    if len(fn_args) > 1:
+                        from trino_tpu.expr.constant_folding import try_fold
+
+                        s_ir = try_fold(src_an.analyze(fn_args[1]))
+                        if not isinstance(s_ir, Literal) or not isinstance(
+                            s_ir.value, str
+                        ):
+                            raise AnalysisError(
+                                "listagg separator must be a string literal"
+                            )
+                        sep = s_ir.value
+                    if len(fc.within_group) > 1:
+                        raise AnalysisError(
+                            "listagg supports a single WITHIN GROUP order key"
+                        )
+                    order = fc.within_group[0] if fc.within_group else None
+                    # param carries (separator, ascending, nulls_first)
+                    param = (
+                        sep,
+                        order.ascending if order is not None else True,
+                        bool(order.nulls_first) if order is not None and order.nulls_first is not None else False,
+                    )
+                    fn_args = fn_args[:1] + ([order.expr] if order is not None else [])
                 arg_irs = [src_an.analyze(a) for a in fn_args]
                 key = (
                     fname,

@@ -79,6 +79,7 @@ class FunctionCall(Node):
     is_star: bool = False  # count(*)
     window: object = None  # Window spec or None
     filter: object = None  # FILTER (WHERE ...) expression
+    within_group: tuple = ()  # LISTAGG ... WITHIN GROUP (ORDER BY ...) keys
 
 
 @dataclass(frozen=True)

@@ -1234,6 +1234,19 @@ class Parser:
             while self.accept_op(","):
                 args.append(self._expr())
         self.expect_op(")")
+        within_group: tuple = ()
+        if name.lower() in ("listagg", "string_agg") and self.accept_kw("within"):
+            # LISTAGG(x, sep) WITHIN GROUP (ORDER BY k) — the ordering is
+            # applied by the sorted collect path
+            self.expect_kw("group")
+            self.expect_op("(")
+            self.expect_kw("order")
+            self.expect_kw("by")
+            items = [self._sort_item()]
+            while self.accept_op(","):
+                items.append(self._sort_item())
+            self.expect_op(")")
+            within_group = tuple(items)  # full SortItems (DESC/NULLS kept)
         filt = None
         if self.accept_kw("filter"):
             self.expect_op("(")
@@ -1268,7 +1281,7 @@ class Parser:
                 frame = ast.WindowFrame(kind, start, end)
             self.expect_op(")")
             window = ast.WindowSpec(tuple(partition_by), tuple(order_by), frame)
-        return ast.FunctionCall(name.lower(), tuple(args), distinct, is_star, window, filt)
+        return ast.FunctionCall(name.lower(), tuple(args), distinct, is_star, window, filt, within_group)
 
     def _frame_bound(self) -> ast.FrameBound:
         """reference: SqlBase.g4 frameBound / sql/tree/FrameBound.java."""
