@@ -67,3 +67,20 @@ def test_unknown_connector_rejected(tmp_path):
     (cat / "bad.properties").write_text("connector.name=nope\n")
     with pytest.raises(ValueError, match="unknown connector.name"):
         load_etc(str(tmp_path))
+
+
+def test_file_event_listener(etc_dir, tmp_path):
+    import json
+
+    from trino_tpu.runtime.config import runner_from_etc
+
+    log = tmp_path / "events.jsonl"
+    import os
+
+    with open(os.path.join(etc_dir, "event-listener.properties"), "w") as fh:
+        fh.write(f"event-listener.name=file\nfile.path={log}\n")
+    r = runner_from_etc(etc_dir)
+    r.execute("select 1")
+    lines = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [d["event"] for d in lines] == ["query_created", "query_completed"]
+    assert lines[1]["state"] == "FINISHED" and lines[1]["rows"] == 1

@@ -161,6 +161,22 @@ def runner_from_etc(etc_dir: str, **kw):
             r.properties.set(k, v)
         except Exception:
             pass
+    # event-listener plugin loading (reference: etc/event-listener.properties
+    # with event-listener.name=...)
+    el_path = os.path.join(etc_dir, "event-listener.properties")
+    if os.path.exists(el_path):
+        el_props = load_properties(el_path)
+        el_name = el_props.get("event-listener.name")
+        if el_name != "file":
+            raise ValueError(
+                f"event-listener.properties: unknown event-listener.name "
+                f"{el_name!r} (supported: 'file')"
+            )
+        if "file.path" not in el_props:
+            raise ValueError("event-listener.properties: missing file.path")
+        from trino_tpu.runtime.events import FileEventListener
+
+        r.events.add(FileEventListener(el_props["file.path"]))
     ac_file = cfg.node_properties.get("access-control.config-file")
     if ac_file:
         import json

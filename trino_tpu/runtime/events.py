@@ -65,6 +65,48 @@ class EventListenerManager:
                 pass
 
 
+class FileEventListener(EventListener):
+    """Append query events as JSON lines (reference role: the
+    http/kafka event-listener plugins' sink, file-backed — the shape an
+    external audit pipeline ingests)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        # surface unwritable paths at STARTUP — the manager swallows
+        # per-event listener errors, so a bad path would otherwise drop the
+        # whole audit trail silently
+        with open(path, "a", encoding="utf-8"):
+            pass
+
+    def _write(self, doc: dict) -> None:
+        import json
+
+        with open(self.path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(doc) + "\n")
+
+    def query_created(self, e: QueryCreatedEvent) -> None:
+        self._write(
+            {
+                "event": "query_created",
+                "query_id": e.query_id,
+                "sql": e.sql,
+                "create_time": e.create_time,
+            }
+        )
+
+    def query_completed(self, e: QueryCompletedEvent) -> None:
+        self._write(
+            {
+                "event": "query_completed",
+                "query_id": e.query_id,
+                "state": e.state,
+                "wall_s": e.wall_s,
+                "rows": e.rows,
+                "error": e.error,
+            }
+        )
+
+
 class CollectingEventListener(EventListener):
     """Test fixture (reference: testing EventsCollector)."""
 
