@@ -524,6 +524,150 @@ def _date_trunc_year(ctx, call, a):
     return Val(_days_from_civil(y, jnp.asarray(1), jnp.asarray(1)), a.valid, T.DATE)
 
 
+def _add_months_days(days, k):
+    """Day-number + k months with month-end clamping (shared by date_add
+    and date_diff's complete-period check)."""
+    y, m, d = _civil_from_days(days)
+    months = y * 12 + (m - 1) + k
+    ny, nm = months // 12, months % 12 + 1
+    last = _days_from_civil(
+        jnp.where(nm == 12, ny + 1, ny),
+        jnp.where(nm == 12, 1, nm + 1),
+        jnp.asarray(1),
+    ) - _days_from_civil(ny, nm, jnp.asarray(1))
+    return _days_from_civil(ny, nm, jnp.minimum(d, last))
+
+
+def _temporal_micros(v: Val):
+    """(local micros, kind) for date/timestamp/timestamptz values.
+    kind: 'date' | 'ts' | 'tz'."""
+    if v.type is T.TIMESTAMP_TZ:
+        return _tz_local_micros(v), "tz"
+    if v.type is T.TIMESTAMP:
+        return jnp.asarray(v.data, jnp.int64), "ts"
+    return jnp.asarray(v.data, jnp.int64) * 86_400_000_000, "date"
+
+
+def _temporal_pack(us, kind, v: Val):
+    """Local micros back to the value's representation."""
+    if kind == "tz":
+        off = T.unpack_tz_offset(jnp.asarray(v.data, jnp.int64))
+        utc_millis = us // 1000 - off * 60_000
+        return utc_millis * T.TZ_SHIFT + (off + T.TZ_OFFSET_BIAS)
+    if kind == "ts":
+        return us
+    return us // 86_400_000_000
+
+
+@register("date_trunc")
+def _date_trunc(ctx, call, unit, v):
+    """date_trunc(unit, date|timestamp|timestamptz) preserving the input
+    type (reference: scalar/DateTimeFunctions truncate family)."""
+    u = _literal_str(unit, "date_trunc").lower()
+    us, kind = _temporal_micros(v)
+    is_ts = kind != "date"
+    days = us // 86_400_000_000
+    if u in ("second", "minute", "hour"):
+        if not is_ts:
+            return Val(v.data, v.valid, call.type, v.dictionary)
+        step = {"second": 1_000_000, "minute": 60_000_000, "hour": 3_600_000_000}[u]
+        return Val(_temporal_pack((us // step) * step, kind, v), v.valid, call.type)
+    if u == "day":
+        out_days = days
+    elif u == "week":
+        # ISO weeks start Monday; 1970-01-01 was a Thursday
+        out_days = days - (days + 3) % 7
+    elif u in ("month", "year", "quarter"):
+        y, m, _ = _civil_from_days(days)
+        if u == "month":
+            out_days = _days_from_civil(y, m, jnp.asarray(1))
+        elif u == "quarter":
+            qm = ((m - 1) // 3) * 3 + 1
+            out_days = _days_from_civil(y, qm, jnp.asarray(1))
+        else:
+            out_days = _days_from_civil(y, jnp.asarray(1), jnp.asarray(1))
+    else:
+        raise NotImplementedError(f"date_trunc unit {u!r}")
+    return Val(
+        _temporal_pack(out_days * 86_400_000_000, kind, v), v.valid, call.type
+    )
+
+
+_TIME_UNIT_US = {
+    "millisecond": 1000,
+    "second": 1_000_000,
+    "minute": 60_000_000,
+    "hour": 3_600_000_000,
+    "day": 86_400_000_000,
+    "week": 7 * 86_400_000_000,
+}
+
+
+@register("date_add")
+def _date_add_general(ctx, call, unit, n, v):
+    """date_add(unit, value, date|timestamp|timestamptz) (reference:
+    DateTimeFunctions.addFieldValue*)."""
+    u = _literal_str(unit, "date_add").lower().rstrip("s")
+    k = jnp.asarray(n.data, jnp.int64)
+    valid = _and_valid(v.valid, n.valid)
+    us, kind = _temporal_micros(v)
+    if u in ("month", "quarter", "year"):
+        mult = {"month": 1, "quarter": 3, "year": 12}[u]
+        rem = us % 86_400_000_000
+        out_days = _add_months_days(us // 86_400_000_000, k * mult)
+        return Val(
+            _temporal_pack(out_days * 86_400_000_000 + rem, kind, v),
+            valid,
+            call.type,
+        )
+    step = _TIME_UNIT_US.get(u)
+    if step is None:
+        raise NotImplementedError(f"date_add unit {u!r}")
+    if kind == "date" and step < 86_400_000_000:
+        raise TypeError(f"date_add({u!r}) on a DATE value")
+    return Val(_temporal_pack(us + k * step, kind, v), valid, call.type)
+
+
+@register("date_diff")
+def _date_diff_general(ctx, call, unit, a, b):
+    """date_diff(unit, from, to) = complete units between (reference:
+    DateTimeFunctions.diffDate/diffTimestamp — Joda field-difference
+    semantics: partial trailing units do not count, truncation toward 0)."""
+    u = _literal_str(unit, "date_diff").lower().rstrip("s")
+    va, _ = _temporal_micros(a)
+    vb, _ = _temporal_micros(b)
+    valid = _and_valid(a.valid, b.valid)
+    if u in ("month", "quarter", "year"):
+        da = va // 86_400_000_000
+        db = vb // 86_400_000_000
+        ya, ma, _dda = _civil_from_days(da)
+        yb, mb, _ddb = _civil_from_days(db)
+        months = (yb * 12 + mb) - (ya * 12 + ma)
+        # complete-period check honoring month-end clamping: the candidate
+        # count stands only if from + months <= to (sign-symmetric); this
+        # keeps date_add and date_diff mutually consistent (Jan 31 + 1
+        # month = Feb 29 -> diff(Jan 31, Feb 29) = 1)
+        shifted = _add_months_days(da, months)
+        months = (
+            months
+            - jnp.where(jnp.logical_and(months > 0, shifted > db), 1, 0)
+            + jnp.where(jnp.logical_and(months < 0, shifted < db), 1, 0)
+        )
+        div = {"month": 1, "quarter": 3, "year": 12}[u]
+        if div > 1:
+            out = jnp.sign(months) * (jnp.abs(months) // div)
+        else:
+            out = months
+        return Val(out, valid, call.type)
+    step = _TIME_UNIT_US.get(u)
+    if step is None:
+        raise NotImplementedError(f"date_diff unit {u!r}")
+    diff = vb - va
+    # truncate toward zero: -30min is 0 complete hours, not -1
+    out = jnp.sign(diff) * (jnp.abs(diff) // step)
+    return Val(out, valid, call.type)
+
+
 # ---------------------------------------------------------------------------
 # time-of-day + timestamp with time zone
 # (reference: operator/scalar/DateTimeFunctions.java + spi DateTimeEncoding)

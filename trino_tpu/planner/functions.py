@@ -136,6 +136,9 @@ SCALAR_RESULT = {
     "date_add_months": _same_as_first,
     "date_trunc_month": _fixed(T.DATE),
     "date_trunc_year": _fixed(T.DATE),
+    "date_trunc": lambda args: args[1],
+    "date_add": lambda args: args[2],
+    "date_diff": _fixed(T.BIGINT),
     "substr": _fixed(T.VARCHAR),
     "substring": _fixed(T.VARCHAR),
     "upper": _fixed(T.VARCHAR),
