@@ -64,6 +64,9 @@ COLLECT_AGGS = ("array_agg", "map_agg", "listagg")
 #: moment family: grouped state is (sum, sum-of-squares, count)
 MOMENT = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
 
+#: two-input (y, x) regression family: state is the raw-sum sextuple
+BIVARIATE = ("covar_samp", "covar_pop", "corr", "regr_slope", "regr_intercept")
+
 
 #: HyperLogLog registers per sketch: p=13 -> 8192 buckets, standard error
 #: 1.04/sqrt(8192) ~= 1.15% (reference: ApproximateCountDistinctAggregation
@@ -174,6 +177,15 @@ def _primitives(spec: AggSpec):
         # reference: operator/aggregation VarianceState (count/mean/m2 as
         # merged moments; here the raw-sum formulation merges by addition)
         return [("sum_f", spec.arg), ("sumsq", spec.arg), ("count", spec.arg)]
+    if spec.name in BIVARIATE:
+        # reference: operator/aggregation CovarianceState/CorrelationState —
+        # raw-sum formulation, merged by addition; rows with EITHER side
+        # null are skipped entirely (pairwise validity)
+        return [
+            ("bi_sum_1", spec.arg), ("bi_sum_2", spec.arg2),
+            ("bi_sumsq_1", spec.arg), ("bi_sumsq_2", spec.arg2),
+            ("bi_sum_12", spec.arg), ("bi_count", spec.arg),
+        ]
     raise NotImplementedError(f"aggregate: {spec.name}")
 
 
@@ -184,8 +196,10 @@ def _state_types(spec: AggSpec, input_types) -> list[T.Type]:
             out.append(T.ArrayType(T.INTEGER))
         elif kind in ("count", "count_star"):
             out.append(T.BIGINT)
-        elif kind in ("sum_f", "sumsq"):
+        elif kind in ("sum_f", "sumsq")or kind.startswith("bi_sum"):
             out.append(T.DOUBLE)
+        elif kind == "bi_count":
+            out.append(T.BIGINT)
         elif kind == "sum":
             t = input_types[arg]
             if isinstance(t, T.DecimalType):
@@ -210,7 +224,10 @@ def _merge_primitives(spec: AggSpec):
             merged.append("hll")
         else:
             merged.append(
-                "sum" if kind in ("count", "count_star", "sum_f", "sumsq") else kind
+                "sum"
+                if kind in ("count", "count_star", "sum_f", "sumsq")
+                or kind.startswith("bi_")
+                else kind
             )
     return merged
 
@@ -222,6 +239,31 @@ def _finalize(spec: AggSpec, states: list[Column]) -> Column:
         return Column(_hll_estimate(states[0].data), T.BIGINT, None)
     if name in ("count", "count_star"):
         return Column(states[0].data, T.BIGINT, None)
+    if name in BIVARIATE:
+        s1, s2 = states[0].data, states[1].data
+        s11, s22 = states[2].data, states[3].data
+        s12, cnt = states[4].data, states[5].data
+        n = cnt.astype(jnp.float64)
+        nn = jnp.maximum(n, 1.0)
+        # raw-sum forms (reference: CovarianceState.getCovariance etc)
+        co_m = s12 - s1 * s2 / nn  # n * covar_pop
+        v1_m = jnp.maximum(s11 - s1 * s1 / nn, 0.0)
+        v2_m = jnp.maximum(s22 - s2 * s2 / nn, 0.0)
+        if name == "covar_pop":
+            return Column(co_m / nn, T.DOUBLE, cnt > 0)
+        if name == "covar_samp":
+            return Column(co_m / jnp.maximum(n - 1.0, 1.0), T.DOUBLE, cnt > 1)
+        if name == "corr":
+            denom = jnp.sqrt(v1_m * v2_m)
+            ok = jnp.logical_and(cnt > 1, denom > 0)
+            return Column(co_m / jnp.where(ok, denom, 1.0), T.DOUBLE, ok)
+        if name == "regr_slope":
+            ok = jnp.logical_and(cnt > 1, v2_m > 0)
+            return Column(co_m / jnp.where(ok, v2_m, 1.0), T.DOUBLE, ok)
+        # regr_intercept = (sum_y - slope * sum_x) / n
+        ok = jnp.logical_and(cnt > 1, v2_m > 0)
+        slope = co_m / jnp.where(ok, v2_m, 1.0)
+        return Column((s1 - slope * s2) / nn, T.DOUBLE, ok)
     if name in MOMENT:
         s, sq, cnt = states[0].data, states[1].data, states[2].data
         n = cnt.astype(jnp.float64)
@@ -960,6 +1002,27 @@ class AggregationOperator:
         )
         return Column(val, spec.out_type, nvalid[:out_cap] > 0, col.dictionary)
 
+    def _bivariate_series(self, batch, spec, kind, perm, live):
+        """(per-row series, pairwise-valid mask) for one bi_* primitive."""
+        cx = batch.columns[spec.arg]
+        cy = batch.columns[spec.arg2]
+        dx = _logical_double(jnp.take(cx.data, perm, mode="clip"), cx.type)
+        dy = _logical_double(jnp.take(cy.data, perm, mode="clip"), cy.type)
+        v = live
+        if cx.valid is not None:
+            v = jnp.logical_and(v, jnp.take(cx.valid, perm, mode="clip"))
+        if cy.valid is not None:
+            v = jnp.logical_and(v, jnp.take(cy.valid, perm, mode="clip"))
+        series = {
+            "bi_sum_1": dx,
+            "bi_sum_2": dy,
+            "bi_sumsq_1": dx * dx,
+            "bi_sumsq_2": dy * dy,
+            "bi_sum_12": dx * dy,
+            "bi_count": jnp.ones(dx.shape, jnp.int64),
+        }[kind]
+        return series, v
+
     def _reduce_one(self, batch, spec, perm, live, gid, nseg, out_cap):
         if self.mode in ("final", "merge"):
             prims = list(zip(_merge_primitives(spec), _primitives(spec)))
@@ -983,6 +1046,15 @@ class AggregationOperator:
                     jnp.ones(batch.capacity, jnp.int64), gid, nseg, "count", valid=live
                 )[:out_cap]
                 out.append(Column(red, T.BIGINT, None))
+                continue
+            if kind.startswith("bi_"):
+                series, v = self._bivariate_series(batch, spec, kind, perm, live)
+                if kind == "bi_count":
+                    red = segment_reduce(series, gid, nseg, "count", valid=v)[:out_cap]
+                    out.append(Column(red, T.BIGINT, None))
+                else:
+                    red = segment_reduce(series, gid, nseg, "sum", valid=v)[:out_cap]
+                    out.append(Column(red, T.DOUBLE, None))
                 continue
             col = batch.columns[arg]
             d = jnp.take(col.data, perm, mode="clip")
@@ -1090,6 +1162,28 @@ class AggregationOperator:
                         states.append(
                             Column(jnp.sum(live, dtype=jnp.int64)[None], T.BIGINT, None)
                         )
+                        continue
+                    if kind.startswith("bi_"):
+                        perm0 = jnp.arange(batch.capacity, dtype=jnp.int64)
+                        series, v = self._bivariate_series(
+                            batch, spec, kind, perm0, live
+                        )
+                        if kind == "bi_count":
+                            states.append(
+                                Column(
+                                    jnp.sum(v, dtype=jnp.int64)[None],
+                                    T.BIGINT,
+                                    None,
+                                )
+                            )
+                        else:
+                            states.append(
+                                Column(
+                                    jnp.sum(jnp.where(v, series, 0.0))[None],
+                                    T.DOUBLE,
+                                    None,
+                                )
+                            )
                         continue
                     col = batch.columns[arg]
                     v = live

@@ -510,32 +510,10 @@ class StageExecutor:
 
     def _agg_partial(self, node: P.AggregationNode, src: _Dist):
         """Per-worker PARTIAL step; returns (stacked states, specs, op)."""
+        from trino_tpu.runtime.local_planner import build_agg_inputs
+
         ngroups = len(node.group_symbols)
-        proj = [src.rewrite(s.ref()) for s in node.group_symbols]
-        specs: list = []
-        input_types = [s.type for s in node.group_symbols]
-        for out_sym, agg in node.aggregations:
-            name = agg.function
-            arg = src.rewrite(agg.args[0]) if agg.args else None
-            if agg.filter is not None:
-                f = src.rewrite(agg.filter)
-                if name == "count_star":
-                    name, arg = "count", SpecialForm(
-                        Form.IF,
-                        [f, Literal(1, T.BIGINT), Literal(None, T.BIGINT)],
-                        T.BIGINT,
-                    )
-                else:
-                    arg = SpecialForm(
-                        Form.IF, [f, arg, Literal(None, arg.type)], arg.type
-                    )
-            if arg is None:
-                specs.append(AggSpec(name, None, out_sym.type))
-            else:
-                nargs = len([s for s in specs if s.arg is not None])
-                proj.append(arg)
-                input_types.append(arg.type)
-                specs.append(AggSpec(name, ngroups + nargs, out_sym.type))
+        proj, specs, input_types = build_agg_inputs(node, src)
         pre = FilterProjectOperator(None, proj)._make_step()
         partial_op = AggregationOperator(
             list(range(ngroups)), specs, input_types, mode="partial"

@@ -159,7 +159,14 @@ class ExprAnalyzer:
         if "e" in t.lower():
             return Literal(float(t), T.DOUBLE)
         if "." in t:
-            scale = len(t.split(".")[1])
+            ip, fp = t.split(".")
+            scale = len(fp)
+            digits = len(ip.lstrip("-+").lstrip("0")) + scale
+            if digits > 18:
+                # beyond short-decimal range (TOTAL significant digits, not
+                # just scale): a double carries the value without the
+                # scaled-int i64 overflow (documented engine cap)
+                return Literal(float(t), T.DOUBLE)
             return Literal(Decimal(t), T.DecimalType(18, scale))
         v = int(t)
         return Literal(v, T.INTEGER if -(2**31) <= v < 2**31 else T.BIGINT)

@@ -42,6 +42,13 @@ AGG_FUNCS = {
     "map_agg": "map_agg",
     "listagg": "listagg",
     "string_agg": "listagg",
+    # bivariate regression family (reference: operator/aggregation/
+    # CovarianceAggregation, CorrelationAggregation, RegrAggregation)
+    "covar_samp": "covar_samp",
+    "covar_pop": "covar_pop",
+    "corr": "corr",
+    "regr_slope": "regr_slope",
+    "regr_intercept": "regr_intercept",
 }
 
 #: aggregates that need every group row co-located (no partial/merge states)
@@ -78,6 +85,8 @@ def agg_result_type(name: str, arg_type: T.Type | None, arg_type2: T.Type | None
         return T.ArrayType(arg_type)
     if name == "listagg":
         return T.VARCHAR
+    if name in ("covar_samp", "covar_pop", "corr", "regr_slope", "regr_intercept"):
+        return T.DOUBLE
     if name == "map_agg":
         return T.MapType(arg_type, arg_type2 if arg_type2 is not None else T.BIGINT)
     raise TypeError(f"unknown aggregate {name}")

@@ -245,58 +245,7 @@ class LocalExecutionPlanner:
         if any(agg.distinct for _, agg in node.aggregations):
             src = self._distinct_preagg(node, src)
         ngroups = len(node.group_symbols)
-        # input projection: group keys, then one computed arg per aggregate
-        # (FILTER folded as IF(filter, arg, NULL) — null-skipping aggregates
-        # make this exact; reference role: AggregationOperator's mask channel)
-        proj: list[Expr] = [src.rewrite(s.ref()) for s in node.group_symbols]
-        specs: list[AggSpec] = []
-        input_types = [s.type for s in node.group_symbols]
-        for i, (out_sym, agg) in enumerate(node.aggregations):
-            name = agg.function
-            arg: Optional[Expr]
-            arg = src.rewrite(agg.args[0]) if agg.args else None
-            if agg.filter is not None:
-                f = src.rewrite(agg.filter)
-                if name == "count_star":
-                    name = "count"
-                    arg = SpecialForm(
-                        Form.IF,
-                        [f, Literal(1, T.BIGINT), Literal(None, T.BIGINT)],
-                        T.BIGINT,
-                    )
-                else:
-                    arg = SpecialForm(
-                        Form.IF, [f, arg, Literal(None, arg.type)], arg.type
-                    )
-            if arg is None:
-                specs.append(AggSpec(name, None, out_sym.type))
-            else:
-                proj.append(arg)
-                input_types.append(arg.type)
-                arg2_ch = None
-                if len(agg.args) > 1:
-                    # two-input aggregates (map_agg key, value)
-                    arg2 = src.rewrite(agg.args[1])
-                    if agg.filter is not None:
-                        f2 = src.rewrite(agg.filter)
-                        arg2 = SpecialForm(
-                            Form.IF,
-                            [f2, arg2, Literal(None, arg2.type)],
-                            arg2.type,
-                        )
-                    proj.append(arg2)
-                    input_types.append(arg2.type)
-                    arg2_ch = ngroups + len(specs_args(specs)) + 1
-                specs.append(
-                    AggSpec(
-                        name,
-                        ngroups + len(specs_args(specs)),
-                        out_sym.type,
-                        param=getattr(agg, "param", None),
-                        arg2=arg2_ch,
-                    )
-                )
-
+        proj, specs, input_types = build_agg_inputs(node, src)
         pre = FilterProjectOperator(None, proj)
         # holistic aggregates need every group row at once: no streaming
         # partials (reference: ArrayAggregationFunction group state)
@@ -830,6 +779,63 @@ def _agg_raw_wave_stream(make_op, op, feed, key_channels: list, budget: int):
         yield wop.finish()
         if wop.memory_ctx is not None:
             wop.memory_ctx.close()
+
+
+def build_agg_inputs(node: "P.AggregationNode", src) -> tuple:
+    """(projection exprs, AggSpecs, input types) for an AggregationNode —
+    the ONE place the aggregate input layout is decided (group keys first,
+    then one computed arg per aggregate, FILTER folded as IF(filter, arg,
+    NULL), two-input aggregates consuming two channels).  Shared by the
+    local planner and the distributed partial-aggregation path so their
+    channel layouts can never diverge.  Reference role: AggregationOperator
+    input channels + the mask channel."""
+    ngroups = len(node.group_symbols)
+    proj: list = [src.rewrite(s.ref()) for s in node.group_symbols]
+    specs: list = []
+    input_types = [s.type for s in node.group_symbols]
+    for out_sym, agg in node.aggregations:
+        name = agg.function
+        arg = src.rewrite(agg.args[0]) if agg.args else None
+        if agg.filter is not None:
+            f = src.rewrite(agg.filter)
+            if name == "count_star":
+                name = "count"
+                arg = SpecialForm(
+                    Form.IF,
+                    [f, Literal(1, T.BIGINT), Literal(None, T.BIGINT)],
+                    T.BIGINT,
+                )
+            else:
+                arg = SpecialForm(
+                    Form.IF, [f, arg, Literal(None, arg.type)], arg.type
+                )
+        if arg is None:
+            specs.append(AggSpec(name, None, out_sym.type))
+            continue
+        proj.append(arg)
+        input_types.append(arg.type)
+        arg2_ch = None
+        if len(agg.args) > 1:
+            # two-input aggregates (map_agg key/value, covar/corr y/x)
+            arg2 = src.rewrite(agg.args[1])
+            if agg.filter is not None:
+                f2 = src.rewrite(agg.filter)
+                arg2 = SpecialForm(
+                    Form.IF, [f2, arg2, Literal(None, arg2.type)], arg2.type
+                )
+            proj.append(arg2)
+            input_types.append(arg2.type)
+            arg2_ch = ngroups + len(specs_args(specs)) + 1
+        specs.append(
+            AggSpec(
+                name,
+                ngroups + len(specs_args(specs)),
+                out_sym.type,
+                param=getattr(agg, "param", None),
+                arg2=arg2_ch,
+            )
+        )
+    return proj, specs, input_types
 
 
 def specs_args(specs: list) -> list:
