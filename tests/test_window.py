@@ -159,3 +159,34 @@ def test_avg_over_partition(runner):
     for k, v in expected.items():
         # window avg over decimal rounds to the decimal scale
         assert abs(got[int(k)] - float(v)) < 0.0051
+
+
+@pytest.mark.smoke
+def test_bounded_rows_frame_min_max(runner):
+    """Sliding min/max over a bounded-start ROWS frame (sparse-table range
+    query kernel; the round-3 engine rejected these at analysis)."""
+    rows = runner.execute(
+        "select n_nationkey, "
+        "min(n_nationkey) over (partition by n_regionkey order by n_nationkey "
+        "  rows between 2 preceding and 1 following), "
+        "max(n_nationkey) over (partition by n_regionkey order by n_nationkey "
+        "  rows between 1 preceding and current row) "
+        "from nation order by n_regionkey, n_nationkey"
+    ).rows
+    import collections
+
+    by_region = collections.defaultdict(list)
+    base = runner.execute(
+        "select n_regionkey, n_nationkey from nation "
+        "order by n_regionkey, n_nationkey"
+    ).rows
+    for rk, nk in base:
+        by_region[rk].append(nk)
+    expect = {}
+    for rk, vals in by_region.items():
+        for i, v in enumerate(vals):
+            lo = max(0, i - 2)
+            hi = min(len(vals) - 1, i + 1)
+            expect[v] = (min(vals[lo:hi + 1]), max(vals[max(0, i - 1):i + 1]))
+    for nk, got_min, got_max in rows:
+        assert (got_min, got_max) == expect[nk], nk

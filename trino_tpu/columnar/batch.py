@@ -118,16 +118,29 @@ class Batch:
     def to_pylist(self) -> list[list]:
         """Rows of python values (live rows only, in order).
 
-        The whole batch comes back in ONE device_get: per-column fetches pay
-        a full round trip each, which dominates result rendering when the
-        device is behind a remote tunnel."""
-        host = jax.device_get(self)
+        All column transfers are STARTED before any is awaited
+        (copy_to_host_async): device_get alone awaits leaves one at a time,
+        paying a full round trip per column when the device sits behind a
+        remote tunnel."""
+        host = device_get_async(self)
         rm = None if host.row_mask is None else np.asarray(host.row_mask)
         cols = [c.to_pylist(rm) for c in host.columns]
         return [list(r) for r in zip(*cols)] if cols else []
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Batch(cap={self.capacity}, width={self.width})"
+
+
+def device_get_async(tree):
+    """device_get with all leaf transfers launched up front — one round-trip
+    latency for the whole pytree instead of one per leaf."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "copy_to_host_async"):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:
+                pass  # backend without async copies: plain get below
+    return jax.device_get(tree)
 
 
 def _batch_flatten(b: Batch):

@@ -24,7 +24,7 @@ import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.columnar import Batch, Column
-from trino_tpu.columnar.batch import concat_batches
+from trino_tpu.columnar.batch import device_get_async, concat_batches
 from trino_tpu.columnar.dictionary import StringDictionary
 from trino_tpu.expr import ExprCompiler
 from trino_tpu.expr.compiler import Val, _and_valid
@@ -305,7 +305,7 @@ class PatternRecognitionOperator:
             perm = multi_key_sort_perm(big, keys)
             live = jnp.take(big.mask(), perm, mode="clip")
             big = big.gather(perm, valid=live)
-        host = jax.device_get(big)
+        host = device_get_async(big)
         live_h = np.asarray(host.mask())[:n]
         # partition ids from sorted partition-key runs: a new partition
         # starts wherever ANY key's (value, validity) changes — collision
@@ -358,7 +358,7 @@ class PatternRecognitionOperator:
             if cond is None:
                 continue
             mask = compiler.filter_mask(rewrite_nav(cond))
-            ok[vi] = np.asarray(jax.device_get(mask))[:n]
+            ok[vi] = np.asarray(device_get_async(mask))[:n]
         ok &= live_h[None, :]
         var_ix = {v: i for i, v in enumerate(self.vars)}
         # host NFA walk per partition

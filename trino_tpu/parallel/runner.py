@@ -28,7 +28,7 @@ import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.columnar import Batch, Column
-from trino_tpu.columnar.batch import concat_batches
+from trino_tpu.columnar.batch import device_get_async, concat_batches
 from trino_tpu.connectors.api import CatalogManager
 from trino_tpu.expr import ExprCompiler
 from trino_tpu.expr.ir import Form, InputRef, Literal, SpecialForm, and_
@@ -190,7 +190,7 @@ class StageExecutor:
             out = self._fragment_result(sub.fragment.id)
             if isinstance(out, _Dist):  # defensive: root should be SINGLE
                 return PhysicalPlan(
-                    iter([unstack_batch(jax.device_get(out.stacked))]),
+                    iter([unstack_batch(device_get_async(out.stacked))]),
                     out.symbols,
                 )
             return out
@@ -267,7 +267,7 @@ class StageExecutor:
         results already live host-side and stay in the memo."""
         if self.spool is None or not isinstance(res, _Dist):
             return
-        host = jax.device_get(res.stacked)
+        host = device_get_async(res.stacked)
         # full-capacity per-worker shards, masks included (the spooled
         # page files of FileSystemExchangeSink)
         shards = [
@@ -340,7 +340,7 @@ class StageExecutor:
                 live = jnp.logical_and(live, col.valid)
             d = col.data.astype(jnp.int64)
             big = jnp.iinfo(jnp.int64).max
-            lo, hi, n = jax.device_get(
+            lo, hi, n = device_get_async(
                 (
                     jnp.min(jnp.where(live, d, big)),
                     jnp.max(jnp.where(live, d, -big)),
@@ -363,7 +363,7 @@ class StageExecutor:
         if node.exchange_kind == "merge":
             batch = self._merge_gather(child, node)
         else:
-            batch = unstack_batch(jax.device_get(child.stacked))
+            batch = unstack_batch(device_get_async(child.stacked))
         return PhysicalPlan(iter([batch]), child.symbols)
 
     def _merge_gather(self, child: _Dist, node: RemoteSourceNode) -> Batch:
@@ -371,7 +371,7 @@ class StageExecutor:
         (MergeOperator/MergeSortedPages role)."""
         from trino_tpu.ops.merge import merge_sorted_shards
 
-        host = jax.device_get(child.stacked)
+        host = device_get_async(child.stacked)
         keys = [
             SortKey(child.channel(s.name), asc, nf)
             for s, asc, nf in node.orderings
@@ -563,7 +563,7 @@ class StageExecutor:
         gather the per-worker state rows, final merge on the coordinator."""
         states, specs, partial_op = self._agg_partial(node, src)
         final_op = self._final_op(specs, partial_op, states)
-        gathered = unstack_batch(jax.device_get(states))
+        gathered = unstack_batch(device_get_async(states))
         from trino_tpu.ops.aggregation import _pad_device
 
         cap = next_pow2(gathered.capacity, floor=1)
@@ -668,8 +668,10 @@ class StageExecutor:
         start, count, sorted_build = spmd_step(self.wm, locate_step)(
             probe.stacked, build_stacked
         )
-        count_h = np.asarray(jax.device_get(count))  # [W, cap_p]
-        mask_h = np.asarray(jax.device_get(probe.stacked.mask()))
+        count_h, mask_h = (
+            np.asarray(x)
+            for x in device_get_async((count, probe.stacked.mask()))
+        )
         emit_h = (
             np.where(mask_h, np.maximum(count_h, 1), 0)
             if node.kind in ("left", "full")
@@ -731,8 +733,9 @@ class StageExecutor:
                 return False
             return bool(
                 np.any(
-                    np.asarray(jax.device_get(stacked.mask()))
-                    & ~np.asarray(jax.device_get(fcol.valid))
+                    (lambda _m, _v: np.asarray(_m) & ~np.asarray(_v))(
+                        *device_get_async((stacked.mask(), fcol.valid))
+                    )
                 )
             )
 
@@ -767,7 +770,7 @@ class StageExecutor:
                 src.stacked, filt.stacked
             )
             totals = (
-                np.asarray(jax.device_get(count)).sum(axis=-1)  # [W]
+                np.asarray(device_get_async(count)).sum(axis=-1)  # [W]
             )
             out_cap = next_pow2(max(1, int(totals.max())), floor=1024)
 

@@ -1557,11 +1557,6 @@ class _WindowExtractor:
         else:
             raise AnalysisError(f"unknown window function {name}")
         frame, start_off, end_off = _resolve_frame(w.frame, bool(order))
-        if name in ("min", "max") and frame == "rows" and start_off is not None:
-            # the executor's prefix-scan min/max needs an unbounded frame start
-            raise AnalysisError(
-                f"{name} over a bounded-start ROWS frame is not supported"
-            )
         fn = P.WindowFunction(
             name,
             [s.ref() for s in arg_syms],

@@ -14,6 +14,7 @@ from typing import Iterable, Iterator, Optional
 
 from trino_tpu import types as T
 from trino_tpu.columnar import Batch, Column
+from trino_tpu.columnar.batch import device_get_async
 from trino_tpu.connectors.api import CatalogManager
 from trino_tpu.expr.ir import (
     Call,
@@ -579,8 +580,8 @@ def _wave_join_stream(
     from trino_tpu.runtime.memory import batch_bytes
 
     # spill both sides to host RAM (device_get frees HBM references)
-    build_host = [jax.device_get(b) for b in build_batches]
-    probe_host = [jax.device_get(b) for b in probe_stream]
+    build_host = device_get_async(list(build_batches))
+    probe_host = device_get_async(list(probe_stream))
     build_batches.clear()
 
     def make_filter(key_channels):
@@ -661,7 +662,7 @@ def _agg_wave_stream(make_op, feed, key_channels: list, budget: int):
         if op.memory_ctx is not None:
             op.memory_ctx.set_bytes(dev_bytes)
         if dev_bytes > spill_at:
-            host_states.extend(jax.device_get(x) for x in device_states)
+            host_states.extend(device_get_async(list(device_states)))
             device_states.clear()
             dev_bytes = 0
             if op.memory_ctx is not None:
@@ -683,7 +684,7 @@ def _agg_wave_stream(make_op, feed, key_channels: list, budget: int):
         if op.memory_ctx is not None:
             op.memory_ctx.close()
         return
-    host_states.extend(jax.device_get(x) for x in device_states)
+    host_states.extend(device_get_async(list(device_states)))
     device_states.clear()
     total = sum(batch_bytes(b) for b in host_states)
     n_waves = min(64, max(2, math.ceil(2.0 * total / budget)))
@@ -748,7 +749,7 @@ def _agg_raw_wave_stream(make_op, op, feed, key_channels: list, budget: int):
     spool = []
     over = False
     for b in it:
-        spool.append(jax.device_get(b))
+        spool.append(device_get_async(b))
         try:
             op.push(b)
             if op.state_bytes() > budget:
@@ -763,7 +764,7 @@ def _agg_raw_wave_stream(make_op, op, feed, key_channels: list, budget: int):
             op.memory_ctx.close()
         return
     consumed = len(spool)
-    spool.extend(jax.device_get(b) for b in it)
+    spool.extend(device_get_async(list(it)))
     frac = consumed / max(len(spool), 1)
     projected = op.state_bytes() / max(frac, 1e-3)
     n_waves = min(64, max(2, math.ceil(2.0 * projected / budget)))
