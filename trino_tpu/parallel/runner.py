@@ -65,7 +65,7 @@ from trino_tpu.planner.fragmenter import (
 )
 from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
 from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
-from trino_tpu.planner.functions import HOLISTIC_AGGS
+from trino_tpu.planner.functions import HOLISTIC_AGGS, PARTITIONABLE_HOLISTIC
 
 _DIST_KINDS = (SOURCE, FIXED_HASH, FIXED_ARBITRARY)
 
@@ -547,6 +547,32 @@ class StageExecutor:
         src = self._to_stacked(src)
         ngroups = len(node.group_symbols)
         assert ngroups, "grouped aggregation expected in distributed fragment"
+        if any(
+            a.function in PARTITIONABLE_HOLISTIC
+            for _, a in node.aggregations
+        ):
+            # holistic percentile: repartition RAW rows on the group keys so
+            # every group is whole on one worker, then run the single-stage
+            # sort-based aggregation per worker — no partial/merge states
+            # and no coordinator gather (scales like the reference's
+            # single-step aggregation over hash distribution)
+            from trino_tpu.runtime.local_planner import build_agg_inputs
+
+            key_channels = [src.channel(s.name) for s in node.group_symbols]
+            exchanged = ex.repartition(src.stacked, key_channels, self.wm)
+            ex_dist = _Dist(exchanged, src.symbols)
+            proj, specs, input_types = build_agg_inputs(node, ex_dist)
+            op = AggregationOperator(
+                list(range(ngroups)), specs, input_types, mode="single"
+            )
+            pre = FilterProjectOperator(None, proj)._make_step()
+            fcap = _trailing_cap(exchanged)
+
+            def single_step(b: Batch) -> Batch:
+                return op._reduce_step(pre(b), out_cap=fcap)
+
+            out = spmd_step(self.wm, single_step)(exchanged)
+            return _Dist(out, node.outputs)
         states, specs, partial_op = self._agg_partial(node, src)
         exchanged = ex.repartition(states, list(range(ngroups)), self.wm)
         final_op = self._final_op(specs, partial_op, states)

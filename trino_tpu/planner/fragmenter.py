@@ -19,7 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from trino_tpu.planner import plan as P
-from trino_tpu.planner.functions import HOLISTIC_AGGS
+from trino_tpu.planner.functions import HOLISTIC_AGGS, PARTITIONABLE_HOLISTIC
 
 # -- partitioning handles (SystemPartitioningHandle.java:41-57) ---------------
 
@@ -164,16 +164,31 @@ class ExchangePlacer:
         child, dist = self._visit(node.source)
         if dist == _Distribution.SINGLE:
             return node.with_children([child]), _Distribution.SINGLE
-        if any(
-            a.distinct or a.function in HOLISTIC_AGGS
+        needs_gather = any(
+            a.distinct
+            or (
+                a.function in HOLISTIC_AGGS
+                and a.function not in PARTITIONABLE_HOLISTIC
+            )
             for _, a in node.aggregations
-        ):
-            # DISTINCT / percentile aggregates need the whole group on one
-            # node; the local engine handles them after a gather
+        ) or (
+            not node.group_symbols
+            and any(
+                a.function in HOLISTIC_AGGS for _, a in node.aggregations
+            )
+        )
+        if needs_gather:
+            # DISTINCT / collect aggregates (and global holistic aggs) need
+            # the whole group on one node; the local engine handles them
+            # after a gather
             return (
                 node.with_children([self._gathered(child, dist)]),
                 _Distribution.SINGLE,
             )
+        # NOTE: grouped percentile does NOT gather — a hash repartition on
+        # the group keys co-locates each whole group, so the executor runs
+        # the single-stage sort-based percentile per worker (the reference's
+        # single-step aggregation over hash distribution)
         if node.group_symbols:
             # the executor pushes the PARTIAL step to the producing side of
             # the exchange and runs FINAL above it (the

@@ -54,6 +54,11 @@ AGG_FUNCS = {
 #: aggregates that need every group row co-located (no partial/merge states)
 HOLISTIC_AGGS = ("percentile", "array_agg", "map_agg", "listagg")
 
+#: the holistic subset that still DISTRIBUTES: after a hash repartition on
+#: the group keys each group is whole on one worker, and the single-stage
+#: kernel runs fully inside the SPMD step (no eager host work)
+PARTITIONABLE_HOLISTIC = ("percentile",)
+
 #: aggregates whose grouped state is the (count, sum, sum-of-squares) triple
 MOMENT_AGGS = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
 
