@@ -199,3 +199,16 @@ def test_grouped_percentile_stays_distributed(dist, local):
     txt = dist.explain_distributed(sql)
     assert "FIXED_HASH[l_returnflag]" in txt  # not a SINGLE gather
     assert dist.execute(sql).rows == local.execute(sql).rows
+
+
+@pytest.mark.smoke
+def test_grouped_distinct_stays_distributed(dist, local):
+    """Uniform grouped DISTINCT repartitions + dedupes per worker instead of
+    gathering (same shape fix as percentile)."""
+    sql = (
+        "select l_returnflag, count(distinct l_suppkey) from lineitem "
+        "group by l_returnflag order by 1"
+    )
+    txt = dist.explain_distributed(sql)
+    assert "FIXED_HASH[l_returnflag]" in txt
+    assert dist.execute(sql).rows == local.execute(sql).rows

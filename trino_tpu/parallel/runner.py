@@ -547,32 +547,15 @@ class StageExecutor:
         src = self._to_stacked(src)
         ngroups = len(node.group_symbols)
         assert ngroups, "grouped aggregation expected in distributed fragment"
-        if any(
+        if any(a.distinct for _, a in node.aggregations) or any(
             a.function in PARTITIONABLE_HOLISTIC
             for _, a in node.aggregations
         ):
-            # holistic percentile: repartition RAW rows on the group keys so
-            # every group is whole on one worker, then run the single-stage
-            # sort-based aggregation per worker — no partial/merge states
-            # and no coordinator gather (scales like the reference's
-            # single-step aggregation over hash distribution)
-            from trino_tpu.runtime.local_planner import build_agg_inputs
-
-            key_channels = [src.channel(s.name) for s in node.group_symbols]
-            exchanged = ex.repartition(src.stacked, key_channels, self.wm)
-            ex_dist = _Dist(exchanged, src.symbols)
-            proj, specs, input_types = build_agg_inputs(node, ex_dist)
-            op = AggregationOperator(
-                list(range(ngroups)), specs, input_types, mode="single"
-            )
-            pre = FilterProjectOperator(None, proj)._make_step()
-            fcap = _trailing_cap(exchanged)
-
-            def single_step(b: Batch) -> Batch:
-                return op._reduce_step(pre(b), out_cap=fcap)
-
-            out = spmd_step(self.wm, single_step)(exchanged)
-            return _Dist(out, node.outputs)
+            # repartition raw rows on the group keys so every group is whole
+            # on one worker, then run the single-stage kernel per worker
+            # (uniform DISTINCT prepends an in-jit dedupe pre-aggregation) —
+            # no partial/merge states and no coordinator gather
+            return self._spmd_single_stage(node, src)
         states, specs, partial_op = self._agg_partial(node, src)
         exchanged = ex.repartition(states, list(range(ngroups)), self.wm)
         final_op = self._final_op(specs, partial_op, states)
@@ -582,6 +565,50 @@ class StageExecutor:
             return final_op._reduce_step(b, out_cap=fcap)
 
         out = spmd_step(self.wm, final_step)(exchanged)
+        return _Dist(out, node.outputs)
+
+
+    def _spmd_single_stage(self, node: P.AggregationNode, src: _Dist) -> _Dist:
+        """Repartition-on-group-keys + per-worker single-stage aggregation
+        (the distributed home of the holistic/DISTINCT shapes; reference:
+        single-step aggregation over hash distribution)."""
+        from trino_tpu.runtime.local_planner import build_agg_inputs
+
+        ngroups = len(node.group_symbols)
+        key_channels = [src.channel(s.name) for s in node.group_symbols]
+        exchanged = ex.repartition(src.stacked, key_channels, self.wm)
+        ex_dist = _Dist(exchanged, src.symbols)
+        fcap = _trailing_cap(exchanged)
+        pre_dd = None
+        agg_src = ex_dist
+        dedupe = None
+        if any(a.distinct for _, a in node.aggregations):
+            # dedupe layout mirrors LocalExecutionPlanner._distinct_preagg:
+            # group keys then the (uniform) distinct argument columns
+            args0 = next(a for _, a in node.aggregations if a.distinct).args
+            keys = [ex_dist.rewrite(s.ref()) for s in node.group_symbols]
+            dd_proj = keys + [ex_dist.rewrite(a) for a in args0]
+            dedupe = AggregationOperator(
+                list(range(len(dd_proj))), [], [e.type for e in dd_proj],
+                mode="single",
+            )
+            pre_dd = FilterProjectOperator(None, dd_proj)._make_step()
+            dd_symbols = list(node.group_symbols) + [
+                P.Symbol(a.name, a.type) for a in args0
+            ]
+            agg_src = PhysicalPlan(iter(()), dd_symbols)
+        proj, specs, input_types = build_agg_inputs(node, agg_src)
+        op = AggregationOperator(
+            list(range(ngroups)), specs, input_types, mode="single"
+        )
+        pre_agg = FilterProjectOperator(None, proj)._make_step()
+
+        def single_step(b: Batch) -> Batch:
+            if pre_dd is not None:
+                b = dedupe._reduce_step(pre_dd(b), out_cap=fcap)
+            return op._reduce_step(pre_agg(b), out_cap=fcap)
+
+        out = spmd_step(self.wm, single_step)(exchanged)
         return _Dist(out, node.outputs)
 
     def _global_agg(self, node: P.AggregationNode, src: _Dist) -> PhysicalPlan:

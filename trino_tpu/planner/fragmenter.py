@@ -164,12 +164,20 @@ class ExchangePlacer:
         child, dist = self._visit(node.source)
         if dist == _Distribution.SINGLE:
             return node.with_children([child]), _Distribution.SINGLE
-        needs_gather = any(
-            a.distinct
-            or (
-                a.function in HOLISTIC_AGGS
-                and a.function not in PARTITIONABLE_HOLISTIC
-            )
+        from trino_tpu.runtime.local_planner import supports_uniform_distinct
+
+        has_distinct = any(a.distinct for _, a in node.aggregations)
+        # uniform DISTINCT keeps its distributed shape: repartition on group
+        # keys, per-worker dedupe + single-stage agg (the shared predicate
+        # IS the _distinct_preagg support envelope)
+        uniform_distinct = (
+            has_distinct
+            and bool(node.group_symbols)
+            and supports_uniform_distinct(node)
+        )
+        needs_gather = (has_distinct and not uniform_distinct) or any(
+            a.function in HOLISTIC_AGGS
+            and a.function not in PARTITIONABLE_HOLISTIC
             for _, a in node.aggregations
         ) or (
             not node.group_symbols

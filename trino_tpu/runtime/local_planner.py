@@ -290,8 +290,7 @@ class LocalExecutionPlanner:
         MarkDistinct/pre-aggregation rewrites in AddExchanges/optimizer).
         Supported: every distinct aggregate shares the same argument list and
         non-distinct aggregates are absent."""
-        distinct_args = {tuple(a.key() for a in agg.args) for _, agg in node.aggregations if agg.distinct}
-        if len(distinct_args) > 1 or any(not agg.distinct for _, agg in node.aggregations):
+        if not supports_uniform_distinct(node):
             raise NotImplementedError("mixed DISTINCT aggregate shapes")
         keys = [src.rewrite(s.ref()) for s in node.group_symbols]
         args0 = next(agg for _, agg in node.aggregations if agg.distinct).args
@@ -780,6 +779,19 @@ def _agg_raw_wave_stream(make_op, op, feed, key_channels: list, budget: int):
         yield wop.finish()
         if wop.memory_ctx is not None:
             wop.memory_ctx.close()
+
+
+def supports_uniform_distinct(node: "P.AggregationNode") -> bool:
+    """The DISTINCT shape both _distinct_preagg and the distributed
+    repartition path can express: every aggregate DISTINCT over one shared
+    argument list, no FILTER clauses (the fragmenter and executor consult
+    THIS predicate so plan- and run-time envelopes cannot diverge)."""
+    distincts = [a for _, a in node.aggregations if a.distinct]
+    return bool(distincts) and (
+        len(distincts) == len(node.aggregations)
+        and len({tuple(x.key() for x in a.args) for a in distincts}) == 1
+        and all(a.filter is None for a in distincts)
+    )
 
 
 def build_agg_inputs(node: "P.AggregationNode", src) -> tuple:
