@@ -99,3 +99,33 @@ def test_listagg_empty_is_null(runner):
         "select listagg(r_name) from region where r_regionkey > 99"
     ).rows
     assert rows == [(None,)]
+
+
+def test_checksum_order_independent(runner):
+    a = runner.execute("select checksum(l_comment) from lineitem").rows
+    b = runner.execute(
+        "select checksum(l_comment) from "
+        "(select l_comment from lineitem order by l_orderkey desc)"
+    ).rows
+    assert a == b and a[0][0] is not None
+    c = runner.execute(
+        "select checksum(l_comment) from lineitem where l_orderkey > 3"
+    ).rows
+    assert a != c
+    assert runner.execute(
+        "select checksum(n_name) from nation where n_nationkey > 99"
+    ).rows == [(None,)]
+
+
+def test_geometric_mean(runner):
+    import math
+
+    got = runner.execute(
+        "select geometric_mean(l_quantity) from lineitem"
+    ).rows[0][0]
+    vals = [
+        float(x[0])
+        for x in runner.execute("select l_quantity from lineitem").rows
+    ]
+    expect = math.exp(sum(math.log(v) for v in vals) / len(vals))
+    assert abs(got - expect) < 1e-9

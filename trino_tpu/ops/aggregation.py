@@ -67,6 +67,9 @@ MOMENT = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
 #: two-input (y, x) regression family: state is the raw-sum sextuple
 BIVARIATE = ("covar_samp", "covar_pop", "corr", "regr_slope", "regr_intercept")
 
+#: checksum's NULL-row contribution (the reference's PRIME64 role)
+CHECKSUM_NULL_PRIME = 0x9E3779B185EBCA87
+
 
 #: HyperLogLog registers per sketch: p=13 -> 8192 buckets, standard error
 #: 1.04/sqrt(8192) ~= 1.15% (reference: ApproximateCountDistinctAggregation
@@ -79,13 +82,25 @@ def _hll_hash(col: Column):
     """Per-row 64-bit hash of the column's VALUE — stable across workers
     (dictionary codes are producer-local, so dict values hash through a
     trace-time crc table, mirroring parallel/serde.stable_row_hash)."""
-    import zlib
+    import hashlib
 
     d = col.data
     if col.dictionary is not None:
+        # full 64-bit value hash (blake2b/8): checksum() needs real 64-bit
+        # entropy — a 32-bit crc birthday-collides at ~77k distinct values
         table = np.fromiter(
             (
-                zlib.crc32(v.encode() if isinstance(v, str) else bytes(v))
+                np.int64(
+                    np.uint64(
+                        int.from_bytes(
+                            hashlib.blake2b(
+                                v.encode() if isinstance(v, str) else bytes(v),
+                                digest_size=8,
+                            ).digest(),
+                            "little",
+                        )
+                    )
+                )
                 for v in col.dictionary.values
             ),
             dtype=np.int64,
@@ -177,6 +192,14 @@ def _primitives(spec: AggSpec):
         # reference: operator/aggregation VarianceState (count/mean/m2 as
         # merged moments; here the raw-sum formulation merges by addition)
         return [("sum_f", spec.arg), ("sumsq", spec.arg), ("count", spec.arg)]
+    if spec.name == "checksum":
+        # order-independent wrapping sum of per-row value hashes
+        # (reference: operator/aggregation/ChecksumAggregationFunction —
+        # xor/sum of XXH64; ours sums 64-bit hashes, same contract: equal
+        # multisets give equal checksums, mergeable by addition).  NULL rows
+        # contribute a fixed prime (the reference's PRIME64), so NULL
+        # placement changes the checksum and all-NULL input is non-null.
+        return [("checksum", spec.arg), ("count_star", None)]
     if spec.name in BIVARIATE:
         # reference: operator/aggregation CovarianceState/CorrelationState —
         # raw-sum formulation, merged by addition; rows with EITHER side
@@ -195,6 +218,8 @@ def _state_types(spec: AggSpec, input_types) -> list[T.Type]:
         if kind == "hll":
             out.append(T.ArrayType(T.INTEGER))
         elif kind in ("count", "count_star"):
+            out.append(T.BIGINT)
+        elif kind == "checksum":
             out.append(T.BIGINT)
         elif kind in ("sum_f", "sumsq")or kind.startswith("bi_sum"):
             out.append(T.DOUBLE)
@@ -225,7 +250,7 @@ def _merge_primitives(spec: AggSpec):
         else:
             merged.append(
                 "sum"
-                if kind in ("count", "count_star", "sum_f", "sumsq")
+                if kind in ("count", "count_star", "sum_f", "sumsq", "checksum")
                 or kind.startswith("bi_")
                 else kind
             )
@@ -239,6 +264,8 @@ def _finalize(spec: AggSpec, states: list[Column]) -> Column:
         return Column(_hll_estimate(states[0].data), T.BIGINT, None)
     if name in ("count", "count_star"):
         return Column(states[0].data, T.BIGINT, None)
+    if name == "checksum":
+        return Column(states[0].data, T.BIGINT, states[1].data > 0)
     if name in BIVARIATE:
         s1, s2 = states[0].data, states[1].data
         s11, s22 = states[2].data, states[3].data
@@ -1047,6 +1074,20 @@ class AggregationOperator:
                 )[:out_cap]
                 out.append(Column(red, T.BIGINT, None))
                 continue
+            if kind == "checksum":
+                col = batch.columns[arg]
+                h = _hll_hash(col).astype(jnp.int64)  # stable value hash
+                h = jnp.take(h, perm, mode="clip")
+                if col.valid is not None:
+                    nullp = jnp.int64(np.int64(np.uint64(CHECKSUM_NULL_PRIME)))
+                    h = jnp.where(
+                        jnp.take(col.valid, perm, mode="clip"), h, nullp
+                    )
+                red = segment_reduce(
+                    jnp.where(live, h, 0), gid, nseg, "sum", valid=live
+                )[:out_cap]
+                out.append(Column(red, T.BIGINT, None))
+                continue
             if kind.startswith("bi_"):
                 series, v = self._bivariate_series(batch, spec, kind, perm, live)
                 if kind == "bi_count":
@@ -1161,6 +1202,22 @@ class AggregationOperator:
                     if kind == "count_star":
                         states.append(
                             Column(jnp.sum(live, dtype=jnp.int64)[None], T.BIGINT, None)
+                        )
+                        continue
+                    if kind == "checksum":
+                        col = batch.columns[arg]
+                        h = _hll_hash(col).astype(jnp.int64)
+                        if col.valid is not None:
+                            nullp = jnp.int64(
+                                np.int64(np.uint64(CHECKSUM_NULL_PRIME))
+                            )
+                            h = jnp.where(col.valid, h, nullp)
+                        states.append(
+                            Column(
+                                jnp.sum(jnp.where(live, h, 0))[None],
+                                T.BIGINT,
+                                None,
+                            )
                         )
                         continue
                     if kind.startswith("bi_"):

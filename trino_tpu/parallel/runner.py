@@ -572,7 +572,10 @@ class StageExecutor:
         """Repartition-on-group-keys + per-worker single-stage aggregation
         (the distributed home of the holistic/DISTINCT shapes; reference:
         single-step aggregation over hash distribution)."""
-        from trino_tpu.runtime.local_planner import build_agg_inputs
+        from trino_tpu.runtime.local_planner import (
+            build_agg_inputs,
+            build_distinct_dedupe,
+        )
 
         ngroups = len(node.group_symbols)
         key_channels = [src.channel(s.name) for s in node.group_symbols]
@@ -583,19 +586,12 @@ class StageExecutor:
         agg_src = ex_dist
         dedupe = None
         if any(a.distinct for _, a in node.aggregations):
-            # dedupe layout mirrors LocalExecutionPlanner._distinct_preagg:
-            # group keys then the (uniform) distinct argument columns
-            args0 = next(a for _, a in node.aggregations if a.distinct).args
-            keys = [ex_dist.rewrite(s.ref()) for s in node.group_symbols]
-            dd_proj = keys + [ex_dist.rewrite(a) for a in args0]
+            dd_proj, dd_symbols = build_distinct_dedupe(node, ex_dist)
             dedupe = AggregationOperator(
                 list(range(len(dd_proj))), [], [e.type for e in dd_proj],
                 mode="single",
             )
             pre_dd = FilterProjectOperator(None, dd_proj)._make_step()
-            dd_symbols = list(node.group_symbols) + [
-                P.Symbol(a.name, a.type) for a in args0
-            ]
             agg_src = PhysicalPlan(iter(()), dd_symbols)
         proj, specs, input_types = build_agg_inputs(node, agg_src)
         op = AggregationOperator(

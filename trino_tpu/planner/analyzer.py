@@ -513,7 +513,13 @@ def split_conjuncts(node: ast.Node) -> list[ast.Node]:
 def collect_aggregates(node: ast.Node, out: list) -> None:
     """Find aggregate FunctionCalls, not descending into subqueries."""
     if isinstance(node, ast.FunctionCall) and node.window is None:
-        if node.name in AGG_FUNCS or node.is_star and node.name == "count":
+        from trino_tpu.planner.functions import REWRITTEN_AGGS
+
+        if (
+            node.name in AGG_FUNCS
+            or node.name in REWRITTEN_AGGS
+            or (node.is_star and node.name == "count")
+        ):
             out.append(node)
             return  # nested aggs are invalid anyway
     for f in getattr(node, "__dataclass_fields__", {}):

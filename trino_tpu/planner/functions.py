@@ -49,7 +49,14 @@ AGG_FUNCS = {
     "corr": "corr",
     "regr_slope": "regr_slope",
     "regr_intercept": "regr_intercept",
+    # order-independent multiset checksum (reference: ChecksumAggregation)
+    "checksum": "checksum",
 }
+
+#: composite aggregates planned as rewrites over simpler ones (the
+#: geometric_mean -> exp(avg(ln(x))) family); consulted by BOTH aggregate
+#: detection (analyzer.collect_aggregates) and the planning hook
+REWRITTEN_AGGS = ("geometric_mean",)
 
 #: aggregates that need every group row co-located (no partial/merge states)
 HOLISTIC_AGGS = ("percentile", "array_agg", "map_agg", "listagg")
@@ -64,7 +71,7 @@ MOMENT_AGGS = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
 
 
 def agg_result_type(name: str, arg_type: T.Type | None, arg_type2: T.Type | None = None) -> T.Type:
-    if name in ("count", "count_star", "approx_distinct"):
+    if name in ("count", "count_star", "approx_distinct", "checksum"):
         return T.BIGINT
     if name == "sum":
         if arg_type is None:

@@ -25,7 +25,7 @@ from trino_tpu.planner.analyzer import (
     collect_aggregates,
     split_conjuncts,
 )
-from trino_tpu.planner.functions import AGG_FUNCS, agg_result_type
+from trino_tpu.planner.functions import AGG_FUNCS, REWRITTEN_AGGS, agg_result_type
 from trino_tpu.sql import ast
 
 
@@ -999,6 +999,20 @@ class LogicalPlanner:
         def post_hook(node: ast.Node, _an) -> Optional[Expr]:
             if isinstance(node, ast.FunctionCall) and node.name == "grouping":
                 return grouping_ir(node)
+            if (
+                isinstance(node, ast.FunctionCall)
+                and node.name in REWRITTEN_AGGS
+                and node.window is None
+            ):
+                # reference: GeometricMeanAggregations — exp of the mean of
+                # logs; planned as exactly that composition
+                inner = ast.FunctionCall(
+                    "avg",
+                    (ast.FunctionCall("ln", tuple(node.args)),),
+                    distinct=node.distinct,
+                    filter=node.filter,
+                )
+                return Call("exp", [agg_symbol(inner).ref()], T.DOUBLE)
             if isinstance(node, ast.FunctionCall) and node.window is None and (
                 node.name in AGG_FUNCS or (node.is_star and node.name == "count")
             ):

@@ -292,18 +292,12 @@ class LocalExecutionPlanner:
         non-distinct aggregates are absent."""
         if not supports_uniform_distinct(node):
             raise NotImplementedError("mixed DISTINCT aggregate shapes")
-        keys = [src.rewrite(s.ref()) for s in node.group_symbols]
-        args0 = next(agg for _, agg in node.aggregations if agg.distinct).args
-        arg_exprs = [src.rewrite(a) for a in args0]
-        proj = keys + arg_exprs
+        proj, symbols = build_distinct_dedupe(node, src)
         dedupe = AggregationOperator(
             list(range(len(proj))), [], [e.type for e in proj], mode="single", streaming=True
         )
         pre = FilterProjectOperator(None, proj)
         stream = dedupe.process(pre.process(src.stream))
-        # layout: group symbols then the distinct arg values under their
-        # original symbol names (args are SymbolRefs by construction)
-        symbols = list(node.group_symbols) + [P.Symbol(a.name, a.type) for a in args0]
         return PhysicalPlan(stream, symbols)
 
     # -- joins ----------------------------------------------------------------
@@ -792,6 +786,20 @@ def supports_uniform_distinct(node: "P.AggregationNode") -> bool:
         and len({tuple(x.key() for x in a.args) for a in distincts}) == 1
         and all(a.filter is None for a in distincts)
     )
+
+
+def build_distinct_dedupe(node: "P.AggregationNode", src) -> tuple:
+    """(projection exprs, output symbols) of the DISTINCT dedupe
+    pre-aggregation — group keys then the (uniform) distinct argument
+    columns.  The ONE place this layout is decided; used by the local
+    planner and the distributed single-stage path."""
+    args0 = next(a for _, a in node.aggregations if a.distinct).args
+    keys = [src.rewrite(s.ref()) for s in node.group_symbols]
+    proj = keys + [src.rewrite(a) for a in args0]
+    symbols = list(node.group_symbols) + [
+        P.Symbol(a.name, a.type) for a in args0
+    ]
+    return proj, symbols
 
 
 def build_agg_inputs(node: "P.AggregationNode", src) -> tuple:
