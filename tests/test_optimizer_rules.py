@@ -1,0 +1,76 @@
+"""Predicate pushdown rule tests (reference: PredicatePushDown.java's
+union/project/aggregation handling + TestPredicatePushdown)."""
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+def test_filter_through_union_reaches_scans(runner):
+    txt = runner.explain(
+        "select * from (select n_nationkey k from nation "
+        "union all select r_regionkey from region) where k < 3"
+    )
+    # no residual Filter nodes: both branches push into their scans
+    assert "Filter" not in txt
+    assert txt.count("pushed=") == 2
+
+
+def test_union_pushdown_results(runner):
+    rows = sorted(
+        runner.execute(
+            "select * from (select n_nationkey k from nation "
+            "union all select r_regionkey from region) where k < 3"
+        ).rows
+    )
+    assert rows == [(0,), (0,), (1,), (1,), (2,), (2,)]
+
+
+def test_having_on_group_key_pushes_below_agg(runner):
+    txt = runner.explain(
+        "select n_regionkey, count(*) c from nation "
+        "group by n_regionkey having n_regionkey < 2"
+    )
+    assert "pushed=" in txt and "Filter" not in txt
+    rows = runner.execute(
+        "select n_regionkey, count(*) c from nation "
+        "group by n_regionkey having n_regionkey < 2 order by 1"
+    ).rows
+    assert rows == [(0, 5), (1, 5)]
+
+
+def test_having_on_aggregate_stays_above(runner):
+    rows = runner.execute(
+        "select n_regionkey, count(*) c from nation "
+        "group by n_regionkey having count(*) > 4 order by 1"
+    ).rows
+    assert len(rows) == 5  # every region has 5 nations
+
+
+def test_filter_through_computed_project(runner):
+    rows = runner.execute(
+        "select k2 from (select n_nationkey * 2 as k2 from nation) "
+        "where k2 <= 4 order by 1"
+    ).rows
+    assert rows == [(0,), (2,), (4,)]
+
+
+def test_union_pushdown_coerced_branch_types(runner):
+    """date-unioned-with-timestamp branches must compare in the COERCED
+    type: the pushed predicate carries the union's cast (and constant
+    folding converts date->timestamp literals by unit, not bit reuse)."""
+    rows = runner.execute(
+        "select * from (select date '2024-01-02' d "
+        "union all select timestamp '2024-01-01 00:00:00' d) "
+        "where d > timestamp '2024-01-01 12:00:00'"
+    ).rows
+    import datetime
+
+    assert rows == [(datetime.datetime(2024, 1, 2, 0, 0),)]

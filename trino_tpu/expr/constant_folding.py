@@ -67,6 +67,10 @@ def try_fold(e: Expr) -> Expr:
             if v is None:
                 return Literal(None, e.type)
             frm = kids[0].type
+            if frm is T.DATE and e.type is T.TIMESTAMP:
+                return Literal(int(v) * 86_400_000_000, e.type)
+            if frm is T.TIMESTAMP and e.type is T.DATE:
+                return Literal(int(v) // 86_400_000_000, e.type)
             if frm is T.TIMESTAMP_TZ or e.type is T.TIMESTAMP_TZ:
                 # packed-tz bits are not interchangeable with plain temporal
                 # encodings; fold the conversions explicitly

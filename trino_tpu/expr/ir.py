@@ -156,6 +156,19 @@ class SpecialForm(Expr):
 # -- convenience constructors used throughout the planner --------------------
 
 
+def substitute_symbols(expr: "Expr", mapping: dict) -> "Expr":
+    """Replace SymbolRefs by name with mapped expressions (bottom-up).
+    The mapping value is used as-is — callers wrap in CAST when the
+    replacement's type differs from the symbol's."""
+
+    def fn(x):
+        if isinstance(x, SymbolRef) and x.name in mapping:
+            return mapping[x.name]
+        return x
+
+    return visit(expr, fn)
+
+
 def and_(*args: Expr) -> Expr:
     flat = []
     for a in args:

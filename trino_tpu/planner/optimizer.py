@@ -117,6 +117,99 @@ def rule_mixed_distinct(node: P.PlanNode):
     return P.AggregationNode(src, node.group_symbols, new_aggs, node.step)
 
 
+def rule_push_filter_through_project(node: P.PlanNode):
+    """Filter(Project) -> Project(Filter) with the project's assignments
+    inlined into the predicate (reference: PredicatePushDown's
+    ExpressionSymbolInliner).  Safe because every engine expression is
+    deterministic; XLA CSE dedupes the doubled computation inside the fused
+    fragment."""
+    from trino_tpu.expr.ir import substitute_symbols
+
+    if not (
+        isinstance(node, P.FilterNode) and isinstance(node.source, P.ProjectNode)
+    ):
+        return None
+    proj = node.source
+    mapping = {s.name: e for s, e in proj.assignments}
+    return P.ProjectNode(
+        P.FilterNode(proj.source, substitute_symbols(node.predicate, mapping)),
+        proj.assignments,
+    )
+
+
+def rule_push_filter_through_union(node: P.PlanNode):
+    """Filter(Union) -> Union(Filter(child_i)) with the predicate rewritten
+    per branch through the union's symbol mapping (reference:
+    iterative/rule/PushdownFilterIntoUnion semantics via PredicatePushDown's
+    union handling).  Filtering before the concat shrinks every branch's
+    static shapes and exchanges."""
+    from trino_tpu import types as T
+    from trino_tpu.expr.ir import Form, SpecialForm, substitute_symbols
+
+    if not (isinstance(node, P.FilterNode) and isinstance(node.source, P.UnionNode)):
+        return None
+    u = node.source
+    if not u.source_symbols:
+        return None
+    new_sources = []
+    for i, src in enumerate(u.sources):
+        mapping = {}
+        for j, out in enumerate(u.symbols):
+            s = u.source_symbols[i][j]
+            e = s.ref()
+            if s.type.name != out.type.name:
+                if s.type is T.UNKNOWN or out.type is T.UNKNOWN:
+                    return None  # NULL-literal branch: let the union coerce
+                # the branch column COERCES to the union output type (date
+                # unioned with timestamp compares in micros, not days) —
+                # push the same cast the union lowering inserts
+                e = SpecialForm(Form.CAST, [e], out.type)
+            mapping[out.name] = e
+        new_sources.append(
+            P.FilterNode(src, substitute_symbols(node.predicate, mapping))
+        )
+    return P.UnionNode(new_sources, u.symbols, u.source_symbols)
+
+
+def rule_push_filter_through_aggregation(node: P.PlanNode):
+    """Conjuncts over GROUP KEYS move below the aggregation (reference:
+    iterative/rule/PushPredicateThroughProjectIntoRowNumber family /
+    PredicatePushDown's aggregation handling) — pre-agg filtering shrinks
+    the grouped sort and every aggregate's input."""
+    from trino_tpu.expr.ir import and_
+    from trino_tpu.planner.join_planning import (
+        collect_symbol_names,
+        split_conjuncts_ir,
+    )
+
+    if not (
+        isinstance(node, P.FilterNode)
+        and isinstance(node.source, P.AggregationNode)
+    ):
+        return None
+    agg = node.source
+    if not agg.group_symbols or agg.step != "single":
+        return None
+    group_names = {s.name for s in agg.group_symbols}
+    below, above = [], []
+    for c in split_conjuncts_ir(node.predicate):
+        if collect_symbol_names(c) <= group_names:
+            below.append(c)
+        else:
+            above.append(c)
+    if not below:
+        return None
+    new_agg = P.AggregationNode(
+        P.FilterNode(agg.source, and_(*below)),
+        agg.group_symbols,
+        agg.aggregations,
+        agg.step,
+    )
+    if above:
+        return P.FilterNode(new_agg, and_(*above))
+    return new_agg
+
+
 def rule_remove_identity_project(node: P.PlanNode):
     """Drop no-op projections (reference: iterative/rule/
     RemoveRedundantIdentityProjections.java)."""
@@ -141,6 +234,9 @@ def optimize(plan: P.OutputNode, rules=None, catalogs=None) -> P.OutputNode:
             push_filter_through_semijoin,
             lambda n: eliminate_cross_joins(n, catalogs),
             push_filter_through_join,
+            rule_push_filter_through_union,
+            rule_push_filter_through_project,
+            rule_push_filter_through_aggregation,
             rule_push_filter_into_scan,
             rule_remove_identity_project,
             rule_mixed_distinct,
