@@ -1,0 +1,88 @@
+"""Array lambda function tests (reference: operator/scalar/
+ArrayTransformFunction, ArrayFilterFunction, ArrayAnyMatchFunction family,
+ReduceFunction, ArraySliceFunction, ArrayConcatFunction)."""
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+def test_transform(runner):
+    assert runner.execute(
+        "select transform(array[1,2,3], x -> x * 2)"
+    ).rows == [([2, 4, 6],)]
+
+
+def test_transform_strings(runner):
+    assert runner.execute(
+        "select transform(array['a','bb'], x -> upper(x))"
+    ).rows == [(["A", "BB"],)]
+
+
+def test_transform_captures_outer_column(runner):
+    # nation 1 (ARGENTINA) is in region 1: [1+1, 2+1]
+    assert runner.execute(
+        "select transform(array[1,2], x -> x + n_regionkey) "
+        "from nation where n_nationkey = 1"
+    ).rows == [([2, 3],)]
+
+
+def test_filter(runner):
+    assert runner.execute(
+        "select filter(array[1,2,3,4], x -> x % 2 = 0)"
+    ).rows == [([2, 4],)]
+    assert runner.execute(
+        "select filter(array[1,2,3], x -> x > 10)"
+    ).rows == [([],)]
+
+
+def test_match_family(runner):
+    assert runner.execute(
+        "select any_match(array[1,2], x -> x > 1), "
+        "all_match(array[1,2], x -> x > 0), "
+        "none_match(array[1,2], x -> x > 5)"
+    ).rows == [(True, True, True)]
+
+
+def test_reduce(runner):
+    assert runner.execute(
+        "select reduce(array[1,2,3], 0, (s, x) -> s + x, s -> s)"
+    ).rows == [(6,)]
+    assert runner.execute(
+        "select reduce(array[1,2,3], 1, (s, x) -> s * x, s -> s * 10)"
+    ).rows == [(60,)]
+
+
+def test_reduce_over_table(runner):
+    rows = runner.execute(
+        "select sum(reduce(l, 0, (s, x) -> s + x, s -> s)) from "
+        "(select array[l_linenumber, 1] l from lineitem limit 100)"
+    ).rows
+    assert rows[0][0] > 100
+
+
+def test_array_concat_operator(runner):
+    assert runner.execute("select array[1,2] || array[3]").rows == [([1, 2, 3],)]
+    assert runner.execute(
+        "select array['a'] || array['b','c']"
+    ).rows == [(["a", "b", "c"],)]
+
+
+def test_slice(runner):
+    assert runner.execute(
+        "select slice(array[1,2,3,4], 2, 2), slice(array[1,2,3,4], -2, 5)"
+    ).rows == [([2, 3], [3, 4])]
+
+
+def test_typeof_version_concat_ws(runner):
+    rows = runner.execute(
+        "select typeof(1), typeof(array[1]), concat_ws('-', 'a', 'b', 'c')"
+    ).rows
+    assert rows == [("integer", "array(integer)", "a-b-c")]

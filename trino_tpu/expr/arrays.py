@@ -297,6 +297,193 @@ def _parse_json_path(path: str):
     return steps
 
 
+@register("slice")
+def _slice_array(ctx, call, arr, start, length):
+    """slice(array, start, length), 1-based; negative start counts from the
+    end (reference: ArraySliceFunction)."""
+    data, lens = _arr2d(ctx, arr)
+    cap, k = data.shape
+    if k == 0:
+        return Val(data, arr.valid, call.type, arr.dictionary, lens)
+    s = jnp.broadcast_to(jnp.asarray(start.data, jnp.int64), (cap,))
+    n = jnp.broadcast_to(jnp.asarray(length.data, jnp.int64), (cap,))
+    ln = lens.astype(jnp.int64)
+    begin = jnp.where(s < 0, ln + s, s - 1)  # 0-based
+    begin_c = jnp.clip(begin, 0, k)
+    take = jnp.clip(jnp.minimum(n, ln - begin_c), 0, k)
+    idx = begin_c[:, None] + jnp.arange(k, dtype=jnp.int64)[None, :]
+    out = jnp.take_along_axis(data, jnp.clip(idx, 0, k - 1), axis=1)
+    new_lens = jnp.where(begin < 0, 0, take).astype(jnp.int32)
+    valid = _and_valid(_and_valid(arr.valid, start.valid), length.valid)
+    # start=0 / negative length: the reference raises INVALID_FUNCTION_
+    # ARGUMENT; row-wise errors aren't expressible, so those rows are NULL
+    valid = _and_valid(valid, jnp.logical_and(s != 0, n >= 0))
+    return Val(out, valid, call.type, arr.dictionary, new_lens)
+
+
+@register("$array_concat")
+def array_concat(ctx, call, a: Val, b: Val) -> Val:
+    """array || array (reference: ArrayConcatFunction)."""
+    from trino_tpu.columnar.dictionary import union_many
+
+    da, la = _arr2d(ctx, a)
+    db, lb = _arr2d(ctx, b)
+    dictionary = a.dictionary
+    if a.dictionary is not None or b.dictionary is not None:
+        dictionary, (ta, tb) = union_many([a.dictionary, b.dictionary])
+        if ta is not None:
+            da = jnp.take(jnp.asarray(ta), jnp.asarray(da, jnp.int32), mode="clip")
+        if tb is not None:
+            db = jnp.take(jnp.asarray(tb), jnp.asarray(db, jnp.int32), mode="clip")
+    ka, kb = da.shape[1], db.shape[1]
+    k = ka + kb
+    dt = call.type.element.np_dtype
+    out = jnp.pad(jnp.asarray(da, dt), ((0, 0), (0, kb)))
+    idx = jnp.arange(k, dtype=jnp.int32)[None, :]
+    from_b = jnp.logical_and(
+        idx >= la[:, None], idx < (la + lb)[:, None]
+    )
+    b_pos = jnp.clip(idx - la[:, None], 0, max(kb - 1, 0))
+    db_p = jnp.pad(jnp.asarray(db, dt), ((0, 0), (0, k - kb)))
+    out = jnp.where(from_b, jnp.take_along_axis(db_p, b_pos, axis=1), out)
+    return Val(
+        out, _and_valid(a.valid, b.valid), call.type, dictionary, la + lb
+    )
+
+
+# -- lambda functions --------------------------------------------------------
+# (reference: operator/scalar/ArrayTransformFunction, ArrayFilterFunction,
+# ArrayAnyMatchFunction family, ReduceFunction)
+#
+# TPU-first evaluation: the lambda body compiles ONCE over the whole padded
+# [capacity, K] element matrix — every scalar op broadcasts elementwise, so
+# transform/filter are single fused device passes with no per-row loops.
+
+
+def _eval_lambda(ctx, lam, args: list, matrix: bool = True) -> Val:
+    """Evaluate a lambda body with parameters bound.  `matrix=True` marks
+    [capacity, K] element-matrix evaluation: captured columns gain a
+    trailing broadcast axis (see ExprCompiler.value)."""
+    prev = getattr(ctx, "_lambda_env", None)
+    prev_matrix = getattr(ctx, "_lambda_matrix", False)
+    env = dict(prev or {})
+    for name, v in zip(lam.params, args):
+        env[name] = v
+    ctx._lambda_env = env
+    ctx._lambda_matrix = matrix
+    try:
+        return ctx.value(lam.body)
+    finally:
+        ctx._lambda_env = prev
+        ctx._lambda_matrix = prev_matrix
+
+
+@register("transform")
+def _transform(ctx, call, arr, lam):
+    data, lens = _arr2d(ctx, arr)
+    elem = Val(data, None, arr.type.element, arr.dictionary)
+    res = _eval_lambda(ctx, lam, [elem])
+    et = call.type.element
+    out = jnp.broadcast_to(jnp.asarray(res.data, et.np_dtype), data.shape)
+    # per-element nulls aren't representable in the rectangular layout: a
+    # null-producing element keeps its fill value (documented deviation)
+    return Val(out, arr.valid, call.type, res.dictionary, lens)
+
+
+@register("filter")
+def _filter_array(ctx, call, arr, lam):
+    data, lens = _arr2d(ctx, arr)
+    em = _elem_mask(data, lens)
+    elem = Val(data, None, arr.type.element, arr.dictionary)
+    res = _eval_lambda(ctx, lam, [elem])
+    keep = jnp.broadcast_to(jnp.asarray(res.data, bool), data.shape)
+    if res.valid is not None and res.valid is not False:
+        keep = jnp.logical_and(keep, jnp.broadcast_to(res.valid, data.shape))
+    keep = jnp.logical_and(keep, em)
+    # stable per-row compaction of kept elements to the front
+    order = jnp.argsort(jnp.logical_not(keep), axis=1, stable=True)
+    out = jnp.take_along_axis(data, order, axis=1)
+    new_lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return Val(out, arr.valid, call.type, arr.dictionary, new_lens)
+
+
+def _match_reduce(ctx, call, arr, lam, combine):
+    """Three-valued match semantics (reference: ArrayAnyMatchFunction):
+    any = TRUE if any true, NULL if none true but some null, else FALSE;
+    all = FALSE if any false, NULL if none false but some null, else TRUE."""
+    data, lens = _arr2d(ctx, arr)
+    em = _elem_mask(data, lens)
+    elem = Val(data, None, arr.type.element, arr.dictionary)
+    res = _eval_lambda(ctx, lam, [elem])
+    m = jnp.broadcast_to(jnp.asarray(res.data, bool), data.shape)
+    if res.valid is False:
+        pv = jnp.zeros(data.shape, bool)
+    elif res.valid is None:
+        pv = jnp.ones(data.shape, bool)
+    else:
+        pv = jnp.broadcast_to(res.valid, data.shape)
+    has_null = jnp.any(jnp.logical_and(em, jnp.logical_not(pv)), axis=1)
+    if combine == "any":
+        hit = jnp.any(jnp.logical_and(em, jnp.logical_and(m, pv)), axis=1)
+        out = hit
+        known = jnp.logical_or(hit, jnp.logical_not(has_null))
+    else:
+        miss = jnp.any(
+            jnp.logical_and(em, jnp.logical_and(jnp.logical_not(m), pv)),
+            axis=1,
+        )
+        out = jnp.logical_not(miss)
+        known = jnp.logical_or(miss, jnp.logical_not(has_null))
+    return Val(out, _and_valid(arr.valid, known), call.type)
+
+
+@register("any_match")
+def _any_match(ctx, call, arr, lam):
+    return _match_reduce(ctx, call, arr, lam, "any")
+
+
+@register("all_match")
+def _all_match(ctx, call, arr, lam):
+    return _match_reduce(ctx, call, arr, lam, "all")
+
+
+@register("none_match")
+def _none_match(ctx, call, arr, lam):
+    v = _match_reduce(ctx, call, arr, lam, "any")
+    return Val(jnp.logical_not(v.data), v.valid, call.type)
+
+
+@register("reduce")
+def _reduce_array(ctx, call, arr, init, comb, final):
+    """reduce(array, init, (s, x) -> ..., s -> ...): the fold unrolls over
+    the (static) padded width K, each step a fused [capacity] update."""
+    data, lens = _arr2d(ctx, arr)
+    cap, k = data.shape
+    state = Val(
+        jnp.broadcast_to(jnp.asarray(init.data), (cap,)),
+        init.valid,
+        init.type,
+        init.dictionary,
+    )
+    for j in range(k):
+        xj = Val(data[:, j], None, arr.type.element, arr.dictionary)
+        new = _eval_lambda(ctx, comb, [state, xj], matrix=False)
+        live = lens > j
+        # the state follows the COMBINATOR's type (it may widen, e.g.
+        # bigint init + double elements); cast the carried state, never
+        # truncate the new value
+        nd = jnp.asarray(new.data)
+        merged = jnp.where(live, nd, jnp.asarray(state.data, nd.dtype))
+        state = Val(merged, state.valid, new.type, new.dictionary)
+    out = _eval_lambda(ctx, final, [state], matrix=False)
+    return Val(
+        jnp.broadcast_to(jnp.asarray(out.data), (cap,)),
+        _and_valid(arr.valid, out.valid),
+        call.type,
+        out.dictionary,
+    )
+
+
 def _json_walk(doc, steps):
     for s in steps:
         if isinstance(s, int):

@@ -74,8 +74,27 @@ class ExprCompiler:
     # -- public entry points -------------------------------------------------
 
     def value(self, expr: Expr) -> Val:
+        from trino_tpu.expr.ir import LambdaParam
+
+        if isinstance(expr, LambdaParam):
+            env = getattr(self, "_lambda_env", None)
+            if not env or expr.name not in env:
+                raise NotImplementedError(
+                    f"unbound lambda parameter {expr.name}"
+                )
+            return env[expr.name]
         if isinstance(expr, InputRef):
             c = self.batch.columns[expr.channel]
+            if (
+                getattr(self, "_lambda_matrix", False)
+                and c.lengths is None
+                and jnp.ndim(c.data) == 1
+            ):
+                # captured column inside an array-lambda body: add the
+                # trailing element axis so it broadcasts against the
+                # [capacity, K] element matrix
+                valid = None if c.valid is None else c.valid[:, None]
+                return Val(c.data[:, None], valid, expr.type, c.dictionary)
             return Val(c.data, c.valid, expr.type, c.dictionary, c.lengths)
         if isinstance(expr, Literal):
             return self._literal(expr)

@@ -32,10 +32,16 @@ def register(name: str):
 
 
 def dispatch(ctx: ExprCompiler, call: Call) -> Val:
+    from trino_tpu.expr.ir import Lambda
+
     fn = FUNCTIONS.get(call.name)
     if fn is None:
         raise NotImplementedError(f"scalar function not implemented: {call.name}")
-    vals = [ctx.value(a) for a in call.args]
+    # lambda arguments pass through unevaluated — the handler binds their
+    # parameters over array elements and evaluates the body itself
+    vals = [
+        a if isinstance(a, Lambda) else ctx.value(a) for a in call.args
+    ]
     return fn(ctx, call, *vals)
 
 
@@ -1473,6 +1479,18 @@ def _bit_count(ctx, call, a, bits=None):
 
     n = lax.population_count(x).astype(jnp.int64)
     return Val(n, a.valid, call.type)
+
+
+@register("typeof")
+def _typeof(ctx, call, v):
+    d = StringDictionary([v.type.name])
+    return Val(np.int32(0), None, call.type, d)
+
+
+@register("version")
+def _version(ctx, call):
+    d = StringDictionary(["trino-tpu 0.4"])
+    return Val(np.int32(0), None, call.type, d)
 
 
 # array/json/map function handlers register themselves on import

@@ -90,6 +90,48 @@ class Literal(Expr):
         return f"{self.value!r}:{self.type.name}"
 
 
+class LambdaParam(Expr):
+    """A bound lambda parameter reference (reference:
+    sql/relational/LambdaDefinitionExpression's argument slots).  The
+    compiler resolves it from the active lambda environment."""
+
+    __slots__ = ("name", "type")
+
+    def __init__(self, name: str, type: Type):
+        self.name = name
+        self.type = type
+
+    def key(self):
+        return ("lparam", self.name, self.type.name)
+
+    def __repr__(self):
+        return f"λ{self.name}:{self.type.name}"
+
+
+class Lambda(Expr):
+    """x -> body (reference: sql/tree/LambdaExpression ->
+    LambdaDefinitionExpression)."""
+
+    __slots__ = ("params", "body", "type")
+
+    def __init__(self, params: Sequence[str], body: Expr, type: Type):
+        self.params = tuple(params)
+        self.body = body
+        self.type = type  # the BODY's result type
+
+    def children(self):
+        return (self.body,)
+
+    def with_children(self, children):
+        return Lambda(self.params, children[0], self.type)
+
+    def key(self):
+        return ("lambda", self.params, self.body.key(), self.type.name)
+
+    def __repr__(self):
+        return f"({', '.join(self.params)}) -> {self.body!r}"
+
+
 class Call(Expr):
     """Scalar function call, name-resolved (e.g. '$add', 'substr', 'year')."""
 

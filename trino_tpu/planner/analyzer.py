@@ -149,10 +149,35 @@ class ExprAnalyzer:
         return m(node)
 
     def _a_Identifier(self, n: ast.Identifier) -> Expr:
+        if len(n.parts) == 1:
+            env = getattr(self, "_lambda_env", None)
+            if env and n.parts[0] in env:
+                return env[n.parts[0]]
         sym, outer = self.scope.resolve(n.parts)
         if outer and self.outer_refs is not None:
             self.outer_refs.add(sym.name)
         return sym.ref()
+
+    def _analyze_lambda(self, lam: "ast.LambdaExpr", param_types) -> "ir.Lambda":
+        """Bind lambda parameters and analyze the body (reference:
+        ExpressionAnalyzer.visitLambdaExpression)."""
+        from trino_tpu.expr.ir import Lambda, LambdaParam
+
+        if len(lam.params) != len(param_types):
+            raise AnalysisError(
+                f"lambda expects {len(param_types)} parameters, "
+                f"got {len(lam.params)}"
+            )
+        prev = getattr(self, "_lambda_env", None)
+        env = dict(prev or {})
+        for name, t in zip(lam.params, param_types):
+            env[name] = LambdaParam(name, t)
+        self._lambda_env = env
+        try:
+            body = self.analyze(lam.body)
+        finally:
+            self._lambda_env = prev
+        return Lambda(list(lam.params), body, body.type)
 
     def _a_NumberLiteral(self, n: ast.NumberLiteral) -> Expr:
         t = n.text
@@ -238,6 +263,14 @@ class ExprAnalyzer:
             return ir.comparison(op, l, r)
         if op == "||":
             l, r = self.analyze(n.left), self.analyze(n.right)
+            if isinstance(l.type, T.ArrayType) or isinstance(r.type, T.ArrayType):
+                if not (
+                    isinstance(l.type, T.ArrayType)
+                    and isinstance(r.type, T.ArrayType)
+                ):
+                    raise AnalysisError("|| requires two arrays or two strings")
+                et = T.common_super_type(l.type.element, r.type.element)
+                return Call("$array_concat", [l, r], T.ArrayType(et))
             return Call("concat", [l, r], T.VARCHAR)
         if op in _ARITH_OPS:
             # date +/- interval
@@ -376,6 +409,63 @@ class ExprAnalyzer:
             return SpecialForm(Form.NULLIF, args, args[0].type)
         if n.name == "try":
             return SpecialForm(Form.TRY, [self.analyze(n.args[0])], T.UNKNOWN)
+        if n.name == "concat_ws":
+            # reference: ConcatWsFunction — NULL values are SKIPPED, not
+            # propagated; rewritten into conditional pairwise concats.
+            # (A leading NULL leaves a leading separator — documented edge.)
+            if len(n.args) < 2:
+                raise AnalysisError("concat_ws needs a separator and values")
+            sep = self.analyze(n.args[0])
+            parts = [self.analyze(a) for a in n.args[1:]]
+            empty = Literal("", T.VARCHAR)
+            out = SpecialForm(Form.COALESCE, [parts[0], empty], T.VARCHAR)
+            for pexp in parts[1:]:
+                piece = SpecialForm(
+                    Form.IF,
+                    [
+                        ir.not_(SpecialForm(Form.IS_NULL, [pexp], T.BOOLEAN)),
+                        Call("concat", [sep, pexp], T.VARCHAR),
+                        empty,
+                    ],
+                    T.VARCHAR,
+                )
+                out = Call("concat", [out, piece], T.VARCHAR)
+            return out
+        if n.name in ("transform", "filter", "any_match", "all_match", "none_match"):
+            # array lambda functions (reference: operator/scalar/
+            # ArrayTransformFunction, ArrayFilterFunction, ArraysMatch*)
+            if len(n.args) != 2:
+                raise AnalysisError(f"{n.name} expects (array, lambda)")
+            arr = self.analyze(n.args[0])
+            if not isinstance(arr.type, T.ArrayType):
+                raise AnalysisError(f"{n.name} expects an array argument")
+            if not isinstance(n.args[1], ast.LambdaExpr):
+                raise AnalysisError(f"{n.name} expects a lambda argument")
+            lam = self._analyze_lambda(n.args[1], [arr.type.element])
+            if n.name == "transform":
+                rt: T.Type = T.ArrayType(lam.type)
+            elif n.name == "filter":
+                rt = arr.type
+            else:
+                rt = T.BOOLEAN
+            return Call(n.name, [arr, lam], rt)
+        if n.name == "reduce":
+            # reduce(array, init, (s, x) -> comb, s -> final)
+            if len(n.args) != 4 or not all(
+                isinstance(a, ast.LambdaExpr) for a in n.args[2:]
+            ):
+                raise AnalysisError(
+                    "reduce expects (array, init, (s, x) -> ..., s -> ...)"
+                )
+            arr = self.analyze(n.args[0])
+            if not isinstance(arr.type, T.ArrayType):
+                raise AnalysisError("reduce expects an array argument")
+            init = self.analyze(n.args[1])
+            comb = self._analyze_lambda(
+                n.args[2], [init.type, arr.type.element]
+            )
+            final = self._analyze_lambda(n.args[3], [comb.type])
+            return Call(n.name, [arr, init, comb, final], final.type)
         args = [self.analyze(a) for a in n.args]
         rt = scalar_result_type(n.name, [a.type for a in args])
         return Call(n.name, args, rt)

@@ -284,6 +284,17 @@ SCALAR_RESULT = {
         args[0].value if isinstance(args[0], T.MapType) else T.BIGINT
     ),
     "map_concat": _same_as_first,
+    "$array_concat": _same_as_first,
+    "slice": _same_as_first,
+    "transform": _same_as_first,  # analyzer overrides with real typing
+    "filter": _same_as_first,
+    "any_match": _fixed(T.BOOLEAN),
+    "all_match": _fixed(T.BOOLEAN),
+    "none_match": _fixed(T.BOOLEAN),
+    "reduce": _same_as_first,
+    "typeof": _fixed(T.VARCHAR),
+    "version": _fixed(T.VARCHAR),
+    "concat_ws": _fixed(T.VARCHAR),
     "contains": _fixed(T.BOOLEAN),
     "array_position": _fixed(T.BIGINT),
     "array_max": lambda args: args[0].element

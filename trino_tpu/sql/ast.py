@@ -301,6 +301,14 @@ class ValuesRelation(Node):
 
 
 @dataclass(frozen=True)
+class LambdaExpr(Node):
+    """x -> body / (a, b) -> body (reference: sql/tree/LambdaExpression)."""
+
+    params: tuple  # parameter names
+    body: Node
+
+
+@dataclass(frozen=True)
 class SelectItem(Node):
     expr: Node
     alias: Optional[str] = None

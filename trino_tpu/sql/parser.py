@@ -1218,6 +1218,46 @@ class Parser:
         self.expect_kw("end")
         return ast.CaseExpr(operand, tuple(whens), default)
 
+    def _fn_arg(self) -> ast.Node:
+        """One function argument; lambda forms `x -> e` and `(a, b) -> e`
+        are recognized here (reference: SqlBase.g4 lambda rule)."""
+        t = self.peek()
+        if (
+            t.kind in ("ident", "qident")
+            and self.peek(1).kind == "op"
+            and self.peek(1).value == "->"
+        ):
+            name = self.ident()
+            self.next()  # ->
+            return ast.LambdaExpr((name,), self._expr())
+        if t.kind == "op" and t.value == "(":
+            # lookahead: ( ident [, ident]* ) ->
+            k = 1
+            names = []
+            ok = True
+            while True:
+                tk = self.peek(k)
+                if tk.kind not in ("ident", "qident"):
+                    ok = False
+                    break
+                names.append(tk.value)
+                nxt = self.peek(k + 1)
+                if nxt.kind == "op" and nxt.value == ",":
+                    k += 2
+                    continue
+                if nxt.kind == "op" and nxt.value == ")":
+                    after = self.peek(k + 2)
+                    ok = after.kind == "op" and after.value == "->"
+                    k += 2
+                    break
+                ok = False
+                break
+            if ok and names:
+                for _ in range(k + 1):  # consume "( names )" and "->"
+                    self.next()
+                return ast.LambdaExpr(tuple(names), self._expr())
+        return self._expr()
+
     def _function_call(self, name: str) -> ast.Node:
         self.expect_op("(")
         distinct = False
@@ -1230,9 +1270,9 @@ class Parser:
                 distinct = True
             else:
                 self.accept_kw("all")
-            args.append(self._expr())
+            args.append(self._fn_arg())
             while self.accept_op(","):
-                args.append(self._expr())
+                args.append(self._fn_arg())
         self.expect_op(")")
         within_group: tuple = ()
         if name.lower() in ("listagg", "string_agg") and self.accept_kw("within"):
