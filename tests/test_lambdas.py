@@ -86,3 +86,38 @@ def test_typeof_version_concat_ws(runner):
         "select typeof(1), typeof(array[1]), concat_ws('-', 'a', 'b', 'c')"
     ).rows
     assert rows == [("integer", "array(integer)", "a-b-c")]
+
+
+def test_compound_predicates_in_lambda(runner):
+    """AND/OR/IF/CASE/COALESCE/BETWEEN inside lambda bodies evaluate over
+    the element matrix (boolean forms broadcast to [capacity, K])."""
+    assert runner.execute(
+        "select filter(array[1,2,3], x -> x > 1 and x < 3)"
+    ).rows == [([2],)]
+    assert runner.execute(
+        "select transform(array[1,2,3], x -> if(x > 1, x * 10, x))"
+    ).rows == [([1, 20, 30],)]
+    assert runner.execute(
+        "select transform(array[1,2], x -> coalesce(nullif(x, 2), 0))"
+    ).rows == [([1, 0],)]
+    assert runner.execute(
+        "select transform(array[1,2,3], x -> case when x = 2 then 99 else x end)"
+    ).rows == [([1, 99, 3],)]
+    assert runner.execute(
+        "select filter(array[1,2,3], x -> x between 2 and 3)"
+    ).rows == [([2, 3],)]
+
+
+def test_null_predicate_semantics(runner):
+    assert runner.execute(
+        "select filter(array[1,2,3], x -> not cast(null as boolean))"
+    ).rows == [([],)]
+    assert runner.execute(
+        "select any_match(array[1,2], x -> x > nullif(1,1))"
+    ).rows == [(None,)]
+
+
+def test_reduce_null_propagates(runner):
+    assert runner.execute(
+        "select reduce(array[1,2], 0, (s, x) -> s + x + nullif(1,1), s -> s)"
+    ).rows == [(None,)]

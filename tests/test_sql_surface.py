@@ -169,3 +169,28 @@ def test_sum_distinct(runner):
         "select sum(distinct n_regionkey), count(*) from nation"
     )
     assert res.rows == [(10, 25)]
+
+
+@pytest.mark.smoke
+def test_intersect_except_all(runner):
+    """Bag semantics via per-side occurrence numbering (reference:
+    ImplementIntersectAsUnion with row_number pairing)."""
+    cases = [
+        ("values (1), (1), (2) intersect all values (1), (1), (3)",
+         [(1,), (1,)]),
+        ("values (1), (1), (2) except all values (1)", [(1,), (2,)]),
+        ("values (1), (1), (1) except all values (1), (1)", [(1,)]),
+        ("select n_regionkey from nation intersect all "
+         "select n_regionkey from nation where n_nationkey < 10",
+         None),  # self-consistency checked below
+    ]
+    for sql, expect in cases[:3]:
+        assert sorted(runner.execute(sql).rows) == sorted(expect), sql
+    # table-backed: intersect all with a subset of itself = the subset bag
+    got = sorted(runner.execute(cases[3][0]).rows)
+    sub = sorted(
+        runner.execute(
+            "select n_regionkey from nation where n_nationkey < 10"
+        ).rows
+    )
+    assert got == sub

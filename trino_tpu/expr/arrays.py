@@ -363,19 +363,25 @@ def array_concat(ctx, call, a: Val, b: Val) -> Val:
 def _eval_lambda(ctx, lam, args: list, matrix: bool = True) -> Val:
     """Evaluate a lambda body with parameters bound.  `matrix=True` marks
     [capacity, K] element-matrix evaluation: captured columns gain a
-    trailing broadcast axis (see ExprCompiler.value)."""
+    trailing broadcast axis and boolean/branch forms broadcast to the
+    element-matrix shape (see ExprCompiler.value / bshape)."""
     prev = getattr(ctx, "_lambda_env", None)
     prev_matrix = getattr(ctx, "_lambda_matrix", False)
+    prev_shape = getattr(ctx, "_lambda_shape", None)
     env = dict(prev or {})
     for name, v in zip(lam.params, args):
         env[name] = v
     ctx._lambda_env = env
     ctx._lambda_matrix = matrix
+    ctx._lambda_shape = (
+        tuple(jnp.shape(args[0].data)) if matrix and args else None
+    )
     try:
         return ctx.value(lam.body)
     finally:
         ctx._lambda_env = prev
         ctx._lambda_matrix = prev_matrix
+        ctx._lambda_shape = prev_shape
 
 
 @register("transform")
@@ -397,7 +403,9 @@ def _filter_array(ctx, call, arr, lam):
     elem = Val(data, None, arr.type.element, arr.dictionary)
     res = _eval_lambda(ctx, lam, [elem])
     keep = jnp.broadcast_to(jnp.asarray(res.data, bool), data.shape)
-    if res.valid is not None and res.valid is not False:
+    if res.valid is False:
+        keep = jnp.zeros(data.shape, bool)  # NULL predicate drops elements
+    elif res.valid is not None:
         keep = jnp.logical_and(keep, jnp.broadcast_to(res.valid, data.shape))
     keep = jnp.logical_and(keep, em)
     # stable per-row compaction of kept elements to the front
@@ -474,7 +482,13 @@ def _reduce_array(ctx, call, arr, init, comb, final):
         # truncate the new value
         nd = jnp.asarray(new.data)
         merged = jnp.where(live, nd, jnp.asarray(state.data, nd.dtype))
-        state = Val(merged, state.valid, new.type, new.dictionary)
+        from trino_tpu.expr.compiler import _valid_arr as _va
+
+        cap_shape = (cap,)
+        mv = jnp.where(
+            live, _va(new.valid, cap_shape), _va(state.valid, cap_shape)
+        )
+        state = Val(merged, mv, new.type, new.dictionary)
     out = _eval_lambda(ctx, final, [state], matrix=False)
     return Val(
         jnp.broadcast_to(jnp.asarray(out.data), (cap,)),

@@ -56,12 +56,14 @@ def _and_valid(a, b):
     return jnp.logical_and(a, b)
 
 
-def _valid_arr(v, cap):
+def _valid_arr(v, shape):
+    if isinstance(shape, int):
+        shape = (shape,)
     if v is None:
-        return jnp.ones(cap, dtype=bool)
+        return jnp.ones(shape, dtype=bool)
     if v is False:
-        return jnp.zeros(cap, dtype=bool)
-    return v
+        return jnp.zeros(shape, dtype=bool)
+    return jnp.broadcast_to(v, shape)
 
 
 class ExprCompiler:
@@ -72,6 +74,12 @@ class ExprCompiler:
         self.capacity = batch.capacity
 
     # -- public entry points -------------------------------------------------
+
+    def bshape(self) -> tuple:
+        """Broadcast shape for boolean/branch forms: [capacity] normally,
+        the [capacity, K] element matrix inside array-lambda bodies."""
+        s = getattr(self, "_lambda_shape", None)
+        return s if s is not None else (self.capacity,)
 
     def value(self, expr: Expr) -> Val:
         from trino_tpu.expr.ir import LambdaParam
@@ -170,13 +178,13 @@ class ExprCompiler:
     def _form_and(self, f: SpecialForm) -> Val:
         vals = [self.value(a) for a in f.args]
         # Kleene AND over n terms: FALSE dominates, else NULL if any null.
-        cap = self.capacity
-        value = jnp.ones(cap, dtype=bool)
-        any_false = jnp.zeros(cap, dtype=bool)
-        all_valid = jnp.ones(cap, dtype=bool)
+        shp = self.bshape()
+        value = jnp.ones(shp, dtype=bool)
+        any_false = jnp.zeros(shp, dtype=bool)
+        all_valid = jnp.ones(shp, dtype=bool)
         for v in vals:
-            va = _valid_arr(v.valid, cap)
-            d = jnp.broadcast_to(jnp.asarray(v.data, dtype=bool), (cap,))
+            va = _valid_arr(v.valid, shp)
+            d = jnp.broadcast_to(jnp.asarray(v.data, dtype=bool), shp)
             value = jnp.logical_and(value, jnp.where(va, d, True))
             any_false = jnp.logical_or(any_false, jnp.logical_and(va, ~d))
             all_valid = jnp.logical_and(all_valid, va)
@@ -184,14 +192,14 @@ class ExprCompiler:
         return Val(value, valid, T.BOOLEAN)
 
     def _form_or(self, f: SpecialForm) -> Val:
-        cap = self.capacity
+        shp = self.bshape()
         vals = [self.value(a) for a in f.args]
-        value = jnp.zeros(cap, dtype=bool)
-        any_true = jnp.zeros(cap, dtype=bool)
-        all_valid = jnp.ones(cap, dtype=bool)
+        value = jnp.zeros(shp, dtype=bool)
+        any_true = jnp.zeros(shp, dtype=bool)
+        all_valid = jnp.ones(shp, dtype=bool)
         for v in vals:
-            va = _valid_arr(v.valid, cap)
-            d = jnp.broadcast_to(jnp.asarray(v.data, dtype=bool), (cap,))
+            va = _valid_arr(v.valid, shp)
+            d = jnp.broadcast_to(jnp.asarray(v.data, dtype=bool), shp)
             value = jnp.logical_or(value, jnp.where(va, d, False))
             any_true = jnp.logical_or(any_true, jnp.logical_and(va, d))
             all_valid = jnp.logical_and(all_valid, va)
@@ -204,8 +212,8 @@ class ExprCompiler:
 
     def _form_is_null(self, f: SpecialForm) -> Val:
         v = self.value(f.args[0])
-        cap = self.capacity
-        return Val(~_valid_arr(v.valid, cap), None, T.BOOLEAN)
+        shp = jnp.shape(v.data) if jnp.ndim(v.data) > 1 else self.bshape()
+        return Val(~_valid_arr(v.valid, shp), None, T.BOOLEAN)
 
     def _form_if(self, f: SpecialForm) -> Val:
         cond, then, els = f.args
@@ -218,25 +226,25 @@ class ExprCompiler:
         return self._case_fold(pairs, default, f.type)
 
     def _case_fold(self, pairs, default: Expr, out_type: T.Type) -> Val:
-        cap = self.capacity
+        shp = self.bshape()
         branches = [self.value(v) for _, v in pairs] + [self.value(default)]
         out_dict = self._merge_branch_dicts(branches, out_type)
         acc = branches[-1]
         acc_data = jnp.broadcast_to(
-            jnp.asarray(self._recode(acc, out_dict), dtype=out_type.np_dtype), (cap,)
+            jnp.asarray(self._recode(acc, out_dict), dtype=out_type.np_dtype), shp
         )
-        acc_valid = _valid_arr(acc.valid, cap)
+        acc_valid = _valid_arr(acc.valid, shp)
         for (cond_e, _), v in zip(reversed(pairs), reversed(branches[:-1])):
             c = self.value(cond_e)
             ctrue = jnp.logical_and(
-                jnp.broadcast_to(jnp.asarray(c.data, dtype=bool), (cap,)),
-                _valid_arr(c.valid, cap),
+                jnp.broadcast_to(jnp.asarray(c.data, dtype=bool), shp),
+                _valid_arr(c.valid, shp),
             )
             vdata = jnp.broadcast_to(
-                jnp.asarray(self._recode(v, out_dict), dtype=out_type.np_dtype), (cap,)
+                jnp.asarray(self._recode(v, out_dict), dtype=out_type.np_dtype), shp
             )
             acc_data = jnp.where(ctrue, vdata, acc_data)
-            acc_valid = jnp.where(ctrue, _valid_arr(v.valid, cap), acc_valid)
+            acc_valid = jnp.where(ctrue, _valid_arr(v.valid, shp), acc_valid)
         return Val(acc_data, acc_valid, out_type, out_dict)
 
     def _merge_branch_dicts(self, vals, out_type):
@@ -264,18 +272,18 @@ class ExprCompiler:
         return jnp.take(table, jnp.asarray(v.data, dtype=jnp.int32), mode="clip")
 
     def _form_coalesce(self, f: SpecialForm) -> Val:
-        cap = self.capacity
+        shp = self.bshape()
         vals = [self.value(a) for a in f.args]
         out_dict = self._merge_branch_dicts(vals, f.type)
         acc = vals[-1]
         acc_data = jnp.broadcast_to(
-            jnp.asarray(self._recode(acc, out_dict), dtype=f.type.np_dtype), (cap,)
+            jnp.asarray(self._recode(acc, out_dict), dtype=f.type.np_dtype), shp
         )
-        acc_valid = _valid_arr(acc.valid, cap)
+        acc_valid = _valid_arr(acc.valid, shp)
         for v in reversed(vals[:-1]):
-            va = _valid_arr(v.valid, cap)
+            va = _valid_arr(v.valid, shp)
             d = jnp.broadcast_to(
-                jnp.asarray(self._recode(v, out_dict), dtype=f.type.np_dtype), (cap,)
+                jnp.asarray(self._recode(v, out_dict), dtype=f.type.np_dtype), shp
             )
             acc_data = jnp.where(va, d, acc_data)
             acc_valid = jnp.logical_or(va, acc_valid)
@@ -284,12 +292,12 @@ class ExprCompiler:
     def _form_nullif(self, f: SpecialForm) -> Val:
         a = self.value(f.args[0])
         eq = self.value(ir.comparison("=", f.args[0], f.args[1]))
-        cap = self.capacity
+        shp = self.bshape()
         eq_true = jnp.logical_and(
-            jnp.broadcast_to(jnp.asarray(eq.data, dtype=bool), (cap,)),
-            _valid_arr(eq.valid, cap),
+            jnp.broadcast_to(jnp.asarray(eq.data, dtype=bool), shp),
+            _valid_arr(eq.valid, shp),
         )
-        valid = jnp.logical_and(_valid_arr(a.valid, cap), ~eq_true)
+        valid = jnp.logical_and(_valid_arr(a.valid, shp), ~eq_true)
         return Val(a.data, valid, f.type, a.dictionary)
 
     def _form_in(self, f: SpecialForm) -> Val:

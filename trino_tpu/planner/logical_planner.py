@@ -264,29 +264,46 @@ class LogicalPlanner:
                 node = P.AggregationNode(node, list(out_syms), [])
             fields = [Field(n, s_) for n, s_ in zip(lnames, out_syms)]
             return RelationPlan(node, fields), lnames
-        # INTERSECT / EXCEPT (distinct semantics): lowered to a tagged UNION
-        # ALL + per-side counts + filter (reference: the
-        # ImplementIntersectAsUnion / ImplementExceptAsUnion rules under
-        # sql/planner/iterative/rule/ + SqlBase.g4:244-245)
-        if s.all:
-            raise AnalysisError(f"{s.op.upper()} ALL not supported yet")
+        # INTERSECT / EXCEPT: lowered to a tagged UNION ALL + per-side
+        # counts + filter (reference: the ImplementIntersectAsUnion /
+        # ImplementExceptAsUnion rules under sql/planner/iterative/rule/ +
+        # SqlBase.g4:244-245).  ALL (bag) semantics ride the same plan with
+        # a per-side occurrence number (row_number over all columns): the
+        # k-th copy of a value on the left pairs with the k-th copy on the
+        # right, so the distinct machinery over (columns..., occ) yields
+        # exactly min(l, r) / max(l - r, 0) copies.
         sides = []
         for rp in (lrp, rrp):
+            node_in = rp.node
+            syms = [f.symbol for f in rp.fields]
+            if s.all:
+                occ = self.alloc.new("occ", T.BIGINT)
+                node_in = P.WindowNode(
+                    node_in,
+                    list(syms),
+                    [],
+                    [(occ, P.WindowFunction("row_number", []))],
+                )
+                syms = syms + [occ]
             side = self.alloc.new("side", T.BIGINT)
             tag = P.ProjectNode(
-                rp.node,
-                [(f.symbol, f.symbol.ref()) for f in rp.fields]
+                node_in,
+                [(sy, sy.ref()) for sy in syms]
                 + [(side, Literal(len(sides), T.BIGINT))],
             )
-            sides.append((tag, [f.symbol for f in rp.fields] + [side]))
+            sides.append((tag, syms + [side]))
         out_syms = []
         for lf, rf in zip(lrp.fields, rrp.fields):
             t = T.common_super_type(lf.symbol.type, rf.symbol.type)
             out_syms.append(self.alloc.new(lf.name, t))
+        group_syms = list(out_syms)
+        if s.all:
+            occ_out = self.alloc.new("occ", T.BIGINT)
+            group_syms.append(occ_out)
         side_sym = self.alloc.new("side", T.BIGINT)
         union = P.UnionNode(
             [n for n, _ in sides],
-            out_syms + [side_sym],
+            group_syms + [side_sym],
             [syms for _, syms in sides],
         )
         lcnt = self.alloc.new("lcnt", T.BIGINT)
@@ -313,7 +330,7 @@ class LogicalPlanner:
                 ),
             ),
         ]
-        agg = P.AggregationNode(union, list(out_syms), aggs)
+        agg = P.AggregationNode(union, group_syms, aggs)
         both = ir.comparison(">", lcnt.ref(), Literal(0, T.BIGINT))
         other = (
             ir.comparison(">", rcnt.ref(), Literal(0, T.BIGINT))
