@@ -121,3 +121,34 @@ def test_reduce_null_propagates(runner):
     assert runner.execute(
         "select reduce(array[1,2], 0, (s, x) -> s + x + nullif(1,1), s -> s)"
     ).rows == [(None,)]
+
+
+def test_array_set_functions(runner):
+    rows = runner.execute(
+        "select arrays_overlap(array[1,2], array[2,3]), "
+        "array_intersect(array[1,2,2,3], array[2,3,4]), "
+        "array_except(array[1,2,2,3], array[2]), "
+        "array_union(array[1,2], array[2,3])"
+    ).rows
+    assert rows == [(True, [2, 3], [1, 3], [1, 2, 3])]
+
+
+def test_zip_with(runner):
+    assert runner.execute(
+        "select zip_with(array[1,2], array[10,20], (x, y) -> x + y)"
+    ).rows == [([11, 22],)]
+    # mismatched lengths: NULL (the reference pads with NULL elements,
+    # unrepresentable in the rectangular layout)
+    assert runner.execute(
+        "select zip_with(array[1], array[1,2], (x, y) -> x + y)"
+    ).rows == [(None,)]
+
+
+def test_array_set_functions_cross_dictionary(runner):
+    """String arrays with disjoint dictionaries unify before membership
+    (regression: results carried the stale pre-merge dictionary)."""
+    rows = runner.execute(
+        "select array_except(array['b'], array['a']), "
+        "array_intersect(array['b','c'], array['a','b'])"
+    ).rows
+    assert rows == [(["b"], ["b"])]

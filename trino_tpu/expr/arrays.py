@@ -351,6 +351,100 @@ def array_concat(ctx, call, a: Val, b: Val) -> Val:
     )
 
 
+def _membership(ctx, a: Val, b: Val):
+    """(hit [cap, Ka], a-codes in the MERGED dictionary, a-lengths, merged
+    dictionary): which live elements of a appear among b's live elements."""
+    from trino_tpu.columnar.dictionary import union_many
+
+    da, la = _arr2d(ctx, a)
+    db, lb = _arr2d(ctx, b)
+    dictionary = a.dictionary
+    if a.dictionary is not None or b.dictionary is not None:
+        dictionary, (ta, tb) = union_many([a.dictionary, b.dictionary])
+        if ta is not None:
+            da = jnp.take(jnp.asarray(ta), jnp.asarray(da, jnp.int32), mode="clip")
+        if tb is not None:
+            db = jnp.take(jnp.asarray(tb), jnp.asarray(db, jnp.int32), mode="clip")
+    emb = _elem_mask(db, lb)
+    hit = jnp.any(
+        jnp.logical_and(emb[:, None, :], da[:, :, None] == db[:, None, :]),
+        axis=2,
+    )
+    return jnp.logical_and(hit, _elem_mask(da, la)), da, la, dictionary
+
+
+def _first_occurrence(da, mask):
+    """Among masked slots, keep only each value's FIRST occurrence per row."""
+    k = da.shape[1]
+    eq_prior = jnp.logical_and(
+        da[:, :, None] == da[:, None, :],
+        jnp.arange(k)[None, None, :] < jnp.arange(k)[None, :, None],
+    )
+    dup = jnp.any(jnp.logical_and(eq_prior, mask[:, None, :]), axis=2)
+    return jnp.logical_and(mask, jnp.logical_not(dup))
+
+
+def _compact_row_subset(data, keep, dictionary, valid, out_type):
+    order = jnp.argsort(jnp.logical_not(keep), axis=1, stable=True)
+    out = jnp.take_along_axis(data, order, axis=1)
+    lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return Val(out, valid, out_type, dictionary, lens)
+
+
+@register("arrays_overlap")
+def _arrays_overlap(ctx, call, a, b):
+    hit, _, _, _ = _membership(ctx, a, b)
+    return Val(
+        jnp.any(hit, axis=1), _and_valid(a.valid, b.valid), call.type
+    )
+
+
+@register("array_intersect")
+def _array_intersect(ctx, call, a, b):
+    """Distinct elements of a present in b (reference:
+    ArrayIntersectFunction; output order is a's first-occurrence order)."""
+    hit, da, _la, dictionary = _membership(ctx, a, b)
+    keep = _first_occurrence(da, hit)
+    return _compact_row_subset(
+        da, keep, dictionary, _and_valid(a.valid, b.valid), call.type
+    )
+
+
+@register("array_except")
+def _array_except(ctx, call, a, b):
+    hit, da, la, dictionary = _membership(ctx, a, b)
+    ema = _elem_mask(da, la)
+    keep = _first_occurrence(da, jnp.logical_and(ema, jnp.logical_not(hit)))
+    return _compact_row_subset(
+        da, keep, dictionary, _and_valid(a.valid, b.valid), call.type
+    )
+
+
+@register("array_union")
+def _array_union(ctx, call, a, b):
+    concat = FUNCTIONS["$array_concat"](ctx, call, a, b)
+    return FUNCTIONS["array_distinct"](ctx, call, concat)
+
+
+@register("zip_with")
+def _zip_with(ctx, call, a, b, lam):
+    """zip_with(a1, a2, (x, y) -> e); rows with mismatched lengths are NULL
+    (the reference pads the shorter side with NULL elements, which the
+    rectangular layout cannot represent — documented deviation)."""
+    da, la = _arr2d(ctx, a)
+    db, lb = _arr2d(ctx, b)
+    k = max(da.shape[1], db.shape[1], 1)
+    dap = jnp.pad(da, ((0, 0), (0, k - da.shape[1])))
+    dbp = jnp.pad(db, ((0, 0), (0, k - db.shape[1])))
+    xa = Val(dap, None, a.type.element, a.dictionary)
+    xb = Val(dbp, None, b.type.element, b.dictionary)
+    res = _eval_lambda(ctx, lam, [xa, xb])
+    et = call.type.element
+    out = jnp.broadcast_to(jnp.asarray(res.data, et.np_dtype), (dap.shape[0], k))
+    valid = _and_valid(_and_valid(a.valid, b.valid), la == lb)
+    return Val(out, valid, call.type, res.dictionary, la)
+
+
 # -- lambda functions --------------------------------------------------------
 # (reference: operator/scalar/ArrayTransformFunction, ArrayFilterFunction,
 # ArrayAnyMatchFunction family, ReduceFunction)

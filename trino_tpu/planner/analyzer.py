@@ -449,6 +449,20 @@ class ExprAnalyzer:
             else:
                 rt = T.BOOLEAN
             return Call(n.name, [arr, lam], rt)
+        if n.name == "zip_with":
+            if len(n.args) != 3 or not isinstance(n.args[2], ast.LambdaExpr):
+                raise AnalysisError("zip_with expects (array, array, lambda)")
+            a1 = self.analyze(n.args[0])
+            a2 = self.analyze(n.args[1])
+            if not (
+                isinstance(a1.type, T.ArrayType)
+                and isinstance(a2.type, T.ArrayType)
+            ):
+                raise AnalysisError("zip_with expects two arrays")
+            lam = self._analyze_lambda(
+                n.args[2], [a1.type.element, a2.type.element]
+            )
+            return Call("zip_with", [a1, a2, lam], T.ArrayType(lam.type))
         if n.name == "reduce":
             # reduce(array, init, (s, x) -> comb, s -> final)
             if len(n.args) != 4 or not all(

@@ -286,6 +286,11 @@ SCALAR_RESULT = {
     "map_concat": _same_as_first,
     "$array_concat": _same_as_first,
     "slice": _same_as_first,
+    "arrays_overlap": _fixed(T.BOOLEAN),
+    "array_intersect": _same_as_first,
+    "array_except": _same_as_first,
+    "array_union": _same_as_first,
+    "zip_with": _same_as_first,  # analyzer overrides with the lambda's type
     "transform": _same_as_first,  # analyzer overrides with real typing
     "filter": _same_as_first,
     "any_match": _fixed(T.BOOLEAN),
