@@ -77,3 +77,31 @@ def test_explain_analyze(runner):
     out = runner.execute("explain analyze select count(*) from tpch.tiny.nation")
     text = "\n".join(r[0] for r in out.rows)
     assert "rows=" in text and "TableScan" in text
+
+
+@pytest.mark.smoke
+def test_show_create_table(runner):
+    runner.execute("create table memory.default.sct (a bigint, s varchar)")
+    ddl = runner.execute("show create table memory.default.sct").rows[0][0]
+    assert "CREATE TABLE memory.default.sct" in ddl
+    assert "a bigint" in ddl and "s varchar" in ddl
+
+
+@pytest.mark.smoke
+def test_alter_table(runner):
+    runner.execute("create table memory.default.alt (a bigint, b varchar)")
+    runner.execute("insert into memory.default.alt values (1, 'x'), (2, 'y')")
+    runner.execute("alter table memory.default.alt add column c double")
+    assert sorted(runner.execute("select * from memory.default.alt").rows) == [
+        (1, "x", None), (2, "y", None),
+    ]
+    runner.execute("alter table memory.default.alt rename column b to bb")
+    cols = runner.execute("show columns from memory.default.alt").rows
+    assert [c[0] for c in cols] == ["a", "bb", "c"]
+    runner.execute("alter table memory.default.alt drop column a")
+    assert sorted(runner.execute("select * from memory.default.alt").rows) == [
+        ("x", None), ("y", None),
+    ]
+    runner.execute("alter table memory.default.alt rename to memory.default.alt2")
+    tables = runner.execute("show tables from memory.default").rows
+    assert ("alt2",) in tables and ("alt",) not in tables

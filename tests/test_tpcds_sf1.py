@@ -14,9 +14,9 @@ import pytest
 
 from trino_tpu.connectors.tpcds.queries import QUERIES
 
-#: small but structurally diverse slice: star joins (3, 7, 19) and
-#: grouping breadth (42, 52)
-SPOT = [3, 7, 19, 42, 52]
+#: structurally diverse slice: star joins (3, 7, 19), date-dim correlated
+#: subquery (25), grouping breadth (42, 52), inventory semi-join shape (82)
+SPOT = [3, 7, 19, 25, 42, 52, 82]
 
 
 @pytest.fixture(scope="module")

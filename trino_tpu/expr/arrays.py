@@ -324,17 +324,9 @@ def _slice_array(ctx, call, arr, start, length):
 @register("$array_concat")
 def array_concat(ctx, call, a: Val, b: Val) -> Val:
     """array || array (reference: ArrayConcatFunction)."""
-    from trino_tpu.columnar.dictionary import union_many
-
     da, la = _arr2d(ctx, a)
     db, lb = _arr2d(ctx, b)
-    dictionary = a.dictionary
-    if a.dictionary is not None or b.dictionary is not None:
-        dictionary, (ta, tb) = union_many([a.dictionary, b.dictionary])
-        if ta is not None:
-            da = jnp.take(jnp.asarray(ta), jnp.asarray(da, jnp.int32), mode="clip")
-        if tb is not None:
-            db = jnp.take(jnp.asarray(tb), jnp.asarray(db, jnp.int32), mode="clip")
+    da, db, dictionary = _unify_array_dicts(a, da, b, db)
     ka, kb = da.shape[1], db.shape[1]
     k = ka + kb
     dt = call.type.element.np_dtype
@@ -351,13 +343,11 @@ def array_concat(ctx, call, a: Val, b: Val) -> Val:
     )
 
 
-def _membership(ctx, a: Val, b: Val):
-    """(hit [cap, Ka], a-codes in the MERGED dictionary, a-lengths, merged
-    dictionary): which live elements of a appear among b's live elements."""
+def _unify_array_dicts(a: Val, da, b: Val, db):
+    """Merge two array Vals' dictionaries and recode both data planes.
+    Returns (da, db, merged dictionary)."""
     from trino_tpu.columnar.dictionary import union_many
 
-    da, la = _arr2d(ctx, a)
-    db, lb = _arr2d(ctx, b)
     dictionary = a.dictionary
     if a.dictionary is not None or b.dictionary is not None:
         dictionary, (ta, tb) = union_many([a.dictionary, b.dictionary])
@@ -365,6 +355,15 @@ def _membership(ctx, a: Val, b: Val):
             da = jnp.take(jnp.asarray(ta), jnp.asarray(da, jnp.int32), mode="clip")
         if tb is not None:
             db = jnp.take(jnp.asarray(tb), jnp.asarray(db, jnp.int32), mode="clip")
+    return da, db, dictionary
+
+
+def _membership(ctx, a: Val, b: Val):
+    """(hit [cap, Ka], a-codes in the MERGED dictionary, a-lengths, merged
+    dictionary): which live elements of a appear among b's live elements."""
+    da, la = _arr2d(ctx, a)
+    db, lb = _arr2d(ctx, b)
+    da, db, dictionary = _unify_array_dicts(a, da, b, db)
     emb = _elem_mask(db, lb)
     hit = jnp.any(
         jnp.logical_and(emb[:, None, :], da[:, :, None] == db[:, None, :]),

@@ -328,6 +328,15 @@ class LocalQueryRunner:
                 rows,
                 [T.VARCHAR, T.VARCHAR, T.VARCHAR, T.VARCHAR, T.BOOLEAN, T.VARCHAR],
             )
+        if stmt.what == "create_table":
+            # reference: sql/rewrite/ShowQueriesRewrite's SHOW CREATE TABLE
+            cat, schema, table = self._resolve_table(stmt.target)
+            meta = self.catalogs.get(cat).metadata().table_metadata(schema, table)
+            cols = ",\n".join(
+                f"   {c.name} {c.type.name}" for c in meta.columns
+            )
+            ddl = f"CREATE TABLE {cat}.{schema}.{table} (\n{cols}\n)"
+            return MaterializedResult(["Create Table"], [(ddl,)], [T.VARCHAR])
         if stmt.what == "roles":
             return MaterializedResult(
                 ["Role"], [(r,) for r in self.grants.list_roles()], [T.VARCHAR]
@@ -507,6 +516,95 @@ class LocalQueryRunner:
     ) -> MaterializedResult:
         self.prepared.pop(stmt.name, None)
         return _ok("DEALLOCATE")
+
+    def _exec_AlterTable(self, stmt: ast.AlterTable) -> MaterializedResult:
+        """ALTER TABLE via snapshot + rebuild on write-capable connectors
+        (reference roles: sql/tree/RenameTable/AddColumn/DropColumn/
+        RenameColumn + connector metadata DDL methods)."""
+        from trino_tpu import types as T
+        from trino_tpu.connectors.api import ColumnMeta, TableHandle
+
+        cat, schema, table = self._resolve_table(stmt.name)
+        conn = self.catalogs.get(cat)
+        if not conn.supports_writes():
+            raise NotImplementedError(f"connector {cat} does not support ALTER")
+        meta = conn.metadata().table_metadata(schema, table)
+        self.access_control.check_can_write(self.user, cat, schema, table)
+        self.transactions.notify_write(cat, schema, table)
+        data = self._run_query(
+            ast.Query(
+                ast.QuerySpec(
+                    (ast.Star(),), ast.TableRef((cat, schema, table)), None, (), None
+                )
+            )
+        )
+        cols = list(meta.columns)
+        rows = [list(r) for r in data.rows]
+        if stmt.action == "rename_table":
+            # unqualified targets resolve against the SOURCE table's
+            # catalog/schema (the reference renames within them)
+            if len(stmt.target) == 1:
+                tgt = (cat, schema, stmt.target[0])
+            elif len(stmt.target) == 2:
+                tgt = (cat, stmt.target[0], stmt.target[1])
+            else:
+                tgt = tuple(stmt.target)
+            if tgt[0] != cat:
+                raise ValueError("RENAME cannot move tables across catalogs")
+            new_schema, new_table = tgt[1], tgt[2]
+            existing = conn.metadata().list_tables(new_schema)
+            if new_table in existing:
+                raise ValueError(
+                    f"target table {new_schema}.{new_table} already exists"
+                )
+            self.transactions.notify_write(cat, new_schema, new_table)
+        else:
+            new_schema, new_table = schema, table
+            names = [c.name for c in cols]
+            if stmt.action == "add_column":
+                if stmt.column in names:
+                    raise ValueError(f"column {stmt.column} already exists")
+                cols.append(ColumnMeta(stmt.column, T.parse_type(stmt.column_type)))
+                for r in rows:
+                    r.append(None)
+            elif stmt.action == "drop_column":
+                if stmt.column not in names:
+                    raise ValueError(f"column {stmt.column} does not exist")
+                ix = names.index(stmt.column)
+                cols.pop(ix)
+                for r in rows:
+                    r.pop(ix)
+            elif stmt.action == "rename_column":
+                if stmt.column not in names:
+                    raise ValueError(f"column {stmt.column} does not exist")
+                if stmt.new_name in names:
+                    raise ValueError(
+                        f"column {stmt.new_name} already exists"
+                    )
+                ix = names.index(stmt.column)
+                cols[ix] = ColumnMeta(stmt.new_name, cols[ix].type)
+            else:
+                raise NotImplementedError(f"ALTER action {stmt.action}")
+        result = MaterializedResult(
+            [c.name for c in cols], [tuple(r) for r in rows], [c.type for c in cols]
+        )
+        same_name = (new_schema, new_table) == (schema, table)
+        snap_fn = getattr(conn, "snapshot_table", None)
+        snap = snap_fn(schema, table) if (same_name and snap_fn) else None
+        conn.create_table(new_schema, new_table, cols)
+        try:
+            self._write_rows(conn, TableHandle(cat, new_schema, new_table), result)
+        except BaseException:
+            # never leave the table truncated/half-built
+            if same_name and snap_fn is not None:
+                conn.restore_table(schema, table, snap)
+            elif not same_name:
+                conn.drop_table(TableHandle(cat, new_schema, new_table))
+            raise
+        if not same_name:
+            conn.drop_table(TableHandle(cat, schema, table))
+            self.grants.set_owner(cat, new_schema, new_table, self.user)
+        return _ok("ALTER TABLE")
 
     def _exec_GrantStatement(self, stmt: ast.GrantStatement) -> MaterializedResult:
         if stmt.roles:

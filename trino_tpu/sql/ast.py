@@ -475,6 +475,18 @@ class RoleStatement(Node):
 
 
 @dataclass(frozen=True)
+class AlterTable(Node):
+    """reference: sql/tree/RenameTable/AddColumn/DropColumn/RenameColumn."""
+
+    name: tuple
+    action: str  # rename_table | rename_column | add_column | drop_column
+    target: tuple = ()  # rename_table
+    column: str = ""
+    new_name: str = ""
+    column_type: str = ""
+
+
+@dataclass(frozen=True)
 class MergeCase(Node):
     """One WHEN clause (reference: sql/tree/MergeCase.java subclasses
     MergeUpdate / MergeDelete / MergeInsert)."""

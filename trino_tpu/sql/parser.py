@@ -169,6 +169,8 @@ class Parser:
             return ast.DeleteStatement(name, where)
         if t.kind == "ident" and t.value.lower() == "merge":
             return self._merge()
+        if t.kind == "ident" and t.value.lower() == "alter":
+            return self._alter()
         if t.kind == "ident" and t.value.lower() in ("grant", "revoke"):
             return self._grant_revoke(t.value.lower())
         if t.is_kw("prepare"):
@@ -240,6 +242,9 @@ class Parser:
                 return ast.ShowStatement("stats", self.qualified_name())
             if what.kind == "ident" and what.value.lower() == "roles":
                 return ast.ShowStatement("roles")
+            if what.is_kw("create"):
+                self.expect_kw("table")
+                return ast.ShowStatement("create_table", self.qualified_name())
             if what.kind == "ident" and what.value.lower() == "grants":
                 target = ()
                 if self.accept_kw("on"):
@@ -383,6 +388,32 @@ class Parser:
                 tuple(privs), name, grantee, is_role, (), grant_option
             )
         return ast.RevokeStatement(tuple(privs), name, grantee)
+
+    def _alter(self) -> ast.Node:
+        """ALTER TABLE t RENAME TO t2 | ADD COLUMN c type | DROP COLUMN c |
+        RENAME COLUMN a TO b (reference: SqlBase.g4 alterTable rules +
+        sql/tree/RenameTable/AddColumn/DropColumn/RenameColumn)."""
+        self.next()  # alter
+        self.expect_kw("table")
+        name = self.qualified_name()
+        t = self.next()
+        word = t.value.lower()
+        if word == "rename":
+            if self.accept_kw("to"):
+                return ast.AlterTable(name, "rename_table", target=self.qualified_name())
+            self._expect_ident("column")
+            col = self.ident()
+            self.expect_kw("to")
+            return ast.AlterTable(name, "rename_column", column=col, new_name=self.ident())
+        if word == "add":
+            self._expect_ident("column")
+            col = self.ident()
+            ctype = self._type_name()
+            return ast.AlterTable(name, "add_column", column=col, column_type=ctype)
+        if word == "drop":
+            self._expect_ident("column")
+            return ast.AlterTable(name, "drop_column", column=self.ident())
+        raise ParseError("unsupported ALTER TABLE action", t)
 
     def _merge(self) -> "ast.MergeStatement":
         """MERGE INTO t [AS a] USING s [AS b] ON cond WHEN [NOT] MATCHED

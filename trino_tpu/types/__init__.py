@@ -319,6 +319,9 @@ _SIMPLE_BY_NAME = {
     )
 }
 _SIMPLE_BY_NAME["timestamptz"] = TIMESTAMP_TZ
+#: the JSON type rides the varchar representation (json path functions
+#: parse per dictionary value; reference: spi JsonType over Slice)
+_SIMPLE_BY_NAME["json"] = VARCHAR
 _SIMPLE_BY_NAME["varchar"] = VARCHAR
 _SIMPLE_BY_NAME["varbinary"] = VARBINARY
 _SIMPLE_BY_NAME["string"] = VARCHAR  # convenience alias
