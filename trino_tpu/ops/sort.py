@@ -41,20 +41,65 @@ class OrderByOperator:
         live = jnp.take(batch.mask(), perm, mode="clip")
         return batch.gather(perm, valid=live)
 
-    def process(self, stream):
-        from trino_tpu.runtime.memory import batch_bytes
+    def _spill_chunk(self) -> Batch:
+        """Device-SORT the accumulated batches, compact to live rows, and
+        move the sorted run to HOST memory (freeing HBM) — the runs then
+        honor merge_sorted_shards' sorted-input contract."""
+        from trino_tpu.columnar.batch import device_get_async
 
+        big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
+        self._acc.clear()
+        big = self._step(_pad_device(big, next_pow2(big.capacity, floor=1)))
+        n = big.num_rows_host()
+        cap = next_pow2(max(n, 1), floor=1)
+        ckey = ("spill_compact",)
+        if ckey not in _STEP_CACHE:
+            _STEP_CACHE[ckey] = jax.jit(
+                Batch.compact_device, static_argnames=("out_capacity",)
+            )
+        compact = _STEP_CACHE[ckey](big, out_capacity=cap)
+        return device_get_async(compact)
+
+    def process(self, stream):
+        """In-memory device sort; over budget, fall back to an EXTERNAL sort
+        (reference: OrderingCompiler + spiller/ GenericSpiller usage in
+        OrderByOperator.java — revoke memory by spilling runs, merge at
+        finish).  Spill runs live in host RAM; the final merge is the same
+        vectorized host lexsort the merge exchange uses, so device memory
+        stays bounded by one chunk."""
+        from trino_tpu.runtime.memory import (
+            ExceededMemoryLimitException,
+            batch_bytes,
+        )
+
+        runs: list[Batch] = []
         total = 0
         for b in stream:
             self._acc.append(b)
             if self.memory_ctx is not None:
                 total += batch_bytes(b)
-                self.memory_ctx.set_bytes(total)
-        if not self._acc:
+                try:
+                    self.memory_ctx.set_bytes(total)
+                except ExceededMemoryLimitException:
+                    runs.append(self._spill_chunk())
+                    total = 0
+                    self.memory_ctx.set_bytes(0)
+        if not self._acc and not runs:
             return
-        big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
-        big = _pad_device(big, next_pow2(big.capacity, floor=1))
-        out = self._step(big)
+        if not runs:
+            big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
+            big = _pad_device(big, next_pow2(big.capacity, floor=1))
+            out = self._step(big)
+            if self.memory_ctx is not None:
+                self.memory_ctx.close()
+            yield out
+            return
+        if self._acc:
+            runs.append(self._spill_chunk())
+        from trino_tpu.ops.merge import merge_sorted_shards
+
+        runs = _unify_host_dictionaries(runs)
+        out = merge_sorted_shards(runs, self.keys)
         if self.memory_ctx is not None:
             self.memory_ctx.close()
         yield out
@@ -122,6 +167,33 @@ class LimitOperator:
                 remaining = None if remaining is None else remaining - (cnt - skip)
                 skip = 0
                 yield b
+
+
+def _unify_host_dictionaries(runs: list) -> list:
+    """Spill runs from different scan batches may carry per-run
+    dictionaries; recode every string channel into one union dictionary so
+    the merge's code comparisons are rank comparisons again."""
+    import numpy as np
+
+    from trino_tpu.columnar import Column
+    from trino_tpu.columnar.dictionary import union_many
+
+    if not runs:
+        return runs
+    width = runs[0].width
+    out = [list(r.columns) for r in runs]
+    for ch in range(width):
+        dicts = [r.columns[ch].dictionary for r in runs]
+        if not any(d is not None for d in dicts):
+            continue
+        merged, tables = union_many(dicts)
+        for i, table in enumerate(tables):
+            c = out[i][ch]
+            data = np.asarray(c.data)
+            if table is not None:
+                data = np.asarray(table)[np.clip(data.astype(np.int64), 0, len(table) - 1)]
+            out[i][ch] = Column(data, c.type, c.valid, merged, c.lengths)
+    return [Batch(cols, r.row_mask) for cols, r in zip(out, runs)]
 
 
 def _truncate(batch: Batch, cap: int) -> Batch:
