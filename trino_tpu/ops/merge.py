@@ -83,18 +83,58 @@ def merge_sorted_shards(shards: Sequence[Batch], keys: Sequence[SortKey]) -> Bat
     cols = []
     for ch in range(shards[0].width):
         first = shards[0].columns[ch]
-        data = np.concatenate([np.asarray(s.columns[ch].data) for s in shards])[order]
-        if any(s.columns[ch].valid is not None for s in shards):
+        parts = [s.columns[ch] for s in shards]
+        lengths = None
+        if any(p.lengths is not None for p in parts):
+            # array/map channels: right-pad each shard's element plane to
+            # the widest K (map channels pad per packed half) and carry the
+            # per-row lengths through the permutation
+            from trino_tpu.types import MapType
+
+            is_map = isinstance(first.type, MapType)
+            kmax = max(
+                (np.asarray(p.data).shape[1] for p in parts if p.lengths is not None),
+                default=1,
+            )
+            kmax = max(kmax, 2 if is_map else 1)
+            padded = []
+            lens_parts = []
+            for p, s in zip(parts, shards):
+                d = np.asarray(p.data)
+                if p.lengths is None or d.ndim == 1:
+                    d = np.zeros((s.capacity, kmax), dtype=d.dtype)
+                    lens_parts.append(np.zeros(s.capacity, np.int32))
+                else:
+                    if d.shape[1] < kmax:
+                        if is_map:
+                            half = d.shape[1] // 2
+                            pad = (kmax - d.shape[1]) // 2
+                            d = np.concatenate(
+                                [
+                                    np.pad(d[:, :half], ((0, 0), (0, pad))),
+                                    np.pad(d[:, half:], ((0, 0), (0, pad))),
+                                ],
+                                axis=1,
+                            )
+                        else:
+                            d = np.pad(d, ((0, 0), (0, kmax - d.shape[1])))
+                    lens_parts.append(np.asarray(p.lengths, np.int32))
+                padded.append(d)
+            data = np.concatenate(padded)[order]
+            lengths = np.concatenate(lens_parts)[order]
+        else:
+            data = np.concatenate([np.asarray(p.data) for p in parts])[order]
+        if any(p.valid is not None for p in parts):
             valid = np.concatenate(
                 [
-                    np.asarray(s.columns[ch].valid)
-                    if s.columns[ch].valid is not None
+                    np.asarray(p.valid)
+                    if p.valid is not None
                     else np.ones(s.capacity, dtype=bool)
-                    for s in shards
+                    for p, s in zip(parts, shards)
                 ]
             )[order]
         else:
             valid = None
-        cols.append(Column(data, first.type, valid, first.dictionary))
+        cols.append(Column(data, first.type, valid, first.dictionary, lengths))
     mask = np.concatenate([np.asarray(s.mask()) for s in shards])[order]
     return Batch(cols, mask)

@@ -42,14 +42,15 @@ class OrderByOperator:
         return batch.gather(perm, valid=live)
 
     def _spill_chunk(self) -> Batch:
-        """Device-SORT the accumulated batches, compact to live rows, and
-        move the sorted run to HOST memory (freeing HBM) — the runs then
-        honor merge_sorted_shards' sorted-input contract."""
+        """Compact the accumulated batches to live rows and move them to
+        HOST memory (freeing HBM) as one spill run.  Runs are NOT
+        per-run sorted: the finish-time merge is a full host lexsort, so a
+        per-run device sort would be thrown-away work; the single-run case
+        re-sorts on device at finish."""
         from trino_tpu.columnar.batch import device_get_async
 
         big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
         self._acc.clear()
-        big = self._step(_pad_device(big, next_pow2(big.capacity, floor=1)))
         n = big.num_rows_host()
         cap = next_pow2(max(n, 1), floor=1)
         ckey = ("spill_compact",)
@@ -63,10 +64,10 @@ class OrderByOperator:
     def process(self, stream):
         """In-memory device sort; over budget, fall back to an EXTERNAL sort
         (reference: OrderingCompiler + spiller/ GenericSpiller usage in
-        OrderByOperator.java — revoke memory by spilling runs, merge at
-        finish).  Spill runs live in host RAM; the final merge is the same
-        vectorized host lexsort the merge exchange uses, so device memory
-        stays bounded by one chunk."""
+        OrderByOperator.java — revoke memory by spilling runs, sort at
+        finish).  Spill runs live UNSORTED in host RAM; the finish step is
+        one vectorized host lexsort over all runs (the merge exchange's
+        kernel), so device memory stays bounded by one chunk."""
         from trino_tpu.runtime.memory import (
             ExceededMemoryLimitException,
             batch_bytes,
@@ -96,6 +97,15 @@ class OrderByOperator:
             return
         if self._acc:
             runs.append(self._spill_chunk())
+        if len(runs) == 1:
+            # one run = the budget tripped at the very end; a device sort of
+            # the whole set is what the in-memory path would have done
+            big = jax.device_put(runs[0])
+            out = self._step(_pad_device(big, next_pow2(big.capacity, floor=1)))
+            if self.memory_ctx is not None:
+                self.memory_ctx.close()
+            yield out
+            return
         from trino_tpu.ops.merge import merge_sorted_shards
 
         runs = _unify_host_dictionaries(runs)

@@ -55,6 +55,9 @@ class WindowSpec:
     end_off: Optional[int] = 0
 
 
+_WINDOW_STEP_CACHE: dict = {}
+
+
 class WindowOperator:
     def __init__(
         self,
@@ -66,7 +69,27 @@ class WindowOperator:
         self.order_keys = list(order_keys)
         self.specs = list(specs)
         self._acc: list[Batch] = []
-        self._step = jax.jit(self._window_step)
+        # shared jitted step across per-query instances (wave execution
+        # constructs one operator per wave; identical configs must not
+        # re-trace — the _STEP_CACHE convention of ops/sort.py)
+        key = (
+            "window",
+            tuple(self.partition_channels),
+            tuple(self.order_keys),
+            tuple(
+                (
+                    sp.name, sp.arg, sp.out_type.name, sp.offset,
+                    sp.default_channel, sp.n_buckets, sp.frame,
+                    sp.start_off, sp.end_off,
+                )
+                for sp in self.specs
+            ),
+        )
+        cached = _WINDOW_STEP_CACHE.get(key)
+        if cached is None:
+            cached = jax.jit(self._window_step)
+            _WINDOW_STEP_CACHE[key] = cached
+        self._step = cached
 
     # -- the jitted kernel ----------------------------------------------------
 

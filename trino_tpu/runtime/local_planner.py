@@ -451,8 +451,20 @@ class LocalExecutionPlanner:
                     end_off=fn.end_off,
                 )
             )
-        op = WindowOperator(part, order, specs)
-        return PhysicalPlan(op.process(src.stream), node.outputs)
+        budget = self.properties.get("query_max_memory_bytes")
+        if budget and part:
+            stream = _window_wave_stream(
+                lambda: WindowOperator(part, order, specs),
+                src.stream,
+                list(part),
+                int(budget),
+            )
+        else:
+            # global windows (no PARTITION BY) need every row at once —
+            # no partition-disjoint wave exists
+            op = WindowOperator(part, order, specs)
+            stream = op.process(src.stream)
+        return PhysicalPlan(stream, node.outputs)
 
     # -- ordering / limiting --------------------------------------------------
 
@@ -727,6 +739,45 @@ def _host_wave_slice(hb: Batch, key_channels: list, n_waves: int, wave: int):
             )
         )
     return Batch(cols, np.ones(n, dtype=bool))
+
+
+def _window_wave_stream(make_op, feed, key_channels: list, budget: int):
+    """Memory-bounded window execution: window functions only ever look
+    within ONE partition, so hash-partitioning the input by the PARTITION BY
+    keys into waves is exact — each wave materializes and sorts only its
+    slice on device (reference role: the spill path of WindowOperator.java/
+    PagesIndex, reshaped as partition-disjoint waves)."""
+    import math
+
+    import jax
+
+    from trino_tpu.runtime.memory import batch_bytes
+
+    acc: list = []
+    total = 0
+    over = False
+    for b in feed:
+        if over:
+            acc.append(device_get_async(b))
+        else:
+            acc.append(b)
+        total += batch_bytes(b)
+        if not over and total > budget:
+            over = True
+            acc = device_get_async(list(acc))  # device memory -> host spool
+    if not over:
+        yield from make_op().process(iter(acc))
+        return
+    n_waves = min(64, max(2, math.ceil(2.0 * total / budget)))
+    for wave in range(n_waves):
+        parts = []
+        for hb in acc:
+            p = _host_wave_slice(hb, key_channels, n_waves, wave)
+            if p is not None:
+                parts.append(p)
+        if not parts:
+            continue
+        yield from make_op().process(jax.device_put(p) for p in parts)
 
 
 def _agg_raw_wave_stream(make_op, op, feed, key_channels: list, budget: int):
