@@ -155,3 +155,17 @@ def test_scalar_count_subquery_coalesced(catalogs):
         for p in projs for _, e in p.assignments
     )
     assert found
+
+
+@pytest.mark.smoke
+def test_explain_type_distributed():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    runner = LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+    rows = runner.execute(
+        "explain (type distributed) "
+        "select l_returnflag, count(*) from lineitem group by 1"
+    ).rows
+    flat = "\n".join(r[0] for r in rows)
+    assert "Fragment" in flat and "FIXED_HASH[l_returnflag]" in flat
+    assert "RemoteSource" in flat

@@ -221,6 +221,20 @@ class LocalQueryRunner:
             collector = StatsCollector()
             self._run_query(inner.query, stats=collector)
             text = collector.render()
+        elif stmt.explain_type == "distributed":
+            # fragments + partitioning handles, even from a local runner
+            # (reference: EXPLAIN (TYPE DISTRIBUTED) -> PlanFragmenter)
+            from trino_tpu.planner.fragmenter import (
+                add_exchanges,
+                create_subplans,
+                fragment_text,
+            )
+
+            plan = self.plan_query(inner.query)
+            sub = create_subplans(
+                add_exchanges(plan, self.catalogs, self.properties)
+            )
+            text = fragment_text(sub)
         else:
             text = plan_text(self.plan_query(inner.query))
         return MaterializedResult(

@@ -367,6 +367,7 @@ class SelectStatement(Node):
 class ExplainStatement(Node):
     statement: Node
     analyze: bool = False
+    explain_type: str = "logical"  # logical | distributed
 
 
 @dataclass(frozen=True)

@@ -104,9 +104,12 @@ class Parser:
         if t.is_kw("explain"):
             self.next()
             analyze = self.accept_kw("analyze") is not None
-            # optional (TYPE ...) options are accepted and ignored
+            # (TYPE DISTRIBUTED|LOGICAL) honored; other options accepted
+            # and ignored (reference: SqlBase.g4 explainOption)
+            explain_type = "logical"
             if self.accept_op("("):
                 depth = 1
+                toks = []
                 while depth:
                     tk = self.next()
                     if tk.kind == "eof":
@@ -115,7 +118,13 @@ class Parser:
                         depth += 1
                     elif tk.kind == "op" and tk.value == ")":
                         depth -= 1
-            return ast.ExplainStatement(self._statement(), analyze=analyze)
+                    else:
+                        toks.append(tk.value.lower())
+                if "type" in toks and "distributed" in toks:
+                    explain_type = "distributed"
+            return ast.ExplainStatement(
+                self._statement(), analyze=analyze, explain_type=explain_type
+            )
         if t.is_kw("create") and self._peek_ident(1, "role"):
             self.next()
             self.next()
