@@ -69,3 +69,15 @@ def test_prepare_null_and_negative_params(runner):
         "prepare q2 from select count(*) from nation where n_nationkey > ?"
     )
     assert runner.execute("execute q2 using -1").rows == [(25,)]
+
+
+@pytest.mark.smoke
+def test_describe_input_output(runner):
+    runner.execute(
+        "prepare dq from select n_name, n_regionkey + ? as rk "
+        "from nation where n_nationkey < ?"
+    )
+    out = runner.execute("describe output dq").rows
+    assert out == [("n_name", "varchar(25)"), ("rk", "bigint")]
+    inp = runner.execute("describe input dq").rows
+    assert inp == [(0, "unknown"), (1, "unknown")]

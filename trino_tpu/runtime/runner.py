@@ -525,6 +525,39 @@ class LocalQueryRunner:
         params = [_ast_literal_value(p) for p in stmt.params]
         return self.execute(_substitute(text, params))
 
+    def _exec_DescribeStatement(self, stmt: ast.DescribeStatement) -> MaterializedResult:
+        """DESCRIBE INPUT/OUTPUT over a prepared statement (reference:
+        sql/analyzer DescribeInputRewrite / DescribeOutputRewrite): the
+        statement plans with placeholders bound to NULL; OUTPUT reports the
+        result columns, INPUT the parameter positions (types unknown — the
+        engine does not infer placeholder types, like the reference reports
+        'unknown' for non-inferable positions)."""
+        from trino_tpu import types as T
+        from trino_tpu.dbapi import _substitute
+
+        text = self.prepared.get(stmt.name)
+        if text is None:
+            raise KeyError(f"prepared statement {stmt.name} not found")
+        n_params = text.count("?")
+        if stmt.kind == "input":
+            return MaterializedResult(
+                ["Position", "Type"],
+                [(i, "unknown") for i in range(n_params)],
+                [T.BIGINT, T.VARCHAR],
+            )
+        bound = _substitute(text, [None] * n_params)
+        parsed = parse_statement(bound)
+        if not isinstance(parsed, ast.SelectStatement):
+            raise NotImplementedError("DESCRIBE OUTPUT supports queries only")
+        plan = self.plan_query(parsed.query)
+        rows = [
+            (name, sym.type.name)
+            for name, sym in zip(plan.column_names, plan.symbols)
+        ]
+        return MaterializedResult(
+            ["Column Name", "Type"], rows, [T.VARCHAR, T.VARCHAR]
+        )
+
     def _exec_DeallocateStatement(
         self, stmt: ast.DeallocateStatement
     ) -> MaterializedResult:

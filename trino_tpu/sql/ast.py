@@ -525,6 +525,15 @@ class ShowStatement(Node):
 
 
 @dataclass(frozen=True)
+class DescribeStatement(Node):
+    """DESCRIBE INPUT/OUTPUT <prepared> (reference: sql/tree/
+    DescribeInput.java, DescribeOutput.java)."""
+
+    kind: str  # input | output
+    name: str = ""
+
+
+@dataclass(frozen=True)
 class SetSession(Node):
     name: str
     value: Node

@@ -263,6 +263,12 @@ class Parser:
             raise ParseError("unsupported SHOW", what)
         if t.is_kw("describe"):
             self.next()
+            if self._peek_ident(0, "input"):
+                self.next()
+                return ast.DescribeStatement("input", self.ident())
+            if self._peek_ident(0, "output"):
+                self.next()
+                return ast.DescribeStatement("output", self.ident())
             return ast.ShowStatement("columns", self.qualified_name())
         if t.is_kw("set"):
             self.next()
