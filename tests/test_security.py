@@ -117,3 +117,13 @@ def test_coordinator_basic_auth():
         assert stats["totalQueries"] >= 1
     finally:
         srv.shutdown()
+
+
+@pytest.mark.smoke
+def test_current_user():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    r = LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+    assert r.execute("select current_user").rows == [("user",)]
+    r.user = "alice"
+    assert r.execute("select current_user").rows == [("alice",)]

@@ -375,6 +375,11 @@ class ExprAnalyzer:
         if n.name == "current_date":
             today = (datetime.date.today() - _EPOCH).days
             return Literal(today, T.DATE)
+        if n.name == "current_user":
+            from trino_tpu.runtime.session import CURRENT_USER
+
+            u = CURRENT_USER.get()
+            return Literal(u, T.VarcharType(len(u)))
         if n.name == "current_timestamp":
             # reference: scalar/CurrentTimestamp.java — session start instant
             # in the session zone (ours: UTC)

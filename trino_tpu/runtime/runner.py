@@ -131,7 +131,10 @@ class LocalQueryRunner:
         from trino_tpu.runtime.events import QueryCompletedEvent, QueryCreatedEvent
         from trino_tpu.runtime.retry import execute_with_retry
 
+        from trino_tpu.runtime.session import CURRENT_USER
+
         self.access_control.check_can_execute_query(self.user)
+        CURRENT_USER.set(self.user)
         stmt = parse_statement(sql)
         m = getattr(self, "_exec_" + type(stmt).__name__, None)
         if m is None:

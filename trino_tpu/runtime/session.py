@@ -122,3 +122,13 @@ class SessionProperties:
     def items(self):
         for name, meta in SESSION_PROPERTIES.items():
             yield name, self._values.get(name, meta.default), meta
+
+
+#: the executing statement's identity, set by the runner around dispatch
+#: (reference: Session.getUser() — threaded as a contextvar because the
+#: expression analyzer has no session handle)
+import contextvars
+
+CURRENT_USER: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "trino_tpu_current_user", default="user"
+)

@@ -1203,7 +1203,7 @@ class Parser:
             e = ast.FunctionCall("strpos", (operand, sub))
         elif t.is_kw("current_date"):
             e = ast.FunctionCall("current_date", ())
-        elif t.is_kw("current_timestamp", "localtimestamp"):
+        elif t.is_kw("current_timestamp", "localtimestamp", "current_user"):
             e = ast.FunctionCall(t.value.lower(), ())
         elif t.is_kw("not"):
             e = ast.UnaryOp("not", self._expr(3))

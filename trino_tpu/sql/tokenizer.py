@@ -20,7 +20,7 @@ KEYWORDS = {
     "following", "current", "filter", "within", "ordinality", "unnest",
     "lateral", "tablesample", "bernoulli", "system", "substring", "for",
     "position", "localtime", "localtimestamp", "current_date",
-    "current_time", "current_timestamp", "exec", "execute", "prepare",
+    "current_time", "current_timestamp", "current_user", "exec", "execute", "prepare",
     "deallocate", "commit", "rollback", "start", "transaction", "work", "use",
     "year", "month", "day", "hour", "minute", "second", "quarter", "week",
     "to",
