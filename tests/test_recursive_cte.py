@@ -1,0 +1,112 @@
+"""WITH RECURSIVE tests (reference: sql/planner recursive CTE expansion,
+bounded by max-recursion-depth)."""
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+def test_counting_sequence(runner):
+    rows = runner.execute(
+        "with recursive t(n) as (select 1 union all "
+        "select n + 1 from t where n < 5) select * from t order by 1"
+    ).rows
+    assert rows == [(1,), (2,), (3,), (4,), (5,)]
+
+
+def test_factorial(runner):
+    rows = runner.execute(
+        "with recursive f(n, v) as (select 1, 1 union all "
+        "select n + 1, v * (n + 1) from f where n < 5) select max(v) from f"
+    ).rows
+    assert rows == [(120,)]
+
+
+def test_distinct_union_fixpoint(runner):
+    rows = runner.execute(
+        "with recursive t(n) as (select 1 union "
+        "select n % 3 + 1 from t) select count(*), sum(n) from t"
+    ).rows
+    assert rows == [(3, 6)]  # fixpoint {1, 2, 3}
+
+
+def test_recursive_over_table(runner):
+    # transitive walk: start at region 0's nations, hop via shared regions
+    rows = runner.execute(
+        "with recursive walk(k) as ("
+        "  select n_nationkey from nation where n_nationkey = 0 "
+        "  union all "
+        "  select w.k + 5 from walk w where w.k < 20"
+        ") select count(*) from walk"
+    ).rows
+    assert rows == [(5,)]  # 0, 5, 10, 15, 20
+
+
+def test_depth_guard(runner):
+    with pytest.raises(RuntimeError, match="exceeded"):
+        runner.execute(
+            "with recursive t(n) as (select 1 union all "
+            "select n + 1 from t) select count(*) from t"
+        )
+
+
+def test_count_star_over_values(runner):
+    """Regression: zero-column projections must carry the row count."""
+    assert runner.execute(
+        "select count(*) from (values (1), (2))"
+    ).rows == [(2,)]
+
+
+def test_non_recursive_with_still_works(runner):
+    rows = runner.execute(
+        "with r as (select r_regionkey k from region) "
+        "select count(*) from r"
+    ).rows
+    assert rows == [(5,)]
+
+
+def test_empty_anchor(runner):
+    assert runner.execute(
+        "with recursive t(x) as (select 1 where false union all "
+        "select x+1 from t) select count(*) from t"
+    ).rows == [(0,)]
+
+
+def test_union_dedupes_anchor(runner):
+    assert runner.execute(
+        "with recursive t(x) as (select * from (values (1),(1)) v(x) "
+        "union select x from t where false) select count(*) from t"
+    ).rows == [(1,)]
+
+
+def test_step_type_widening(runner):
+    from decimal import Decimal
+
+    rows = runner.execute(
+        "with recursive t(x) as (select 1 union all "
+        "select x + 0.5 from t where x < 3) select max(x) from t"
+    ).rows
+    assert rows == [(Decimal("3.0"),)]
+
+
+def test_nested_cte_in_definition(runner):
+    assert runner.execute(
+        "with recursive t(n) as (with seed as (select 1 as n) "
+        "select n from seed union all select n+1 from t where n<3) "
+        "select count(*) from t"
+    ).rows == [(3,)]
+
+
+def test_explain_recursive(runner):
+    rows = runner.execute(
+        "explain with recursive t(n) as (select 1 union all "
+        "select n + 1 from t where n < 3) select * from t"
+    ).rows
+    assert rows

@@ -50,6 +50,11 @@ class FilterProjectOperator:
             if pred is not None:
                 out = out.filter(c.filter_mask(pred))
             cols = [c.column(e) for e in projs]
+            if not cols:
+                # zero-column projection (`count(*)` over bare rows): the
+                # row count must ride the materialized mask, else capacity
+                # collapses to 0
+                return Batch(cols, out.mask())
             return Batch(cols, out.row_mask)
 
         return step

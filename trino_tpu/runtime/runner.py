@@ -107,6 +107,7 @@ class LocalQueryRunner:
         return self.plan_query(stmt.query)
 
     def plan_query(self, query: ast.Query) -> OutputNode:
+        query = self._expand_recursive_ctes(query)
         plan = LogicalPlanner(
             self.catalogs, self.session, views=self.views
         ).plan(query)
@@ -176,6 +177,127 @@ class LocalQueryRunner:
                 walk(c)
 
         walk(plan)
+
+    #: WITH RECURSIVE iteration cap (reference: the max_recursion_depth
+    #: session property guarding RecursiveCte expansion)
+    MAX_RECURSION_DEPTH = 100
+
+    def _expand_recursive_ctes(self, query: ast.Query) -> ast.Query:
+        """WITH RECURSIVE t AS (anchor UNION [ALL] step) — iterate to a
+        fixpoint and replace the CTE with its materialized rows (reference:
+        sql/planner's recursive CTE expansion, which the reference also
+        bounds by max-recursion-depth; here each step plans the recursive
+        term against a VALUES relation of the previous delta)."""
+        if not query.recursive:
+            return query
+
+        def references(node, name) -> bool:
+            if isinstance(node, ast.TableRef) and node.name == (name,):
+                return True
+
+            def walk_tuple(t) -> bool:
+                for item in t:
+                    if isinstance(item, ast.Node) and references(item, name):
+                        return True
+                    if isinstance(item, tuple) and walk_tuple(item):
+                        return True
+                return False
+
+            for f in getattr(node, "__dataclass_fields__", {}):
+                v = getattr(node, f)
+                if isinstance(v, ast.Node) and references(v, name):
+                    return True
+                if isinstance(v, tuple) and walk_tuple(v):
+                    return True
+            return False
+
+        new_ctes = []
+        for w in query.ctes:
+            if not references(w.query, w.name):
+                new_ctes.append(w)
+                continue
+            if w.query.order_by or w.query.limit is not None or w.query.offset:
+                raise NotImplementedError(
+                    "ORDER BY/LIMIT inside a recursive CTE definition"
+                )
+            body = w.query.body
+            if not (isinstance(body, ast.SetOp) and body.op == "union"):
+                raise NotImplementedError(
+                    "recursive CTE must be anchor UNION [ALL] recursive-term"
+                )
+            anchor, step = body.left, body.right
+            if references(anchor, w.name):
+                raise NotImplementedError(
+                    "recursive CTE anchor must not reference the CTE"
+                )
+            # the CTE definition's own nested WITH entries stay in scope for
+            # both the anchor and every recursive step
+            prior_ctes = tuple(new_ctes) + tuple(w.query.ctes)
+            res = self._run_query(ast.Query(anchor, ctes=prior_ctes))
+            names = list(w.column_names) or list(res.column_names)
+            distinct = not body.all
+            total: list = []
+            seen: set = set()
+            for r in res.rows:
+                t = tuple(r)
+                if distinct:
+                    if t in seen:
+                        continue
+                    seen.add(t)
+                total.append(r)
+            cur_types = list(res.types)
+            work = list(total) if distinct else list(res.rows)
+            for _ in range(self.MAX_RECURSION_DEPTH):
+                if not work:
+                    break
+                bound = ast.Query(
+                    step,
+                    ctes=prior_ctes
+                    + (
+                        ast.WithQuery(
+                            w.name,
+                            ast.Query(_values_relation(work, cur_types)),
+                            tuple(names),
+                        ),
+                    ),
+                )
+                nxt = self._run_query(bound)
+                # UNION coercion: widen the carried types so step values
+                # are never cast back down to the anchor's narrower type
+                from trino_tpu import types as T
+
+                cur_types = [
+                    T.common_super_type(a, b)
+                    for a, b in zip(cur_types, nxt.types)
+                ]
+                rows = []
+                for r in nxt.rows:
+                    t = tuple(r)
+                    if distinct:
+                        if t in seen:
+                            continue
+                        seen.add(t)
+                    rows.append(r)
+                if not rows:
+                    break
+                total.extend(rows)
+                work = rows
+            else:
+                raise RuntimeError(
+                    f"recursive CTE {w.name} exceeded "
+                    f"{self.MAX_RECURSION_DEPTH} iterations"
+                )
+            new_ctes.append(
+                ast.WithQuery(
+                    w.name,
+                    ast.Query(_values_relation(total, cur_types, names)),
+                    tuple(names),
+                )
+            )
+        return ast.Query(
+            query.body, query.order_by, query.limit, query.offset,
+            tuple(new_ctes), False,
+        )
 
     def _run_query(self, query: ast.Query, stats=None) -> MaterializedResult:
         plan = self.plan_query(query)
@@ -1028,6 +1150,54 @@ class LocalQueryRunner:
             with ThreadPoolExecutor(max_workers=min(writers, len(items))) as pool:
                 cols = list(pool.map(build, items))
         sink.append(cols)
+
+
+def _values_relation(rows, types, names=None):
+    """Materialized python rows -> a VALUES relation of typed literal AST
+    nodes (the recursive-CTE binding; reference: the VALUES node the
+    reference's CTE expansion feeds each iteration)."""
+    import datetime
+    from decimal import Decimal
+
+    from trino_tpu import types as T
+
+    def lit(v, t):
+        if v is None:
+            return ast.CastExpr(ast.NullLiteral(), t.name)
+        if t is T.BOOLEAN or isinstance(v, bool):
+            return ast.BooleanLiteral(bool(v))
+        if isinstance(v, Decimal):
+            return ast.CastExpr(ast.NumberLiteral(str(v)), t.name)
+        if isinstance(v, datetime.datetime):
+            return ast.TimestampLiteral(v.isoformat(sep=" "))
+        if isinstance(v, datetime.date):
+            return ast.DateLiteral(v.isoformat())
+        if isinstance(v, str):
+            return ast.CastExpr(ast.StringLiteral(v), t.name) if not T.is_string_kind(t) else ast.StringLiteral(v)
+        if isinstance(v, float):
+            return ast.CastExpr(ast.NumberLiteral(repr(v)), t.name)
+        if isinstance(v, int):
+            return ast.CastExpr(ast.NumberLiteral(str(v)), t.name)
+        raise NotImplementedError(
+            f"recursive CTE value of type {type(v).__name__}"
+        )
+
+    if not rows:
+        # zero-row relation with the right arity/types: typed NULLs under
+        # WHERE false (VALUES itself needs >= 1 row)
+        items = tuple(
+            ast.SelectItem(
+                ast.CastExpr(ast.NullLiteral(), t.name),
+                alias=(names[i] if names else f"c{i}"),
+            )
+            for i, t in enumerate(types)
+        )
+        return ast.QuerySpec(
+            items, None, ast.BooleanLiteral(False), (), None
+        )
+    return ast.ValuesRelation(
+        tuple(tuple(lit(v, t) for v, t in zip(r, types)) for r in rows)
+    )
 
 
 def _ast_literal_value(node):

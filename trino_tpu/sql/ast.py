@@ -353,6 +353,7 @@ class Query(Node):
     limit: Optional[int] = None
     offset: Optional[int] = None
     ctes: tuple = ()  # of WithQuery
+    recursive: bool = False  # WITH RECURSIVE
 
 
 # -- statements --------------------------------------------------------------

@@ -535,8 +535,9 @@ class Parser:
 
     def _query(self) -> ast.Query:
         ctes = ()
+        recursive = False
         if self.accept_kw("with"):
-            self.accept_kw("recursive")
+            recursive = self.accept_kw("recursive") is not None
             lst = []
             while True:
                 name = self.ident()
@@ -557,7 +558,7 @@ class Parser:
             ctes = tuple(lst)
         body = self._query_body()
         order_by, limit, offset = self._order_limit()
-        return ast.Query(body, order_by, limit, offset, ctes)
+        return ast.Query(body, order_by, limit, offset, ctes, recursive)
 
     def _order_limit(self):
         order_by = ()
