@@ -194,3 +194,18 @@ def test_intersect_except_all(runner):
         ).rows
     )
     assert got == sub
+
+
+@pytest.mark.smoke
+def test_tablesample_bernoulli(runner):
+    total = runner.execute("select count(*) from lineitem").only_value()
+    n = runner.execute(
+        "select count(*) from lineitem tablesample bernoulli (25)"
+    ).only_value()
+    assert 0.15 * total < n < 0.35 * total
+    assert runner.execute(
+        "select count(*) from lineitem tablesample bernoulli (0)"
+    ).only_value() == 0
+    assert runner.execute(
+        "select count(*) from lineitem tablesample system (100)"
+    ).only_value() == total

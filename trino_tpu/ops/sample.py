@@ -1,0 +1,51 @@
+"""Row sampling operator (reference: operator/SampleOperator.java —
+BERNOULLI keeps each row with probability p).
+
+Determinism note: the keep/drop decision is a splitmix64 hash of the
+row's global position under a per-operator salt, so a given plan samples
+reproducibly (the reference draws from a per-driver RNG; reproducible
+sampling is the friendlier property for a trace-compiled engine and is
+explicitly allowed by the SQL spec's implementation-defined sampling).
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu.columnar import Batch
+
+
+class SampleOperator:
+    def __init__(self, ratio: float):
+        self.ratio = float(ratio)
+        self.salt = np.uint64(random.getrandbits(63))
+        self._offset = 0
+        self._step = jax.jit(self._sample_step)
+
+    def _sample_step(self, batch: Batch, offset) -> Batch:
+        cap = batch.capacity
+        pos = jnp.arange(cap, dtype=jnp.uint64) + offset + self.salt
+        # splitmix64 over the salted global position
+        u = pos
+        u = (u ^ (u >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        u = (u ^ (u >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        u = u ^ (u >> jnp.uint64(31))
+        # top 53 bits -> uniform [0, 1)
+        unif = (u >> jnp.uint64(11)).astype(jnp.float64) / float(1 << 53)
+        return batch.filter(unif < self.ratio)
+
+    def process(self, stream):
+        if self.ratio >= 1.0:
+            yield from stream
+            return
+        for b in stream:
+            if self.ratio <= 0.0:
+                yield b.filter(jnp.zeros(b.capacity, dtype=bool))
+            else:
+                yield self._step(b, jnp.uint64(self._offset))
+            self._offset += b.capacity
+        return

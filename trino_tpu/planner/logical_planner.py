@@ -416,6 +416,10 @@ class LogicalPlanner:
             return RelationPlan(rp.node, fields)
         if isinstance(rel, ast.MatchRecognize):
             return self.plan_match_recognize(rel, outer, ctes)
+        if isinstance(rel, ast.TableSample):
+            src = self.plan_relation(rel.relation, outer, ctes)
+            ratio = max(0.0, min(1.0, rel.percent / 100.0))
+            return RelationPlan(P.SampleNode(src.node, ratio), src.fields)
         if isinstance(rel, ast.Join):
             return self.plan_join(rel, outer, ctes)
         if isinstance(rel, ast.ValuesRelation):

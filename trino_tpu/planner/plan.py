@@ -354,6 +354,27 @@ class UnionNode(PlanNode):
 
 
 @dataclass
+class SampleNode(PlanNode):
+    """reference: sql/planner/plan/SampleNode.java (BERNOULLI row sampling;
+    SYSTEM falls back to the same row-level filter — split-level sampling
+    has no meaning for generated/columnar splits)."""
+
+    source: PlanNode
+    ratio: float  # 0..1
+
+    @property
+    def outputs(self):
+        return self.source.outputs
+
+    @property
+    def children(self):
+        return [self.source]
+
+    def with_children(self, children):
+        return SampleNode(children[0], self.ratio)
+
+
+@dataclass
 class MeasureSpec:
     """One MATCH_RECOGNIZE measure (reference: sql/planner/plan/
     PatternRecognitionNode.Measure — restricted to the navigations the

@@ -219,6 +219,13 @@ class LocalExecutionPlanner:
         op = UnnestOperator(exprs, with_ordinality=node.ordinality is not None)
         return PhysicalPlan(op.process(src.stream), node.outputs)
 
+    def _visit_SampleNode(self, node: "P.SampleNode") -> PhysicalPlan:
+        from trino_tpu.ops.sample import SampleOperator
+
+        src = self.plan(node.source)
+        op = SampleOperator(node.ratio)
+        return PhysicalPlan(op.process(src.stream), src.symbols)
+
     def _visit_PatternRecognitionNode(
         self, node: P.PatternRecognitionNode
     ) -> PhysicalPlan:

@@ -264,6 +264,16 @@ class MatchRecognize(Node):
 
 
 @dataclass(frozen=True)
+class TableSample(Node):
+    """relation TABLESAMPLE BERNOULLI|SYSTEM (p) (reference:
+    sql/tree/SampledRelation.java)."""
+
+    relation: Node
+    method: str  # bernoulli | system
+    percent: float = 100.0
+
+
+@dataclass(frozen=True)
 class Unnest(Node):
     exprs: tuple
     with_ordinality: bool = False

@@ -790,6 +790,15 @@ class Parser:
             # reference grammar: patternRecognition wraps the ALIASED
             # relation and may itself be aliased (SqlBase.g4 sampledRelation)
             r = self._maybe_alias(self._match_recognize(r))
+        if self.accept_kw("tablesample"):
+            m = self.next()
+            method = m.value.lower()
+            if method not in ("bernoulli", "system"):
+                raise ParseError("expected BERNOULLI or SYSTEM", m)
+            self.expect_op("(")
+            pct = float(self.next().value)
+            self.expect_op(")")
+            r = ast.TableSample(r, method, pct)
         return r
 
     def _maybe_alias(self, r: ast.Node) -> ast.Node:
