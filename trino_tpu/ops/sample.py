@@ -19,33 +19,39 @@ import numpy as np
 from trino_tpu.columnar import Batch
 
 
+@jax.jit
+def _sample_step(batch: Batch, offset, ratio) -> Batch:
+    """Keep rows where splitmix64(salted position) < ratio.  Salt/offset/
+    ratio are TRACED arguments so every sampled query shares ONE compiled
+    kernel (the _STEP_CACHE convention, via jit's own signature cache)."""
+    cap = batch.capacity
+    pos = jnp.arange(cap, dtype=jnp.uint64) + offset
+    u = pos
+    u = (u ^ (u >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    u = (u ^ (u >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    u = u ^ (u >> jnp.uint64(31))
+    # top 53 bits -> uniform [0, 1)
+    unif = (u >> jnp.uint64(11)).astype(jnp.float64) / float(1 << 53)
+    return batch.filter(unif < ratio)
+
+
 class SampleOperator:
     def __init__(self, ratio: float):
         self.ratio = float(ratio)
         self.salt = np.uint64(random.getrandbits(63))
         self._offset = 0
-        self._step = jax.jit(self._sample_step)
-
-    def _sample_step(self, batch: Batch, offset) -> Batch:
-        cap = batch.capacity
-        pos = jnp.arange(cap, dtype=jnp.uint64) + offset + self.salt
-        # splitmix64 over the salted global position
-        u = pos
-        u = (u ^ (u >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
-        u = (u ^ (u >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
-        u = u ^ (u >> jnp.uint64(31))
-        # top 53 bits -> uniform [0, 1)
-        unif = (u >> jnp.uint64(11)).astype(jnp.float64) / float(1 << 53)
-        return batch.filter(unif < self.ratio)
 
     def process(self, stream):
         if self.ratio >= 1.0:
             yield from stream
             return
+        ratio = jnp.float64(self.ratio)
         for b in stream:
             if self.ratio <= 0.0:
                 yield b.filter(jnp.zeros(b.capacity, dtype=bool))
             else:
-                yield self._step(b, jnp.uint64(self._offset))
+                yield _sample_step(
+                    b, jnp.uint64(self._offset) + self.salt, ratio
+                )
             self._offset += b.capacity
         return

@@ -148,6 +148,7 @@ class ExchangePlacer:
 
     _p_FilterNode = _inherit
     _p_ProjectNode = _inherit
+    _p_SampleNode = _inherit  # Bernoulli sampling is row-local
     _p_UnnestNode = _inherit  # elementwise expansion: stays in its fragment
 
     def _p_OutputNode(self, node):

@@ -417,9 +417,15 @@ class LogicalPlanner:
         if isinstance(rel, ast.MatchRecognize):
             return self.plan_match_recognize(rel, outer, ctes)
         if isinstance(rel, ast.TableSample):
+            if not (0.0 <= rel.percent <= 100.0):
+                raise AnalysisError(
+                    "sample percentage must be between 0 and 100, "
+                    f"got {rel.percent}"
+                )
             src = self.plan_relation(rel.relation, outer, ctes)
-            ratio = max(0.0, min(1.0, rel.percent / 100.0))
-            return RelationPlan(P.SampleNode(src.node, ratio), src.fields)
+            return RelationPlan(
+                P.SampleNode(src.node, rel.percent / 100.0), src.fields
+            )
         if isinstance(rel, ast.Join):
             return self.plan_join(rel, outer, ctes)
         if isinstance(rel, ast.ValuesRelation):

@@ -50,6 +50,10 @@ def _prune(node: P.PlanNode, needed: set) -> P.PlanNode:
         child = _prune(node.source, needed | _refs(node.predicate))
         return P.FilterNode(child, node.predicate)
 
+    if isinstance(node, P.SampleNode):
+        # sampling reads no symbols: pass the needed set straight through
+        return P.SampleNode(_prune(node.source, needed), node.ratio)
+
     if isinstance(node, P.ProjectNode):
         assigns = [(s, e) for s, e in node.assignments if s.name in needed]
         if not assigns:

@@ -340,6 +340,14 @@ def _compute(node, catalogs, cache) -> PlanStats:
         )
     if isinstance(node, P.EnforceSingleRowNode):
         return PlanStats(1.0, {})
+    if isinstance(node, P.SampleNode):
+        src = compute_stats(node.source, catalogs, cache)
+        rows = max(1.0, src.rows * node.ratio)
+        cols = {
+            k: (replace(v, ndv=min(v.ndv, rows)) if v.ndv else v)
+            for k, v in src.columns.items()
+        }
+        return PlanStats(rows, cols)
     kids = node.children
     if kids:
         src = compute_stats(kids[0], catalogs, cache)

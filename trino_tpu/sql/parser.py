@@ -796,7 +796,10 @@ class Parser:
             if method not in ("bernoulli", "system"):
                 raise ParseError("expected BERNOULLI or SYSTEM", m)
             self.expect_op("(")
-            pct = float(self.next().value)
+            pt = self.next()
+            if pt.kind != "number":
+                raise ParseError("expected sample percentage", pt)
+            pct = float(pt.value)
             self.expect_op(")")
             r = ast.TableSample(r, method, pct)
         return r
