@@ -147,3 +147,17 @@ def next_pow2(n: int, floor: int = 1024) -> int:
     while c < n:
         c <<= 1
     return c
+
+
+def splitmix64(u):
+    """The splitmix64 finalizer over uint64 arrays/scalars (works on numpy
+    and traced jax values; uint64 wrap-around is the intended semantics).
+    THE shared copy — serde/aggregation/generators carry historical inline
+    duplicates pinned by persisted data and exchange compatibility; new
+    code should call this."""
+    import numpy as np
+
+    with np.errstate(over="ignore"):
+        u = (u ^ (u >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        u = (u ^ (u >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return u ^ (u >> np.uint64(31))

@@ -2,21 +2,23 @@
 BERNOULLI keeps each row with probability p).
 
 Determinism note: the keep/drop decision is a splitmix64 hash of the
-row's global position under a per-operator salt, so a given plan samples
-reproducibly (the reference draws from a per-driver RNG; reproducible
-sampling is the friendlier property for a trace-compiled engine and is
-explicitly allowed by the SQL spec's implementation-defined sampling).
+row's arrival position under a salt derived from the operator's plan
+position.  The salt is deterministic, so sampling reproduces exactly when
+batch arrival order does (task_concurrency=1, or any serial feed); under
+the parallel local exchange the arrival order — and therefore the sampled
+row SET — may differ between runs while the sampling probability is
+unchanged.  (The reference's per-driver RNG is nondeterministic in all
+configurations; the SQL spec leaves sampling implementation-defined.)
 """
 
 from __future__ import annotations
-
-import random
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from trino_tpu.columnar import Batch
+from trino_tpu.ops.common import splitmix64
 
 
 @jax.jit
@@ -26,19 +28,16 @@ def _sample_step(batch: Batch, offset, ratio) -> Batch:
     kernel (the _STEP_CACHE convention, via jit's own signature cache)."""
     cap = batch.capacity
     pos = jnp.arange(cap, dtype=jnp.uint64) + offset
-    u = pos
-    u = (u ^ (u >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
-    u = (u ^ (u >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
-    u = u ^ (u >> jnp.uint64(31))
+    u = splitmix64(pos)
     # top 53 bits -> uniform [0, 1)
     unif = (u >> jnp.uint64(11)).astype(jnp.float64) / float(1 << 53)
     return batch.filter(unif < ratio)
 
 
 class SampleOperator:
-    def __init__(self, ratio: float):
+    def __init__(self, ratio: float, seed: int = 0):
         self.ratio = float(ratio)
-        self.salt = np.uint64(random.getrandbits(63))
+        self.salt = np.uint64(splitmix64(np.uint64(seed * 2 + 1)))
         self._offset = 0
 
     def process(self, stream):

@@ -137,6 +137,20 @@ def rule_push_filter_through_project(node: P.PlanNode):
     )
 
 
+def rule_push_filter_through_sample(node: P.PlanNode):
+    """Filter(Sample) -> Sample(Filter): Bernoulli keep/drop is independent
+    per row, so filtering first is equivalent and lets predicates reach the
+    scan (reference: PredicatePushDown's SampleNode pass-through)."""
+    if not (
+        isinstance(node, P.FilterNode) and isinstance(node.source, P.SampleNode)
+    ):
+        return None
+    sample = node.source
+    return P.SampleNode(
+        P.FilterNode(sample.source, node.predicate), sample.ratio
+    )
+
+
 def rule_push_filter_through_union(node: P.PlanNode):
     """Filter(Union) -> Union(Filter(child_i)) with the predicate rewritten
     per branch through the union's symbol mapping (reference:
@@ -235,6 +249,7 @@ def optimize(plan: P.OutputNode, rules=None, catalogs=None) -> P.OutputNode:
             lambda n: eliminate_cross_joins(n, catalogs),
             push_filter_through_join,
             rule_push_filter_through_union,
+            rule_push_filter_through_sample,
             rule_push_filter_through_project,
             rule_push_filter_through_aggregation,
             rule_push_filter_into_scan,

@@ -223,7 +223,10 @@ class LocalExecutionPlanner:
         from trino_tpu.ops.sample import SampleOperator
 
         src = self.plan(node.source)
-        op = SampleOperator(node.ratio)
+        # deterministic per plan position: re-planning the same query (or a
+        # retried fragment) samples the same rows
+        self._sample_seq = getattr(self, "_sample_seq", 0) + 1
+        op = SampleOperator(node.ratio, seed=self._sample_seq)
         return PhysicalPlan(op.process(src.stream), src.symbols)
 
     def _visit_PatternRecognitionNode(
