@@ -129,3 +129,104 @@ def test_geometric_mean(runner):
     ]
     expect = math.exp(sum(math.log(v) for v in vals) / len(vals))
     assert abs(got - expect) < 1e-9
+
+
+def test_min_by_max_by_global(runner):
+    rows = runner.execute(
+        "select min_by(n_name, n_nationkey), max_by(n_name, n_nationkey) "
+        "from nation"
+    ).rows
+    assert rows == [("ALGERIA", "UNITED STATES")]
+
+
+def test_min_by_grouped(runner):
+    rows = runner.execute(
+        "select n_regionkey, min_by(n_name, n_nationkey) from nation "
+        "group by 1 order by 1"
+    ).rows
+    assert rows[:2] == [(0, "ALGERIA"), (1, "ARGENTINA")]
+
+
+def test_min_by_all_null_keys(runner):
+    assert runner.execute(
+        "select min_by(n_name, n_nationkey) from nation where n_nationkey > 99"
+    ).rows == [(None,)]
+
+
+def test_max_by_numeric_value(runner):
+    # value at extreme key; compare against correlated-scalar formulation
+    got = runner.execute(
+        "select l_returnflag, max_by(l_extendedprice, l_orderkey) "
+        "from lineitem group by 1 order by 1"
+    ).rows
+    assert len(got) == 3
+    for flag, price in got:
+        expect = runner.execute(
+            "select l_extendedprice from lineitem "
+            f"where l_returnflag = '{flag}' "
+            "order by l_orderkey desc, l_linenumber desc limit 1"
+        ).rows[0][0]
+        # ties on l_orderkey break by first-row-seen; just check membership
+        cands = {
+            r[0]
+            for r in runner.execute(
+                "select l_extendedprice from lineitem "
+                f"where l_returnflag = '{flag}' and l_orderkey = "
+                "(select max(l_orderkey) from lineitem "
+                f"where l_returnflag = '{flag}')"
+            ).rows
+        }
+        assert price in cands and expect in cands
+
+
+def test_min_by_distributed(runner):
+    from trino_tpu.parallel.runner import DistributedQueryRunner
+
+    d = DistributedQueryRunner(catalog="tpch", schema="tiny")
+    sql = (
+        "select l_returnflag, max_by(l_comment, l_extendedprice) "
+        "from lineitem group by 1 order by 1"
+    )
+    assert d.execute(sql).rows == runner.execute(sql).rows
+
+
+def test_count_if(runner):
+    rows = runner.execute(
+        "select count_if(n_regionkey = 2), count_if(n_regionkey > 99) "
+        "from nation"
+    ).rows
+    assert rows == [(5, 0)]
+
+
+def test_bool_and_over_comparison(runner):
+    rows = runner.execute(
+        "select n_regionkey, bool_and(n_nationkey < 20), "
+        "bool_or(n_nationkey > 20) from nation group by 1 order by 1"
+    ).rows
+    # region 0 keys: 0,5,14,15,16 (all <20); region 1 includes 24
+    assert rows[0] == (0, True, False)
+    assert rows[1] == (1, False, True)
+
+
+def test_minmax_by_nan_keys(runner):
+    # NaN orders as largest (engine sort rule): max_by prefers the NaN-key
+    # row, min_by only picks it when every key in the group is NaN
+    runner.execute("drop table if exists memory.default.mmnan")
+    runner.execute(
+        "create table memory.default.mmnan as select * from (values "
+        "(1, 'a', 1.0), (1, 'b', cast('NaN' as double)), "
+        "(2, 'c', 5.0), (3, 'd', cast('NaN' as double))) t(g, v, k)"
+    )
+    assert runner.execute(
+        "select g, max_by(v, k) from memory.default.mmnan group by 1 order by 1"
+    ).rows == [(1, "b"), (2, "c"), (3, "d")]
+    assert runner.execute(
+        "select g, min_by(v, k) from memory.default.mmnan group by 1 order by 1"
+    ).rows == [(1, "a"), (2, "c"), (3, "d")]
+
+
+def test_minmax_by_arity_and_count_if_distinct_rejected(runner):
+    with pytest.raises(Exception, match="min_by requires 2"):
+        runner.execute("select min_by(n_name) from nation")
+    with pytest.raises(Exception, match="count_if does not support DISTINCT"):
+        runner.execute("select count_if(distinct n_regionkey > 1) from nation")

@@ -209,3 +209,23 @@ def test_tablesample_bernoulli(runner):
     assert runner.execute(
         "select count(*) from lineitem tablesample system (100)"
     ).only_value() == total
+
+
+def test_trim_specification_forms(runner):
+    rows = runner.execute(
+        "select trim(leading 'x' from 'xxhixx'), "
+        "trim(trailing 'x' from 'xxhixx'), "
+        "trim(both 'x' from 'xxhixx'), "
+        "trim('x' from 'xxhixx'), "
+        "trim(from '  hi  '), "
+        "trim('  hi  ')"
+    ).rows
+    assert rows == [("hixx", "xxhi", "hi", "hi", "hi", "hi")]
+
+
+def test_position_function(runner):
+    rows = runner.execute(
+        "select position('b' in 'abc'), position('z' in 'abc'), "
+        "position('' in 'abc')"
+    ).rows
+    assert rows == [(2, 0, 1)]

@@ -910,18 +910,21 @@ def _lower(ctx, call, value):
 
 
 @register("trim")
-def _trim(ctx, call, value):
-    return _string_map(ctx, call, value, str.strip, "trim")
+def _trim(ctx, call, value, chars=None):
+    cs = _literal_str(chars, "trim") if chars is not None else None
+    return _string_map(ctx, call, value, lambda s: s.strip(cs), "trim")
 
 
 @register("ltrim")
-def _ltrim(ctx, call, value):
-    return _string_map(ctx, call, value, str.lstrip, "ltrim")
+def _ltrim(ctx, call, value, chars=None):
+    cs = _literal_str(chars, "ltrim") if chars is not None else None
+    return _string_map(ctx, call, value, lambda s: s.lstrip(cs), "ltrim")
 
 
 @register("rtrim")
-def _rtrim(ctx, call, value):
-    return _string_map(ctx, call, value, str.rstrip, "rtrim")
+def _rtrim(ctx, call, value, chars=None):
+    cs = _literal_str(chars, "rtrim") if chars is not None else None
+    return _string_map(ctx, call, value, lambda s: s.rstrip(cs), "rtrim")
 
 
 @register("reverse")

@@ -636,7 +636,9 @@ class AggregationOperator:
         domain discovered from data min/max instead of assumed."""
         if not self.group_channels:
             return False
-        if any(s.name == "percentile" for s in self.aggregates):
+        if any(s.name in HOLISTIC_AGGS for s in self.aggregates):
+            # holistic aggregates need the sorted numbering (percentile,
+            # collect) or joint key/value selection (min_by/max_by)
             return False
         for ch in self.group_channels:
             col = batch.columns[ch]
@@ -849,6 +851,12 @@ class AggregationOperator:
                     cols.append(self._percentile_one(batch, spec, out_cap))
                 elif spec.name == "listagg":
                     cols.append(self._listagg_one(batch, spec, out_cap))
+                elif spec.name in ("min_by", "max_by"):
+                    cols.append(
+                        self._minmax_by_one(
+                            batch, spec, perm, live, gid_c, nseg, out_cap
+                        )
+                    )
                 else:
                     cols.append(
                         self._collect_one(batch, spec, perm, live, gid_c, nseg, out_cap)
@@ -997,6 +1005,57 @@ class AggregationOperator:
             d,
         )
 
+    def _minmax_by_one(
+        self, batch: Batch, spec: AggSpec, perm, live, gid_c, nseg, out_cap
+    ) -> Column:
+        """min_by/max_by(value, key): the VALUE at each group's extreme KEY
+        (reference: MinMaxByNAggregation, N=1).  Jit-safe: extreme key via
+        segment reduce, then the first row achieving it selects the value.
+        Rows with NULL keys are skipped; ties pick the first sorted row."""
+        from trino_tpu.ops.common import _max_sentinel, _min_sentinel
+
+        cap = batch.capacity
+        vcol = batch.columns[spec.arg]
+        kcol = batch.columns[spec.arg2]
+        kd = jnp.take(kcol.data, perm, mode="clip")
+        vkey = live
+        if kcol.valid is not None:
+            vkey = jnp.logical_and(vkey, jnp.take(kcol.valid, perm, mode="clip"))
+        want_min = spec.name == "min_by"
+        sent = (
+            _max_sentinel(kd.dtype) if want_min else _min_sentinel(kd.dtype)
+        )
+        keyed = jnp.where(vkey, kd, sent)
+        if want_min and jnp.issubdtype(kd.dtype, jnp.floating):
+            # NaN orders as largest (same rule the sort path uses), so for
+            # min it must only win when every key is NaN — remap to +inf
+            # instead of letting segment_min propagate it
+            keyed = jnp.where(jnp.isnan(keyed), jnp.inf, keyed)
+        red = jax.ops.segment_min if want_min else jax.ops.segment_max
+        kext = red(keyed, gid_c, nseg)
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        kext_g = jnp.take(kext, gid_c, mode="clip")
+        match = keyed == kext_g
+        if jnp.issubdtype(kd.dtype, jnp.floating):
+            # segment min/max propagate NaN keys; NaN != NaN would then match
+            # no row and silently select a padded one
+            match = jnp.logical_or(
+                match, jnp.logical_and(jnp.isnan(keyed), jnp.isnan(kext_g))
+            )
+        at_ext = jnp.logical_and(vkey, match)
+        first = jax.ops.segment_min(jnp.where(at_ext, pos, cap), gid_c, nseg)
+        idx = jnp.clip(first[:out_cap], 0, cap - 1)
+        vd = jnp.take(vcol.data, perm, mode="clip")
+        out = jnp.take(vd, idx, mode="clip")
+        has_key = jax.ops.segment_sum(vkey.astype(jnp.int64), gid_c, nseg)[:out_cap] > 0
+        valid = has_key
+        if vcol.valid is not None:
+            vvalid = jnp.take(
+                jnp.take(vcol.valid, perm, mode="clip"), idx, mode="clip"
+            )
+            valid = jnp.logical_and(valid, vvalid)
+        return Column(out, spec.out_type, valid, vcol.dictionary)
+
     def _percentile_one(self, batch: Batch, spec: AggSpec, out_cap: int) -> Column:
         """Exact per-group percentile: re-sort by (group keys, value) and
         pick the nearest-rank row of each group (reference role:
@@ -1124,6 +1183,18 @@ class AggregationOperator:
         live = batch.mask()
         cols = []
         for spec in self.aggregates:
+            if spec.name in ("min_by", "max_by"):
+                if self.mode != "single":
+                    raise NotImplementedError(
+                        f"{spec.name} requires single-stage aggregation"
+                    )
+                cap0 = batch.capacity
+                perm0 = jnp.arange(cap0, dtype=jnp.int64)
+                gid0 = jnp.zeros(cap0, dtype=jnp.int64)
+                cols.append(
+                    self._minmax_by_one(batch, spec, perm0, live, gid0, 2, 1)
+                )
+                continue
             if spec.name in COLLECT_AGGS:
                 if self.mode != "single":
                     raise NotImplementedError(

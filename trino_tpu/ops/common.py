@@ -133,12 +133,16 @@ def segment_reduce(values, gid, num_segments: int, kind: str, valid=None):
 def _max_sentinel(dtype):
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.asarray(jnp.inf, dtype)
+    if jnp.dtype(dtype) == jnp.dtype(bool):
+        return jnp.asarray(True, dtype)  # bool_and identity
     return jnp.asarray(jnp.iinfo(dtype).max, dtype)
 
 
 def _min_sentinel(dtype):
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.asarray(-jnp.inf, dtype)
+    if jnp.dtype(dtype) == jnp.dtype(bool):
+        return jnp.asarray(False, dtype)  # bool_or identity
     return jnp.asarray(jnp.iinfo(dtype).min, dtype)
 
 
@@ -152,9 +156,9 @@ def next_pow2(n: int, floor: int = 1024) -> int:
 def splitmix64(u):
     """The splitmix64 finalizer over uint64 arrays/scalars (works on numpy
     and traced jax values; uint64 wrap-around is the intended semantics).
-    THE shared copy — serde/aggregation/generators carry historical inline
-    duplicates pinned by persisted data and exchange compatibility; new
-    code should call this."""
+    THE shared copy — serde/aggregation/generators still carry inline
+    duplicates that compute the same bytes; new code should call this,
+    and the duplicates can be folded into it at leisure."""
     import numpy as np
 
     with np.errstate(over="ignore"):

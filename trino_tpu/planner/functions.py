@@ -51,20 +51,26 @@ AGG_FUNCS = {
     "regr_intercept": "regr_intercept",
     # order-independent multiset checksum (reference: ChecksumAggregation)
     "checksum": "checksum",
+    # value-at-extremum pair aggregates (reference: operator/aggregation/
+    # MinMaxByNAggregation family, the N=1 forms); distributed via the
+    # group-key repartition path (joint key/value state does not merge
+    # column-independently)
+    "min_by": "min_by",
+    "max_by": "max_by",
 }
 
 #: composite aggregates planned as rewrites over simpler ones (the
 #: geometric_mean -> exp(avg(ln(x))) family); consulted by BOTH aggregate
 #: detection (analyzer.collect_aggregates) and the planning hook
-REWRITTEN_AGGS = ("geometric_mean",)
+REWRITTEN_AGGS = ("geometric_mean", "count_if")
 
 #: aggregates that need every group row co-located (no partial/merge states)
-HOLISTIC_AGGS = ("percentile", "array_agg", "map_agg", "listagg")
+HOLISTIC_AGGS = ("percentile", "array_agg", "map_agg", "listagg", "min_by", "max_by")
 
 #: the holistic subset that still DISTRIBUTES: after a hash repartition on
 #: the group keys each group is whole on one worker, and the single-stage
 #: kernel runs fully inside the SPMD step (no eager host work)
-PARTITIONABLE_HOLISTIC = ("percentile",)
+PARTITIONABLE_HOLISTIC = ("percentile", "min_by", "max_by")
 
 #: aggregates whose grouped state is the (count, sum, sum-of-squares) triple
 MOMENT_AGGS = ("stddev_samp", "stddev_pop", "var_samp", "var_pop")
@@ -99,6 +105,10 @@ def agg_result_type(name: str, arg_type: T.Type | None, arg_type2: T.Type | None
         return T.VARCHAR
     if name in ("covar_samp", "covar_pop", "corr", "regr_slope", "regr_intercept"):
         return T.DOUBLE
+    if name in ("min_by", "max_by"):
+        if arg_type2 is None:
+            raise TypeError(f"{name} requires 2 arguments (value, key)")
+        return arg_type
     if name == "map_agg":
         return T.MapType(arg_type, arg_type2 if arg_type2 is not None else T.BIGINT)
     raise TypeError(f"unknown aggregate {name}")

@@ -1031,8 +1031,19 @@ class LogicalPlanner:
                 and node.name in REWRITTEN_AGGS
                 and node.window is None
             ):
-                # reference: GeometricMeanAggregations — exp of the mean of
-                # logs; planned as exactly that composition
+                if node.name == "count_if":
+                    # reference: CountIfAggregation = count(*) FILTER (cond)
+                    if node.distinct:
+                        raise AnalysisError("count_if does not support DISTINCT")
+                    cond = node.args[0]
+                    if node.filter is not None:
+                        cond = ast.BinaryOp("and", cond, node.filter)
+                    inner = ast.FunctionCall(
+                        "count", (), is_star=True, filter=cond
+                    )
+                    return agg_symbol(inner).ref()
+                # geometric_mean (reference: GeometricMeanAggregations) —
+                # exp of the mean of logs; planned as that composition
                 inner = ast.FunctionCall(
                     "avg",
                     (ast.FunctionCall("ln", tuple(node.args)),),

@@ -1209,7 +1209,9 @@ class Parser:
             e = ast.FunctionCall("substr", args)
         elif t.is_kw("position"):
             self.expect_op("(")
-            sub = self._expr()
+            # bind above IN so `position('l' in s)` doesn't parse the
+            # needle as an IN-list expression
+            sub = self._expr(5)
             self.expect_kw("in")
             operand = self._expr()
             self.expect_op(")")
@@ -1319,6 +1321,30 @@ class Parser:
 
     def _function_call(self, name: str) -> ast.Node:
         self.expect_op("(")
+        if name.lower() == "trim":
+            # TRIM([LEADING|TRAILING|BOTH] [chars] FROM str) spec form
+            # (reference: SqlBase.g4 trimsSpecification); plain trim(x)
+            # falls through to the normal argument list
+            save = self.i
+            spec = "both"
+            t0 = self.peek()
+            if t0.kind == "ident" and t0.value in ("leading", "trailing", "both"):
+                spec = t0.value
+                self.next()
+            chars = None
+            if not self.peek().is_kw("from"):
+                try:
+                    chars = self._expr(5)
+                except ParseError:
+                    self.i = save
+                    chars = None
+            if self.accept_kw("from"):
+                val = self._expr()
+                self.expect_op(")")
+                fn = {"leading": "ltrim", "trailing": "rtrim", "both": "trim"}[spec]
+                args = (val,) + ((chars,) if chars is not None else ())
+                return ast.FunctionCall(fn, args)
+            self.i = save
         distinct = False
         is_star = False
         args: list[ast.Node] = []
