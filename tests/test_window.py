@@ -190,3 +190,121 @@ def test_bounded_rows_frame_min_max(runner):
             expect[v] = (min(vals[lo:hi + 1]), max(vals[max(0, i - 1):i + 1]))
     for nk, got_min, got_max in rows:
         assert (got_min, got_max) == expect[nk], nk
+
+
+def test_named_window_clause(runner):
+    rows = runner.execute(
+        "select n_name, rank() over w, "
+        "sum(n_nationkey) over (w rows between 1 preceding and current row) "
+        "from nation where n_regionkey = 1 "
+        "window w as (partition by n_regionkey order by n_nationkey) "
+        "order by n_nationkey"
+    ).rows
+    assert rows[0] == ("ARGENTINA", 1, 1)
+    assert rows[1] == ("BRAZIL", 2, 3)
+
+
+def test_named_window_inheritance_chain(runner):
+    rows = runner.execute(
+        "select n_name, row_number() over w2 from nation where n_regionkey=2 "
+        "window w as (partition by n_regionkey), "
+        "w2 as (w order by n_name desc) order by n_name limit 2"
+    ).rows
+    assert rows == [("CHINA", 5), ("INDIA", 4)]
+
+
+def test_named_window_undefined(runner):
+    import pytest
+
+    with pytest.raises(Exception, match="window 'wz' is not defined"):
+        runner.execute("select rank() over wz from nation")
+
+
+def test_ignore_nulls_navigation(runner):
+    runner.execute("drop table if exists memory.default.ign")
+    runner.execute(
+        "create table memory.default.ign as select * from (values "
+        "(1, 10), (2, null), (3, null), (4, 40), (5, null)) t(i, x)"
+    )
+    rows = runner.execute(
+        "select i, lag(x) ignore nulls over (order by i), "
+        "lead(x) ignore nulls over (order by i), "
+        "first_value(x) ignore nulls over (order by i), "
+        "last_value(x) ignore nulls over (order by i) "
+        "from memory.default.ign order by i"
+    ).rows
+    assert rows == [
+        (1, None, 40, 10, 10),
+        (2, 10, 40, 10, 10),
+        (3, 10, 40, 10, 10),
+        (4, 10, None, 10, 40),
+        (5, 40, None, 10, 40),
+    ]
+
+
+def test_ignore_nulls_lag_offset_and_partition(runner):
+    runner.execute("drop table if exists memory.default.ign2")
+    runner.execute(
+        "create table memory.default.ign2 as select * from (values "
+        "(1, 1, 'a'), (1, 2, null), (1, 3, 'c'), (1, 4, null), (1, 5, null), "
+        "(2, 1, null), (2, 2, 'z')) t(g, i, x)"
+    )
+    rows = runner.execute(
+        "select g, i, lag(x, 2) ignore nulls over (partition by g order by i) "
+        "from memory.default.ign2 order by g, i"
+    ).rows
+    assert rows == [
+        (1, 1, None), (1, 2, None), (1, 3, None), (1, 4, "a"), (1, 5, "a"),
+        (2, 1, None), (2, 2, None),
+    ]
+
+
+def test_ignore_nulls_respect_default(runner):
+    runner.execute("drop table if exists memory.default.ignr")
+    runner.execute(
+        "create table memory.default.ignr as select * from (values "
+        "(1, 10), (2, null), (3, null), (4, 40), (5, null)) t(i, x)"
+    )
+    rows = runner.execute(
+        "select i, lag(x) respect nulls over (order by i) "
+        "from memory.default.ignr order by i"
+    ).rows
+    assert rows == [(1, None), (2, 10), (3, None), (4, None), (5, 40)]
+
+
+def test_ignore_nulls_invalid_function(runner):
+    import pytest
+
+    with pytest.raises(Exception, match="IGNORE NULLS is not valid"):
+        runner.execute(
+            "select rank() ignore nulls over (order by n_nationkey) from nation"
+        )
+
+
+def test_ignore_nulls_distributed(runner):
+    from trino_tpu.parallel.runner import DistributedQueryRunner
+
+    d = DistributedQueryRunner(catalog="tpch", schema="tiny")
+    sql = (
+        "select l_orderkey, l_linenumber, lag(l_comment) ignore nulls "
+        "over (partition by l_returnflag order by l_orderkey, l_linenumber) "
+        "from lineitem order by 1, 2 limit 20"
+    )
+    assert d.execute(sql).rows == runner.execute(sql).rows
+
+
+def test_null_treatment_requires_over(runner):
+    import pytest
+
+    with pytest.raises(Exception, match="requires an OVER clause"):
+        runner.execute("select max(n_nationkey) ignore nulls from nation")
+
+
+def test_duplicate_window_name_rejected(runner):
+    import pytest
+
+    with pytest.raises(Exception, match="specified more than once"):
+        runner.execute(
+            "select rank() over w from nation "
+            "window w as (order by n_name), w as (order by n_regionkey)"
+        )

@@ -53,6 +53,9 @@ class WindowSpec:
     # side); the default running frame is (None, 0).
     start_off: Optional[int] = None
     end_off: Optional[int] = 0
+    # IGNORE NULLS for lag/lead/first_value/last_value (reference:
+    # operator/window/LagFunction.java ignoreNulls handling)
+    ignore_nulls: bool = False
 
 
 _WINDOW_STEP_CACHE: dict = {}
@@ -80,7 +83,7 @@ class WindowOperator:
                 (
                     sp.name, sp.arg, sp.out_type.name, sp.offset,
                     sp.default_channel, sp.n_buckets, sp.frame,
-                    sp.start_off, sp.end_off,
+                    sp.start_off, sp.end_off, sp.ignore_nulls,
                 )
                 for sp in self.specs
             ),
@@ -159,6 +162,28 @@ class WindowOperator:
             final_cols.append(Column(data, c.type, valid, c.dictionary))
         return Batch(final_cols, batch.row_mask)
 
+    @staticmethod
+    def _valid_ranks(v, live, part_first, pos, cap):
+        """(pref, pos_of) for IGNORE NULLS: pref[i] = count of non-null live
+        rows at or before sorted row i WITHIN its partition; pos_of is a
+        [cap+1] table mapping slot part_first + rank (0-based, per
+        partition) -> the sorted-row index of that partition's rank-th
+        non-null row (cap = no such row).  Slots of different partitions
+        are disjoint because ranks never exceed the partition size."""
+        vi = jnp.logical_and(live, v)
+        c = jnp.cumsum(vi.astype(jnp.int64))
+        base = jnp.where(
+            part_first > 0,
+            jnp.take(c, jnp.maximum(part_first - 1, 0), mode="clip"),
+            0,
+        )
+        pref = c - base
+        slot = jnp.where(vi, part_first + pref - 1, cap)
+        pos_of = jnp.full(cap + 1, cap, jnp.int64).at[slot].set(
+            pos, mode="drop"
+        )
+        return pref, pos_of
+
     def _compute(
         self, spec, batch, perm, live, pid, nseg, part_start, part_size,
         idx_in_part, new_peer, peer_gid, peer_last, pos, cap,
@@ -225,6 +250,40 @@ class WindowOperator:
             col = batch.columns[spec.arg]
             d = jnp.take(col.data, perm, mode="clip")
             v = jnp.take(col.valid, perm, mode="clip") if col.valid is not None else jnp.ones(cap, bool)
+            if spec.ignore_nulls:
+                # k-th non-null neighbour via per-partition valid-rank
+                # indexing: rank positions scatter to a dense pos_of table
+                # laid out at partition offsets, so one gather finds the row
+                pref, pos_of = self._valid_ranks(
+                    v, live, part_first, pos, cap
+                )
+                if name == "lag":
+                    tgt = pref - v.astype(jnp.int64) - spec.offset
+                    found = tgt >= 0
+                else:
+                    total = jnp.take(
+                        pref, jnp.clip(part_last, 0, cap - 1), mode="clip"
+                    )
+                    tgt = pref + spec.offset - 1
+                    found = pref + spec.offset <= total
+                slot = jnp.where(found, part_first + tgt, cap)
+                src_row = jnp.take(pos_of, jnp.clip(slot, 0, cap), mode="clip")
+                data = jnp.take(d, jnp.clip(src_row, 0, cap - 1), mode="clip")
+                valid = jnp.logical_and(found, src_row < cap)
+                if spec.default_channel is not None:
+                    dc = batch.columns[spec.default_channel]
+                    dd = jnp.take(dc.data, perm, mode="clip")
+                    dv = (
+                        jnp.take(dc.valid, perm, mode="clip")
+                        if dc.valid is not None
+                        else jnp.ones(cap, bool)
+                    )
+                    data = jnp.where(valid, data, dd)
+                    valid = jnp.where(valid, valid, dv)
+                return Column(
+                    data.astype(spec.out_type.np_dtype), spec.out_type,
+                    valid, col.dictionary,
+                )
             off = spec.offset if name == "lag" else -spec.offset
             src = pos - off
             in_part = jnp.logical_and(
@@ -248,6 +307,33 @@ class WindowOperator:
             col = batch.columns[spec.arg]
             d = jnp.take(col.data, perm, mode="clip")
             v = jnp.take(col.valid, perm, mode="clip") if col.valid is not None else jnp.ones(cap, bool)
+            if spec.ignore_nulls:
+                # first/last non-null row of the frame [lo, hi] via the same
+                # valid-rank table: frame's valid count = pref[hi]-pref[lo-1]
+                pref, pos_of = self._valid_ranks(
+                    v, live, part_first, pos, cap
+                )
+                before = jnp.where(
+                    lo > part_first,
+                    jnp.take(pref, jnp.clip(lo - 1, 0, cap - 1), mode="clip"),
+                    0,
+                )
+                upto = jnp.where(
+                    frame_n > 0,
+                    jnp.take(pref, jnp.clip(hi, 0, cap - 1), mode="clip"),
+                    before,
+                )
+                found = upto > before
+                rank0 = before if name == "first_value" else upto - 1
+                slot = jnp.where(found, part_first + rank0, cap)
+                src_row = jnp.take(pos_of, jnp.clip(slot, 0, cap), mode="clip")
+                return Column(
+                    jnp.take(d, jnp.clip(src_row, 0, cap - 1), mode="clip")
+                    .astype(spec.out_type.np_dtype),
+                    spec.out_type,
+                    jnp.logical_and(found, src_row < cap),
+                    col.dictionary,
+                )
             src = jnp.clip(lo if name == "first_value" else hi, 0, cap - 1)
             return Column(
                 jnp.take(d, src, mode="clip").astype(spec.out_type.np_dtype),

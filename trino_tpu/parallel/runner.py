@@ -904,6 +904,7 @@ class StageExecutor:
                     frame=fn.frame,
                     start_off=fn.start_off,
                     end_off=fn.end_off,
+                    ignore_nulls=fn.ignore_nulls,
                 )
             )
         op = WindowOperator(part, order, specs)

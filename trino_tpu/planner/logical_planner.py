@@ -1552,6 +1552,14 @@ class _WindowExtractor:
     def _plan_call(self, fc: ast.FunctionCall) -> P.Symbol:
         an = ExprAnalyzer(self.scope, hook=self.an_hook)
         w = fc.window
+        if getattr(w, "ref", None) is not None:
+            raise AnalysisError(f"window '{w.ref}' is not defined")
+        if fc.ignore_nulls and fc.name not in (
+            "lag", "lead", "first_value", "last_value"
+        ):
+            raise AnalysisError(
+                f"IGNORE NULLS is not valid for {fc.name}"
+            )
         part = [
             self._pre_symbol(an.analyze(p), _name_hint(p)) for p in w.partition_by
         ]
@@ -1618,6 +1626,7 @@ class _WindowExtractor:
             default=None if default_sym is None else default_sym.ref(),
             start_off=start_off,
             end_off=end_off,
+            ignore_nulls=fc.ignore_nulls,
         )
         out = self.planner.alloc.new(fc.name, out_t)
         self.functions.append((out, part, order, fn))

@@ -242,6 +242,7 @@ class WindowFunction:
     # the default running frame is (None, 0)
     start_off: object = None
     end_off: object = 0
+    ignore_nulls: bool = False  # lag/lead/first_value/last_value
 
 
 @dataclass

@@ -459,6 +459,7 @@ class LocalExecutionPlanner:
                     frame=fn.frame,
                     start_off=fn.start_off,
                     end_off=fn.end_off,
+                    ignore_nulls=fn.ignore_nulls,
                 )
             )
         budget = self.properties.get("query_max_memory_bytes")

@@ -80,6 +80,7 @@ class FunctionCall(Node):
     window: object = None  # Window spec or None
     filter: object = None  # FILTER (WHERE ...) expression
     within_group: tuple = ()  # LISTAGG ... WITHIN GROUP (ORDER BY ...) keys
+    ignore_nulls: bool = False  # lag/lead/first_value/last_value nullTreatment
 
 
 @dataclass(frozen=True)
@@ -104,6 +105,10 @@ class WindowSpec(Node):
     partition_by: tuple
     order_by: tuple  # of SortItem
     frame: object = None  # WindowFrame or None
+    # named-window reference (OVER w / OVER (w ...)); resolved away by the
+    # parser against the query's WINDOW clause (reference: sql/tree/
+    # WindowReference.java + analyzer named-window resolution)
+    ref: object = None
 
 
 @dataclass(frozen=True)

@@ -674,7 +674,29 @@ class Parser:
                 exprs.append(self._grouping_element())
             group_by = tuple(exprs)
         having = self._expr() if self.accept_kw("having") else None
-        return ast.QuerySpec(tuple(items), relation, where, group_by, having, distinct)
+        items = tuple(items)
+        if self.accept_kw("window"):
+            # WINDOW w AS (...), w2 AS (...): resolve references here so the
+            # planner only ever sees inline specs (reference: analyzer named-
+            # window resolution over sql/tree/WindowDefinition.java)
+            defs: dict = {}
+            while True:
+                nt = self.next()
+                if nt.kind not in ("ident", "qident"):
+                    raise ParseError("expected window name", nt)
+                self.expect_kw("as")
+                self.expect_op("(")
+                spec = self._window_spec_body()
+                if nt.value.lower() in defs:
+                    raise ParseError(
+                        f"window '{nt.value.lower()}' specified more than once",
+                        nt,
+                    )
+                defs[nt.value.lower()] = _merge_window_spec(spec, defs, strict=True)
+                if not self.accept_op(","):
+                    break
+            items = _substitute_named_windows(items, defs)
+        return ast.QuerySpec(items, relation, where, group_by, having, distinct)
 
     def _grouping_element(self):
         """groupingElement: ROLLUP '(' ... ')' | CUBE '(' ... ')' |
@@ -1378,35 +1400,68 @@ class Parser:
             self.expect_kw("where")
             filt = self._expr()
             self.expect_op(")")
+        ignore_nulls = False
+        null_treatment = None
+        t0 = self.peek()
+        if (
+            t0.kind == "ident"
+            and t0.value.lower() in ("ignore", "respect")
+            and self.peek(1).is_kw("nulls")
+        ):
+            null_treatment = self.next()
+            ignore_nulls = null_treatment.value.lower() == "ignore"
+            self.next()  # NULLS
         window = None
+        if null_treatment is not None and not self.peek().is_kw("over"):
+            raise ParseError(
+                "IGNORE/RESPECT NULLS requires an OVER clause", null_treatment
+            )
         if self.accept_kw("over"):
-            self.expect_op("(")
-            partition_by: list[ast.Node] = []
-            order_by: list[ast.SortItem] = []
-            if self.accept_kw("partition"):
-                self.expect_kw("by")
+            if self.accept_op("("):
+                window = self._window_spec_body()
+            else:
+                t = self.next()
+                if t.kind not in ("ident", "qident"):
+                    raise ParseError("expected window name or specification", t)
+                window = ast.WindowSpec((), (), None, ref=t.value.lower())
+        return ast.FunctionCall(
+            name.lower(), tuple(args), distinct, is_star, window, filt,
+            within_group, ignore_nulls,
+        )
+
+    def _window_spec_body(self) -> ast.WindowSpec:
+        """Inside of an OVER ( ... ) or WINDOW w AS ( ... ): an optional
+        leading existing-window name, then PARTITION BY / ORDER BY / frame
+        (reference: SqlBase.g4 windowSpecification)."""
+        ref = None
+        t = self.peek()
+        if t.kind in ("ident", "qident"):
+            ref = self.next().value.lower()
+        partition_by: list[ast.Node] = []
+        order_by: list[ast.SortItem] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition_by.append(self._expr())
+            while self.accept_op(","):
                 partition_by.append(self._expr())
-                while self.accept_op(","):
-                    partition_by.append(self._expr())
-            if self.accept_kw("order"):
-                self.expect_kw("by")
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self._sort_item())
+            while self.accept_op(","):
                 order_by.append(self._sort_item())
-                while self.accept_op(","):
-                    order_by.append(self._sort_item())
-            frame = None
-            if self.peek().is_kw("rows", "range", "groups"):
-                kind = self.next().value.lower()
-                if self.accept_kw("between"):
-                    start = self._frame_bound()
-                    self.expect_kw("and")
-                    end = self._frame_bound()
-                else:
-                    start = self._frame_bound()
-                    end = ast.FrameBound("current")
-                frame = ast.WindowFrame(kind, start, end)
-            self.expect_op(")")
-            window = ast.WindowSpec(tuple(partition_by), tuple(order_by), frame)
-        return ast.FunctionCall(name.lower(), tuple(args), distinct, is_star, window, filt, within_group)
+        frame = None
+        if self.peek().is_kw("rows", "range", "groups"):
+            kind = self.next().value.lower()
+            if self.accept_kw("between"):
+                start = self._frame_bound()
+                self.expect_kw("and")
+                end = self._frame_bound()
+            else:
+                start = self._frame_bound()
+                end = ast.FrameBound("current")
+            frame = ast.WindowFrame(kind, start, end)
+        self.expect_op(")")
+        return ast.WindowSpec(tuple(partition_by), tuple(order_by), frame, ref=ref)
 
     def _frame_bound(self) -> ast.FrameBound:
         """reference: SqlBase.g4 frameBound / sql/tree/FrameBound.java."""
@@ -1423,6 +1478,50 @@ class Parser:
             return ast.FrameBound("preceding", value)
         self.expect_kw("following")
         return ast.FrameBound("following", value)
+
+
+def _merge_window_spec(spec, defs, strict=False):
+    """Resolve a WindowSpec's named-window reference against `defs`.
+    The referencing spec inherits the base's partitioning/ordering/frame
+    and may add its own ordering or frame (lenient version of the SQL
+    inheritance rules the reference enforces in its analyzer)."""
+    if spec.ref is None:
+        return spec
+    base = defs.get(spec.ref)
+    if base is None:
+        if strict:
+            raise ParseError(
+                f"window '{spec.ref}' is not defined",
+                Token("ident", spec.ref, 0),
+            )
+        return spec  # left for the planner to reject with context
+    return ast.WindowSpec(
+        spec.partition_by or base.partition_by,
+        spec.order_by or base.order_by,
+        spec.frame if spec.frame is not None else base.frame,
+    )
+
+
+def _substitute_named_windows(obj, defs):
+    """Rewrite resolved named-window references through the select items.
+    Stops at nested queries: a WINDOW clause scopes to its own query spec."""
+    import dataclasses
+
+    if isinstance(obj, ast.WindowSpec):
+        return _merge_window_spec(obj, defs)
+    if isinstance(obj, tuple):
+        return tuple(_substitute_named_windows(x, defs) for x in obj)
+    if isinstance(obj, (ast.Query, ast.QuerySpec, ast.SetOp)):
+        return obj
+    if dataclasses.is_dataclass(obj) and isinstance(obj, ast.Node):
+        changes = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            nv = _substitute_named_windows(v, defs)
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(obj, **changes) if changes else obj
+    return obj
 
 
 def parse_statement(sql: str) -> ast.Node:

@@ -23,7 +23,7 @@ KEYWORDS = {
     "current_time", "current_timestamp", "current_user", "exec", "execute", "prepare",
     "deallocate", "commit", "rollback", "start", "transaction", "work", "use",
     "year", "month", "day", "hour", "minute", "second", "quarter", "week",
-    "to",
+    "to", "window",
 }
 
 _MULTI_OPS = ("<=", ">=", "<>", "!=", "||", "->", "=>")
