@@ -230,3 +230,62 @@ def test_minmax_by_arity_and_count_if_distinct_rejected(runner):
         runner.execute("select min_by(n_name) from nation")
     with pytest.raises(Exception, match="count_if does not support DISTINCT"):
         runner.execute("select count_if(distinct n_regionkey > 1) from nation")
+
+
+def test_array_agg_order_by(runner):
+    rows = runner.execute(
+        "select array_agg(n_name order by n_nationkey desc) from nation "
+        "where n_regionkey = 1"
+    ).rows
+    assert rows == [
+        (["UNITED STATES", "PERU", "CANADA", "BRAZIL", "ARGENTINA"],)
+    ]
+    rows = runner.execute(
+        "select n_regionkey, array_agg(n_nationkey order by n_name desc) "
+        "from nation group by 1 order by 1 limit 2"
+    ).rows
+    assert rows == [(0, [16, 15, 14, 5, 0]), (1, [24, 17, 3, 2, 1])]
+
+
+def test_array_join(runner):
+    rows = runner.execute(
+        "select array_join(array[1,2,3], '-'), "
+        "array_join(array['a','b'], ', '), "
+        "array_join(array[1.5, 2.0], '|'), "
+        "array_join(array[true, false], ','), "
+        "array_join(cast(null as array(varchar)), ',')"
+    ).rows
+    assert rows == [("1-2-3", "a, b", "1.5|2.0", "true,false", None)]
+
+
+def test_array_join_of_array_agg(runner):
+    rows = runner.execute(
+        "select n_regionkey, array_join(array_agg(n_name order by n_name), ',') "
+        "from nation where n_nationkey < 6 group by 1 order by 1"
+    ).rows
+    assert rows == [
+        (0, "ALGERIA,ETHIOPIA"),
+        (1, "ARGENTINA,BRAZIL,CANADA"),
+        (4, "EGYPT"),
+    ]
+
+
+def test_array_join_temporal(runner):
+    rows = runner.execute(
+        "select array_join(array[date '2024-01-01', date '2024-01-02'], ',')"
+    ).rows
+    assert rows == [("2024-01-01,2024-01-02",)]
+
+
+def test_agg_order_by_rejections(runner):
+    with pytest.raises(Exception, match="DISTINCT with ORDER BY"):
+        runner.execute(
+            "select array_agg(distinct n_regionkey order by n_nationkey) "
+            "from nation"
+        )
+    with pytest.raises(Exception, match="not supported for map_agg"):
+        runner.execute(
+            "select map_agg(n_nationkey, n_name order by n_name) from nation"
+        )
+    with pytest.raises(Exception, match="not supported for upper"):
+        runner.execute("select upper(n_name order by n_nationkey) from nation")

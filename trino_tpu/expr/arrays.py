@@ -713,3 +713,83 @@ def _json_identity(ctx, call, value):
     """JSON is carried as canonical text (the engine's JSON runtime type is
     dictionary-encoded varchar), so parse/format are identity on valid text."""
     return Val(value.data, value.valid, call.type, value.dictionary)
+
+
+@register("array_join")
+def _array_join(ctx, call, arr, sep, *rest):
+    """array_join(arr, sep [, null_replacement]) — reference:
+    operator/scalar/ArrayJoin.java.  Eager host render: rectangular arrays
+    carry no per-element nulls (documented deviation), so the optional
+    null_replacement is accepted and unused."""
+    import jax
+
+    data, lens = _arr2d(ctx, arr)
+    if isinstance(data, jax.core.Tracer):
+        # host rendering can't trace; FilterProjectOperator runs projections
+        # containing array_join unjitted (EAGER_FUNCS), other jitted
+        # contexts (join residuals, ...) get a clean error instead of a
+        # TracerArrayConversionError
+        raise NotImplementedError(
+            "array_join is not supported in this expression context"
+        )
+    s = _literal_str(sep, "array_join")
+    if rest:
+        _literal_str(rest[0], "array_join")  # validate; elements can't be null
+    d = np.asarray(data)
+    ln = np.asarray(lens)
+    et = arr.type.element if isinstance(arr.type, T.ArrayType) else None
+    if arr.dictionary is not None:
+        vals = arr.dictionary.values
+
+        def render(c):
+            return vals[int(c)] if 0 <= int(c) < len(vals) else ""
+
+    elif et is not None and et.name == "boolean":
+
+        def render(c):
+            return "true" if c else "false"
+
+    elif isinstance(et, T.DecimalType) and et.scale > 0:
+        q = 10 ** et.scale
+
+        def render(c):
+            v = int(c)
+            sign = "-" if v < 0 else ""
+            return f"{sign}{abs(v) // q}.{abs(v) % q:0{et.scale}d}"
+
+    elif et is not None and et.name == "date":
+        import datetime
+
+        def render(c):
+            return (
+                datetime.date(1970, 1, 1) + datetime.timedelta(days=int(c))
+            ).isoformat()
+
+    elif et is not None and et.name == "timestamp":
+        import datetime
+
+        def render(c):
+            dt = datetime.datetime(1970, 1, 1) + datetime.timedelta(
+                microseconds=int(c)
+            )
+            return dt.isoformat(sep=" ")
+
+    elif et is not None and et.name == "timestamp with time zone":
+        raise NotImplementedError(
+            "array_join over timestamp with time zone arrays"
+        )
+
+    elif d.dtype.kind == "f":
+
+        def render(c):
+            return str(float(c))
+
+    else:
+
+        def render(c):
+            return str(int(c))
+
+    joined = [s.join(render(c) for c in d[i, : ln[i]]) for i in range(d.shape[0])]
+    nd = StringDictionary.from_unsorted(joined)
+    codes = jnp.asarray(np.asarray(nd.encode(joined), np.int32))
+    return Val(codes, arr.valid, call.type, nd)

@@ -886,6 +886,28 @@ class AggregationOperator:
 
         cap = batch.capacity
         col = batch.columns[spec.arg]
+        if (
+            spec.name == "array_agg"
+            and spec.arg2 is not None
+            and spec.param is not None
+        ):
+            # array_agg(x ORDER BY k): re-sort by (group keys, k) so the
+            # scatter positions below follow the requested element order
+            # (the _percentile_one re-sort pattern); group numbering is
+            # unchanged because the group keys stay most significant
+            asc, nf = spec.param
+            keys = [SortKey(ch) for ch in self.group_channels] + [
+                SortKey(spec.arg2, asc, nf)
+            ]
+            perm = multi_key_sort_perm(batch, keys)
+            live = jnp.take(batch.mask(), perm, mode="clip")
+            if self.group_channels:
+                gid, _, _ = group_ids_from_sorted(
+                    batch, perm, self.group_channels
+                )
+                gid_c = gid
+            else:
+                gid_c = jnp.zeros(cap, dtype=jnp.int64)
         d = jnp.take(col.data, perm, mode="clip")
         varg = live
         if col.valid is not None:

@@ -16,7 +16,17 @@ import jax
 
 from trino_tpu.columnar import Batch
 from trino_tpu.expr import ExprCompiler
-from trino_tpu.expr.ir import Expr
+from trino_tpu.expr.ir import Call, Expr
+
+#: functions that must evaluate eagerly (host-side per-row rendering):
+#: projections containing one run the step unjitted
+EAGER_FUNCS = frozenset({"array_join"})
+
+
+def _needs_eager(e: Expr) -> bool:
+    if isinstance(e, Call) and e.name in EAGER_FUNCS:
+        return True
+    return any(_needs_eager(c) for c in e.children())
 
 
 #: process-level jitted-step cache, keyed by expression structure — operator
@@ -37,7 +47,13 @@ class FilterProjectOperator:
         )
         cached = _STEP_CACHE.get(key)
         if cached is None:
-            cached = jax.jit(self._make_step())
+            step = self._make_step()
+            exprs = ([] if predicate is None else [predicate]) + list(
+                projections
+            )
+            # expressions with host-eager functions (per-row string renders
+            # that can't trace) run the same step without jit
+            cached = step if any(map(_needs_eager, exprs)) else jax.jit(step)
             _STEP_CACHE[key] = cached
         self._step = cached
 

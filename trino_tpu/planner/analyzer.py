@@ -372,6 +372,10 @@ class ExprAnalyzer:
             raise AnalysisError(
                 f"aggregate function {n.name} not allowed in this context"
             )
+        if n.within_group:
+            raise AnalysisError(
+                f"ORDER BY in arguments is not supported for {n.name}"
+            )
         if n.name == "current_date":
             today = (datetime.date.today() - _EPOCH).days
             return Literal(today, T.DATE)

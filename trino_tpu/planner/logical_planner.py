@@ -936,6 +936,29 @@ class LogicalPlanner:
                         )
                     param = float(p_ir.value)
                     fn_args = fn_args[:1]
+                if fc.within_group and fname not in ("array_agg", "listagg"):
+                    raise AnalysisError(
+                        f"ORDER BY in arguments is not supported for {fname}"
+                    )
+                if fname == "array_agg" and fc.within_group:
+                    # array_agg(x ORDER BY k): the order key rides as a
+                    # second projected argument; param = (asc, nulls_first)
+                    if len(fc.within_group) > 1:
+                        raise AnalysisError(
+                            "array_agg supports a single ORDER BY key"
+                        )
+                    if distinct:
+                        raise AnalysisError(
+                            "array_agg does not support DISTINCT with ORDER BY"
+                        )
+                    order = fc.within_group[0]
+                    param = (
+                        order.ascending,
+                        bool(order.nulls_first)
+                        if order.nulls_first is not None
+                        else False,
+                    )
+                    fn_args = fn_args[:1] + [order.expr]
                 if fname == "listagg":
                     # listagg(value [, separator]) [WITHIN GROUP (ORDER BY k)]
                     # — separator folds to the AggSpec param; the first order

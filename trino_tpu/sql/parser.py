@@ -1380,6 +1380,15 @@ class Parser:
             args.append(self._fn_arg())
             while self.accept_op(","):
                 args.append(self._fn_arg())
+        agg_order: tuple = ()
+        if self.accept_kw("order"):
+            # in-args aggregate ordering: array_agg(x ORDER BY k) —
+            # reference: SqlBase.g4 aggregate orderBy
+            self.expect_kw("by")
+            o_items = [self._sort_item()]
+            while self.accept_op(","):
+                o_items.append(self._sort_item())
+            agg_order = tuple(o_items)
         self.expect_op(")")
         within_group: tuple = ()
         if name.lower() in ("listagg", "string_agg") and self.accept_kw("within"):
@@ -1394,6 +1403,7 @@ class Parser:
                 items.append(self._sort_item())
             self.expect_op(")")
             within_group = tuple(items)  # full SortItems (DESC/NULLS kept)
+        within_group = within_group or agg_order
         filt = None
         if self.accept_kw("filter"):
             self.expect_op("(")
