@@ -229,3 +229,29 @@ def test_position_function(runner):
         "position('' in 'abc')"
     ).rows
     assert rows == [(2, 0, 1)]
+
+
+def test_format_function(runner):
+    rows = runner.execute(
+        "select format('%s has %d nations', r_name, 5) from region "
+        "order by r_name limit 1"
+    ).rows
+    assert rows == [("AFRICA has 5 nations",)]
+    rows = runner.execute(
+        "select format('%05d|%.2f|%s', n_nationkey, 1.5, n_name), "
+        "format('%,d', 1234567), format('%s', date '2024-03-01'), "
+        "format('100%%'), format('%s', cast(null as varchar)) "
+        "from nation order by n_nationkey limit 1"
+    ).rows
+    assert rows == [
+        ("00000|1.50|ALGERIA", "1,234,567", "2024-03-01", "100%", "null")
+    ]
+    rows = runner.execute(
+        "select format('[%10s]', 'hi'), format('[%-6s]', 'hi'), "
+        "format('%+d', 5), format('%#x', 255), "
+        "format('%s', cast(1.10 as decimal(4,2))), "
+        "format('%d', cast(null as bigint))"
+    ).rows
+    assert rows == [
+        ("[        hi]", "[hi    ]", "+5", "0xff", "1.10", None)
+    ]

@@ -28,7 +28,9 @@ def try_fold(e: Expr) -> Expr:
     try:
         if isinstance(e, Call):
             vals = [k.value for k in kids]
-            if any(v is None for v in vals):
+            if any(v is None for v in vals) and e.name != "format":
+                # format renders null arguments as 'null' text under %s
+                # (Java formatter semantics), so it must not null-fold
                 return Literal(None, e.type)
             if e.name == "$neg":
                 return Literal(-vals[0], e.type)

@@ -1499,3 +1499,171 @@ def _version(ctx, call):
 # array/json/map function handlers register themselves on import
 from trino_tpu.expr import arrays as _arrays  # noqa: E402,F401
 from trino_tpu.expr import maps as _maps  # noqa: E402,F401
+
+
+def _render_tz(millis: int, offset_minutes: int) -> str:
+    """Render a packed timestamptz as local-time text with its offset."""
+    import datetime
+
+    dt = datetime.datetime(1970, 1, 1) + datetime.timedelta(
+        milliseconds=millis + offset_minutes * 60_000
+    )
+    sign = "+" if offset_minutes >= 0 else "-"
+    om = abs(offset_minutes)
+    return f"{dt.isoformat(sep=' ')} {sign}{om // 60:02d}:{om % 60:02d}"
+
+
+@register("format")
+def _format(ctx, call, fmt, *args):
+    """format(fmt, args...) — reference: operator/scalar/FormatFunction.java
+    (Java format-directive subset: %s %d %x %X %o %f %e %g with -,0 flags,
+    width, precision).  Eager host render per row (EAGER_FUNCS): projections
+    containing it run unjitted."""
+    import datetime
+    import re
+
+    import jax
+
+    f = _literal_str(fmt, "format")
+    cap = ctx.capacity
+    if any(
+        isinstance(jnp.asarray(a.data), jax.core.Tracer) or a.lengths is not None
+        for a in args
+    ):
+        raise NotImplementedError(
+            "format is not supported in this expression context"
+        )
+    if re.search(r"%\d+\$", f):
+        raise NotImplementedError("format: %n$ argument indexes")
+
+    # translate the Java-style directives into one Python .format template
+    pieces, specs = [], []
+    last = 0
+    for m in re.finditer(r"%([-+0, #]*)(\d*)(?:\.(\d+))?([a-zA-Z%])", f):
+        pieces.append(f[last : m.start()].replace("{", "{{").replace("}", "}}"))
+        last = m.end()
+        flags, width, prec, conv = m.groups()
+        if conv == "%":
+            pieces.append("%")
+            continue
+        if conv not in "sdxXofeEgG":
+            raise NotImplementedError(f"format: unsupported directive %{conv}")
+        spec = ""
+        if "-" in flags:
+            spec += "<"
+        elif conv == "s" and width:
+            spec += ">"  # Java right-aligns %Ns; Python left-aligns strings
+        if "+" in flags:
+            spec += "+"
+        elif " " in flags:
+            spec += " "
+        if "#" in flags:
+            spec += "#"
+        if "0" in flags and "-" not in flags:
+            spec += "0"
+        spec += width
+        if "," in flags and conv in "dfeEgG":
+            spec += ","
+        if prec:
+            spec += "." + prec
+        spec += {"s": "s", "d": "d", "x": "x", "X": "X", "o": "o"}.get(
+            conv, conv
+        )
+        pieces.append("{%d:%s}" % (len(specs), spec))
+        specs.append(conv)
+    pieces.append(f[last:].replace("{", "{{").replace("}", "}}"))
+    template = "".join(pieces)
+    if len(specs) != len(args):
+        raise NotImplementedError(
+            f"format: {len(specs)} directives but {len(args)} arguments"
+        )
+
+    # per-row python values + per-arg validity (a null arg renders as
+    # 'null' under %s, like the reference's Java formatter; numeric
+    # directives null the row)
+    avalids = []
+    cols = []
+    for a in args:
+        if a.is_literal_null:
+            avalids.append(np.zeros(cap, dtype=bool))
+            cols.append([None] * cap)
+            continue
+        d = np.asarray(jnp.broadcast_to(jnp.asarray(a.data), (cap,)))
+        avalids.append(
+            np.asarray(jnp.broadcast_to(jnp.asarray(a.valid), (cap,)))
+            if a.valid is not None
+            else np.ones(cap, dtype=bool)
+        )
+        t = a.type
+        if a.dictionary is not None:
+            vals = a.dictionary.values
+            cols.append(
+                [vals[int(c)] if 0 <= int(c) < len(vals) else "" for c in d]
+            )
+        elif isinstance(t, T.DecimalType) and t.scale > 0:
+            q = 10 ** t.scale
+            cols.append(
+                [
+                    f"{'-' if int(c) < 0 else ''}"
+                    f"{abs(int(c)) // q}.{abs(int(c)) % q:0{t.scale}d}"
+                    for c in d
+                ]
+            )
+        elif t.name == "timestamp with time zone":
+            cols.append(
+                [
+                    _render_tz(int(T.unpack_tz_millis(np.int64(c))),
+                               int(T.unpack_tz_offset(np.int64(c))))
+                    for c in d
+                ]
+            )
+        elif t.name == "date":
+            epoch = datetime.date(1970, 1, 1)
+            cols.append(
+                [
+                    (epoch + datetime.timedelta(days=int(c))).isoformat()
+                    for c in d
+                ]
+            )
+        elif t.name == "timestamp":
+            ep = datetime.datetime(1970, 1, 1)
+            cols.append(
+                [
+                    (ep + datetime.timedelta(microseconds=int(c))).isoformat(
+                        sep=" "
+                    )
+                    for c in d
+                ]
+            )
+        elif t.name == "boolean":
+            cols.append([("true" if c else "false") for c in d])
+        elif d.dtype.kind == "f":
+            cols.append([float(c) for c in d])
+        else:
+            cols.append([int(c) for c in d])
+
+    outs = []
+    valid = np.ones(cap, dtype=bool)
+    for i in range(cap):
+        row = []
+        for j, conv in enumerate(specs):
+            if not avalids[j][i]:
+                if conv == "s":
+                    row.append("null")
+                    continue
+                valid[i] = False
+                break
+            v = cols[j][i]
+            if conv in "dxXo" and not isinstance(v, int):
+                v = int(float(v))
+            elif conv in "feEgG" and not isinstance(v, float):
+                v = float(v)
+            elif conv == "s":
+                v = str(v)
+            row.append(v)
+        outs.append(template.format(*row) if valid[i] else "")
+    from trino_tpu.columnar import StringDictionary
+
+    nd = StringDictionary.from_unsorted(outs)
+    codes = jnp.asarray(np.asarray(nd.encode(outs), np.int32))
+    return Val(codes, None if valid.all() else jnp.asarray(valid), call.type, nd)

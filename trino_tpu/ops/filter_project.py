@@ -20,7 +20,7 @@ from trino_tpu.expr.ir import Call, Expr
 
 #: functions that must evaluate eagerly (host-side per-row rendering):
 #: projections containing one run the step unjitted
-EAGER_FUNCS = frozenset({"array_join"})
+EAGER_FUNCS = frozenset({"array_join", "format"})
 
 
 def _needs_eager(e: Expr) -> bool:

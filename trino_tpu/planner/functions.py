@@ -313,6 +313,7 @@ SCALAR_RESULT = {
     "contains": _fixed(T.BOOLEAN),
     "array_position": _fixed(T.BIGINT),
     "array_join": _fixed(T.VARCHAR),
+    "format": _fixed(T.VARCHAR),
     "array_max": lambda args: args[0].element
     if isinstance(args[0], T.ArrayType)
     else args[0],
