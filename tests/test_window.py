@@ -308,3 +308,44 @@ def test_duplicate_window_name_rejected(runner):
             "select rank() over w from nation "
             "window w as (order by n_name), w as (order by n_regionkey)"
         )
+
+
+def test_nth_value(runner):
+    rows = runner.execute(
+        "select n_nationkey, nth_value(n_name, 2) over "
+        "(partition by n_regionkey order by n_nationkey "
+        "rows between unbounded preceding and unbounded following) "
+        "from nation where n_regionkey = 1 order by n_nationkey"
+    ).rows
+    assert all(v == "BRAZIL" for _, v in rows)
+    # running frame: n-th row beyond the frame end is NULL
+    rows = runner.execute(
+        "select x, nth_value(x, 2) over (order by x) from "
+        "(select 1 x union all select 2 union all select 3) t"
+    ).rows
+    assert sorted(rows) == [(1, None), (2, 2), (3, 2)]
+
+
+def test_nth_value_ignore_nulls(runner):
+    runner.execute("drop table if exists memory.default.ignn")
+    runner.execute(
+        "create table memory.default.ignn as select * from (values "
+        "(1, 10), (2, null), (3, null), (4, 40), (5, null)) t(i, x)"
+    )
+    rows = runner.execute(
+        "select i, nth_value(x, 2) ignore nulls over "
+        "(order by i rows between unbounded preceding and unbounded following) "
+        "from memory.default.ignn order by i"
+    ).rows
+    assert [v for _, v in rows] == [40] * 5
+
+
+def test_nth_value_validation(runner):
+    with pytest.raises(Exception, match="nth_value"):
+        runner.execute(
+            "select nth_value(n_name) over (order by n_nationkey) from nation"
+        )
+    with pytest.raises(Exception, match="positive"):
+        runner.execute(
+            "select nth_value(n_name, 0) over (order by n_nationkey) from nation"
+        )

@@ -303,13 +303,13 @@ class WindowOperator:
                 data = jnp.where(in_part, data, dd)
                 valid = jnp.where(in_part, valid, dv)
             return Column(data.astype(spec.out_type.np_dtype), spec.out_type, valid, col.dictionary)
-        if name in ("first_value", "last_value"):
+        if name in ("first_value", "last_value", "nth_value"):
             col = batch.columns[spec.arg]
             d = jnp.take(col.data, perm, mode="clip")
             v = jnp.take(col.valid, perm, mode="clip") if col.valid is not None else jnp.ones(cap, bool)
             if spec.ignore_nulls:
-                # first/last non-null row of the frame [lo, hi] via the same
-                # valid-rank table: frame's valid count = pref[hi]-pref[lo-1]
+                # first/last/nth non-null row of the frame [lo, hi] via the
+                # same valid-rank table: frame valid count = pref[hi]-pref[lo-1]
                 pref, pos_of = self._valid_ranks(
                     v, live, part_first, pos, cap
                 )
@@ -323,8 +323,15 @@ class WindowOperator:
                     jnp.take(pref, jnp.clip(hi, 0, cap - 1), mode="clip"),
                     before,
                 )
-                found = upto > before
-                rank0 = before if name == "first_value" else upto - 1
+                if name == "first_value":
+                    found = upto > before
+                    rank0 = before
+                elif name == "last_value":
+                    found = upto > before
+                    rank0 = upto - 1
+                else:  # nth_value(x, n): n-th non-null row of the frame
+                    found = upto - before >= spec.offset
+                    rank0 = before + spec.offset - 1
                 slot = jnp.where(found, part_first + rank0, cap)
                 src_row = jnp.take(pos_of, jnp.clip(slot, 0, cap), mode="clip")
                 return Column(
@@ -332,6 +339,23 @@ class WindowOperator:
                     .astype(spec.out_type.np_dtype),
                     spec.out_type,
                     jnp.logical_and(found, src_row < cap),
+                    col.dictionary,
+                )
+            if name == "nth_value":
+                src_raw = lo + spec.offset - 1
+                in_frame = src_raw <= hi
+                src = jnp.clip(src_raw, 0, cap - 1)
+                return Column(
+                    jnp.take(d, src, mode="clip").astype(
+                        spec.out_type.np_dtype
+                    ),
+                    spec.out_type,
+                    jnp.logical_and(
+                        jnp.logical_and(
+                            jnp.take(v, src, mode="clip"), in_frame
+                        ),
+                        frame_n > 0,
+                    ),
                     col.dictionary,
                 )
             src = jnp.clip(lo if name == "first_value" else hi, 0, cap - 1)

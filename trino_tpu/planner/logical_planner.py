@@ -1534,7 +1534,7 @@ def _subquery_spec(q: ast.Query) -> ast.QuerySpec:
 #: operator/window/* function registry)
 _WINDOW_RANK = {"row_number", "rank", "dense_rank", "ntile"}
 _WINDOW_DOUBLE = {"percent_rank", "cume_dist"}
-_WINDOW_VALUE = {"lag", "lead", "first_value", "last_value"}
+_WINDOW_VALUE = {"lag", "lead", "first_value", "last_value", "nth_value"}
 
 
 class _WindowExtractor:
@@ -1578,7 +1578,7 @@ class _WindowExtractor:
         if getattr(w, "ref", None) is not None:
             raise AnalysisError(f"window '{w.ref}' is not defined")
         if fc.ignore_nulls and fc.name not in (
-            "lag", "lead", "first_value", "last_value"
+            "lag", "lead", "first_value", "last_value", "nth_value"
         ):
             raise AnalysisError(
                 f"IGNORE NULLS is not valid for {fc.name}"
@@ -1606,6 +1606,10 @@ class _WindowExtractor:
                 n_buckets = int(lit.value)
             out_t = T.DOUBLE if name in _WINDOW_DOUBLE else T.BIGINT
         elif name in _WINDOW_VALUE:
+            if name == "nth_value" and len(fc.args) != 2:
+                raise AnalysisError("nth_value requires (value, n)")
+            if not fc.args:
+                raise AnalysisError(f"{name} requires an argument")
             arg = an.analyze(fc.args[0])
             arg_syms = [self._pre_symbol(arg, _name_hint(fc.args[0]))]
             out_t = arg.type
@@ -1619,6 +1623,17 @@ class _WindowExtractor:
                     default_sym = self._pre_symbol(
                         an.analyze(fc.args[2]), "default"
                     )
+            if name == "nth_value":
+                off = an.analyze(fc.args[1])
+                if not isinstance(off, Literal) or not isinstance(
+                    off.value, int
+                ):
+                    raise AnalysisError(
+                        "nth_value n must be an integer literal"
+                    )
+                offset = off.value
+                if offset < 1:
+                    raise AnalysisError("nth_value n must be positive")
         elif name in AGG_FUNCS or (fc.is_star and name == "count"):
             if fc.distinct:
                 raise AnalysisError(

@@ -64,6 +64,7 @@ WINDOW_FUNCS = (
     "lead",
     "first_value",
     "last_value",
+    "nth_value",
 )
 
 
