@@ -1,0 +1,118 @@
+"""LATERAL join tests (reference: sql/tree/Lateral.java + the
+TransformCorrelated* decorrelation rules)."""
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+def test_lateral_projection_only(runner):
+    rows = runner.execute(
+        "select n_name, x from nation, lateral (select n_nationkey + 1 as x) "
+        "where n_regionkey = 1 order by n_name limit 2"
+    ).rows
+    assert rows == [("ARGENTINA", 2), ("BRAZIL", 3)]
+
+
+def test_lateral_correlated_aggregate(runner):
+    rows = runner.execute(
+        "select r_name, t.cnt from region, lateral "
+        "(select count(*) cnt from nation where n_regionkey = r_regionkey) t "
+        "order by r_name"
+    ).rows
+    assert rows == [(n, 5) for n, _ in rows]
+    assert len(rows) == 5
+
+
+def test_lateral_empty_group_count_zero(runner):
+    rows = runner.execute(
+        "select r_name, cnt from region, lateral "
+        "(select count(*) cnt from nation "
+        "where n_regionkey = r_regionkey and n_nationkey > 90) "
+        "order by r_name limit 2"
+    ).rows
+    assert rows == [("AFRICA", 0), ("AMERICA", 0)]
+
+
+def test_lateral_correlated_rows(runner):
+    rows = runner.execute(
+        "select r_name, n_name from region, lateral "
+        "(select n_name from nation where n_regionkey = r_regionkey) "
+        "order by r_name, n_name limit 3"
+    ).rows
+    assert rows == [
+        ("AFRICA", "ALGERIA"), ("AFRICA", "ETHIOPIA"), ("AFRICA", "KENYA"),
+    ]
+
+
+def test_lateral_uncorrelated_aggregate_cross(runner):
+    rows = runner.execute(
+        "select r_name, x from region cross join lateral "
+        "(select max(n_nationkey) x from nation) order by r_name limit 2"
+    ).rows
+    assert rows == [("AFRICA", 24), ("AMERICA", 24)]
+
+
+def test_lateral_uncorrelated_limit(runner):
+    rows = runner.execute(
+        "select r_name, nn from region, lateral "
+        "(select n_name nn from nation order by n_nationkey limit 2) "
+        "order by r_name, nn limit 4"
+    ).rows
+    assert rows == [
+        ("AFRICA", "ALGERIA"), ("AFRICA", "ARGENTINA"),
+        ("AMERICA", "ALGERIA"), ("AMERICA", "ARGENTINA"),
+    ]
+
+
+def test_lateral_correlated_limit_rejected(runner):
+    with pytest.raises(Exception, match="not found|LATERAL"):
+        runner.execute(
+            "select r_name, nn from region, lateral "
+            "(select n_name nn from nation where n_regionkey = r_regionkey "
+            "order by n_nationkey limit 1)"
+        )
+
+
+def test_lateral_star(runner):
+    rows = runner.execute(
+        "select r_name, n_name from region, lateral "
+        "(select * from nation where n_regionkey = r_regionkey) "
+        "order by r_name, n_name limit 2"
+    ).rows
+    assert rows == [("AFRICA", "ALGERIA"), ("AFRICA", "ETHIOPIA")]
+
+
+def test_lateral_grouped_correlated_inner_semantics(runner):
+    # user GROUP BY: empty groups drop the outer row (INNER, not LEFT)
+    rows = runner.execute(
+        "select r_name, c from region, lateral "
+        "(select n_regionkey g, count(*) c from nation "
+        "where n_regionkey = r_regionkey and n_nationkey > 20 "
+        "group by n_regionkey) order by r_name"
+    ).rows
+    assert rows == [("AMERICA", 1), ("ASIA", 1), ("EUROPE", 2)]
+
+
+def test_lateral_agg_with_limit_rejected(runner):
+    with pytest.raises(Exception, match="ORDER BY/LIMIT"):
+        runner.execute(
+            "select r_name, c from region, lateral "
+            "(select count(*) c from nation where n_regionkey = r_regionkey "
+            "limit 1)"
+        )
+
+
+def test_outer_join_without_equi_clean_error(runner):
+    with pytest.raises(Exception, match="equi-join condition"):
+        runner.execute(
+            "select r_name, x from region left join "
+            "(select max(n_nationkey) x from nation) t on true"
+        )
