@@ -677,6 +677,15 @@ class LogicalPlanner:
                 inner_rel, left, outer, ctes,
                 alias=u_alias, column_aliases=u_cols,
             )
+        if isinstance(inner_rel, ast.SubqueryRelation) and inner_rel.lateral:
+            if j.kind in ("cross", "inner") and j.on is None and not j.using:
+                return self.plan_lateral(
+                    left, inner_rel.query, outer, ctes,
+                    alias=u_alias, column_aliases=u_cols,
+                )
+            # LEFT JOIN LATERAL ... ON cond: fall through to ordinary join
+            # planning (works when the subquery is uncorrelated; correlated
+            # references fail with column-not-found like before)
         right = self.plan_relation(j.right, outer, ctes)
         fields = left.fields + right.fields
         if j.kind == "cross":
@@ -702,11 +711,198 @@ class LogicalPlanner:
                 criteria.append(pair)
             else:
                 residual.append(e)
+        if not criteria and j.kind in ("left", "right", "full"):
+            raise AnalysisError(
+                f"{j.kind.upper()} JOIN requires an equi-join condition"
+            )
         node = P.JoinNode(
             j.kind, left.node, right.node, criteria,
             ir.and_(*residual) if residual else None,
         )
         return RelationPlan(node, fields)
+
+    def plan_lateral(
+        self, left, q: ast.Query, outer, ctes, alias=None, column_aliases=()
+    ) -> RelationPlan:
+        """LATERAL (subquery referencing left-relation columns) — the
+        correlated-apply relation (reference: sql/tree/Lateral.java +
+        the TransformCorrelated* decorrelation rules).  Decorrelates into
+        ordinary joins the same way plan_subquery_value does: correlated
+        equi-conjuncts become join criteria, aggregates group by them."""
+        spec = _subquery_spec(q)
+        if spec.distinct:
+            raise AnalysisError("LATERAL with SELECT DISTINCT not supported")
+        lat_scope = left.scope(outer)
+
+        def _named(i, default):
+            return column_aliases[i] if i < len(column_aliases) else default
+
+        # projection-only lateral (no FROM): computed columns over each
+        # left row — the common `lateral (select expr as x)` idiom
+        if spec.relation is None:
+            if spec.group_by or spec.having is not None or spec.where is not None:
+                raise AnalysisError(
+                    "LATERAL without FROM supports plain SELECT only"
+                )
+            an = ExprAnalyzer(lat_scope)
+            assigns = [(f.symbol, f.symbol.ref()) for f in left.fields]
+            new_fields = []
+            for i, item in enumerate(spec.items):
+                if not isinstance(item, ast.SelectItem):
+                    raise AnalysisError(
+                        "SELECT * not supported in LATERAL without FROM"
+                    )
+                e = an.analyze(item.expr)
+                name = _named(i, item.alias or _name_hint(item.expr))
+                sym = self.alloc.new(name, e.type)
+                assigns.append((sym, e))
+                new_fields.append(Field(name, sym, alias))
+            node = P.ProjectNode(left.node, assigns)
+            return RelationPlan(node, left.fields + new_fields)
+
+        agg_calls: list = []
+        for item in spec.items:
+            if isinstance(item, ast.SelectItem):
+                collect_aggregates(item.expr, agg_calls)
+        aggregated = bool(agg_calls or spec.group_by or spec.having is not None)
+
+        if q.order_by or q.limit is not None or q.offset is not None:
+            # uncorrelated only: plan the whole query (order/limit intact)
+            # and cross join; correlated references fail cleanly inside.
+            # Silently dropping the ordering/limit is never acceptable.
+            if aggregated:
+                raise AnalysisError(
+                    "LATERAL aggregate with ORDER BY/LIMIT not supported"
+                )
+            rp, names = self.plan_query(q, outer, ctes)
+            fields = [
+                Field(_named(i, n), f.symbol, alias)
+                for i, (n, f) in enumerate(zip(names, rp.fields))
+            ]
+            node = P.JoinNode("cross", left.node, rp.node, [])
+            return RelationPlan(node, left.fields + fields)
+
+        # plan the lateral FROM, then classify WHERE conjuncts exactly like
+        # plan_subquery_value: local filters apply in place, correlated
+        # equi-conjuncts become (outer, inner) criteria, the rest residual
+        sub = self.plan_relation(spec.relation, lat_scope, ctes)
+        sub_scope = sub.scope(lat_scope)
+        sub_syms = {f.symbol.name for f in sub.fields}
+        crit: list[tuple] = []
+        correlated: list[Expr] = []
+        if spec.where is not None:
+            for c in split_conjuncts(spec.where):
+                if _contains_subquery(c):
+                    # nested subquery conjunct: applied over the lateral
+                    # relation ONLY (outer=None) — a left-column reference
+                    # here would otherwise build a filter below the join
+                    # over symbols the sub never produces
+                    sub = self._apply_where(sub, c, None, ctes)
+                    sub_scope = sub.scope(lat_scope)
+                    continue
+                outer_refs: set = set()
+                an = ExprAnalyzer(sub_scope, outer_refs=outer_refs)
+                e = an.analyze(c)
+                if not outer_refs:
+                    sub = RelationPlan(P.FilterNode(sub.node, e), sub.fields)
+                    sub_scope = sub.scope(lat_scope)
+                    continue
+                pair = _as_equi_pair(e, outer_refs, sub_syms)
+                if pair is not None:
+                    crit.append(pair)
+                else:
+                    correlated.append(e)
+
+        if aggregated:
+            if correlated:
+                # residuals reference pre-aggregation inner symbols that the
+                # aggregation output no longer exposes
+                raise AnalysisError(
+                    "correlated LATERAL aggregate supports equi-join "
+                    "correlation only"
+                )
+            inner_keys = [i for _, i in crit]
+            spec2 = ast.QuerySpec(
+                spec.items, None, None, spec.group_by, spec.having, False
+            )
+            rp2, names2 = self._plan_aggregation(
+                spec2, sub, sub_scope, lat_scope, ctes, extra_keys=inner_keys
+            )
+            nk = len(inner_keys)
+            if crit:
+                out_keys = [rp2.fields[i].symbol for i in range(nk)]
+                # no GROUP BY: the subquery yields exactly one row per outer
+                # row even over an empty group, so unmatched outers survive
+                # (LEFT); with a user GROUP BY an empty group yields nothing
+                # and the outer row must drop (INNER)
+                kind = "inner" if spec.group_by else "left"
+                node = P.JoinNode(
+                    kind, left.node, rp2.node,
+                    [(o, k) for (o, _), k in zip(crit, out_keys)],
+                    None,
+                )
+            else:
+                node = P.JoinNode("cross", left.node, rp2.node, [])
+            val_fields = [
+                Field(_named(i - nk, names2[i]), rp2.fields[i].symbol, alias)
+                for i in range(nk, len(rp2.fields))
+            ]
+            out = RelationPlan(node, left.fields + val_fields)
+            if crit and _is_bare_count(spec):
+                # count over no matching rows reads NULL off the LEFT JOIN
+                # but must be 0 (the classic count bug)
+                f0 = val_fields[0]
+                fixed = self.alloc.new(f0.name, T.BIGINT)
+                assigns = [
+                    (f.symbol, f.symbol.ref()) for f in left.fields
+                ] + [
+                    (
+                        fixed,
+                        SpecialForm(
+                            Form.COALESCE,
+                            [f0.symbol.ref(), Literal(0, T.BIGINT)],
+                            T.BIGINT,
+                        ),
+                    )
+                ]
+                out = RelationPlan(
+                    P.ProjectNode(out.node, assigns),
+                    left.fields + [Field(f0.name, fixed, alias)],
+                )
+            return out
+
+        # non-aggregated: correlated equi pairs join, items project over the
+        # combined row (they may mix inner and outer columns)
+        if crit or correlated:
+            node = P.JoinNode(
+                "inner", left.node, sub.node, crit,
+                ir.and_(*correlated) if correlated else None,
+            )
+        else:
+            node = P.JoinNode("cross", left.node, sub.node, [])
+        combined = RelationPlan(node, left.fields + sub.fields)
+        an = ExprAnalyzer(combined.scope(outer))
+        assigns = [(f.symbol, f.symbol.ref()) for f in left.fields]
+        new_fields = []
+        i = 0
+        for item in spec.items:
+            if isinstance(item, ast.Star):
+                for f in sub.fields:
+                    if item.qualifier and f.alias != item.qualifier[-1]:
+                        continue  # t.* expands t's columns only
+                    assigns.append((f.symbol, f.symbol.ref()))
+                    new_fields.append(Field(_named(i, f.name), f.symbol, alias))
+                    i += 1
+                continue
+            e = an.analyze(item.expr)
+            name = _named(i, item.alias or _name_hint(item.expr))
+            sym = self.alloc.new(name, e.type)
+            assigns.append((sym, e))
+            new_fields.append(Field(name, sym, alias))
+            i += 1
+        return RelationPlan(
+            P.ProjectNode(combined.node, assigns), left.fields + new_fields
+        )
 
     # -- SELECT core ---------------------------------------------------------
 

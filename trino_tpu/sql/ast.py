@@ -242,6 +242,7 @@ class AliasedRelation(Node):
 @dataclass(frozen=True)
 class SubqueryRelation(Node):
     query: "Query"
+    lateral: bool = False  # LATERAL (...): subquery sees the left row scope
 
 
 @dataclass(frozen=True)

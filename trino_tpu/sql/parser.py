@@ -993,7 +993,7 @@ class Parser:
             self.expect_op("(")
             q = self._query()
             self.expect_op(")")
-            return ast.SubqueryRelation(q)  # analyzer handles correlation
+            return ast.SubqueryRelation(q, lateral=True)
         return ast.TableRef(self.qualified_name())
 
     def _table_arg_body(self) -> ast.Node:
