@@ -289,3 +289,37 @@ def test_agg_order_by_rejections(runner):
         )
     with pytest.raises(Exception, match="not supported for upper"):
         runner.execute("select upper(n_name order by n_nationkey) from nation")
+
+
+def test_minmax_by_n_form(runner):
+    rows = runner.execute(
+        "select min_by(n_name, n_nationkey, 3), max_by(n_name, n_nationkey, 2) "
+        "from nation"
+    ).rows
+    assert rows == [
+        (["ALGERIA", "ARGENTINA", "BRAZIL"], ["UNITED STATES", "UNITED KINGDOM"])
+    ]
+    rows = runner.execute(
+        "select n_regionkey, min_by(n_name, n_nationkey, 2) from nation "
+        "group by 1 order by 1 limit 2"
+    ).rows
+    assert rows == [(0, ["ALGERIA", "ETHIOPIA"]), (1, ["ARGENTINA", "BRAZIL"])]
+    assert runner.execute(
+        "select min_by(n_name, n_nationkey, 3) from nation where n_nationkey > 99"
+    ).rows == [([],)]
+
+
+def test_minmax_by_n_distributed(runner):
+    from trino_tpu.parallel.runner import DistributedQueryRunner
+
+    d = DistributedQueryRunner(catalog="tpch", schema="tiny")
+    sql = (
+        "select l_returnflag, max_by(l_comment, l_extendedprice, 2) "
+        "from lineitem group by 1 order by 1"
+    )
+    assert d.execute(sql).rows == runner.execute(sql).rows
+
+
+def test_minmax_by_n_validation(runner):
+    with pytest.raises(Exception, match="positive integer literal"):
+        runner.execute("select min_by(n_name, n_nationkey, 0) from nation")

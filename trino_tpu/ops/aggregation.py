@@ -1027,6 +1027,54 @@ class AggregationOperator:
             d,
         )
 
+    def _minmax_by_n(self, batch: Batch, spec: AggSpec, nseg, out_cap) -> Column:
+        """min_by/max_by(value, key, n): the values at each group's n
+        extreme keys, as a padded array in key order (reference:
+        MinMaxByNAggregation's TypedHeap — a sort-based engine takes the
+        first n of the key-sorted run instead).  NULL keys and NULL values
+        are skipped (rectangular arrays carry no per-element nulls — the
+        array_agg deviation)."""
+        n = int(spec.param)
+        want_min = spec.name == "min_by"
+        cap = batch.capacity
+        kcol = batch.columns[spec.arg2]
+        vcol = batch.columns[spec.arg]
+        keys = [SortKey(ch) for ch in self.group_channels] + [
+            SortKey(spec.arg2, want_min)
+        ]
+        perm = multi_key_sort_perm(batch, keys)
+        live = jnp.take(batch.mask(), perm, mode="clip")
+        if self.group_channels:
+            gid, _, _ = group_ids_from_sorted(batch, perm, self.group_channels)
+            gid_c = gid
+        else:
+            gid_c = jnp.zeros(cap, dtype=jnp.int64)
+        varg = live
+        if kcol.valid is not None:
+            varg = jnp.logical_and(varg, jnp.take(kcol.valid, perm, mode="clip"))
+        if vcol.valid is not None:
+            varg = jnp.logical_and(varg, jnp.take(vcol.valid, perm, mode="clip"))
+        rank_incl = jnp.cumsum(varg.astype(jnp.int64))
+        base = jax.ops.segment_min(
+            jnp.where(varg, rank_incl - 1, cap + 1), gid_c, nseg
+        )
+        pos_in_group = rank_incl - 1 - jnp.take(base, gid_c, mode="clip")
+        counts = jax.ops.segment_sum(varg.astype(jnp.int64), gid_c, nseg)
+        keep = jnp.logical_and(varg, pos_in_group < n)
+        scatter_g = jnp.where(keep, gid_c, nseg)
+        scatter_p = jnp.clip(pos_in_group, 0, n - 1)
+        vd = jnp.take(vcol.data, perm, mode="clip")
+        et = spec.out_type.element
+        out = (
+            jnp.zeros((nseg + 1, n), dtype=et.np_dtype)
+            .at[scatter_g, scatter_p]
+            .set(jnp.asarray(vd, et.np_dtype), mode="drop")
+        )
+        lengths = jnp.minimum(counts[:out_cap], n).astype(jnp.int32)
+        return Column(
+            out[:out_cap], spec.out_type, None, vcol.dictionary, lengths
+        )
+
     def _minmax_by_one(
         self, batch: Batch, spec: AggSpec, perm, live, gid_c, nseg, out_cap
     ) -> Column:
@@ -1034,6 +1082,8 @@ class AggregationOperator:
         (reference: MinMaxByNAggregation, N=1).  Jit-safe: extreme key via
         segment reduce, then the first row achieving it selects the value.
         Rows with NULL keys are skipped; ties pick the first sorted row."""
+        if spec.param is not None:
+            return self._minmax_by_n(batch, spec, nseg, out_cap)
         from trino_tpu.ops.common import _max_sentinel, _min_sentinel
 
         cap = batch.capacity

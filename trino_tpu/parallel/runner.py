@@ -960,6 +960,7 @@ def _slice_host(batch: Batch, n: int) -> Batch:
             c.type,
             None if c.valid is None else np.asarray(c.valid)[:n],
             c.dictionary,
+            None if c.lengths is None else np.asarray(c.lengths)[:n],
         )
         for c in batch.columns
     ]

@@ -1155,6 +1155,30 @@ class LogicalPlanner:
                         else False,
                     )
                     fn_args = fn_args[:1] + [order.expr]
+                if fname in ("min_by", "max_by") and len(fn_args) == 3:
+                    # N-form: min_by/max_by(value, key, n) returns the array
+                    # of values at the n extreme keys (reference:
+                    # MinMaxByNAggregation); n folds to the AggSpec param
+                    from trino_tpu.expr.constant_folding import try_fold
+
+                    n_ir = try_fold(src_an.analyze(fn_args[2]))
+                    if (
+                        not isinstance(n_ir, Literal)
+                        or not isinstance(n_ir.value, int)
+                        or isinstance(n_ir.value, bool)
+                        or n_ir.value < 1
+                    ):
+                        raise AnalysisError(
+                            f"{fname} n must be a positive integer literal"
+                        )
+                    if n_ir.value > 10_000:
+                        # dense [groups, n] state; the reference caps n at
+                        # 10000 (MinMaxByNAggregation) for the same reason
+                        raise AnalysisError(
+                            f"{fname} n must not exceed 10000"
+                        )
+                    param = n_ir.value
+                    fn_args = fn_args[:2]
                 if fname == "listagg":
                     # listagg(value [, separator]) [WITHIN GROUP (ORDER BY k)]
                     # — separator folds to the AggSpec param; the first order
@@ -1200,6 +1224,8 @@ class LogicalPlanner:
                 return agg_map[key]
             arg_t2 = arg_irs[1].type if len(arg_irs) > 1 else None
             out_t = agg_result_type(fname, arg_t, arg_t2)
+            if fname in ("min_by", "max_by") and param is not None:
+                out_t = T.ArrayType(arg_t)  # the N-form collects an array
             sym = alloc.new(fc.name, out_t)
             aggregations.append(
                 (
