@@ -71,6 +71,19 @@ BIVARIATE = ("covar_samp", "covar_pop", "corr", "regr_slope", "regr_intercept")
 CHECKSUM_NULL_PRIME = 0x9E3779B185EBCA87
 
 
+def _group_ranks(varg, gid_c, cap: int, nseg: int):
+    """(pos_in_group, counts) for the collect-style scatters: the 0-based
+    rank of each kept row (varg) within its group in sorted order, and the
+    per-group kept-row counts.  Shared by _collect_one and _minmax_by_n."""
+    rank_incl = jnp.cumsum(varg.astype(jnp.int64))
+    base = jax.ops.segment_min(
+        jnp.where(varg, rank_incl - 1, cap + 1), gid_c, nseg
+    )
+    pos_in_group = rank_incl - 1 - jnp.take(base, gid_c, mode="clip")
+    counts = jax.ops.segment_sum(varg.astype(jnp.int64), gid_c, nseg)
+    return pos_in_group, counts
+
+
 #: HyperLogLog registers per sketch: p=13 -> 8192 buckets, standard error
 #: 1.04/sqrt(8192) ~= 1.15% (reference: ApproximateCountDistinctAggregation
 #: defaults + state/HyperLogLogStateFactory.java:23)
@@ -934,13 +947,7 @@ class AggregationOperator:
             elif vcol.dictionary is not None:
                 dictionary = vcol.dictionary
         # within-group rank over kept rows
-        rank_incl = jnp.cumsum(varg.astype(jnp.int64))
-        pos = jnp.arange(cap, dtype=jnp.int64)
-        base = jax.ops.segment_min(
-            jnp.where(varg, rank_incl - 1, cap + 1), gid_c, nseg
-        )
-        pos_in_group = rank_incl - 1 - jnp.take(base, gid_c, mode="clip")
-        counts = jax.ops.segment_sum(varg.astype(jnp.int64), gid_c, nseg)
+        pos_in_group, counts = _group_ranks(varg, gid_c, cap, nseg)
         kmax = int(np.asarray(jnp.max(counts[:out_cap])))  # the one host sync
         k = next_pow2(max(kmax, 1), floor=1)
         scatter_g = jnp.where(varg, gid_c, nseg)  # drop non-kept rows
@@ -1054,12 +1061,7 @@ class AggregationOperator:
             varg = jnp.logical_and(varg, jnp.take(kcol.valid, perm, mode="clip"))
         if vcol.valid is not None:
             varg = jnp.logical_and(varg, jnp.take(vcol.valid, perm, mode="clip"))
-        rank_incl = jnp.cumsum(varg.astype(jnp.int64))
-        base = jax.ops.segment_min(
-            jnp.where(varg, rank_incl - 1, cap + 1), gid_c, nseg
-        )
-        pos_in_group = rank_incl - 1 - jnp.take(base, gid_c, mode="clip")
-        counts = jax.ops.segment_sum(varg.astype(jnp.int64), gid_c, nseg)
+        pos_in_group, counts = _group_ranks(varg, gid_c, cap, nseg)
         keep = jnp.logical_and(varg, pos_in_group < n)
         scatter_g = jnp.where(keep, gid_c, nseg)
         scatter_p = jnp.clip(pos_in_group, 0, n - 1)
