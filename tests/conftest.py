@@ -66,8 +66,25 @@ jax.config.update("jax_enable_x64", True)
 # copied between machines — /tmp is machine-local by construction.  Disable
 # with TRINO_TPU_NO_TEST_CACHE=1 (e.g. when bisecting compiler issues).
 if os.environ.get("TRINO_TPU_NO_TEST_CACHE") != "1":
+    # Key the cache dir by a host-CPU fingerprint: /tmp can survive a
+    # container migration to a different machine, and XLA will load (and
+    # warn about, and potentially SIGILL on) AOT entries compiled for the
+    # old machine's features.  A fingerprinted path narrows the window to
+    # machines whose cpuinfo flags hash identically (XLA's own
+    # prefer-no-gather/scatter pseudo-feature warnings can still fire on
+    # same-machine reloads — those are benign).
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as _f:
+            _flags = next(
+                (ln for ln in _f if ln.startswith("flags")), "unknown"
+            )
+    except OSError:
+        _flags = "unknown"
+    _fp = hashlib.sha256(_flags.encode()).hexdigest()[:12]
     jax.config.update(
-        "jax_compilation_cache_dir", "/tmp/trino_tpu_test_xla_cache"
+        "jax_compilation_cache_dir", f"/tmp/trino_tpu_test_xla_cache_{_fp}"
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

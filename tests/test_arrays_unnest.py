@@ -198,3 +198,24 @@ def test_json_over_column(runner):
         """(VALUES ('{"k": "v1"}'), ('{"k": "v2"}'), ('broken')) t(j)""",
     )
     assert res == [("v1",), ("v2",), (None,)]
+
+
+def test_array_column_is_null(runner):
+    # ADVICE r4: IS NULL on an array/map value is a per-ROW predicate even
+    # though the data is [capacity, K]; regression for a 2-D-mask crash.
+    rows = runner.execute(
+        "select arr is null, arr is not null from "
+        "(select slice(array[x, x], if(x = 1, 1), 2) arr "
+        "from (values 1, 2) t(x))"
+    ).rows
+    assert sorted(rows) == [(False, True), (True, False)]
+
+
+def test_array_is_null_in_where(runner):
+    rows = runner.execute(
+        "select cardinality(arr) from "
+        "(select slice(array[x, x], if(x <> 2, 1), 2) arr "
+        "from (values 1, 2, 3) t(x)) "
+        "where arr is not null order by 1"
+    ).rows
+    assert rows == [(2,), (2,)]

@@ -152,3 +152,16 @@ def test_array_set_functions_cross_dictionary(runner):
         "array_intersect(array['b','c'], array['a','b'])"
     ).rows
     assert rows == [(["b"], ["b"])]
+
+
+def test_concat_ws_null_handling(runner):
+    # ADVICE r4: NULLs are skipped entirely -- no separator for a NULL in
+    # ANY position, including first (reference: ConcatWsFunction).
+    rows = runner.execute(
+        "select concat_ws(',', cast(null as varchar), 'b', 'c'), "
+        "concat_ws(',', 'a', cast(null as varchar), 'c'), "
+        "concat_ws(',', cast(null as varchar), cast(null as varchar)), "
+        "concat_ws(',', '', 'b'), "
+        "concat_ws(cast(null as varchar), 'a', 'b')"
+    ).rows
+    assert rows == [("b,c", "a,c", "", ",b", None)]

@@ -86,3 +86,32 @@ def test_merge_insert_condition_filters(runner):
     )
     assert res.rows == [(0,)]
     assert runner.execute("select count(*) from tgt").rows == [(3,)]
+
+
+def test_merge_multiple_source_matches_raises(runner):
+    # ADVICE r4: a target row matched by >1 source row is a cardinality
+    # violation (reference: MERGE_TARGET_ROW_MULTIPLE_MATCHES), not a
+    # silent duplication of the target row.
+    runner.execute("insert into src values (2,'B2')")
+    with pytest.raises(Exception, match="more than one source row"):
+        runner.execute(
+            "merge into tgt t using src s on t.k = s.k "
+            "when matched then update set v = s.v"
+        )
+    # target must be untouched after the failed merge
+    assert sorted(runner.execute("select * from tgt").rows) == [
+        (1, "a"), (2, "b"), (3, "c"),
+    ]
+
+
+def test_merge_duplicate_target_rows_ok(runner):
+    # duplicate TARGET rows each matching one source row is legal join
+    # cardinality -- both copies update, no error.
+    runner.execute("insert into tgt values (2,'b')")
+    res = runner.execute(
+        "merge into tgt t using src s on t.k = s.k "
+        "when matched and s.k = 2 then update set v = s.v"
+    )
+    assert res.rows == [(2,)]
+    rows = sorted(runner.execute("select * from tgt").rows)
+    assert rows == [(1, "a"), (2, "B"), (2, "B"), (3, "c")]

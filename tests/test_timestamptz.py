@@ -48,7 +48,8 @@ def test_tz_casts(runner):
         "cast(date '2020-03-01' as timestamp with time zone)"
     ).rows
     ts, d, tz = rows[0]
-    assert ts == datetime.datetime(2020, 3, 1, 5, 0)
+    # wall clock in the value's zone (ADVICE r4 fix), matching tz->date
+    assert ts == datetime.datetime(2020, 3, 1, 10, 30)
     assert d == datetime.date(2020, 3, 1)
     assert tz == datetime.datetime(2020, 3, 1, tzinfo=datetime.timezone.utc)
 
@@ -113,3 +114,29 @@ def test_tz_order_by(runner):
     ).rows
     instants = [r[0].astimezone(datetime.timezone.utc) for r in rows]
     assert instants == sorted(instants)
+
+
+def test_tz_cast_to_timestamp_keeps_wall_clock(runner):
+    # ADVICE r4: cast(tz -> timestamp) keeps the wall clock in the value's
+    # zone (reference DateTimeOperators), consistent with cast(tz -> date).
+    rows = runner.execute(
+        "select cast(timestamp '2020-03-01 10:30:00 +05:30' as timestamp)"
+    ).rows
+    assert rows == [(datetime.datetime(2020, 3, 1, 10, 30),)]
+    # consistency: date(ts) == date(cast(ts as timestamp))
+    rows = runner.execute(
+        "select cast(timestamp '2020-03-01 01:30:00 +05:30' as date), "
+        "cast(cast(timestamp '2020-03-01 01:30:00 +05:30' as timestamp) as date)"
+    ).rows
+    assert rows[0][0] == rows[0][1] == datetime.date(2020, 3, 1)
+
+
+def test_tz_cast_wall_clock_non_constant():
+    # same semantics through the compiled (column, non-folded) path
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    r = LocalQueryRunner(catalog="memory", schema="default", target_splits=2)
+    r.execute("create table tzc (x timestamp with time zone)")
+    r.execute("insert into tzc values (timestamp '2020-03-01 10:30:00 +05:30')")
+    rows = r.execute("select cast(x as timestamp) from tzc").rows
+    assert rows == [(datetime.datetime(2020, 3, 1, 10, 30),)]

@@ -18,7 +18,9 @@ from trino_tpu.types import (
     DecimalType,
     DATE,
     TIMESTAMP,
+    TIMESTAMP_TZ,
     is_string_kind,
+    pack_tz,
 )
 from trino_tpu.columnar.column import Column
 from trino_tpu.columnar.batch import Batch
@@ -37,6 +39,11 @@ def _to_device_scalar(v, t: Type):
         return (v - _EPOCH_DATE).days
     if t is TIMESTAMP and isinstance(v, datetime.datetime):
         return int((v - _EPOCH_TS).total_seconds() * 1_000_000)
+    if t is TIMESTAMP_TZ and isinstance(v, datetime.datetime):
+        off = v.utcoffset()
+        off_min = int(off.total_seconds() // 60) if off is not None else 0
+        utc = v.replace(tzinfo=None) - datetime.timedelta(minutes=off_min)
+        return pack_tz(int((utc - _EPOCH_TS).total_seconds() * 1000), off_min)
     return v
 
 

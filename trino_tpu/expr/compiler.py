@@ -72,6 +72,11 @@ class ExprCompiler:
     def __init__(self, batch: Batch):
         self.batch = batch
         self.capacity = batch.capacity
+        # DAG memo: rewrites (e.g. concat_ws's threaded accumulator) reference
+        # the same sub-Expr OBJECT many times; without this, trace cost is
+        # exponential in the sharing depth.  Keyed on the lambda context too,
+        # because the same Expr compiles differently inside a lambda body.
+        self._memo: dict = {}
 
     # -- public entry points -------------------------------------------------
 
@@ -106,12 +111,28 @@ class ExprCompiler:
             return Val(c.data, c.valid, expr.type, c.dictionary, c.lengths)
         if isinstance(expr, Literal):
             return self._literal(expr)
-        if isinstance(expr, SpecialForm):
-            return self._form(expr)
-        if isinstance(expr, Call):
-            from trino_tpu.expr.functions import dispatch
+        if isinstance(expr, (SpecialForm, Call)):
+            env = getattr(self, "_lambda_env", None)
+            key = (
+                id(expr),
+                id(env),
+                getattr(self, "_lambda_shape", None),
+            )
+            hit = self._memo.get(key)
+            # the entry pins BOTH id()-keyed objects (expr and lambda env):
+            # id() keys are only valid while the object is alive, and this
+            # memo outlives one compile call — a recycled address must miss,
+            # not return a stale Val from a freed scope
+            if hit is not None and hit[0] is expr and hit[1] is env:
+                return hit[2]
+            if isinstance(expr, SpecialForm):
+                v = self._form(expr)
+            else:
+                from trino_tpu.expr.functions import dispatch
 
-            return dispatch(self, expr)
+                v = dispatch(self, expr)
+            self._memo[key] = (expr, env, v)
+            return v
         raise NotImplementedError(f"cannot compile {expr!r}")
 
     def column(self, expr: Expr) -> Column:
@@ -212,7 +233,14 @@ class ExprCompiler:
 
     def _form_is_null(self, f: SpecialForm) -> Val:
         v = self.value(f.args[0])
-        shp = jnp.shape(v.data) if jnp.ndim(v.data) > 1 else self.bshape()
+        # Array/map values carry [capacity, K] data but PER-ROW validity
+        # (lengths is set) — IS NULL is a row predicate, so keep the row
+        # shape.  Only a lambda matrix context (ndim>1, lengths None) has
+        # genuinely 2-D validity.
+        if jnp.ndim(v.data) > 1 and v.lengths is None:
+            shp = jnp.shape(v.data)
+        else:
+            shp = self.bshape()
         return Val(~_valid_arr(v.valid, shp), None, T.BOOLEAN)
 
     def _form_if(self, f: SpecialForm) -> Val:
@@ -256,6 +284,14 @@ class ExprCompiler:
         merged = dicts[0]
         for d in dicts[1:]:
             if d is not merged and d != merged:
+                if len(merged) + len(d) > (1 << 20):
+                    # same materialization bound as concat's cross-product
+                    # path: fail fast instead of letting an IF chain double
+                    # its dictionary into the gigabytes
+                    raise NotImplementedError(
+                        "string branch dictionary merge exceeds the "
+                        f"materialization bound ({len(merged)}+{len(d)})"
+                    )
                 merged = StringDictionary.from_unsorted(merged.values + d.values)
         return merged
 

@@ -16,13 +16,36 @@ def _lit_value(e: Expr):
     raise ValueError("not a literal")
 
 
-def try_fold(e: Expr) -> Expr:
-    """Best-effort: fold arithmetic/comparison/cast over literal children."""
-    kids = [try_fold(k) for k in e.children()]
+def try_fold(e: Expr, _memo: dict = None) -> Expr:
+    """Best-effort: fold arithmetic/comparison/cast over literal children.
+
+    Memoized by sub-Expr identity: rewrites (concat_ws, CASE chains) emit
+    DAGs where the same object is referenced many times — a plain recursion
+    would be exponential in the sharing depth."""
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(e))
+    if hit is not None:
+        return hit
+    out = _try_fold_uncached(e, _memo)
+    _memo[id(e)] = out
+    return out
+
+
+def _try_fold_uncached(e: Expr, _memo: dict) -> Expr:
+    kids = [try_fold(k, _memo) for k in e.children()]
     if kids:
         e = e.with_children(kids)
     if isinstance(e, Literal):
         return e
+    # Short-circuit form folding BEFORE the all-literal gate: IF/AND/OR can
+    # collapse on a literal condition alone, which is what keeps rewrites
+    # like concat_ws's threaded accumulator from reaching the compiler as a
+    # dictionary-doubling IF chain when the inputs are constants.
+    if isinstance(e, SpecialForm):
+        folded = _fold_form(e, kids)
+        if folded is not None:
+            return folded
     if not all(isinstance(k, Literal) for k in kids):
         return e
     try:
@@ -32,6 +55,10 @@ def try_fold(e: Expr) -> Expr:
                 # format renders null arguments as 'null' text under %s
                 # (Java formatter semantics), so it must not null-fold
                 return Literal(None, e.type)
+            if e.name in ("concat", "$concat") and all(
+                isinstance(v, str) for v in vals
+            ):
+                return Literal("".join(vals), e.type)
             if e.name == "$neg":
                 return Literal(-vals[0], e.type)
             if e.name in ("$add", "$sub", "$mul", "$div"):
@@ -77,7 +104,10 @@ def try_fold(e: Expr) -> Expr:
                 # packed-tz bits are not interchangeable with plain temporal
                 # encodings; fold the conversions explicitly
                 if frm is T.TIMESTAMP_TZ and e.type is T.TIMESTAMP:
-                    return Literal(T.unpack_tz_millis(int(v)) * 1000, e.type)
+                    local = T.unpack_tz_millis(int(v)) + T.unpack_tz_offset(
+                        int(v)
+                    ) * 60_000
+                    return Literal(local * 1000, e.type)
                 if frm is T.TIMESTAMP_TZ and e.type is T.DATE:
                     local = T.unpack_tz_millis(int(v)) + T.unpack_tz_offset(
                         int(v)
@@ -92,6 +122,59 @@ def try_fold(e: Expr) -> Expr:
     except (ValueError, TypeError, ArithmeticError):
         return e
     return e
+
+
+def _fold_form(e: SpecialForm, kids: list):
+    """Kleene/short-circuit folding over partially-literal form args.
+    Returns a replacement Expr or None (no simplification)."""
+    f = e.form
+    if f == Form.IS_NULL and isinstance(kids[0], Literal):
+        return Literal(kids[0].value is None, T.BOOLEAN)
+    if f == Form.NOT and isinstance(kids[0], Literal):
+        v = kids[0].value
+        return Literal(None if v is None else (not bool(v)), T.BOOLEAN)
+    if f == Form.IF and isinstance(kids[0], Literal):
+        cond = kids[0].value
+        if cond:
+            return kids[1]
+        return kids[2] if len(kids) > 2 else Literal(None, e.type)
+    if f in (Form.AND, Form.OR):
+        dominant = False if f == Form.AND else True
+        keep, saw_null = [], False
+        for k in kids:
+            if isinstance(k, Literal):
+                if k.value is None:
+                    saw_null = True
+                elif bool(k.value) == dominant:
+                    return Literal(dominant, T.BOOLEAN)
+                # neutral literal: drop
+            else:
+                keep.append(k)
+        if not keep:
+            return Literal(None if saw_null else (not dominant), T.BOOLEAN)
+        if saw_null:
+            return None  # NULL arm must survive for kleene eval
+        if len(keep) == 1:
+            return keep[0]
+        if len(keep) < len(kids):
+            return SpecialForm(f, keep, T.BOOLEAN)
+        return None
+    if f == Form.COALESCE:
+        out = []
+        for k in kids:
+            if isinstance(k, Literal) and k.value is None:
+                continue
+            out.append(k)
+            if isinstance(k, Literal):
+                break
+        if not out:
+            return Literal(None, e.type)
+        if len(out) == 1 and out[0].type == e.type:
+            return out[0]
+        if len(out) < len(kids):
+            return SpecialForm(Form.COALESCE, out, e.type)
+        return None
+    return None
 
 
 def _to_py(lit: Literal):

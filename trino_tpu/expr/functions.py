@@ -1113,8 +1113,11 @@ def compile_cast(ctx: ExprCompiler, v: Val, to: T.Type) -> Val:
     # timestamptz conversions (session zone = UTC; reference:
     # DateTimeOperators cast family over packed values)
     if frm is T.TIMESTAMP_TZ and to is T.TIMESTAMP:
-        millis = T.unpack_tz_millis(jnp.asarray(v.data, jnp.int64))
-        return Val(millis * 1000, v.valid, to)
+        # keep the wall clock in the value's zone (reference: cast drops the
+        # zone, not the offset), matching the tz->date path below
+        p = jnp.asarray(v.data, jnp.int64)
+        local = T.unpack_tz_millis(p) + T.unpack_tz_offset(p) * 60_000
+        return Val(local * 1000, v.valid, to)
     if frm is T.TIMESTAMP_TZ and to is T.DATE:
         p = jnp.asarray(v.data, jnp.int64)
         local = (T.unpack_tz_millis(p) + T.unpack_tz_offset(p) * 60_000) * 1000
@@ -1511,6 +1514,62 @@ def _render_tz(millis: int, offset_minutes: int) -> str:
     sign = "+" if offset_minutes >= 0 else "-"
     om = abs(offset_minutes)
     return f"{dt.isoformat(sep=' ')} {sign}{om // 60:02d}:{om % 60:02d}"
+
+
+@register("concat_ws")
+def _concat_ws_eager(ctx, call, sep, *parts):
+    """concat_ws(sep, v1, ..., vn) for MANY string columns — eager host
+    render per row (EAGER_FUNCS), because the compiled concat chain would
+    materialize cross-product dictionaries.  Reference:
+    operator/scalar/ConcatWsFunction.java (NULL values skipped, NULL
+    separator -> NULL).  The <=2-column case is rewritten by the analyzer
+    into compiled IF/concat forms and never reaches here."""
+    import jax
+
+    cap = ctx.capacity
+    if any(
+        isinstance(jnp.asarray(a.data), jax.core.Tracer)
+        for a in (sep,) + tuple(parts)
+    ):
+        raise NotImplementedError(
+            "concat_ws is not supported in this expression context"
+        )
+
+    def _strings_of(v):
+        if v.is_literal_null:
+            return [None] * cap
+        d = np.asarray(jnp.broadcast_to(jnp.asarray(v.data), (cap,)))
+        va = (
+            np.asarray(jnp.broadcast_to(jnp.asarray(v.valid), (cap,)))
+            if v.valid is not None
+            else np.ones(cap, dtype=bool)
+        )
+        vals = v.dictionary.values if v.dictionary is not None else None
+        out = []
+        for i in range(cap):
+            if not va[i]:
+                out.append(None)
+            elif vals is not None:
+                c = int(d[i])
+                out.append(vals[c] if 0 <= c < len(vals) else "")
+            else:
+                out.append(str(d[i]))
+        return out
+
+    sep_s = _strings_of(sep)
+    part_s = [_strings_of(p) for p in parts]
+    outs, valid = [], np.ones(cap, dtype=bool)
+    for i in range(cap):
+        if sep_s[i] is None:
+            valid[i] = False
+            outs.append("")
+            continue
+        outs.append(sep_s[i].join(p[i] for p in part_s if p[i] is not None))
+    from trino_tpu.columnar import StringDictionary
+
+    nd = StringDictionary.from_unsorted(outs)
+    codes = jnp.asarray(np.asarray(nd.encode(outs), np.int32))
+    return Val(codes, None if valid.all() else jnp.asarray(valid), call.type, nd)
 
 
 @register("format")

@@ -13,6 +13,27 @@ from typing import Any, Sequence
 from trino_tpu.types import Type, BOOLEAN
 
 
+#: render budget for expression __repr__: a shared DAG would otherwise
+#: expand to an exponential-size string in EXPLAIN / plan rendering
+_REPR_BUDGET = 2000
+
+
+def _render(e: "Expr", budget: list) -> str:
+    if budget[0] <= 0:
+        return "\u2026"
+    budget[0] -= 1
+    return e._render(budget)
+
+
+#: hash-consing table: flat structural key -> small int id.  Composite keys
+#: reference children by interned id, so a key stays FLAT (O(node arity))
+#: even when the expression is a deeply shared DAG — a naive recursive key
+#: would expand the DAG into an exponential-size tree (concat_ws's threaded
+#: accumulator, CASE chains).  Process-level, like the jitted-step caches
+#: that consume these keys.
+_KEY_IDS: dict = {}
+
+
 class Expr:
     type: Type
 
@@ -24,14 +45,40 @@ class Expr:
         return self
 
     # structural equality for optimizer rules
-    def key(self):
+    def _compute_key(self):
         raise NotImplementedError
+
+    def key(self):
+        """Flat structural key (cached; children appear as interned ids)."""
+        k = getattr(self, "_key", None)
+        if k is None:
+            k = self._compute_key()
+            self._key = k
+        return k
+
+    def key_id(self) -> int:
+        """Interned id of this node's structural key."""
+        i = getattr(self, "_key_id", None)
+        if i is None:
+            k = self.key()
+            i = _KEY_IDS.get(k)
+            if i is None:
+                i = len(_KEY_IDS)
+                _KEY_IDS[k] = i
+            self._key_id = i
+        return i
 
     def __eq__(self, other):
         return isinstance(other, Expr) and self.key() == other.key()
 
     def __hash__(self):
         return hash(self.key())
+
+    def _render(self, budget: list) -> str:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return _render(self, [_REPR_BUDGET])
 
 
 class InputRef(Expr):
@@ -43,10 +90,10 @@ class InputRef(Expr):
         self.channel = channel
         self.type = type
 
-    def key(self):
+    def _compute_key(self):
         return ("input", self.channel, self.type.name)
 
-    def __repr__(self):
+    def _render(self, budget):
         return f"#{self.channel}:{self.type.name}"
 
 
@@ -61,10 +108,10 @@ class SymbolRef(Expr):
         self.name = name
         self.type = type
 
-    def key(self):
+    def _compute_key(self):
         return ("sym", self.name, self.type.name)
 
-    def __repr__(self):
+    def _render(self, budget):
         return f"${self.name}:{self.type.name}"
 
 
@@ -83,10 +130,13 @@ class Literal(Expr):
     def is_null(self) -> bool:
         return self.value is None
 
-    def key(self):
-        return ("lit", self.value, self.type.name)
+    def _compute_key(self):
+        v = self.value
+        if isinstance(v, (list, dict)):  # array/map literals: hashable form
+            v = repr(v)
+        return ("lit", v, self.type.name)
 
-    def __repr__(self):
+    def _render(self, budget):
         return f"{self.value!r}:{self.type.name}"
 
 
@@ -101,10 +151,10 @@ class LambdaParam(Expr):
         self.name = name
         self.type = type
 
-    def key(self):
+    def _compute_key(self):
         return ("lparam", self.name, self.type.name)
 
-    def __repr__(self):
+    def _render(self, budget):
         return f"λ{self.name}:{self.type.name}"
 
 
@@ -125,11 +175,11 @@ class Lambda(Expr):
     def with_children(self, children):
         return Lambda(self.params, children[0], self.type)
 
-    def key(self):
-        return ("lambda", self.params, self.body.key(), self.type.name)
+    def _compute_key(self):
+        return ("lambda", self.params, self.body.key_id(), self.type.name)
 
-    def __repr__(self):
-        return f"({', '.join(self.params)}) -> {self.body!r}"
+    def _render(self, budget):
+        return f"({', '.join(self.params)}) -> {_render(self.body, budget)}"
 
 
 class Call(Expr):
@@ -148,11 +198,11 @@ class Call(Expr):
     def with_children(self, children):
         return Call(self.name, tuple(children), self.type)
 
-    def key(self):
-        return ("call", self.name, tuple(a.key() for a in self.args), self.type.name)
+    def _compute_key(self):
+        return ("call", self.name, tuple(a.key_id() for a in self.args), self.type.name)
 
-    def __repr__(self):
-        return f"{self.name}({', '.join(map(repr, self.args))})"
+    def _render(self, budget):
+        return f"{self.name}({', '.join(_render(a, budget) for a in self.args)})"
 
 
 class Form(enum.Enum):
@@ -188,11 +238,11 @@ class SpecialForm(Expr):
     def with_children(self, children):
         return SpecialForm(self.form, tuple(children), self.type)
 
-    def key(self):
-        return ("form", self.form.value, tuple(a.key() for a in self.args), self.type.name)
+    def _compute_key(self):
+        return ("form", self.form.value, tuple(a.key_id() for a in self.args), self.type.name)
 
-    def __repr__(self):
-        return f"{self.form.value}({', '.join(map(repr, self.args))})"
+    def _render(self, budget):
+        return f"{self.form.value}({', '.join(_render(a, budget) for a in self.args)})"
 
 
 # -- convenience constructors used throughout the planner --------------------
@@ -242,19 +292,39 @@ def comparison(op: str, left: Expr, right: Expr) -> Expr:
                  "<=": "$le", ">": "$gt", ">=": "$ge"}[op], [left, right], BOOLEAN)
 
 
-def visit(expr: Expr, fn) -> Expr:
-    """Bottom-up rewrite: fn applied to every node after its children."""
+def visit(expr: Expr, fn, _memo: dict = None) -> Expr:
+    """Bottom-up rewrite: fn applied to every node after its children.
+
+    Memoized by node identity: planner rewrites produce DAGs where the same
+    sub-Expr object is referenced many times (concat_ws's threaded
+    accumulator, CASE chains); an unmemoized walk is exponential in the
+    sharing depth AND un-shares the DAG for every downstream pass."""
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(expr))
+    if hit is not None:
+        return hit
+    out = expr
     kids = expr.children()
     if kids:
-        expr = expr.with_children([visit(k, fn) for k in kids])
-    return fn(expr)
+        out = expr.with_children([visit(k, fn, _memo) for k in kids])
+    out = fn(out)
+    _memo[id(expr)] = out
+    return out
 
 
-def collect_input_channels(expr: Expr, acc: set | None = None) -> set:
+def collect_input_channels(
+    expr: Expr, acc: set | None = None, _seen: set | None = None
+) -> set:
     if acc is None:
         acc = set()
+    if _seen is None:
+        _seen = set()
+    if id(expr) in _seen:
+        return acc
+    _seen.add(id(expr))
     if isinstance(expr, InputRef):
         acc.add(expr.channel)
     for k in expr.children():
-        collect_input_channels(k, acc)
+        collect_input_channels(k, acc, _seen)
     return acc

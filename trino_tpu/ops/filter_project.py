@@ -20,13 +20,18 @@ from trino_tpu.expr.ir import Call, Expr
 
 #: functions that must evaluate eagerly (host-side per-row rendering):
 #: projections containing one run the step unjitted
-EAGER_FUNCS = frozenset({"array_join", "format"})
+EAGER_FUNCS = frozenset({"array_join", "format", "concat_ws"})
 
 
-def _needs_eager(e: Expr) -> bool:
+def _needs_eager(e: Expr, _seen: set = None) -> bool:
+    if _seen is None:
+        _seen = set()
+    if id(e) in _seen:  # shared-DAG guard (see ir.visit)
+        return False
+    _seen.add(id(e))
     if isinstance(e, Call) and e.name in EAGER_FUNCS:
         return True
-    return any(_needs_eager(c) for c in e.children())
+    return any(_needs_eager(c, _seen) for c in e.children())
 
 
 #: process-level jitted-step cache, keyed by expression structure — operator

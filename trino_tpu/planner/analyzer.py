@@ -419,27 +419,46 @@ class ExprAnalyzer:
         if n.name == "try":
             return SpecialForm(Form.TRY, [self.analyze(n.args[0])], T.UNKNOWN)
         if n.name == "concat_ws":
-            # reference: ConcatWsFunction — NULL values are SKIPPED, not
-            # propagated; rewritten into conditional pairwise concats.
-            # (A leading NULL leaves a leading separator — documented edge.)
+            # reference: ConcatWsFunction — NULL values are SKIPPED entirely
+            # (no separator emitted for them, even in first position).
+            # Rewritten into conditional pairwise concats with an "emitted
+            # anything yet" boolean threaded through as an expression.
             if len(n.args) < 2:
                 raise AnalysisError("concat_ws needs a separator and values")
             sep = self.analyze(n.args[0])
             parts = [self.analyze(a) for a in n.args[1:]]
+            # Many non-literal string parts: the compiled IF/concat chain
+            # would build cross-product dictionaries (doubling per part), so
+            # route through the eager per-row host renderer instead (same
+            # escape hatch as format()/array_join).
+            if sum(1 for p in parts if not isinstance(p, Literal)) > 2:
+                return Call("concat_ws", [sep] + parts, T.VARCHAR)
             empty = Literal("", T.VARCHAR)
-            out = SpecialForm(Form.COALESCE, [parts[0], empty], T.VARCHAR)
-            for pexp in parts[1:]:
-                piece = SpecialForm(
+            out: Expr = empty
+            emitted: Expr = Literal(False, T.BOOLEAN)
+            for pexp in parts:
+                non_null = ir.not_(SpecialForm(Form.IS_NULL, [pexp], T.BOOLEAN))
+                appended = SpecialForm(
                     Form.IF,
                     [
-                        ir.not_(SpecialForm(Form.IS_NULL, [pexp], T.BOOLEAN)),
-                        Call("concat", [sep, pexp], T.VARCHAR),
-                        empty,
+                        emitted,
+                        Call("concat", [out, Call("concat", [sep, pexp], T.VARCHAR)], T.VARCHAR),
+                        pexp,
                     ],
                     T.VARCHAR,
                 )
-                out = Call("concat", [out, piece], T.VARCHAR)
-            return out
+                out = SpecialForm(Form.IF, [non_null, appended, out], T.VARCHAR)
+                emitted = SpecialForm(Form.OR, [emitted, non_null], T.BOOLEAN)
+            # NULL separator -> NULL result (reference: ConcatWsFunction)
+            return SpecialForm(
+                Form.IF,
+                [
+                    ir.not_(SpecialForm(Form.IS_NULL, [sep], T.BOOLEAN)),
+                    out,
+                    Literal(None, T.VARCHAR),
+                ],
+                T.VARCHAR,
+            )
         if n.name in ("transform", "filter", "any_match", "all_match", "none_match"):
             # array lambda functions (reference: operator/scalar/
             # ArrayTransformFunction, ArrayFilterFunction, ArraysMatch*)

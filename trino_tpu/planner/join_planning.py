@@ -29,13 +29,18 @@ def split_conjuncts_ir(e: Expr) -> list:
     return [e]
 
 
-def collect_symbol_names(e: Expr, acc=None) -> set:
+def collect_symbol_names(e: Expr, acc=None, _seen=None) -> set:
     if acc is None:
         acc = set()
+    if _seen is None:
+        _seen = set()
+    if id(e) in _seen:  # shared-DAG guard (see ir.visit)
+        return acc
+    _seen.add(id(e))
     if isinstance(e, SymbolRef):
         acc.add(e.name)
     for k in e.children():
-        collect_symbol_names(k, acc)
+        collect_symbol_names(k, acc, _seen)
     return acc
 
 
@@ -61,16 +66,27 @@ def _equi_edge(c: Expr, sym2src: dict):
     return (sa, P.Symbol(a.name, a.type), sb, P.Symbol(b.name, b.type))
 
 
-def extract_common_or_conjuncts(e: Expr) -> Expr:
+def extract_common_or_conjuncts(e: Expr, _memo: dict = None) -> Expr:
     """OR(a AND b AND x1, a AND b AND x2) -> a AND b AND OR(x1, x2).
 
     Reference: sql/planner/iterative/rule/ExtractCommonPredicatesExpression
     Rewriter — without this, TPC-DS Q13/Q48-style predicates keep their join
     equalities trapped inside OR disjuncts and the comma join list degrades
-    to a cross product."""
+    to a cross product.  Memoized by node identity (shared-DAG guard)."""
+    if _memo is None:
+        _memo = {}
+    hit = _memo.get(id(e))
+    if hit is not None:
+        return hit
+    out = _extract_common_uncached(e, _memo)
+    _memo[id(e)] = out
+    return out
+
+
+def _extract_common_uncached(e: Expr, _memo: dict) -> Expr:
     kids = e.children()
     if kids:
-        e = e.with_children([extract_common_or_conjuncts(k) for k in kids])
+        e = e.with_children([extract_common_or_conjuncts(k, _memo) for k in kids])
     if not (isinstance(e, SpecialForm) and e.form == Form.OR):
         return e
     arms = [split_conjuncts_ir(a) for a in e.args]

@@ -876,9 +876,10 @@ class LocalQueryRunner:
              target match.
 
         First-match-wins across clauses is a nested IF chain, exactly the
-        searched-CASE the reference plans.  A source row matching multiple
-        target rows follows join semantics (the reference raises; detecting
-        that would need a count aggregation per target key)."""
+        searched-CASE the reference plans.  A target row matched by more than
+        one source row is a cardinality violation (reference:
+        MERGE_TARGET_ROW_MULTIPLE_MATCHES); detected by comparing the join
+        pair count against the count of distinct matched target rows."""
         cat, schema, table = self._resolve_table(stmt.target)
         conn = self.catalogs.get(cat)
         if not conn.supports_writes():
@@ -951,6 +952,7 @@ class LocalQueryRunner:
             res = self._run_query(
                 ast.Query(ast.QuerySpec(tuple(items), join, None, (), None))
             )
+            n_join_pairs = len(res.rows)
             for r in res.rows:
                 keep, hit = r[-2], r[-1]
                 if hit:
@@ -982,6 +984,31 @@ class LocalQueryRunner:
                 )
             )
         )
+        if matched_cases:
+            # Cardinality check: part 1 emitted one row per (target, source)
+            # join pair.  #pairs > #matched-target-rows means some target
+            # row was matched by >1 source row.
+            n_target = self._run_query(
+                ast.Query(
+                    ast.QuerySpec(
+                        (
+                            ast.SelectItem(
+                                ast.FunctionCall("count", (), is_star=True)
+                            ),
+                        ),
+                        tgt_rel,
+                        None,
+                        (),
+                        None,
+                    )
+                )
+            ).rows[0][0]
+            n_matched = int(n_target) - len(kept.rows)
+            if n_join_pairs > n_matched:
+                raise ValueError(
+                    "MERGE: one target table row matched more than one "
+                    "source row (MERGE_TARGET_ROW_MULTIPLE_MATCHES)"
+                )
 
         # -- part 3: WHEN NOT MATCHED inserts ---------------------------------
         insert_rows: list = []
