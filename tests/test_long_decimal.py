@@ -1,0 +1,280 @@
+"""Long decimal (precision 19-38, two-limb i128) tests.
+
+Reference: core/trino-spi/.../spi/type/Int128Math.java semantics +
+TestDecimalOperators/TestDecimalAggregation coverage; round-4 verdict
+Missing #3 (the silent precision>18 clamp was a wrong-results landmine).
+"""
+
+from decimal import Decimal
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture()
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    r = LocalQueryRunner(catalog="memory", schema="default", target_splits=2)
+    r.execute("create table big (k bigint, v decimal(38,2))")
+    r.execute(
+        "insert into big values "
+        "(1, decimal '12345678901234567890.12'), "
+        "(1, decimal '98765432109876543210.88'), "
+        "(2, decimal '-5.00'), (2, null)"
+    )
+    return r
+
+
+def test_literal_roundtrip(runner):
+    rows = runner.execute(
+        "select cast('99999999999999999999999999999999999999' as decimal(38,0)), "
+        "decimal '-12345678901234567890123456.789012'"
+    ).rows
+    assert rows == [
+        (
+            Decimal("99999999999999999999999999999999999999"),
+            Decimal("-12345678901234567890123456.789012"),
+        )
+    ]
+
+
+def test_add_sub_exact(runner):
+    rows = runner.execute(
+        "select cast('99999999999999999999.25' as decimal(38,2)) + "
+        "cast('0.75' as decimal(38,2)), "
+        "cast('10000000000000000000.00' as decimal(38,2)) - "
+        "cast('0.01' as decimal(38,2))"
+    ).rows
+    assert rows == [
+        (Decimal("100000000000000000000.00"), Decimal("9999999999999999999.99"))
+    ]
+
+
+def test_negation_and_compare(runner):
+    rows = runner.execute(
+        "select -cast('12345678901234567890.12' as decimal(38,2)), "
+        "cast('12345678901234567890.12' as decimal(38,2)) > "
+        "cast('12345678901234567890.11' as decimal(38,2))"
+    ).rows
+    assert rows == [(Decimal("-12345678901234567890.12"), True)]
+
+
+def test_ctas_scan_roundtrip(runner):
+    assert sorted(
+        runner.execute("select v from big where v is not null").rows
+    ) == [
+        (Decimal("-5.00"),),
+        (Decimal("12345678901234567890.12"),),
+        (Decimal("98765432109876543210.88"),),
+    ]
+
+
+def test_grouped_sum_exact(runner):
+    rows = runner.execute(
+        "select k, sum(v), count(v) from big group by k order by k"
+    ).rows
+    assert rows == [
+        (1, Decimal("111111111011111111101.00"), 2),
+        (2, Decimal("-5.00"), 1),
+    ]
+
+
+def test_global_agg_family(runner):
+    rows = runner.execute(
+        "select sum(v), avg(v), min(v), max(v) from big"
+    ).rows
+    assert rows == [
+        (
+            Decimal("111111111011111111096.00"),
+            # 111111111011111111096.00 / 3, round half up at scale 2
+            Decimal("37037037003703703698.67"),
+            Decimal("-5.00"),
+            Decimal("98765432109876543210.88"),
+        )
+    ]
+
+
+def test_short_decimal_sum_widens_exactly(runner):
+    # SUM over short decimals is typed decimal(38, s) with an exact Int128
+    # state: 12 copies of 9e17 overflow i64 (1.08e19 > 9.2e18)
+    runner.execute("create table w (v decimal(18,0))")
+    runner.execute(
+        "insert into w values " + ", ".join(["(900000000000000000)"] * 12)
+    )
+    rows = runner.execute("select sum(v) from w").rows
+    assert rows == [(Decimal("10800000000000000000"),)]
+
+
+def test_order_by_long(runner):
+    rows = runner.execute(
+        "select v from big order by v desc nulls last"
+    ).rows
+    assert rows == [
+        (Decimal("98765432109876543210.88"),),
+        (Decimal("12345678901234567890.12"),),
+        (Decimal("-5.00"),),
+        (None,),
+    ]
+
+
+def test_where_filter_long(runner):
+    rows = runner.execute(
+        "select k from big where v > decimal '12345678901234567890.11' "
+        "order by v"
+    ).rows
+    assert rows == [(1,), (1,)]
+
+
+def test_cast_long_to_short_and_back(runner):
+    rows = runner.execute(
+        "select cast(cast('123.45' as decimal(38,2)) as decimal(10,2)), "
+        "cast(cast('123.45' as decimal(10,2)) as decimal(38,4))"
+    ).rows
+    assert rows == [(Decimal("123.45"), Decimal("123.4500"))]
+
+
+def test_cast_long_to_double_and_varchar_literal(runner):
+    rows = runner.execute(
+        "select cast(cast('12345678901234567890.50' as decimal(38,2)) as double)"
+    ).rows
+    assert abs(rows[0][0] - 1.234567890123456789e19) < 1e5
+
+
+def test_sum_distributed_partial_final():
+    # the partial/final split must merge Int128 states exactly
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    r = LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=4)
+    rows = r.execute(
+        "select sum(l_extendedprice) from lineitem"
+    ).rows
+    # engine-vs-pandas oracle
+    from trino_tpu.testing import tpch_pandas
+
+    li = tpch_pandas("tiny", "lineitem")
+    expected = Decimal(str(li["l_extendedprice"].sum())).quantize(
+        Decimal("0.01")
+    )
+    assert rows[0][0] == expected
+
+
+def test_avg_rounding_half_up(runner):
+    runner.execute("create table a2 (v decimal(38,2))")
+    runner.execute(
+        "insert into a2 values (decimal '0.01'), (decimal '0.02')"
+    )
+    # 0.03 / 2 = 0.015 -> rounds half away from zero to 0.02
+    assert runner.execute("select avg(v) from a2").rows == [
+        (Decimal("0.02"),)
+    ]
+
+
+def test_long_mul_div_mod(runner):
+    rows = runner.execute(
+        "select cast('12345678901234567890.12' as decimal(38,2)) * 2, "
+        "cast('12345678901234567890.12' as decimal(38,2)) * decimal '-1.5', "
+        "cast('12345678901234567890.12' as decimal(38,2)) % decimal '7.00'"
+    ).rows
+    assert rows[0][0] == Decimal("24691357802469135780.24")
+    assert rows[0][1] == Decimal("-18518518351851851835.180")
+    # 1234567890123456789012 % 700 = 412 -> 4.12
+    assert rows[0][2] == Decimal((1234567890123456789012 % 700)).scaleb(-2)
+
+
+def test_short_mul_widens_to_long(runner):
+    # (18,0) * (18,0) types as decimal(36,0): product needs two limbs
+    rows = runner.execute(
+        "select cast(999999999999999999 as decimal(18,0)) * "
+        "cast(999999999999999999 as decimal(18,0))"
+    ).rows
+    assert rows[0][0] == Decimal(999999999999999999) * Decimal(
+        999999999999999999
+    )
+
+
+def test_cast_negative_double_to_long(runner):
+    rows = runner.execute(
+        "select cast(-2.5e0 as decimal(38,1)), cast(-1e0 as decimal(38,2))"
+    ).rows
+    assert rows == [(Decimal("-2.5"), Decimal("-1.00"))]
+
+
+def test_group_by_long_key_distributed_hash():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    r = LocalQueryRunner(catalog="memory", schema="default", target_splits=2)
+    r.execute("create table gk (v decimal(38,2), n bigint)")
+    r.execute(
+        "insert into gk values (decimal '99999999999999999999.25', 1), "
+        "(decimal '99999999999999999999.25', 2), (decimal '-5.00', 3)"
+    )
+    rows = r.execute(
+        "select v, count(*), sum(n) from gk group by v order by v"
+    ).rows
+    assert rows == [
+        (Decimal("-5.00"), 1, 3),
+        (Decimal("99999999999999999999.25"), 2, 3),
+    ]
+
+
+def test_join_on_long_decimal_key(runner):
+    runner.execute("create table j1 (k decimal(38,2), a bigint)")
+    runner.execute("create table j2 (k decimal(38,2), b bigint)")
+    runner.execute(
+        "insert into j1 values (decimal '99999999999999999999.25', 1), "
+        "(decimal '-5.00', 2)"
+    )
+    runner.execute(
+        "insert into j2 values (decimal '99999999999999999999.25', 10), "
+        "(decimal '7.00', 20)"
+    )
+    rows = runner.execute(
+        "select a, b from j1 join j2 on j1.k = j2.k"
+    ).rows
+    assert rows == [(1, 10)]
+
+
+def test_floor_ceil_round_abs_on_long_sum(runner):
+    rows = runner.execute(
+        "select floor(sum(v)), ceil(sum(v)), round(sum(v)), abs(min(v)) "
+        "from big"
+    ).rows
+    # sum = 111111111011111111096.00
+    assert rows == [
+        (
+            Decimal("111111111011111111096"),
+            Decimal("111111111011111111096"),
+            Decimal("111111111011111111096.00"),
+            Decimal("5.00"),
+        )
+    ]
+    rows = runner.execute(
+        "select floor(v), ceil(v) from big where k = 2 and v is not null"
+    ).rows
+    assert rows == [(Decimal("-5"), Decimal("-5"))]
+    rows = runner.execute(
+        "select floor(cast('-2.5' as decimal(38,1))), "
+        "ceil(cast('-2.5' as decimal(38,1))), "
+        "round(cast('-2.5' as decimal(38,1)))"
+    ).rows
+    assert rows == [(Decimal("-3"), Decimal("-2"), Decimal("-3.0"))]
+
+
+def test_greatest_least_long(runner):
+    rows = runner.execute(
+        "select greatest(max(v), decimal '5.00'), least(min(v), sum(v)) "
+        "from big"
+    ).rows
+    assert rows == [
+        (Decimal("98765432109876543210.88"), Decimal("-5.00"))
+    ]
+
+
+def test_union_long_with_bigint(runner):
+    rows = runner.execute(
+        "select v from (select v from big where k = 2 and v is not null "
+        "union all select cast(3 as bigint)) t(v) order by v"
+    ).rows
+    assert rows == [(Decimal("-5.00"),), (Decimal("3.00"),)]

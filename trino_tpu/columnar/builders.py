@@ -8,7 +8,7 @@ data (python rows, numpy arrays, pandas frames) into device Batches.
 from __future__ import annotations
 
 import datetime
-from decimal import Decimal
+from decimal import Context, Decimal
 from typing import Optional, Sequence
 
 import numpy as np
@@ -43,7 +43,10 @@ def _to_device_scalar(v, t: Type):
         off = v.utcoffset()
         off_min = int(off.total_seconds() // 60) if off is not None else 0
         utc = v.replace(tzinfo=None) - datetime.timedelta(minutes=off_min)
-        return pack_tz(int((utc - _EPOCH_TS).total_seconds() * 1000), off_min)
+        # timedelta floor-division: float total_seconds()*1000 truncates
+        # toward zero, putting every pre-epoch fractional value 1 ms high
+        millis = (utc - _EPOCH_TS) // datetime.timedelta(milliseconds=1)
+        return pack_tz(millis, off_min)
     return v
 
 
@@ -57,6 +60,29 @@ def column_from_values(values: Sequence, t: Type) -> Column:
         d = StringDictionary(present)
         codes = d.encode([v if v is not None else None for v in values])
         return Column(codes, t, valid, d)
+    if isinstance(t, DecimalType) and t.is_long:
+        # long decimal: [n, 2] int64 limb planes (types/int128.py)
+        from trino_tpu.types.int128 import split_py
+
+        arr2 = np.zeros((n, 2), dtype=np.int64)
+        # explicit wide context: the default 28-digit context would round
+        # 29+ digit values during scaleb
+        ctx = Context(prec=60)
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            if isinstance(v, Decimal):
+                scaled = int(
+                    v.scaleb(t.scale, context=ctx).to_integral_value(
+                        context=ctx
+                    )
+                )
+            elif isinstance(v, int):
+                scaled = v * t.scale_factor  # exact python-int path
+            else:
+                scaled = int(round(float(v) * t.scale_factor))
+            arr2[i, 0], arr2[i, 1] = split_py(scaled)
+        return Column(arr2, t, valid)
     # fast path: plain python numbers convert in one C-level call (also what
     # makes the scaled-writer thread pool worthwhile — the conversion runs
     # outside the GIL's per-object churn)

@@ -152,6 +152,25 @@ class ExprCompiler:
             elif v.valid is not None:
                 valid = jnp.broadcast_to(v.valid, (self.capacity,))
             return Column(data, v.type, valid, v.dictionary, lengths)
+        if isinstance(v.type, T.DecimalType) and v.type.is_long:
+            # two-limb planes: [capacity, 2]
+            d = jnp.asarray(v.data, jnp.int64)
+            if jnp.ndim(d) == 0:  # null literal fill
+                d = jnp.zeros((1, 2), jnp.int64)
+            elif jnp.ndim(d) == 1:
+                # 1-D data under a long type: short-VALUED rows (e.g. a
+                # window sum computed in i64) — widen each row to planes
+                from trino_tpu.types.int128 import widen64
+
+                h, l = widen64(d)
+                d = jnp.stack([h, l], axis=-1)
+            data = jnp.broadcast_to(d, (self.capacity, 2))
+            valid = None
+            if v.valid is False:
+                valid = jnp.zeros(self.capacity, dtype=bool)
+            elif v.valid is not None:
+                valid = jnp.broadcast_to(v.valid, (self.capacity,))
+            return Column(data, v.type, valid)
         data = jnp.broadcast_to(
             jnp.asarray(v.data, dtype=v.type.np_dtype), (self.capacity,)
         )
@@ -184,9 +203,22 @@ class ExprCompiler:
         if isinstance(lit.type, T.DecimalType):
             from decimal import Decimal
 
+            from decimal import Context
+
+            ctx = Context(prec=60)  # default 28-digit context rounds 29+
             scaled = int(
-                (Decimal(str(lit.value)) * lit.type.scale_factor).to_integral_value()
+                ctx.multiply(
+                    Decimal(str(lit.value)), Decimal(lit.type.scale_factor)
+                ).to_integral_value(context=ctx)
             )
+            if lit.type.is_long:
+                from trino_tpu.types.int128 import split_py
+
+                return Val(
+                    np.asarray([split_py(scaled)], np.int64),  # [1, 2] planes
+                    None,
+                    lit.type,
+                )
             return Val(np.int64(scaled), None, lit.type)
         return Val(lit.type.np_dtype.type(lit.value), None, lit.type)
 
@@ -234,10 +266,15 @@ class ExprCompiler:
     def _form_is_null(self, f: SpecialForm) -> Val:
         v = self.value(f.args[0])
         # Array/map values carry [capacity, K] data but PER-ROW validity
-        # (lengths is set) — IS NULL is a row predicate, so keep the row
-        # shape.  Only a lambda matrix context (ndim>1, lengths None) has
-        # genuinely 2-D validity.
-        if jnp.ndim(v.data) > 1 and v.lengths is None:
+        # (lengths is set), and long decimals carry [capacity, 2] limb
+        # planes — IS NULL is a row predicate, so keep the row shape.  Only
+        # a lambda matrix context (ndim>1, lengths None, not a long
+        # decimal) has genuinely 2-D validity.
+        if (
+            jnp.ndim(v.data) > 1
+            and v.lengths is None
+            and not (isinstance(v.type, T.DecimalType) and v.type.is_long)
+        ):
             shp = jnp.shape(v.data)
         else:
             shp = self.bshape()
@@ -256,6 +293,8 @@ class ExprCompiler:
     def _case_fold(self, pairs, default: Expr, out_type: T.Type) -> Val:
         shp = self.bshape()
         branches = [self.value(v) for _, v in pairs] + [self.value(default)]
+        if isinstance(out_type, T.DecimalType) and out_type.is_long:
+            return self._case_fold_long(pairs, branches, out_type, shp)
         out_dict = self._merge_branch_dicts(branches, out_type)
         acc = branches[-1]
         acc_data = jnp.broadcast_to(
@@ -274,6 +313,34 @@ class ExprCompiler:
             acc_data = jnp.where(ctrue, vdata, acc_data)
             acc_valid = jnp.where(ctrue, _valid_arr(v.valid, shp), acc_valid)
         return Val(acc_data, acc_valid, out_type, out_dict)
+
+    def _case_fold_long(self, pairs, branches, out_type: T.Type, shp) -> Val:
+        """CASE/IF over long-decimal branches: select on limb planes."""
+        from trino_tpu.expr.functions import _to_planes
+
+        def planes(v):
+            h, l = _to_planes(v, out_type.scale)
+            return (
+                jnp.broadcast_to(jnp.asarray(h, jnp.int64), shp),
+                jnp.broadcast_to(jnp.asarray(l, jnp.int64), shp),
+            )
+
+        acc = branches[-1]
+        acc_h, acc_l = planes(acc)
+        acc_valid = _valid_arr(acc.valid, shp)
+        for (cond_e, _), v in zip(reversed(pairs), reversed(branches[:-1])):
+            c = self.value(cond_e)
+            ctrue = jnp.logical_and(
+                jnp.broadcast_to(jnp.asarray(c.data, dtype=bool), shp),
+                _valid_arr(c.valid, shp),
+            )
+            vh, vl = planes(v)
+            acc_h = jnp.where(ctrue, vh, acc_h)
+            acc_l = jnp.where(ctrue, vl, acc_l)
+            acc_valid = jnp.where(ctrue, _valid_arr(v.valid, shp), acc_valid)
+        return Val(
+            jnp.stack([acc_h, acc_l], axis=-1), acc_valid, out_type
+        )
 
     def _merge_branch_dicts(self, vals, out_type):
         if not T.is_string_kind(out_type):

@@ -53,8 +53,48 @@ def _dec_scale(t: T.Type) -> int | None:
     return t.scale if isinstance(t, T.DecimalType) else None
 
 
+def _is_long_dec(t: T.Type) -> bool:
+    return isinstance(t, T.DecimalType) and t.is_long
+
+
+def _to_planes(v: Val, to_scale: int):
+    """Any integer/decimal Val -> (hi, lo) i128 planes rescaled to to_scale
+    (types/int128.py limb convention)."""
+    from trino_tpu.types import int128 as i128
+
+    t = v.type
+    if _is_long_dec(t):
+        d = jnp.asarray(v.data, jnp.int64)
+        if d.ndim == 0:  # null-fill scalar
+            h = jnp.int64(0)
+            l = jnp.int64(0)
+        elif d.ndim == 1:
+            # 1-D data under a long type: short-valued rows (window sums
+            # computed in i64); literals always carry [1, 2] planes
+            h, l = i128.widen64(d)
+        else:
+            h, l = d[..., 0], d[..., 1]
+        return i128.rescale128(h, l, t.scale, to_scale)
+    s = t.scale if isinstance(t, T.DecimalType) else 0
+    h, l = i128.widen64(jnp.asarray(v.data, jnp.int64))
+    return i128.rescale128(h, l, s, to_scale)
+
+
+def _planes_val(h, l, rt: T.Type, valid) -> Val:
+    """Stack (hi, lo) planes into a long-decimal Val ([..., 2])."""
+    h = jnp.asarray(h, jnp.int64)
+    l = jnp.asarray(l, jnp.int64)
+    h, l = jnp.broadcast_arrays(h, l)
+    return Val(jnp.stack([h, l], axis=-1), valid, rt)
+
+
 def _to_float(v: Val):
     """Numeric value as f64 data."""
+    if _is_long_dec(v.type):
+        from trino_tpu.types import int128 as i128
+
+        h, l = _to_planes(v, v.type.scale)
+        return i128.to_float128(h, l) / float(v.type.scale_factor)
     d = jnp.asarray(v.data)
     if isinstance(v.type, T.DecimalType):
         return d.astype(jnp.float64) / float(v.type.scale_factor)
@@ -110,9 +150,25 @@ def _result_as(call_type: T.Type, data, valid) -> Val:
 
 
 def _arith(ctx, call, a, b, int_op, float_op):
-    ad, bd, hint = _align_numeric(a, b)
     valid = _and_valid(a.valid, b.valid)
     rt = call.type
+    if (
+        (_is_long_dec(rt) or _is_long_dec(a.type) or _is_long_dec(b.type))
+        and rt.name not in ("real", "double")
+        and a.type.name not in ("real", "double")
+        and b.type.name not in ("real", "double")
+        and int_op in (jnp.add, jnp.subtract)
+    ):
+        # exact two-limb path (reference: Int128Math.add/subtract)
+        from trino_tpu.types import int128 as i128
+
+        s = rt.scale if isinstance(rt, T.DecimalType) else 0
+        ah, al = _to_planes(a, s)
+        bh, bl = _to_planes(b, s)
+        op = i128.add128 if int_op is jnp.add else i128.sub128
+        h, l = op(ah, al, bh, bl)
+        return _planes_val(h, l, rt, valid)
+    ad, bd, hint = _align_numeric(a, b)
     if rt.name in ("real", "double") or hint is T.DOUBLE:
         out = float_op(jnp.asarray(ad, jnp.float64), jnp.asarray(bd, jnp.float64))
         return Val(out, valid, T.DOUBLE if rt.name not in ("real",) else rt)
@@ -137,6 +193,36 @@ def _mul(ctx, call, a, b):
     rt = call.type
     valid = _and_valid(a.valid, b.valid)
     sa, sb = _dec_scale(a.type), _dec_scale(b.type)
+    if _is_long_dec(a.type) or _is_long_dec(b.type) or _is_long_dec(rt):
+        if a.type.name in ("real", "double") or b.type.name in ("real", "double"):
+            return Val(_to_float(a) * _to_float(b), valid, T.DOUBLE)
+        from trino_tpu.types import int128 as i128
+
+        if _is_long_dec(a.type) and _is_long_dec(b.type):
+            raise NotImplementedError(
+                "multiplication of two long decimals"
+            )
+        ls = _dec_scale(a.type) or 0
+        ss = _dec_scale(b.type) or 0
+        if not _is_long_dec(a.type) and not _is_long_dec(b.type):
+            # short x short with a long result: one exact 64x64->128
+            h, l = i128.mul64x64(
+                jnp.asarray(a.data, jnp.int64), jnp.asarray(b.data, jnp.int64)
+            )
+        else:
+            # one side rides as planes, the other as a plain i64 multiplier
+            long_v, short_v = (a, b) if _is_long_dec(a.type) else (b, a)
+            ls = _dec_scale(long_v.type) or 0
+            ss = _dec_scale(short_v.type) or 0
+            h, l = _to_planes(long_v, ls)
+            sd = jnp.asarray(short_v.data, jnp.int64)
+            h, l = i128.mul128_by_i64vec(h, l, sd)
+        prod_scale = ls + ss
+        out_scale = rt.scale if isinstance(rt, T.DecimalType) else prod_scale
+        h, l = i128.rescale128(h, l, prod_scale, out_scale)
+        if isinstance(rt, T.DecimalType) and not rt.is_long:
+            return Val(l, valid, rt)
+        return _planes_val(h, l, rt, valid)
     if sa is not None or sb is not None:
         if a.type.name in ("real", "double") or b.type.name in ("real", "double"):
             return Val(_to_float(a) * _to_float(b), valid, T.DOUBLE)
@@ -158,6 +244,38 @@ def _div(ctx, call, a, b):
     valid = _and_valid(a.valid, b.valid)
     rt = call.type
     sa, sb = _dec_scale(a.type), _dec_scale(b.type)
+    if _is_long_dec(a.type) or _is_long_dec(b.type):
+        if rt.name in ("real", "double") or b.type.name in ("real", "double"):
+            bz = _to_float(b) == 0.0
+            valid = _and_valid(valid, jnp.logical_not(bz))
+            return Val(
+                _to_float(a) / jnp.where(bz, 1.0, _to_float(b)), valid, T.DOUBLE
+            )
+        if _is_long_dec(b.type):
+            raise NotImplementedError("division by a long decimal")
+        from trino_tpu.types import int128 as i128
+
+        out_scale = rt.scale if isinstance(rt, T.DecimalType) else 0
+        # numerator scaled so quotient lands at out_scale (reference:
+        # Int128Math.divideRoundUp shift arithmetic)
+        h, l = _to_planes(a, out_scale + (sb or 0))
+        bd = jnp.asarray(b.data, jnp.int64)
+        bz = bd == 0
+        valid = _and_valid(valid, jnp.logical_not(bz))
+        den = jnp.where(bz, 1, bd)
+        neg_d = den < 0
+        den_abs = jnp.abs(den)
+        qh, ql, r = i128.divmod128_by_vec(h, l, den_abs)
+        round_up = (2 * jnp.abs(r)) >= den_abs
+        neg_q = (h < 0) ^ neg_d
+        bump = jnp.where(round_up, jnp.where(neg_q, -1, 1), 0)
+        nqh, nql = i128.neg128(qh, ql)
+        qh = jnp.where(neg_d, nqh, qh)
+        ql = jnp.where(neg_d, nql, ql)
+        qh, ql = i128.add128(qh, ql, bump >> 63, bump)
+        if isinstance(rt, T.DecimalType) and not rt.is_long:
+            return Val(ql, valid, rt)
+        return _planes_val(qh, ql, rt, valid)
     bzero = jnp.asarray(b.data) == 0
     valid = _and_valid(valid, jnp.logical_not(bzero))
     if rt.name in ("real", "double"):
@@ -187,6 +305,31 @@ def _div(ctx, call, a, b):
 @register("$mod")
 def _mod(ctx, call, a, b):
     valid = _and_valid(a.valid, b.valid)
+    if _is_long_dec(a.type) or _is_long_dec(b.type) or _is_long_dec(call.type):
+        if _is_long_dec(b.type):
+            raise NotImplementedError("mod by a long decimal")
+        from trino_tpu.types import int128 as i128
+
+        s = max(_dec_scale(a.type) or 0, _dec_scale(b.type) or 0)
+        h, l = _to_planes(a, s)
+        sb = _dec_scale(b.type) or 0
+        pb = b.type.precision if isinstance(b.type, T.DecimalType) else 19
+        if pb + (s - sb) > 18:
+            # rescaled divisor could overflow i64 (static type bound)
+            raise NotImplementedError(
+                "mod with a divisor wider than 18 digits at the common scale"
+            )
+        bd = jnp.asarray(b.data, jnp.int64) * (10 ** (s - sb))
+        bz = bd == 0
+        valid = _and_valid(valid, jnp.logical_not(bz))
+        den = jnp.abs(jnp.where(bz, 1, bd))
+        _, _, r = i128.divmod128_by_vec(h, l, den)  # sign follows dividend
+        rt = call.type
+        out_s = rt.scale if isinstance(rt, T.DecimalType) else s
+        rh, rl = i128.rescale128(*i128.widen64(r), s, out_s)
+        if isinstance(rt, T.DecimalType) and rt.is_long:
+            return _planes_val(rh, rl, rt, valid)
+        return Val(rl, valid, rt)
     bzero = jnp.asarray(b.data) == 0
     valid = _and_valid(valid, ~bzero)
     ad, bd, hint = _align_numeric(a, b)
@@ -198,6 +341,11 @@ def _mod(ctx, call, a, b):
 
 @register("$neg")
 def _neg(ctx, call, a):
+    if _is_long_dec(a.type):
+        from trino_tpu.types import int128 as i128
+
+        h, l = _to_planes(a, a.type.scale)
+        return _planes_val(*i128.neg128(h, l), call.type, a.valid)
     return Val(jnp.negative(jnp.asarray(a.data)), a.valid, call.type)
 
 
@@ -246,9 +394,31 @@ def _dict_range_cmp(op: str, col: Val, lit: str):
     raise AssertionError(op)
 
 
+def _cmp_long(op: str, a: Val, b: Val, valid) -> Val:
+    """Comparison over two-limb long decimals (either side may be short)."""
+    from trino_tpu.types import int128 as i128
+
+    s = max(_dec_scale(a.type) or 0, _dec_scale(b.type) or 0)
+    ah, al = _to_planes(a, s)
+    bh, bl = _to_planes(b, s)
+    eq = i128.eq128(ah, al, bh, bl)
+    lt = i128.lt128(ah, al, bh, bl)
+    out = {
+        "$eq": eq,
+        "$ne": ~eq,
+        "$lt": lt,
+        "$le": lt | eq,
+        "$gt": ~(lt | eq),
+        "$ge": ~lt,
+    }[op]
+    return Val(out, valid, T.BOOLEAN)
+
+
 def _comparison(op: str, jop):
     def handler(ctx, call, a, b):
         valid = _and_valid(a.valid, b.valid)
+        if _is_long_dec(a.type) or _is_long_dec(b.type):
+            return _cmp_long(op, a, b, valid)
         # string-vs-literal fast paths
         la, lb = _string_literal_of(a), _string_literal_of(b)
         if a.dictionary is not None and lb is not None and la is None:
@@ -307,6 +477,15 @@ FUNCTIONS["sign"] = lambda ctx, call, a: Val(
 
 @register("abs")
 def _abs(ctx, call, a):
+    if _is_long_dec(a.type):
+        from trino_tpu.types import int128 as i128
+
+        h, l = _to_planes(a, a.type.scale)
+        nh, nl = i128.neg128(h, l)
+        neg = h < 0
+        return _planes_val(
+            jnp.where(neg, nh, h), jnp.where(neg, nl, l), call.type, a.valid
+        )
     return Val(jnp.abs(jnp.asarray(a.data)), a.valid, call.type)
 
 
@@ -327,8 +506,31 @@ def _mod_fn(ctx, call, a, b):
     return _mod(ctx, call, a, b)
 
 
+def _floor_ceil_long(a: Val, out_t: T.Type, is_ceil: bool) -> Val:
+    """floor/ceil of a long decimal to scale 0 over limb planes."""
+    from trino_tpu.types import int128 as i128
+
+    h, l = _to_planes(a, a.type.scale)
+    qh, ql, any_r = i128.truncdiv_pow10(h, l, a.type.scale)
+    if is_ceil:
+        adj = jnp.logical_and(any_r, h >= 0).astype(jnp.int64)
+    else:
+        adj = -jnp.logical_and(any_r, h < 0).astype(jnp.int64)
+    qh, ql = i128.add128(qh, ql, adj >> 63, adj)
+    if isinstance(out_t, T.DecimalType) and out_t.is_long:
+        return _planes_val(qh, ql, out_t, a.valid)
+    return Val(ql, a.valid, out_t)
+
+
 @register("floor")
 def _floor(ctx, call, a):
+    if _is_long_dec(a.type):
+        out_t = (
+            call.type
+            if isinstance(call.type, T.DecimalType)
+            else T.DecimalType(max(a.type.precision - a.type.scale, 19), 0)
+        )
+        return _floor_ceil_long(a, out_t, is_ceil=False)
     if isinstance(a.type, T.DecimalType):
         # jnp // on ints is floor division, exactly SQL floor-to-scale-0
         d = jnp.asarray(a.data, jnp.int64) // a.type.scale_factor
@@ -341,6 +543,13 @@ def _floor(ctx, call, a):
 @register("ceil")
 @register("ceiling")
 def _ceil(ctx, call, a):
+    if _is_long_dec(a.type):
+        out_t = (
+            call.type
+            if isinstance(call.type, T.DecimalType)
+            else T.DecimalType(max(a.type.precision - a.type.scale, 19), 0)
+        )
+        return _floor_ceil_long(a, out_t, is_ceil=True)
     if isinstance(a.type, T.DecimalType):
         d = -((-jnp.asarray(a.data, jnp.int64)) // a.type.scale_factor)
         return Val(d, a.valid, T.DecimalType(18, 0))
@@ -354,6 +563,21 @@ def _round(ctx, call, a, nd=None):
     digits = 0
     if nd is not None:
         digits = int(np.asarray(nd.data))  # literal digits only
+    if _is_long_dec(a.type):
+        from trino_tpu.types import int128 as i128
+
+        s = a.type.scale
+        h, l = _to_planes(a, s)
+        h, l = i128.rescale128(h, l, s, min(s, digits))  # round half away
+        out_t = call.type
+        out_s = out_t.scale if isinstance(out_t, T.DecimalType) else digits
+        h, l = i128.rescale128(h, l, min(s, digits), out_s)
+        if isinstance(out_t, T.DecimalType) and out_t.is_long:
+            return _planes_val(h, l, out_t, a.valid)
+        if not isinstance(out_t, T.DecimalType):
+            out_t = T.DecimalType(19, out_s)
+            return _planes_val(h, l, out_t, a.valid)
+        return Val(l, a.valid, out_t)
     if isinstance(a.type, T.DecimalType):
         from trino_tpu.expr.functions import _rescale_decimal
 
@@ -377,6 +601,22 @@ def _minmax(jop):
         valid = None
         for v in vals:
             valid = _and_valid(valid, v.valid)
+        if any(_is_long_dec(v.type) for v in vals):
+            from trino_tpu.types import int128 as i128
+
+            want_max = jop is jnp.maximum
+            s = max((_dec_scale(v.type) or 0) for v in vals)
+            ah, al = _to_planes(vals[0], s)
+            for v in vals[1:]:
+                bh, bl = _to_planes(v, s)
+                lt = i128.lt128(ah, al, bh, bl)
+                take_b = lt if want_max else ~lt
+                ah = jnp.where(take_b, bh, ah)
+                al = jnp.where(take_b, bl, al)
+            rt = call.type
+            if isinstance(rt, T.DecimalType) and not rt.is_long:
+                return Val(al, valid, rt)
+            return _planes_val(ah, al, rt, valid)
         dicts = [v.dictionary for v in vals if v.dictionary is not None]
         if dicts:
             # recode everything into one union dictionary up front so codes
@@ -1071,6 +1311,39 @@ def compile_cast(ctx: ExprCompiler, v: Val, to: T.Type) -> Val:
         valid = _and_valid(v.valid, jnp.take(jnp.asarray(ok), codes, mode="clip"))
         return Val(data, valid, to)
     if isinstance(to, T.DecimalType):
+        if to.is_long:
+            # short/long/integer -> long decimal: limb planes at the target
+            # scale (reference: Int128Math.rescale)
+            if frm.name in ("double", "real"):
+                from trino_tpu.types import int128 as i128
+
+                f = _to_float(v) * to.scale_factor
+                r = jnp.sign(f) * jnp.floor(jnp.abs(f) + 0.5)
+                # f64 has 53 bits: hi limb from float division is exact
+                # enough only within 2**53; beyond that the cast is lossy
+                # exactly like the reference's double->decimal
+                h = jnp.floor(r / float(i128.TWO64)).astype(jnp.int64)
+                lf = r - h.astype(jnp.float64) * float(i128.TWO64)
+                # lf is the UNSIGNED low limb in [0, 2**64): values with the
+                # top bit set exceed int64 max, so shift into signed range
+                # before converting to recover the bit pattern
+                l = jnp.where(lf >= float(1 << 63), lf - float(i128.TWO64), lf).astype(
+                    jnp.int64
+                )
+                return _planes_val(h, l, to, v.valid)
+            h, l = _to_planes(v, to.scale)
+            return _planes_val(h, l, to, v.valid)
+        if _is_long_dec(frm):
+            # long -> short decimal: rescale in limbs, then take the low
+            # limb (values that fit precision 18 live entirely in it)
+            from trino_tpu.types import int128 as i128
+
+            h, l = _to_planes(v, to.scale)
+            fits = jnp.logical_or(
+                jnp.logical_and(h == 0, l >= 0),
+                jnp.logical_and(h == -1, l < 0),
+            )
+            return Val(l, _and_valid(v.valid, fits), to)
         if isinstance(frm, T.DecimalType):
             return Val(
                 _rescale_decimal(jnp.asarray(v.data, jnp.int64), frm.scale, to.scale),
@@ -1087,6 +1360,14 @@ def compile_cast(ctx: ExprCompiler, v: Val, to: T.Type) -> Val:
         return Val(
             jnp.asarray(v.data, jnp.int64) * to.scale_factor, v.valid, to
         )
+    if _is_long_dec(frm):
+        # long decimal -> double/bigint
+        if to.name in ("double", "real"):
+            return Val(_to_float(v), v.valid, to)
+        if to.name in ("bigint", "integer", "smallint", "tinyint"):
+            h, l = _to_planes(v, 0)
+            return Val(l.astype(to.np_dtype), v.valid, to)
+        raise NotImplementedError(f"cast {frm.name} -> {to.name}")
     if to.name in ("double", "real"):
         return Val(_to_float(v).astype(to.np_dtype), v.valid, to)
     if to.name in ("bigint", "integer", "smallint", "tinyint"):
@@ -1156,7 +1437,10 @@ def _parse_scalar(s: str, to: T.Type):
     if isinstance(to, T.DecimalType):
         from decimal import Decimal
 
-        return int(Decimal(s).scaleb(to.scale).to_integral_value())
+        from decimal import Context
+
+        _c = Context(prec=60)
+        return int(Decimal(s).scaleb(to.scale, context=_c).to_integral_value(context=_c))
     if to is T.DATE:
         import datetime
 

@@ -241,7 +241,8 @@ def _state_types(spec: AggSpec, input_types) -> list[T.Type]:
         elif kind == "sum":
             t = input_types[arg]
             if isinstance(t, T.DecimalType):
-                out.append(T.DecimalType(18, t.scale))
+                # reference: DecimalSumAggregation — Int128 state, exact
+                out.append(T.DecimalType(38, t.scale))
             elif t.name in ("double", "real"):
                 out.append(T.DOUBLE)
             else:
@@ -268,6 +269,61 @@ def _merge_primitives(spec: AggSpec):
                 else kind
             )
     return merged
+
+
+def _reduce128(d, gid, nseg: int, kind: str, valid):
+    """min/max/any over long-decimal limb planes -> [nseg, 2]."""
+    from trino_tpu.types import int128 as i128
+
+    if kind in ("min", "max"):
+        h, l = i128.segment_minmax128(
+            jnp.asarray(d[:, 0], jnp.int64),
+            jnp.asarray(d[:, 1], jnp.int64),
+            gid,
+            nseg,
+            valid,
+            kind == "max",
+        )
+        return jnp.stack([h, l], axis=-1)
+    if kind == "any":
+        n = d.shape[0]
+        idx = jnp.where(valid, jnp.arange(n, dtype=jnp.int64), n)
+        first = jax.ops.segment_min(idx, gid, nseg)
+        return jnp.take(d, jnp.clip(first, 0, n - 1), axis=0, mode="clip")
+    raise NotImplementedError(f"long decimal {kind}")
+
+
+def _sum128(d, gid, nseg: int, valid, in_precision: int = None):
+    """Exact i128 segmented sum -> [nseg, 2] limb planes.  Input is either a
+    short scaled-i64 column (1-D, widened) or long planes ([n, 2]).
+
+    Fast path: when the input's declared precision bounds every partial sum
+    inside i64 (10**p * rows < 2**63 — static per trace), ONE i64
+    segment_sum is provably exact and the result widens per group (the
+    group-count-sized widen is free next to the row-sized reduction)."""
+    from trino_tpu.types import int128 as i128
+
+    if d.ndim == 2:
+        h, l = i128.segment_sum128(
+            jnp.asarray(d[:, 0], jnp.int64),
+            jnp.asarray(d[:, 1], jnp.int64),
+            gid,
+            nseg,
+            valid=valid,
+        )
+    else:
+        d = jnp.asarray(d, jnp.int64)
+        if (
+            in_precision is not None
+            and (10**in_precision) * d.shape[0] < (1 << 63)
+        ):
+            red = jax.ops.segment_sum(
+                jnp.where(valid, d, 0) if valid is not None else d, gid, nseg
+            )
+            h, l = i128.widen64(red)
+        else:
+            h, l = i128.sum128_widened(d, gid, nseg, valid=valid)
+    return jnp.stack([h, l], axis=-1)
 
 
 def _finalize(spec: AggSpec, states: list[Column]) -> Column:
@@ -321,7 +377,27 @@ def _finalize(spec: AggSpec, states: list[Column]) -> Column:
     nonempty = cnt.data > 0
     valid = nonempty
     if name == "avg":
-        if isinstance(spec.out_type, T.DecimalType):
+        if isinstance(spec.out_type, T.DecimalType) and value.data.ndim == 2:
+            # Int128 sum state / count (reference: DecimalAverageAggregation,
+            # divide via the schoolbook limb division in types/int128) —
+            # count is data-dependent, so divide limb-wise by folding the
+            # divisor in via float seeding is not exact; instead use the
+            # exact path: q = divmod by count done in two 63-bit halves.
+            from trino_tpu.types import int128 as i128
+
+            h = value.data[:, 0]
+            l = value.data[:, 1]
+            den = jnp.where(nonempty, cnt.data, 1)
+            qh, ql, r = i128.divmod128_by_vec(h, l, den)
+            half = jnp.where(2 * jnp.abs(r) >= den, 1, 0)
+            neg = h < 0
+            bump = jnp.where(neg, -half, half)
+            qh2, ql2 = i128.add128(qh, ql, bump >> 63, bump)
+            if spec.out_type.is_long:
+                data = jnp.stack([qh2, ql2], axis=-1)
+            else:
+                data = ql2  # avg of short input fits the short result
+        elif isinstance(spec.out_type, T.DecimalType):
             num = value.data
             den = jnp.where(nonempty, cnt.data, 1)
             sign = jnp.sign(num)
@@ -332,8 +408,24 @@ def _finalize(spec: AggSpec, states: list[Column]) -> Column:
             data = value.data.astype(jnp.float64) / jnp.where(nonempty, cnt.data, 1)
         return Column(data.astype(spec.out_type.np_dtype), spec.out_type, valid)
     # sum/min/max/any_value/bool_*
+    data = value.data
+    if data.ndim == 2 and isinstance(spec.out_type, T.DecimalType):
+        if not spec.out_type.is_long:
+            # caller declared a short result: values are asserted to fit,
+            # so the low limb carries them exactly
+            data = data[:, 1]
+        elif (
+            isinstance(value.type, T.DecimalType)
+            and value.type.scale != spec.out_type.scale
+        ):
+            from trino_tpu.types import int128 as i128
+
+            h, l = i128.rescale128(
+                data[:, 0], data[:, 1], value.type.scale, spec.out_type.scale
+            )
+            data = jnp.stack([h, l], axis=-1)
     return Column(
-        value.data.astype(spec.out_type.np_dtype),
+        data.astype(spec.out_type.np_dtype),
         spec.out_type,
         valid,
         states[0].dictionary,
@@ -657,6 +749,8 @@ class AggregationOperator:
             col = batch.columns[ch]
             if col.lengths is not None:
                 return False
+            if col.data.ndim > 1:
+                return False  # long-decimal limb planes: sort path handles
             dt = col.data.dtype
             if not (jnp.issubdtype(dt, jnp.integer) or dt == jnp.bool_):
                 return False
@@ -838,12 +932,19 @@ class AggregationOperator:
         first_idx = jnp.where(new_group, gid_c, out_cap)
         for ch in gch:
             col = batch.columns[ch]
-            d = jnp.take(col.data, perm, mode="clip")
-            key_out = (
-                jnp.zeros(nseg, dtype=col.data.dtype)
-                .at[first_idx]
-                .set(d, mode="drop")[:out_cap]
-            )
+            d = jnp.take(col.data, perm, axis=0, mode="clip")
+            if d.ndim > 1:  # long-decimal limb planes: scatter rows
+                key_out = (
+                    jnp.zeros((nseg,) + d.shape[1:], dtype=col.data.dtype)
+                    .at[first_idx]
+                    .set(d, mode="drop")[:out_cap]
+                )
+            else:
+                key_out = (
+                    jnp.zeros(nseg, dtype=col.data.dtype)
+                    .at[first_idx]
+                    .set(d, mode="drop")[:out_cap]
+                )
             valid = None
             if col.valid is not None:
                 v = jnp.take(col.valid, perm, mode="clip")
@@ -1191,10 +1292,29 @@ class AggregationOperator:
             ch = spec.arg
             for kind, _ in prims:
                 col = batch.columns[ch]
-                d = jnp.take(col.data, perm, mode="clip")
+                d = jnp.take(col.data, perm, axis=0, mode="clip")
                 v = live
                 if col.valid is not None:
                     v = jnp.logical_and(v, jnp.take(col.valid, perm, mode="clip"))
+                if (
+                    kind == "sum"
+                    and isinstance(col.type, T.DecimalType)
+                    and col.type.is_long
+                ):
+                    # merging Int128 partial-sum states
+                    red2 = _sum128(d, gid, nseg, v)[:out_cap]
+                    state_cols.append(Column(red2, col.type, None))
+                    ch += 1
+                    continue
+                if (
+                    d.ndim == 2
+                    and isinstance(col.type, T.DecimalType)
+                    and kind in ("min", "max", "any")
+                ):
+                    red2 = _reduce128(d, gid, nseg, kind, v)[:out_cap]
+                    state_cols.append(Column(red2, col.type, None))
+                    ch += 1
+                    continue
                 red = segment_reduce(d, gid, nseg, kind, valid=v)[:out_cap]
                 state_cols.append(Column(red, col.type, None, col.dictionary))
                 ch += 1
@@ -1231,7 +1351,7 @@ class AggregationOperator:
                     out.append(Column(red, T.DOUBLE, None))
                 continue
             col = batch.columns[arg]
-            d = jnp.take(col.data, perm, mode="clip")
+            d = jnp.take(col.data, perm, axis=0, mode="clip")
             v = live
             if col.valid is not None:
                 v = jnp.logical_and(v, jnp.take(col.valid, perm, mode="clip"))
@@ -1242,6 +1362,23 @@ class AggregationOperator:
                     dl = dl * dl
                 red = segment_reduce(dl, gid, nseg, "sum", valid=v)[:out_cap]
                 out.append(Column(red, T.DOUBLE, None))
+                continue
+            if kind == "sum" and isinstance(st, T.DecimalType) and st.is_long:
+                prec = (
+                    col.type.precision
+                    if isinstance(col.type, T.DecimalType)
+                    else None
+                )
+                red2 = _sum128(d, gid, nseg, v, in_precision=prec)[:out_cap]
+                out.append(Column(red2, st, None))
+                continue
+            if (
+                d.ndim == 2
+                and isinstance(col.type, T.DecimalType)
+                and kind in ("min", "max", "any")
+            ):
+                red2 = _reduce128(d, gid, nseg, kind, v)[:out_cap]
+                out.append(Column(red2, st, None))
                 continue
             if kind == "sum":
                 # widen BEFORE reducing: int32 inputs must accumulate in int64
@@ -1333,6 +1470,32 @@ class AggregationOperator:
                         )
                         ch += 1
                         continue
+                    if (
+                        kind == "sum"
+                        and isinstance(col.type, T.DecimalType)
+                        and col.type.is_long
+                    ):
+                        gid0 = jnp.zeros(col.data.shape[0], dtype=jnp.int64)
+                        states.append(
+                            Column(_sum128(col.data, gid0, 1, v), col.type, None)
+                        )
+                        ch += 1
+                        continue
+                    if (
+                        col.data.ndim == 2
+                        and isinstance(col.type, T.DecimalType)
+                        and kind in ("min", "max", "any")
+                    ):
+                        gid0 = jnp.zeros(col.data.shape[0], dtype=jnp.int64)
+                        states.append(
+                            Column(
+                                _reduce128(col.data, gid0, 1, kind, v),
+                                col.type,
+                                None,
+                            )
+                        )
+                        ch += 1
+                        continue
                     states.append(
                         Column(
                             _masked_reduce(col.data, v, kind)[None],
@@ -1409,6 +1572,31 @@ class AggregationOperator:
                         if kind == "sumsq":
                             d = d * d
                         kind = "sum"
+                    elif kind == "sum" and isinstance(st, T.DecimalType) and st.is_long:
+                        gid0 = jnp.zeros(d.shape[0], dtype=jnp.int64)
+                        prec = (
+                            col.type.precision
+                            if isinstance(col.type, T.DecimalType)
+                            else None
+                        )
+                        states.append(
+                            Column(
+                                _sum128(d, gid0, 1, v, in_precision=prec),
+                                st,
+                                None,
+                            )
+                        )
+                        continue
+                    elif (
+                        d.ndim == 2
+                        and isinstance(col.type, T.DecimalType)
+                        and kind in ("min", "max", "any")
+                    ):
+                        gid0 = jnp.zeros(d.shape[0], dtype=jnp.int64)
+                        states.append(
+                            Column(_reduce128(d, gid0, 1, kind, v), st, None)
+                        )
+                        continue
                     elif kind == "sum":
                         d = d.astype(st.np_dtype)  # widen before reducing
                     states.append(

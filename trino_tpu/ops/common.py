@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from trino_tpu.columnar import Batch, Column
+from trino_tpu.types import DecimalType
 
 
 @dataclass(frozen=True)
@@ -61,6 +62,30 @@ def multi_key_sort_perm(batch: Batch, keys, capacity=None):
     # iterate stable sorts from least-significant key to most-significant
     for k in reversed(list(keys)):
         col = batch.columns[k.channel].gather(perm)
+        if col.data.ndim == 2 and isinstance(col.type, DecimalType):
+            # long decimal: two stable passes — low limb (unsigned order via
+            # sign-flip), then high limb; null rank rides the high pass
+            from trino_tpu.types.int128 import _SIGN
+
+            lo = col.data[:, 1] ^ _SIGN
+            if not k.ascending:
+                lo = ~lo
+            perm = perm[jnp.argsort(lo, stable=True)]
+            hi = jnp.take(
+                batch.columns[k.channel].data[:, 0], perm, mode="clip"
+            )
+            if not k.ascending:
+                hi = ~hi
+            perm = perm[jnp.argsort(hi, stable=True)]
+            if col.valid is not None:
+                v = jnp.take(batch.columns[k.channel].valid, perm, mode="clip")
+                rank = jnp.where(
+                    v,
+                    jnp.zeros(n, jnp.int8),
+                    jnp.asarray(-2 if k.nulls_first else 2, jnp.int8),
+                )
+                perm = perm[jnp.argsort(rank, stable=True)]
+            continue
         rank, key = _key_with_null_order(col, k.ascending, k.nulls_first)
         order = jnp.argsort(key, stable=True)
         perm = perm[order]
@@ -80,9 +105,11 @@ def group_ids_from_sorted(batch: Batch, perm, key_channels):
     change = jnp.zeros(n, dtype=bool)
     for ch in key_channels:
         col = batch.columns[ch]
-        d = jnp.take(col.data, perm, mode="clip")
-        prev = jnp.roll(d, 1)
+        d = jnp.take(col.data, perm, axis=0, mode="clip")
+        prev = jnp.roll(d, 1, axis=0)
         neq = d != prev
+        if neq.ndim > 1:  # long decimal limb planes: any limb differing
+            neq = jnp.any(neq, axis=-1)
         if col.valid is not None:
             v = jnp.take(col.valid, perm, mode="clip")
             pv = jnp.roll(v, 1)

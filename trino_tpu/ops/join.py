@@ -54,12 +54,12 @@ def _canon_build_keys(build: Batch, key_channels: Sequence[int]):
     canon = []
     for ch in key_channels:
         col = build.columns[ch]
-        d, nm = _canon_data(col)
+        ds, nm = _canon_data(col)
         if col.valid is not None:
             nomatch = jnp.logical_or(nomatch, jnp.logical_not(col.valid))
         if nm is not None:
             nomatch = jnp.logical_or(nomatch, nm)
-        canon.append(d)
+        canon.extend(ds)
     return canon, nomatch
 
 
@@ -73,20 +73,31 @@ def _lex_sort_perm(canon, nomatch, cap: int):
 
 
 def _canon_data(col: Column):
-    """(comparable-form data, extra-nomatch mask or None) for one key column.
+    """([comparable-form arrays], extra-nomatch mask or None) for one key
+    column.  Long decimals expand into TWO canon arrays (high limb, then
+    low limb in unsigned order) so every downstream consumer — lex sort,
+    binary search, composite packing — treats them as an extra key.
 
     SQL `=` never matches NULL, and float NaN keys never equal anything
     (reference DoubleOperators.equal is IEEE ==), so both are folded into the
     per-row `nomatch` flag instead of riding sentinel orderings.
     """
     d = col.data
+    if isinstance(col.type, T.DecimalType) and col.type.is_long:
+        sign = jnp.int64(np.int64(-(2**63)))
+        if d.ndim == 1:
+            # short-valued rows under a long type (e.g. a window sum):
+            # widen so BOTH join sides contribute the same two canon arrays
+            d64 = jnp.asarray(d, jnp.int64)
+            return [d64 >> 63, d64 ^ sign], None
+        return [d[:, 0], d[:, 1] ^ sign], None
     if d.dtype == jnp.bool_:
         d = d.astype(jnp.int8)
     nm = None
     if jnp.issubdtype(d.dtype, jnp.floating):
         nm = jnp.isnan(d)
         d = jnp.where(nm, jnp.zeros_like(d), d)
-    return d, nm
+    return [d], nm
 
 
 def _sort_build_device(build: Batch, key_channels: Sequence[int]):
@@ -110,17 +121,20 @@ def _canon_probe_device(probe: Batch, key_channels: Sequence[int], build_canon=N
     up-front dictionary unification).  Returns (key arrays, nomatch mask)."""
     nomatch = jnp.logical_not(probe.mask())
     arrs = []
-    for i, ch in enumerate(key_channels):
+    for ch in key_channels:
         col = probe.columns[ch]
         if col.valid is not None:
             nomatch = jnp.logical_or(nomatch, jnp.logical_not(col.valid))
-        d, nm = _canon_data(col)
+        ds, nm = _canon_data(col)
         if nm is not None:
             nomatch = jnp.logical_or(nomatch, nm)
-        if build_canon is not None and d.dtype != build_canon[i].dtype:
-            # promoted dtype, never narrowing (see _probe_canonical)
-            d = d.astype(jnp.promote_types(d.dtype, build_canon[i].dtype))
-        arrs.append(d)
+        for d in ds:
+            if build_canon is not None:
+                bd = build_canon[len(arrs)]
+                if d.dtype != bd.dtype:
+                    # promoted dtype, never narrowing (see _probe_canonical)
+                    d = d.astype(jnp.promote_types(d.dtype, bd.dtype))
+            arrs.append(d)
     return arrs, nomatch
 
 
@@ -337,16 +351,18 @@ class _SortedBuildJoinBase:
                     d = jnp.take(table, d, mode="clip")
                 arrs.append(d)
                 continue
-            d, nm = _canon_data(col)
+            ds, nm = _canon_data(col)
             if nm is not None:
                 nomatch = jnp.logical_or(nomatch, nm)
-            # compare in the PROMOTED dtype: narrowing a wide probe key to
-            # the build dtype would wrap out-of-range values onto valid build
-            # keys (e.g. BIGINT 2^32+5 = INTEGER 5) and fabricate matches
-            bd = self._build_canon[i]
-            if d.dtype != bd.dtype:
-                d = d.astype(jnp.promote_types(d.dtype, bd.dtype))
-            arrs.append(d)
+            for d in ds:
+                # compare in the PROMOTED dtype: narrowing a wide probe key
+                # to the build dtype would wrap out-of-range values onto
+                # valid build keys (e.g. BIGINT 2^32+5 = INTEGER 5) and
+                # fabricate matches
+                bd = self._build_canon[len(arrs)]
+                if d.dtype != bd.dtype:
+                    d = d.astype(jnp.promote_types(d.dtype, bd.dtype))
+                arrs.append(d)
         return arrs, nomatch
 
     def _locate_batch(self, probe: Batch):
@@ -434,7 +450,7 @@ class HashJoinOperator(_SortedBuildJoinBase):
         out_live = jnp.arange(out_cap, dtype=jnp.int64) < total_emit
         pcols = [
             Column(
-                jnp.take(c.data, ids, mode="clip"),
+                jnp.take(c.data, ids, axis=0, mode="clip"),
                 c.type,
                 None if c.valid is None else jnp.take(c.valid, ids, mode="clip"),
                 c.dictionary,
@@ -444,7 +460,7 @@ class HashJoinOperator(_SortedBuildJoinBase):
         bvalid_base = jnp.logical_and(matched, out_live)
         bcols = [
             Column(
-                jnp.take(c.data, build_row, mode="clip"),
+                jnp.take(c.data, build_row, axis=0, mode="clip"),
                 c.type,
                 bvalid_base
                 if c.valid is None
@@ -499,7 +515,7 @@ class HashJoinOperator(_SortedBuildJoinBase):
         build_row = jnp.clip(start, 0, cap_b - 1)
         bcols = [
             Column(
-                jnp.take(c.data, build_row, mode="clip"),
+                jnp.take(c.data, build_row, axis=0, mode="clip"),
                 c.type,
                 matched
                 if c.valid is None
@@ -626,7 +642,7 @@ class NestedLoopJoinOperator:
         out_live = jnp.arange(out_cap, dtype=jnp.int64) < total_emit
         pcols = [
             Column(
-                jnp.take(c.data, ids, mode="clip"),
+                jnp.take(c.data, ids, axis=0, mode="clip"),
                 c.type,
                 None if c.valid is None else jnp.take(c.valid, ids, mode="clip"),
                 c.dictionary,
@@ -635,7 +651,7 @@ class NestedLoopJoinOperator:
         ]
         bcols = [
             Column(
-                jnp.take(c.data, j, mode="clip"),
+                jnp.take(c.data, j, axis=0, mode="clip"),
                 c.type,
                 None if c.valid is None else jnp.take(c.valid, j, mode="clip"),
                 c.dictionary,
@@ -748,7 +764,7 @@ class SemiJoinOperator(_SortedBuildJoinBase):
         build_row = jnp.clip(start[ids] + j, 0, cap_b - 1)
         pcols = [
             Column(
-                jnp.take(c.data, ids, mode="clip"),
+                jnp.take(c.data, ids, axis=0, mode="clip"),
                 c.type,
                 None if c.valid is None else jnp.take(c.valid, ids, mode="clip"),
                 c.dictionary,
@@ -757,7 +773,7 @@ class SemiJoinOperator(_SortedBuildJoinBase):
         ]
         bcols = [
             Column(
-                jnp.take(c.data, build_row, mode="clip"),
+                jnp.take(c.data, build_row, axis=0, mode="clip"),
                 c.type,
                 in_range
                 if c.valid is None

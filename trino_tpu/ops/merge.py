@@ -25,8 +25,23 @@ from trino_tpu.ops.common import SortKey
 
 
 def _np_key_parts(col: Column, ascending: bool, nulls_first: bool):
-    """(rank or None, value_key) mirroring ops/common._key_with_null_order."""
+    """(rank or None, [value_keys least->most significant]) mirroring
+    ops/common._key_with_null_order.  Long decimals contribute two keys
+    (low limb in unsigned order, then high limb)."""
     data = np.asarray(col.data)
+    if data.ndim == 2:  # long-decimal limb planes
+        hi = data[:, 0]
+        lo = data[:, 1] ^ np.int64(-(2**63))  # unsigned order
+        if not ascending:
+            hi, lo = ~hi, ~lo
+        rank = None
+        if col.valid is not None:
+            rank = np.where(
+                np.asarray(col.valid),
+                np.zeros(len(data), dtype=np.int8),
+                np.asarray(-2 if nulls_first else 2, np.int8),
+            )
+        return rank, [lo, hi]
     if data.dtype == np.bool_:
         data = data.astype(np.int8)
     rank = None
@@ -43,7 +58,7 @@ def _np_key_parts(col: Column, ascending: bool, nulls_first: bool):
         rank = np.where(
             np.asarray(col.valid), base, np.asarray(-2 if nulls_first else 2, np.int8)
         )
-    return rank, value_key
+    return rank, [value_key]
 
 
 def merge_sorted_shards(shards: Sequence[Batch], keys: Sequence[SortKey]) -> Batch:
@@ -65,7 +80,11 @@ def merge_sorted_shards(shards: Sequence[Batch], keys: Sequence[SortKey]) -> Bat
             _np_key_parts(s.columns[k.channel], k.ascending, k.nulls_first)
             for s in shards
         ]
-        lex_cols.append(np.concatenate([p[1] for p in parts]))
+        n_keys = max(len(p[1]) for p in parts)
+        for ki in range(n_keys):
+            lex_cols.append(
+                np.concatenate([p[1][min(ki, len(p[1]) - 1)] for p in parts])
+            )
         if any(p[0] is not None for p in parts):
             lex_cols.append(
                 np.concatenate(

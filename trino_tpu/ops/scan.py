@@ -28,7 +28,8 @@ def page_to_batch(page, types: Sequence[Type], capacity: Optional[int] = None) -
     for cd, t in zip(page, types):
         data = np.asarray(cd.values, dtype=t.np_dtype)
         if len(data) < cap:
-            data = np.concatenate([data, np.zeros(cap - len(data), dtype=t.np_dtype)])
+            pad_shape = (cap - len(data),) + data.shape[1:]
+            data = np.concatenate([data, np.zeros(pad_shape, dtype=t.np_dtype)])
         valid = None
         if cd.valid is not None:
             v = np.asarray(cd.valid, dtype=bool)

@@ -39,12 +39,17 @@ def _hash_rows(batch: Batch, key_channels: Sequence[int]) -> jnp.ndarray:
         v = c.data
         if v.dtype == jnp.bool_:
             v = v.astype(jnp.int8)
-        bits = v.astype(jnp.int64).astype(jnp.uint64)
-        if c.valid is not None:
-            bits = jnp.where(c.valid, bits, jnp.uint64(0xDEADBEEF))
-        x = (bits ^ (bits >> 33)) * _MIX
-        x = x ^ (x >> 29)
-        h = (h ^ x) * _MIX
+        # long-decimal limb planes: mix each limb as its own word
+        planes = (
+            [v[:, i] for i in range(v.shape[1])] if v.ndim == 2 else [v]
+        )
+        for p in planes:
+            bits = p.astype(jnp.int64).astype(jnp.uint64)
+            if c.valid is not None:
+                bits = jnp.where(c.valid, bits, jnp.uint64(0xDEADBEEF))
+            x = (bits ^ (bits >> 33)) * _MIX
+            x = x ^ (x >> 29)
+            h = (h ^ x) * _MIX
     return h
 
 
@@ -80,6 +85,13 @@ def _exchange_kernel(key_channels, n_workers, slot_cap):
         flat = jnp.where(valid_slot, d_sorted * slot_cap + slot, n_workers * slot_cap)
 
         def scatter(col_1d, fill):
+            if col_1d.ndim > 1:  # long-decimal limb planes [cap, k]
+                k = col_1d.shape[1]
+                out = jnp.full(
+                    (n_workers * slot_cap + 1, k), fill, dtype=col_1d.dtype
+                )
+                out = out.at[flat].set(col_1d[order], mode="drop")
+                return out[:-1].reshape(n_workers, slot_cap, k)
             out = jnp.full((n_workers * slot_cap + 1,), fill, dtype=col_1d.dtype)
             out = out.at[flat].set(col_1d[order], mode="drop")
             return out[:-1].reshape(n_workers, slot_cap)
@@ -104,7 +116,10 @@ def _exchange_kernel(key_channels, n_workers, slot_cap):
                 if valid is None
                 else jax.lax.all_to_all(valid, "workers", split_axis=0, concat_axis=0).reshape(-1)
             )
-            out_cols.append(Column(rd.reshape(-1), c.type, rv, c.dictionary))
+            shaped = (
+                rd.reshape(-1, rd.shape[-1]) if rd.ndim > 2 else rd.reshape(-1)
+            )
+            out_cols.append(Column(shaped, c.type, rv, c.dictionary))
         out = Batch(out_cols, recv_mask)
         return jax.tree.map(lambda x: x[None], out)
 

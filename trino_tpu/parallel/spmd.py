@@ -108,9 +108,17 @@ def stack_batches(batches: Sequence[Optional[Batch]], wm: WorkerMesh, cap: Optio
                 for b in batches
                 if b is not None and b.width
             )
+        from trino_tpu.types import DecimalType as _Dec
+
+        is_long_dec = isinstance(types[ch], _Dec) and types[ch].is_long
         for wi, b in enumerate(batches):
             if b is None or not b.width:
-                shape = (cap, k) if any_lengths else (cap,)
+                if any_lengths:
+                    shape = (cap, k)
+                elif is_long_dec:
+                    shape = (cap, 2)  # limb planes
+                else:
+                    shape = (cap,)
                 datas.append(np.zeros(shape, dtype=types[ch].np_dtype))
                 valids.append(np.zeros(cap, dtype=bool))
                 if any_lengths:
@@ -118,6 +126,9 @@ def stack_batches(batches: Sequence[Optional[Batch]], wm: WorkerMesh, cap: Optio
                 continue
             c = b.columns[ch]
             data = np.asarray(c.data)
+            if is_long_dec and data.ndim == 1:
+                # short-valued rows under a long type: widen to planes
+                data = np.stack([data >> 63, data], axis=-1)
             if any_lengths and data.shape[1] < k:
                 data = np.pad(data, ((0, 0), (0, k - data.shape[1])))
             table = tables_per_ch[ch][wi]

@@ -187,12 +187,13 @@ class ExprAnalyzer:
             ip, fp = t.split(".")
             scale = len(fp)
             digits = len(ip.lstrip("-+").lstrip("0")) + scale
-            if digits > 18:
-                # beyond short-decimal range (TOTAL significant digits, not
-                # just scale): a double carries the value without the
-                # scaled-int i64 overflow (documented engine cap)
-                return Literal(float(t), T.DOUBLE)
-            return Literal(Decimal(t), T.DecimalType(18, scale))
+            if digits > 38:
+                raise AnalysisError(
+                    f"decimal literal exceeds precision 38: {t}"
+                )
+            # reference: DecimalParser sizes the literal's type by its
+            # digits; 19+ digits become a long (two-limb) decimal
+            return Literal(Decimal(t), T.DecimalType(max(digits, 1), scale))
         v = int(t)
         return Literal(v, T.INTEGER if -(2**31) <= v < 2**31 else T.BIGINT)
 

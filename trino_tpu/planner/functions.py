@@ -83,7 +83,9 @@ def agg_result_type(name: str, arg_type: T.Type | None, arg_type2: T.Type | None
         if arg_type is None:
             raise TypeError("sum requires an argument")
         if isinstance(arg_type, T.DecimalType):
-            return T.DecimalType(18, arg_type.scale)
+            # reference: DecimalSumAggregation widens to decimal(38, s) with
+            # an Int128 state; the two-limb exact sum lives in types/int128
+            return T.DecimalType(38, arg_type.scale)
         if arg_type.name in ("double", "real"):
             return T.DOUBLE
         return T.BIGINT
@@ -120,17 +122,26 @@ def arith_result_type(op: str, a: T.Type, b: T.Type) -> T.Type:
         return T.DOUBLE
     if op in ("+", "-"):
         if da or db:
+            # reference rule: p = max(p1-s1, p2-s2) + max(s1, s2) + 1, cap 38
+            digits = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 19}
             sa = a.scale if da else 0
             sb = b.scale if db else 0
-            return T.DecimalType(18, max(sa, sb))
+            ia = (a.precision - sa) if da else digits.get(a.name, 19)
+            ib = (b.precision - sb) if db else digits.get(b.name, 19)
+            s = max(sa, sb)
+            return T.DecimalType(min(max(ia, ib) + s + 1, 38), s)
         if a is T.DATE or b is T.DATE:
             return T.DATE  # date +/- interval-day
         return T.common_super_type(a, b)
     if op == "*":
         if da or db:
+            # reference rule: p = p1 + p2, cap 38 (DecimalOperators.multiply)
+            digits = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 19}
             sa = a.scale if da else 0
             sb = b.scale if db else 0
-            return T.DecimalType(18, sa + sb)
+            pa = a.precision if da else digits.get(a.name, 19)
+            pb = b.precision if db else digits.get(b.name, 19)
+            return T.DecimalType(min(pa + pb, 38), sa + sb)
         return T.common_super_type(a, b)
     if op == "/":
         if da or db:
@@ -204,13 +215,20 @@ SCALAR_RESULT = {
     "power": _fixed(T.DOUBLE),
     "pow": _fixed(T.DOUBLE),
     "mod": _same_as_first,
-    "floor": lambda args: T.DecimalType(18, 0)
+    # reference: floor/ceil(decimal(p,s)) -> decimal(p - s + min(s,1), 0)
+    "floor": lambda args: T.DecimalType(
+        max(args[0].precision - args[0].scale + min(args[0].scale, 1), 1), 0
+    )
     if isinstance(args[0], T.DecimalType)
     else args[0],
-    "ceil": lambda args: T.DecimalType(18, 0)
+    "ceil": lambda args: T.DecimalType(
+        max(args[0].precision - args[0].scale + min(args[0].scale, 1), 1), 0
+    )
     if isinstance(args[0], T.DecimalType)
     else args[0],
-    "ceiling": lambda args: T.DecimalType(18, 0)
+    "ceiling": lambda args: T.DecimalType(
+        max(args[0].precision - args[0].scale + min(args[0].scale, 1), 1), 0
+    )
     if isinstance(args[0], T.DecimalType)
     else args[0],
     "round": lambda args: args[0],

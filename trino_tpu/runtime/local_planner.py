@@ -955,6 +955,8 @@ def _host_minmax(batches, channel: int):
         c = b.columns[channel]
         if c.dictionary is not None:
             return None
+        if c.data.ndim > 1:
+            return None  # long-decimal limb planes: no scalar range
         dt = np.dtype(c.data.dtype)
         if dt == np.dtype(bool):
             return None  # boolean join keys: range pruning is pointless

@@ -146,22 +146,28 @@ UNKNOWN = _Unknown()
 
 
 class DecimalType(Type):
-    """Short decimal: scaled i64. precision <= 18 enforced.
+    """Decimal: scaled i64 for precision <= 18 (short), two-limb i128 planes
+    stacked on the last axis ([..., 2] int64: hi, lo-bits) for 19-38 (long).
 
-    Reference: spi/type/DecimalType.java (long-encoded short decimals).
+    Reference: spi/type/DecimalType.java — long-encoded short decimals and
+    Int128-encoded long decimals (spi/type/Int128.java); the limb math lives
+    in types/int128.py.
     """
 
     def __init__(self, precision: int = 38, scale: int = 0):
-        if precision > 18:
-            # The engine computes in i64; TPC-H/TPC-DS fit in (18, s) after the
-            # standard sum-widening clamp.
-            precision = 18
+        if precision > 38:
+            raise ValueError(f"decimal precision {precision} exceeds 38")
         self.precision = precision
         self.scale = scale
         self.name = f"decimal({precision},{scale})"
         self.np_dtype = np.dtype(np.int64)
         self.orderable = True
         self.comparable = True
+
+    @property
+    def is_long(self) -> bool:
+        """True when the device representation is two i64 limbs."""
+        return self.precision > 18
 
     @property
     def scale_factor(self) -> int:
@@ -406,11 +412,13 @@ def common_super_type(a: Type, b: Type) -> Type:
         if da and db:
             scale = max(a.scale, b.scale)
             intd = max(a.precision - a.scale, b.precision - b.scale)
-            return DecimalType(min(intd + scale, 18), scale)
+            return DecimalType(min(intd + scale, 38), scale)
         other = b if da else a
         dec = a if da else b
         if other.name in ("tinyint", "smallint", "integer", "bigint"):
-            return DecimalType(18, dec.scale)
+            digits = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 19}
+            intd = max(dec.precision - dec.scale, digits[other.name])
+            return DecimalType(min(max(intd + dec.scale, 18), 38), dec.scale)
         if other.name in ("real", "double"):
             return DOUBLE
         raise TypeError(f"no common type for {a} and {b}")
