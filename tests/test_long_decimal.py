@@ -278,3 +278,55 @@ def test_union_long_with_bigint(runner):
         "union all select cast(3 as bigint)) t(v) order by v"
     ).rows
     assert rows == [(Decimal("-5.00"),), (Decimal("3.00"),)]
+
+
+def test_window_functions_over_long(runner):
+    runner.execute("create table wt (k bigint, v decimal(38,2))")
+    runner.execute(
+        "insert into wt values (1, decimal '99999999999999999999.25'), "
+        "(2, decimal '99999999999999999999.25'), (3, decimal '-5.00')"
+    )
+    assert runner.execute(
+        "select k, rank() over (order by v) from wt order by k"
+    ).rows == [(1, 2), (2, 2), (3, 1)]
+    assert runner.execute(
+        "select k, count(*) over (partition by v) from wt order by k"
+    ).rows == [(1, 2), (2, 2), (3, 1)]
+    assert runner.execute(
+        "select k, lag(v) over (order by k) from wt order by k"
+    ).rows == [
+        (1, None),
+        (2, Decimal("99999999999999999999.25")),
+        (3, Decimal("99999999999999999999.25")),
+    ]
+    assert runner.execute(
+        "select k, first_value(v) over (order by v rows between "
+        "unbounded preceding and current row) from wt order by k"
+    ).rows == [
+        (1, Decimal("-5.00")),
+        (2, Decimal("-5.00")),
+        (3, Decimal("-5.00")),
+    ]
+
+
+def test_holistic_aggs_over_long(runner):
+    runner.execute("create table ht (k bigint, v decimal(38,2))")
+    runner.execute(
+        "insert into ht values (1, decimal '99999999999999999999.25'), "
+        "(1, decimal '12345678901234567890.12'), (2, decimal '-5.00')"
+    )
+    assert runner.execute(
+        "select min_by(v, k), max_by(v, k) from ht"
+    ).rows == [
+        (Decimal("99999999999999999999.25"), Decimal("-5.00"))
+    ]
+    assert runner.execute(
+        "select approx_percentile(v, 0.5) from ht"
+    ).rows == [(Decimal("12345678901234567890.12"),)]
+    # unsupported long paths fail loudly, never silently wrong
+    import pytest as _pt
+
+    with _pt.raises(Exception, match="long-decimal"):
+        runner.execute("select array_agg(v) from ht")
+    with _pt.raises(Exception, match="long-decimal"):
+        runner.execute("select k, sum(v) over (partition by k) from ht")

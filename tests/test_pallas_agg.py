@@ -47,3 +47,49 @@ def test_query_with_pallas_agg_matches_default():
     fast.execute("set session pallas_agg = true")
     actual = fast.execute(sql).rows
     assert_rows_match(actual, expected, ordered=False, atol=0.5)
+
+
+def test_matmul_direct_sums_exact():
+    """The one-hot GEMM aggregation path (TPU default) is exact for int,
+    short-decimal, long-decimal, and double sums — forced on here since
+    tests run on CPU where the segmented path is the default."""
+    from decimal import Decimal
+
+    import trino_tpu.ops.aggregation as agg
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    q = (
+        "select l_returnflag, sum(l_quantity), sum(l_extendedprice), "
+        "sum(l_extendedprice * (1 - l_discount)), avg(l_quantity), "
+        "count(*), count(l_comment) from lineitem group by l_returnflag "
+        "order by l_returnflag"
+    )
+    # oracle FIRST, through whatever (segmented) steps are already cached
+    expected = LocalQueryRunner(
+        catalog="tpch", schema="tiny", target_splits=4
+    ).execute(q).rows
+
+    orig = agg.AggregationOperator._matmul_direct_sums
+    orig_cache = agg._STEP_CACHE
+    called = {"n": 0}
+
+    def forced(self, batch, live, gid, prod):
+        self.force_matmul = True
+        out = orig(self, batch, live, gid, prod)
+        if out is not None:
+            called["n"] += 1
+        return out
+
+    # fresh step cache: the jitted steps bake the (forced) matmul path into
+    # their traces, so they must neither reuse earlier unforced traces nor
+    # leak forced ones back into the shared process-level cache
+    agg.AggregationOperator._matmul_direct_sums = forced
+    agg._STEP_CACHE = {}
+    try:
+        r = LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=4)
+        rows = r.execute(q).rows
+        assert called["n"] > 0, "matmul path did not engage"
+        assert rows == expected
+    finally:
+        agg.AggregationOperator._matmul_direct_sums = orig
+        agg._STEP_CACHE = orig_cache

@@ -154,22 +154,23 @@ class Column:
             return out
         is_dec = isinstance(t, DecimalType)
         is_long_dec = is_dec and t.is_long and data.ndim == 2
+        if is_long_dec:
+            from decimal import Context, Decimal
+
+            from trino_tpu.types.int128 import join_py
+
+            # hoisted: the default 28-digit context rounds 29+ digit values,
+            # and constructing the wide one per row is pure overhead
+            _ldec_ctx = Context(prec=60)
         for i in rows:
             if valid is not None and not valid[i]:
                 out.append(None)
             elif self.dictionary is not None:
                 out.append(self.dictionary.values[int(data[i])])
             elif is_long_dec:
-                from decimal import Context, Decimal
-
-                from trino_tpu.types.int128 import join_py
-
-                # default Decimal context is 28 significant digits — scaleb
-                # under it silently ROUNDS a 38-digit value
-                ctx = Context(prec=60)
                 out.append(
                     Decimal(join_py(int(data[i, 0]), int(data[i, 1]))).scaleb(
-                        -t.scale, context=ctx
+                        -t.scale, context=_ldec_ctx
                     )
                 )
             elif is_dec:

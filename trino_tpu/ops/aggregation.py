@@ -304,12 +304,20 @@ def _sum128(d, gid, nseg: int, valid, in_precision: int = None):
     from trino_tpu.types import int128 as i128
 
     if d.ndim == 2:
+        # the precision bound can prove the HIGH limb never needs chunking:
+        # |hi| <= 10**p / 2**64, so hi sums stay in i64 when that times the
+        # row count is < 2**62
+        hi_direct = (
+            in_precision is not None
+            and ((10**in_precision >> 64) + 1) * d.shape[0] < (1 << 62)
+        )
         h, l = i128.segment_sum128(
             jnp.asarray(d[:, 0], jnp.int64),
             jnp.asarray(d[:, 1], jnp.int64),
             gid,
             nseg,
             valid=valid,
+            hi_direct=hi_direct,
         )
     else:
         d = jnp.asarray(d, jnp.int64)
@@ -534,6 +542,8 @@ class AggregationOperator:
         fold_every: Optional[int] = None,
         memory_ctx=None,
         use_pallas: bool = False,
+        pre_step=None,
+        pre_key=None,
     ):
         # merge: states in -> states out (used to combine partial outputs)
         assert mode in ("single", "partial", "final", "merge")
@@ -553,6 +563,11 @@ class AggregationOperator:
         #: (ops/pallas_agg.py); float32 accumulation, so restricted to
         #: DOUBLE/REAL sums + counts where f32 matmul precision is acceptable
         self.use_pallas = use_pallas
+        #: fused upstream projection: applied INSIDE the jitted reduce step
+        #: so projection outputs (e.g. decimal products) never round-trip
+        #: through memory between the project and the partial aggregation
+        self._pre = pre_step
+        self._pre_key = pre_key
         self._acc: list[Batch] = []
         self._per_batch: Optional["AggregationOperator"] = None
         key = (
@@ -561,6 +576,7 @@ class AggregationOperator:
             tuple(t.name for t in self.input_types),
             mode,
             use_pallas,
+            pre_key,
         )
         cached = _STEP_CACHE.get(key)
         if cached is None:
@@ -578,13 +594,18 @@ class AggregationOperator:
     #: sort path (or, later, aggregation waves) takes over.
     POSITIONAL_LIMIT = 1 << 24
 
-    def _direct_group_info(self, batch: Batch):
+    def _direct_group_info(self, batch: Batch, src_channels=None):
         """(sizes, prod) when every group key is a small-domain code column
         (dictionary or boolean) — the BigintGroupByHash analog: group id is
         the mixed-radix code index, no sort needed (reference:
-        operator/BigintGroupByHash.java's dense small-domain fast path)."""
+        operator/BigintGroupByHash.java's dense small-domain fast path).
+
+        `src_channels`: when the input projection is FUSED into this
+        operator, the group keys' pre-projection channels in the raw batch
+        (group projections are identity InputRefs in that case)."""
         sizes = []
-        for ch in self.group_channels:
+        chans = src_channels if src_channels is not None else self.group_channels
+        for ch in chans:
             c = batch.columns[ch]
             if c.dictionary is not None:
                 n = len(c.dictionary.values)
@@ -639,6 +660,14 @@ class AggregationOperator:
         if pallas_sums is not None:
             cols.extend(pallas_sums)
             return Batch(cols, out_live)
+        matmul_states = self._matmul_direct_sums(batch, live, gid, prod)
+        if matmul_states is not None:
+            for spec, state_cols in zip(self.aggregates, matmul_states):
+                if self.mode == "partial":
+                    cols.extend(state_cols)
+                else:
+                    cols.append(_finalize(spec, state_cols))
+            return Batch(cols, out_live)
         perm = jnp.arange(cap, dtype=jnp.int64)
         for spec in self.aggregates:
             state_cols = self._reduce_one(batch, spec, perm, live, gid, nseg, prod)
@@ -647,6 +676,160 @@ class AggregationOperator:
             else:
                 cols.append(_finalize(spec, state_cols))
         return Batch(cols, out_live)
+
+    #: one-hot matmul path bounds: groups (one-hot width) and rows (chunk
+    #: sums must stay exact in f64: 2**32 chunks * 2**21 rows = 2**53)
+    MATMUL_GROUP_LIMIT = 32
+    MATMUL_ROW_LIMIT = 1 << 21
+
+    def _matmul_direct_sums(self, batch: Batch, live, gid, prod: int):
+        """EXACT one-hot matmul aggregation (default on the direct path):
+        every sum/count reduces in ONE dot — [cap, G] one-hot against a
+        [cap, K] plane matrix — instead of K segmented scatter-adds.
+
+        This is the MXU-native formulation (TPU: systolic-array matmul; CPU:
+        a single GEMM) and it is exact: integer inputs split into 32-bit
+        chunk planes, each chunk sum < 2**32 * 2**21 = 2**53 fits the f64
+        mantissa, and the chunks recombine into i64/i128 with carries.
+        Returns per-spec primitive STATE columns (same layout as
+        _reduce_one) or None when ineligible.
+
+        Reference role: the grouped-sum loop of operator/aggregation/
+        DecimalSumAggregation + GroupedAccumulator, reshaped for hardware
+        that prefers one big matmul over row-at-a-time accumulation."""
+        cap = batch.capacity
+        if prod > self.MATMUL_GROUP_LIMIT or cap > self.MATMUL_ROW_LIMIT:
+            return None
+        if self.mode not in ("single", "partial"):
+            return None
+        if not self.aggregates:
+            return None  # pure dedupe (e.g. DISTINCT pre-aggregation)
+        # the one-hot GEMM is the accelerator formulation; CPU's scalar
+        # pipelines prefer the segmented scatter-adds
+        import jax as _j
+
+        if _j.default_backend() == "cpu" and not getattr(
+            self, "force_matmul", False
+        ):
+            return None
+        for spec in self.aggregates:
+            if spec.name not in ("sum", "avg", "count", "count_star"):
+                return None
+            if spec.name in ("sum", "avg"):
+                t = self.input_types[spec.arg]
+                if not (
+                    isinstance(t, T.DecimalType)
+                    or t.name
+                    in ("tinyint", "smallint", "integer", "bigint", "double", "real")
+                ):
+                    return None
+
+        m32 = jnp.int64(0xFFFFFFFF)
+        planes = []  # f64 [cap] arrays
+        plan = []  # per spec: list of (prim_kind, chunk_layout, plane_idx..)
+
+        def _valid_plane(col):
+            v = live
+            if col is not None and col.valid is not None:
+                v = jnp.logical_and(v, col.valid)
+            return v
+
+        for spec in self.aggregates:
+            prims = []
+            if spec.name == "count_star":
+                prims.append(("count", "count", (len(planes),)))
+                planes.append(live.astype(jnp.float64))
+            elif spec.name == "count":
+                col = batch.columns[spec.arg]
+                v = _valid_plane(col)
+                prims.append(("count", "count", (len(planes),)))
+                planes.append(v.astype(jnp.float64))
+            else:  # sum / avg -> (sum, count) primitive states
+                col = batch.columns[spec.arg]
+                v = _valid_plane(col)
+                vf = v.astype(jnp.float64)
+                t = self.input_types[spec.arg]
+                st = _state_types(spec, self.input_types)[0]
+                if t.name in ("double", "real"):
+                    d = jnp.where(v, col.data.astype(jnp.float64), 0.0)
+                    prims.append(("sum", "f64", (len(planes),)))
+                    planes.append(d)
+                elif col.data.ndim == 2:  # long decimal input
+                    h = jnp.where(v, col.data[:, 0], 0)
+                    l = jnp.where(v, col.data[:, 1], 0)
+                    i0 = len(planes)
+                    planes.extend(
+                        [
+                            (l & m32).astype(jnp.float64),
+                            ((l >> 32) & m32).astype(jnp.float64),
+                            (h & m32).astype(jnp.float64),
+                            (h >> 32).astype(jnp.float64),
+                        ]
+                    )
+                    prims.append(("sum", "i128", (i0, i0 + 1, i0 + 2, i0 + 3)))
+                else:
+                    d = jnp.where(v, jnp.asarray(col.data, jnp.int64), 0)
+                    i0 = len(planes)
+                    planes.extend(
+                        [
+                            (d & m32).astype(jnp.float64),
+                            (d >> 32).astype(jnp.float64),  # signed top chunk
+                        ]
+                    )
+                    kind = (
+                        "i128"
+                        if isinstance(st, T.DecimalType) and st.is_long
+                        else "i64"
+                    )
+                    prims.append(("sum", kind + "_2", (i0, i0 + 1)))
+                prims.append(("count", "count", (len(planes),)))
+                planes.append(vf)
+            plan.append((spec, prims))
+
+        onehot = jnp.logical_and(
+            gid[:, None] == jnp.arange(prod, dtype=gid.dtype)[None, :],
+            live[:, None],
+        ).astype(jnp.float64)
+        V = jnp.stack(planes, axis=1)  # [cap, K]
+        S = jnp.einsum("ng,nk->gk", onehot, V)  # ONE matmul: [G, K]
+
+        from trino_tpu.types import int128 as i128
+
+        out_states: list = []
+        for spec, prims in plan:
+            state_cols = []
+            sts = _state_types(spec, self.input_types)
+            for (kind, layout, idx), st in zip(prims, sts):
+                if layout == "count":
+                    state_cols.append(
+                        Column(S[:, idx[0]].astype(jnp.int64), T.BIGINT)
+                    )
+                elif layout == "f64":
+                    state_cols.append(Column(S[:, idx[0]], st))
+                elif layout == "i64_2":
+                    s0 = S[:, idx[0]].astype(jnp.int64)
+                    s1 = S[:, idx[1]].astype(jnp.int64)
+                    state_cols.append(Column((s1 << 32) + s0, st))
+                elif layout == "i128_2":
+                    hi, lo = i128.recombine2(
+                        S[:, idx[0]].astype(jnp.int64),
+                        S[:, idx[1]].astype(jnp.int64),
+                    )
+                    state_cols.append(
+                        Column(jnp.stack([hi, lo], axis=-1), st)
+                    )
+                else:  # i128 (4 chunk planes)
+                    hi, lo = i128.recombine4(
+                        S[:, idx[0]].astype(jnp.int64),
+                        S[:, idx[1]].astype(jnp.int64),
+                        S[:, idx[2]].astype(jnp.int64),
+                        S[:, idx[3]].astype(jnp.int64),
+                    )
+                    state_cols.append(
+                        Column(jnp.stack([hi, lo], axis=-1), st)
+                    )
+            out_states.append(state_cols)
+        return out_states
 
     def _pallas_direct_sums(self, batch: Batch, live, gid, prod: int):
         """MXU one-hot-matmul fast path (ops/pallas_agg.py) when every
@@ -901,14 +1084,23 @@ class AggregationOperator:
         if any(s.name in COLLECT_AGGS for s in self.aggregates):
             return self._reduce_step(big, out_cap=cap)
         # the in-jit small-domain direct path needs no host sync; prefer it
-        # when statically eligible (dict/bool keys)
-        if self.group_channels and self._direct_group_info(big) is None:
+        # when statically eligible (dict/bool keys).  A fused projection
+        # (self._pre) means `big` is RAW input: the positional fallback
+        # would inspect pre-projection channels, so skip it — _step applies
+        # the projection inside its own trace.
+        if (
+            self._pre is None
+            and self.group_channels
+            and self._direct_group_info(big) is None
+        ):
             out = self._positional_try(big)
             if out is not None:
                 return out
         return self._step(big, out_cap=cap)
 
     def _reduce_step(self, batch: Batch, out_cap: int) -> Batch:
+        if self._pre is not None:
+            batch = self._pre(batch)
         gch = self.group_channels
         if not gch:
             return self._global_reduce(batch)
@@ -1022,7 +1214,7 @@ class AggregationOperator:
                 gid_c = gid
             else:
                 gid_c = jnp.zeros(cap, dtype=jnp.int64)
-        d = jnp.take(col.data, perm, mode="clip")
+        d = jnp.take(col.data, perm, axis=0, mode="clip")
         varg = live
         if col.valid is not None:
             varg = jnp.logical_and(varg, jnp.take(col.valid, perm, mode="clip"))
@@ -1030,7 +1222,7 @@ class AggregationOperator:
         dictionary = col.dictionary
         if spec.name == "map_agg":
             vcol = batch.columns[spec.arg2]
-            vd = jnp.take(vcol.data, perm, mode="clip")
+            vd = jnp.take(vcol.data, perm, axis=0, mode="clip")
             if vcol.valid is not None:
                 varg = jnp.logical_and(
                     varg, jnp.take(vcol.valid, perm, mode="clip")
@@ -1047,6 +1239,11 @@ class AggregationOperator:
                     vd = jnp.take(jnp.asarray(tv), jnp.asarray(vd, jnp.int32), mode="clip")
             elif vcol.dictionary is not None:
                 dictionary = vcol.dictionary
+        if jnp.ndim(d) > 1:
+            raise NotImplementedError(
+                f"{spec.name} over a long-decimal argument "
+                "(cast to decimal(18,s) or double first)"
+            )
         # within-group rank over kept rows
         pos_in_group, counts = _group_ranks(varg, gid_c, cap, nseg)
         kmax = int(np.asarray(jnp.max(counts[:out_cap])))  # the one host sync
@@ -1220,8 +1417,8 @@ class AggregationOperator:
         at_ext = jnp.logical_and(vkey, match)
         first = jax.ops.segment_min(jnp.where(at_ext, pos, cap), gid_c, nseg)
         idx = jnp.clip(first[:out_cap], 0, cap - 1)
-        vd = jnp.take(vcol.data, perm, mode="clip")
-        out = jnp.take(vd, idx, mode="clip")
+        vd = jnp.take(vcol.data, perm, axis=0, mode="clip")
+        out = jnp.take(vd, idx, axis=0, mode="clip")
         has_key = jax.ops.segment_sum(vkey.astype(jnp.int64), gid_c, nseg)[:out_cap] > 0
         valid = has_key
         if vcol.valid is not None:
@@ -1257,9 +1454,9 @@ class AggregationOperator:
         target = start + jnp.round(
             p * jnp.maximum(nvalid - 1, 0).astype(jnp.float64)
         ).astype(jnp.int64)
-        d_sorted = jnp.take(col.data, perm2, mode="clip")
+        d_sorted = jnp.take(col.data, perm2, axis=0, mode="clip")
         val = jnp.take(
-            d_sorted, jnp.clip(target[:out_cap], 0, cap - 1), mode="clip"
+            d_sorted, jnp.clip(target[:out_cap], 0, cap - 1), axis=0, mode="clip"
         )
         return Column(val, spec.out_type, nvalid[:out_cap] > 0, col.dictionary)
 
@@ -1440,8 +1637,10 @@ class AggregationOperator:
                 idx = jnp.round(
                     p * jnp.maximum(n - 1, 0).astype(jnp.float64)
                 ).astype(jnp.int64)
-                d_sorted = jnp.take(col.data, perm, mode="clip")
-                val = jnp.take(d_sorted, jnp.clip(idx, 0, batch.capacity - 1))
+                d_sorted = jnp.take(col.data, perm, axis=0, mode="clip")
+                val = jnp.take(
+                    d_sorted, jnp.clip(idx, 0, batch.capacity - 1), axis=0
+                )
                 cols.append(
                     Column(val[None], spec.out_type, (n > 0)[None], col.dictionary)
                 )
@@ -1619,12 +1818,16 @@ class AggregationOperator:
         """Per-batch operator for streaming: raw rows -> states, or (when this
         op's input is already states) states -> states."""
         per_mode = "merge" if self.mode in ("final", "merge") else "partial"
-        return AggregationOperator(
+        op = AggregationOperator(
             self.group_channels,
             self.aggregates,
             self.input_types,
             mode=per_mode,
+            pre_step=self._pre if per_mode == "partial" else None,
+            pre_key=self._pre_key if per_mode == "partial" else None,
         )
+        op._group_src_channels = getattr(self, "_group_src_channels", None)
+        return op
 
     #: fold accumulated per-batch states after this many batches (bounds
     #: device memory at ~FOLD_EVERY batch capacities, the revoke analog)
@@ -1638,7 +1841,9 @@ class AggregationOperator:
         if self._per_batch is None:
             self._per_batch = self._batch_reducer()
         per_batch = self._per_batch
-        if per_batch._direct_group_info(batch) is not None:
+        if per_batch._direct_group_info(
+            batch, src_channels=getattr(per_batch, "_group_src_channels", None)
+        ) is not None:
             return per_batch._step(batch, out_cap=batch.capacity)
         return per_batch._reduce_full(batch)
 
@@ -1678,6 +1883,16 @@ class AggregationOperator:
     def finish(self) -> Batch:
         if not self._acc:
             empty = self._empty_input()
+            if self._pre is not None:
+                # _empty_input is in POST-projection layout; the fused pre
+                # expects raw channels, so reduce with an unfused twin
+                twin = AggregationOperator(
+                    self.group_channels,
+                    self.aggregates,
+                    self.input_types,
+                    mode=self.mode,
+                )
+                return twin.finish()
             if any(s.name in COLLECT_AGGS for s in self.aggregates):
                 return self._reduce_step(empty, out_cap=max(1, empty.capacity))
             return self._step(empty, out_cap=max(1, empty.capacity))

@@ -80,6 +80,30 @@ class FilterProjectOperator:
 
         return step
 
+    def fusable_step(self):
+        """(raw untraced step, structural key) for fusion INTO a downstream
+        operator's jitted program (e.g. the aggregation partial step), or
+        (None, None) when the expressions need host-eager evaluation.
+        Fusion removes the materialize-then-reload of projection outputs —
+        on TPU that is HBM traffic, on CPU cache traffic."""
+        exprs = ([] if self.predicate is None else [self.predicate]) + list(
+            self.projections
+        )
+        if any(map(_needs_eager, exprs)):
+            return None, None
+        key = (
+            None if self.predicate is None else self.predicate.key(),
+            tuple(e.key() for e in self.projections),
+        )
+        raw = _STEP_CACHE.get(("raw", key))
+        if raw is None:
+            # cache the RAW closure too: the consumer bakes it into its own
+            # jitted program keyed by `key`, so the closure identity must be
+            # stable across queries or every query would retrace
+            raw = self._make_step()
+            _STEP_CACHE[("raw", key)] = raw
+        return raw, key
+
     def process(self, stream):
         for batch in stream:
             yield self._step(batch)

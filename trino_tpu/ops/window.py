@@ -111,8 +111,10 @@ class WindowOperator:
         first_live = jnp.logical_and(live, jnp.cumsum(live) == 1)
         for ch in self.partition_channels:
             col = batch.columns[ch]
-            d = jnp.take(col.data, perm, mode="clip")
-            neq = d != jnp.roll(d, 1)
+            d = jnp.take(col.data, perm, axis=0, mode="clip")
+            neq = d != jnp.roll(d, 1, axis=0)
+            if neq.ndim > 1:  # long-decimal limb planes
+                neq = jnp.any(neq, axis=-1)
             if col.valid is not None:
                 v = jnp.take(col.valid, perm, mode="clip")
                 pv = jnp.roll(v, 1)
@@ -132,8 +134,10 @@ class WindowOperator:
         new_peer = new_part
         for k in self.order_keys:
             col = batch.columns[k.channel]
-            d = jnp.take(col.data, perm, mode="clip")
-            neq = d != jnp.roll(d, 1)
+            d = jnp.take(col.data, perm, axis=0, mode="clip")
+            neq = d != jnp.roll(d, 1, axis=0)
+            if neq.ndim > 1:  # long-decimal limb planes
+                neq = jnp.any(neq, axis=-1)
             if col.valid is not None:
                 v = jnp.take(col.valid, perm, mode="clip")
                 pv = jnp.roll(v, 1)
@@ -157,7 +161,7 @@ class WindowOperator:
         inv = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(pos)
         final_cols = list(batch.columns)
         for c in out_cols:
-            data = jnp.take(c.data, inv, mode="clip")
+            data = jnp.take(c.data, inv, axis=0, mode="clip")
             valid = None if c.valid is None else jnp.take(c.valid, inv, mode="clip")
             final_cols.append(Column(data, c.type, valid, c.dictionary))
         return Batch(final_cols, batch.row_mask)
@@ -248,7 +252,7 @@ class WindowOperator:
                 return Column(bucket + 1, T.BIGINT, None)
         if name in ("lag", "lead"):
             col = batch.columns[spec.arg]
-            d = jnp.take(col.data, perm, mode="clip")
+            d = jnp.take(col.data, perm, axis=0, mode="clip")
             v = jnp.take(col.valid, perm, mode="clip") if col.valid is not None else jnp.ones(cap, bool)
             if spec.ignore_nulls:
                 # k-th non-null neighbour via per-partition valid-rank
@@ -268,11 +272,11 @@ class WindowOperator:
                     found = pref + spec.offset <= total
                 slot = jnp.where(found, part_first + tgt, cap)
                 src_row = jnp.take(pos_of, jnp.clip(slot, 0, cap), mode="clip")
-                data = jnp.take(d, jnp.clip(src_row, 0, cap - 1), mode="clip")
+                data = jnp.take(d, jnp.clip(src_row, 0, cap - 1), axis=0, mode="clip")
                 valid = jnp.logical_and(found, src_row < cap)
                 if spec.default_channel is not None:
                     dc = batch.columns[spec.default_channel]
-                    dd = jnp.take(dc.data, perm, mode="clip")
+                    dd = jnp.take(dc.data, perm, axis=0, mode="clip")
                     dv = (
                         jnp.take(dc.valid, perm, mode="clip")
                         if dc.valid is not None
@@ -290,11 +294,11 @@ class WindowOperator:
                 src >= part_start[safe_pid], src < part_start[safe_pid] + n_in_part
             )
             src_c = jnp.clip(src, 0, cap - 1)
-            data = jnp.take(d, src_c, mode="clip")
+            data = jnp.take(d, src_c, axis=0, mode="clip")
             valid = jnp.logical_and(in_part, jnp.take(v, src_c, mode="clip"))
             if spec.default_channel is not None:
                 dc = batch.columns[spec.default_channel]
-                dd = jnp.take(dc.data, perm, mode="clip")
+                dd = jnp.take(dc.data, perm, axis=0, mode="clip")
                 dv = (
                     jnp.take(dc.valid, perm, mode="clip")
                     if dc.valid is not None
@@ -305,7 +309,7 @@ class WindowOperator:
             return Column(data.astype(spec.out_type.np_dtype), spec.out_type, valid, col.dictionary)
         if name in ("first_value", "last_value", "nth_value"):
             col = batch.columns[spec.arg]
-            d = jnp.take(col.data, perm, mode="clip")
+            d = jnp.take(col.data, perm, axis=0, mode="clip")
             v = jnp.take(col.valid, perm, mode="clip") if col.valid is not None else jnp.ones(cap, bool)
             if spec.ignore_nulls:
                 # first/last/nth non-null row of the frame [lo, hi] via the
@@ -335,7 +339,7 @@ class WindowOperator:
                 slot = jnp.where(found, part_first + rank0, cap)
                 src_row = jnp.take(pos_of, jnp.clip(slot, 0, cap), mode="clip")
                 return Column(
-                    jnp.take(d, jnp.clip(src_row, 0, cap - 1), mode="clip")
+                    jnp.take(d, jnp.clip(src_row, 0, cap - 1), axis=0, mode="clip")
                     .astype(spec.out_type.np_dtype),
                     spec.out_type,
                     jnp.logical_and(found, src_row < cap),
@@ -346,7 +350,7 @@ class WindowOperator:
                 in_frame = src_raw <= hi
                 src = jnp.clip(src_raw, 0, cap - 1)
                 return Column(
-                    jnp.take(d, src, mode="clip").astype(
+                    jnp.take(d, src, axis=0, mode="clip").astype(
                         spec.out_type.np_dtype
                     ),
                     spec.out_type,
@@ -360,7 +364,7 @@ class WindowOperator:
                 )
             src = jnp.clip(lo if name == "first_value" else hi, 0, cap - 1)
             return Column(
-                jnp.take(d, src, mode="clip").astype(spec.out_type.np_dtype),
+                jnp.take(d, src, axis=0, mode="clip").astype(spec.out_type.np_dtype),
                 spec.out_type,
                 jnp.logical_and(jnp.take(v, src, mode="clip"), frame_n > 0),
                 col.dictionary,
@@ -369,11 +373,16 @@ class WindowOperator:
         if name == "count" and spec.arg is None:  # count(*) over (...)
             return Column(frame_n, T.BIGINT, None)
         col = batch.columns[spec.arg]
-        d = jnp.take(col.data, perm, mode="clip")
+        d = jnp.take(col.data, perm, axis=0, mode="clip")
         v = live
         if col.valid is not None:
             v = jnp.logical_and(v, jnp.take(col.valid, perm, mode="clip"))
         if name in ("sum", "avg", "count"):
+            if d.ndim > 1:
+                raise NotImplementedError(
+                    "window aggregation over a long-decimal input column "
+                    "(cast to decimal(18,s) or double first)"
+                )
             dd = jnp.where(v, d, 0).astype(
                 jnp.float64 if jnp.issubdtype(d.dtype, jnp.floating) else jnp.int64
             )
@@ -407,6 +416,11 @@ class WindowOperator:
                 avg = ssum.astype(jnp.float64) / jnp.maximum(scnt, 1)
             return Column(avg.astype(spec.out_type.np_dtype), spec.out_type, scnt > 0)
         if name in ("min", "max"):
+            if d.ndim > 1:
+                raise NotImplementedError(
+                    "window min/max over a long-decimal input column "
+                    "(cast to decimal(18,s) or double first)"
+                )
             sent = _max_sentinel(d.dtype) if name == "min" else _min_sentinel(d.dtype)
             dd = jnp.where(v, d, sent)
             if whole:

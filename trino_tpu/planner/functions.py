@@ -123,11 +123,10 @@ def arith_result_type(op: str, a: T.Type, b: T.Type) -> T.Type:
     if op in ("+", "-"):
         if da or db:
             # reference rule: p = max(p1-s1, p2-s2) + max(s1, s2) + 1, cap 38
-            digits = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 19}
             sa = a.scale if da else 0
             sb = b.scale if db else 0
-            ia = (a.precision - sa) if da else digits.get(a.name, 19)
-            ib = (b.precision - sb) if db else digits.get(b.name, 19)
+            ia = (a.precision - sa) if da else T.INT_DIGITS.get(a.name, 19)
+            ib = (b.precision - sb) if db else T.INT_DIGITS.get(b.name, 19)
             s = max(sa, sb)
             return T.DecimalType(min(max(ia, ib) + s + 1, 38), s)
         if a is T.DATE or b is T.DATE:
@@ -136,11 +135,10 @@ def arith_result_type(op: str, a: T.Type, b: T.Type) -> T.Type:
     if op == "*":
         if da or db:
             # reference rule: p = p1 + p2, cap 38 (DecimalOperators.multiply)
-            digits = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 19}
             sa = a.scale if da else 0
             sb = b.scale if db else 0
-            pa = a.precision if da else digits.get(a.name, 19)
-            pb = b.precision if db else digits.get(b.name, 19)
+            pa = a.precision if da else T.INT_DIGITS.get(a.name, 19)
+            pb = b.precision if db else T.INT_DIGITS.get(b.name, 19)
             return T.DecimalType(min(pa + pb, 38), sa + sb)
         return T.common_super_type(a, b)
     if op == "/":

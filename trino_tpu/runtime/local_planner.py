@@ -251,21 +251,85 @@ class LocalExecutionPlanner:
 
     # -- aggregation ----------------------------------------------------------
 
+    def _collapse_agg_source(self, node: P.AggregationNode):
+        """Fold a Project*/Filter? chain under an aggregation into the
+        aggregation's own input projection (classic projection merging), so
+        the whole filter+compute+partial-reduce pipeline compiles as ONE
+        XLA program — no intermediate column materialization.  Returns
+        (source PhysicalPlan proxy, predicate Expr or None), or None when
+        the shape doesn't match."""
+        from trino_tpu.expr.ir import substitute_symbols
+
+        maps = []
+        inner = node.source
+        while isinstance(inner, P.ProjectNode):
+            maps.append({s.name: e for s, e in inner.assignments})
+            inner = inner.source
+        pred = None
+        if isinstance(inner, P.FilterNode):
+            pred = inner.predicate
+            inner = inner.source
+        if not maps and pred is None:
+            return None
+        if not isinstance(inner, P.TableScanNode):
+            # conservative: only collapse over scans (other sources may have
+            # their own operators with observable behavior)
+            return None
+        base = self.plan(inner)
+
+        class _Sub:
+            stream = base.stream
+            symbols = base.symbols
+
+            @staticmethod
+            def rewrite(e):
+                for m in maps:
+                    e = substitute_symbols(e, m)
+                return base.rewrite(e)
+
+            @staticmethod
+            def channel(name):
+                return base.channel(name)
+
+        pred_ir = base.rewrite(pred) if pred is not None else None
+        return _Sub, pred_ir
+
     def _visit_AggregationNode(self, node: P.AggregationNode) -> PhysicalPlan:
-        src = self.plan(node.source)
-        if any(agg.distinct for _, agg in node.aggregations):
+        distinct = any(agg.distinct for _, agg in node.aggregations)
+        collapsed = None if distinct else self._collapse_agg_source(node)
+        if collapsed is not None:
+            src, fused_pred = collapsed
+        else:
+            src = self.plan(node.source)
+            fused_pred = None
+        if distinct:
             src = self._distinct_preagg(node, src)
         ngroups = len(node.group_symbols)
         proj, specs, input_types = build_agg_inputs(node, src)
-        pre = FilterProjectOperator(None, proj)
+        pre = FilterProjectOperator(fused_pred, proj)
         # holistic aggregates need every group row at once: no streaming
         # partials (reference: ArrayAggregationFunction group state)
         streaming = not any(
             s.name in HOLISTIC_AGGS for s in specs
         )
 
+        budget = self.properties.get("query_max_memory_bytes")
+        # Fuse the agg-input projection INTO the jitted partial-reduce
+        # program when possible: projection outputs (decimal products etc.)
+        # then never materialize between operators — the whole-fragment
+        # fusion XLA is built for.  Group keys must be identity InputRefs so
+        # host-side direct-path eligibility can read the RAW batch.
+        from trino_tpu.expr.ir import InputRef
+
+        pre_raw = pre_key = group_src = None
+        if streaming and not (budget and ngroups):
+            if all(isinstance(proj[i], InputRef) for i in range(ngroups)):
+                pre_raw, pre_key = pre.fusable_step()
+                if pre_raw is not None:
+                    group_src = [proj[i].channel for i in range(ngroups)]
+
         def make_op():
-            return AggregationOperator(
+            op = AggregationOperator(
                 list(range(ngroups)),
                 specs,
                 input_types,
@@ -274,10 +338,13 @@ class LocalExecutionPlanner:
                 fold_every=self.properties.get("agg_fold_batches"),
                 memory_ctx=self.memory.child("aggregation"),
                 use_pallas=self.properties.get("pallas_agg"),
+                pre_step=pre_raw,
+                pre_key=pre_key,
             )
+            op._group_src_channels = group_src
+            return op
 
-        budget = self.properties.get("query_max_memory_bytes")
-        feed = pre.process(src.stream)
+        feed = src.stream if pre_raw is not None else pre.process(src.stream)
         if budget and ngroups:
             stream = _agg_wave_stream(
                 make_op, feed, list(range(ngroups)), int(budget)

@@ -392,6 +392,11 @@ def is_string_kind(t: Type) -> bool:
     return isinstance(t, (VarcharType, CharType))
 
 
+#: max decimal digits of each integer type (reference: TypeCoercion's
+#: bigint-as-decimal(19,0) etc.)
+INT_DIGITS = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 19}
+
+
 def common_super_type(a: Type, b: Type) -> Type:
     """Least common type for binary operations / UNION / CASE branches.
 
@@ -416,8 +421,7 @@ def common_super_type(a: Type, b: Type) -> Type:
         other = b if da else a
         dec = a if da else b
         if other.name in ("tinyint", "smallint", "integer", "bigint"):
-            digits = {"tinyint": 3, "smallint": 5, "integer": 10, "bigint": 19}
-            intd = max(dec.precision - dec.scale, digits[other.name])
+            intd = max(dec.precision - dec.scale, INT_DIGITS[other.name])
             return DecimalType(min(max(intd + dec.scale, 18), 38), dec.scale)
         if other.name in ("real", "double"):
             return DOUBLE
