@@ -144,3 +144,225 @@ class TestReadOnlyConnector:
             runner.execute(
                 f"insert into {catalog}.{schema}.{table} values (1)"
             )
+
+
+@pytest.mark.parametrize("catalog", WRITABLE)
+class TestWritableConnectorExtended:
+    """Round-5 widening (BaseConnectorTest breadth: NULL handling, schema
+    evolution, CTAS, views over connector tables, transactional rollback)."""
+
+    def test_insert_all_nulls_row(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (k bigint, s varchar)")
+        runner.execute(f"insert into {_t(catalog)} values (null, null)")
+        assert runner.execute(f"select * from {_t(catalog)}").rows == [
+            (None, None)
+        ]
+        assert runner.execute(
+            f"select count(*), count(k) from {_t(catalog)}"
+        ).rows == [(1, 0)]
+
+    def test_empty_table_aggregates(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (k bigint)")
+        assert runner.execute(
+            f"select count(*), sum(k), min(k) from {_t(catalog)}"
+        ).rows == [(0, None, None)]
+
+    def test_ctas_roundtrip(self, runner, catalog):
+        runner.execute(
+            f"create table {_t(catalog)} as "
+            "select n_nationkey k, n_name s from tpch.tiny.nation "
+            "where n_nationkey < 3"
+        )
+        assert runner.execute(
+            f"select count(*) from {_t(catalog)}"
+        ).rows == [(3,)]
+
+    def test_add_column_schema_evolution(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (k bigint)")
+        runner.execute(f"insert into {_t(catalog)} values (1)")
+        runner.execute(f"alter table {_t(catalog)} add column s varchar")
+        runner.execute(f"insert into {_t(catalog)} values (2, 'x')")
+        assert sorted(
+            runner.execute(f"select * from {_t(catalog)}").rows
+        ) == [(1, None), (2, "x")]
+
+    def test_rename_column(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (old bigint)")
+        runner.execute(f"insert into {_t(catalog)} values (5)")
+        runner.execute(
+            f"alter table {_t(catalog)} rename column old to renamed"
+        )
+        assert runner.execute(
+            f"select renamed from {_t(catalog)}"
+        ).rows == [(5,)]
+
+    def test_drop_column(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (a bigint, b bigint)")
+        runner.execute(f"insert into {_t(catalog)} values (1, 2)")
+        runner.execute(f"alter table {_t(catalog)} drop column b")
+        assert runner.execute(f"select * from {_t(catalog)}").rows == [(1,)]
+
+    def test_insert_select_from_self(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (k bigint)")
+        runner.execute(f"insert into {_t(catalog)} values (1), (2)")
+        runner.execute(
+            f"insert into {_t(catalog)} select k + 10 from {_t(catalog)}"
+        )
+        assert sorted(
+            runner.execute(f"select k from {_t(catalog)}").rows
+        ) == [(1,), (2,), (11,), (12,)]
+
+    def test_delete_all_then_reinsert(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (k bigint)")
+        runner.execute(f"insert into {_t(catalog)} values (1), (2)")
+        runner.execute(f"delete from {_t(catalog)}")
+        assert runner.execute(
+            f"select count(*) from {_t(catalog)}"
+        ).rows == [(0,)]
+        runner.execute(f"insert into {_t(catalog)} values (9)")
+        assert runner.execute(f"select * from {_t(catalog)}").rows == [(9,)]
+
+    def test_long_decimal_roundtrip(self, runner, catalog):
+        from decimal import Decimal
+
+        runner.execute(f"create table {_t(catalog)} (v decimal(38,2))")
+        runner.execute(
+            f"insert into {_t(catalog)} values "
+            "(decimal '99999999999999999999.25'), (null)"
+        )
+        assert sorted(
+            runner.execute(f"select * from {_t(catalog)}").rows,
+            key=lambda r: (r[0] is not None, r[0]),
+        ) == [(None,), (Decimal("99999999999999999999.25"),)]
+
+    def test_timestamp_roundtrip(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (ts timestamp)")
+        runner.execute(
+            f"insert into {_t(catalog)} values "
+            "(timestamp '2021-07-15 13:14:15.250')"
+        )
+        assert runner.execute(f"select * from {_t(catalog)}").rows == [
+            (datetime.datetime(2021, 7, 15, 13, 14, 15, 250000),)
+        ]
+
+    def test_duplicate_create_rejected(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (k bigint)")
+        with pytest.raises(Exception):
+            runner.execute(f"create table {_t(catalog)} (k bigint)")
+        # IF NOT EXISTS form must not raise
+        runner.execute(
+            f"create table if not exists {_t(catalog)} (k bigint)"
+        )
+
+    def test_merge_upsert(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (k bigint, v bigint)")
+        runner.execute(f"insert into {_t(catalog)} values (1, 10), (2, 20)")
+        runner.execute(
+            f"merge into {_t(catalog)} t using (values (2, 200), (3, 300)) "
+            "s(k, v) on t.k = s.k "
+            "when matched then update set v = s.v "
+            "when not matched then insert values (s.k, s.v)"
+        )
+        assert sorted(
+            runner.execute(f"select * from {_t(catalog)}").rows
+        ) == [(1, 10), (2, 200), (3, 300)]
+
+    def test_view_over_connector_table(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (k bigint)")
+        runner.execute(f"insert into {_t(catalog)} values (1), (2)")
+        runner.execute(
+            f"create view memory.default.conf_v as "
+            f"select k * 2 d from {_t(catalog)}"
+        )
+        try:
+            assert sorted(
+                runner.execute("select d from memory.default.conf_v").rows
+            ) == [(2,), (4,)]
+        finally:
+            runner.execute("drop view memory.default.conf_v")
+
+    def test_unicode_strings(self, runner, catalog):
+        runner.execute(f"create table {_t(catalog)} (s varchar)")
+        runner.execute(
+            f"insert into {_t(catalog)} values ('héllo'), ('日本語'), ('')"
+        )
+        rows = sorted(runner.execute(f"select s from {_t(catalog)}").rows)
+        assert rows == [("",), ("héllo",), ("日本語",)]
+        assert runner.execute(
+            f"select length(s) from {_t(catalog)} where s = '日本語'"
+        ).rows == [(3,)]
+
+
+@pytest.mark.parametrize("catalog,schema,table,expected", READ_ONLY)
+class TestReadOnlyConnectorExtended:
+    def test_limit_pushdown_shape(self, runner, catalog, schema, table, expected):
+        rows = runner.execute(
+            f"select * from {catalog}.{schema}.{table} limit 3"
+        ).rows
+        assert len(rows) == 3
+
+    def test_order_by_first_column(self, runner, catalog, schema, table, expected):
+        pk = runner.execute(
+            f"show columns from {catalog}.{schema}.{table}"
+        ).rows[0][0]
+        rows = runner.execute(
+            f"select {pk} from {catalog}.{schema}.{table} order by {pk}"
+        ).rows
+        vals = [r[0] for r in rows]
+        assert vals == sorted(vals) and len(vals) == expected
+
+    def test_describe_matches_select_star(self, runner, catalog, schema, table, expected):
+        cols = runner.execute(
+            f"show columns from {catalog}.{schema}.{table}"
+        ).rows
+        res = runner.execute(
+            f"select * from {catalog}.{schema}.{table} limit 1"
+        )
+        assert [c[0] for c in cols] == list(res.column_names)
+
+    def test_ddl_rejected(self, runner, catalog, schema, table, expected):
+        with pytest.raises(Exception):
+            runner.execute(f"drop table {catalog}.{schema}.{table}")
+        with pytest.raises(Exception):
+            runner.execute(
+                f"delete from {catalog}.{schema}.{table}"
+            )
+
+
+class TestIcebergSnapshots:
+    """Iceberg-analog specific: snapshots, time travel, metadata tables,
+    write conflict (BaseIcebergConnectorTest analogs)."""
+
+    def test_snapshot_history_grows(self, runner):
+        runner.execute("create table iceberg.default.snap_t (k bigint)")
+        runner.execute("insert into iceberg.default.snap_t values (1)")
+        runner.execute("insert into iceberg.default.snap_t values (2)")
+        hist = runner.execute(
+            'select * from iceberg.default."snap_t$history"'
+        ).rows
+        assert len(hist) >= 2
+
+    def test_time_travel_reads_old_snapshot(self, runner):
+        runner.execute("create table iceberg.default.tt_t (k bigint)")
+        runner.execute("insert into iceberg.default.tt_t values (1)")
+        snaps = runner.execute(
+            'select * from iceberg.default."tt_t$snapshots"'
+        ).rows
+        first_snapshot = snaps[-1][0]
+        runner.execute("insert into iceberg.default.tt_t values (2)")
+        assert runner.execute(
+            "select count(*) from iceberg.default.tt_t"
+        ).only_value() == 2
+        # the OLD snapshot must still read one row
+        old_count = runner.execute(
+            f'select count(*) from iceberg.default."tt_t@{first_snapshot}"'
+        ).only_value()
+        assert old_count == 1
+
+    def test_files_metadata_table(self, runner):
+        runner.execute("create table iceberg.default.files_t (k bigint)")
+        runner.execute("insert into iceberg.default.files_t values (1)")
+        files = runner.execute(
+            'select * from iceberg.default."files_t$files"'
+        ).rows
+        assert len(files) >= 1

@@ -73,3 +73,58 @@ def test_date_functions_over_table(runner):
         "select count(distinct date_trunc('month', o_orderdate)) from orders"
     ).rows
     assert rows[0][0] > 50  # ~80 distinct months across the 6.5-year window
+
+
+def test_time_type(runner):
+    import datetime
+
+    rows = runner.execute(
+        "select time '10:30:05.5', hour(time '10:30:05'), "
+        "minute(time '10:30:05'), cast('23:59:59' as time), "
+        "cast(timestamp '2020-03-01 10:30:00' as time), "
+        "time '10:00:00' < time '11:00:00'"
+    ).rows
+    assert rows == [
+        (
+            datetime.time(10, 30, 5, 500000),
+            10,
+            30,
+            datetime.time(23, 59, 59),
+            datetime.time(10, 30),
+            True,
+        )
+    ]
+
+
+def test_interval_year_month_type(runner):
+    import datetime
+
+    rows = runner.execute(
+        "select interval '3' month, interval '2' year, "
+        "date '2020-01-31' + interval '1' month, "
+        "timestamp '2020-01-31 10:00:00' + interval '1' month, "
+        "date '2020-03-31' - interval '1' month"
+    ).rows
+    assert rows == [
+        (
+            "0-3",
+            "2-0",
+            datetime.date(2020, 2, 29),
+            datetime.datetime(2020, 2, 29, 10, 0),
+            datetime.date(2020, 2, 29),
+        )
+    ]
+
+
+def test_interval_values_in_expressions(runner):
+    import datetime
+
+    # interval as a first-class value: arithmetic over column temporals
+    rows = runner.execute(
+        "select d + interval '1' year from (values date '2019-02-28') t(d)"
+    ).rows
+    assert rows == [(datetime.date(2020, 2, 28),)]
+    rows = runner.execute(
+        "select interval '1' year + interval '2' month"
+    ).rows
+    assert rows == [("1-2",)]

@@ -10,10 +10,13 @@ plan is cut into fragments with explicit partitioning handles
 
 import pytest
 
+
 from tests.test_e2e import assert_rows_match
 from trino_tpu.connectors.tpch.queries import QUERIES
 from trino_tpu.parallel import DistributedQueryRunner
 from trino_tpu.runtime.runner import LocalQueryRunner
+
+pytestmark = pytest.mark.heavy
 
 
 @pytest.fixture(scope="module")

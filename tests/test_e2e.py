@@ -9,6 +9,7 @@ import datetime
 import math
 from decimal import Decimal
 
+
 import numpy as np
 import pandas as pd
 import pytest
@@ -17,6 +18,8 @@ from tests.tpch_oracle import ORACLES
 from trino_tpu.connectors.tpch.queries import QUERIES
 from trino_tpu.runtime.runner import LocalQueryRunner
 from trino_tpu.testing import tpch_pandas
+
+pytestmark = pytest.mark.heavy
 
 
 @pytest.fixture(scope="module")

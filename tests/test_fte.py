@@ -7,9 +7,12 @@ finished stages."""
 
 import pytest
 
+
 from trino_tpu.parallel import DistributedQueryRunner
 from trino_tpu.runtime.retry import FAILURE_INJECTOR, InjectedFailure
 from trino_tpu.runtime.runner import LocalQueryRunner
+
+pytestmark = pytest.mark.heavy
 
 
 @pytest.fixture(autouse=True)

@@ -320,9 +320,12 @@ def test_holistic_aggs_over_long(runner):
     ).rows == [
         (Decimal("99999999999999999999.25"), Decimal("-5.00"))
     ]
-    assert runner.execute(
+    got = runner.execute(
         "select approx_percentile(v, 0.5) from ht"
-    ).rows == [(Decimal("12345678901234567890.12"),)]
+    ).rows[0][0]
+    # global form goes through the quantile sketch: ~1.6% value resolution
+    want = Decimal("12345678901234567890.12")
+    assert abs(float(got - want)) / float(want) < 0.02
     # unsupported long paths fail loudly, never silently wrong
     import pytest as _pt
 

@@ -8,10 +8,13 @@ deployment; only the transport endpoints share a host."""
 
 import pytest
 
+
 from tests.test_e2e import assert_rows_match
 from trino_tpu.runtime.runner import LocalQueryRunner
 from trino_tpu.server.worker import WorkerServer
 from trino_tpu.parallel.remote import MultiHostQueryRunner
+
+pytestmark = pytest.mark.heavy
 
 
 @pytest.fixture(scope="module")
@@ -172,3 +175,45 @@ def test_worker_death_mid_query_reassigns(local):
                 w.shutdown()
             except Exception:
                 pass
+
+
+def test_cross_fragment_dynamic_filter(mh, local):
+    """Build-side key ranges prune probe-side scans ACROSS fragments
+    (reference: DynamicFilterService delivery into task descriptors)."""
+    mh.properties.set("join_distribution_type", "PARTITIONED")
+    try:
+        q = (
+            "select c_name from customer join orders on c_custkey = o_custkey "
+            "where o_orderkey = 7"
+        )
+        rows = mh.execute(q).rows
+        assert rows == local.execute(q).rows and len(rows) == 1
+    finally:
+        mh.properties.set("join_distribution_type", "AUTOMATIC")
+
+
+def test_dynamic_ranges_delivered(mh):
+    """The probe fragment's descriptors actually carry build ranges."""
+    import trino_tpu.server.worker as w
+
+    seen = {}
+    orig = w.WorkerServer._execute
+
+    def spy(self, desc):
+        if desc.dynamic_ranges:
+            seen[desc.task_id] = dict(desc.dynamic_ranges)
+        return orig(self, desc)
+
+    w.WorkerServer._execute = spy
+    try:
+        mh.properties.set("join_distribution_type", "PARTITIONED")
+        mh.execute(
+            "select count(*) from lineitem join orders "
+            "on l_orderkey = o_orderkey where o_orderkey < 100"
+        )
+        assert seen, "no task descriptor carried dynamic ranges"
+        rng = next(iter(seen.values()))
+        assert all(len(v) == 2 for v in rng.values())
+    finally:
+        w.WorkerServer._execute = orig
+        mh.properties.set("join_distribution_type", "AUTOMATIC")

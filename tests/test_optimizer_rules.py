@@ -74,3 +74,67 @@ def test_union_pushdown_coerced_branch_types(runner):
     import datetime
 
     assert rows == [(datetime.datetime(2024, 1, 2, 0, 0),)]
+
+
+def _explain(runner, sql: str) -> str:
+    return "\n".join(r[0] for r in runner.execute("explain " + sql).rows)
+
+
+def test_rule_fire_stats_in_explain(runner):
+    text = _explain(
+        runner,
+        "select c_name from (select * from customer order by c_custkey) t "
+        "where c_custkey < 5 limit 3",
+    )
+    assert "rule fires:" in text
+
+
+def test_trivial_filter_removed(runner):
+    text = _explain(runner, "select n_name from nation where 1 = 1")
+    assert "Filter" not in text
+
+
+def test_false_filter_becomes_empty_values(runner):
+    text = _explain(runner, "select n_name from nation where 1 = 0")
+    assert "Values" in text and "TableScan" not in text
+    assert runner.execute(
+        "select n_name from nation where 1 = 0"
+    ).rows == []
+
+
+def test_merge_limits(runner):
+    rows = runner.execute(
+        "select * from (select n_name from nation limit 10) t limit 3"
+    ).rows
+    assert len(rows) == 3
+    text = _explain(
+        runner, "select * from (select n_name from nation limit 10) t limit 3"
+    )
+    assert text.count("Limit") + text.count("TopN") <= 1
+
+
+def test_redundant_sort_under_aggregation_removed(runner):
+    text = _explain(
+        runner,
+        "select x, count(*) from "
+        "(select n_regionkey x from nation order by n_name) t group by x",
+    )
+    assert "Sort" not in text
+
+
+def test_redundant_distinct_removed(runner):
+    text = _explain(
+        runner,
+        "select distinct x from "
+        "(select n_regionkey x from nation group by n_regionkey) t",
+    )
+    # one aggregation, not two
+    assert text.count("Aggregation") == 1
+
+
+def test_limit_over_values_folds(runner):
+    text = _explain(runner, "select * from (values 1, 2, 3) t(x) limit 2")
+    assert "Limit" not in text
+    assert runner.execute(
+        "select * from (values 1, 2, 3) t(x) limit 2"
+    ).rows == [(1,), (2,)]

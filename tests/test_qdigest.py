@@ -1,0 +1,94 @@
+"""Mergeable quantile sketch for global approx_percentile.
+
+Reference: operator/aggregation/ApproximateLongPercentileAggregations.java
+(qdigest states — fixed-size, mergeable); round-4 verdict Missing #5.
+"""
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+def _rel_err(a, b):
+    return abs(float(a) - float(b)) / max(abs(float(b)), 1e-9)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=4)
+
+
+def test_global_sketch_within_error(runner):
+    from trino_tpu.testing import tpch_pandas
+
+    li = tpch_pandas("tiny", "lineitem")
+    rows = runner.execute(
+        "select approx_percentile(l_quantity, 0.5), "
+        "approx_percentile(l_quantity, 0.9), "
+        "approx_percentile(l_extendedprice, 0.25), "
+        "approx_percentile(l_extendedprice, 0.99) from lineitem"
+    ).rows[0]
+    exact = [
+        li.l_quantity.quantile(0.5),
+        li.l_quantity.quantile(0.9),
+        li.l_extendedprice.quantile(0.25),
+        li.l_extendedprice.quantile(0.99),
+    ]
+    for got, want in zip(rows, exact):
+        # 1/64 per-bucket value resolution -> ~2% worst case
+        assert _rel_err(got, want) < 0.02, (got, want)
+
+
+def test_sketch_state_is_mergeable_across_splits(runner):
+    # many splits force partial states that merge by count addition; the
+    # answer must not depend on the split count
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    one = LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=1)
+    r1 = one.execute(
+        "select approx_percentile(l_extendedprice, 0.5) from lineitem"
+    ).rows
+    rn = runner.execute(
+        "select approx_percentile(l_extendedprice, 0.5) from lineitem"
+    ).rows
+    assert r1 == rn
+
+
+def test_negative_and_double_inputs(runner):
+    rows = runner.execute(
+        "select approx_percentile(x, 0.5) from "
+        "(values -100.0, -50.0, -10.0, 10.0, 50.0) t(x)"
+    ).rows
+    assert _rel_err(rows[0][0], -10.0) < 0.02
+
+
+def test_grouped_stays_exact(runner):
+    from trino_tpu.testing import tpch_pandas
+
+    li = tpch_pandas("tiny", "lineitem")
+    rows = dict(
+        runner.execute(
+            "select l_returnflag, approx_percentile(l_quantity, 0.5) "
+            "from lineitem group by l_returnflag"
+        ).rows
+    )
+    for flag, grp in li.groupby("l_returnflag"):
+        # nearest-rank exact percentile per group
+        import numpy as np
+
+        vals = np.sort(grp.l_quantity.values)
+        idx = int(round(0.5 * (len(vals) - 1)))
+        assert float(rows[flag]) == float(vals[idx])
+
+
+def test_distributed_sketch():
+    from trino_tpu.parallel import DistributedQueryRunner
+
+    d = DistributedQueryRunner(n_workers=8)
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    l = LocalQueryRunner(target_splits=3)
+    q = "select approx_percentile(l_extendedprice, 0.5) from lineitem"
+    assert d.execute(q).rows == l.execute(q).rows

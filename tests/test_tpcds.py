@@ -4,6 +4,7 @@ tpcds query smoke suites)."""
 import numpy as np
 import pytest
 
+
 from trino_tpu.connectors.tpcds import TpcdsConnector
 from trino_tpu.connectors.tpcds.queries import QUERIES
 from trino_tpu.connectors.tpcds.schema import TABLES

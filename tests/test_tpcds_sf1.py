@@ -12,7 +12,10 @@ rely on.
 
 import pytest
 
+
 from trino_tpu.connectors.tpcds.queries import QUERIES
+
+pytestmark = pytest.mark.heavy
 
 #: structurally diverse slice: star joins (3, 7, 19), date-dim correlated
 #: subquery (25), grouping breadth (42, 52), inventory semi-join shape (82)

@@ -39,6 +39,11 @@ def _to_device_scalar(v, t: Type):
         return (v - _EPOCH_DATE).days
     if t is TIMESTAMP and isinstance(v, datetime.datetime):
         return int((v - _EPOCH_TS).total_seconds() * 1_000_000)
+    if t.name == "time" and isinstance(v, datetime.time):
+        return (
+            (v.hour * 3600 + v.minute * 60 + v.second) * 1_000_000
+            + v.microsecond
+        )
     if t is TIMESTAMP_TZ and isinstance(v, datetime.datetime):
         off = v.utcoffset()
         off_min = int(off.total_seconds() // 60) if off is not None else 0

@@ -190,6 +190,31 @@ class Column:
                     datetime.datetime(1970, 1, 1)
                     + datetime.timedelta(microseconds=int(data[i]))
                 )
+            elif t.name == "time":
+                import datetime
+
+                us = int(data[i]) % 86_400_000_000
+                out.append(
+                    (
+                        datetime.datetime(1970, 1, 1)
+                        + datetime.timedelta(microseconds=us)
+                    ).time()
+                )
+            elif t.name == "interval year to month":
+                mo = int(data[i])
+                sign = "-" if mo < 0 else ""
+                out.append(f"{sign}{abs(mo) // 12}-{abs(mo) % 12}")
+            elif t.name == "interval day to second":
+                us = int(data[i])
+                sign = "-" if us < 0 else ""
+                us = abs(us)
+                d_, rem = divmod(us, 86_400_000_000)
+                h_, rem = divmod(rem, 3_600_000_000)
+                m_, rem = divmod(rem, 60_000_000)
+                s_, frac = divmod(rem, 1_000_000)
+                out.append(
+                    f"{sign}{d_} {h_:02d}:{m_:02d}:{s_:02d}.{frac // 1000:03d}"
+                )
             elif t.name == "timestamp with time zone":
                 import datetime
 

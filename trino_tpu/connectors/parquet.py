@@ -53,10 +53,9 @@ def _arrow_to_type(at) -> T.Type:
     if pa.types.is_float64(at):
         return T.DOUBLE
     if pa.types.is_decimal(at):
-        if at.precision > 18:
+        if at.precision > 38:
             raise NotImplementedError(
-                f"decimal({at.precision},{at.scale}) exceeds the engine's "
-                "short-decimal (i64) range"
+                f"decimal({at.precision},{at.scale}) exceeds precision 38"
             )
         return T.DecimalType(at.precision, at.scale)
     if pa.types.is_date(at):
@@ -94,6 +93,25 @@ def _array_to_column_data(arr, t: T.Type) -> ColumnData:
         )
         codes = np.asarray(dict_arr.indices.fill_null(0))
         return ColumnData(remap[np.clip(codes.astype(np.int64), 0, len(remap) - 1)], valid, d)
+    if isinstance(t, T.DecimalType) and t.is_long:
+        # arrow decimal128 -> two-limb planes (types/int128.py)
+        import decimal as _d
+
+        from trino_tpu.types.int128 import split_py
+
+        ctx = _d.Context(prec=60)
+        out = np.zeros((len(arr), 2), dtype=np.int64)
+        for i, v in enumerate(arr.to_pylist()):
+            if v is not None:
+                out[i, 0], out[i, 1] = split_py(
+                    int(v.scaleb(t.scale, context=ctx))
+                )
+        valid = (
+            None
+            if arr.null_count == 0
+            else np.asarray(arr.is_valid())
+        )
+        return ColumnData(out, valid, None)
     if isinstance(t, T.DecimalType):
         # arrow decimal -> unscaled int64 (the engine's cents representation)
         if t.precision <= 15:
@@ -306,15 +324,36 @@ def _column_data_to_arrow(cd: ColumnData, t: T.Type):
     vals = np.asarray(cd.values)
     mask = None if cd.valid is None else ~np.asarray(cd.valid)
     if cd.dictionary is not None:
-        strings = np.asarray(cd.dictionary.values, dtype=object)[
-            vals.astype(np.int64)
+        dvals = cd.dictionary.values
+        codes = vals.astype(np.int64)
+        # null rows carry arbitrary codes (and an all-null column has an
+        # EMPTY dictionary): only decode in-range codes of live rows
+        strings = [
+            dvals[int(c)]
+            if 0 <= int(c) < len(dvals)
+            and (mask is None or not mask[i])
+            else None
+            for i, c in enumerate(codes)
         ]
-        return pa.array(strings.tolist(), type=pa.string(), mask=mask)
+        return pa.array(strings, type=pa.string(), mask=mask)
     if isinstance(t, T.DecimalType):
         import decimal
 
-        q = decimal.Decimal(1).scaleb(-t.scale)
-        dec = [decimal.Decimal(int(v)).scaleb(-t.scale) for v in vals]
+        ctx = decimal.Context(prec=60)
+        if vals.ndim == 2:  # long decimal limb planes
+            from trino_tpu.types.int128 import join_py
+
+            dec = [
+                decimal.Decimal(join_py(int(h), int(l))).scaleb(
+                    -t.scale, context=ctx
+                )
+                for h, l in vals
+            ]
+        else:
+            dec = [
+                decimal.Decimal(int(v)).scaleb(-t.scale, context=ctx)
+                for v in vals
+            ]
         return pa.array(dec, type=pa.decimal128(t.precision, t.scale), mask=mask)
     if t is T.DATE:
         return pa.array(vals.astype(np.int32), type=pa.date32(), mask=mask)

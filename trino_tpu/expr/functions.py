@@ -755,7 +755,20 @@ def _date_add_months(ctx, call, a, n):
         jnp.where(nm == 12, ny + 1, ny), jnp.where(nm == 12, 1, nm + 1), jnp.asarray(1)
     ) - _days_from_civil(ny, nm, jnp.asarray(1))
     nd = jnp.minimum(d, last)
-    return Val(_days_from_civil(ny, nm, nd), _and_valid(a.valid, n.valid), call.type)
+    days = _days_from_civil(ny, nm, nd)
+    valid = _and_valid(a.valid, n.valid)
+    if a.type is T.TIMESTAMP:
+        # keep the time-of-day: shift only the calendar day component
+        tod = jnp.asarray(a.data, jnp.int64) % 86_400_000_000
+        return Val(days * 86_400_000_000 + tod, valid, call.type)
+    if a.type is T.TIMESTAMP_TZ:
+        p = jnp.asarray(a.data, jnp.int64)
+        off = T.unpack_tz_offset(p)
+        local_ms = T.unpack_tz_millis(p) + off * 60_000
+        tod_ms = local_ms % 86_400_000
+        utc_ms = days * 86_400_000 + tod_ms - off * 60_000
+        return Val(utc_ms * T.TZ_SHIFT + (off + T.TZ_OFFSET_BIAS), valid, call.type)
+    return Val(days, valid, call.type)
 
 
 @register("date_trunc_month")
@@ -978,6 +991,18 @@ def _with_timezone(ctx, call, v, zone):
     utc = local_millis - off * 60_000
     return Val(
         utc * T.TZ_SHIFT + (off + T.TZ_OFFSET_BIAS), v.valid, T.TIMESTAMP_TZ
+    )
+
+
+@register("$tz_add_micros")
+def _tz_add_micros(ctx, call, v, delta):
+    """timestamptz + day-second interval: shift the UTC instant, keep the
+    zone offset (reference: DateTimeOperators tz + interval)."""
+    p = jnp.asarray(v.data, jnp.int64)
+    off = p % T.TZ_SHIFT
+    millis = T.unpack_tz_millis(p) + jnp.asarray(delta.data, jnp.int64) // 1000
+    return Val(
+        millis * T.TZ_SHIFT + off, _and_valid(v.valid, delta.valid), T.TIMESTAMP_TZ
     )
 
 
@@ -1389,6 +1414,12 @@ def compile_cast(ctx: ExprCompiler, v: Val, to: T.Type) -> Val:
         return Val(jnp.asarray(v.data).astype(to.np_dtype), v.valid, to)
     if to is T.DATE and frm is T.TIMESTAMP:
         return Val(jnp.asarray(v.data, jnp.int64) // 86_400_000_000, v.valid, to)
+    if to is T.TIME and frm is T.TIMESTAMP:
+        return Val(
+            jnp.asarray(v.data, jnp.int64) % 86_400_000_000, v.valid, to
+        )
+    if to is T.TIMESTAMP and frm is T.TIME:
+        return Val(jnp.asarray(v.data, jnp.int64), v.valid, to)
     if to is T.TIMESTAMP and frm is T.DATE:
         return Val(jnp.asarray(v.data, jnp.int64) * 86_400_000_000, v.valid, to)
     # timestamptz conversions (session zone = UTC; reference:
@@ -1448,6 +1479,29 @@ def _parse_scalar(s: str, to: T.Type):
         return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
     if to is T.BOOLEAN:
         return s.lower() in ("true", "t", "1")
+    if to is T.TIME:
+        parts = s.split(":")
+        h = int(parts[0]) if parts and parts[0] else 0
+        mi = int(parts[1]) if len(parts) > 1 else 0
+        sec = float(parts[2]) if len(parts) > 2 else 0.0
+        return (h * 3600 + mi * 60) * 1_000_000 + int(round(sec * 1_000_000))
+    if to is T.TIMESTAMP:
+        import datetime
+
+        txt = s.replace("T", " ")
+        if " " in txt:
+            d, tm = txt.split(" ", 1)
+        else:
+            d, tm = txt, "00:00:00"
+        y, m, dd = map(int, d.split("-"))
+        parts = tm.split(":")
+        h = int(parts[0]) if parts and parts[0] else 0
+        mi = int(parts[1]) if len(parts) > 1 else 0
+        sec = float(parts[2]) if len(parts) > 2 else 0.0
+        days = (datetime.date(y, m, dd) - datetime.date(1970, 1, 1)).days
+        return days * 86_400_000_000 + (h * 3600 + mi * 60) * 1_000_000 + int(
+            round(sec * 1_000_000)
+        )
     raise ValueError(f"cannot parse {s!r} as {to.name}")
 
 

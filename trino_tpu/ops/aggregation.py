@@ -189,6 +189,10 @@ def _hll_estimate(registers) -> jnp.ndarray:
 def _primitives(spec: AggSpec):
     if spec.name == "approx_distinct":
         return [("hll", spec.arg)]
+    if spec.name == "approx_percentile":
+        # log-bucket quantile sketch (reference: qdigest states), merged by
+        # elementwise count addition
+        return [("qdigest", spec.arg)]
     if spec.name == "count_star":
         return [("count_star", None)]
     if spec.name == "count":
@@ -230,6 +234,8 @@ def _state_types(spec: AggSpec, input_types) -> list[T.Type]:
     for kind, arg in _primitives(spec):
         if kind == "hll":
             out.append(T.ArrayType(T.INTEGER))
+        elif kind == "qdigest":
+            out.append(T.ArrayType(T.BIGINT))
         elif kind in ("count", "count_star"):
             out.append(T.BIGINT)
         elif kind == "checksum":
@@ -259,8 +265,8 @@ def _merge_primitives(spec: AggSpec):
     for kind, _ in prims:
         # counts and moment sums are already-reduced values: merge by adding;
         # HLL registers merge by elementwise max
-        if kind == "hll":
-            merged.append("hll")
+        if kind in ("hll", "qdigest"):
+            merged.append(kind)
         else:
             merged.append(
                 "sum"
@@ -339,6 +345,37 @@ def _finalize(spec: AggSpec, states: list[Column]) -> Column:
     name = spec.name
     if name == "approx_distinct":
         return Column(_hll_estimate(states[0].data), T.BIGINT, None)
+    if name == "approx_percentile":
+        from trino_tpu.ops import qdigest as qd
+
+        p = float(spec.param if spec.param is not None else 0.5)
+        counts = states[0].data
+        if counts.ndim == 2:
+            counts = counts[0]
+        val, total = qd.estimate(counts, p)
+        out_t = spec.out_type
+        if isinstance(out_t, T.DecimalType):
+            if out_t.is_long:
+                # float -> limb planes (values can exceed i64; same split
+                # as the double->long-decimal cast)
+                from trino_tpu.types.int128 import TWO64
+
+                r = jnp.round(val * out_t.scale_factor)
+                h = jnp.floor(r / float(TWO64)).astype(jnp.int64)
+                lf = r - h.astype(jnp.float64) * float(TWO64)
+                l = jnp.where(
+                    lf >= float(1 << 63), lf - float(TWO64), lf
+                ).astype(jnp.int64)
+                return Column(
+                    jnp.stack([h, l], axis=-1)[None, :],
+                    out_t,
+                    (total > 0)[None],
+                )
+            scaled = jnp.round(val * out_t.scale_factor).astype(jnp.int64)
+            return Column(scaled[None], out_t, (total > 0)[None])
+        return Column(
+            val.astype(out_t.np_dtype)[None], out_t, (total > 0)[None]
+        )
     if name in ("count", "count_star"):
         return Column(states[0].data, T.BIGINT, None)
     if name == "checksum":
@@ -547,7 +584,10 @@ class AggregationOperator:
     ):
         # merge: states in -> states out (used to combine partial outputs)
         assert mode in ("single", "partial", "final", "merge")
-        if group_channels and any(s.name == "approx_distinct" for s in aggregates):
+        if group_channels and any(
+            s.name in ("approx_distinct", "approx_percentile")
+            for s in aggregates
+        ):
             # grouped sketches would need [groups, HLL_M] register state;
             # the planner rewrites grouped approx_distinct to exact DISTINCT
             # count instead, so this is unreachable from SQL
@@ -1669,6 +1709,22 @@ class AggregationOperator:
                         )
                         ch += 1
                         continue
+                    if kind == "qdigest":
+                        from trino_tpu.ops import qdigest as qd
+
+                        counts = jnp.sum(
+                            jnp.where(v[:, None], col.data, 0), axis=0
+                        )
+                        states.append(
+                            Column(
+                                counts[None, :],
+                                T.ArrayType(T.BIGINT),
+                                None,
+                                lengths=jnp.full(1, qd.NBUCKETS, jnp.int32),
+                            )
+                        )
+                        ch += 1
+                        continue
                     if (
                         kind == "sum"
                         and isinstance(col.type, T.DecimalType)
@@ -1761,6 +1817,27 @@ class AggregationOperator:
                                 T.ArrayType(T.INTEGER),
                                 None,
                                 lengths=jnp.full(1, HLL_M, jnp.int32),
+                            )
+                        )
+                        continue
+                    if kind == "qdigest":
+                        from trino_tpu.ops import qdigest as qd
+
+                        if col.data.ndim == 2:  # long-decimal limb planes
+                            from trino_tpu.types import int128 as i128
+
+                            f = i128.to_float128(
+                                col.data[:, 0], col.data[:, 1]
+                            ) / float(col.type.scale_factor)
+                        else:
+                            f = _logical_double(col.data, col.type)
+                        counts = qd.histogram(f, v)
+                        states.append(
+                            Column(
+                                counts[None, :],
+                                T.ArrayType(T.BIGINT),
+                                None,
+                                lengths=jnp.full(1, qd.NBUCKETS, jnp.int32),
                             )
                         )
                         continue
@@ -1907,7 +1984,7 @@ class AggregationOperator:
         merger = AggregationOperator(
             list(range(len(self.group_channels))),
             [
-                AggSpec(s.name, self._state_channel(i), s.out_type)
+                AggSpec(s.name, self._state_channel(i), s.out_type, param=s.param)
                 for i, s in enumerate(self.aggregates)
             ],
             [c.type for c in states_batch.columns],

@@ -142,6 +142,10 @@ class _StageScheduler:
             raise RuntimeError("no live workers")
         #: fragment_id -> list[RemoteTaskClient] (producing tasks)
         self._stage_tasks: dict[int, list] = {}
+        #: fragment_id -> {probe symbol name: (lo, hi)} awaiting delivery
+        self._pending_ranges: dict[int, dict] = {}
+        #: fragment ids whose dynamic-filter summaries WILL be fetched
+        self._want_ranges: set = set()
         self._subplans: dict[int, SubPlan] = {}
         #: task_id -> TaskDescriptor (for replacement resubmission)
         self._descs: dict[str, TaskDescriptor] = {}
@@ -293,6 +297,7 @@ class _StageScheduler:
         fid = sub.fragment.id
         if fid in self._stage_tasks:
             return self._stage_tasks[fid]
+        self._collect_dynamic_filters(sub)
         for child in sub.children:
             self._ensure_stage(child)
         if sub.fragment.partitioning.kind not in _DIST:
@@ -312,10 +317,89 @@ class _StageScheduler:
                 output_partitioning=self._output_partitioning(sub),
                 split_mod=(i, w),
                 properties=dict(self.runner.properties._values),
+                dynamic_ranges=dict(self._pending_ranges.get(fid, {})),
+                collect_ranges=fid in self._want_ranges,
             )
             tasks.append(self._submit_on_live(desc, url))
         self._stage_tasks[fid] = tasks
         return tasks
+
+    def _collect_dynamic_filters(self, sub: SubPlan) -> None:
+        """Cross-fragment dynamic filtering (reference:
+        DynamicFilterService + DynamicFiltersFetcher): for an inner join in
+        this fragment whose build AND probe sides both arrive through
+        exchanges, run the build-side stage FIRST, wait for it, collect the
+        workers' per-column value-range summaries, and deliver the probe
+        symbols' ranges inside the probe fragment's task descriptors."""
+        from trino_tpu.planner import plan as P
+
+        def remote_ids(node) -> set:
+            if isinstance(node, RemoteSourceNode):
+                return {node.fragment_id}
+            out: set = set()
+            for c in node.children:
+                out |= remote_ids(c)
+            return out
+
+        def visit(node) -> None:
+            for c in node.children:
+                visit(c)
+            if not (isinstance(node, P.JoinNode) and node.kind == "inner"):
+                return
+            build_ids = remote_ids(node.right)
+            probe_ids = remote_ids(node.left)
+            if not build_ids or not probe_ids:
+                return
+            child_by_id = {c.fragment.id: c for c in sub.children}
+            builds = [child_by_id[f] for f in build_ids if f in child_by_id]
+            probes = [f for f in probe_ids if f in child_by_id]
+            if not builds or not probes:
+                return
+            for bsub in builds:
+                self._want_ranges.add(bsub.fragment.id)
+                tasks = self._ensure_stage(bsub)
+                ranges = self._merged_ranges(tasks)
+                if not ranges:
+                    continue
+                outs = {s.name for s in bsub.fragment.root.outputs}
+                for lsym, rsym in node.criteria:
+                    rng = ranges.get(rsym.name) if rsym.name in outs else None
+                    if rng is None:
+                        continue
+                    for pf in probes:
+                        self._pending_ranges.setdefault(pf, {})[
+                            lsym.name
+                        ] = tuple(rng)
+
+        visit(sub.fragment.root)
+
+    def _merged_ranges(self, tasks) -> dict:
+        """Union of completed build tasks' column ranges ({} on any
+        failure/timeout — dynamic filters are an optimization, never a
+        correctness dependency)."""
+        import json as _json
+
+        merged: dict = {}
+        for t in tasks:
+            if isinstance(t, _LocalResult):
+                return {}
+            try:
+                # the /dynamic endpoint blocks on task completion itself
+                body = _http_get(
+                    f"{t.worker_url}/v1/task/{t.task_id}/dynamic"
+                )
+                ranges = _json.loads(body.decode())
+            except Exception:
+                return {}
+            if t.state() != "FINISHED":
+                return {}
+            for name, (lo, hi) in ranges.items():
+                if name in merged:
+                    mlo, mhi = merged[name]
+                    merged[name] = (min(mlo, lo), max(mhi, hi))
+                else:
+                    merged[name] = (lo, hi)
+        return merged
 
     def _output_partitioning(self, sub: SubPlan) -> Optional[tuple]:
         """How the PARENT consumes this fragment decides the bucket layout

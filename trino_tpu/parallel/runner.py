@@ -532,7 +532,7 @@ class StageExecutor:
             c.type for c in jax.tree.map(lambda x: x[0], states).columns
         ]
         merge_specs = [
-            AggSpec(s.name, partial_op._state_channel(i), s.out_type)
+            AggSpec(s.name, partial_op._state_channel(i), s.out_type, param=s.param)
             for i, s in enumerate(specs)
         ]
         ngroups = len(partial_op.group_channels)

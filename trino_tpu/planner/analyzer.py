@@ -248,9 +248,32 @@ class ExprAnalyzer:
             utc_millis = local_millis - off * 60_000
         return Literal(T.pack_tz(utc_millis, off), T.TIMESTAMP_TZ)
 
+    def _a_TimeLiteral(self, n: ast.TimeLiteral) -> Expr:
+        parts = n.text.strip().split(":")
+        h = int(parts[0]) if parts and parts[0] else 0
+        mi = int(parts[1]) if len(parts) > 1 else 0
+        sec = float(parts[2]) if len(parts) > 2 else 0.0
+        micros = (h * 3600 + mi * 60) * 1_000_000 + int(round(sec * 1_000_000))
+        return Literal(micros, T.TIME)
+
     def _a_IntervalLiteral(self, n: ast.IntervalLiteral) -> Expr:
-        # stands alone only long enough for date arithmetic to consume it
-        raise AnalysisError("INTERVAL literal outside date arithmetic")
+        # first-class interval value (reference: IntervalYearMonthType /
+        # IntervalDayTimeType); date arithmetic still takes its inline
+        # shortcut before this runs
+        count = int(n.value) * n.sign
+        u = n.unit.rstrip("s")
+        if u in ("year", "month"):
+            months = count * (12 if u == "year" else 1)
+            return Literal(months, T.INTERVAL_YEAR_MONTH)
+        mult = {
+            "day": 86_400_000_000,
+            "hour": 3_600_000_000,
+            "minute": 60_000_000,
+            "second": 1_000_000,
+        }.get(u)
+        if mult is None:
+            raise AnalysisError(f"unsupported interval unit {n.unit}")
+        return Literal(count * mult, T.INTERVAL_DAY)
 
     def _a_BinaryOp(self, n: ast.BinaryOp) -> Expr:
         op = n.op
@@ -275,15 +298,76 @@ class ExprAnalyzer:
             return Call("concat", [l, r], T.VARCHAR)
         if op in _ARITH_OPS:
             # date +/- interval
-            if op in ("+", "-") and isinstance(n.right, ast.IntervalLiteral):
+            if (
+                op in ("+", "-")
+                and isinstance(n.right, ast.IntervalLiteral)
+                and not isinstance(n.left, ast.IntervalLiteral)
+            ):
                 return self._date_interval(n.left, n.right, op)
-            if op == "+" and isinstance(n.left, ast.IntervalLiteral):
+            if (
+                op == "+"
+                and isinstance(n.left, ast.IntervalLiteral)
+                and not isinstance(n.right, ast.IntervalLiteral)
+            ):
                 return self._date_interval(n.right, n.left, op)
             l, r = self.analyze(n.left), self.analyze(n.right)
+            iv = self._interval_arith(op, l, r)
+            if iv is not None:
+                return iv
             rt = arith_result_type(op, l.type, r.type)
             name = {"+": "$add", "-": "$sub", "*": "$mul", "/": "$div", "%": "$mod"}[op]
             return Call(name, [l, r], rt)
         raise AnalysisError(f"unsupported operator {op}")
+
+    def _interval_arith(self, op: str, l: Expr, r: Expr):
+        """temporal +/- interval VALUE (column or expression operands;
+        the literal-syntax shortcut in _a_BinaryOp handles the common
+        `date + INTERVAL '1' DAY` spelling before analysis)."""
+        if op not in ("+", "-"):
+            return None
+        temporal = (T.DATE, T.TIMESTAMP, T.TIMESTAMP_TZ)
+        ilt = l.type in (T.INTERVAL_YEAR_MONTH, T.INTERVAL_DAY)
+        irt = r.type in (T.INTERVAL_YEAR_MONTH, T.INTERVAL_DAY)
+        if irt and l.type in temporal:
+            base, delta = l, r
+        elif ilt and r.type in temporal and op == "+":
+            base, delta = r, l
+        elif ilt and irt and l.type == r.type:
+            # interval +/- interval of the same kind
+            return Call(
+                "$add" if op == "+" else "$sub", [l, r], l.type
+            )
+        else:
+            return None
+        if op == "-":
+            delta = Call("$neg", [delta], delta.type)
+        if delta.type is T.INTERVAL_YEAR_MONTH:
+            return Call("date_add_months", [base, delta], base.type)
+        # day-second interval: micros arithmetic
+        if base.type is T.TIMESTAMP_TZ:
+            # the packed (millis*4096 + offset) value needs unpack/repack
+            return Call("$tz_add_micros", [base, delta], T.TIMESTAMP_TZ)
+        if base.type is T.DATE:
+            from trino_tpu.expr.constant_folding import try_fold
+
+            folded = try_fold(delta)
+            if isinstance(folded, Literal) and folded.value is not None:
+                us = int(folded.value)
+                if us % 86_400_000_000 != 0:
+                    # reference: DateTimeOperators refuses sub-day interval
+                    # components on a DATE
+                    raise AnalysisError(
+                        "cannot add an interval with a time component to a date"
+                    )
+                return Call(
+                    "date_add_days",
+                    [base, Literal(us // 86_400_000_000, T.BIGINT)],
+                    T.DATE,
+                )
+            # non-constant interval: lift to timestamp (documented
+            # divergence; the reference raises only on sub-day components)
+            base = SpecialForm(Form.CAST, [base], T.TIMESTAMP)
+        return Call("$add", [base, delta], base.type)
 
     def _date_interval(self, date_node, interval: ast.IntervalLiteral, op: str):
         d = self.analyze(date_node)

@@ -99,7 +99,7 @@ def agg_result_type(name: str, arg_type: T.Type | None, arg_type2: T.Type | None
         return T.BOOLEAN
     if name in MOMENT_AGGS:
         return T.DOUBLE
-    if name == "percentile":
+    if name in ("percentile", "approx_percentile"):
         return arg_type
     if name == "array_agg":
         return T.ArrayType(arg_type)

@@ -1115,6 +1115,14 @@ class LogicalPlanner:
             else:
                 fname = AGG_FUNCS[sql_name]
                 if fname == "percentile":
+                    if (
+                        sql_name == "approx_percentile"
+                        and not (spec.group_by or extra_keys)
+                    ):
+                        # global form: mergeable log-bucket sketch (bounded
+                        # state, reference: qdigest); grouped form stays the
+                        # exact sort-based percentile
+                        fname = "approx_percentile"
                     if len(fn_args) != 2:
                         # weighted / accuracy signatures would silently give
                         # wrong numbers — reject anything but (value, frac)

@@ -14,10 +14,22 @@ from trino_tpu.expr.ir import and_
 from trino_tpu.planner import plan as P
 
 
-def _rewrite_bottom_up(node: P.PlanNode, rules) -> P.PlanNode:
+#: rule-fire counters of the LAST optimize() call (reference: the
+#: IterativeOptimizer rule stats surfaced by EXPLAIN) — read by EXPLAIN
+LAST_RULE_STATS: dict = {}
+
+
+def _rule_name(rule) -> str:
+    n = getattr(rule, "__name__", None)
+    return n if n and n != "<lambda>" else "eliminate_cross_joins"
+
+
+def _rewrite_bottom_up(node: P.PlanNode, rules, stats=None) -> P.PlanNode:
     kids = node.children
     if kids:
-        node = node.with_children([_rewrite_bottom_up(c, rules) for c in kids])
+        node = node.with_children(
+            [_rewrite_bottom_up(c, rules, stats) for c in kids]
+        )
     changed = True
     while changed:
         changed = False
@@ -26,6 +38,9 @@ def _rewrite_bottom_up(node: P.PlanNode, rules) -> P.PlanNode:
             if out is not None:
                 node = out
                 changed = True
+                if stats is not None:
+                    name = _rule_name(rule)
+                    stats[name] = stats.get(name, 0) + 1
     return node
 
 
@@ -234,6 +249,183 @@ def rule_remove_identity_project(node: P.PlanNode):
     return None
 
 
+def rule_remove_trivial_filter(node: P.PlanNode):
+    """Filter(TRUE) -> source; Filter(FALSE/NULL) -> empty Values
+    (reference: iterative/rule/RemoveTrivialFilters.java)."""
+    from trino_tpu.expr.ir import Literal
+
+    if not isinstance(node, P.FilterNode):
+        return None
+    p = node.predicate
+    if isinstance(p, Literal):
+        if p.value is True:
+            return node.source
+        if p.value in (False, None):
+            return P.ValuesNode(list(node.source.outputs), [])
+    return None
+
+
+def rule_merge_limits(node: P.PlanNode):
+    """Limit(a, Limit(b, x)) -> Limit(min(a,b), x) (reference:
+    iterative/rule/MergeLimits.java); only when neither carries OFFSET."""
+    if not (
+        isinstance(node, P.LimitNode)
+        and isinstance(node.source, P.LimitNode)
+        and node.offset == 0
+        and node.source.offset == 0
+        and node.count is not None
+        and node.source.count is not None
+    ):
+        return None
+    return P.LimitNode(
+        node.source.source, min(node.count, node.source.count)
+    )
+
+
+def rule_push_limit_through_project(node: P.PlanNode):
+    """Limit(Project(x)) -> Project(Limit(x)) (reference:
+    iterative/rule/PushLimitThroughProject.java): limiting first shrinks
+    the projected batch's static shape."""
+    if not (
+        isinstance(node, P.LimitNode)
+        and isinstance(node.source, P.ProjectNode)
+        and node.offset == 0
+    ):
+        return None
+    proj = node.source
+    return P.ProjectNode(
+        P.LimitNode(proj.source, node.count, node.offset), proj.assignments
+    )
+
+
+def rule_push_limit_through_union(node: P.PlanNode):
+    """Limit(Union(c_i)) -> Limit(Union(Limit(c_i))) (reference:
+    iterative/rule/PushLimitThroughUnion.java) — every branch needs at most
+    `count` rows.  Fires once per shape (guarded by the inner limits)."""
+    if not (
+        isinstance(node, P.LimitNode)
+        and isinstance(node.source, P.UnionNode)
+        and node.offset == 0
+        and node.count is not None
+    ):
+        return None
+    u = node.source
+    if all(
+        isinstance(s, P.LimitNode) and s.count is not None
+        and s.count <= node.count
+        for s in u.sources
+    ):
+        return None
+    capped = [
+        s
+        if isinstance(s, P.LimitNode)
+        and s.count is not None
+        and s.count <= node.count
+        else P.LimitNode(s, node.count)
+        for s in u.sources
+    ]
+    return P.LimitNode(
+        P.UnionNode(capped, u.symbols, u.source_symbols), node.count
+    )
+
+
+def rule_limit_over_values(node: P.PlanNode):
+    """Limit(Values) folds at plan time (reference:
+    iterative/rule/EvaluateZeroLimit + constant-folded inputs)."""
+    if not (
+        isinstance(node, P.LimitNode)
+        and isinstance(node.source, P.ValuesNode)
+        and node.count is not None
+    ):
+        return None
+    v = node.source
+    lo = node.offset
+    hi = lo + node.count
+    if lo == 0 and hi >= len(v.rows):
+        return v
+    return P.ValuesNode(v.symbols, v.rows[lo:hi])
+
+
+def rule_remove_redundant_sort(node: P.PlanNode):
+    """Aggregation/MarkDistinct over a Sort (possibly through projections)
+    drops the sort: grouped reduction is order-insensitive (reference:
+    iterative/rule/RemoveRedundantSort.java family)."""
+    if not isinstance(node, (P.AggregationNode, P.MarkDistinctNode)):
+        return None
+    # walk through row-preserving projections to find the sort
+    chain = []
+    cur = node.source
+    while isinstance(cur, P.ProjectNode):
+        chain.append(cur)
+        cur = cur.source
+    if not isinstance(cur, P.SortNode):
+        return None
+    rebuilt = cur.source
+    for proj in reversed(chain):
+        rebuilt = P.ProjectNode(rebuilt, proj.assignments)
+    return node.with_children([rebuilt] + list(node.children[1:]))
+
+
+def rule_remove_redundant_distinct(node: P.PlanNode):
+    """DISTINCT (group-by-all-no-aggregates) over an aggregation already
+    grouped on the same keys — possibly through a pure renaming projection
+    — is a no-op (reference: iterative/rule/RemoveRedundantDistinct
+    semantics)."""
+    from trino_tpu.expr.ir import SymbolRef
+
+    if not (
+        isinstance(node, P.AggregationNode) and not node.aggregations
+    ):
+        return None
+    src = node.source
+    rename: dict = {}
+    if isinstance(src, P.ProjectNode):
+        if not all(isinstance(e, SymbolRef) for _, e in src.assignments):
+            return None
+        rename = {s.name: e.name for s, e in src.assignments}
+        src = src.source
+    if not isinstance(src, P.AggregationNode):
+        return None
+    outer_keys = {rename.get(s.name, s.name) for s in node.group_symbols}
+    if outer_keys == {s.name for s in src.group_symbols}:
+        return node.source  # the inner agg (through the projection if any)
+    return None
+
+
+def rule_merge_adjacent_projects(node: P.PlanNode):
+    """Project(Project(x)) -> one Project with inlined assignments
+    (reference: iterative/rule/InlineProjections.java).  Expressions are
+    deterministic and XLA CSE dedupes any duplicated subtrees."""
+    from trino_tpu.expr.ir import substitute_symbols
+
+    if not (
+        isinstance(node, P.ProjectNode)
+        and isinstance(node.source, P.ProjectNode)
+    ):
+        return None
+    inner = node.source
+    mapping = {s.name: e for s, e in inner.assignments}
+    merged = [
+        (s, substitute_symbols(e, mapping)) for s, e in node.assignments
+    ]
+    return P.ProjectNode(inner.source, merged)
+
+
+def rule_limit_to_topn(node: P.PlanNode):
+    """Limit(Sort(x)) -> TopN (reference: iterative/rule/CreateTopN) —
+    in case the syntactic lowering missed a shape (e.g. after other
+    rewrites re-exposed it)."""
+    if not (
+        isinstance(node, P.LimitNode)
+        and isinstance(node.source, P.SortNode)
+        and node.count is not None
+        and node.offset == 0
+    ):
+        return None
+    s = node.source
+    return P.TopNNode(s.source, s.orderings, node.count)
+
+
 def optimize(plan: P.OutputNode, rules=None, catalogs=None) -> P.OutputNode:
     from trino_tpu.planner.join_planning import (
         eliminate_cross_joins,
@@ -245,6 +437,7 @@ def optimize(plan: P.OutputNode, rules=None, catalogs=None) -> P.OutputNode:
         rules = [
             rule_fold_constants,
             rule_merge_filters,
+            rule_remove_trivial_filter,
             push_filter_through_semijoin,
             lambda n: eliminate_cross_joins(n, catalogs),
             push_filter_through_join,
@@ -254,7 +447,15 @@ def optimize(plan: P.OutputNode, rules=None, catalogs=None) -> P.OutputNode:
             rule_push_filter_through_aggregation,
             rule_push_filter_into_scan,
             rule_remove_identity_project,
+            rule_merge_adjacent_projects,
             rule_mixed_distinct,
+            rule_merge_limits,
+            rule_push_limit_through_project,
+            rule_push_limit_through_union,
+            rule_limit_over_values,
+            rule_limit_to_topn,
+            rule_remove_redundant_sort,
+            rule_remove_redundant_distinct,
         ]
     # iterate whole-tree passes to fixpoint: rules unlock each other (e.g.
     # cross-join elimination creates filters that then push into scans),
@@ -262,14 +463,17 @@ def optimize(plan: P.OutputNode, rules=None, catalogs=None) -> P.OutputNode:
     # normalizes (merges the planner's cascaded single-conjunct filters) so
     # whole-predicate rules see the complete conjunct set.
     normalize = [rule_fold_constants, rule_merge_filters]
+    stats: dict = {}
     prev = None
     for _ in range(10):
         plan = _rewrite_bottom_up(plan, normalize)
-        plan = _rewrite_bottom_up(plan, rules)
+        plan = _rewrite_bottom_up(plan, rules, stats)
         fp = plan_fingerprint(plan)
         if fp == prev:
             break
         prev = fp
+    global LAST_RULE_STATS
+    LAST_RULE_STATS = stats
     from trino_tpu.planner.pruning import prune
 
     plan = prune(plan)

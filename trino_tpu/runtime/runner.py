@@ -362,6 +362,14 @@ class LocalQueryRunner:
             text = fragment_text(sub)
         else:
             text = plan_text(self.plan_query(inner.query))
+            from trino_tpu.planner import optimizer as _opt
+
+            if _opt.LAST_RULE_STATS:
+                fires = ", ".join(
+                    f"{k}={v}"
+                    for k, v in sorted(_opt.LAST_RULE_STATS.items())
+                )
+                text += f"\nrule fires: {fires}"
         return MaterializedResult(
             ["Query Plan"], [(line,) for line in text.splitlines()], [T.VARCHAR]
         )
@@ -555,10 +563,12 @@ class LocalQueryRunner:
 
         cat, schema, table = self._resolve_table(stmt.name)
         conn = self.catalogs.get(cat)
-        if stmt.if_not_exists and table in conn.metadata().list_tables(schema):
-            return _ok("CREATE TABLE")
-        cols = [ColumnMeta(n, T.parse_type(t)) for n, t in stmt.columns]
         self.access_control.check_can_write(self.user, cat, schema, table)
+        if table in conn.metadata().list_tables(schema):
+            if stmt.if_not_exists:
+                return _ok("CREATE TABLE")
+            raise ValueError(f"table '{cat}.{schema}.{table}' already exists")
+        cols = [ColumnMeta(n, T.parse_type(t)) for n, t in stmt.columns]
         self.transactions.notify_write(cat, schema, table)
         conn.create_table(schema, table, cols)
         self.grants.set_owner(cat, schema, table, self.user)
@@ -569,13 +579,15 @@ class LocalQueryRunner:
 
         cat, schema, table = self._resolve_table(stmt.name)
         conn = self.catalogs.get(cat)
-        if stmt.if_not_exists and table in conn.metadata().list_tables(schema):
-            return _ok("CREATE TABLE AS")
+        self.access_control.check_can_write(self.user, cat, schema, table)
+        if table in conn.metadata().list_tables(schema):
+            if stmt.if_not_exists:
+                return _ok("CREATE TABLE AS")
+            raise ValueError(f"table '{cat}.{schema}.{table}' already exists")
         result = self._run_query(stmt.query)
         cols = [
             ColumnMeta(n, t) for n, t in zip(result.column_names, result.types)
         ]
-        self.access_control.check_can_write(self.user, cat, schema, table)
         self.transactions.notify_write(cat, schema, table)
         conn.create_table(schema, table, cols)
         self.grants.set_owner(cat, schema, table, self.user)

@@ -61,6 +61,13 @@ class TaskDescriptor:
     split_mod: Optional[tuple] = None
     #: session properties to apply
     properties: dict = field(default_factory=dict)
+    #: cross-fragment dynamic filters: probe symbol name -> (lo, hi) raw
+    #: device-representation bounds (reference: DynamicFilterService summary
+    #: delivery into task descriptors)
+    dynamic_ranges: dict = field(default_factory=dict)
+    #: compute the dynamic-filter range summary for this task's output
+    #: (set only on build-side fragments the coordinator will query)
+    collect_ranges: bool = False
 
 
 class _FilteringConnector:
@@ -93,6 +100,9 @@ class _Task:
         self.state = "RUNNING"
         self.error: Optional[str] = None
         self.buckets: list = []
+        #: per-output-symbol (lo, hi) value bounds of this task's result
+        #: (the dynamic-filter summary the coordinator may collect)
+        self.ranges: dict = {}
         self.done = threading.Event()
 
 
@@ -168,6 +178,20 @@ class WorkerServer:
                     ).encode()
                     return self._bytes(200, body, "text/plain")
                 if (
+                    len(parts) == 4
+                    and parts[:2] == ["v1", "task"]
+                    and parts[3] == "dynamic"
+                ):
+                    t = worker._tasks.get(parts[2])
+                    if t is None:
+                        return self._bytes(404, b"no such task", "text/plain")
+                    t.done.wait(timeout=600)
+                    import json as _json
+
+                    return self._bytes(
+                        200, _json.dumps(t.ranges).encode(), "application/json"
+                    )
+                if (
                     len(parts) == 5
                     and parts[:2] == ["v1", "task"]
                     and parts[3] == "results"
@@ -222,7 +246,7 @@ class WorkerServer:
     def _run(self, t: _Task) -> None:
         self._slots.acquire()
         try:
-            t.buckets = self._execute(t.desc)
+            t.buckets, t.ranges = self._execute(t.desc)
             t.state = "FINISHED"
         except Exception:
             t.state = "FAILED"
@@ -256,6 +280,10 @@ class WorkerServer:
         lp = LocalExecutionPlanner(
             catalogs, target_splits=props.get("target_splits"), properties=props
         )
+        # coordinator-delivered dynamic filters fuse into this fragment's
+        # scans exactly like locally-registered build ranges
+        for name, rng in (desc.dynamic_ranges or {}).items():
+            lp.dynamic_filters[name] = tuple(rng)
         saved = lp.plan
 
         def hook(node):
@@ -270,18 +298,58 @@ class WorkerServer:
         out = lp.plan(desc.fragment_root)
         batches = [b for b in out.stream]
         if not batches:
-            return [batches_to_bytes([])] * (
+            empty = [batches_to_bytes([])] * (
                 desc.output_partitioning[1] if desc.output_partitioning else 1
             )
+            return empty, {}
+        ranges = (
+            _result_ranges(batches, desc.output_symbols)
+            if desc.collect_ranges
+            else {}
+        )
         if desc.output_partitioning is None:
-            return [batches_to_bytes(batches)]
+            return [batches_to_bytes(batches)], ranges
         channels, n = desc.output_partitioning
         host = concat_batches(batches)
         import jax
 
         host = jax.device_get(host)
         buckets = partition_batches([host], channels, n)
-        return [batches_to_bytes(bs) for bs in buckets]
+        return [batches_to_bytes(bs) for bs in buckets], ranges
+
+
+def _result_ranges(batches, symbols) -> dict:
+    """{symbol name: [lo, hi]} over 1-D numeric result columns (the
+    dynamic-filter summary; dictionary/limb-plane/bool columns skipped)."""
+    import jax
+    import numpy as np
+
+    out: dict = {}
+    for i, sym in enumerate(symbols):
+        lo = hi = None
+        for b in batches:
+            c = b.columns[i]
+            d = np.asarray(jax.device_get(c.data))
+            if d.ndim != 1 or c.dictionary is not None or d.dtype == np.bool_:
+                lo = None
+                break
+            if not np.issubdtype(d.dtype, np.number):
+                lo = None
+                break
+            live = np.asarray(jax.device_get(b.mask()))
+            if c.valid is not None:
+                live = live & np.asarray(jax.device_get(c.valid))
+            if not live.any():
+                continue
+            vals = d[live]
+            blo, bhi = vals.min(), vals.max()
+            lo = blo if lo is None else min(lo, blo)
+            hi = bhi if hi is None else max(hi, bhi)
+        if lo is not None and hi is not None:
+            out[sym.name] = [int(lo), int(hi)] if np.issubdtype(
+                type(lo), np.integer
+            ) else [float(lo), float(hi)]
+    return out
 
 
 class _FilteringCatalogs:

@@ -47,6 +47,11 @@ class DateLiteral(Node):
 
 
 @dataclass(frozen=True)
+class TimeLiteral(Node):
+    text: str
+
+
+@dataclass(frozen=True)
 class TimestampLiteral(Node):
     text: str
 

@@ -452,10 +452,20 @@ class Parser:
         else:
             source = ast.TableRef(self.qualified_name())
         source_alias = None
+        source_columns = ()
         if self.accept_kw("as"):
             source_alias = self.ident()
-        elif self.peek().kind == "ident" and self.peek(1).is_kw("on"):
+        elif self.peek().kind == "ident" and (
+            self.peek(1).is_kw("on")
+            or (self.peek(1).kind == "op" and self.peek(1).value == "(")
+        ):
             source_alias = self.ident()
+        if source_alias is not None and self.accept_op("("):
+            cols = [self.ident()]
+            while self.accept_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+            source_columns = tuple(cols)
         self.expect_kw("on")
         on = self._expr()
         cases = []
@@ -508,6 +518,16 @@ class Parser:
                 raise ParseError("expected UPDATE/DELETE/INSERT", act)
         if not cases:
             raise ParseError("MERGE requires at least one WHEN clause", self.peek())
+        if source_columns:
+            # wrap column aliases up front: the runner consumes the source
+            # relation verbatim (s(k, v) renames ride AliasedRelation)
+            rel = (
+                ast.SubqueryRelation(source)
+                if isinstance(source, ast.Query)
+                else source
+            )
+            source = ast.AliasedRelation(rel, source_alias, source_columns)
+            source_alias = None
         return ast.MergeStatement(
             target, target_alias, source, source_alias, on, tuple(cases)
         )
@@ -1178,6 +1198,12 @@ class Parser:
                 e = ast.TimestampLiteral(self.next().value)
             else:
                 e = ast.Identifier(("timestamp",))
+        elif (
+            t.kind in ("ident", "keyword")
+            and t.value.lower() == "time"
+            and self.peek().kind == "string"
+        ):
+            e = ast.TimeLiteral(self.next().value)
         elif (
             t.kind == "ident"
             and t.value.lower() == "decimal"

@@ -30,6 +30,8 @@ __all__ = [
     "TIMESTAMP",
     "TIMESTAMP_TZ",
     "INTERVAL_DAY",
+    "INTERVAL_YEAR_MONTH",
+    "TIME",
     "pack_tz",
     "unpack_tz_millis",
     "unpack_tz_offset",
@@ -130,6 +132,12 @@ def unpack_tz_offset(packed):
     return packed % TZ_SHIFT - TZ_OFFSET_BIAS
 #: interval day-to-second, microseconds, i64
 INTERVAL_DAY = _Simple("interval day to second", np.int64)
+#: time of day, microseconds since midnight, i64
+#: (reference: spi/type/TimeType.java, p=6 equivalent)
+TIME = _Simple("time", np.int64)
+#: interval year-to-month, whole months, i64
+#: (reference: type/IntervalYearMonthType.java over int months)
+INTERVAL_YEAR_MONTH = _Simple("interval year to month", np.int64)
 
 
 class _Unknown(Type):
@@ -321,6 +329,9 @@ _SIMPLE_BY_NAME = {
         DATE,
         TIMESTAMP,
         TIMESTAMP_TZ,
+        TIME,
+        INTERVAL_DAY,
+        INTERVAL_YEAR_MONTH,
         UNKNOWN,
     )
 }
