@@ -122,3 +122,28 @@ def test_dead_worker_blocks_query():
     # recovery: the remote worker heartbeats again and queries proceed
     r.failure_detector.heartbeat("remote-worker-9")
     assert r.execute(SQL).row_count == 5
+
+def test_spool_rides_filesystem_spi(tmp_path):
+    """The spool resolves its storage through the filesystem SPI; remote
+    schemes fail loudly at configuration time."""
+    import pytest as _pt
+
+    from trino_tpu.runtime.fte import SpoolManager
+
+    s = SpoolManager(str(tmp_path / "spool"))
+    import numpy as np
+
+    from trino_tpu.columnar import Batch, Column
+    from trino_tpu import types as T
+
+    b = Batch([Column(np.arange(4), T.BIGINT)], np.ones(4, bool))
+    from trino_tpu.planner.plan import Symbol
+
+    syms = [Symbol("x", T.BIGINT)]
+    s.save("q1", 0, [b], syms)
+    assert s.exists("q1", 0)
+    out = s.load("q1", 0, syms, [None])
+    assert np.array_equal(np.asarray(out[0].columns[0].data), np.arange(4))
+
+    with _pt.raises(NotImplementedError, match="s3"):
+        SpoolManager("s3://bucket/spool")

@@ -152,7 +152,15 @@ class IcebergConnector(Connector):
     name = "iceberg"
 
     def __init__(self, root: str):
-        self.root = root
+        from trino_tpu.filesystem import filesystem_for, strip_scheme
+
+        # the filesystem SPI resolves the warehouse location (rejects
+        # remote schemes loudly); metadata versions, snapshot commits, and
+        # data-file writes go through self.fs — schema/table LISTING still
+        # uses local os walks (directory-shape discovery, the remaining
+        # seam when an object-store implementation lands)
+        self.fs = filesystem_for(root)
+        self.root = strip_scheme(root)
         self._metadata = _IcebergMetadata(self)
 
     def metadata(self) -> _IcebergMetadata:
@@ -171,10 +179,9 @@ class IcebergConnector(Connector):
 
     def _versions(self, schema: str, table: str) -> list[int]:
         d = self._meta_dir(schema, table)
-        if not os.path.isdir(d):
-            return []
         out = []
-        for f in os.listdir(d):
+        for p in self.fs.list(d):
+            f = os.path.basename(p)
             if f.startswith("v") and f.endswith(".json"):
                 try:
                     out.append(int(f[1:-5]))
@@ -186,18 +193,21 @@ class IcebergConnector(Connector):
         vs = self._versions(schema, table)
         if not vs:
             raise KeyError(f"iceberg table {schema}.{table} does not exist")
-        with open(os.path.join(self._meta_dir(schema, table), f"v{vs[-1]}.json")) as f:
-            return json.load(f)
+        return json.loads(
+            self.fs.read(
+                os.path.join(self._meta_dir(schema, table), f"v{vs[-1]}.json")
+            )
+        )
 
     def _store(self, schema: str, table: str, md: dict) -> None:
         d = self._meta_dir(schema, table)
-        os.makedirs(d, exist_ok=True)
+        self.fs.mkdirs(d)
         vs = self._versions(schema, table)
         v = (vs[-1] + 1) if vs else 1
-        tmp = os.path.join(d, f".v{v}.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(md, f, indent=1)
-        os.replace(tmp, os.path.join(d, f"v{v}.json"))  # atomic commit
+        # fs.write publishes atomically (temp + rename), the commit contract
+        self.fs.write(
+            os.path.join(d, f"v{v}.json"), json.dumps(md, indent=1).encode()
+        )
 
     @staticmethod
     def _snapshot(md: dict, snapshot_id: Optional[int]) -> dict:
@@ -447,9 +457,10 @@ class _IcebergSink:
         ]
         tbl = pa.table(dict(zip(self.names, arrays)))
         ddir = os.path.join(self.conn._dir(self.handle.schema, base), "data")
-        os.makedirs(ddir, exist_ok=True)
+        self.conn.fs.mkdirs(ddir)
         fname = f"{uuid.uuid4().hex}.parquet"
-        pq.write_table(tbl, os.path.join(ddir, fname))
+        with self.conn.fs.open_output(os.path.join(ddir, fname)) as f:
+            pq.write_table(tbl, f)
         self.conn.commit_append(
             self.handle.schema, base, os.path.join("data", fname), rows
         )

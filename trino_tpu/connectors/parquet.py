@@ -94,23 +94,23 @@ def _array_to_column_data(arr, t: T.Type) -> ColumnData:
         codes = np.asarray(dict_arr.indices.fill_null(0))
         return ColumnData(remap[np.clip(codes.astype(np.int64), 0, len(remap) - 1)], valid, d)
     if isinstance(t, T.DecimalType) and t.is_long:
-        # arrow decimal128 -> two-limb planes (types/int128.py)
-        import decimal as _d
-
-        from trino_tpu.types.int128 import split_py
-
-        ctx = _d.Context(prec=60)
-        out = np.zeros((len(arr), 2), dtype=np.int64)
-        for i, v in enumerate(arr.to_pylist()):
-            if v is not None:
-                out[i, 0], out[i, 1] = split_py(
-                    int(v.scaleb(t.scale, context=ctx))
-                )
+        # arrow decimal128 stores each value as 16 little-endian two's
+        # complement bytes == exactly our (lo, hi) limb pair; a buffer view
+        # avoids any per-row Python arithmetic (the arrow scale matches the
+        # engine type's scale by construction of _arrow_to_type)
+        buf = arr.buffers()[1]
+        words = np.frombuffer(buf, dtype="<i8", count=2 * (arr.offset + len(arr)))
+        words = words[2 * arr.offset :].reshape(-1, 2)
+        out = np.empty((len(arr), 2), dtype=np.int64)
+        out[:, 0] = words[:, 1]  # high limb
+        out[:, 1] = words[:, 0]  # low limb bit pattern
         valid = (
             None
             if arr.null_count == 0
             else np.asarray(arr.is_valid())
         )
+        if valid is not None:
+            out[~valid] = 0
         return ColumnData(out, valid, None)
     if isinstance(t, T.DecimalType):
         # arrow decimal -> unscaled int64 (the engine's cents representation)
@@ -326,16 +326,14 @@ def _column_data_to_arrow(cd: ColumnData, t: T.Type):
     if cd.dictionary is not None:
         dvals = cd.dictionary.values
         codes = vals.astype(np.int64)
-        # null rows carry arbitrary codes (and an all-null column has an
-        # EMPTY dictionary): only decode in-range codes of live rows
-        strings = [
-            dvals[int(c)]
-            if 0 <= int(c) < len(dvals)
-            and (mask is None or not mask[i])
-            else None
-            for i, c in enumerate(codes)
+        if not dvals:  # all-null column: empty dictionary, mask covers rows
+            return pa.array([None] * len(codes), type=pa.string())
+        # null rows carry arbitrary codes: clip (pa.array's mask nulls the
+        # masked rows regardless of the clipped placeholder value)
+        arr = np.asarray(dvals, dtype=object)[
+            np.clip(codes, 0, len(dvals) - 1)
         ]
-        return pa.array(strings, type=pa.string(), mask=mask)
+        return pa.array(arr.tolist(), type=pa.string(), mask=mask)
     if isinstance(t, T.DecimalType):
         import decimal
 

@@ -202,13 +202,7 @@ def _from_py(v, t: T.Type) -> Literal:
     if t is T.DATE and isinstance(v, int):
         return Literal(v, t)
     if t is T.TIME and isinstance(v, str):
-        parts = v.strip().split(":")
-        h = int(parts[0]) if parts and parts[0] else 0
-        mi = int(parts[1]) if len(parts) > 1 else 0
-        sec = float(parts[2]) if len(parts) > 2 else 0.0
-        return Literal(
-            (h * 3600 + mi * 60) * 1_000_000 + int(round(sec * 1_000_000)), t
-        )
+        return Literal(T.parse_time_micros(v), t)
     if isinstance(v, str) and t.np_dtype.kind in "iu" and not T.is_string_kind(t):
         # no host parse rule for this target: leave the cast unfolded
         raise ValueError(f"unfoldable cast to {t.name}")

@@ -1480,11 +1480,7 @@ def _parse_scalar(s: str, to: T.Type):
     if to is T.BOOLEAN:
         return s.lower() in ("true", "t", "1")
     if to is T.TIME:
-        parts = s.split(":")
-        h = int(parts[0]) if parts and parts[0] else 0
-        mi = int(parts[1]) if len(parts) > 1 else 0
-        sec = float(parts[2]) if len(parts) > 2 else 0.0
-        return (h * 3600 + mi * 60) * 1_000_000 + int(round(sec * 1_000_000))
+        return T.parse_time_micros(s)
     if to is T.TIMESTAMP:
         import datetime
 
@@ -1494,14 +1490,8 @@ def _parse_scalar(s: str, to: T.Type):
         else:
             d, tm = txt, "00:00:00"
         y, m, dd = map(int, d.split("-"))
-        parts = tm.split(":")
-        h = int(parts[0]) if parts and parts[0] else 0
-        mi = int(parts[1]) if len(parts) > 1 else 0
-        sec = float(parts[2]) if len(parts) > 2 else 0.0
         days = (datetime.date(y, m, dd) - datetime.date(1970, 1, 1)).days
-        return days * 86_400_000_000 + (h * 3600 + mi * 60) * 1_000_000 + int(
-            round(sec * 1_000_000)
-        )
+        return days * 86_400_000_000 + T.parse_time_micros(tm)
     raise ValueError(f"cannot parse {s!r} as {to.name}")
 
 

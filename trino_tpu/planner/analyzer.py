@@ -249,12 +249,10 @@ class ExprAnalyzer:
         return Literal(T.pack_tz(utc_millis, off), T.TIMESTAMP_TZ)
 
     def _a_TimeLiteral(self, n: ast.TimeLiteral) -> Expr:
-        parts = n.text.strip().split(":")
-        h = int(parts[0]) if parts and parts[0] else 0
-        mi = int(parts[1]) if len(parts) > 1 else 0
-        sec = float(parts[2]) if len(parts) > 2 else 0.0
-        micros = (h * 3600 + mi * 60) * 1_000_000 + int(round(sec * 1_000_000))
-        return Literal(micros, T.TIME)
+        try:
+            return Literal(T.parse_time_micros(n.text), T.TIME)
+        except ValueError as e:
+            raise AnalysisError(str(e))
 
     def _a_IntervalLiteral(self, n: ast.IntervalLiteral) -> Expr:
         # first-class interval value (reference: IntervalYearMonthType /

@@ -30,9 +30,16 @@ class SpoolManager:
     FileSystemExchangeManager / LocalFileSystemExchangeStorage)."""
 
     def __init__(self, directory: Optional[str] = None):
+        from trino_tpu.filesystem import filesystem_for, strip_scheme
+
         self._own = directory is None
-        self.dir = directory or tempfile.mkdtemp(prefix="trino_tpu_spool_")
-        os.makedirs(self.dir, exist_ok=True)
+        # the filesystem SPI resolves the location (and rejects remote
+        # schemes loudly until an object-store implementation lands)
+        self.fs = filesystem_for(directory)
+        self.dir = strip_scheme(
+            directory or tempfile.mkdtemp(prefix="trino_tpu_spool_")
+        )
+        self.fs.mkdirs(self.dir)
 
     def _path(self, query_id: str, fragment_id: int) -> str:
         return os.path.join(self.dir, f"{query_id}_f{fragment_id}.npz")
@@ -47,7 +54,7 @@ class SpoolManager:
                 if c.valid is not None:
                     arrays[f"b{bi}_c{ci}_valid"] = np.asarray(c.valid)
         path = self._path(query_id, fragment_id)
-        with open(path, "wb") as f:
+        with self.fs.open_output(path) as f:  # streaming: no double-buffer
             np.savez(f, **arrays)
         return path
 
@@ -56,9 +63,9 @@ class SpoolManager:
         from trino_tpu.columnar import Batch, Column
 
         path = self._path(query_id, fragment_id)
-        if not os.path.exists(path):
+        if not self.fs.exists(path):
             return None
-        z = np.load(path, allow_pickle=False)
+        z = np.load(self.fs.open_input(path), allow_pickle=False)
         out = []
         for bi in range(int(z["__nbatches__"])):
             cols = []
@@ -72,12 +79,16 @@ class SpoolManager:
         return out
 
     def exists(self, query_id: str, fragment_id: int) -> bool:
-        return os.path.exists(self._path(query_id, fragment_id))
+        return self.fs.exists(self._path(query_id, fragment_id))
 
     def close(self) -> None:
         """Remove spooled intermediates (query finished); only directories
         this manager created are deleted."""
         if self._own:
+            # through the SPI: spool cleanup must follow the files wherever
+            # they live, not assume a local tree
+            for p in list(self.fs.list(self.dir)):
+                self.fs.delete(p)
             import shutil
 
             shutil.rmtree(self.dir, ignore_errors=True)

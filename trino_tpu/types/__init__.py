@@ -30,6 +30,7 @@ __all__ = [
     "TIMESTAMP",
     "TIMESTAMP_TZ",
     "INTERVAL_DAY",
+    "parse_time_micros",
     "INTERVAL_YEAR_MONTH",
     "TIME",
     "pack_tz",
@@ -342,6 +343,18 @@ _SIMPLE_BY_NAME["json"] = VARCHAR
 _SIMPLE_BY_NAME["varchar"] = VARCHAR
 _SIMPLE_BY_NAME["varbinary"] = VARBINARY
 _SIMPLE_BY_NAME["string"] = VARCHAR  # convenience alias
+
+
+def parse_time_micros(text: str) -> int:
+    """'HH:MM:SS(.fff)?' -> microseconds since midnight, range-checked
+    (reference: TimeType parsing rejects out-of-range components)."""
+    parts = text.strip().split(":")
+    h = int(parts[0]) if parts and parts[0] else 0
+    mi = int(parts[1]) if len(parts) > 1 else 0
+    sec = float(parts[2]) if len(parts) > 2 else 0.0
+    if not (0 <= h < 24 and 0 <= mi < 60 and 0.0 <= sec < 60.0):
+        raise ValueError(f"invalid TIME value: {text!r}")
+    return (h * 3600 + mi * 60) * 1_000_000 + int(round(sec * 1_000_000))
 
 
 def parse_type(text: str) -> Type:
