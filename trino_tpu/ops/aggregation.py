@@ -581,6 +581,7 @@ class AggregationOperator:
         use_pallas: bool = False,
         pre_step=None,
         pre_key=None,
+        pre_jit=None,
     ):
         # merge: states in -> states out (used to combine partial outputs)
         assert mode in ("single", "partial", "final", "merge")
@@ -608,8 +609,13 @@ class AggregationOperator:
         #: through memory between the project and the partial aggregation
         self._pre = pre_step
         self._pre_key = pre_key
+        #: jitted standalone projection (for paths that must materialize the
+        #: projected batch OUTSIDE the fused reduce, e.g. the positional
+        #: group path whose eligibility reads concrete key stats)
+        self._pre_jit = pre_jit
         self._acc: list[Batch] = []
         self._per_batch: Optional["AggregationOperator"] = None
+        self._unfused_twin: Optional["AggregationOperator"] = None
         key = (
             tuple(self.group_channels),
             tuple(self.aggregates),
@@ -1902,6 +1908,7 @@ class AggregationOperator:
             mode=per_mode,
             pre_step=self._pre if per_mode == "partial" else None,
             pre_key=self._pre_key if per_mode == "partial" else None,
+            pre_jit=self._pre_jit if per_mode == "partial" else None,
         )
         op._group_src_channels = getattr(self, "_group_src_channels", None)
         return op
@@ -1922,6 +1929,24 @@ class AggregationOperator:
             batch, src_channels=getattr(per_batch, "_group_src_channels", None)
         ) is not None:
             return per_batch._step(batch, out_cap=batch.capacity)
+        if per_batch._pre is not None and per_batch._pre_jit is not None:
+            # non-direct group keys (e.g. bigint orderkeys): the positional
+            # path needs the PROJECTED batch for key stats, so materialize
+            # the projection once and reduce through an unfused twin.
+            # group_channels/input_types/spec.arg all describe the
+            # POST-projection layout already (the fused op applies pre
+            # first inside its own step), so the twin's config is correct
+            # for the projected batch it is fed.
+            if self._unfused_twin is None:
+                self._unfused_twin = AggregationOperator(
+                    per_batch.group_channels,
+                    per_batch.aggregates,
+                    per_batch.input_types,
+                    mode=per_batch.mode,
+                )
+            return self._unfused_twin._reduce_full(
+                per_batch._pre_jit(batch)
+            )
         return per_batch._reduce_full(batch)
 
     def push(self, batch: Batch) -> None:

@@ -340,6 +340,7 @@ class LocalExecutionPlanner:
                 use_pallas=self.properties.get("pallas_agg"),
                 pre_step=pre_raw,
                 pre_key=pre_key,
+                pre_jit=pre._step if pre_raw is not None else None,
             )
             op._group_src_channels = group_src
             return op
