@@ -45,6 +45,31 @@ _PROBE_CODE = "import jax; jax.devices(); print(jax.default_backend())"
 _EXTRA_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_EXTRA.json")
 
 
+def _deep_merge(base: dict, updates: dict) -> dict:
+    """Recursive dict merge: update values win, sibling sections survive."""
+    out = dict(base)
+    for k, v in updates.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _merge_extra(updates: dict) -> None:
+    """Merge `updates` into BENCH_EXTRA.json instead of rewriting it — a
+    suite run must never silently drop sections an earlier run recorded
+    (the SF10 walls were lost exactly that way after c807a39)."""
+    existing: dict = {}
+    try:
+        with open(_EXTRA_PATH) as f:
+            existing = dict(json.load(f))
+    except (OSError, ValueError, TypeError):
+        pass
+    with open(_EXTRA_PATH, "w") as f:
+        json.dump(_deep_merge(existing, updates), f, indent=1)
+
+
 def _probe_backend(timeout: float = 90.0) -> tuple:
     """Check in a throwaway subprocess whether the ambient backend (TPU via
     axon, or whatever JAX_PLATFORMS points at) can initialize.  Returns
@@ -301,6 +326,88 @@ def _extra_configs(args, deadline: float) -> dict:
     return out
 
 
+#: measured in a fresh child (the 8-virtual-worker mesh needs
+#: xla_force_host_platform_device_count set BEFORE jax initializes); prints
+#: exactly one JSON line with the mesh-vs-local Q6 walls and the
+#: per-fragment breakdown from the mesh profile
+_MESH_CODE = """
+import json, time
+import jax
+jax.config.update("jax_enable_x64", True)
+from trino_tpu.runtime.runner import LocalQueryRunner
+from trino_tpu.parallel import DistributedQueryRunner
+from trino_tpu.connectors.tpch.queries import QUERIES
+schema = "@SCHEMA@"
+runs = @RUNS@
+local = LocalQueryRunner(schema=schema, target_splits=8)
+dist = DistributedQueryRunner(n_workers=8, schema=schema)
+
+def warm(r):
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        r.execute(QUERIES[6])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+t0 = time.perf_counter()
+d_rows = dist.execute(QUERIES[6]).rows
+mesh_cold = time.perf_counter() - t0
+mesh_warm = warm(dist)
+t0 = time.perf_counter()
+l_rows = local.execute(QUERIES[6]).rows
+local_cold = time.perf_counter() - t0
+local_warm = warm(local)
+prof = dist.last_mesh_profile
+print(json.dumps({
+    "schema": schema,
+    "workers": dist.wm.n,
+    "q6_local_warm_s": round(local_warm, 4),
+    "q6_local_cold_s": round(local_cold, 4),
+    "q6_mesh8_warm_s": round(mesh_warm, 4),
+    "q6_mesh8_cold_s": round(mesh_cold, 4),
+    "mesh_over_local_warm": round(mesh_warm / max(local_warm, 1e-9), 3),
+    "matches_local": sorted(map(str, d_rows)) == sorted(map(str, l_rows)),
+    "profile": prof.to_json() if prof is not None else None,
+}), flush=True)
+"""
+
+
+def _run_mesh(args) -> dict:
+    """Mesh-vs-local Q6 walls + per-fragment profile, recorded under the
+    'mesh' section keyed by schema (so sf1/sf10 runs coexist).  The child
+    is a sanitized local-CPU interpreter with an 8-device virtual mesh
+    unless a real multi-device backend is ambient."""
+    from _cleanenv import cpu_env
+
+    schema = _schema_for_sf(float(os.environ.get("BENCH_MESH_SF", args.sf)))
+    env = cpu_env(os.environ, n_virtual_devices=8)
+    code = _MESH_CODE.replace("@SCHEMA@", schema).replace(
+        "@RUNS@", str(max(1, args.runs // 2))
+    )
+    timeout = float(os.environ.get("BENCH_MESH_TIMEOUT", 1200))
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {schema: {"error": f"mesh bench timed out after {timeout:.0f}s"}}
+    lines = [l for l in (r.stdout or "").splitlines() if l.startswith("{")]
+    if r.returncode != 0 or not lines:
+        tail = " | ".join((r.stderr or "").strip().splitlines()[-3:])
+        return {
+            schema: {"error": f"mesh child rc={r.returncode}: {tail}"[:500]}
+        }
+    # "error": None clears a stale failure key a previous run may have
+    # deep-merged into this schema's section
+    return {schema: {"error": None, **json.loads(lines[-1])}}
+
+
 def _schema_for_sf(sf: float) -> str:
     try:
         from trino_tpu.connectors.tpch.schema import SCHEMAS
@@ -349,20 +456,68 @@ def _child_main(args) -> None:
         try:
             extra = _run_suite(args, _schema_for_sf(args.sf))
             extra["headline"] = payload
-            with open(_EXTRA_PATH, "w") as f:
-                json.dump(extra, f, indent=1)
+            _merge_extra(extra)
         except Exception as exc:
-            with open(_EXTRA_PATH, "w") as f:
-                json.dump({"error": f"{type(exc).__name__}: {exc}"[:500]}, f)
+            _merge_extra(
+                {"suite_error": f"{type(exc).__name__}: {exc}"[:500]}
+            )
+    if (
+        args.suite
+        or args.mesh
+        or os.environ.get("BENCH_SUITE") == "1"
+        or os.environ.get("BENCH_MESH") == "1"
+    ):
+        try:
+            # success clears any stale run_error a previous attempt merged
+            _merge_extra({"mesh": {**_run_mesh(args), "run_error": None}})
+        except Exception as exc:
+            _merge_extra(
+                {"mesh": {"run_error": f"{type(exc).__name__}: {exc}"[:500]}}
+            )
+
+
+def _extra_child_budget(args) -> float:
+    """Seconds the measured child may legitimately spend AFTER the headline
+    line (suite + mesh sections): the supervisor must not kill it mid-way
+    or the side-file sections are silently absent AND the mesh grandchild
+    is orphaned."""
+    extra = 0.0
+    if args.suite or os.environ.get("BENCH_SUITE") == "1":
+        try:
+            extra += float(os.environ.get("BENCH_BUDGET_S", 900)) + 300
+        except ValueError:
+            extra += 1200
+    if (
+        args.suite
+        or getattr(args, "mesh", False)
+        or os.environ.get("BENCH_SUITE") == "1"
+        or os.environ.get("BENCH_MESH") == "1"
+    ):
+        try:
+            extra += float(os.environ.get("BENCH_MESH_TIMEOUT", 1200)) + 60
+        except ValueError:
+            extra += 1260
+    return extra
 
 
 def _supervise(cmd, env, timeout: float) -> bool:
     """Run the measured child, STREAMING its stdout to ours line-by-line so
     an already-printed headline survives a later hang/kill.  Returns True if
-    at least one line was forwarded."""
+    at least one line was forwarded.  The child runs in its own process
+    group so a timeout kill also reaches grandchildren (the mesh bench
+    subprocess)."""
+    import signal
+
     proc = subprocess.Popen(
-        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, start_new_session=True,
     )
+
+    def _kill():
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
     got = False
     deadline = time.monotonic() + timeout
     import selectors
@@ -373,7 +528,7 @@ def _supervise(cmd, env, timeout: float) -> bool:
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            proc.kill()
+            _kill()
             break
         if not sel.select(timeout=min(remaining, 5.0)):
             if proc.poll() is not None:
@@ -389,7 +544,7 @@ def _supervise(cmd, env, timeout: float) -> bool:
     try:
         proc.wait(timeout=5)
     except subprocess.TimeoutExpired:
-        proc.kill()
+        _kill()
     return got
 
 
@@ -403,6 +558,12 @@ def main() -> None:
         action="store_true",
         help="after the headline line, also measure Q1/Q6/Q3/Q18 + extras "
         "into BENCH_EXTRA.json (default: headline only)",
+    )
+    ap.add_argument(
+        "--mesh",
+        action="store_true",
+        help="after the headline line, measure mesh-8 vs single-worker Q6 "
+        "walls + per-fragment profile into BENCH_EXTRA.json's mesh section",
     )
     ap.add_argument(
         "--tpu-timeout",
@@ -440,7 +601,11 @@ def main() -> None:
         child_env["_TRINO_TPU_BENCH_CHILD"] = "1"
         child_env["_TRINO_TPU_BENCH_PLATFORM"] = platform
         child_env["_TRINO_TPU_BENCH_FORENSICS"] = json.dumps(tpu_forensics)
-        if _supervise([sys.executable] + sys.argv, child_env, args.tpu_timeout):
+        if _supervise(
+            [sys.executable] + sys.argv,
+            child_env,
+            args.tpu_timeout + _extra_child_budget(args),
+        ):
             return
         platform = ""  # TPU attempt failed: fall through to CPU child
         tpu_forensics["probe_error"] = (
@@ -456,7 +621,11 @@ def main() -> None:
     env["_TRINO_TPU_BENCH_CHILD"] = "1"
     env["_TRINO_TPU_BENCH_PLATFORM"] = "cpu"
     env["_TRINO_TPU_BENCH_FORENSICS"] = json.dumps(tpu_forensics)
-    if not _supervise([sys.executable] + sys.argv, env, max(args.tpu_timeout, 480)):
+    if not _supervise(
+        [sys.executable] + sys.argv,
+        env,
+        max(args.tpu_timeout, 480) + _extra_child_budget(args),
+    ):
         # last-ditch: the contract is one JSON line, no matter what
         print(
             json.dumps(

@@ -215,3 +215,100 @@ def test_grouped_distinct_stays_distributed(dist, local):
     txt = dist.explain_distributed(sql)
     assert "FIXED_HASH[l_returnflag]" in txt
     assert dist.execute(sql).rows == local.execute(sql).rows
+
+
+# -- PR 1: device-resident mesh pipeline + per-fragment profile --
+
+
+def test_mesh_profile_breakdown(dist):
+    """Every distributed query records a per-fragment, per-phase breakdown
+    whose phases sum to the fragment wall (the `other` bucket absorbs the
+    untracked remainder, so the invariant is exact)."""
+    dist.execute(
+        "select n_regionkey, count(*), sum(n_nationkey) from nation "
+        "group by n_regionkey"
+    )
+    prof = dist.last_mesh_profile
+    assert prof is not None and prof.fragments
+    for st in prof.fragments.values():
+        assert st.kind, "partitioning handle recorded per fragment"
+        assert set(st.phases) >= {
+            "trace", "compute", "collective", "transfer", "other"
+        }
+        # `other` absorbs the untracked remainder, so the sum matches the
+        # wall up to timer skew between adjacent perf_counter windows
+        assert abs(sum(st.phases.values()) - st.wall_s) <= max(
+            0.005, 0.05 * st.wall_s
+        )
+    # the JSON form (bench evidence) carries the same fields
+    js = prof.to_json()
+    assert js["fragments"] and "trace_cache" in js
+    assert all("phases_ms" in f and "kind" in f for f in js["fragments"])
+
+
+def test_mesh_no_host_roundtrip_between_fragments(dist):
+    """A multi-fragment mesh query hands batches between distributed
+    fragments as device-resident sharded arrays: the host_restack counter
+    (host batches re-entering the mesh mid-query) and host_gather counter
+    (device results pulled to host before the final result read) both stay
+    zero — only the root result_gather touches the host."""
+    sql = (
+        "select n_regionkey, count(*), sum(n_nationkey) from nation "
+        "group by n_regionkey"
+    )
+    dist.execute(sql)
+    prof = dist.last_mesh_profile
+    assert len(prof.fragments) >= 2, "expected a multi-fragment plan"
+    assert prof.counters.get("host_restack", 0) == 0
+    assert prof.counters.get("host_gather", 0) == 0
+    assert prof.counters.get("result_gather", 0) >= 1
+
+
+def test_mesh_trace_cache_warm_zero_retraces(dist):
+    """Repeated same-bucket batches reuse compiled SPMD programs: after a
+    warmup execution, re-running the query performs ZERO retraces and the
+    trace cache reports hits (the per-execution recompile was the dominant
+    mesh cost before the trace cache)."""
+    from trino_tpu.parallel.spmd import TRACE_CACHE
+
+    sql = (
+        "select o_orderstatus, count(*) from orders "
+        "where o_totalprice > 1000 group by o_orderstatus"
+    )
+    dist.execute(sql)  # warmup: traces + compiles
+    r0 = TRACE_CACHE.retraces
+    dist.execute(sql)
+    prof = dist.last_mesh_profile
+    assert TRACE_CACHE.retraces == r0, "warm run must not retrace"
+    assert prof.retraces == 0
+    assert prof.trace_hits > 0 and prof.trace_misses == 0
+
+
+def test_explain_analyze_distributed_shows_fragment_phases(dist):
+    """EXPLAIN ANALYZE on a distributed query renders the per-fragment
+    collective/compute/transfer timings and the trace-cache counters."""
+    out = dist.execute(
+        "explain analyze select n_regionkey, count(*) from nation "
+        "group by n_regionkey"
+    )
+    text = "\n".join(r[0] for r in out.rows)
+    assert "Mesh execution profile" in text
+    assert "Fragment" in text and "collective=" in text
+    assert "compute=" in text and "transfer=" in text
+    assert "trace cache:" in text
+
+
+def test_string_join_distinct_dictionaries_recode(dist, local):
+    """Each join side bakes its OWN dictionary-recode table into its
+    compiled program; the trace-cache keys must differ even when both key
+    columns sit at the same channel index (regression: a shared key reused
+    side A's translation table for side B, silently corrupting the join)."""
+    sql = (
+        "select count(*) from "
+        "(select l_linestatus s from lineitem where l_orderkey < 100) l "
+        "join (select o_orderstatus s2 from orders where o_orderkey < 100) o "
+        "on l.s = o.s2"
+    )
+    d = dist.execute(sql).rows
+    l = local.execute(sql).rows
+    assert d == l and d[0][0] > 0, (d, l)

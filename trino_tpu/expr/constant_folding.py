@@ -60,16 +60,31 @@ def _try_fold_uncached(e: Expr, _memo: dict) -> Expr:
             ):
                 return Literal("".join(vals), e.type)
             if e.name == "$neg":
-                return Literal(-vals[0], e.type)
+                return _from_py(-vals[0], e.type, wrap_ints=True)
             if e.name in ("$add", "$sub", "$mul", "$div"):
                 a, b = _to_py(kids[0]), _to_py(kids[1])
+
+                def _int_div():
+                    # exact truncate-toward-zero, matching the device
+                    # integer division (float a/b corrupts above 2**53)
+                    if not b:
+                        return None
+                    q = abs(a) // abs(b)
+                    return q if (a >= 0) == (b >= 0) else -q
+
                 out = {
                     "$add": lambda: a + b,
                     "$sub": lambda: a - b,
                     "$mul": lambda: a * b,
-                    "$div": lambda: a / b if b else None,
+                    "$div": lambda: (
+                        _int_div()
+                        if T.is_integer_kind(e.type)
+                        else (a / b if b else None)
+                    ),
                 }[e.name]()
-                return _from_py(out, e.type)
+                # integer arithmetic wraps (matching the device column
+                # path's two's-complement overflow); only CASTS null
+                return _from_py(out, e.type, wrap_ints=True)
             if e.name in ("$eq", "$ne", "$lt", "$le", "$gt", "$ge"):
                 a, b = _to_py(kids[0]), _to_py(kids[1])
                 out = {
@@ -183,13 +198,27 @@ def _to_py(lit: Literal):
     return lit.value
 
 
-def _from_py(v, t: T.Type) -> Literal:
+def _from_py(v, t: T.Type, wrap_ints: bool = False) -> Literal:
     if v is None:
         return Literal(None, t)
     if isinstance(t, T.DecimalType):
         return Literal(Decimal(str(v)), t)
     if T.is_integer_kind(t):
-        return Literal(int(v), t)
+        import numpy as np
+
+        iv = int(v)
+        info = np.iinfo(t.np_dtype)
+        if not int(info.min) <= iv <= int(info.max):
+            if wrap_ints:
+                # arithmetic overflow wraps two's-complement, exactly like
+                # the unfolded device column path
+                m = 1 << info.bits
+                iv = ((iv + (m >> 1)) % m) - (m >> 1)
+            else:
+                # casts NULL on overflow, matching compile_cast (and
+                # np.int64(huge) would crash the compiler otherwise)
+                return Literal(None, t)
+        return Literal(iv, t)
     if t.name in ("double", "real"):
         return Literal(float(v), t)
     if t is T.DATE and isinstance(v, str):

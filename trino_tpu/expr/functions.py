@@ -1324,6 +1324,30 @@ def compile_cast(ctx: ExprCompiler, v: Val, to: T.Type) -> Val:
     if T.is_string_kind(frm):
         # varchar -> numeric/date via dictionary table
         d = _require_dict(v, "cast from varchar")
+        if isinstance(to, T.DecimalType) and to.is_long:
+            # long-decimal target: the scaled value needs up to 128 bits, so
+            # the parse table is two int64 limb planes (types/int128.split_py)
+            # — a single int64 table would overflow on assignment for >18
+            # digit values and silently NULL a representable number
+            from trino_tpu.types.int128 import split_py
+
+            table2 = np.zeros((len(d), 2), dtype=np.int64)
+            ok = np.ones(len(d), dtype=bool)
+            bound = 10**to.precision
+            for i, s in enumerate(d.values):
+                try:
+                    x = _parse_scalar(s, to)
+                    if not -bound < x < bound:
+                        raise ValueError("out of decimal range")
+                    table2[i, 0], table2[i, 1] = split_py(x)
+                except (ValueError, ArithmeticError):
+                    ok[i] = False
+            codes = jnp.asarray(v.data, jnp.int32)
+            data = jnp.take(jnp.asarray(table2), codes, axis=0, mode="clip")
+            valid = _and_valid(
+                v.valid, jnp.take(jnp.asarray(ok), codes, mode="clip")
+            )
+            return Val(data, valid, to)
         table = np.zeros(len(d), dtype=to.np_dtype)
         ok = np.ones(len(d), dtype=bool)
         for i, s in enumerate(d.values):
@@ -1391,19 +1415,41 @@ def compile_cast(ctx: ExprCompiler, v: Val, to: T.Type) -> Val:
             return Val(_to_float(v), v.valid, to)
         if to.name in ("bigint", "integer", "smallint", "tinyint"):
             h, l = _to_planes(v, 0)
-            return Val(l.astype(to.np_dtype), v.valid, to)
+            # range check (reference: Int128Math overflow on narrowing cast):
+            # the value fits i64 iff the high limb is pure sign extension of
+            # the low limb; narrower targets additionally bound the low limb.
+            # Out-of-range values become NULL (the engine's lazy device
+            # pipeline cannot raise data-dependently inside jit) instead of
+            # silently wrapping to the unrelated low limb.
+            fits = jnp.logical_or(
+                jnp.logical_and(h == 0, l >= 0),
+                jnp.logical_and(h == -1, l < 0),
+            )
+            if to.name != "bigint":
+                info = np.iinfo(to.np_dtype)
+                fits = jnp.logical_and(
+                    fits,
+                    jnp.logical_and(l >= int(info.min), l <= int(info.max)),
+                )
+            return Val(l.astype(to.np_dtype), _and_valid(v.valid, fits), to)
         raise NotImplementedError(f"cast {frm.name} -> {to.name}")
     if to.name in ("double", "real"):
         return Val(_to_float(v).astype(to.np_dtype), v.valid, to)
     if to.name in ("bigint", "integer", "smallint", "tinyint"):
         if isinstance(frm, T.DecimalType):
-            return Val(
-                _rescale_decimal(jnp.asarray(v.data, jnp.int64), frm.scale, 0).astype(
-                    to.np_dtype
-                ),
-                v.valid,
-                to,
-            )
+            r = _rescale_decimal(jnp.asarray(v.data, jnp.int64), frm.scale, 0)
+            valid = v.valid
+            if to.name != "bigint":
+                # same NULL-on-overflow contract as the long-decimal cast:
+                # a short decimal can still exceed int/smallint/tinyint
+                info = np.iinfo(to.np_dtype)
+                valid = _and_valid(
+                    valid,
+                    jnp.logical_and(
+                        r >= int(info.min), r <= int(info.max)
+                    ),
+                )
+            return Val(r.astype(to.np_dtype), valid, to)
         if frm.name in ("double", "real"):
             f = _to_float(v)
             return Val(

@@ -309,34 +309,60 @@ def _sum128(d, gid, nseg: int, valid, in_precision: int = None):
     group-count-sized widen is free next to the row-sized reduction)."""
     from trino_tpu.types import int128 as i128
 
+    rows = d.shape[0]
+    #: per-row magnitude under which `rows` addends provably sum inside i64
+    thr = ((1 << 63) - 1) // max(rows, 1)
     if d.ndim == 2:
-        # the precision bound can prove the HIGH limb never needs chunking:
-        # |hi| <= 10**p / 2**64, so hi sums stay in i64 when that times the
-        # row count is < 2**62
+        h = jnp.asarray(d[:, 0], jnp.int64)
+        l = jnp.asarray(d[:, 1], jnp.int64)
+        if valid is not None:
+            h = jnp.where(valid, h, 0)
+            l = jnp.where(valid, l, 0)
+        # Runtime-adaptive narrow path (the common TPC-H shape: a product
+        # typed decimal(25+) whose actual values are ~10 digits).  One cheap
+        # pass proves the batch's values are i64 (high limb == sign
+        # extension) and small enough that `rows` of them can't overflow an
+        # i64 accumulator; lax.cond then runs a single segment sum instead
+        # of the 3-4 chunk-plane sums.  Exact either way — the check reads
+        # the data, not the (over-wide) declared precision.
+        fits = jnp.logical_and(
+            jnp.all(h == (l >> 63)),
+            jnp.logical_and(jnp.max(l) < thr, jnp.min(l) > -thr),
+        )
         hi_direct = (
             in_precision is not None
-            and ((10**in_precision >> 64) + 1) * d.shape[0] < (1 << 62)
+            and ((10**in_precision >> 64) + 1) * rows < (1 << 62)
         )
-        h, l = i128.segment_sum128(
-            jnp.asarray(d[:, 0], jnp.int64),
-            jnp.asarray(d[:, 1], jnp.int64),
-            gid,
-            nseg,
-            valid=valid,
-            hi_direct=hi_direct,
-        )
+
+        def _fast(_):
+            return i128.widen64(jax.ops.segment_sum(l, gid, nseg))
+
+        def _wide(_):
+            return i128.segment_sum128(
+                h, l, gid, nseg, valid=None, hi_direct=hi_direct
+            )
+
+        h, l = jax.lax.cond(fits, _fast, _wide, None)
     else:
         d = jnp.asarray(d, jnp.int64)
+        if valid is not None:
+            d = jnp.where(valid, d, 0)
         if (
             in_precision is not None
-            and (10**in_precision) * d.shape[0] < (1 << 63)
+            and (10**in_precision) * rows < (1 << 63)
         ):
-            red = jax.ops.segment_sum(
-                jnp.where(valid, d, 0) if valid is not None else d, gid, nseg
-            )
+            red = jax.ops.segment_sum(d, gid, nseg)
             h, l = i128.widen64(red)
         else:
-            h, l = i128.sum128_widened(d, gid, nseg, valid=valid)
+            fits = jnp.logical_and(jnp.max(d) < thr, jnp.min(d) > -thr)
+
+            def _fast(_):
+                return i128.widen64(jax.ops.segment_sum(d, gid, nseg))
+
+            def _wide(_):
+                return i128.sum128_widened(d, gid, nseg, valid=None)
+
+            h, l = jax.lax.cond(fits, _fast, _wide, None)
     return jnp.stack([h, l], axis=-1)
 
 

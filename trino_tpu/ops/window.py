@@ -379,10 +379,14 @@ class WindowOperator:
             v = jnp.logical_and(v, jnp.take(col.valid, perm, mode="clip"))
         if name in ("sum", "avg", "count"):
             if d.ndim > 1:
-                raise NotImplementedError(
-                    "window aggregation over a long-decimal input column "
-                    "(cast to decimal(18,s) or double first)"
-                )
+                if name != "count":
+                    raise NotImplementedError(
+                        "window sum/avg over a long-decimal input column "
+                        "(cast to decimal(18,s) or double first)"
+                    )
+                # count reads only the validity mask: a 1-D surrogate keeps
+                # the shared sum/count reduction below shape-correct
+                d = jnp.zeros(d.shape[0], dtype=jnp.int64)
             dd = jnp.where(v, d, 0).astype(
                 jnp.float64 if jnp.issubdtype(d.dtype, jnp.floating) else jnp.int64
             )

@@ -16,7 +16,7 @@ pattern, with the host sync standing in for buffer backpressure).
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -24,7 +24,7 @@ import numpy as np
 
 from trino_tpu.columnar import Batch, Column
 from trino_tpu.ops.common import next_pow2
-from trino_tpu.parallel.spmd import WorkerMesh, spmd_collective_step
+from trino_tpu.parallel.spmd import WorkerMesh
 
 _MIX = np.uint64(0x9E3779B97F4A7C15)
 
@@ -126,46 +126,101 @@ def _exchange_kernel(key_channels, n_workers, slot_cap):
     return kernel
 
 
+def exchange_slot_cap(
+    stacked: Batch, key_channels: Sequence[int], wm: WorkerMesh
+) -> int:
+    """Phase 1 of the two-step exchange: a (cached) jitted counts pass, one
+    tiny [W, W] host sync, and the pow2 slot-capacity bucket.  The bucket is
+    what lets the fused phase-2 program cache across executions."""
+    from trino_tpu.parallel.spmd import cached_spmd_step
+
+    counts_fn = cached_spmd_step(
+        wm,
+        ("exchange_counts", tuple(key_channels), wm.n),
+        lambda: _counts_kernel(key_channels, wm.n),
+        collective=True,
+    )
+    counts = np.asarray(counts_fn(stacked))  # [W, W]
+    return next_pow2(max(1, int(counts.max())), floor=64)
+
+
+def fused_repartition(
+    stacked: Batch,
+    key_channels: Sequence[int],
+    wm: WorkerMesh,
+    consumer=None,
+    key: tuple = (),
+    slot_cap: Optional[int] = None,
+) -> Batch:
+    """Hash-repartition a stacked [W, cap] batch so equal keys land on the
+    same worker, running bucketize + all_to_all (+ the consumer's first
+    step, when given) as ONE compiled program.  Returns a stacked
+    [W, W*slot_cap] batch — or the consumer's output shape.
+
+    `consumer` is a per-worker Batch -> Batch step applied to the received
+    batch INSIDE the same jit (the reference's exchange-then-operator pair
+    collapsed into one task); `key` must fingerprint it for the trace
+    cache (empty key + consumer=None is the plain repartition)."""
+    from trino_tpu.parallel.spmd import cached_spmd_step
+
+    assert consumer is None or key, "a fused consumer needs a cache key"
+    if slot_cap is None:
+        slot_cap = exchange_slot_cap(stacked, key_channels, wm)
+
+    def build():
+        ex_k = _exchange_kernel(key_channels, wm.n, slot_cap)
+        if consumer is None:
+            return ex_k
+
+        def kernel(st: Batch):
+            out = ex_k(st)
+            b = jax.tree.map(lambda x: x[0], out)
+            ob = consumer(b)
+            return jax.tree.map(lambda x: x[None], ob)
+
+        return kernel
+
+    fn = cached_spmd_step(
+        wm,
+        ("fused_exchange", tuple(key_channels), slot_cap) + tuple(key),
+        build,
+        collective=True,
+    )
+    return fn(stacked)
+
+
 def repartition(stacked: Batch, key_channels: Sequence[int], wm: WorkerMesh) -> Batch:
     """Hash-repartition a stacked [W, cap] batch so equal keys land on the
     same worker.  Returns a stacked [W, W*slot_cap] batch."""
-    from jax.sharding import PartitionSpec as P
+    return fused_repartition(stacked, key_channels, wm)
 
-    from trino_tpu.parallel.spmd import shard_map_compat
 
-    counts_fn = jax.jit(
-        shard_map_compat(
-            _counts_kernel(key_channels, wm.n), wm.mesh, P("workers"), P("workers")
+def _broadcast_kernel(st: Batch):
+    b = jax.tree.map(lambda x: x[0], st)
+
+    def bcast(x):
+        g = jax.lax.all_gather(x, "workers")  # [W, cap, ...]
+        return g.reshape((-1,) + g.shape[2:])
+
+    cols = [
+        Column(
+            bcast(c.data),
+            c.type,
+            None if c.valid is None else bcast(c.valid),
+            c.dictionary,
         )
-    )
-    counts = np.asarray(counts_fn(stacked))  # [W, W]
-    slot_cap = next_pow2(max(1, int(counts.max())), floor=64)
-    fn = spmd_collective_step(wm, _exchange_kernel(key_channels, wm.n, slot_cap))
-    return fn(stacked)
+        for c in b.columns
+    ]
+    out = Batch(cols, bcast(b.mask()))
+    return jax.tree.map(lambda x: x[None], out)
 
 
 def broadcast(stacked: Batch, wm: WorkerMesh) -> Batch:
     """Replicate every worker's rows to all workers (FIXED_BROADCAST /
     BroadcastOutputBuffer role): stacked [W, cap] -> stacked [W, W*cap]."""
+    from trino_tpu.parallel.spmd import cached_spmd_step
 
-    def kernel(st: Batch):
-        b = jax.tree.map(lambda x: x[0], st)
-
-        def bcast(x):
-            g = jax.lax.all_gather(x, "workers")  # [W, cap, ...]
-            return g.reshape((-1,) + g.shape[2:])
-
-        cols = [
-            Column(
-                bcast(c.data),
-                c.type,
-                None if c.valid is None else bcast(c.valid),
-                c.dictionary,
-            )
-            for c in b.columns
-        ]
-        out = Batch(cols, bcast(b.mask()))
-        return jax.tree.map(lambda x: x[None], out)
-
-    fn = spmd_collective_step(wm, kernel)
+    fn = cached_spmd_step(
+        wm, ("broadcast",), lambda: _broadcast_kernel, collective=True
+    )
     return fn(stacked)

@@ -16,22 +16,47 @@ implementation forces an explicit SINGLE fragment at plan time.
 Stage value forms: a distributed stage yields a `_Dist` (stacked [W, cap]
 device batch, sharded over the mesh); a SINGLE/COORDINATOR_ONLY stage yields
 materialized host batches via the local engine.
+
+Device-resident fragment pipeline (the mesh fast path):
+
+  * Unary operators (filter/project/window/sort/limit/...) DEFER their
+    per-worker step onto the `_Dist` instead of dispatching immediately;
+    a chain compiles as ONE SPMD program at the next materialization
+    boundary (exchange, join, gather) — no intermediate columns ever hit
+    HBM between them, and nothing returns to the host.
+  * Every compiled program is held in spmd.TRACE_CACHE keyed on (step
+    semantics, pow2 shape bucket, mesh), so repeated executions of the same
+    query — and repeated same-bucket batches — reuse traces instead of
+    retracing and recompiling per run (the dominant cost of the old path).
+  * Scans cache their stacked [W, cap] device batch in the buffer pool's
+    device tier keyed by (splits, columns, scan version, mesh): a warm mesh
+    query performs ZERO host->device transfers for table data.
+  * The bucketize + all_to_all exchange FUSES into the consumer's first
+    jitted step (exchange.fused_repartition), so a repartition and the
+    final aggregation above it run as one compiled collective program.
+  * Small collectives batch: all dynamic-filter summaries of a join build
+    side reduce in one program and cross to the host in one transfer.
+
+Observability: a per-fragment, per-phase MeshProfile (trace/compile,
+collective, compute, transfer, other) with byte counters — rendered by
+EXPLAIN ANALYZE, exposed as runner.last_mesh_profile, and recorded in the
+bench JSON so mesh regressions are visible per fragment.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from trino_tpu import types as T
 from trino_tpu.columnar import Batch, Column
 from trino_tpu.columnar.batch import device_get_async, concat_batches
 from trino_tpu.connectors.api import CatalogManager
 from trino_tpu.expr import ExprCompiler
-from trino_tpu.expr.ir import Form, InputRef, Literal, SpecialForm, and_
+from trino_tpu.expr.ir import InputRef, and_
 from trino_tpu.ops.aggregation import AggregationOperator, AggSpec
 from trino_tpu.ops.common import SortKey, next_pow2
 from trino_tpu.ops.filter_project import FilterProjectOperator
@@ -45,8 +70,11 @@ from trino_tpu.ops.join import (
 from trino_tpu.ops.sort import OrderByOperator, TopNOperator
 from trino_tpu.parallel import exchange as ex
 from trino_tpu.parallel.spmd import (
+    TRACE_CACHE,
     WorkerMesh,
-    spmd_step,
+    bucket_cap,
+    cached_spmd_step,
+    mesh_key,
     stack_batches,
     unstack_batch,
 )
@@ -64,6 +92,8 @@ from trino_tpu.planner.fragmenter import (
     fragment_text,
 )
 from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
+from trino_tpu.runtime.memory import batch_bytes
+from trino_tpu.runtime.query_stats import MeshProfile
 from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
 from trino_tpu.planner.functions import HOLISTIC_AGGS, PARTITIONABLE_HOLISTIC
 
@@ -71,11 +101,39 @@ _DIST_KINDS = (SOURCE, FIXED_HASH, FIXED_ARBITRARY)
 
 
 class _Dist:
-    """A distributed intermediate: stacked [W, cap] batch + symbol layout."""
+    """A distributed intermediate: stacked [W, cap] batch + symbol layout.
 
-    def __init__(self, stacked: Batch, symbols: list):
-        self.stacked = stacked
+    `pending` holds deferred per-worker steps [(key_part, fn)] appended by
+    unary operators; accessing `.stacked` materializes them as ONE cached
+    SPMD program (the device-resident fragment pipeline).  `cap` tracks the
+    trailing row capacity through deferred shape-changing steps so
+    consumers can size their static output shapes without materializing."""
+
+    def __init__(self, stacked: Batch, symbols: list, ex=None, pending=(),
+                 cap: Optional[int] = None):
+        self._stacked = stacked
         self.symbols = list(symbols)
+        self.ex = ex
+        self.pending = list(pending)
+        self.cap = cap if cap is not None else _trailing_cap(stacked)
+
+    @property
+    def stacked(self) -> Batch:
+        if self.pending:
+            self._stacked = self.ex._run_chain(self._stacked, self.pending)
+            self.pending = []
+        return self._stacked
+
+    def defer(self, key_part, step, symbols=None, cap: Optional[int] = None) -> "_Dist":
+        """Append a per-worker step lazily (must be a pure Batch -> Batch
+        function; `key_part` must fingerprint its semantics)."""
+        return _Dist(
+            self._stacked,
+            self.symbols if symbols is None else symbols,
+            self.ex,
+            self.pending + [(key_part, step)],
+            cap if cap is not None else self.cap,
+        )
 
     def channel(self, name: str) -> int:
         for i, s in enumerate(self.symbols):
@@ -85,6 +143,21 @@ class _Dist:
 
     def rewrite(self, expr):
         return PhysicalPlan(iter(()), self.symbols).rewrite(expr)
+
+
+def _sig(symbols) -> tuple:
+    """Channel-layout signature for trace-cache keys (types only: steps are
+    positional, names don't reach the compiled program)."""
+    return tuple(s.type.name for s in symbols)
+
+
+def _spec_sig(specs) -> tuple:
+    """Full AggSpec fingerprint for trace-cache keys — the param matters:
+    min_by(x, k) and min_by(x, k, 3) compile different programs."""
+    return tuple(
+        (s.name, s.arg, s.out_type.name, repr(s.param), s.arg2)
+        for s in specs
+    )
 
 
 class DistributedQueryRunner(LocalQueryRunner):
@@ -106,6 +179,8 @@ class DistributedQueryRunner(LocalQueryRunner):
         self.failure_detector = HeartbeatFailureDetector()
         for i in range(self.wm.n):
             self.failure_detector.register(f"worker-{i}")
+        #: MeshProfile of the most recent distributed query (bench evidence)
+        self.last_mesh_profile = None
 
     # -- planning -------------------------------------------------------------
 
@@ -122,10 +197,6 @@ class DistributedQueryRunner(LocalQueryRunner):
     # queries run through the stage executor) ---------------------------------
 
     def _run_query(self, query, stats=None) -> MaterializedResult:
-        if stats is not None:
-            # EXPLAIN ANALYZE instrumentation hooks the local operator
-            # streams; run it through the local engine
-            return super()._run_query(query, stats=stats)
         # in-process mesh workers share this process's liveness: refresh them
         # BEFORE the dead check, so only genuinely remote/stale registrations
         # (server-mode workers) can fail it
@@ -136,16 +207,23 @@ class DistributedQueryRunner(LocalQueryRunner):
             raise RuntimeError(f"workers failed heartbeat: {sorted(dead)}")
         plan = self.plan_query(query)
         sub = self.create_subplan(plan)
+        # EXPLAIN ANALYZE runs the SAME distributed path, with the profile
+        # in blocking mode so per-phase times measure device work
+        profile = MeshProfile(blocking=stats is not None)
         executor = StageExecutor(
             self.catalogs, self.wm, self.properties,
             query_id=getattr(self, "_current_qid", "q"),
+            profile=profile,
         )
         #: kept for tests / EXPLAIN evidence (dynamic filter pruning counts)
         self.last_stage_executor = executor
+        self.last_mesh_profile = profile
         host = executor.run(sub)
         rows = []
         for batch in host.stream:
             rows.extend(tuple(r) for r in batch.to_pylist())
+        if stats is not None:
+            stats.mesh_profile = profile
         return MaterializedResult(
             list(plan.column_names), rows, [s.type for s in plan.symbols]
         )
@@ -160,13 +238,20 @@ class StageExecutor:
     #: EventDrivenFaultTolerantQueryScheduler task retry budget)
     TASK_ATTEMPTS = 4
 
-    def __init__(self, catalogs, wm: WorkerMesh, properties, query_id: str = "q"):
+    def __init__(self, catalogs, wm: WorkerMesh, properties, query_id: str = "q",
+                 profile: Optional[MeshProfile] = None):
         self.catalogs = catalogs
         self.wm = wm
         self.properties = properties
         self.query_id = query_id
+        self.profile = profile if profile is not None else MeshProfile()
         self._subplans: dict[int, SubPlan] = {}
         self._results: dict[int, object] = {}
+        self._root_fid: Optional[int] = None
+        self._current_fid: int = -1
+        #: per-stage elapsed bookkeeping so fragment walls are SELF time
+        self._frame_stack: list[dict] = []
+        self._trace_base = (TRACE_CACHE.hits, TRACE_CACHE.misses, TRACE_CACHE.retraces)
         self.retry_task = properties.get("retry_policy") == "TASK"
         self.spool = None
         self._spool_meta: dict[int, tuple] = {}
@@ -182,21 +267,74 @@ class StageExecutor:
 
             self.spool = SpoolManager()
 
+    # -- instrumented step dispatch -------------------------------------------
+
+    def _dist(self, stacked: Batch, symbols: list) -> _Dist:
+        return _Dist(stacked, symbols, ex=self)
+
+    def _call(self, fn, *args, phase: str = "compute"):
+        """Run a (cached-jitted) program with phase attribution: calls that
+        trigger a trace are booked as `trace` (trace + XLA compile time);
+        blocking mode additionally waits on the result inside the window so
+        the phase measures device time."""
+        prof = self.profile
+        r0 = TRACE_CACHE.retraces
+        t0 = time.perf_counter()
+        out = fn(*args)
+        if prof.blocking:
+            out = jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        if TRACE_CACHE.retraces > r0:
+            TRACE_CACHE.trace_s += dt
+            prof.add_phase(self._current_fid, "trace", dt)
+        else:
+            prof.add_phase(self._current_fid, phase, dt)
+        return out
+
+    def _run_chain(self, stacked: Batch, pending: list) -> Batch:
+        """Materialize a deferred step chain as ONE cached SPMD program."""
+        keys = tuple(k for k, _ in pending)
+        steps = [s for _, s in pending]
+
+        def build():
+            def chain(b: Batch) -> Batch:
+                for s in steps:
+                    b = s(b)
+                return b
+
+            return chain
+
+        fn = cached_spmd_step(self.wm, ("chain",) + keys, build)
+        return self._call(fn, stacked)
+
     # -- public ---------------------------------------------------------------
 
     def run(self, sub: SubPlan) -> PhysicalPlan:
         try:
             self._register(sub)
+            self._root_fid = sub.fragment.id
             out = self._fragment_result(sub.fragment.id)
             if isinstance(out, _Dist):  # defensive: root should be SINGLE
-                return PhysicalPlan(
-                    iter([unstack_batch(device_get_async(out.stacked))]),
-                    out.symbols,
-                )
+                host = unstack_batch(device_get_async(out.stacked))
+                self.profile.bump("result_gather")
+                return PhysicalPlan(iter([host]), out.symbols)
             return out
         finally:
+            self._finalize_profile()
             if self.spool is not None:
                 self.spool.close()
+
+    def _finalize_profile(self) -> None:
+        prof = self.profile
+        h0, m0, r0 = self._trace_base
+        prof.trace_hits = TRACE_CACHE.hits - h0
+        prof.trace_misses = TRACE_CACHE.misses - m0
+        prof.retraces = TRACE_CACHE.retraces - r0
+        for fid, sub in self._subplans.items():
+            if fid in prof.fragments:
+                prof.fragments[fid].kind = str(sub.fragment.partitioning)
+        for st in prof.fragments.values():
+            st.close()
 
     # -- stage orchestration --------------------------------------------------
 
@@ -237,26 +375,38 @@ class StageExecutor:
         sub = self._subplans[fid]
         attempts = self.TASK_ATTEMPTS if self.retry_task else 1
         last = None
-        for _ in range(attempts):
-            try:
-                FAILURE_INJECTOR.maybe_fail(f"stage:{fid}")
-                if sub.fragment.partitioning.kind in _DIST_KINDS:
-                    res = self._exec(sub.fragment.root)
-                else:
-                    out = self._local_fragment(sub)
-                    res = ("host", list(out.stream), out.symbols)
-                # fires after the body ran (children memoized/spooled): a
-                # failure here retries ONLY this stage
-                FAILURE_INJECTOR.maybe_fail(f"stage:{fid}:finish")
-                self._spool(fid, res)
-                return res
-            except RETRYABLE as e:
-                last = e
-        if not self.retry_task:
-            raise last  # keep the original (QUERY-level-retryable) error
-        raise StageFailedException(
-            f"stage {fid} failed after {attempts} attempts: {last}"
-        ) from last
+        prev_fid = self._current_fid
+        self._current_fid = fid
+        self._frame_stack.append({"child_s": 0.0})
+        t0 = time.perf_counter()
+        try:
+            for _ in range(attempts):
+                try:
+                    FAILURE_INJECTOR.maybe_fail(f"stage:{fid}")
+                    if sub.fragment.partitioning.kind in _DIST_KINDS:
+                        res = self._exec(sub.fragment.root)
+                    else:
+                        out = self._local_fragment(sub)
+                        res = ("host", list(out.stream), out.symbols)
+                    # fires after the body ran (children memoized/spooled): a
+                    # failure here retries ONLY this stage
+                    FAILURE_INJECTOR.maybe_fail(f"stage:{fid}:finish")
+                    self._spool(fid, res)
+                    return res
+                except RETRYABLE as e:
+                    last = e
+            if not self.retry_task:
+                raise last  # keep the original (QUERY-level-retryable) error
+            raise StageFailedException(
+                f"stage {fid} failed after {attempts} attempts: {last}"
+            ) from last
+        finally:
+            elapsed = time.perf_counter() - t0
+            frame = self._frame_stack.pop()
+            self.profile.fragment(fid).wall_s += elapsed - frame["child_s"]
+            if self._frame_stack:
+                self._frame_stack[-1]["child_s"] += elapsed
+            self._current_fid = prev_fid
 
     # -- spooled stage outputs (ExchangeManager role) -------------------------
 
@@ -267,7 +417,11 @@ class StageExecutor:
         results already live host-side and stay in the memo."""
         if self.spool is None or not isinstance(res, _Dist):
             return
-        host = device_get_async(res.stacked)
+        stacked = res.stacked  # deferred chain runs as its own phase
+        with self.profile.phase(fid, "transfer"):
+            host = device_get_async(stacked)
+        self.profile.bump("spool_write")
+        self.profile.fragment(fid).bytes_to_host += batch_bytes(host)
         # full-capacity per-worker shards, masks included (the spooled
         # page files of FileSystemExchangeSink)
         shards = [
@@ -283,7 +437,8 @@ class StageExecutor:
     def _load_spooled(self, fid: int) -> "_Dist":
         symbols, dicts = self._spool_meta[fid]
         shards = self.spool.load(self.query_id, fid, symbols, dicts)
-        return _Dist(stack_batches(shards, self.wm), symbols)
+        self.profile.bump("spool_read")
+        return self._dist(stack_batches(shards, self.wm), symbols)
 
     def _local_fragment(self, sub: SubPlan) -> PhysicalPlan:
         """SINGLE/COORDINATOR_ONLY fragment: run the local engine over
@@ -325,31 +480,68 @@ class StageExecutor:
     def _register_dynamic_filters(self, criteria, build: "_Dist") -> None:
         """Record build-side key min/max under the probe symbol names.
         Dictionary-coded keys are skipped (codes are producer-local).
-        Device-side reductions: only three scalars cross to the host."""
+        ALL summaries reduce in ONE cached program and cross to the host in
+        ONE transfer (batched small collectives): k criteria cost the same
+        sync as one."""
+        pairs = []  # (probe name, channel)
+        # materialize pending steps first: deferred projections may have
+        # changed a key column's dictionary, which the skip check reads
+        stacked = build.stacked
         for lsym, rsym in criteria:
             try:
-                col = build.stacked.columns[build.channel(rsym.name)]
+                chn = build.channel(rsym.name)
             except KeyError:
                 continue
+            col = stacked.columns[chn]
             if col.dictionary is not None or jnp.issubdtype(
                 col.data.dtype, jnp.floating
             ):
                 continue
-            live = build.stacked.mask()
-            if col.valid is not None:
-                live = jnp.logical_and(live, col.valid)
-            d = col.data.astype(jnp.int64)
-            big = jnp.iinfo(jnp.int64).max
-            lo, hi, n = device_get_async(
-                (
-                    jnp.min(jnp.where(live, d, big)),
-                    jnp.max(jnp.where(live, d, -big)),
-                    jnp.sum(live),
-                )
-            )
-            if int(n) == 0:
+            pairs.append((lsym.name, chn))
+        if not pairs:
+            return
+        chans = tuple(ch for _, ch in pairs)
+
+        def build_step():
+            def step(b: Batch):
+                big = jnp.iinfo(jnp.int64).max
+                outs = []
+                for chn in chans:
+                    c = b.columns[chn]
+                    live = b.mask()
+                    if c.valid is not None:
+                        live = jnp.logical_and(live, c.valid)
+                    d = c.data.astype(jnp.int64)
+                    outs.append(
+                        jnp.stack(
+                            [
+                                jnp.min(jnp.where(live, d, big)),
+                                jnp.max(jnp.where(live, d, -big)),
+                                jnp.sum(live, dtype=jnp.int64),
+                            ]
+                        )
+                    )
+                return jnp.stack(outs)  # [k, 3]
+
+            return step
+
+        fn = cached_spmd_step(
+            self.wm,
+            ("dynfilters", chans, _sig(build.symbols)),
+            build_step,
+        )
+        reduced = self._call(fn, stacked)
+        with self.profile.phase(self._current_fid, "transfer"):
+            summ = np.asarray(device_get_async(reduced))
+        self.profile.bump("dynamic_filter_sync")
+        # [W, k, 3] -> per-criterion global (lo, hi, n)
+        for i, (name, _) in enumerate(pairs):
+            lo = int(summ[:, i, 0].min())
+            hi = int(summ[:, i, 1].max())
+            n = int(summ[:, i, 2].sum())
+            if n == 0:
                 continue
-            self.dynamic_filters[lsym.name] = (int(lo), int(hi))
+            self.dynamic_filters[name] = (lo, hi)
 
     def _raw_remote(self, node: RemoteSourceNode):
         """Child fragment result WITHOUT the exchange applied."""
@@ -360,10 +552,17 @@ class StageExecutor:
         child = self._raw_remote(node)
         if isinstance(child, PhysicalPlan):
             return child
+        fid = self._current_fid
         if node.exchange_kind == "merge":
             batch = self._merge_gather(child, node)
         else:
-            batch = unstack_batch(device_get_async(child.stacked))
+            stacked = child.stacked  # deferred chain runs as its own phase
+            with self.profile.phase(fid, "transfer"):
+                batch = unstack_batch(device_get_async(stacked))
+        self.profile.bump(
+            "result_gather" if fid == self._root_fid else "host_gather"
+        )
+        self.profile.fragment(fid).bytes_to_host += batch_bytes(batch)
         return PhysicalPlan(iter([batch]), child.symbols)
 
     def _merge_gather(self, child: _Dist, node: RemoteSourceNode) -> Batch:
@@ -389,12 +588,23 @@ class StageExecutor:
         child = self._raw_remote(node)
         stacked = self._to_stacked(child)
         if node.exchange_kind == "broadcast":
-            return _Dist(ex.broadcast(stacked.stacked, self.wm), stacked.symbols)
+            out = self._call(
+                ex.broadcast, stacked.stacked, self.wm, phase="collective"
+            )
+            self.profile.fragment(self._current_fid).collective_bytes += (
+                batch_bytes(out)
+            )
+            return self._dist(out, stacked.symbols)
         if node.exchange_kind == "repartition":
             chans = [stacked.channel(s.name) for s in node.partition_symbols]
-            return _Dist(
-                ex.repartition(stacked.stacked, chans, self.wm), stacked.symbols
+            out = self._call(
+                ex.repartition, stacked.stacked, chans, self.wm,
+                phase="collective",
             )
+            self.profile.fragment(self._current_fid).collective_bytes += (
+                batch_bytes(out)
+            )
+            return self._dist(out, stacked.symbols)
         raise NotImplementedError(
             f"exchange {node.exchange_kind} feeding a distributed fragment"
         )
@@ -406,8 +616,18 @@ class StageExecutor:
         host = concat_batches(batches) if batches else None
         if host is None or not host.width:
             raise NotImplementedError("empty single-fragment feed")
-        stacked = stack_batches([host] + [None] * (self.wm.n - 1), self.wm)
-        return _Dist(stacked, result.symbols)
+        with self.profile.phase(self._current_fid, "transfer"):
+            stacked = stack_batches(
+                [host] + [None] * (self.wm.n - 1), self.wm
+            )
+        # a host batch re-entered the mesh mid-query: the counter the
+        # no-host-roundtrip regression test asserts stays ZERO between
+        # distributed fragments
+        self.profile.bump("host_restack")
+        self.profile.fragment(self._current_fid).bytes_to_device += (
+            batch_bytes(host)
+        )
+        return self._dist(stacked, result.symbols)
 
     # -- distributed node execution -------------------------------------------
 
@@ -425,6 +645,7 @@ class StageExecutor:
 
     def _x_TableScanNode(self, node: P.TableScanNode) -> _Dist:
         from trino_tpu.ops.scan import ScanOperator
+        from trino_tpu.runtime.buffer_pool import POOL, BufferPool
         from trino_tpu.runtime.retry import FAILURE_INJECTOR
 
         connector = self.catalogs.get(node.handle.catalog)
@@ -441,6 +662,29 @@ class StageExecutor:
         )
         page_rows = self.properties.get("page_rows")
         use_cache = self.properties.get("scan_cache")
+
+        # device-resident stacked-scan cache: a warm mesh query reuses the
+        # sharded [W, cap] batch directly from HBM — zero host->device bytes
+        version = (
+            connector.scan_version(node.handle) if use_cache else None
+        )
+        cache_key = None
+        if version is not None and splits:
+            cache_key = (
+                "mesh_scan",
+                mesh_key(self.wm),
+                tuple(
+                    BufferPool.split_key(s, names, page_rows, version)
+                    for s in splits
+                ),
+            )
+            cached = POOL.get_device(cache_key)
+            if cached is not None:
+                self.profile.bump("scan_cache_hit")
+                return self._scan_filters(
+                    node, self._dist(cached[0], [s for s, _ in node.assignments])
+                )
+            self.profile.bump("scan_cache_miss")
 
         per_worker: list = [[] for _ in range(self.wm.n)]
         for i, split in enumerate(splits):
@@ -461,32 +705,75 @@ class StageExecutor:
                 for t in types
             ]
             host_batches[0] = Batch(cols, np.zeros(1, bool))
-        stacked = stack_batches(host_batches, self.wm)
-        out = _Dist(stacked, [s for s, _ in node.assignments])
+        with self.profile.phase(self._current_fid, "transfer"):
+            stacked = stack_batches(host_batches, self.wm)
+        self.profile.fragment(self._current_fid).bytes_to_device += (
+            batch_bytes(stacked)
+        )
+        if cache_key is not None:
+            POOL.put_device(cache_key, [stacked])
+        return self._scan_filters(
+            node, self._dist(stacked, [s for s, _ in node.assignments])
+        )
+
+    def _scan_filters(self, node: P.TableScanNode, out: _Dist) -> _Dist:
+        """Defer the pushed predicate + dynamic-filter pruning onto the scan
+        output (they fold into the consumer chain's single program)."""
         if node.pushed_predicate is not None:
             pred = out.rewrite(node.pushed_predicate)
             step = FilterProjectOperator(
                 pred, [InputRef(i, s.type) for i, s in enumerate(out.symbols)]
             )._make_step()
-            out = _Dist(spmd_step(self.wm, step)(out.stacked), out.symbols)
+            out = out.defer(("scan_pred", pred.key(), _sig(out.symbols)), step)
         # dynamic filters from already-completed build fragments prune this
         # scan's feed (reference: DynamicFilterService -> split pruning)
         from trino_tpu.runtime.local_planner import _range_expr
 
         dyn = []
+        ranges = []
         for s, _ in node.assignments:
             rng = self.dynamic_filters.get(s.name)
             if rng is not None:
                 dyn.append(out.rewrite(_range_expr(s, *rng)))
+                ranges.append((s.name, rng))
         if dyn:
-            before = int(jnp.sum(out.stacked.mask()))
             step = FilterProjectOperator(
                 and_(*dyn),
                 [InputRef(i, s.type) for i, s in enumerate(out.symbols)],
             )._make_step()
-            out = _Dist(spmd_step(self.wm, step)(out.stacked), out.symbols)
-            after = int(jnp.sum(out.stacked.mask()))
-            self.dynamic_filter_stats[node.handle.table] = (before, after)
+            dkey = ("dyn_filter", tuple(ranges), _sig(out.symbols))
+            # before/after pruning counts (the always-available EXPLAIN /
+            # DynamicFilterService evidence) run as ONE cached program with
+            # ONE host sync, WITHOUT materializing the deferred chain — the
+            # scan steps stay pending so they still fold into the
+            # consumer's fused program.  This does execute the chain an
+            # extra time for the two scalars (cheaper than the pre-PR two
+            # materializations + two syncs); making the stats lazy is a
+            # ROADMAP item
+            pend = list(out.pending)
+
+            def build_counts():
+                steps = [fn for _, fn in pend]
+
+                def count_step(b: Batch):
+                    for st in steps:
+                        b = st(b)
+                    nb = jnp.sum(b.mask(), dtype=jnp.int64)
+                    na = jnp.sum(step(b).mask(), dtype=jnp.int64)
+                    return jnp.stack([nb, na])
+
+                return count_step
+
+            fn = cached_spmd_step(
+                self.wm,
+                ("dyn_counts", tuple(k for k, _ in pend), dkey),
+                build_counts,
+            )
+            counts = np.asarray(device_get_async(self._call(fn, out._stacked)))
+            self.dynamic_filter_stats[node.handle.table] = (
+                int(counts[:, 0].sum()), int(counts[:, 1].sum())
+            )
+            out = out.defer(dkey, step)
         return out
 
     def _x_FilterNode(self, node: P.FilterNode) -> _Dist:
@@ -495,21 +782,26 @@ class StageExecutor:
         step = FilterProjectOperator(
             pred, [InputRef(i, s.type) for i, s in enumerate(src.symbols)]
         )._make_step()
-        return _Dist(spmd_step(self.wm, step)(src.stacked), src.symbols)
+        return src.defer(("filter", pred.key(), _sig(src.symbols)), step)
 
     def _x_ProjectNode(self, node: P.ProjectNode) -> _Dist:
         src = self._exec(node.source)
         exprs = [src.rewrite(e) for _, e in node.assignments]
         step = FilterProjectOperator(None, exprs)._make_step()
-        return _Dist(
-            spmd_step(self.wm, step)(src.stacked),
-            [s for s, _ in node.assignments],
+        return src.defer(
+            ("project", tuple(e.key() for e in exprs), _sig(src.symbols)),
+            step,
+            symbols=[s for s, _ in node.assignments],
         )
 
     # -- aggregation ----------------------------------------------------------
 
     def _agg_partial(self, node: P.AggregationNode, src: _Dist):
-        """Per-worker PARTIAL step; returns (stacked states, specs, op)."""
+        """Per-worker PARTIAL step; returns (stacked states, specs, op).
+        The step FUSES onto the source's deferred chain, so e.g.
+        scan-filter-project-partial compiles as one SPMD program; the
+        output is then compacted to the live-group bucket so downstream
+        exchanges move states, not dead capacity."""
         from trino_tpu.runtime.local_planner import build_agg_inputs
 
         ngroups = len(node.group_symbols)
@@ -518,19 +810,54 @@ class StageExecutor:
         partial_op = AggregationOperator(
             list(range(ngroups)), specs, input_types, mode="partial"
         )
-        cap = _trailing_cap(src.stacked)
-        part_cap = next_pow2(cap, floor=1)
+        part_cap = next_pow2(src.cap, floor=1) if ngroups else 1
 
         def partial_step(b: Batch) -> Batch:
             return partial_op._reduce_step(pre(b), out_cap=part_cap)
 
-        states = spmd_step(self.wm, partial_step)(src.stacked)
+        key = (
+            "agg_partial",
+            tuple(e.key() for e in proj),
+            _spec_sig(specs),
+            part_cap,
+            _sig(src.symbols),
+        )
+        states = self._run_chain(
+            src._stacked, src.pending + [(key, partial_step)]
+        )
+        if ngroups:
+            states = self._compact_states(states)
         return states, specs, partial_op
 
+    def _compact_states(self, states: Batch) -> Batch:
+        """Compact a [W, cap] partial-state batch down to the pow2 bucket of
+        the max per-worker live-group count (live states may sit at
+        range-positional slots, so this is a gather, not a slice).  One tiny
+        [W] host sync; the downstream exchange + final program then run at
+        state scale, not input scale."""
+        cap = _trailing_cap(states)
+        with self.profile.phase(self._current_fid, "transfer"):
+            live = np.asarray(
+                device_get_async(jnp.sum(states.mask(), axis=-1))
+            )
+        cap2 = bucket_cap(int(live.max()), floor=64)
+        if cap2 >= cap:
+            return states
+
+        def build():
+            def step(b: Batch) -> Batch:
+                return b.compact_device(out_capacity=cap2)
+
+            return step
+
+        fn = cached_spmd_step(self.wm, ("state_compact", cap2), build)
+        return self._call(fn, states)
+
     def _final_op(self, specs, partial_op, states) -> AggregationOperator:
-        state_types = [
-            c.type for c in jax.tree.map(lambda x: x[0], states).columns
-        ]
+        # state types read off the stacked columns directly — the old
+        # tree.map(x[0]) gathered the whole sharded batch eagerly just to
+        # look at dtypes (2.5s per query on an 8-way CPU mesh)
+        state_types = [c.type for c in states.columns]
         merge_specs = [
             AggSpec(s.name, partial_op._state_channel(i), s.out_type, param=s.param)
             for i, s in enumerate(specs)
@@ -557,21 +884,37 @@ class StageExecutor:
             # no partial/merge states and no coordinator gather
             return self._spmd_single_stage(node, src)
         states, specs, partial_op = self._agg_partial(node, src)
-        exchanged = ex.repartition(states, list(range(ngroups)), self.wm)
         final_op = self._final_op(specs, partial_op, states)
-        fcap = _trailing_cap(exchanged)
+        # fused exchange: bucketize + all_to_all + the FINAL aggregation
+        # step run as one compiled program (phase 1 sizes the slot bucket)
+        chans = list(range(ngroups))
+        slot_cap = ex.exchange_slot_cap(states, chans, self.wm)
+        fcap = self.wm.n * slot_cap
 
         def final_step(b: Batch) -> Batch:
             return final_op._reduce_step(b, out_cap=fcap)
 
-        out = spmd_step(self.wm, final_step)(exchanged)
-        return _Dist(out, node.outputs)
-
+        out = self._call(
+            ex.fused_repartition,
+            states,
+            chans,
+            self.wm,
+            final_step,
+            ("agg_final", _spec_sig(specs), fcap,
+             _sig(node.outputs)),
+            slot_cap,
+            phase="collective",
+        )
+        self.profile.fragment(self._current_fid).collective_bytes += (
+            batch_bytes(out)
+        )
+        return self._dist(out, node.outputs)
 
     def _spmd_single_stage(self, node: P.AggregationNode, src: _Dist) -> _Dist:
         """Repartition-on-group-keys + per-worker single-stage aggregation
         (the distributed home of the holistic/DISTINCT shapes; reference:
-        single-step aggregation over hash distribution)."""
+        single-step aggregation over hash distribution).  The dedupe +
+        aggregation consumer fuses into the exchange program."""
         from trino_tpu.runtime.local_planner import (
             build_agg_inputs,
             build_distinct_dedupe,
@@ -579,9 +922,10 @@ class StageExecutor:
 
         ngroups = len(node.group_symbols)
         key_channels = [src.channel(s.name) for s in node.group_symbols]
-        exchanged = ex.repartition(src.stacked, key_channels, self.wm)
-        ex_dist = _Dist(exchanged, src.symbols)
-        fcap = _trailing_cap(exchanged)
+        stacked = src.stacked
+        slot_cap = ex.exchange_slot_cap(stacked, key_channels, self.wm)
+        fcap = self.wm.n * slot_cap
+        ex_dist = self._dist(stacked, src.symbols)  # layout proxy
         pre_dd = None
         agg_src = ex_dist
         dedupe = None
@@ -604,15 +948,35 @@ class StageExecutor:
                 b = dedupe._reduce_step(pre_dd(b), out_cap=fcap)
             return op._reduce_step(pre_agg(b), out_cap=fcap)
 
-        out = spmd_step(self.wm, single_step)(exchanged)
-        return _Dist(out, node.outputs)
+        out = self._call(
+            ex.fused_repartition,
+            stacked,
+            key_channels,
+            self.wm,
+            single_step,
+            ("agg_single", tuple(e.key() for e in proj),
+             _spec_sig(specs), fcap,
+             pre_dd is not None, _sig(src.symbols)),
+            slot_cap,
+            phase="collective",
+        )
+        self.profile.fragment(self._current_fid).collective_bytes += (
+            batch_bytes(out)
+        )
+        return self._dist(out, node.outputs)
 
     def _global_agg(self, node: P.AggregationNode, src: _Dist) -> PhysicalPlan:
         """Global aggregation over a distributed child: partial per worker,
-        gather the per-worker state rows, final merge on the coordinator."""
+        gather the (single-row) state shards, final merge on the
+        coordinator.  The partial output capacity is 1 — only W state rows
+        ever cross to the host."""
         states, specs, partial_op = self._agg_partial(node, src)
         final_op = self._final_op(specs, partial_op, states)
-        gathered = unstack_batch(device_get_async(states))
+        fid = self._current_fid
+        with self.profile.phase(fid, "transfer"):
+            gathered = unstack_batch(device_get_async(states))
+        self.profile.bump("state_gather")
+        self.profile.fragment(fid).bytes_to_host += batch_bytes(gathered)
         from trino_tpu.ops.aggregation import _pad_device
 
         cap = next_pow2(gathered.capacity, floor=1)
@@ -627,8 +991,7 @@ class StageExecutor:
         unions the dictionaries, a jitted take recodes each side."""
         from trino_tpu.columnar.dictionary import union_dictionaries
 
-        def recode(dist: _Dist, ch: int, table, merged):
-            col = dist.stacked.columns[ch]
+        def recode(dist: _Dist, ch: int, table, merged, dkey):
             tbl = jnp.asarray(table)
 
             def step(batch: Batch) -> Batch:
@@ -642,11 +1005,12 @@ class StageExecutor:
                 )
                 return Batch(cols, batch.row_mask)
 
-            return _Dist(
-                spmd_step(self.wm, step)(dist.stacked), dist.symbols
-            )
+            # the recode table is a closure constant: the dictionary-content
+            # hashes in the key pin the cached program to THESE dictionaries
+            return dist.defer(("recode", ch, dkey), step)
 
         for ca, cb in zip(ak, bk):
+            # .stacked (not ._stacked): deferred steps may change dictionaries
             da = a.stacked.columns[ca].dictionary
             db = b.stacked.columns[cb].dictionary
             if da is None and db is None:
@@ -658,8 +1022,11 @@ class StageExecutor:
                     "join key mixes dictionary and plain strings"
                 )
             merged, ta, tb = union_dictionaries(da, db)
-            a = recode(a, ca, ta, merged)
-            b = recode(b, cb, tb, merged)
+            # key = (OWN dictionary, other): the two sides bake DIFFERENT
+            # translation tables, so their keys must differ even when the
+            # channel index coincides (ca == cb is the common case)
+            a = recode(a, ca, ta, merged, (hash(da), hash(db)))
+            b = recode(b, cb, tb, merged, (hash(db), hash(da)))
         return a, b
 
     def _x_JoinNode(self, node: P.JoinNode) -> _Dist:
@@ -684,19 +1051,33 @@ class StageExecutor:
         probe, build = self._unify_key_dicts(probe, pk, build, bk)
         out_symbols = probe.symbols + build.symbols
         residual = None
+        residual_key = None
         if node.filter is not None:
             expr = PhysicalPlan(iter(()), out_symbols).rewrite(node.filter)
+            residual_key = expr.key()
 
             def residual(batch: Batch, _e=expr):
                 return ExprCompiler(batch).filter_mask(_e)
 
         if node.distribution == "broadcast":
-            build_stacked = ex.broadcast(build.stacked, self.wm)
-        else:
-            build_stacked = ex.repartition(build.stacked, bk, self.wm)
-            probe = _Dist(
-                ex.repartition(probe.stacked, pk, self.wm), probe.symbols
+            build_stacked = self._call(
+                ex.broadcast, build.stacked, self.wm, phase="collective"
             )
+        else:
+            build_stacked = self._call(
+                ex.repartition, build.stacked, bk, self.wm, phase="collective"
+            )
+            probe_stacked = self._call(
+                ex.repartition, probe.stacked, pk, self.wm,
+                phase="collective",
+            )
+            self.profile.fragment(self._current_fid).collective_bytes += (
+                batch_bytes(probe_stacked)
+            )
+            probe = self._dist(probe_stacked, probe.symbols)
+        self.profile.fragment(self._current_fid).collective_bytes += (
+            batch_bytes(build_stacked)
+        )
 
         op = HashJoinOperator(
             node.kind, pk, bk,
@@ -705,22 +1086,33 @@ class StageExecutor:
             residual=residual,
         )
         cap_b = _trailing_cap(build_stacked)
-
-        def locate_step(pb: Batch, bb: Batch):
-            # per-shard PagesHash analog: sort THIS shard's build once, then
-            # binary-search the probe keys against it (ops/join.py design)
-            sb, canon, n_match = _sort_build_device(bb, bk)
-            pc, pn = _canon_probe_device(pb, pk, canon)
-            start, count = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
-            return start, count, sb
-
-        start, count, sorted_build = spmd_step(self.wm, locate_step)(
-            probe.stacked, build_stacked
+        jkey = (
+            node.kind, tuple(pk), tuple(bk), cap_b,
+            _sig(probe.symbols), _sig(build.symbols), residual_key,
         )
-        count_h, mask_h = (
-            np.asarray(x)
-            for x in device_get_async((count, probe.stacked.mask()))
+
+        def build_locate():
+            def locate_step(pb: Batch, bb: Batch):
+                # per-shard PagesHash analog: sort THIS shard's build once,
+                # then binary-search the probe keys against it
+                sb, canon, n_match = _sort_build_device(bb, bk)
+                pc, pn = _canon_probe_device(pb, pk, canon)
+                start, count = _locate_sorted(
+                    canon, n_match, pc, pn, cap_b=cap_b
+                )
+                return start, count, sb
+
+            return locate_step
+
+        locate = cached_spmd_step(self.wm, ("join_locate",) + jkey, build_locate)
+        start, count, sorted_build = self._call(
+            locate, probe.stacked, build_stacked
         )
+        with self.profile.phase(self._current_fid, "transfer"):
+            count_h, mask_h = (
+                np.asarray(x)
+                for x in device_get_async((count, probe.stacked.mask()))
+            )
         emit_h = (
             np.where(mask_h, np.maximum(count_h, 1), 0)
             if node.kind in ("left", "full")
@@ -730,39 +1122,48 @@ class StageExecutor:
         out_cap = next_pow2(max(1, int(totals.max())), floor=1024)
         probe_types = [s.type for s in probe.symbols]
 
-        def expand_step(pb: Batch, bb: Batch, st, ct, total):
-            matched0 = (
-                jnp.zeros(cap_b, dtype=bool) if node.kind == "full" else None
-            )
-            out, matched = op._expand_step(
-                pb, bb, st, ct, matched0, out_cap=out_cap,
-                cap_b=cap_b, total_emit=total,
-            )
-            if node.kind == "full":
-                # per-shard unmatched-build tail: with PARTITIONED inputs
-                # every build row lives on exactly one shard, so the tail
-                # emits each unmatched build row exactly once globally
-                tail_live = jnp.logical_and(
-                    bb.mask(), jnp.logical_not(matched)
+        def build_expand():
+            def expand_step(pb: Batch, bb: Batch, st, ct, total):
+                matched0 = (
+                    jnp.zeros(cap_b, dtype=bool)
+                    if node.kind == "full"
+                    else None
                 )
-                ncols = [
-                    Column(
-                        jnp.zeros(cap_b, dtype=t.np_dtype),
-                        t,
-                        jnp.zeros(cap_b, dtype=bool),
-                        None,
+                out, matched = op._expand_step(
+                    pb, bb, st, ct, matched0, out_cap=out_cap,
+                    cap_b=cap_b, total_emit=total,
+                )
+                if node.kind == "full":
+                    # per-shard unmatched-build tail: with PARTITIONED
+                    # inputs every build row lives on exactly one shard, so
+                    # the tail emits each unmatched build row exactly once
+                    tail_live = jnp.logical_and(
+                        bb.mask(), jnp.logical_not(matched)
                     )
-                    for t in probe_types
-                ]
-                tail = Batch(ncols + list(bb.columns), tail_live)
-                out = concat_batches([out, tail])
-            return out
+                    ncols = [
+                        Column(
+                            jnp.zeros(cap_b, dtype=t.np_dtype),
+                            t,
+                            jnp.zeros(cap_b, dtype=bool),
+                            None,
+                        )
+                        for t in probe_types
+                    ]
+                    tail = Batch(ncols + list(bb.columns), tail_live)
+                    out = concat_batches([out, tail])
+                return out
 
-        out = spmd_step(self.wm, expand_step)(
+            return expand_step
+
+        expand = cached_spmd_step(
+            self.wm, ("join_expand", out_cap) + jkey, build_expand
+        )
+        out = self._call(
+            expand,
             probe.stacked, sorted_build, start, count,
             jax.device_put(totals, self.wm.sharding()),
         )
-        return _Dist(out, out_symbols)
+        return self._dist(out, out_symbols)
 
     def _x_SemiJoinNode(self, node: P.SemiJoinNode) -> _Dist:
         if isinstance(node.source, RemoteSourceNode):
@@ -806,51 +1207,82 @@ class StageExecutor:
                 null_aware=node.null_aware,
                 residual=residual,
             )
-            has_null = _global_has_null(filt.stacked)
-            cap_b = _trailing_cap(filt.stacked)
-
-            def locate_step(pb: Batch, bb: Batch):
-                sb, canon, n_match = _sort_build_device(bb, [fk])
-                pc, pn = _canon_probe_device(pb, [sk], canon)
-                st, ct = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
-                return st, ct, sb
-
-            start, count, sorted_b = spmd_step(self.wm, locate_step)(
-                src.stacked, filt.stacked
+            filt_stacked = filt.stacked
+            has_null = _global_has_null(filt_stacked)
+            cap_b = _trailing_cap(filt_stacked)
+            skey = (
+                sk, fk, cap_b, node.null_aware, has_null, expr.key(),
+                _sig(src.symbols), _sig(filt.symbols),
             )
-            totals = (
-                np.asarray(device_get_async(count)).sum(axis=-1)  # [W]
+
+            def build_locate():
+                def locate_step(pb: Batch, bb: Batch):
+                    sb, canon, n_match = _sort_build_device(bb, [fk])
+                    pc, pn = _canon_probe_device(pb, [sk], canon)
+                    st, ct = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
+                    return st, ct, sb
+
+                return locate_step
+
+            locate = cached_spmd_step(
+                self.wm, ("semi_locate",) + skey, build_locate
             )
+            start, count, sorted_b = self._call(locate, src.stacked, filt_stacked)
+            with self.profile.phase(self._current_fid, "transfer"):
+                totals = (
+                    np.asarray(device_get_async(count)).sum(axis=-1)  # [W]
+                )
             out_cap = next_pow2(max(1, int(totals.max())), floor=1024)
 
-            def mark_step(pb: Batch, bb: Batch, st, ct, total) -> Batch:
-                return op._mark_residual_step(
-                    pb, bb, st, ct,
-                    cap_b=cap_b, out_cap=out_cap, total_emit=total,
-                    has_null=has_null,
-                )
+            def build_mark():
+                def mark_step(pb: Batch, bb: Batch, st, ct, total) -> Batch:
+                    return op._mark_residual_step(
+                        pb, bb, st, ct,
+                        cap_b=cap_b, out_cap=out_cap, total_emit=total,
+                        has_null=has_null,
+                    )
 
-            out = spmd_step(self.wm, mark_step)(
+                return mark_step
+
+            mark = cached_spmd_step(
+                self.wm, ("semi_mark_residual", out_cap) + skey, build_mark
+            )
+            out = self._call(
+                mark,
                 src.stacked, sorted_b, start, count,
                 jax.device_put(totals, self.wm.sharding()),
             )
-            return _Dist(out, src.symbols + [node.mark])
+            return self._dist(out, src.symbols + [node.mark])
 
         op = SemiJoinOperator(
             sk, fk, [s.type for s in filt.symbols], null_aware=node.null_aware
         )
-        bcast = ex.broadcast(filt.stacked, self.wm)
+        bcast = self._call(
+            ex.broadcast, filt.stacked, self.wm, phase="collective"
+        )
+        self.profile.fragment(self._current_fid).collective_bytes += (
+            batch_bytes(bcast)
+        )
         cap_b = _trailing_cap(bcast)
         has_null = _global_has_null(bcast)
 
-        def mark_step(pb: Batch, bb: Batch) -> Batch:
-            _, canon, n_match = _sort_build_device(bb, [fk])
-            pc, pn = _canon_probe_device(pb, [sk], canon)
-            _, count = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
-            return op._mark_step(pb, count, has_null)
+        def build_mark():
+            def mark_step(pb: Batch, bb: Batch) -> Batch:
+                _, canon, n_match = _sort_build_device(bb, [fk])
+                pc, pn = _canon_probe_device(pb, [sk], canon)
+                _, count = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
+                return op._mark_step(pb, count, has_null)
 
-        out = spmd_step(self.wm, mark_step)(src.stacked, bcast)
-        return _Dist(out, src.symbols + [node.mark])
+            return mark_step
+
+        mark = cached_spmd_step(
+            self.wm,
+            ("semi_mark", sk, fk, cap_b, node.null_aware, has_null,
+             _sig(src.symbols), _sig(filt.symbols)),
+            build_mark,
+        )
+        out = self._call(mark, src.stacked, bcast)
+        return self._dist(out, src.symbols + [node.mark])
 
     def _x_UnnestNode(self, node: P.UnnestNode) -> _Dist:
         from trino_tpu.ops.unnest import UnnestOperator
@@ -863,18 +1295,28 @@ class StageExecutor:
             cols, mask = op.raw_step(b)
             return Batch(cols, mask)
 
-        out = spmd_step(self.wm, step)(src.stacked)
-        return _Dist(out, node.outputs)
+        # output capacity is element-shape dependent: run eagerly (still a
+        # cached program) rather than deferring with an unknown cap
+        fn = cached_spmd_step(
+            self.wm,
+            ("unnest", tuple(e.key() for e in exprs),
+             node.ordinality is not None, _sig(src.symbols), src.cap),
+            lambda: step,
+        )
+        out = self._call(fn, src.stacked)
+        return self._dist(out, node.outputs)
 
     def _x_MarkDistinctNode(self, node: P.MarkDistinctNode) -> _Dist:
         from trino_tpu.ops.aggregation import MarkDistinctOperator
 
         src = self._exec(node.source)
-        op = MarkDistinctOperator(
-            [src.channel(s.name) for s in node.key_symbols]
+        chans = tuple(src.channel(s.name) for s in node.key_symbols)
+        op = MarkDistinctOperator(list(chans))
+        return src.defer(
+            ("mark_distinct", chans, _sig(src.symbols)),
+            op._mark_step,
+            symbols=node.outputs,
         )
-        out = spmd_step(self.wm, op._mark_step)(src.stacked)
-        return _Dist(out, node.outputs)
 
     # -- window ---------------------------------------------------------------
 
@@ -910,8 +1352,12 @@ class StageExecutor:
         op = WindowOperator(part, order, specs)
         # per-worker window over hash-partitioned rows: every partition is
         # wholly on one worker after the repartition exchange below this node
-        out = spmd_step(self.wm, op._window_step)(src.stacked)
-        return _Dist(out, node.outputs)
+        return src.defer(
+            ("window", tuple(part), tuple(repr(k) for k in order),
+             tuple(repr(s) for s in specs), _sig(src.symbols)),
+            op._window_step,
+            symbols=node.outputs,
+        )
 
     # -- ordering / limiting (partial steps; merge happens at the exchange) ---
 
@@ -922,8 +1368,10 @@ class StageExecutor:
             for s, asc, nf in node.orderings
         ]
         op = OrderByOperator(keys)
-        out = spmd_step(self.wm, op._sort_step)(src.stacked)
-        return _Dist(out, src.symbols)
+        return src.defer(
+            ("sort", tuple(repr(k) for k in keys), _sig(src.symbols)),
+            op._sort_step,
+        )
 
     def _x_TopNNode(self, node: P.TopNNode) -> _Dist:
         src = self._exec(node.source)
@@ -937,8 +1385,12 @@ class StageExecutor:
         def step(b: Batch) -> Batch:
             return op._merge_step(b, out_cap=out_cap)
 
-        out = spmd_step(self.wm, step)(src.stacked)
-        return _Dist(out, src.symbols)
+        return src.defer(
+            ("topn", tuple(repr(k) for k in keys), node.count, out_cap,
+             _sig(src.symbols)),
+            step,
+            cap=out_cap,
+        )
 
     def _x_LimitNode(self, node: P.LimitNode) -> _Dist:
         src = self._exec(node.source)
@@ -949,8 +1401,7 @@ class StageExecutor:
             rank = jnp.cumsum(live) - 1
             return b.filter(jnp.logical_and(live, rank < n))
 
-        out = spmd_step(self.wm, step)(src.stacked)
-        return _Dist(out, src.symbols)
+        return src.defer(("limit", n, _sig(src.symbols)), step)
 
 
 def _slice_host(batch: Batch, n: int) -> Batch:
@@ -973,5 +1424,3 @@ def _trailing_cap(stacked: Batch) -> int:
     if stacked.columns:
         return stacked.columns[0].data.shape[-1]
     return stacked.row_mask.shape[-1]
-
-

@@ -9,6 +9,9 @@ property (SqlTaskExecution), realized as SPMD.
 
 from __future__ import annotations
 
+import threading
+import time
+from collections import OrderedDict
 from functools import partial
 from typing import Callable, Optional, Sequence
 
@@ -21,9 +24,82 @@ from trino_tpu.columnar import Batch, Column
 from trino_tpu.ops.common import next_pow2
 
 
+class TraceCache:
+    """Process-wide cache of jitted SPMD programs, keyed on the step's
+    semantic fingerprint + shape bucket (reference role: the task-level
+    operator-factory reuse a long-lived worker gets for free; here the jit
+    wrapper IS the compiled task, so a fresh closure per execution would
+    retrace and recompile every fragment every query).
+
+    Keys must capture everything the step closure bakes in that is not a
+    traced argument or pytree aux data: expression fingerprints, static
+    capacities, dynamic-filter ranges, mesh signature.  Dictionaries and
+    dtypes ride as pytree aux, so jax's own jit cache retraces on their
+    change — `retraces` counts those trace-time executions (zero after
+    warmup for repeated same-bucket batches)."""
+
+    def __init__(self, limit: int = 512):
+        self.limit = limit
+        self._fns: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.retraces = 0
+        #: wall seconds spent inside calls that traced (trace + XLA compile)
+        self.trace_s = 0.0
+
+    def get(self, key, build: Callable):
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                self._fns.move_to_end(key)
+                self.hits += 1
+                return fn
+        fn = build()
+        with self._lock:
+            self.misses += 1
+            self._fns[key] = fn
+            while len(self._fns) > self.limit:
+                self._fns.popitem(last=False)
+        return fn
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._fns),
+            "hits": self.hits,
+            "misses": self.misses,
+            "retraces": self.retraces,
+            "trace_s": round(self.trace_s, 4),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._fns.clear()
+
+
+#: the process-wide cache (cleared only by tests / explicit calls)
+TRACE_CACHE = TraceCache()
+
+
+def mesh_key(wm: "WorkerMesh") -> tuple:
+    """Stable fingerprint of the mesh for trace-cache keys."""
+    return (wm.n, tuple(str(d) for d in wm.devices))
+
+
+def bucket_cap(n: int, floor: int = 64) -> int:
+    """Pow2 shape bucket for batch capacities: a small set of distinct
+    shapes so (fragment, bucket)-keyed traces are reused across batches."""
+    return next_pow2(max(1, n), floor=floor)
+
+
 def shard_map_compat(fn, mesh, in_specs, out_specs):
-    """jax.shard_map across API versions (check_rep -> check_vma rename)."""
-    from jax import shard_map
+    """jax.shard_map across API versions (top-level export landed after
+    0.4.x — fall back to jax.experimental.shard_map — and the check_rep ->
+    check_vma rename)."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
 
     for kw in ({"check_vma": False}, {"check_rep": False}, {}):
         try:
@@ -183,9 +259,12 @@ def spmd_step(wm: WorkerMesh, step: Callable, out_replicated: bool = False):
 
     `step` sees a worker-local Batch (no leading axis) and returns one; the
     wrapper maps it over the mesh with shard_map, squeezing the local [1, cap]
-    shard view to [cap]."""
+    shard view to [cap].  The python body only runs while jax traces — each
+    run bumps TRACE_CACHE.retraces, so "zero retraces after warmup" is a
+    measured fact, not an assumption."""
 
     def local(*args):
+        TRACE_CACHE.retraces += 1
         squeezed = jax.tree.map(lambda x: x[0], list(args))
         out = step(*squeezed)
         return jax.tree.map(lambda x: x[None], out)
@@ -200,7 +279,29 @@ def spmd_collective_step(wm: WorkerMesh, step: Callable, out_replicated: bool = 
     """Like spmd_step but `step` may use collectives over axis name
     'workers' (all_to_all / all_gather / psum); the local shard view keeps
     its leading axis of 1 so collective outputs shape naturally."""
+
+    def traced(*args):
+        TRACE_CACHE.retraces += 1
+        return step(*args)
+
     inner = shard_map_compat(
-        step, wm.mesh, P("workers"), P() if out_replicated else P("workers")
+        traced, wm.mesh, P("workers"), P() if out_replicated else P("workers")
     )
     return jax.jit(inner)
+
+
+def cached_spmd_step(
+    wm: WorkerMesh,
+    key: tuple,
+    build_step: Callable,
+    out_replicated: bool = False,
+    collective: bool = False,
+):
+    """TRACE_CACHE-backed spmd_step: `build_step()` constructs the per-worker
+    step closure only on a cache miss.  `key` must fingerprint the step's
+    semantics (expression text, static caps, mesh) — see TraceCache."""
+    lift = spmd_collective_step if collective else spmd_step
+    return TRACE_CACHE.get(
+        ("spmd", collective, out_replicated, mesh_key(wm)) + tuple(key),
+        lambda: lift(wm, build_step(), out_replicated=out_replicated),
+    )

@@ -195,6 +195,26 @@ class ExprAnalyzer:
             # digits; 19+ digits become a long (two-limb) decimal
             return Literal(Decimal(t), T.DecimalType(max(digits, 1), scale))
         v = int(t)
+        if n.decimal:
+            # DECIMAL '123' is decimal(3,0), never integer/bigint — an
+            # undotted 19+ digit literal must keep its long-decimal type
+            digits = len(t.lstrip("-+").lstrip("0"))
+            if digits > 38:
+                raise AnalysisError(
+                    f"decimal literal exceeds precision 38: {t}"
+                )
+            return Literal(Decimal(t), T.DecimalType(max(digits, 1), 0))
+        if not -(2**63) <= v < 2**63:
+            # an undotted literal beyond bigint range types as a decimal —
+            # np.int64(v) in the compiler would otherwise crash with a raw
+            # OverflowError, and cast contexts (including the recursive-CTE
+            # working-table rebinding) legitimately produce these
+            digits = len(t.lstrip("-+").lstrip("0"))
+            if digits > 38:
+                raise AnalysisError(
+                    f"numeric literal exceeds precision 38: {t}"
+                )
+            return Literal(Decimal(t), T.DecimalType(max(digits, 1), 0))
         return Literal(v, T.INTEGER if -(2**31) <= v < 2**31 else T.BIGINT)
 
     def _a_StringLiteral(self, n: ast.StringLiteral) -> Expr:
@@ -441,10 +461,28 @@ class ExprAnalyzer:
     def _a_UnaryOp(self, n: ast.UnaryOp) -> Expr:
         if n.op == "not":
             return ir.not_(self.analyze(n.operand))
+        if n.op == "-" and isinstance(n.operand, ast.NumberLiteral):
+            # fold the sign into the literal text BEFORE range checks so
+            # -9223372036854775808 (min bigint: unsigned text 2**63) types
+            # (reference: Trino's min-long literal special case)
+            return self._a_NumberLiteral(
+                ast.NumberLiteral("-" + n.operand.text, n.operand.decimal)
+            )
         v = self.analyze(n.operand)
         if n.op == "-":
             if isinstance(v, Literal) and v.value is not None:
-                return Literal(-v.value, v.type)
+                val = -v.value
+                if T.is_integer_kind(v.type):
+                    # negating a min-value literal overflows the type:
+                    # wrap two's-complement like the device $neg would
+                    # (np.int64(2**63) would crash the compiler)
+                    import numpy as np
+
+                    info = np.iinfo(v.type.np_dtype)
+                    if not int(info.min) <= val <= int(info.max):
+                        m = 1 << info.bits
+                        val = ((val + (m >> 1)) % m) - (m >> 1)
+                return Literal(val, v.type)
             return Call("$neg", [v], v.type)
         return v
 

@@ -551,6 +551,26 @@ def plan_text(node: PlanNode, indent: int = 0) -> str:
             detail += f" limit={node.count}"
     elif isinstance(node, LimitNode):
         detail = f"[{node.count}]"
+    elif isinstance(node, WindowNode):
+        fns = ", ".join(
+            f"{s.name} := {f.name}({', '.join(map(repr, f.args))}) "
+            f"frame={f.frame}[{f.start_off},{f.end_off}] off={f.offset}"
+            for s, f in node.functions
+        )
+        part = ", ".join(s.name for s in node.partition_by)
+        order = ", ".join(
+            f"{s.name} {'ASC' if asc else 'DESC'}"
+            for s, asc, _ in node.order_by
+        )
+        detail = f"[{fns}] partition=[{part}] order=[{order}]"
+    elif isinstance(node, UnnestNode):
+        items = ", ".join(f"{s.name} := {e!r}" for s, e in node.unnest)
+        detail = f"[{items}]" + (
+            " ordinality" if node.ordinality is not None else ""
+        )
+    elif isinstance(node, MarkDistinctNode):
+        keys = ", ".join(s.name for s in node.key_symbols)
+        detail = f"[{keys} -> {node.mark.name}]"
     elif isinstance(node, OutputNode):
         detail = "[" + ", ".join(node.column_names) + "]"
     elif isinstance(node, ExchangeNode):

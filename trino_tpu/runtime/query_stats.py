@@ -15,7 +15,136 @@ per-call CPU vs scheduled split in OperatorStats).
 from __future__ import annotations
 
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
+
+
+#: phase vocabulary of the mesh fragment profile (order = render order)
+MESH_PHASES = ("trace", "compute", "collective", "transfer", "other")
+
+
+@dataclass
+class FragmentStats:
+    """Per-fragment, per-phase breakdown of one distributed stage
+    (reference role: StageStats / the per-stage rollup of OperatorStats).
+
+    wall_s is the stage's SELF time (child-stage walls excluded); phases
+    always sum to wall_s because `other` absorbs the untracked remainder,
+    so `sum(phases) == wall` is an invariant, not an approximation."""
+
+    fragment_id: int
+    kind: str = ""
+    wall_s: float = 0.0
+    phases: dict = field(default_factory=lambda: {p: 0.0 for p in MESH_PHASES})
+    #: bytes moved by this stage, by direction/kind
+    bytes_to_device: int = 0
+    bytes_to_host: int = 0
+    collective_bytes: int = 0
+
+    def close(self) -> None:
+        tracked = sum(v for k, v in self.phases.items() if k != "other")
+        self.phases["other"] = max(0.0, self.wall_s - tracked)
+
+    def line(self) -> str:
+        ph = " ".join(
+            f"{k}={self.phases.get(k, 0.0) * 1e3:.1f}ms" for k in MESH_PHASES
+        )
+        return (
+            f"Fragment {self.fragment_id} [{self.kind}] "
+            f"wall={self.wall_s * 1e3:.1f}ms {ph} "
+            f"bytes(to_device={self.bytes_to_device} "
+            f"to_host={self.bytes_to_host} "
+            f"collective={self.collective_bytes})"
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "fragment": self.fragment_id,
+            "kind": self.kind,
+            "wall_s": round(self.wall_s, 4),
+            "phases_ms": {
+                k: round(v * 1e3, 2) for k, v in self.phases.items()
+            },
+            "bytes_to_device": self.bytes_to_device,
+            "bytes_to_host": self.bytes_to_host,
+            "collective_bytes": self.collective_bytes,
+        }
+
+
+class MeshProfile:
+    """Per-query mesh execution profile: one FragmentStats per distributed
+    stage plus query-wide transfer/trace counters.  `blocking=True` (EXPLAIN
+    ANALYZE / bench) blocks on device results inside each phase so the
+    breakdown measures device time, not dispatch time — measurement mode
+    only, it serializes the async pipeline."""
+
+    def __init__(self, blocking: bool = False):
+        self.blocking = blocking
+        self.fragments: dict[int, FragmentStats] = {}
+        #: query-wide event counters: host_gather (device->host exchanges),
+        #: host_restack (host->device re-stacks BETWEEN fragments — zero on
+        #: the device-resident path), scan_cache_hit/miss
+        self.counters: dict[str, int] = {}
+        self.trace_hits = 0
+        self.trace_misses = 0
+        self.retraces = 0
+
+    def fragment(self, fid: int) -> FragmentStats:
+        st = self.fragments.get(fid)
+        if st is None:
+            st = self.fragments[fid] = FragmentStats(fid)
+        return st
+
+    def bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    @contextmanager
+    def phase(self, fid: int, name: str):
+        """Time a phase of fragment `fid` (caller blocks inside the window
+        when self.blocking, so the phase measures device time)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_phase(fid, name, time.perf_counter() - t0)
+
+    def add_phase(self, fid: int, name: str, seconds: float) -> None:
+        st = self.fragment(fid)
+        st.phases[name] = st.phases.get(name, 0.0) + seconds
+
+    def render(self) -> str:
+        lines = [
+            "Mesh execution profile (per-fragment; wall = stage self time):"
+        ]
+        for fid in sorted(self.fragments):
+            lines.append("  " + self.fragments[fid].line())
+        lines.append(
+            "  trace cache: "
+            f"hits={self.trace_hits} misses={self.trace_misses} "
+            f"retraces={self.retraces}"
+        )
+        if self.counters:
+            lines.append(
+                "  transfers: "
+                + " ".join(
+                    f"{k}={v}" for k, v in sorted(self.counters.items())
+                )
+            )
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        return {
+            "fragments": [
+                self.fragments[fid].to_json()
+                for fid in sorted(self.fragments)
+            ],
+            "trace_cache": {
+                "hits": self.trace_hits,
+                "misses": self.trace_misses,
+                "retraces": self.retraces,
+            },
+            "counters": dict(self.counters),
+        }
 
 
 @dataclass
@@ -45,6 +174,9 @@ class StatsCollector:
         #: per-query MemoryContext set by the execution planner so peak
         #: reservations render with the stats (MemoryPool visibility)
         self.memory = None
+        #: MeshProfile attached by the distributed runner so EXPLAIN ANALYZE
+        #: renders the per-fragment collective/compute/transfer breakdown
+        self.mesh_profile = None
 
     def register(self, name: str, detail: str = "", depth: int = 0) -> OperatorStats:
         st = OperatorStats(self._next_id, name, detail, depth=depth)
@@ -86,6 +218,8 @@ class StatsCollector:
             "Query execution statistics (wall = inclusive of subtree; "
             "device = blocked-on-device per op):"
         ]
+        if self.mesh_profile is not None:
+            lines.append(self.mesh_profile.render())
         for st in reversed(self.operators):
             lines.append(st.line())
         total_dev = sum(st.device_s for st in self.operators)

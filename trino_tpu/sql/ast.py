@@ -24,6 +24,9 @@ class Identifier(Node):
 @dataclass(frozen=True)
 class NumberLiteral(Node):
     text: str
+    #: True for DECIMAL '...' typed literals: an undotted text must still
+    #: type as a decimal (digits, 0), never integer/bigint
+    decimal: bool = False
 
 
 @dataclass(frozen=True)

@@ -1210,7 +1210,7 @@ class Parser:
             and self.peek().kind == "string"
         ):
             # DECIMAL '1.23' typed literal
-            e = ast.NumberLiteral(self.next().value)
+            e = ast.NumberLiteral(self.next().value, decimal=True)
         elif t.is_kw("interval"):
             sign = 1
             if self.accept_op("-"):
