@@ -1,0 +1,452 @@
+"""Tests for the static-analysis verification subsystem (trino_tpu/verify):
+plan sanity checkers over hand-built broken plans, strict verification of
+every optimizer-emitted TPC-H/TPC-DS plan, the trace-cache key-completeness
+audit, the device-residency contract on a warm mesh-8 run, and the AST lint
+gate over the repo (so plain `pytest` enforces the linter, not just CI)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu import verify as V
+from trino_tpu.expr.ir import Literal, and_, comparison
+from trino_tpu.planner import plan as P
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sym(name, typ=T.BIGINT):
+    return P.Symbol(name, typ)
+
+
+def _values(*symbols):
+    return P.ValuesNode(list(symbols), [])
+
+
+def _rules(violations):
+    return {v.rule for v in violations}
+
+
+# -- hand-built broken plans --------------------------------------------------
+
+
+class TestBrokenPlans:
+    def test_clean_plan_passes(self):
+        a = _sym("a")
+        plan = P.FilterNode(
+            _values(a), comparison(">", a.ref(), Literal(1, T.BIGINT))
+        )
+        assert V.check_plan(plan) == []
+
+    def test_duplicate_node_instance(self):
+        a = _sym("a")
+        shared = _values(a)
+        plan = P.UnionNode([shared, shared], [a], [[a], [a]])
+        assert "duplicate-node" in _rules(V.check_plan(plan))
+
+    def test_duplicate_node_id(self):
+        a = _sym("a")
+        left = _values(a)
+        right = _values(a)
+        right.id = left.id  # simulate a rewrite that cloned ids
+        plan = P.UnionNode([left, right], [a], [[a], [a]])
+        assert "duplicate-node-id" in _rules(V.check_plan(plan))
+
+    def test_dangling_symbol(self):
+        a = _sym("a")
+        ghost = _sym("ghost")
+        plan = P.FilterNode(
+            _values(a), comparison(">", ghost.ref(), Literal(1, T.BIGINT))
+        )
+        vs = V.check_plan(plan)
+        assert "dangling-symbol" in _rules(vs)
+        v = next(x for x in vs if x.rule == "dangling-symbol")
+        assert "ghost" in str(v) and v.node_id > 0
+
+    def test_symbol_type_mismatch(self):
+        a = _sym("a", T.BIGINT)
+        wrong_ref = P.Symbol("a", T.VARCHAR).ref()  # reads bigint as varchar
+        plan = P.FilterNode(
+            _values(a), comparison(">", wrong_ref, Literal(1, T.BIGINT))
+        )
+        assert "symbol-type-mismatch" in _rules(V.check_plan(plan))
+
+    def test_filter_predicate_not_boolean(self):
+        a = _sym("a")
+        plan = P.FilterNode(_values(a), a.ref())  # bigint predicate
+        assert "predicate-not-boolean" in _rules(V.check_plan(plan))
+
+    def test_project_type_mismatch(self):
+        a = _sym("a", T.VARCHAR)
+        out = _sym("x", T.BIGINT)
+        plan = P.ProjectNode(_values(a), [(out, a.ref())])
+        assert "project-type-mismatch" in _rules(V.check_plan(plan))
+
+    def test_join_key_dtype_mismatch(self):
+        l = _sym("l", T.VARCHAR)
+        r = _sym("r", T.DOUBLE)
+        plan = P.JoinNode("inner", _values(l), _values(r), [(l, r)])
+        assert "join-key-type-mismatch" in _rules(V.check_plan(plan))
+
+    def test_join_key_int_widths_are_hash_compatible(self):
+        # the exchange hash canonicalizes to int64: mixed integer widths
+        # meet at a repartition legally
+        l = _sym("l", T.INTEGER)
+        r = _sym("r", T.BIGINT)
+        plan = P.JoinNode("inner", _values(l), _values(r), [(l, r)])
+        assert V.check_plan(plan) == []
+
+    def test_decimal_scale_mismatch_join_keys(self):
+        l = _sym("l", T.DecimalType(12, 2))
+        r = _sym("r", T.DecimalType(12, 4))  # same family, different scale
+        plan = P.JoinNode("inner", _values(l), _values(r), [(l, r)])
+        assert "join-key-type-mismatch" in _rules(V.check_plan(plan))
+
+    def test_bad_exchange_partitioning(self):
+        a = _sym("a")
+        ghost = _sym("ghost")
+        plan = P.ExchangeNode(_values(a), "repartition", [ghost])
+        vs = V.check_plan(plan)
+        assert "dangling-symbol" in _rules(vs)
+        assert any("partition" in str(v) for v in vs)
+
+    def test_composite_exchange_partition_key(self):
+        # packed array/map layouts do not hash canonically: repartitioning
+        # on one scatters equal keys across workers
+        a = _sym("a", T.ArrayType(T.BIGINT))
+        plan = P.ExchangeNode(_values(a), "repartition", [a])
+        assert "exchange-key-not-hashable" in _rules(V.check_plan(plan))
+
+    def test_bad_exchange_kind(self):
+        a = _sym("a")
+        plan = P.ExchangeNode(_values(a), "teleport", [a])
+        assert "bad-exchange-kind" in _rules(V.check_plan(plan))
+
+    def test_agg_output_type_rule(self):
+        a = _sym("a")
+        cnt = _sym("c", T.VARCHAR)  # count must be bigint
+        plan = P.AggregationNode(
+            _values(a), [], [(cnt, P.Aggregation("count", [a.ref()]))]
+        )
+        assert "agg-type-mismatch" in _rules(V.check_plan(plan))
+
+    def test_union_type_mismatch(self):
+        a = _sym("a", T.BIGINT)
+        b = _sym("b", T.DATE)  # date does not coerce to bigint
+        out = _sym("u", T.BIGINT)
+        plan = P.UnionNode([_values(a), _values(b)], [out], [[a], [b]])
+        assert "union-type-mismatch" in _rules(V.check_plan(plan))
+
+    def test_values_arity(self):
+        a = _sym("a")
+        plan = P.ValuesNode([a], [(1, 2)])
+        assert "values-arity" in _rules(V.check_plan(plan))
+
+    def test_strict_enforcement_raises_named_violation(self):
+        a = _sym("a")
+        ghost = _sym("ghost")
+        plan = P.FilterNode(
+            _values(a), comparison(">", ghost.ref(), Literal(1, T.BIGINT))
+        )
+        with pytest.raises(V.PlanViolation) as ei:
+            V.enforce(V.check_plan(plan), "strict")
+        assert ei.value.rule == "dangling-symbol"
+        assert ei.value.node_type == "FilterNode"
+
+    def test_warn_mode_collects_instead_of_raising(self):
+        a = _sym("a")
+        plan = P.FilterNode(_values(a), a.ref())
+        before = len(V.LAST_WARNINGS)
+        with pytest.warns(RuntimeWarning):
+            V.enforce(V.check_plan(plan), "warn")
+        assert len(V.LAST_WARNINGS) > before
+
+    def test_default_mode_is_strict_under_pytest(self):
+        assert V.resolve_mode(None) == "strict"
+        assert V.resolve_mode("default") == "strict"
+        assert V.resolve_mode("off") == "off"
+
+
+# -- optimizer integration ----------------------------------------------------
+
+
+class TestOptimizerIntegration:
+    def test_broken_rule_caught_at_its_iteration(self):
+        """A rewrite rule that drops a produced symbol fails the fixpoint
+        check that follows it, not the eventual execution."""
+        from trino_tpu.planner.optimizer import optimize
+        from trino_tpu.runtime.runner import LocalQueryRunner
+
+        r = LocalQueryRunner()
+        plan = optimize(
+            r.create_plan("select 1 as x"), catalogs=r.catalogs
+        )  # sanity: the pipeline itself is clean
+
+        def evil_rule(node):
+            # rewrite any Filter to reference a symbol nobody produces
+            if isinstance(node, P.FilterNode) and not getattr(
+                node, "_evil", False
+            ):
+                ghost = P.Symbol("no_such_symbol", T.BOOLEAN)
+                out = P.FilterNode(node.source, ghost.ref())
+                out._evil = True
+                return out
+            return None
+
+        from trino_tpu.planner import optimizer as O
+
+        base = r.create_plan("select 1 as x")
+        broken = P.OutputNode(
+            P.FilterNode(base.source, Literal(True, T.BOOLEAN)),
+            base.column_names,
+            base.symbols,
+        )
+        with pytest.raises(V.PlanViolation) as ei:
+            O.optimize(broken, rules=[evil_rule], catalogs=r.catalogs,
+                       verify="strict")
+        assert ei.value.rule == "dangling-symbol"
+
+    def test_tpch_all_plans_pass_strict(self):
+        from trino_tpu.connectors.tpch.queries import QUERIES
+        from trino_tpu.runtime.runner import LocalQueryRunner
+
+        r = LocalQueryRunner()
+        r.properties.set("verify_plan", "strict")
+        for q in sorted(QUERIES):
+            r.create_plan(QUERIES[q])  # raises PlanViolation on any failure
+
+    def test_tpcds_all_plans_pass_strict(self):
+        from trino_tpu.connectors.tpcds.queries import QUERIES
+        from trino_tpu.runtime.runner import LocalQueryRunner
+
+        r = LocalQueryRunner(catalog="tpcds", schema="tiny")
+        r.properties.set("verify_plan", "strict")
+        for q in sorted(QUERIES):
+            r.create_plan(QUERIES[q])
+
+    def test_tpch_distributed_subplans_pass_strict(self):
+        from trino_tpu.connectors.tpch.queries import QUERIES
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+
+        r = DistributedQueryRunner()
+        r.properties.set("verify_plan", "strict")
+        for q in sorted(QUERIES):
+            r.create_subplan(r.create_plan(QUERIES[q]))
+
+    def test_grouping_sets_branches_are_fresh_instances(self):
+        """The grouping-set UNION lowering copies the shared input per
+        branch (the duplicate-node rule the checker caught on 11 TPC-DS
+        rollup queries)."""
+        from trino_tpu.runtime.runner import LocalQueryRunner
+
+        r = LocalQueryRunner()
+        r.properties.set("verify_plan", "strict")
+        plan = r.create_plan(
+            "select n_regionkey, n_nationkey, count(*) from nation "
+            "group by rollup (n_regionkey, n_nationkey)"
+        )
+        seen = set()
+        for node in P.walk(plan):
+            assert id(node) not in seen
+            seen.add(id(node))
+
+
+# -- fragment-level invariants ------------------------------------------------
+
+
+class TestSubplanChecks:
+    def test_remote_source_symbol_mismatch(self):
+        from trino_tpu.planner.fragmenter import (
+            PartitioningHandle,
+            PlanFragment,
+            RemoteSourceNode,
+            SINGLE,
+            SOURCE,
+            SubPlan,
+        )
+
+        a = _sym("a")
+        child_root = _values(a)
+        child = SubPlan(
+            PlanFragment(1, child_root, PartitioningHandle(SOURCE)), []
+        )
+        wrong = _sym("not_a")
+        parent_root = RemoteSourceNode(1, [wrong], "gather")
+        parent = SubPlan(
+            PlanFragment(0, parent_root, PartitioningHandle(SINGLE)), [child]
+        )
+        assert "remote-symbol-mismatch" in _rules(V.check_subplan(parent))
+
+    def test_dangling_remote_source(self):
+        from trino_tpu.planner.fragmenter import (
+            PartitioningHandle,
+            PlanFragment,
+            RemoteSourceNode,
+            SINGLE,
+            SubPlan,
+        )
+
+        a = _sym("a")
+        root = RemoteSourceNode(99, [a], "gather")
+        sub = SubPlan(PlanFragment(0, root, PartitioningHandle(SINGLE)), [])
+        assert "dangling-remote-source" in _rules(V.check_subplan(sub))
+
+
+# -- trace-cache key-completeness audit ---------------------------------------
+
+
+class TestCacheKeyAudit:
+    def test_same_key_same_closure_passes(self):
+        from trino_tpu.parallel.spmd import TRACE_CACHE
+
+        def make(n):
+            def build():
+                def step(x):
+                    return x + n
+
+                return step
+
+            return build
+
+        key = ("test_audit_ok", id(self))
+        with V.cache_key_audit() as auditor:
+            TRACE_CACHE.get(key, make(1))
+            TRACE_CACHE.get(key, make(1))
+        assert auditor.checked == 2
+
+    def test_incomplete_key_raises(self):
+        """Two builders whose steps bake DIFFERENT constants must not share
+        a cache key — the second arrival raises CacheKeyViolation naming
+        the differing free variable."""
+        from trino_tpu.parallel.spmd import TRACE_CACHE
+
+        def make(n):
+            def build():
+                def step(x):
+                    return x + n
+
+                return step
+
+            return build
+
+        key = ("test_audit_bad", id(self))
+        with V.cache_key_audit():
+            TRACE_CACHE.get(key, make(1))
+            with pytest.raises(V.CacheKeyViolation) as ei:
+                TRACE_CACHE.get(key, make(2))
+        assert "n" in str(ei.value)
+
+    def test_fingerprint_sees_nested_closures_and_arrays(self):
+        import numpy as np
+
+        table = np.arange(4)
+
+        def outer():
+            def inner(x):
+                return x + table
+
+            return inner
+
+        fp1 = V.closure_fingerprint(outer())
+        table2 = np.arange(4)
+        table2[0] = 99
+
+        def outer2():
+            def inner(x):
+                return x + table2
+
+            return inner
+
+        assert fp1 != V.closure_fingerprint(outer2())
+
+
+# -- device residency (warm mesh-8) -------------------------------------------
+
+
+class TestDeviceResidency:
+    def test_warm_q6_mesh8_is_device_resident(self):
+        """The acceptance contract: a warm mesh-8 TPC-H Q6 run performs
+        zero retraces and zero unexpected host transfers, with the
+        cache-key audit live over its trace traffic."""
+        from trino_tpu.connectors.tpch.queries import QUERIES
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+
+        runner = DistributedQueryRunner(n_workers=8)
+        report = V.device_residency(runner, QUERIES[6])
+        assert report["retraces"] == 0
+        assert report["counters"].get("host_restack", 0) == 0
+        assert report["cache_keys_checked"] > 0
+
+    def test_residency_violation_detected(self):
+        """A query that re-enters the mesh from the host (host_restack)
+        fails the contract — the detector is live, not vacuous."""
+        from trino_tpu.parallel.runner import DistributedQueryRunner
+
+        runner = DistributedQueryRunner(n_workers=8)
+        # VALUES plans coordinator-side; joining it against a distributed
+        # table forces a host batch into the mesh mid-query
+        sql = (
+            "select count(*) from lineitem join "
+            "(values 1, 2, 3) as t(k) on l_linenumber = k"
+        )
+        with pytest.raises(V.ResidencyViolation) as ei:
+            V.device_residency(runner, sql)
+        assert "host_restack" in str(ei.value)
+
+
+# -- the AST lint gate --------------------------------------------------------
+
+
+class TestLintGate:
+    def test_lint_clean_on_repo(self):
+        """tools/lint_tpu.py exits 0 over the repo: every host transfer in
+        device code is an explicitly declared boundary."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "lint_tpu.py")],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_lint_flags_hazards(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax.numpy as jnp\n"
+            "def f(x):\n"
+            "    n = int(jnp.sum(x))\n"
+            "    v = x.item()\n"
+            "    import numpy as np\n"
+            "    a = np.asarray(jnp.max(x))\n"
+            "    return n, v, a\n"
+        )
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        try:
+            import lint_tpu
+        finally:
+            sys.path.pop(0)
+        rules = {f.rule for f in lint_tpu.lint_file(str(bad))}
+        assert rules == {
+            "host-sync-cast", "host-sync-item", "host-sync-asarray"
+        }
+
+    def test_lint_suppressions(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text(
+            "import jax.numpy as jnp\n"
+            "def boundary(x):  # lint: allow(host-sync-cast)\n"
+            "    return int(jnp.sum(x))\n"
+            "def line_level(x):\n"
+            "    return x.item()  # lint: allow(host-sync-item)\n"
+        )
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        try:
+            import lint_tpu
+        finally:
+            sys.path.pop(0)
+        assert lint_tpu.lint_file(str(ok)) == []

@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""AST lint for host-sync hazards in device code (stdlib `ast` only).
+
+The mesh pipeline's performance rests on fragment chains staying
+device-resident; one stray `.item()` or `np.asarray` on a device value
+inserts a silent host round-trip that no test fails but every benchmark
+pays.  This linter walks `trino_tpu/ops/`, `trino_tpu/parallel/`, and
+`trino_tpu/expr/` flagging the hazard patterns statically, at review time:
+
+  rule              | flags
+  ------------------+----------------------------------------------------
+  host-sync-item    | `x.item()` — always a blocking device->host sync
+  host-sync-cast    | `float()/int()/bool()` applied to a jnp expression
+  host-sync-asarray | `np.asarray(...)` / `np.array(...)` of a jnp value
+  host-transfer     | `jax.device_get` / `device_get_async` /
+                    | `block_until_ready` calls (allowed only at declared
+                    | host boundaries)
+  untyped-symbol    | `Symbol(name)` built without a type — untyped
+                    | PlanNode construction poisons downstream typing
+
+Suppression: append `# lint: allow(<rule>)` (comma-separate several rules,
+or `allow(*)` for all) to the offending line or to the enclosing `def` /
+`class` line — a def-level allowance declares the whole function a genuine
+host boundary.  Run `python tools/lint_tpu.py` from the repo root; exits 1
+when findings remain.  Wired into CI and tests/test_verify.py so the gate
+also runs under plain pytest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+
+#: directories holding device code (paths relative to the repo root)
+DEFAULT_PATHS = ("trino_tpu/ops", "trino_tpu/parallel", "trino_tpu/expr")
+
+RULES = {
+    "host-sync-item": ".item() blocks on a device->host transfer",
+    "host-sync-cast": "python scalar cast of a jnp value syncs the device",
+    "host-sync-asarray": "np.asarray/np.array of a jnp value syncs the device",
+    "host-transfer": "explicit device->host transfer outside a declared "
+                     "host boundary",
+    "untyped-symbol": "Symbol constructed without a type",
+}
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
+
+
+@dataclass
+class Finding:
+    file: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self):
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _allowances(source: str) -> dict:
+    """line number -> set of allowed rule names ('*' = all)."""
+    out: dict[int, set] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _ALLOW_RE.search(text)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _contains_jnp(node: ast.AST) -> bool:
+    """Heuristic for 'this expression produces a device value': the subtree
+    references `jnp` (every device op in this codebase routes through the
+    jax.numpy namespace)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id == "jnp":
+            return True
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.findings: list[Finding] = []
+        self.allow = _allowances(source)
+        #: stack of (def/class line, end line) carrying def-level allowances
+        self._scopes: list[tuple[int, int]] = []
+
+    # -- suppression ----------------------------------------------------------
+
+    def _allowed(self, rule: str, line: int) -> bool:
+        for at in (line, *[s for s, e in self._scopes if s <= line <= e]):
+            rules = self.allow.get(at)
+            if rules and (rule in rules or "*" in rules):
+                return True
+        return False
+
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        if not self._allowed(rule, node.lineno):
+            self.findings.append(
+                Finding(self.path, node.lineno, rule, message)
+            )
+
+    def _visit_scope(self, node) -> None:
+        self._scopes.append((node.lineno, node.end_lineno or node.lineno))
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+    visit_ClassDef = _visit_scope
+
+    # -- rules ----------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # x.item()
+        if isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
+            self._flag(
+                "host-sync-item", node,
+                "`.item()` forces a blocking device->host sync; keep the "
+                "value on device or move this to a declared host boundary",
+            )
+        # float(jnp...), int(jnp...), bool(jnp...)
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id in ("float", "int", "bool")
+            and node.args
+            and _contains_jnp(node.args[0])
+        ):
+            self._flag(
+                "host-sync-cast", node,
+                f"`{fn.id}(...)` of a jnp expression syncs the device; "
+                "use jnp casts inside the program",
+            )
+        # np.asarray(jnp...) / np.array(jnp...)
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in ("asarray", "array")
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "np"
+            and node.args
+            and _contains_jnp(node.args[0])
+        ):
+            self._flag(
+                "host-sync-asarray", node,
+                "`np.%s(...)` of a jnp value copies it to the host; stay in "
+                "jnp or declare a host boundary" % fn.attr,
+            )
+        # jax.device_get(...) / device_get_async(...) / x.block_until_ready()
+        transfer = None
+        if isinstance(fn, ast.Attribute) and fn.attr in (
+            "device_get", "block_until_ready"
+        ):
+            transfer = fn.attr
+        elif isinstance(fn, ast.Name) and fn.id in (
+            "device_get_async", "device_get"
+        ):
+            transfer = fn.id
+        if transfer is not None:
+            self._flag(
+                "host-transfer", node,
+                f"`{transfer}` moves device data to the host; allowed only "
+                "at declared boundaries (# lint: allow(host-transfer))",
+            )
+        # Symbol("name") without a type
+        if (
+            (isinstance(fn, ast.Name) and fn.id == "Symbol")
+            or (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "Symbol"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("P", "plan")
+            )
+        ):
+            n_pos = len(node.args)
+            kw = {k.arg for k in node.keywords}
+            if n_pos < 2 and "type" not in kw:
+                self._flag(
+                    "untyped-symbol", node,
+                    "Symbol constructed without a type — untyped plan "
+                    "symbols break the dtype checkers downstream",
+                )
+        self.generic_visit(node)
+
+
+def lint_file(path: str) -> list:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "syntax-error", str(e))]
+    linter = _Linter(path, source)
+    linter.visit(tree)
+    return linter.findings
+
+
+def run_lint(paths=None, root: str = ".") -> list:
+    """Lint every .py file under `paths` (files or directories, relative to
+    `root`); returns all findings sorted by location."""
+    paths = list(paths) if paths else list(DEFAULT_PATHS)
+    files = []
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full):
+            files.append(full)
+            continue
+        for dirpath, _, names in os.walk(full):
+            files.extend(
+                os.path.join(dirpath, n) for n in names if n.endswith(".py")
+            )
+    findings = []
+    for f in sorted(files):
+        findings.extend(lint_file(f))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="AST lint for host-sync hazards in TPU device code"
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=None,
+        help=f"files/dirs to lint (default: {', '.join(DEFAULT_PATHS)})",
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="repo root (default: parent of this script's directory)",
+    )
+    args = ap.parse_args(argv)
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    findings = run_lint(args.paths or None, root=root)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"\n{len(findings)} finding(s) across "
+              f"{len({f.file for f in findings})} file(s)")
+        return 1
+    print("lint_tpu: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1912,9 +1912,9 @@ def _concat_ws_eager(ctx, call, sep, *parts):
     def _strings_of(v):
         if v.is_literal_null:
             return [None] * cap
-        d = np.asarray(jnp.broadcast_to(jnp.asarray(v.data), (cap,)))
+        d = np.asarray(jnp.broadcast_to(jnp.asarray(v.data), (cap,)))  # lint: allow(host-sync-asarray)
         va = (
-            np.asarray(jnp.broadcast_to(jnp.asarray(v.valid), (cap,)))
+            np.asarray(jnp.broadcast_to(jnp.asarray(v.valid), (cap,)))  # lint: allow(host-sync-asarray)
             if v.valid is not None
             else np.ones(cap, dtype=bool)
         )
@@ -2021,9 +2021,9 @@ def _format(ctx, call, fmt, *args):
             avalids.append(np.zeros(cap, dtype=bool))
             cols.append([None] * cap)
             continue
-        d = np.asarray(jnp.broadcast_to(jnp.asarray(a.data), (cap,)))
+        d = np.asarray(jnp.broadcast_to(jnp.asarray(a.data), (cap,)))  # lint: allow(host-sync-asarray)
         avalids.append(
-            np.asarray(jnp.broadcast_to(jnp.asarray(a.valid), (cap,)))
+            np.asarray(jnp.broadcast_to(jnp.asarray(a.valid), (cap,)))  # lint: allow(host-sync-asarray)
             if a.valid is not None
             else np.ones(cap, dtype=bool)
         )

@@ -150,7 +150,7 @@ def _prepare_sorted_build(build: Batch, key_channels: Sequence[int]):
     canon, nomatch = _canon_build_keys(build, key_channels)
     perm = None
     table = None
-    n_match = int(jnp.sum(jnp.logical_not(nomatch)))
+    n_match = int(jnp.sum(jnp.logical_not(nomatch)))  # lint: allow(host-sync-cast)
     if all(jnp.issubdtype(d.dtype, jnp.integer) for d in canon):
         imax = jnp.iinfo(jnp.int64).max
         mins, widths = [], []
@@ -158,8 +158,8 @@ def _prepare_sorted_build(build: Batch, key_channels: Sequence[int]):
         for d in canon:
             d64 = d.astype(jnp.int64)
             # nomatch rows must not widen the packed range
-            mn = int(jnp.min(jnp.where(nomatch, imax, d64)))
-            mx = int(jnp.max(jnp.where(nomatch, -imax, d64)))
+            mn = int(jnp.min(jnp.where(nomatch, imax, d64)))  # lint: allow(host-sync-cast)
+            mx = int(jnp.max(jnp.where(nomatch, -imax, d64)))  # lint: allow(host-sync-cast)
             mins.append(mn)
             widths.append(mx - mn + 1)
             total *= mx - mn + 1
@@ -555,7 +555,7 @@ class HashJoinOperator(_SortedBuildJoinBase):
         cap_b = self.build.capacity
         start, count = self._locate_batch(probe)
         maxc, total_inner, probe_live = (
-            int(x) for x in jax.device_get(
+            int(x) for x in jax.device_get(  # lint: allow(host-transfer)
                 (jnp.max(count), jnp.sum(count), probe.count())
             )
         )
@@ -577,7 +577,7 @@ class HashJoinOperator(_SortedBuildJoinBase):
         if self.kind == "inner":
             total = total_inner
         else:
-            total = int(jnp.sum(jnp.where(probe.mask(), jnp.maximum(count, 1), 0)))
+            total = int(jnp.sum(jnp.where(probe.mask(), jnp.maximum(count, 1), 0)))  # lint: allow(host-sync-cast)
         out_cap = next_pow2(max(total, 1), floor=1024)
         out, new_matched = self._expand(
             probe, self.build, start, count, self._build_matched,
@@ -795,7 +795,7 @@ class SemiJoinOperator(_SortedBuildJoinBase):
             if self.residual is None:
                 yield self._mark(probe, count, has_null=self._filter_has_null)
             else:
-                total = int(jnp.sum(count))
+                total = int(jnp.sum(count))  # lint: allow(host-sync-cast)
                 out_cap = next_pow2(max(total, 1), floor=1024)
                 yield self._mark_res(
                     probe, self.build, start, count,

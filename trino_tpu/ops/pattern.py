@@ -305,7 +305,7 @@ class PatternRecognitionOperator:
             perm = multi_key_sort_perm(big, keys)
             live = jnp.take(big.mask(), perm, mode="clip")
             big = big.gather(perm, valid=live)
-        host = device_get_async(big)
+        host = device_get_async(big)  # lint: allow(host-transfer)
         live_h = np.asarray(host.mask())[:n]
         # partition ids from sorted partition-key runs: a new partition
         # starts wherever ANY key's (value, validity) changes — collision
@@ -358,7 +358,7 @@ class PatternRecognitionOperator:
             if cond is None:
                 continue
             mask = compiler.filter_mask(rewrite_nav(cond))
-            ok[vi] = np.asarray(device_get_async(mask))[:n]
+            ok[vi] = np.asarray(device_get_async(mask))[:n]  # lint: allow(host-transfer)
         ok &= live_h[None, :]
         var_ix = {v: i for i, v in enumerate(self.vars)}
         # host NFA walk per partition

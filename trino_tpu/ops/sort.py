@@ -59,7 +59,7 @@ class OrderByOperator:
                 Batch.compact_device, static_argnames=("out_capacity",)
             )
         compact = _STEP_CACHE[ckey](big, out_capacity=cap)
-        return device_get_async(compact)
+        return device_get_async(compact)  # lint: allow(host-transfer)
 
     def process(self, stream):
         """In-memory device sort; over budget, fall back to an EXTERNAL sort

@@ -114,7 +114,7 @@ class MultiHostQueryRunner(LocalQueryRunner):
         dplan = add_exchanges(
             plan, self.catalogs, self.properties, n_workers=len(self.worker_urls)
         )
-        sub = create_subplans(dplan)
+        sub = create_subplans(dplan, properties=self.properties)
         out = _StageScheduler(self).run(sub)
         rows = []
         for batch in out.stream:
@@ -509,7 +509,7 @@ class _StageScheduler:
         for bs in per_producer:
             if not bs:
                 continue
-            host = jax.device_get(concat_batches(bs))
+            host = jax.device_get(concat_batches(bs))  # lint: allow(host-transfer)
             mask = np.asarray(host.mask())
             idx = np.nonzero(mask)[0]
             shards.append(_take_host(host, idx))
@@ -529,7 +529,7 @@ class _LocalResult:
 
         from trino_tpu.columnar.batch import concat_batches
 
-        batches = [jax.device_get(b) for b in plan.stream]
+        batches = [jax.device_get(b) for b in plan.stream]  # lint: allow(host-transfer)
         self.plan = PhysicalPlan(iter(batches), plan.symbols)
 
 

@@ -47,8 +47,15 @@ class TraceCache:
         self.retraces = 0
         #: wall seconds spent inside calls that traced (trace + XLA compile)
         self.trace_s = 0.0
+        #: audit hook (verify.cache_key_audit): called as audit(key, build)
+        #: on EVERY get — hits included — so cache-key completeness (same key
+        #: => same step-closure semantics) is checked against live traffic
+        self.audit: Optional[Callable] = None
 
     def get(self, key, build: Callable):
+        audit = self.audit  # snapshot: a concurrent audit-exit may null it
+        if audit is not None:
+            audit(key, build)
         with self._lock:
             fn = self._fns.get(key)
             if fn is not None:

@@ -364,10 +364,29 @@ class ExchangePlacer:
         return self._inherit(node)
 
 
+def _verify_mode(properties) -> str:
+    from trino_tpu import verify as V
+
+    mode = None
+    if properties is not None:
+        try:
+            mode = properties.get("verify_plan")
+        except KeyError:  # pragma: no cover - older property sets
+            mode = None
+    return V.resolve_mode(mode)
+
+
 def add_exchanges(plan: P.OutputNode, catalogs, properties=None, n_workers: int = 8):
+    from trino_tpu import verify as V
+
     placer = ExchangePlacer(catalogs, properties, n_workers)
     out = placer.place(plan)
     assert isinstance(out, P.OutputNode)
+    # distributed invariants: every ExchangeNode's partition symbols exist
+    # with hashable dtypes, and no placement broke dependencies
+    mode = _verify_mode(properties)
+    if mode != "off":
+        V.enforce(V.check_plan(out), mode)
     return out
 
 
@@ -426,8 +445,16 @@ def _fragment_partitioning(body: P.PlanNode) -> PartitioningHandle:
     return PartitioningHandle(COORDINATOR_ONLY)
 
 
-def create_subplans(distributed_plan: P.PlanNode) -> SubPlan:
-    return _Fragmenter().fragment(distributed_plan)
+def create_subplans(distributed_plan: P.PlanNode, properties=None) -> SubPlan:
+    from trino_tpu import verify as V
+
+    sub = _Fragmenter().fragment(distributed_plan)
+    # fragment invariants: unique fragment ids, every RemoteSourceNode names
+    # an existing fragment whose root outputs match symbol-for-symbol
+    mode = _verify_mode(properties)
+    if mode != "off":
+        V.enforce(V.check_subplan(sub), mode)
+    return sub
 
 
 def fragment_text(sub: SubPlan) -> str:

@@ -1397,6 +1397,18 @@ class LogicalPlanner:
             empty_idx = {
                 k for k, s in enumerate(gsets_ast) if not s and not extra_keys
             }
+            # each grouping-set branch owns its OWN instance of the
+            # pre-projected input (one shared instance in K tree positions
+            # breaks the duplicate-node sanity rule); the first consumer
+            # takes the original, later ones take copies
+            _pre_used = [False]
+
+            def own_pre():
+                if _pre_used[0]:
+                    return P.copy_tree(pre_node)
+                _pre_used[0] = True
+                return pre_node
+
             branches = []
             branch_syms = []
             for k, sk in enumerate(set_keys):
@@ -1414,7 +1426,7 @@ class LogicalPlanner:
                 bgid = alloc.new("groupid", T.BIGINT)
                 assigns.append((bgid, Literal(k, T.BIGINT)))
                 bsyms.append(bgid)
-                branches.append(P.ProjectNode(pre_node, assigns))
+                branches.append(P.ProjectNode(own_pre(), assigns))
                 branch_syms.append(bsyms)
             main = None
             if branches:
@@ -1429,7 +1441,7 @@ class LogicalPlanner:
                 gaggs = [
                     (alloc.new(s.name, s.type), spec) for s, spec in aggregations
                 ]
-                gnode = P.AggregationNode(pre_node, [], gaggs)
+                gnode = P.AggregationNode(own_pre(), [], gaggs)
                 passigns = []
                 psyms = []
                 for s in group_syms:

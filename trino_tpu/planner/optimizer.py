@@ -426,12 +426,20 @@ def rule_limit_to_topn(node: P.PlanNode):
     return P.TopNNode(s.source, s.orderings, node.count)
 
 
-def optimize(plan: P.OutputNode, rules=None, catalogs=None) -> P.OutputNode:
+def optimize(plan: P.OutputNode, rules=None, catalogs=None, verify=None) -> P.OutputNode:
     from trino_tpu.planner.join_planning import (
         eliminate_cross_joins,
         push_filter_through_join,
         push_filter_through_semijoin,
     )
+    from trino_tpu import verify as V
+
+    # sanity-check the analyzer/logical-planner output BEFORE rewriting, so
+    # a planning bug is named at its source, not blamed on the first rule
+    # (reference: PlanSanityChecker.validateIntermediatePlan)
+    vmode = V.resolve_mode(verify)
+    if vmode != "off":
+        V.enforce(V.check_plan(plan), vmode)
 
     if rules is None:
         rules = [
@@ -470,13 +478,20 @@ def optimize(plan: P.OutputNode, rules=None, catalogs=None) -> P.OutputNode:
         plan = _rewrite_bottom_up(plan, rules, stats)
         fp = plan_fingerprint(plan)
         if fp == prev:
-            break
+            break  # unchanged -> already validated last iteration
         prev = fp
+        # every fixpoint iteration that CHANGED the plan re-validates: a
+        # rule that broke an invariant is caught on the iteration that
+        # fired it, while LAST_RULE_STATS still names the suspects
+        if vmode != "off":
+            V.enforce(V.check_plan(plan), vmode)
     global LAST_RULE_STATS
     LAST_RULE_STATS = stats
     from trino_tpu.planner.pruning import prune
 
     plan = prune(plan)
+    if vmode != "off":
+        V.enforce(V.check_plan(plan), vmode)
     assert isinstance(plan, P.OutputNode)
     return plan
 

@@ -41,8 +41,19 @@ class SymbolAllocator:
         return Symbol(name, type)
 
 
+#: process-wide PlanNode id allocator (reference: PlanNodeIdAllocator.java —
+#: every node carries a unique id so the sanity checkers can name the exact
+#: failing node and detect shared-subtree reuse after a bad rewrite)
+_NODE_IDS = itertools.count(1)
+
+
 class PlanNode:
     id: int = 0
+
+    def __post_init__(self):
+        # dataclass subclasses route through here; `id` is not a dataclass
+        # field, so structural equality and repr are unaffected
+        self.id = next(_NODE_IDS)
 
     @property
     def outputs(self) -> list[Symbol]:
@@ -503,6 +514,19 @@ class ExchangeNode(PlanNode):
         return ExchangeNode(
             children[0], self.kind, self.partition_symbols, self.orderings
         )
+
+
+def copy_tree(node: PlanNode) -> PlanNode:
+    """Structurally identical copy with fresh node instances (and ids) all
+    the way down.  Used when a lowering needs the same input subtree in K
+    places (grouping-set UNION branches): sharing one instance would break
+    the tree-uniqueness invariant the sanity checkers enforce."""
+    import dataclasses
+
+    kids = node.children
+    if kids:
+        return node.with_children([copy_tree(c) for c in kids])
+    return dataclasses.replace(node)
 
 
 def walk(node: PlanNode):

@@ -116,7 +116,11 @@ class LocalQueryRunner:
     def optimize(self, plan: OutputNode) -> OutputNode:
         from trino_tpu.planner.optimizer import optimize
 
-        return optimize(plan, catalogs=self.catalogs)
+        return optimize(
+            plan,
+            catalogs=self.catalogs,
+            verify=self.properties.get("verify_plan"),
+        )
 
     def explain(self, sql: str) -> str:
         return plan_text(self.create_plan(sql))
@@ -357,7 +361,8 @@ class LocalQueryRunner:
 
             plan = self.plan_query(inner.query)
             sub = create_subplans(
-                add_exchanges(plan, self.catalogs, self.properties)
+                add_exchanges(plan, self.catalogs, self.properties),
+                properties=self.properties,
             )
             text = fragment_text(sub)
         else:

@@ -90,6 +90,13 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             4,
         ),
         PropertyMetadata(
+            "verify_plan",
+            "plan sanity-checker enforcement: strict (raise PlanViolation) "
+            "| warn | off | default (strict under pytest, warn elsewhere)",
+            str,
+            "default",
+        ),
+        PropertyMetadata(
             "pallas_agg",
             "use the Pallas MXU one-hot-matmul kernel for eligible "
             "small-domain float aggregations",

@@ -1,0 +1,51 @@
+"""Static-analysis verification subsystem (reference:
+sql/planner/sanity/PlanSanityChecker.java plus the engine's own
+device-residency contracts).
+
+Three layers:
+
+  * plan sanity checkers (`check_plan` / `check_subplan`) — structural,
+    dependency, and per-node-type typing rules, run by the optimizer after
+    analysis, after each fixpoint iteration, and after fragmentation;
+  * kernel/SPMD verifier (`device_residency`, `cache_key_audit`) — replays
+    a query and asserts the mesh pipeline's zero-host-round-trip and
+    zero-warm-retrace contracts, and checks trace-cache key completeness
+    against step-closure free variables;
+  * AST lint (`tools/lint_tpu.py`) — flags host-sync hazards in device code
+    at review time; wired into CI and the tier-1 test run.
+
+Enforcement of the plan checkers follows the `verify_plan` session property
+(strict | warn | off; default strict under pytest, warn in benches).
+"""
+
+from trino_tpu.verify.plan_checker import (
+    LAST_WARNINGS,
+    MODES,
+    PlanViolation,
+    check_plan,
+    check_subplan,
+    enforce,
+    resolve_mode,
+)
+from trino_tpu.verify.residency import (
+    CacheKeyViolation,
+    ResidencyViolation,
+    cache_key_audit,
+    closure_fingerprint,
+    device_residency,
+)
+
+__all__ = [
+    "LAST_WARNINGS",
+    "MODES",
+    "PlanViolation",
+    "check_plan",
+    "check_subplan",
+    "enforce",
+    "resolve_mode",
+    "CacheKeyViolation",
+    "ResidencyViolation",
+    "cache_key_audit",
+    "closure_fingerprint",
+    "device_residency",
+]

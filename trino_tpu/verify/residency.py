@@ -1,0 +1,260 @@
+"""Kernel/SPMD static+dynamic verifier: device-residency and trace-cache
+contracts as assertable facts.
+
+PR 1 made the mesh fast by keeping fragment chains device-resident and
+caching every compiled SPMD program in `spmd.TRACE_CACHE`; the proof was
+counters (`host_restack`, `retraces`) that nothing asserted.  This module
+turns them into contracts:
+
+  * `device_residency(runner, sql)` replays a query on a warmed mesh and
+    raises `ResidencyViolation` if a distributed fragment chain performs an
+    unexpected host transfer (a host batch re-entering the mesh mid-query)
+    or if a warm execution retraces any program;
+  * `cache_key_audit()` wraps `spmd.TRACE_CACHE` and checks cache-key
+    completeness: the step closure's free variables are fingerprinted and
+    hashed against the declared cache key — two different closures arriving
+    under one key means the key under-describes the program (the class of
+    bug that silently serves a stale compiled program, e.g. a dynamic-filter
+    range baked into a step but missing from its key).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from contextlib import contextmanager
+from typing import Optional
+
+import numpy as np
+
+from trino_tpu.parallel.spmd import TRACE_CACHE
+
+
+class ResidencyViolation(Exception):
+    """A device-residency or trace-cache contract failed."""
+
+
+class CacheKeyViolation(ResidencyViolation):
+    """Two distinct step closures arrived under one trace-cache key."""
+
+
+# -- closure fingerprinting ---------------------------------------------------
+
+_MAX_DEPTH = 5
+_MAX_SEQ = 64
+_MAX_ARRAY_BYTES = 1 << 16
+
+
+def _array_fp(v) -> tuple:
+    shape = tuple(getattr(v, "shape", ()))
+    dtype = str(getattr(v, "dtype", ""))
+    size = int(np.prod(shape)) if shape else 1
+    if size * getattr(v, "itemsize", 8) <= _MAX_ARRAY_BYTES:
+        try:
+            digest = hashlib.sha1(np.asarray(v).tobytes()).hexdigest()[:16]
+            return ("array", shape, dtype, digest)
+        except Exception:
+            pass
+    return ("array", shape, dtype)
+
+
+def _value_fp(v, depth: int) -> tuple:
+    """Semantic fingerprint of one closure constant.  Primitives by value
+    (the dynamic-filter-range class of key bugs), arrays by content hash
+    when small, callables recursively, opaque objects by type name only —
+    an operator instance's semantics are expected to live in the key
+    already, and object identity would only produce false positives."""
+    if depth > _MAX_DEPTH:
+        return ("depth",)
+    if v is None or isinstance(v, (bool, int, str, bytes)):
+        return ("prim", v)
+    if isinstance(v, float):
+        return ("prim", repr(v))  # repr: NaN-stable
+    if isinstance(v, (tuple, list)):
+        return ("seq", type(v).__name__) + tuple(
+            _value_fp(x, depth + 1) for x in v[:_MAX_SEQ]
+        )
+    if isinstance(v, dict):
+        items = sorted(v.items(), key=lambda kv: repr(kv[0]))[:_MAX_SEQ]
+        return ("map",) + tuple(
+            (repr(k), _value_fp(x, depth + 1)) for k, x in items
+        )
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return _array_fp(v)
+    if callable(v):
+        return ("fn", getattr(v, "__qualname__", type(v).__name__),
+                closure_fingerprint(v, depth + 1))
+    return ("obj", type(v).__name__)
+
+
+def closure_fingerprint(fn, depth: int = 0) -> tuple:
+    """Fingerprint of a callable's free variables (recursing through nested
+    closures).  Two builders with equal fingerprints would compile
+    equivalent programs for the purposes of the cache-key contract.
+    Always returns a tuple of (name, value-fingerprint) pairs so
+    fingerprints diff uniformly."""
+    import functools
+
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        out = [("$type", ("prim", type(fn).__name__))]
+        if isinstance(fn, functools.partial) and depth <= _MAX_DEPTH:
+            out.append(("$partial.func", ("fn", getattr(fn.func, "__qualname__", ""),
+                                          closure_fingerprint(fn.func, depth + 1))))
+            out.append(("$partial.args", _value_fp(fn.args, depth + 1)))
+            out.append(("$partial.kw", _value_fp(fn.keywords or {}, depth + 1)))
+            return tuple(out)
+        call = getattr(type(fn), "__call__", None)
+        if (
+            call is not None
+            and getattr(call, "__code__", None) is not None
+            and depth <= _MAX_DEPTH
+        ):
+            # callable object: fingerprint its __call__ closure plus its
+            # instance dict (the state a builder object would bake in)
+            out.append(("$call", ("fn", type(fn).__name__,
+                                  closure_fingerprint(call, depth + 1))))
+            inst = getattr(fn, "__dict__", None)
+            if inst:
+                out.append(("$self", _value_fp(inst, depth + 1)))
+        return tuple(out)
+    out = [("$code", (code.co_filename, code.co_firstlineno))]
+    cells = getattr(fn, "__closure__", None) or ()
+    for name, cell in zip(code.co_freevars, cells):
+        try:
+            val = cell.cell_contents
+        except ValueError:  # not yet filled
+            out.append((name, ("empty",)))
+            continue
+        out.append((name, _value_fp(val, depth)))
+    defaults = getattr(fn, "__defaults__", None) or ()
+    for i, d in enumerate(defaults):
+        out.append((f"$default{i}", _value_fp(d, depth)))
+    return tuple(out)
+
+
+class CacheKeyAuditor:
+    """Records key -> closure fingerprint across TRACE_CACHE traffic and
+    raises when one key arrives with two different closures."""
+
+    def __init__(self):
+        self.seen: dict = {}
+        self.checked = 0
+
+    def __call__(self, key, build) -> None:
+        fp = closure_fingerprint(build)
+        self.checked += 1
+        prev = self.seen.get(key)
+        if prev is None:
+            self.seen[key] = fp
+            return
+        if prev != fp:
+            diffs = _fp_diff(prev, fp)
+            raise CacheKeyViolation(
+                "trace-cache key is incomplete: two step closures with "
+                f"different free variables share key {key!r}; differing "
+                f"free variables: {diffs}"
+            )
+
+
+def _fp_diff(a: tuple, b: tuple) -> list:
+    try:
+        da, db = dict(a), dict(b)
+    except (TypeError, ValueError):  # defensive: irregular fingerprint shape
+        return ["<unstructured fingerprint>"]
+    names = sorted(set(da) | set(db))
+    return [n for n in names if da.get(n) != db.get(n)]
+
+
+@contextmanager
+def cache_key_audit():
+    """Enable the trace-cache key-completeness audit for a scope."""
+    auditor = CacheKeyAuditor()
+    prev = TRACE_CACHE.audit
+    TRACE_CACHE.audit = auditor
+    try:
+        yield auditor
+    finally:
+        TRACE_CACHE.audit = prev
+
+
+# -- device residency ---------------------------------------------------------
+
+#: mesh-profile counters that are LEGITIMATE host boundaries: explicit
+#: gathers at SINGLE-fragment/result edges, the batched dynamic-filter sync,
+#: scan-cache bookkeeping, and FTE spooling.  `host_restack` is deliberately
+#: absent: a host batch re-entering the mesh between distributed fragments
+#: is the hidden round-trip this contract exists to catch.
+ALLOWED_COUNTERS = (
+    "result_gather",
+    "host_gather",
+    "state_gather",
+    "scan_cache_hit",
+    "scan_cache_miss",
+    "dynamic_filter_sync",
+    "spool_read",
+    "spool_write",
+)
+
+
+def device_residency(
+    runner,
+    sql: str,
+    warmups: int = 1,
+    allowed_counters: tuple = ALLOWED_COUNTERS,
+    audit_cache_keys: bool = True,
+) -> dict:
+    """Replay `sql` on a warmed mesh and assert the device-residency
+    contracts of the distributed pipeline:
+
+      * zero retraces — every compiled SPMD program came out of the trace
+        cache (a warm retrace means a cache key misses shape/semantic
+        state);
+      * zero unexpected host transfers — no counter outside
+        `allowed_counters` fires, in particular `host_restack` (a host
+        batch re-entering the mesh between distributed fragments);
+      * (optional) cache-key completeness over the replay's cache traffic.
+
+    Returns a report dict on success; raises ResidencyViolation on failure.
+    `runner` is a DistributedQueryRunner (anything with .execute and
+    .last_mesh_profile).
+    """
+    auditor: Optional[CacheKeyAuditor] = None
+    ctx = cache_key_audit() if audit_cache_keys else None
+    try:
+        if ctx is not None:
+            auditor = ctx.__enter__()
+        for _ in range(max(0, warmups)):
+            runner.execute(sql)
+        runner.execute(sql)
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    prof = runner.last_mesh_profile
+    if prof is None:
+        raise ResidencyViolation(
+            "query produced no mesh profile — not a distributed execution"
+        )
+    problems = []
+    if prof.retraces:
+        problems.append(
+            f"warm execution retraced {prof.retraces} SPMD program(s) "
+            "(trace-cache key misses shape or semantic state)"
+        )
+    for name, n in sorted(prof.counters.items()):
+        if n and name not in allowed_counters:
+            problems.append(
+                f"unexpected host transfer: counter '{name}' fired {n}x "
+                "on the warm run"
+            )
+    if problems:
+        raise ResidencyViolation(
+            f"device residency violated for {sql!r}: " + "; ".join(problems)
+        )
+    return {
+        "sql": sql,
+        "retraces": prof.retraces,
+        "trace_hits": prof.trace_hits,
+        "trace_misses": prof.trace_misses,
+        "counters": dict(prof.counters),
+        "cache_keys_checked": auditor.checked if auditor else 0,
+    }
