@@ -359,6 +359,32 @@ l_rows = local.execute(QUERIES[6]).rows
 local_cold = time.perf_counter() - t0
 local_warm = warm(local)
 prof = dist.last_mesh_profile
+
+# Q3 under co-partitioned lineitem/orders layouts: the partitioned-join gap
+# (probe repartition elided + speculative capacity — no host count sync)
+dist.execute(
+    "set session table_layouts = "
+    "'tpch.%s.lineitem:l_orderkey:8,tpch.%s.orders:o_orderkey:8'"
+    % (schema, schema)
+)
+def warm_q(r, q):
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        r.execute(QUERIES[q])
+        best = min(best, time.perf_counter() - t0)
+    return best
+t0 = time.perf_counter()
+d3_rows = dist.execute(QUERIES[3]).rows
+q3_mesh_cold = time.perf_counter() - t0
+q3_mesh_warm = warm_q(dist, 3)
+q3_prof = dist.last_mesh_profile
+q3_counters = dict(q3_prof.counters) if q3_prof is not None else {}
+t0 = time.perf_counter()
+l3_rows = local.execute(QUERIES[3]).rows
+q3_local_cold = time.perf_counter() - t0
+q3_local_warm = warm_q(local, 3)
+
 print(json.dumps({
     "schema": schema,
     "workers": dist.wm.n,
@@ -369,6 +395,24 @@ print(json.dumps({
     "mesh_over_local_warm": round(mesh_warm / max(local_warm, 1e-9), 3),
     "matches_local": sorted(map(str, d_rows)) == sorted(map(str, l_rows)),
     "profile": prof.to_json() if prof is not None else None,
+    "q3_local_warm_s": round(q3_local_warm, 4),
+    "q3_local_cold_s": round(q3_local_cold, 4),
+    "q3_mesh8_warm_s": round(q3_mesh_warm, 4),
+    "q3_mesh8_cold_s": round(q3_mesh_cold, 4),
+    "q3_mesh_over_local_warm": round(
+        q3_mesh_warm / max(q3_local_warm, 1e-9), 3
+    ),
+    "q3_matches_local": sorted(map(str, d3_rows)) == sorted(map(str, l3_rows)),
+    # elision + speculation evidence: warm Q3 must show zero speculative
+    # retries and zero probe repartitions under the layouts
+    "q3_counters": {
+        "exchange_elided": q3_counters.get("exchange_elided", 0),
+        "repartition_collective": q3_counters.get("repartition_collective", 0),
+        "join_speculative_retry": q3_counters.get("join_speculative_retry", 0),
+        "join_overflow_check": q3_counters.get("join_overflow_check", 0),
+        "join_capacity_sync": q3_counters.get("join_capacity_sync", 0),
+        "scan_bucketize": q3_counters.get("scan_bucketize", 0),
+    },
 }), flush=True)
 """
 
@@ -563,7 +607,9 @@ def main() -> None:
         "--mesh",
         action="store_true",
         help="after the headline line, measure mesh-8 vs single-worker Q6 "
-        "walls + per-fragment profile into BENCH_EXTRA.json's mesh section",
+        "and Q3 (co-partitioned layouts; elision/speculative-retry "
+        "counters) walls + per-fragment profile into BENCH_EXTRA.json's "
+        "mesh section",
     )
     ap.add_argument(
         "--tpu-timeout",

@@ -24,6 +24,12 @@ or `allow(*)` for all) to the offending line or to the enclosing `def` /
 host boundary.  Run `python tools/lint_tpu.py` from the repo root; exits 1
 when findings remain.  Wired into CI and tests/test_verify.py so the gate
 also runs under plain pytest.
+
+Suppression budget: the repo-wide `allow()` count is capped by the
+checked-in baseline (tools/lint_baseline.json).  New suppressions beyond
+the budget fail the lint — declaring a new host boundary means paying it
+down elsewhere (or consciously raising the baseline in review).  Shrinking
+the count below the baseline prints a reminder to ratchet it down.
 """
 
 from __future__ import annotations
@@ -199,9 +205,7 @@ def lint_file(path: str) -> list:
     return linter.findings
 
 
-def run_lint(paths=None, root: str = ".") -> list:
-    """Lint every .py file under `paths` (files or directories, relative to
-    `root`); returns all findings sorted by location."""
+def _lint_files(paths, root: str) -> list:
     paths = list(paths) if paths else list(DEFAULT_PATHS)
     files = []
     for p in paths:
@@ -213,11 +217,52 @@ def run_lint(paths=None, root: str = ".") -> list:
             files.extend(
                 os.path.join(dirpath, n) for n in names if n.endswith(".py")
             )
+    return sorted(files)
+
+
+def run_lint(paths=None, root: str = ".") -> list:
+    """Lint every .py file under `paths` (files or directories, relative to
+    `root`); returns all findings sorted by location."""
     findings = []
-    for f in sorted(files):
+    for f in _lint_files(paths, root):
         findings.extend(lint_file(f))
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
+
+
+def count_suppressions(paths=None, root: str = ".") -> int:
+    """Repo-wide `# lint: allow(...)` count over the linted paths."""
+    n = 0
+    for f in _lint_files(paths, root):
+        with open(f, "r", encoding="utf-8") as fh:
+            n += len(_ALLOW_RE.findall(fh.read()))
+    return n
+
+
+def suppression_budget(root: str = ".") -> int:
+    """Checked-in allow() budget (tools/lint_baseline.json)."""
+    import json
+
+    path = os.path.join(root, "tools", "lint_baseline.json")
+    with open(path, "r", encoding="utf-8") as fh:
+        return int(json.load(fh)["allow_budget"])
+
+
+def check_suppression_budget(paths=None, root: str = ".") -> list:
+    """-> [error message] when the allow() count exceeds the baseline."""
+    try:
+        budget = suppression_budget(root)
+    except (OSError, KeyError, ValueError):
+        return []  # partial checkouts / custom paths: budget not enforced
+    count = count_suppressions(paths, root)
+    if count > budget:
+        return [
+            f"suppression budget exceeded: {count} `# lint: allow()` "
+            f"suppressions > baseline {budget} "
+            "(tools/lint_baseline.json) — remove a suppression or "
+            "consciously raise the baseline in review"
+        ]
+    return []
 
 
 def main(argv=None) -> int:
@@ -239,11 +284,28 @@ def main(argv=None) -> int:
     findings = run_lint(args.paths or None, root=root)
     for f in findings:
         print(f)
-    if findings:
-        print(f"\n{len(findings)} finding(s) across "
-              f"{len({f.file for f in findings})} file(s)")
+    budget_errors = []
+    if not args.paths:  # budget is repo-wide; skip for targeted runs
+        budget_errors = check_suppression_budget(None, root)
+        for e in budget_errors:
+            print(e)
+    if findings or budget_errors:
+        if findings:
+            print(f"\n{len(findings)} finding(s) across "
+                  f"{len({f.file for f in findings})} file(s)")
         return 1
-    print("lint_tpu: clean")
+    count = count_suppressions(None, root)
+    try:
+        budget = suppression_budget(root)
+        slack = (
+            f" ({budget - count} under budget — consider ratcheting "
+            "tools/lint_baseline.json down)"
+            if count < budget
+            else ""
+        )
+        print(f"lint_tpu: clean ({count}/{budget} suppressions{slack})")
+    except (OSError, KeyError, ValueError):
+        print("lint_tpu: clean")
     return 0
 
 

@@ -154,6 +154,13 @@ class Connector:
     ) -> PageSource:
         raise NotImplementedError
 
+    def table_layout(self, handle: TableHandle):
+        """Declared hash-bucketed layout of `handle`, or None (reference
+        role: ConnectorMetadata.getTableProperties' partitioning handle).
+        Consulted by partitioning.LayoutResolver AFTER session-property and
+        engine-registry declarations."""
+        return None
+
     def scan_version(self, handle: TableHandle):
         """Cache token for scan results of `handle`: scans of the same split
         + columns + version may be served from the engine's buffer pool.

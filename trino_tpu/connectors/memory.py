@@ -32,6 +32,8 @@ from trino_tpu.connectors.api import (
 class _Stored:
     meta: TableMetadata
     columns: list  # list[ColumnData], concatenated
+    #: declared hash-bucketing (CREATE TABLE ... WITH (bucketed_by, ...))
+    layout: object = None
 
     @property
     def rows(self) -> int:
@@ -196,10 +198,15 @@ class MemoryConnector(Connector):
     def supports_writes(self) -> bool:
         return True
 
-    def create_table(self, schema: str, table: str, columns: Sequence[ColumnMeta]):
+    def create_table(self, schema: str, table: str, columns: Sequence[ColumnMeta],
+                     layout=None):
         self.store[(schema, table)] = _Stored(
-            TableMetadata(schema, table, tuple(columns)), []
+            TableMetadata(schema, table, tuple(columns)), [], layout
         )
+
+    def table_layout(self, handle: TableHandle):
+        st = self.store.get((handle.schema, handle.table))
+        return st.layout if st is not None else None
 
     def drop_table(self, handle: TableHandle):
         self.store.pop((handle.schema, handle.table), None)
@@ -239,7 +246,7 @@ class MemoryConnector(Connector):
         (never mutates arrays in place), so copying the table map and each
         table's column list captures a consistent point-in-time view."""
         return {
-            key: _Stored(st.meta, list(st.columns))
+            key: _Stored(st.meta, list(st.columns), st.layout)
             for key, st in self.store.items()
         }
 
@@ -255,7 +262,7 @@ class MemoryConnector(Connector):
         st = self.store.get((schema, table))
         if st is None:
             return MISSING
-        return _Stored(st.meta, list(st.columns))
+        return _Stored(st.meta, list(st.columns), st.layout)
 
     def restore_table(self, schema: str, table: str, snap) -> None:
         from trino_tpu.runtime.transactions import MISSING

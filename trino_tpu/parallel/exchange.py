@@ -27,13 +27,19 @@ from trino_tpu.ops.common import next_pow2
 from trino_tpu.parallel.spmd import WorkerMesh
 
 _MIX = np.uint64(0x9E3779B97F4A7C15)
+#: FNV offset basis seeding the row hash; shared with the host-side layout
+#: mirror (partitioning/layout.host_bucket_hash) — the two MUST stay equal
+#: or bucketed scans stop co-locating with repartition exchanges
+HASH_INIT = np.uint64(1469598103934665603)
+#: NULL key sentinel (nulls group together, SQL GROUP BY semantics)
+_NULL_HASH = 0xDEADBEEF
 
 
 def _hash_rows(batch: Batch, key_channels: Sequence[int]) -> jnp.ndarray:
-    """64-bit row hash over key columns; NULL hashes as a distinct constant
-    (nulls group together, SQL GROUP BY semantics)."""
+    """64-bit row hash over key columns; NULL hashes as a distinct constant.
+    Mirrored host-side by partitioning/layout.host_bucket_hash."""
     cap = batch.capacity
-    h = jnp.full(cap, 1469598103934665603, dtype=jnp.uint64)
+    h = jnp.full(cap, HASH_INIT, dtype=jnp.uint64)
     for ch in key_channels:
         c = batch.columns[ch]
         v = c.data
@@ -46,7 +52,7 @@ def _hash_rows(batch: Batch, key_channels: Sequence[int]) -> jnp.ndarray:
         for p in planes:
             bits = p.astype(jnp.int64).astype(jnp.uint64)
             if c.valid is not None:
-                bits = jnp.where(c.valid, bits, jnp.uint64(0xDEADBEEF))
+                bits = jnp.where(c.valid, bits, jnp.uint64(_NULL_HASH))
             x = (bits ^ (bits >> 33)) * _MIX
             x = x ^ (x >> 29)
             h = (h ^ x) * _MIX

@@ -111,8 +111,12 @@ class MultiHostQueryRunner(LocalQueryRunner):
         if stats is not None:
             return super()._run_query(query, stats=stats)
         plan = self.plan_query(query)
+        # colocate=False: HTTP workers shard scans by split_mod, not by the
+        # exchange hash — layout placements would be claims the data plane
+        # does not realize (the in-process mesh runner is the elision home)
         dplan = add_exchanges(
-            plan, self.catalogs, self.properties, n_workers=len(self.worker_urls)
+            plan, self.catalogs, self.properties,
+            n_workers=len(self.worker_urls), colocate=False,
         )
         sub = create_subplans(dplan, properties=self.properties)
         out = _StageScheduler(self).run(sub)

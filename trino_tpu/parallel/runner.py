@@ -78,6 +78,16 @@ from trino_tpu.parallel.spmd import (
     stack_batches,
     unstack_batch,
 )
+from trino_tpu.partitioning import (
+    CAP_HISTORY,
+    LayoutResolver,
+    bucket_rows,
+    initial_cap,
+    join_output_placements,
+    next_cap,
+    scan_partitioning,
+    speculation_mode,
+)
 from trino_tpu.planner import plan as P
 from trino_tpu.planner.fragmenter import (
     COORDINATOR_ONLY,
@@ -110,15 +120,25 @@ class _Dist:
     the eventual materialization to the producer, not to whichever consumer
     happens to trigger it.  `cap` tracks the trailing row capacity through
     deferred shape-changing steps so consumers can size their static output
-    shapes without materializing."""
+    shapes without materializing.  `placements` carries the partitioning
+    property (ordered symbol-name tuples the rows are exchange-hash-placed
+    on) so downstream repartitions on already-placed data become no-ops.
+    `realigned` records that rows were MOVED off the connector's default
+    split alignment (bucketized scan, any exchange, a host re-stack) — the
+    residual semi join's historical per-shard contract assumes default
+    alignment, so a realigned side without an exact-key placement must be
+    hash-repartitioned before per-shard marking."""
 
     def __init__(self, stacked: Batch, symbols: list, ex=None, pending=(),
-                 cap: Optional[int] = None):
+                 cap: Optional[int] = None, placements: tuple = (),
+                 realigned: bool = False):
         self._stacked = stacked
         self.symbols = list(symbols)
         self.ex = ex
         self.pending = list(pending)
         self.cap = cap if cap is not None else _trailing_cap(stacked)
+        self.placements = tuple(placements)
+        self.realigned = realigned
 
     @property
     def stacked(self) -> Batch:
@@ -127,16 +147,24 @@ class _Dist:
             self.pending = []
         return self._stacked
 
-    def defer(self, key_part, step, symbols=None, cap: Optional[int] = None) -> "_Dist":
+    def defer(self, key_part, step, symbols=None, cap: Optional[int] = None,
+              placements: Optional[tuple] = None) -> "_Dist":
         """Append a per-worker step lazily (must be a pure Batch -> Batch
-        function; `key_part` must fingerprint its semantics)."""
+        function; `key_part` must fingerprint its semantics).  Placements
+        survive symbol-preserving steps; a step that renames its output
+        symbols must pass the remapped `placements` explicitly (default:
+        dropped — claiming a stale placement is a correctness bug)."""
         fid = self.ex._current_fid if self.ex is not None else -1
+        if placements is None:
+            placements = self.placements if symbols is None else ()
         return _Dist(
             self._stacked,
             self.symbols if symbols is None else symbols,
             self.ex,
             self.pending + [(key_part, step, fid)],
             cap if cap is not None else self.cap,
+            placements,
+            self.realigned,
         )
 
     def channel(self, name: str) -> int:
@@ -192,7 +220,12 @@ class DistributedQueryRunner(LocalQueryRunner):
         dplan = add_exchanges(
             plan, self.catalogs, self.properties, n_workers=self.wm.n
         )
-        return create_subplans(dplan, properties=self.properties)
+        return create_subplans(
+            dplan,
+            properties=self.properties,
+            catalogs=self.catalogs,
+            n_workers=self.wm.n,
+        )
 
     def explain_distributed(self, sql: str) -> str:
         return fragment_text(self.create_subplan(self.create_plan(sql)))
@@ -266,6 +299,14 @@ class StageExecutor:
         self.dynamic_filters: dict[str, tuple] = {}
         #: EXPLAIN-able evidence: table -> (rows_before, rows_after) pruning
         self.dynamic_filter_stats: dict[str, tuple] = {}
+        #: partitioning-aware execution (table layouts + elision + the
+        #: speculative join capacity), all gated by session properties so
+        #: regressions bisect by flipping the new paths off
+        self.layouts = LayoutResolver(catalogs, properties)
+        try:
+            self.colocate = bool(properties.get("colocated_join"))
+        except KeyError:  # pragma: no cover - older property sets
+            self.colocate = True
         if self.retry_task:
             from trino_tpu.runtime.fte import SpoolManager
 
@@ -273,8 +314,19 @@ class StageExecutor:
 
     # -- instrumented step dispatch -------------------------------------------
 
-    def _dist(self, stacked: Batch, symbols: list) -> _Dist:
-        return _Dist(stacked, symbols, ex=self)
+    def _dist(self, stacked: Batch, symbols: list, placements: tuple = (),
+              realigned: bool = False) -> _Dist:
+        return _Dist(
+            stacked, symbols, ex=self, placements=placements,
+            realigned=realigned,
+        )
+
+    def _host_pull(self, *vals):  # lint: allow(host-transfer)
+        """Declared host boundary for the runner's tiny device->host reads
+        (speculative overflow flags, speculative-off capacity syncs): every
+        value crosses in ONE transfer."""
+        out = [np.asarray(x) for x in device_get_async(tuple(vals))]
+        return out if len(out) > 1 else out[0]
 
     def _call(self, fn, *args, phase: str = "compute", fid: Optional[int] = None):
         """Run a (cached-jitted) program with phase attribution: calls that
@@ -449,13 +501,20 @@ class StageExecutor:
             [c.dictionary for c in shards[0].columns] if shards else []
         )
         self.spool.save(self.query_id, fid, shards, res.symbols)
-        self._spool_meta[fid] = (res.symbols, dicts)
+        self._spool_meta[fid] = (
+            res.symbols, dicts, res.placements, res.realigned
+        )
 
     def _load_spooled(self, fid: int) -> "_Dist":
-        symbols, dicts = self._spool_meta[fid]
+        # spooled shards rehydrate worker-for-worker, so the stage output's
+        # placements survive the host round-trip
+        symbols, dicts, placements, realigned = self._spool_meta[fid]
         shards = self.spool.load(self.query_id, fid, symbols, dicts)
         self.profile.bump("spool_read")
-        return self._dist(stack_batches(shards, self.wm), symbols)
+        return self._dist(
+            stack_batches(shards, self.wm), symbols, placements=placements,
+            realigned=realigned,
+        )
 
     def _local_fragment(self, sub: SubPlan) -> PhysicalPlan:
         """SINGLE/COORDINATOR_ONLY fragment: run the local engine over
@@ -611,17 +670,20 @@ class StageExecutor:
             self.profile.fragment(self._current_fid).collective_bytes += (
                 batch_bytes(out)
             )
-            return self._dist(out, stacked.symbols)
+            return self._dist(out, stacked.symbols, realigned=True)
         if node.exchange_kind == "repartition":
+            names = tuple(s.name for s in node.partition_symbols)
+            # runtime exchange elision: the producing fragment's output is
+            # already placed on (a subset of) the requested keys — rows
+            # with equal key combinations are co-located, the collective
+            # would move nothing anywhere new
+            if self.colocate and any(
+                t and set(t) <= set(names) for t in stacked.placements
+            ):
+                self.profile.bump("exchange_elided")
+                return stacked
             chans = [stacked.channel(s.name) for s in node.partition_symbols]
-            out = self._call(
-                ex.repartition, stacked.stacked, chans, self.wm,
-                phase="collective",
-            )
-            self.profile.fragment(self._current_fid).collective_bytes += (
-                batch_bytes(out)
-            )
-            return self._dist(out, stacked.symbols)
+            return self._repartition_side(stacked, chans)
         raise NotImplementedError(
             f"exchange {node.exchange_kind} feeding a distributed fragment"
         )
@@ -644,7 +706,7 @@ class StageExecutor:
         self.profile.fragment(self._current_fid).bytes_to_device += (
             batch_bytes(host)
         )
-        return self._dist(stacked, result.symbols)
+        return self._dist(stacked, result.symbols, realigned=True)
 
     # -- distributed node execution -------------------------------------------
 
@@ -679,6 +741,15 @@ class StageExecutor:
         )
         page_rows = self.properties.get("page_rows")
         use_cache = self.properties.get("scan_cache")
+        # bucketed layout: shard rows by the exchange hash of the bucket
+        # columns instead of round-robin splits, so the scan output IS a
+        # repartition-on-those-keys placement (the co-located join feed)
+        part = (
+            scan_partitioning(node, self.layouts, self.wm.n)
+            if self.colocate
+            else None
+        )
+        placements = (part[1],) if part is not None else ()
 
         # device-resident stacked-scan cache: a warm mesh query reuses the
         # sharded [W, cap] batch directly from HBM — zero host->device bytes
@@ -690,6 +761,9 @@ class StageExecutor:
             cache_key = (
                 "mesh_scan",
                 mesh_key(self.wm),
+                # layout in the key: the same splits shard differently once
+                # a layout is declared (or colocated_join flips)
+                None if part is None else ("layout",) + part[1] + part[2],
                 tuple(
                     BufferPool.split_key(s, names, page_rows, version)
                     for s in splits
@@ -699,7 +773,11 @@ class StageExecutor:
             if cached is not None:
                 self.profile.bump("scan_cache_hit")
                 return self._scan_filters(
-                    node, self._dist(cached[0], [s for s, _ in node.assignments])
+                    node,
+                    self._dist(
+                        cached[0], [s for s, _ in node.assignments],
+                        placements=placements, realigned=part is not None,
+                    ),
                 )
             self.profile.bump("scan_cache_miss")
 
@@ -712,10 +790,18 @@ class StageExecutor:
                 connector, split, names, types,
                 page_rows=page_rows, use_cache=use_cache,
             )
-            per_worker[i % self.wm.n].extend(op.host_batches())
-        host_batches = [
-            (concat_batches(bs) if bs else None) for bs in per_worker
-        ]
+            if part is None:
+                per_worker[i % self.wm.n].extend(op.host_batches())
+            else:
+                per_worker[0].extend(op.host_batches())
+        if part is not None and per_worker[0]:
+            host_batches = self._bucketize_host(
+                concat_batches(per_worker[0]), part[2]
+            )
+        else:
+            host_batches = [
+                (concat_batches(bs) if bs else None) for bs in per_worker
+            ]
         if all(b is None for b in host_batches):
             cols = [
                 Column(np.zeros(1, dtype=t.np_dtype), t, np.zeros(1, bool))
@@ -730,8 +816,25 @@ class StageExecutor:
         if cache_key is not None:
             POOL.put_device(cache_key, [stacked])
         return self._scan_filters(
-            node, self._dist(stacked, [s for s, _ in node.assignments])
+            node,
+            self._dist(
+                stacked, [s for s, _ in node.assignments],
+                placements=placements, realigned=part is not None,
+            ),
         )
+
+    def _bucketize_host(self, host: Batch, key_channels: tuple) -> list:
+        """Split one host batch into per-worker shards by the layout hash
+        (the numpy mirror of the exchange hash — see partitioning.layout),
+        so the stacked scan output is exactly what a hash repartition on
+        the bucket columns would have produced."""
+        self.profile.bump("scan_bucketize")
+        dest = bucket_rows(host, key_channels, self.wm.n)
+        out = []
+        for w in range(self.wm.n):
+            idx = np.nonzero(dest == w)[0]
+            out.append(_take_host(host, idx) if idx.size else None)
+        return out
 
     def _scan_filters(self, node: P.TableScanNode, out: _Dist) -> _Dist:
         """Defer the pushed predicate + dynamic-filter pruning onto the scan
@@ -805,13 +908,27 @@ class StageExecutor:
         return src.defer(("filter", pred.key(), _sig(src.symbols)), step)
 
     def _x_ProjectNode(self, node: P.ProjectNode) -> _Dist:
+        from trino_tpu.expr.ir import SymbolRef
+
         src = self._exec(node.source)
         exprs = [src.rewrite(e) for _, e in node.assignments]
         step = FilterProjectOperator(None, exprs)._make_step()
+        # placements rename through identity refs; any placement column the
+        # projection drops loses its placement claim
+        rename: dict = {}
+        for s, e in node.assignments:
+            if isinstance(e, SymbolRef):
+                rename.setdefault(e.name, s.name)
+        placements = tuple(
+            tuple(rename[n] for n in t)
+            for t in src.placements
+            if t and all(n in rename for n in t)
+        )
         return src.defer(
             ("project", tuple(e.key() for e in exprs), _sig(src.symbols)),
             step,
             symbols=[s for s, _ in node.assignments],
+            placements=placements,
         )
 
     # -- aggregation ----------------------------------------------------------
@@ -889,7 +1006,10 @@ class StageExecutor:
 
     def _x_AggregationNode(self, node: P.AggregationNode) -> _Dist:
         if not isinstance(node.source, RemoteSourceNode):
-            raise NotImplementedError("aggregation without an exchange below")
+            # exchange elided by the placer: the child is placed on a
+            # subset of the grouping keys, so every group is whole on one
+            # worker — single-stage per worker, fused onto the child chain
+            return self._colocated_agg(node, self._exec(node.source))
         src = self._raw_remote(node.source)
         src = self._to_stacked(src)
         ngroups = len(node.group_symbols)
@@ -928,7 +1048,45 @@ class StageExecutor:
         self.profile.fragment(self._current_fid).collective_bytes += (
             batch_bytes(out)
         )
-        return self._dist(out, node.outputs)
+        return self._dist(
+            out, node.outputs,
+            placements=((tuple(s.name for s in node.group_symbols),)),
+            realigned=True,
+        )
+
+    def _colocated_agg(self, node: P.AggregationNode, src: _Dist) -> _Dist:
+        """Single-stage grouped aggregation over an already-placed child
+        (no exchange, no partial/final split): groups are whole per worker
+        because the child's placement is a subset of the grouping keys.
+        Defers onto the child chain, so scan-filter-aggregate still
+        compiles as ONE SPMD program."""
+        from trino_tpu.runtime.local_planner import build_agg_inputs
+
+        ngroups = len(node.group_symbols)
+        assert ngroups, "colocated aggregation needs grouping keys"
+        proj, specs, input_types = build_agg_inputs(node, src)
+        pre = FilterProjectOperator(None, proj)._make_step()
+        op = AggregationOperator(
+            list(range(ngroups)), specs, input_types, mode="single"
+        )
+        out_cap = next_pow2(src.cap, floor=64)
+
+        def step(b: Batch) -> Batch:
+            return op._reduce_step(pre(b), out_cap=out_cap)
+
+        self.profile.bump("exchange_elided")
+        gnames = {s.name for s in node.group_symbols}
+        placements = tuple(
+            t for t in src.placements if t and set(t) <= gnames
+        )
+        return src.defer(
+            ("agg_colocated", tuple(e.key() for e in proj),
+             _spec_sig(specs), out_cap, _sig(src.symbols)),
+            step,
+            symbols=node.outputs,
+            cap=out_cap,
+            placements=placements,
+        )
 
     def _spmd_single_stage(self, node: P.AggregationNode, src: _Dist) -> _Dist:
         """Repartition-on-group-keys + per-worker single-stage aggregation
@@ -983,7 +1141,11 @@ class StageExecutor:
         self.profile.fragment(self._current_fid).collective_bytes += (
             batch_bytes(out)
         )
-        return self._dist(out, node.outputs)
+        return self._dist(
+            out, node.outputs,
+            placements=((tuple(s.name for s in node.group_symbols),)),
+            realigned=True,
+        )
 
     def _global_agg(self, node: P.AggregationNode, src: _Dist) -> PhysicalPlan:
         """Global aggregation over a distributed child: partial per worker,
@@ -1049,23 +1211,43 @@ class StageExecutor:
             b = recode(b, cb, tb, merged, (hash(db), hash(da)))
         return a, b
 
+    def _join_side(self, side_node):
+        """One join input: a child-fragment result (exchange NOT applied)
+        or an inline already-placed subtree (elided exchange)."""
+        if isinstance(side_node, RemoteSourceNode):
+            return self._to_stacked(self._raw_remote(side_node))
+        return self._exec(side_node)
+
+    def _place_join_side(self, side_node, side: _Dist, keys):
+        """Apply (or elide) the partitioned-join repartition of one side:
+        a RemoteSource(repartition) hashes on ITS partition symbols (the
+        aligned subset the placer chose); an inline side was already placed
+        by a layout or upstream exchange and moves nothing."""
+        if (
+            isinstance(side_node, RemoteSourceNode)
+            and side_node.exchange_kind == "repartition"
+        ):
+            syms = side_node.partition_symbols or keys
+            return self._repartition_side(
+                side, [side.channel(s.name) for s in syms]
+            )
+        self.profile.bump("exchange_elided")
+        return side
+
     def _x_JoinNode(self, node: P.JoinNode) -> _Dist:
-        assert node.distribution in ("broadcast", "partitioned"), node
+        assert node.distribution in (
+            "broadcast", "partitioned", "colocated"
+        ), node
         probe_node, build_node = node.left, node.right
-        assert isinstance(build_node, RemoteSourceNode)
         # BUILD side first: its fragment completes before the probe side is
         # even pulled, so build-key ranges can prune probe-side scans in
         # later fragments (reference: DynamicFilterService.java:107,126 —
         # filters collected from build tasks reach probe scans before
         # splits feed)
-        build = self._to_stacked(self._raw_remote(build_node))
+        build = self._join_side(build_node)
         if node.kind == "inner":
             self._register_dynamic_filters(node.criteria, build)
-        if node.distribution == "partitioned":
-            assert isinstance(probe_node, RemoteSourceNode)
-            probe = self._to_stacked(self._raw_remote(probe_node))
-        else:
-            probe = self._exec(probe_node)
+        probe = self._join_side(probe_node)
         pk = [probe.channel(l.name) for l, _ in node.criteria]
         bk = [build.channel(r.name) for _, r in node.criteria]
         probe, build = self._unify_key_dicts(probe, pk, build, bk)
@@ -1083,21 +1265,17 @@ class StageExecutor:
             build_stacked = self._call(
                 ex.broadcast, build.stacked, self.wm, phase="collective"
             )
-        else:
-            build_stacked = self._call(
-                ex.repartition, build.stacked, bk, self.wm, phase="collective"
-            )
-            probe_stacked = self._call(
-                ex.repartition, probe.stacked, pk, self.wm,
-                phase="collective",
-            )
             self.profile.fragment(self._current_fid).collective_bytes += (
-                batch_bytes(probe_stacked)
+                batch_bytes(build_stacked)
             )
-            probe = self._dist(probe_stacked, probe.symbols)
-        self.profile.fragment(self._current_fid).collective_bytes += (
-            batch_bytes(build_stacked)
-        )
+        else:
+            build = self._place_join_side(
+                build_node, build, [r for _, r in node.criteria]
+            )
+            probe = self._place_join_side(
+                probe_node, probe, [l for l, _ in node.criteria]
+            )
+            build_stacked = build.stacked
 
         op = HashJoinOperator(
             node.kind, pk, bk,
@@ -1110,80 +1288,187 @@ class StageExecutor:
             node.kind, tuple(pk), tuple(bk), cap_b,
             _sig(probe.symbols), _sig(build.symbols), residual_key,
         )
-
-        def build_locate():
-            def locate_step(pb: Batch, bb: Batch):
-                # per-shard PagesHash analog: sort THIS shard's build once,
-                # then binary-search the probe keys against it
-                sb, canon, n_match = _sort_build_device(bb, bk)
-                pc, pn = _canon_probe_device(pb, pk, canon)
-                start, count = _locate_sorted(
-                    canon, n_match, pc, pn, cap_b=cap_b
-                )
-                return start, count, sb
-
-            return locate_step
-
-        locate = cached_spmd_step(self.wm, ("join_locate",) + jkey, build_locate)
-        start, count, sorted_build = self._call(
-            locate, probe.stacked, build_stacked
-        )
-        with self.profile.phase(self._current_fid, "transfer"):
-            count_h, mask_h = (
-                np.asarray(x)
-                for x in device_get_async((count, probe.stacked.mask()))  # lint: allow(host-transfer)
-            )
-        emit_h = (
-            np.where(mask_h, np.maximum(count_h, 1), 0)
-            if node.kind in ("left", "full")
-            else np.where(mask_h, count_h, 0)
-        )
-        totals = emit_h.sum(axis=-1)  # [W]
-        out_cap = next_pow2(max(1, int(totals.max())), floor=1024)
+        # capacity-history discriminator: two queries can share the same
+        # join signature (and compiled programs) while filtering the probe
+        # differently — their deferred-chain keys tell them apart so their
+        # recorded capacities don't ping-pong
+        probe_fp = tuple(k for k, _, _ in probe.pending)
+        probe_stacked = probe.stacked
         probe_types = [s.type for s in probe.symbols]
 
-        def build_expand():
-            def expand_step(pb: Batch, bb: Batch, st, ct, total):
-                matched0 = (
-                    jnp.zeros(cap_b, dtype=bool)
-                    if node.kind == "full"
-                    else None
-                )
-                out, matched = op._expand_step(
-                    pb, bb, st, ct, matched0, out_cap=out_cap,
-                    cap_b=cap_b, total_emit=total,
-                )
-                if node.kind == "full":
-                    # per-shard unmatched-build tail: with PARTITIONED
-                    # inputs every build row lives on exactly one shard, so
-                    # the tail emits each unmatched build row exactly once
-                    tail_live = jnp.logical_and(
-                        bb.mask(), jnp.logical_not(matched)
+        def device_emit_total(pb: Batch, count):
+            """Per-worker emitted-row total, ON DEVICE (what the pre-PR
+            path synced the whole count matrix to the host to compute)."""
+            live = pb.mask()
+            emit = (
+                jnp.where(live, jnp.maximum(count, 1), 0)
+                if node.kind in ("left", "full")
+                else jnp.where(live, count, 0)
+            )
+            return jnp.sum(emit, dtype=jnp.int64)
+
+        def locate(pb: Batch, bb: Batch):
+            # per-shard PagesHash analog: sort THIS shard's build once,
+            # then binary-search the probe keys against it
+            sb, canon, n_match = _sort_build_device(bb, bk)
+            pc, pn = _canon_probe_device(pb, pk, canon)
+            start, count = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
+            return sb, start, count
+
+        def expand(pb: Batch, sb: Batch, start, count, total, out_cap: int):
+            matched0 = (
+                jnp.zeros(cap_b, dtype=bool) if node.kind == "full" else None
+            )
+            out, matched = op._expand_step(
+                pb, sb, start, count, matched0, out_cap=out_cap,
+                cap_b=cap_b, total_emit=total,
+            )
+            if node.kind == "full":
+                # per-shard unmatched-build tail: with PARTITIONED inputs
+                # every build row lives on exactly one shard, so the tail
+                # emits each unmatched build row exactly once
+                tail_live = jnp.logical_and(sb.mask(), jnp.logical_not(matched))
+                ncols = [
+                    Column(
+                        jnp.zeros(cap_b, dtype=t.np_dtype),
+                        t,
+                        jnp.zeros(cap_b, dtype=bool),
+                        None,
                     )
-                    ncols = [
-                        Column(
-                            jnp.zeros(cap_b, dtype=t.np_dtype),
-                            t,
-                            jnp.zeros(cap_b, dtype=bool),
-                            None,
-                        )
-                        for t in probe_types
-                    ]
-                    tail = Batch(ncols + list(bb.columns), tail_live)
-                    out = concat_batches([out, tail])
+                    for t in probe_types
+                ]
+                tail = Batch(ncols + list(sb.columns), tail_live)
+                out = concat_batches([out, tail])
+            return out
+
+        out = self._sized_expansion(
+            ("join",) + jkey, probe_stacked, build_stacked,
+            locate, device_emit_total, expand, compact_probe=True,
+            stats_key=("join",) + jkey + (probe_fp,),
+        )
+        return self._dist(
+            out, out_symbols,
+            placements=join_output_placements(
+                probe.placements, node.criteria, node.kind
+            ),
+            realigned=probe.realigned or node.distribution != "broadcast",
+        )
+
+    # -- capacity-sized expansions (joins / residual semi joins) --------------
+
+    def _sized_expansion(self, key, probe_stacked, build_stacked,
+                         locate, device_total, expand,
+                         compact_probe: bool = False,
+                         stats_key=None) -> Batch:
+        """Run a locate+expand pair whose static output capacity depends on
+        the data, under the `join_speculative_capacity` policy:
+
+          * warm (capacity history holds the tight pow2 buckets measured
+            before): ONE fused locate+expand program launched speculatively
+            with an on-device overflow flag — no host sync before or during
+            the join; the post-hoc [W] flag read overlaps completed device
+            work, and an overflow (changed data) retries at the next
+            bucket.  With `compact_probe`, the program first compacts the
+            probe to its recorded live-row bucket (deferred filters leave
+            dead capacity: a half-selective scan otherwise doubles every
+            downstream locate/expand), guarded by the same overflow flag;
+          * cold (no history) or speculation off: a sizing pass — locate
+            runs first and its per-worker emitted TOTAL + live count
+            (computed on device) cross as one tiny [W, 2] transfer to pick
+            the exact buckets; the expand then consumes locate's
+            device-resident outputs.  The pre-PR path shipped the whole
+            [W, cap] count matrix and stalled dispatch on it.
+
+        Cold and warm paths agree on the expand capacity (the tight
+        bucket), so every downstream static shape is identical across runs
+        — warm replays retrace nothing."""
+        spec = speculation_mode(self.properties)
+        hist_key = ("cap",) + (stats_key if stats_key is not None else key)
+        pkey = ("pcap",) + (stats_key if stats_key is not None else key)
+        out_cap = (
+            initial_cap(hist_key, spec) if spec is not None else None
+        )
+        cap_p = _trailing_cap(probe_stacked)
+        fid = self._current_fid
+
+        while out_cap is not None:  # speculative fused path
+            pcap = CAP_HISTORY.guess(pkey, cap_p) if compact_probe else cap_p
+            pcap = min(pcap, cap_p)
+
+            def build_fused(oc=out_cap, pc=pcap):
+                def step(pb: Batch, bb: Batch):
+                    live = jnp.sum(pb.mask(), dtype=jnp.int64)
+                    over = live > pc
+                    if pc < cap_p:
+                        pb = pb.compact_device(out_capacity=pc)
+                    sb, start, count = locate(pb, bb)
+                    total = device_total(pb, count)
+                    over = jnp.logical_or(over, total > oc)
+                    return (
+                        expand(pb, sb, start, count, total, oc),
+                        total,
+                        live,
+                        over,
+                    )
+
+                return step
+
+            fn = cached_spmd_step(
+                self.wm, ("fused_expand", out_cap, pcap) + key, build_fused
+            )
+            out, total, live, over = self._call(
+                fn, probe_stacked, build_stacked
+            )
+            with self.profile.phase(fid, "transfer"):
+                over_h, total_h, live_h = self._host_pull(over, total, live)
+            self.profile.bump("join_overflow_check")
+            if not over_h.any():
+                CAP_HISTORY.record(hist_key, out_cap)
+                if compact_probe:
+                    CAP_HISTORY.record(pkey, pcap)
                 return out
+            self.profile.bump("join_speculative_retry")
+            if int(live_h.max()) > pcap:
+                CAP_HISTORY.record(
+                    pkey, next_pow2(int(live_h.max()), floor=1024)
+                )
+            if int(total_h.max()) > out_cap:
+                out_cap = next_cap(int(total_h.max()), out_cap)
 
-            return expand_step
+        # sizing pass: locate + one [W] totals read + exactly-sized expand
+        def build_locate():
+            def step(pb: Batch, bb: Batch):
+                sb, start, count = locate(pb, bb)
+                live = jnp.sum(pb.mask(), dtype=jnp.int64)
+                return sb, start, count, device_total(pb, count), live
 
-        expand = cached_spmd_step(
-            self.wm, ("join_expand", out_cap) + jkey, build_expand
+            return step
+
+        loc = cached_spmd_step(self.wm, ("locate",) + key, build_locate)
+        sb, start, count, total_dev, live_dev = self._call(
+            loc, probe_stacked, build_stacked
         )
-        out = self._call(
-            expand,
-            probe.stacked, sorted_build, start, count,
-            jax.device_put(totals, self.wm.sharding()),
-        )
-        return self._dist(out, out_symbols)
+        with self.profile.phase(fid, "transfer"):
+            totals, lives = self._host_pull(total_dev, live_dev)
+        self.profile.bump("join_capacity_sync")
+        cap = next_pow2(max(1, int(totals.max())), floor=1024)
+
+        def build_expand(oc=cap):
+            def step(pb: Batch, sb: Batch, start, count, total):
+                return expand(pb, sb, start, count, total, oc)
+
+            return step
+
+        fn = cached_spmd_step(self.wm, ("expand", cap) + key, build_expand)
+        out = self._call(fn, probe_stacked, sb, start, count, total_dev)
+        if spec is not None:
+            CAP_HISTORY.record(hist_key, cap)
+            if compact_probe:
+                CAP_HISTORY.record(
+                    pkey,
+                    min(cap_p, next_pow2(max(1, int(lives.max())), floor=1024)),
+                )
+        return out
 
     def _x_SemiJoinNode(self, node: P.SemiJoinNode) -> _Dist:
         if isinstance(node.source, RemoteSourceNode):
@@ -1227,6 +1512,33 @@ class StageExecutor:
                 null_aware=node.null_aware,
                 residual=residual,
             )
+            # per-shard marking needs key-matching pairs co-located.  With
+            # no placements, both sides ride the connector's aligned range
+            # splits (the historical contract); once EITHER side is hash-
+            # placed (a bucketed layout), range alignment is gone — hash-
+            # place the other side too so the shards line up exactly
+            src_placed = any(
+                t == (node.source_key.name,) for t in src.placements
+            )
+            filt_placed = any(
+                t == (node.filtering_key.name,) for t in filt.placements
+            )
+            # a REALIGNED side without an exact-key placement (bucketized
+            # on other columns, placement claim dropped by a projection, a
+            # host re-stack, ...) breaks range alignment just as surely as
+            # a placed one — once anything moved, every side must end up
+            # exact-key hash-placed
+            if self.colocate and (
+                src_placed or filt_placed or src.realigned or filt.realigned
+            ):
+                if src_placed:
+                    self.profile.bump("exchange_elided")
+                else:
+                    src = self._repartition_side(src, [sk])
+                if filt_placed:
+                    self.profile.bump("exchange_elided")
+                else:
+                    filt = self._repartition_side(filt, [fk])
             filt_stacked = filt.stacked
             has_null = _global_has_null(filt_stacked)
             cap_b = _trailing_cap(filt_stacked)
@@ -1235,44 +1547,34 @@ class StageExecutor:
                 _sig(src.symbols), _sig(filt.symbols),
             )
 
-            def build_locate():
-                def locate_step(pb: Batch, bb: Batch):
-                    sb, canon, n_match = _sort_build_device(bb, [fk])
-                    pc, pn = _canon_probe_device(pb, [sk], canon)
-                    st, ct = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
-                    return st, ct, sb
+            src_fp = tuple(k for k, _, _ in src.pending)
+            src_stacked = src.stacked
 
-                return locate_step
+            def locate(pb: Batch, bb: Batch):
+                sb, canon, n_match = _sort_build_device(bb, [fk])
+                pc, pn = _canon_probe_device(pb, [sk], canon)
+                st, ct = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
+                return sb, st, ct
 
-            locate = cached_spmd_step(
-                self.wm, ("semi_locate",) + skey, build_locate
-            )
-            start, count, sorted_b = self._call(locate, src.stacked, filt_stacked)
-            with self.profile.phase(self._current_fid, "transfer"):
-                totals = (
-                    np.asarray(device_get_async(count)).sum(axis=-1)  # [W]  # lint: allow(host-transfer)
+            def device_total(pb: Batch, ct):
+                return jnp.sum(ct, dtype=jnp.int64)
+
+            def mark(pb: Batch, sb: Batch, st, ct, total, out_cap: int):
+                return op._mark_residual_step(
+                    pb, sb, st, ct,
+                    cap_b=cap_b, out_cap=out_cap, total_emit=total,
+                    has_null=has_null,
                 )
-            out_cap = next_pow2(max(1, int(totals.max())), floor=1024)
 
-            def build_mark():
-                def mark_step(pb: Batch, bb: Batch, st, ct, total) -> Batch:
-                    return op._mark_residual_step(
-                        pb, bb, st, ct,
-                        cap_b=cap_b, out_cap=out_cap, total_emit=total,
-                        has_null=has_null,
-                    )
-
-                return mark_step
-
-            mark = cached_spmd_step(
-                self.wm, ("semi_mark_residual", out_cap) + skey, build_mark
+            out = self._sized_expansion(
+                ("semi",) + skey, src_stacked, filt_stacked,
+                locate, device_total, mark,
+                stats_key=("semi",) + skey + (src_fp,),
             )
-            out = self._call(
-                mark,
-                src.stacked, sorted_b, start, count,
-                jax.device_put(totals, self.wm.sharding()),
+            return self._dist(
+                out, src.symbols + [node.mark], placements=src.placements,
+                realigned=src.realigned,
             )
-            return self._dist(out, src.symbols + [node.mark])
 
         op = SemiJoinOperator(
             sk, fk, [s.type for s in filt.symbols], null_aware=node.null_aware
@@ -1302,7 +1604,26 @@ class StageExecutor:
             build_mark,
         )
         out = self._call(mark, src.stacked, bcast)
-        return self._dist(out, src.symbols + [node.mark])
+        return self._dist(
+            out, src.symbols + [node.mark], placements=src.placements,
+            realigned=src.realigned,
+        )
+
+    def _repartition_side(self, side: _Dist, chans: list) -> _Dist:
+        """Hash-place one operand on `chans` (co-locating it with a side
+        that is already layout-placed on the aligned keys)."""
+        stacked = self._call(
+            ex.repartition, side.stacked, chans, self.wm, phase="collective"
+        )
+        self.profile.bump("repartition_collective")
+        self.profile.fragment(self._current_fid).collective_bytes += (
+            batch_bytes(stacked)
+        )
+        return self._dist(
+            stacked, side.symbols,
+            placements=((tuple(side.symbols[c].name for c in chans),)),
+            realigned=True,
+        )
 
     def _x_UnnestNode(self, node: P.UnnestNode) -> _Dist:
         from trino_tpu.ops.unnest import UnnestOperator
@@ -1324,7 +1645,10 @@ class StageExecutor:
             lambda: step,
         )
         out = self._call(fn, src.stacked)
-        return self._dist(out, node.outputs)
+        return self._dist(
+            out, node.outputs, placements=src.placements,
+            realigned=src.realigned,
+        )
 
     def _x_MarkDistinctNode(self, node: P.MarkDistinctNode) -> _Dist:
         from trino_tpu.ops.aggregation import MarkDistinctOperator
@@ -1336,6 +1660,7 @@ class StageExecutor:
             ("mark_distinct", chans, _sig(src.symbols)),
             op._mark_step,
             symbols=node.outputs,
+            placements=src.placements,
         )
 
     # -- window ---------------------------------------------------------------
@@ -1377,6 +1702,7 @@ class StageExecutor:
              tuple(repr(s) for s in specs), _sig(src.symbols)),
             op._window_step,
             symbols=node.outputs,
+            placements=src.placements,
         )
 
     # -- ordering / limiting (partial steps; merge happens at the exchange) ---
@@ -1422,6 +1748,21 @@ class StageExecutor:
             return b.filter(jnp.logical_and(live, rank < n))
 
         return src.defer(("limit", n, _sig(src.symbols)), step)
+
+
+def _take_host(batch: Batch, idx: np.ndarray) -> Batch:
+    """Row-gather of a HOST batch (bucketized scan sharding)."""
+    cols = [
+        Column(
+            np.asarray(c.data)[idx],
+            c.type,
+            None if c.valid is None else np.asarray(c.valid)[idx],
+            c.dictionary,
+            None if c.lengths is None else np.asarray(c.lengths)[idx],
+        )
+        for c in batch.columns
+    ]
+    return Batch(cols, np.asarray(batch.mask())[idx])
 
 
 def _slice_host(batch: Batch, n: int) -> Batch:

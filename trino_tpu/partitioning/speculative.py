@@ -1,0 +1,96 @@
+"""Speculative join output capacity (Spark-AQE-style guess-and-retry).
+
+The pre-PR distributed join blocked the accelerator on a host sync of the
+per-probe-row match counts to size the expand program's static output
+capacity.  Speculative execution replaces the sync: pick a pow2 `out_cap`
+from history (or a conservative cold guess), run the fused locate+expand
+program with the per-worker emitted total and an on-device overflow flag in
+its outputs, and only if some worker overflowed, retry at the exact pow2
+bucket of the observed totals.  The host never reads match counts before
+dispatching the join; the post-hoc flag read is a tiny [W] transfer that
+overlaps completed device work.
+
+`CapacityHistory` remembers the last good capacity per join fingerprint, so
+a warm query replays at the right bucket with zero retries (asserted by
+`verify.device_residency` over the partitioned-join path).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from trino_tpu.ops.common import next_pow2
+
+#: smallest speculative bucket (matches the old host-sync path's floor)
+CAP_FLOOR = 1024
+
+
+class CapacityHistory:
+    """join fingerprint -> last good pow2 out_cap (process-wide, bounded)."""
+
+    def __init__(self, limit: int = 1024):
+        self.limit = limit
+        self._caps: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def guess(self, key, default: int) -> int:
+        with self._lock:
+            cap = self._caps.get(key)
+            if cap is not None:
+                self._caps.move_to_end(key)
+                return cap
+        return default
+
+    def record(self, key, cap: int) -> None:
+        with self._lock:
+            self._caps[key] = cap
+            self._caps.move_to_end(key)
+            while len(self._caps) > self.limit:
+                self._caps.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._caps.clear()
+
+
+#: the process-wide history (cleared only by tests)
+CAP_HISTORY = CapacityHistory()
+
+
+def speculation_mode(properties):
+    """Parse the `join_speculative_capacity` session property:
+    -> None (off) | 0 (on, auto initial cap) | pow2 int (initial-cap
+    override)."""
+    try:
+        raw = str(properties.get("join_speculative_capacity")).strip().lower()
+    except KeyError:  # older property sets
+        return 0
+    if raw in ("off", "false", "no", "0"):
+        return None
+    if raw in ("on", "true", "yes", ""):
+        return 0
+    try:
+        return next_pow2(max(1, int(raw)), floor=1)
+    except ValueError:
+        raise ValueError(
+            f"join_speculative_capacity must be on|off|<initial cap>, got {raw!r}"
+        )
+
+
+def initial_cap(history_key, override: int):
+    """Speculative capacity to launch at: the recorded history (tight —
+    the exact bucket the cold sizing pass measured), else the session
+    override.  Returns None when neither exists: the caller runs the cold
+    sizing pass (one tiny [W] totals read) instead of speculating on a
+    guess — a wrong guess either overflows (retry) or, worse, silently
+    oversizes the expand and every downstream static shape."""
+    cap = CAP_HISTORY.guess(history_key, 0)
+    if cap:
+        return cap
+    return override or None
+
+
+def next_cap(observed_total: int, current: int) -> int:
+    """Retry bucket after an overflow at `current`."""
+    return max(next_pow2(max(1, observed_total), floor=CAP_FLOOR), current * 2)

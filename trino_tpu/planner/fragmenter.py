@@ -101,12 +101,32 @@ class ExchangePlacer:
     """Insert ExchangeNodes so every operator's distribution requirement is
     met, choosing broadcast vs partitioned joins by stats (AddExchanges)."""
 
-    def __init__(self, catalogs, properties=None, n_workers: int = 8):
+    def __init__(self, catalogs, properties=None, n_workers: int = 8,
+                 colocate=None):
+        from trino_tpu.partitioning import LayoutResolver
         from trino_tpu.runtime.session import SessionProperties
 
         self.catalogs = catalogs
         self.properties = properties or SessionProperties()
         self.n_workers = n_workers
+        self.resolver = LayoutResolver(catalogs, self.properties)
+        if colocate is not None:
+            # executors whose data plane cannot honor hash placements
+            # (the HTTP split_mod scheduler) force elision off regardless
+            # of the session property
+            self.colocate = bool(colocate)
+        else:
+            try:
+                self.colocate = bool(self.properties.get("colocated_join"))
+            except KeyError:  # pragma: no cover - older property sets
+                self.colocate = True
+
+    def _placements(self, node: P.PlanNode) -> tuple:
+        from trino_tpu.partitioning import derive_partitioning
+
+        if not self.colocate:
+            return ()
+        return derive_partitioning(node, self.resolver, self.n_workers)
 
     def place(self, node: P.PlanNode):
         out, dist = self._visit(node)
@@ -199,6 +219,22 @@ class ExchangePlacer:
         # the single-stage sort-based percentile per worker (the reference's
         # single-step aggregation over hash distribution)
         if node.group_symbols:
+            # exchange elision: when the child is already placed on a
+            # subset of the grouping keys (a bucketed scan or an upstream
+            # repartition), every group is whole on one worker — run the
+            # aggregation single-stage with NO exchange (the reference's
+            # partitioning-matching in AddExchanges)
+            if not has_distinct and not any(
+                a.function in HOLISTIC_AGGS for _, a in node.aggregations
+            ):
+                gnames = {s.name for s in node.group_symbols}
+                if any(
+                    t and set(t) <= gnames for t in self._placements(child)
+                ):
+                    return (
+                        node.with_children([child]),
+                        _Distribution.DISTRIBUTED,
+                    )
             # the executor pushes the PARTIAL step to the producing side of
             # the exchange and runs FINAL above it (the
             # PushPartialAggregationThroughExchange effect)
@@ -247,22 +283,69 @@ class ExchangePlacer:
             # one worker (reference: AddExchanges forces partitioned for
             # full/right joins)
             broadcast = False
+        if broadcast and self.colocate:
+            # partitioning matching beats the stats heuristic: when the
+            # PROBE side is already placed on its keys (bucketed layout or
+            # upstream exchange), a partitioned join moves at most the
+            # build side once — strictly less than W broadcast copies; a
+            # fully co-located join moves nothing at all
+            lex, rex, dist = self._partitioned_join_sides(
+                left, right, node.criteria
+            )
+            if dist == "colocated" or lex is left:
+                return (
+                    P.JoinNode(
+                        node.kind, lex, rex, node.criteria, node.filter, dist
+                    ),
+                    _Distribution.DISTRIBUTED,
+                )
         if broadcast:
             ex = P.ExchangeNode(right, "broadcast")
             out = P.JoinNode(
                 node.kind, left, ex, node.criteria, node.filter, "broadcast"
             )
         else:
-            lex = P.ExchangeNode(
-                left, "repartition", [l for l, _ in node.criteria]
-            )
-            rex = P.ExchangeNode(
-                right, "repartition", [r for _, r in node.criteria]
+            lex, rex, dist = self._partitioned_join_sides(
+                left, right, node.criteria
             )
             out = P.JoinNode(
-                node.kind, lex, rex, node.criteria, node.filter, "partitioned"
+                node.kind, lex, rex, node.criteria, node.filter, dist
             )
         return out, _Distribution.DISTRIBUTED
+
+    def _partitioned_join_sides(self, left, right, criteria):
+        """Exchange placement for a partitioned join, with partitioning
+        matching: a side already placed on (a subset of) its join keys
+        keeps its placement and skips the repartition; when BOTH sides
+        share an aligned placement the join is fully co-located.  The
+        repartitioned side hashes the keys ALIGNED with the placed side's
+        tuple, so equal-key rows of the two sides land on one worker."""
+        from trino_tpu.partitioning import (
+            align_through_criteria,
+            hash_aligned_criteria,
+        )
+
+        lprops = self._placements(left)
+        rprops = self._placements(right)
+        l2r = {l.name: r for l, r in hash_aligned_criteria(criteria)}
+        for tl in lprops:
+            if tl and all(n in l2r for n in tl):
+                tr = tuple(l2r[n].name for n in tl)
+                if tr in rprops:
+                    return left, right, "colocated"
+        lal = align_through_criteria(lprops, criteria, left_side=True)
+        if lal is not None:
+            _, other = lal
+            return left, P.ExchangeNode(right, "repartition", list(other)), "partitioned"
+        ral = align_through_criteria(rprops, criteria, left_side=False)
+        if ral is not None:
+            _, other = ral
+            return P.ExchangeNode(left, "repartition", list(other)), right, "partitioned"
+        return (
+            P.ExchangeNode(left, "repartition", [l for l, _ in criteria]),
+            P.ExchangeNode(right, "repartition", [r for _, r in criteria]),
+            "partitioned",
+        )
 
     def _p_SemiJoinNode(self, node: P.SemiJoinNode):
         src, sdist = self._visit(node.source)
@@ -376,17 +459,24 @@ def _verify_mode(properties) -> str:
     return V.resolve_mode(mode)
 
 
-def add_exchanges(plan: P.OutputNode, catalogs, properties=None, n_workers: int = 8):
+def add_exchanges(plan: P.OutputNode, catalogs, properties=None,
+                  n_workers: int = 8, colocate=None):
     from trino_tpu import verify as V
 
-    placer = ExchangePlacer(catalogs, properties, n_workers)
+    placer = ExchangePlacer(catalogs, properties, n_workers, colocate=colocate)
     out = placer.place(plan)
     assert isinstance(out, P.OutputNode)
     # distributed invariants: every ExchangeNode's partition symbols exist
-    # with hashable dtypes, and no placement broke dependencies
+    # with hashable dtypes, no placement broke dependencies, and every
+    # elided exchange is backed by a producing layout or exchange
     mode = _verify_mode(properties)
     if mode != "off":
+        from trino_tpu.verify.partitioning import check_partitioning
+
         V.enforce(V.check_plan(out), mode)
+        V.enforce(
+            check_partitioning(out, placer.resolver, n_workers), mode
+        )
     return out
 
 
@@ -394,8 +484,10 @@ def add_exchanges(plan: P.OutputNode, catalogs, properties=None, n_workers: int 
 
 
 class _Fragmenter:
-    def __init__(self):
+    def __init__(self, resolver=None, n_workers: int = 8):
         self.next_id = 0
+        self.resolver = resolver
+        self.n_workers = n_workers
 
     def fragment(self, root: P.PlanNode) -> SubPlan:
         """Cut at every ExchangeNode; the subtree below each exchange becomes
@@ -421,17 +513,29 @@ class _Fragmenter:
         body = cut(root)
         fid = self.next_id
         self.next_id += 1
-        part = _fragment_partitioning(body)
+        part = _fragment_partitioning(body, self.resolver, self.n_workers)
         sub = SubPlan(PlanFragment(fid, body, part), children)
         return sub
 
 
-def _fragment_partitioning(body: P.PlanNode) -> PartitioningHandle:
-    """Derive the fragment's partitioning handle from its body."""
+def _fragment_partitioning(
+    body: P.PlanNode, resolver=None, n_workers: int = 8
+) -> PartitioningHandle:
+    """Derive the fragment's partitioning handle from its body.  SOURCE
+    fragments report their layout-derived partition symbols (when the
+    resolver finds a usable bucketed layout), so EXPLAIN (TYPE DISTRIBUTED)
+    makes layout decisions auditable without reading planner internals."""
     has_scan = any(isinstance(n, P.TableScanNode) for n in P.walk(body))
     remotes = [n for n in P.walk(body) if isinstance(n, RemoteSourceNode)]
     if has_scan:
-        return PartitioningHandle(SOURCE)
+        keys: tuple = ()
+        if resolver is not None:
+            from trino_tpu.partitioning import derive_partitioning
+
+            props = derive_partitioning(body, resolver, n_workers)
+            if props:
+                keys = props[0]
+        return PartitioningHandle(SOURCE, keys)
     for r in remotes:
         if r.exchange_kind == "repartition":
             return PartitioningHandle(
@@ -445,10 +549,17 @@ def _fragment_partitioning(body: P.PlanNode) -> PartitioningHandle:
     return PartitioningHandle(COORDINATOR_ONLY)
 
 
-def create_subplans(distributed_plan: P.PlanNode, properties=None) -> SubPlan:
+def create_subplans(
+    distributed_plan: P.PlanNode,
+    properties=None,
+    catalogs=None,
+    n_workers: int = 8,
+) -> SubPlan:
     from trino_tpu import verify as V
+    from trino_tpu.partitioning import LayoutResolver
 
-    sub = _Fragmenter().fragment(distributed_plan)
+    resolver = LayoutResolver(catalogs, properties)
+    sub = _Fragmenter(resolver, n_workers).fragment(distributed_plan)
     # fragment invariants: unique fragment ids, every RemoteSourceNode names
     # an existing fragment whose root outputs match symbol-for-symbol
     mode = _verify_mode(properties)

@@ -363,6 +363,7 @@ class LocalQueryRunner:
             sub = create_subplans(
                 add_exchanges(plan, self.catalogs, self.properties),
                 properties=self.properties,
+                catalogs=self.catalogs,
             )
             text = fragment_text(sub)
         else:
@@ -562,6 +563,62 @@ class LocalQueryRunner:
     # -- DDL / DML (reference: execution/CreateTableTask, DropTableTask,
     # InsertStatement via TableWriterOperator -> ConnectorPageSink) ----------
 
+    @staticmethod
+    def _table_layout_from(properties: tuple, column_names) -> "object":
+        """Extract a TableLayout from CREATE TABLE WITH (...) properties
+        (reference: connector table properties -> bucketing handle)."""
+        from trino_tpu.partitioning import TableLayout
+
+        props = dict(properties or ())
+        unknown = set(props) - {"bucketed_by", "bucket_count"}
+        if unknown:
+            raise ValueError(
+                f"unknown table properties: {sorted(unknown)} "
+                "(supported: bucketed_by, bucket_count)"
+            )
+        if not props:
+            return None
+        cols = props.get("bucketed_by")
+        count = props.get("bucket_count")
+        if not cols or not count:
+            raise ValueError(
+                "bucketed tables need BOTH bucketed_by and bucket_count"
+            )
+        cols = tuple(str(c) for c in (cols if isinstance(cols, tuple) else (cols,)))
+        missing = [c for c in cols if c not in list(column_names)]
+        if missing:
+            raise ValueError(f"bucketed_by names unknown columns: {missing}")
+        return TableLayout(cols, int(count))
+
+    @staticmethod
+    def _create_with_layout(conn, schema, table, cols, layout) -> bool:
+        """Create the table, passing the layout to connectors that store
+        one (memory — transactional with the table via snapshots); returns
+        whether the connector took ownership of the layout."""
+        import inspect
+
+        kw = {}
+        if layout is not None:
+            try:
+                if "layout" in inspect.signature(conn.create_table).parameters:
+                    kw = {"layout": layout}
+            except (TypeError, ValueError):  # builtins / C callables
+                pass
+        conn.create_table(schema, table, cols, **kw)
+        return bool(kw)
+
+    def _register_layout(self, cat, schema, table, layout, owned: bool) -> None:
+        """Engine-level registry fallback for connectors that cannot store
+        the layout themselves.  NOT transactional (a rolled-back CREATE
+        leaves the entry until the matching DROP) — connector-owned layouts
+        are preferred exactly because they roll back with the table."""
+        if layout is not None and not owned:
+            from trino_tpu.partitioning import declare_layout
+
+            declare_layout(
+                (cat, schema, table), layout.bucket_columns, layout.bucket_count
+            )
+
     def _exec_CreateTable(self, stmt: ast.CreateTable) -> MaterializedResult:
         from trino_tpu import types as T
         from trino_tpu.connectors.api import ColumnMeta
@@ -574,8 +631,12 @@ class LocalQueryRunner:
                 return _ok("CREATE TABLE")
             raise ValueError(f"table '{cat}.{schema}.{table}' already exists")
         cols = [ColumnMeta(n, T.parse_type(t)) for n, t in stmt.columns]
+        layout = self._table_layout_from(
+            stmt.properties, [n for n, _ in stmt.columns]
+        )
         self.transactions.notify_write(cat, schema, table)
-        conn.create_table(schema, table, cols)
+        owned = self._create_with_layout(conn, schema, table, cols, layout)
+        self._register_layout(cat, schema, table, layout, owned)
         self.grants.set_owner(cat, schema, table, self.user)
         return _ok("CREATE TABLE")
 
@@ -593,8 +654,10 @@ class LocalQueryRunner:
         cols = [
             ColumnMeta(n, t) for n, t in zip(result.column_names, result.types)
         ]
+        layout = self._table_layout_from(stmt.properties, result.column_names)
         self.transactions.notify_write(cat, schema, table)
-        conn.create_table(schema, table, cols)
+        owned = self._create_with_layout(conn, schema, table, cols, layout)
+        self._register_layout(cat, schema, table, layout, owned)
         self.grants.set_owner(cat, schema, table, self.user)
         self._write_rows(conn, TableHandle(cat, schema, table), result)
         return MaterializedResult(["rows"], [(result.row_count,)], [])
@@ -1160,6 +1223,9 @@ class LocalQueryRunner:
         self.access_control.check_can_write(self.user, cat, schema, table)
         self.transactions.notify_write(cat, schema, table)
         conn.drop_table(TableHandle(cat, schema, table))
+        from trino_tpu.partitioning import drop_layout
+
+        drop_layout((cat, schema, table))
         return _ok("DROP TABLE")
 
     def _write_rows(self, conn, handle, result: MaterializedResult) -> None:

@@ -97,6 +97,27 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "default",
         ),
         PropertyMetadata(
+            "colocated_join",
+            "use table layouts / derived partitioning to elide exchanges "
+            "(co-partitioned joins, single-stage aggregations)",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
+            "join_speculative_capacity",
+            "speculative join output capacity: on | off | <initial pow2 "
+            "cap override> (off = block on the match-count host sync)",
+            str,
+            "on",
+        ),
+        PropertyMetadata(
+            "table_layouts",
+            "declared hash-bucketed layouts for generated tables: "
+            "'catalog.schema.table:col1+col2:bucket_count', comma-separated",
+            str,
+            "",
+        ),
+        PropertyMetadata(
             "pallas_agg",
             "use the Pallas MXU one-hot-matmul kernel for eligible "
             "small-domain float aggregations",

@@ -400,6 +400,8 @@ class CreateTableAs(Node):
     name: tuple
     query: Query
     if_not_exists: bool = False
+    #: WITH (name = value, ...) table properties (bucketed_by, bucket_count)
+    properties: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -407,6 +409,8 @@ class CreateTable(Node):
     name: tuple
     columns: tuple  # of (name, type_name)
     if_not_exists: bool = False
+    #: WITH (name = value, ...) table properties (bucketed_by, bucket_count)
+    properties: tuple = ()
 
 
 @dataclass(frozen=True)

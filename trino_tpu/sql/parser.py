@@ -329,6 +329,11 @@ class Parser:
             self.expect_kw("exists")
             if_not_exists = True
         name = self.qualified_name()
+        if self.accept_kw("with"):
+            # CREATE TABLE t WITH (...) AS query
+            props = self._table_properties()
+            self.expect_kw("as")
+            return ast.CreateTableAs(name, self._query(), if_not_exists, props)
         if self.accept_kw("as"):
             return ast.CreateTableAs(name, self._query(), if_not_exists)
         self.expect_op("(")
@@ -340,7 +345,50 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        return ast.CreateTable(name, tuple(cols), if_not_exists)
+        props = ()
+        if self.accept_kw("with"):
+            props = self._table_properties()
+        return ast.CreateTable(name, tuple(cols), if_not_exists, props)
+
+    def _table_properties(self) -> tuple:
+        """WITH ( name = literal | ARRAY['a', ...] , ... ) table properties
+        (reference: SqlBase.g4 properties rule; values restricted to the
+        literal shapes the connectors consume)."""
+        self.expect_op("(")
+        props = []
+        while True:
+            pname = self.ident()
+            self.expect_op("=")
+            props.append((pname, self._property_value()))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return tuple(props)
+
+    def _property_value(self):
+        t = self.peek()
+        if t.is_kw("array") or (t.kind == "ident" and t.value.lower() == "array"):
+            self.next()
+            self.expect_op("[")
+            items = []
+            if not self.accept_op("]"):
+                while True:
+                    items.append(self._property_value())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op("]")
+            return tuple(items)
+        if t.kind == "string":
+            self.next()
+            return t.value
+        if t.kind == "number":
+            self.next()
+            txt = str(t.value)
+            return float(txt) if "." in txt else int(txt)
+        if t.kind in ("ident", "keyword") and t.value.lower() in ("true", "false"):
+            self.next()
+            return t.value.lower() == "true"
+        raise ParseError("unsupported table property value", t)
 
     def _peek_ident(self, k: int, word: str) -> bool:
         t = self.peek(k)

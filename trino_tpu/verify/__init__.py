@@ -27,6 +27,7 @@ from trino_tpu.verify.plan_checker import (
     enforce,
     resolve_mode,
 )
+from trino_tpu.verify.partitioning import check_partitioning
 from trino_tpu.verify.residency import (
     CacheKeyViolation,
     ResidencyViolation,
@@ -39,6 +40,7 @@ __all__ = [
     "LAST_WARNINGS",
     "MODES",
     "PlanViolation",
+    "check_partitioning",
     "check_plan",
     "check_subplan",
     "enforce",

@@ -190,9 +190,19 @@ ALLOWED_COUNTERS = (
     "state_gather",
     "scan_cache_hit",
     "scan_cache_miss",
+    "scan_bucketize",
     "dynamic_filter_sync",
     "spool_read",
     "spool_write",
+    # partitioning-aware execution: elision bookkeeping is not a transfer,
+    # and the speculative join's post-hoc [W] overflow-flag read is a
+    # declared tiny boundary.  `join_capacity_sync` (the speculative-off
+    # blocking match-count sync) and `join_speculative_retry` are
+    # deliberately ABSENT: a warm partitioned join must neither block on
+    # capacities nor retry its expand.
+    "exchange_elided",
+    "repartition_collective",
+    "join_overflow_check",
 )
 
 
