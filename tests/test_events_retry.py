@@ -35,6 +35,77 @@ def test_events_on_failure(runner):
     assert "bogus_col" in listener.completed[0].error
 
 
+class _BrokenListener(CollectingEventListener):
+    def query_completed(self, e):
+        raise RuntimeError("sink is down")
+
+
+def test_listener_failure_warns_once_and_does_not_propagate(runner, caplog):
+    """A broken audit sink must be VISIBLE (one rate-limited warning per
+    listener class per event type) without breaking queries or starving
+    other listeners."""
+    import logging
+
+    broken = _BrokenListener()
+    healthy = CollectingEventListener()
+    runner.events.add(broken)
+    runner.events.add(healthy)
+    with caplog.at_level(logging.WARNING, logger="trino_tpu.events"):
+        runner.execute("select count(*) from region")
+        runner.execute("select count(*) from region")
+    # queries succeeded, the healthy listener saw both completions
+    assert len(healthy.completed) == 2
+    warnings = [
+        r for r in caplog.records
+        if "_BrokenListener" in r.getMessage()
+        and "query_completed" in r.getMessage()
+    ]
+    assert len(warnings) == 1, "warning must be rate-limited per class/event"
+    # created events (which _BrokenListener handles fine) did not warn
+    assert not any(
+        "query_created" in r.getMessage() for r in caplog.records
+    )
+
+
+def test_error_classification_user_vs_internal(runner):
+    from trino_tpu.runtime.events import classify_error
+    from trino_tpu.planner.analyzer import AnalysisError
+    from trino_tpu.sql.parser import parse_statement
+
+    with pytest.raises(Exception) as ei:
+        parse_statement("not sql at all")
+    assert classify_error(ei.value) == "USER_ERROR"  # ParseError
+    assert classify_error(AnalysisError("no such column")) == "USER_ERROR"
+    assert classify_error(KeyError("missing table")) == "USER_ERROR"
+    assert classify_error(NotImplementedError("stmt")) == "USER_ERROR"
+    assert classify_error(RuntimeError("bug")) == "INTERNAL_ERROR"
+    assert classify_error(ZeroDivisionError()) == "INTERNAL_ERROR"
+
+
+def test_failed_event_carries_error_type_and_registry_counts(runner):
+    from trino_tpu.telemetry import REGISTRY
+
+    listener = CollectingEventListener()
+    runner.events.add(listener)
+    c = REGISTRY.counter("trino_tpu_queries_total")
+    before = c.value(("FAILED", "USER_ERROR"))
+    with pytest.raises(Exception):
+        runner.execute("select bogus_col from region")
+    done = listener.completed[-1]
+    assert done.state == "FAILED"
+    assert done.error_type == "USER_ERROR"
+    assert c.value(("FAILED", "USER_ERROR")) == before + 1
+
+
+def test_completed_event_statistics_payload(runner):
+    listener = CollectingEventListener()
+    runner.events.add(listener)
+    runner.execute("select count(*) from region")
+    st = listener.completed[-1].statistics
+    assert st is not None and st.rows == 1 and st.wall_s > 0
+    assert st.spans > 0  # query_trace defaults on
+
+
 def test_injected_failure_fails_without_retry(runner):
     FAILURE_INJECTOR.inject("scan:tiny.nation", times=1)
     with pytest.raises(InjectedFailure):

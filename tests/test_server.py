@@ -60,3 +60,51 @@ def test_cli_format():
     lines = text.splitlines()
     assert lines[0].startswith("a ") and "bb" in lines[0]
     assert "NULL" in text and "(2 rows)" in text
+
+
+def test_metrics_endpoint(server, client):
+    import urllib.request
+
+    client.execute("select count(*) from tpch.tiny.nation")
+    req = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/v1/metrics", timeout=10
+    )
+    assert req.status == 200
+    assert req.headers["Content-Type"].startswith("text/plain")
+    text = req.read().decode()
+    # valid Prometheus exposition exposing trace-cache + exchange counters
+    assert "# TYPE trino_tpu_queries_total counter" in text
+    assert "trino_tpu_trace_cache_hits_total" in text
+    assert 'trino_tpu_mesh_events_total{counter="exchange_elided"}' in text
+    assert "trino_tpu_query_wall_seconds_count" in text
+
+
+def test_query_trace_endpoint(server):
+    import json
+    import urllib.request
+    from urllib.error import HTTPError
+
+    q = server.submit("select count(*) from tpch.tiny.region")
+    assert q.done.wait(timeout=30)
+    req = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/v1/query/{q.id}/trace", timeout=10
+    )
+    doc = json.loads(req.read().decode())
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "query" in names and "execute" in names
+    with pytest.raises(HTTPError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/v1/query/nope/trace", timeout=10
+        )
+
+
+def test_ui_stats_carry_trace_cache(server):
+    import json
+    import urllib.request
+
+    req = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/ui/api/stats", timeout=10
+    )
+    doc = json.loads(req.read().decode())
+    assert doc["metricsUri"] == "/v1/metrics"
+    assert "retraces" in doc.get("traceCache", {})

@@ -56,3 +56,53 @@ def test_caches_table(runner):
         "select tier, bytes from system.runtime.caches order by tier"
     ).rows
     assert [r[0] for r in rows] == ["device", "host"]
+
+
+def test_queries_table_wall_and_error_type(runner):
+    runner.execute("select count(*) from nation")
+    wall = runner.execute(
+        "select wall_s from system.runtime.queries "
+        "where state = 'FINISHED' order by query_id desc limit 1"
+    ).only_value()
+    assert wall is not None and wall >= 0
+    try:
+        runner.execute("select nope from nation")
+    except Exception:
+        pass
+    rows = runner.execute(
+        "select error_type from system.runtime.queries "
+        "where state = 'FAILED'"
+    ).rows
+    assert ("USER_ERROR",) in rows
+
+
+def test_spans_table(runner):
+    runner.execute("select count(*) from region")
+    rows = runner.execute(
+        "select query_id, name, parent_id, duration_ms "
+        "from system.runtime.spans"
+    ).rows
+    assert rows, "traced queries must surface spans"
+    names = {r[1] for r in rows}
+    assert {"query", "analyze", "optimize", "execute"} <= names
+    # exactly one root span (parent_id = 0) per traced query
+    by_query: dict = {}
+    for qid, name, parent, _ in rows:
+        if parent == 0:
+            by_query[qid] = by_query.get(qid, 0) + 1
+    assert by_query and all(n == 1 for n in by_query.values())
+
+
+def test_metrics_tables(runner):
+    runner.execute("select count(*) from nation")
+    rows = runner.execute(
+        "select name, kind, value from system.runtime.metrics "
+        "where name = 'trino_tpu_queries_total'"
+    ).rows
+    assert rows and all(r[1] == "counter" for r in rows)
+    # the system.metrics schema re-exposes the same registry
+    total = runner.execute(
+        "select sum(value) from system.metrics.metrics "
+        "where name = 'trino_tpu_queries_total'"
+    ).only_value()
+    assert total >= 1

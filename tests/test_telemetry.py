@@ -1,0 +1,450 @@
+"""Unified query telemetry: span tracer, metrics registry, Prometheus text,
+Perfetto export, system tables, and the MeshProfile JSON contract
+(reference style: TestQueryStats + the opentelemetry span assertions of
+TestTracing, plus jmx_exporter text-format checks)."""
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _compare_bench():
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    try:
+        import compare_bench
+    finally:
+        sys.path.pop(0)
+    return compare_bench
+
+from trino_tpu.parallel import DistributedQueryRunner
+from trino_tpu.runtime.query_stats import MESH_PHASES, FragmentStats, MeshProfile
+from trino_tpu.runtime.runner import LocalQueryRunner
+from trino_tpu.telemetry import (
+    NULL_TRACER,
+    REGISTRY,
+    MetricsRegistry,
+    SpanTracer,
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=2)
+
+
+@pytest.fixture(scope="module")
+def dist():
+    return DistributedQueryRunner(n_workers=8)
+
+
+# -- metrics registry ---------------------------------------------------------
+
+
+def test_counter_register_once_bump_everywhere():
+    reg = MetricsRegistry()
+    c1 = reg.counter("x_total", "help text")
+    c2 = reg.counter("x_total")
+    assert c1 is c2
+    c1.inc()
+    c2.inc(4)
+    assert c1.value() == 5
+
+
+def test_labeled_counter_and_prometheus_text():
+    reg = MetricsRegistry()
+    c = reg.counter("events_total", "events", labelnames=("kind",))
+    c.labels("a").inc(2)
+    c.labels(kind="b").inc()
+    text = reg.render_prometheus()
+    assert "# TYPE events_total counter" in text
+    assert 'events_total{kind="a"} 2' in text
+    assert 'events_total{kind="b"} 1' in text
+
+
+def test_kind_mismatch_rejected():
+    reg = MetricsRegistry()
+    reg.counter("m")
+    with pytest.raises(TypeError):
+        reg.gauge("m")
+
+
+def test_histogram_buckets_cumulative():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = reg.render_prometheus()
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1.0"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_count 3" in text
+    assert h.value() == 3
+
+
+def test_callback_gauge_and_snapshot():
+    reg = MetricsRegistry()
+    reg.gauge_fn("live_things", "pull-style", lambda: 7)
+    reg.counter("c_total").inc(3)
+    snap = reg.snapshot()
+    assert snap["live_things"] == 7
+    assert snap["c_total"] == 3
+    rows = dict((r[0], r[3]) for r in reg.rows())
+    assert rows["live_things"] == 7.0
+
+
+def test_concurrent_scrape_vs_bump():
+    """HTTP handler threads scrape /v1/metrics while the query thread
+    inserts new series — the series lock must keep renders from tripping
+    over dict resizes."""
+    import threading
+
+    reg = MetricsRegistry()
+    h = reg.histogram("x_seconds", "t")
+    c = reg.counter("y_total", "t", labelnames=("k",))
+    stop = False
+    errs = []
+
+    def scrape():
+        while not stop:
+            try:
+                reg.render_prometheus()
+                reg.snapshot()
+            except Exception as e:  # pragma: no cover - the regression
+                errs.append(e)
+                break
+
+    t = threading.Thread(target=scrape)
+    t.start()
+    try:
+        for i in range(5000):
+            h.observe(i * 0.001)
+            c.labels(str(i % 499)).inc()
+    finally:
+        stop = True
+        t.join()
+    assert not errs
+    assert c.labels("0").value() >= 1
+
+
+def test_prometheus_text_shape():
+    """Every non-comment line of the engine registry parses as
+    `name{labels} value` — the exposition-format contract /v1/metrics
+    serves."""
+    line_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$"
+    )
+    for line in REGISTRY.render_prometheus().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert line_re.match(line), f"bad exposition line: {line!r}"
+
+
+def test_engine_vocabulary_preregistered():
+    """Exchange/speculation counters render before any query bumps them."""
+    text = REGISTRY.render_prometheus()
+    for label in ("exchange_elided", "join_capacity_sync", "host_restack"):
+        assert f'counter="{label}"' in text
+    assert "trino_tpu_trace_cache_hits_total" in text
+    assert 'trino_tpu_buffer_pool_bytes{tier="device"}' in text
+
+
+# -- span tracer --------------------------------------------------------------
+
+
+def test_span_nesting_and_chrome_export():
+    tr = SpanTracer(query_id="q_test")
+    with tr.span("query", query_id="q_test"):
+        with tr.span("analyze"):
+            pass
+        tr.record("launch", tr.t0, tr.t0 + 0.001, {"phase": "compute"})
+    d = tr.root.to_dict()
+    assert d["name"] == "query"
+    assert [c["name"] for c in d["children"]] == ["analyze", "launch"]
+    chrome = tr.to_chrome_trace()
+    assert chrome["displayTimeUnit"] == "ms"
+    names = [e["name"] for e in chrome["traceEvents"]]
+    assert names == ["query", "analyze", "launch"]
+    for e in chrome["traceEvents"]:
+        assert e["ph"] == "X" and "ts" in e and "dur" in e
+    # the export round-trips through JSON (what Perfetto ingests)
+    assert json.loads(json.dumps(chrome))["traceEvents"]
+
+
+def test_span_error_attribution():
+    tr = SpanTracer()
+    with pytest.raises(RuntimeError):
+        with tr.span("query"):
+            raise RuntimeError("boom")
+    assert tr.root.attrs["error"] == "RuntimeError"
+    assert tr.root.end_s is not None
+
+
+def test_null_tracer_is_inert():
+    assert NULL_TRACER.enabled is False
+    with NULL_TRACER.span("anything", a=1) as sp:
+        assert sp is None
+    NULL_TRACER.record("x", 0.0, 1.0)
+    assert NULL_TRACER.flat_spans() == []
+    assert NULL_TRACER.to_chrome_trace()["traceEvents"] == []
+
+
+# -- query instrumentation (local) -------------------------------------------
+
+
+def test_local_query_trace_structure(runner):
+    runner.execute("select count(*) from nation")
+    trace = runner.last_trace
+    assert trace is not None
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert names[0] == "query"
+    assert "analyze" in names and "optimize" in names and "execute" in names
+
+
+def test_query_trace_off_is_zero_overhead(runner):
+    runner.execute("set session query_trace = false")
+    before = runner.last_trace
+    try:
+        runner.execute("select count(*) from region")
+        assert runner.last_trace is before  # nothing recorded
+    finally:
+        runner.execute("set session query_trace = true")
+
+
+def test_completion_metrics_and_statistics(runner):
+    from trino_tpu.runtime.events import CollectingEventListener
+
+    listener = CollectingEventListener()
+    runner.events.add(listener)
+    c = REGISTRY.counter("trino_tpu_queries_total")
+    before = c.value(("FINISHED", ""))
+    runner.execute("select count(*) from nation")
+    assert c.value(("FINISHED", "")) == before + 1
+    done = listener.completed[-1]
+    assert done.statistics is not None
+    assert done.statistics.wall_s > 0
+    assert done.statistics.rows == 1
+    assert done.statistics.spans >= 4  # query + analyze/optimize/execute
+    assert REGISTRY.histogram("trino_tpu_query_wall_seconds").value() > 0
+    runner.events.listeners.remove(listener)
+
+
+def test_explain_analyze_verbose_exports_trace(runner):
+    res = runner.execute(
+        "explain analyze verbose select count(*) from nation"
+    )
+    text = "\n".join(r[0] for r in res.rows)
+    assert "Query trace (spans" in text
+    json_lines = [
+        r[0] for r in res.rows if r[0].startswith("Trace JSON: ")
+    ]
+    assert json_lines, "VERBOSE must embed the Chrome-trace JSON"
+    chrome = json.loads(json_lines[0][len("Trace JSON: "):])
+    assert any(e["name"] == "query" for e in chrome["traceEvents"])
+
+
+def test_plain_explain_analyze_has_no_trace(runner):
+    res = runner.execute("explain analyze select count(*) from nation")
+    assert not any("Trace JSON" in r[0] for r in res.rows)
+
+
+# -- query instrumentation (distributed) --------------------------------------
+
+
+def test_mesh_trace_nests_query_fragment_launch(dist):
+    sql = "select count(*) from lineitem"
+    dist.execute(sql)
+    dist.execute(sql)  # warm: spans must exist without retraces
+    trace = dist.last_trace
+    assert trace is not None
+    assert any(
+        e["name"] == "query" for e in trace["traceEvents"]
+    ), "chrome export must carry the root span"
+    # structural validation on the flattened span tree
+    qid = trace["otherData"]["query_id"]
+    flat = None
+    for q, s in dist.traces:
+        if q == qid:
+            flat = s
+    assert flat, "trace history must hold the served query"
+    by_id = {s["span_id"]: s for s in flat}
+    roots = [s for s in flat if s["parent_id"] == 0]
+    assert len(roots) == 1 and roots[0]["name"] == "query"
+    frag = [s for s in flat if s["name"].startswith("fragment-")]
+    assert frag, "per-stage fragment spans expected"
+    launches = [s for s in flat if s["name"] == "launch"]
+    assert launches, "per-launch child spans expected"
+    for l in launches:
+        # every launch sits under a fragment span under the query root
+        cur = by_id[l["parent_id"]]
+        seen = set()
+        while cur["parent_id"] != 0 and cur["span_id"] not in seen:
+            seen.add(cur["span_id"])
+            cur = by_id[cur["parent_id"]]
+        assert cur["name"] in ("query",) or cur["name"].startswith(
+            "fragment-"
+        )
+        attrs = json.loads(l["attributes"])
+        assert attrs["phase"] in MESH_PHASES
+        assert "fragment" in attrs
+
+
+def test_mesh_events_mirrored_to_registry(dist):
+    c = REGISTRY.counter("trino_tpu_mesh_events_total")
+    before = c.value(("result_gather",)) + c.value(("host_gather",)) + c.value(
+        ("state_gather",)
+    )
+    dist.execute("select count(*) from orders")
+    after = c.value(("result_gather",)) + c.value(("host_gather",)) + c.value(
+        ("state_gather",)
+    )
+    assert after > before
+
+
+def test_residency_holds_with_tracing_enabled(dist):
+    """The telemetry-on contract: spans add no host syncs or retraces."""
+    from trino_tpu import verify as V
+
+    assert bool(dist.properties.get("query_trace")) is True
+    report = V.device_residency(
+        dist, "select sum(l_extendedprice) from lineitem"
+    )
+    assert report["retraces"] == 0
+    assert report["tracing_enabled"] is True
+    assert report["spans"] > 0
+
+
+# -- MeshProfile / FragmentStats JSON contract (the EXPLAIN ANALYZE and
+# BENCH_EXTRA.json schema, asserted instead of documented) --------------------
+
+FRAGMENT_JSON_KEYS = {
+    "fragment", "kind", "wall_s", "phases_ms",
+    "bytes_to_device", "bytes_to_host", "collective_bytes",
+}
+
+
+def test_fragment_stats_json_schema():
+    st = FragmentStats(3, kind="SOURCE")
+    st.wall_s = 0.01
+    st.phases["compute"] = 0.004
+    st.close()
+    doc = st.to_json()
+    assert set(doc) == FRAGMENT_JSON_KEYS
+    assert set(doc["phases_ms"]) == set(MESH_PHASES)
+    assert doc["fragment"] == 3 and doc["kind"] == "SOURCE"
+
+
+def test_mesh_profile_json_schema():
+    prof = MeshProfile()
+    prof.add_phase(0, "compute", 0.002)
+    prof.fragment(0).wall_s = 0.003
+    prof.bump("scan_cache_hit")
+    prof.fragment(0).close()
+    doc = prof.to_json()
+    assert set(doc) == {"fragments", "trace_cache", "counters"}
+    assert set(doc["trace_cache"]) == {"hits", "misses", "retraces"}
+    assert doc["counters"]["scan_cache_hit"] == 1
+    assert doc["fragments"][0]["phases_ms"]["compute"] == pytest.approx(2.0)
+
+
+def test_phases_sum_to_wall_after_close():
+    st = FragmentStats(0)
+    st.wall_s = 0.010
+    st.phases["compute"] = 0.004
+    st.phases["transfer"] = 0.001
+    st.close()
+    assert sum(st.phases.values()) == pytest.approx(st.wall_s, abs=1e-12)
+    assert st.phases["other"] == pytest.approx(0.005, abs=1e-12)
+
+
+def test_phases_sum_to_wall_on_real_mesh_profile(dist):
+    """The cross-fragment `_call` attribution invariant, asserted on a live
+    profile: deferred chains bill their PRODUCER fragment, and walls move
+    with the phases, so every fragment's phases still sum to its wall."""
+    sql = "select count(*), sum(l_quantity) from lineitem where l_quantity < 30"
+    dist.execute(sql)
+    dist.execute(sql)
+    prof = dist.last_mesh_profile
+    assert prof.fragments, "distributed query must profile fragments"
+    for fid, st in prof.fragments.items():
+        assert st.phases["other"] >= 0.0
+        assert sum(st.phases.values()) == pytest.approx(
+            st.wall_s, abs=1e-4
+        ), f"fragment {fid} phases do not sum to wall"
+
+
+def test_phase_totals_rollup():
+    prof = MeshProfile()
+    prof.add_phase(0, "compute", 0.002)
+    prof.add_phase(1, "compute", 0.003)
+    prof.add_phase(1, "transfer", 0.001)
+    totals = prof.phase_totals()
+    assert totals["compute"] == pytest.approx(0.005)
+    assert totals["transfer"] == pytest.approx(0.001)
+
+
+# -- counter regression gate (tools/compare_bench.py) -------------------------
+
+
+def _clean_extra():
+    return {
+        "mesh": {
+            "sf1": {
+                "error": None,
+                "profile": {
+                    "trace_cache": {"hits": 5, "misses": 0, "retraces": 0},
+                    "counters": {"scan_cache_hit": 1},
+                },
+                "q3_counters": {
+                    "repartition_collective": 0,
+                    "join_capacity_sync": 0,
+                    "join_speculative_retry": 0,
+                },
+            }
+        }
+    }
+
+
+def test_compare_bench_clean():
+    violations, skipped = _compare_bench().check_extra(_clean_extra())
+    assert violations == [] and skipped == []
+
+
+def test_compare_bench_flags_drift():
+    check_extra = _compare_bench().check_extra
+    bad = _clean_extra()
+    bad["mesh"]["sf1"]["profile"]["trace_cache"]["retraces"] = 2
+    bad["mesh"]["sf1"]["profile"]["counters"]["host_restack"] = 1
+    bad["mesh"]["sf1"]["q3_counters"]["join_capacity_sync"] = 3
+    violations, _ = check_extra(bad)
+    assert len(violations) == 3
+    assert any("retraces" in v for v in violations)
+    assert any("host_restack" in v for v in violations)
+    assert any("join_capacity_sync" in v for v in violations)
+
+
+def test_compare_bench_skips_errored_sections():
+    extra = {"mesh": {"sf1": {"error": "mesh child rc=1"}}}
+    violations, skipped = _compare_bench().check_extra(extra)
+    assert violations == [] and len(skipped) == 1
+
+
+def test_compare_bench_snapshot_gate():
+    check_snapshot = _compare_bench().check_snapshot
+    ok = {
+        'trino_tpu_mesh_events_total{counter="host_restack"}': 0,
+        # cold sizing passes may fire this in a process-lifetime snapshot
+        'trino_tpu_mesh_events_total{counter="join_capacity_sync"}': 2,
+    }
+    bad = {'trino_tpu_mesh_events_total{counter="host_restack"}': 1}
+    assert check_snapshot(ok) == []
+    assert len(check_snapshot(bad)) == 1
+
+
+def test_compare_bench_gates_checked_in_file():
+    """The repo's own BENCH_EXTRA.json must pass the gate CI runs."""
+    assert _compare_bench().main([]) == 0

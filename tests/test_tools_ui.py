@@ -159,3 +159,45 @@ def test_verifier_match_and_mismatch(runner):
     )
     st = {r.query_id: r.status for r in rep2.results}
     assert st == {"ok": "MATCH", "bad": "MISMATCH", "err": "CONTROL_ERROR"}
+
+
+# -- lint: raw perf_counter phase timing --------------------------------------
+
+
+def test_lint_flags_raw_perf_counter(tmp_path):
+    import os
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo_root, "tools"))
+    try:
+        import lint_tpu
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "from time import perf_counter\n"
+        "def f():\n"
+        "    t0 = time.perf_counter()\n"
+        "    t1 = perf_counter()\n"
+        "    return t1 - t0\n"
+    )
+    findings = [
+        f for f in lint_tpu.lint_file(str(bad))
+        if f.rule == "raw-perf-counter"
+    ]
+    assert len(findings) == 2
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "from trino_tpu.telemetry import now\n"
+        "def f():\n"
+        "    return now()\n"
+        "def boundary():  # lint: allow(raw-perf-counter)\n"
+        "    import time\n"
+        "    return time.perf_counter()\n"
+    )
+    assert [
+        f for f in lint_tpu.lint_file(str(ok))
+        if f.rule == "raw-perf-counter"
+    ] == []

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Counter regression gate: assert the SLO-like mesh counters recorded in
+BENCH_EXTRA.json (by `bench.py --mesh`) still hold their invariants.
+
+The mesh fast path's correctness-performance contract is a set of counters
+that must be ZERO on warm executions — a drift means a regression that walls
+alone may hide (a retrace can cost little on tiny data and 30x on SF10):
+
+  * `profile.trace_cache.retraces == 0` — warm runs reuse every compiled
+    SPMD program (PR 1's contract);
+  * `profile.counters.host_restack == 0` — no host batch re-enters the mesh
+    between distributed fragments (the device-resident pipeline);
+  * `q3_counters.repartition_collective == 0` — under co-partitioned
+    layouts the probe repartition is elided (PR 3);
+  * `q3_counters.join_capacity_sync == 0` and
+    `q3_counters.join_speculative_retry == 0` — the warm speculative join
+    neither blocks on capacities nor retries its expand.
+
+Modes:
+  python tools/compare_bench.py                 # gate the checked-in file
+  python tools/compare_bench.py --extra F.json  # gate another file
+  python tools/compare_bench.py --snapshot S.json
+      # additionally diff a FRESH registry snapshot (the `metrics` section a
+      # new `bench.py --mesh` run records) against the same expectations —
+      # the zero-counters above must be zero in the fresh snapshot's
+      # mesh-events series too.
+
+Exit status: 0 when every invariant holds, 1 on drift (the CI gate next to
+lint_tpu.py).  Sections that recorded an error are reported as skipped, not
+failed — a bench that could not run is not a counter regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: profile-level expectations: (path within a mesh schema section, expected)
+PROFILE_ZERO = (
+    ("profile", "trace_cache", "retraces"),
+)
+
+#: MeshProfile counters that must be absent-or-zero on the recorded profile
+PROFILE_COUNTER_ZERO = ("host_restack",)
+
+#: q3 (layouts) counters that must be zero warm
+Q3_ZERO = (
+    "repartition_collective",
+    "join_capacity_sync",
+    "join_speculative_retry",
+)
+
+#: registry-snapshot series (telemetry/metrics names) that must be zero in a
+#: fresh `bench.py --mesh` snapshot.  The snapshot is PROCESS-LIFETIME, so
+#: only counters that must never fire even cold belong here —
+#: `join_capacity_sync` legitimately fires on cold sizing passes and is
+#: gated per-warm-run via q3_counters instead.
+SNAPSHOT_ZERO_LABELS = (
+    "host_restack",
+    "join_speculative_retry",
+)
+
+
+def _dig(d: dict, path: tuple):
+    cur = d
+    for p in path:
+        if not isinstance(cur, dict) or p not in cur:
+            return None
+        cur = cur[p]
+    return cur
+
+
+def check_extra(extra: dict) -> tuple:
+    """-> (violations, skipped) over every mesh schema section."""
+    violations: list[str] = []
+    skipped: list[str] = []
+    mesh = extra.get("mesh")
+    if not isinstance(mesh, dict):
+        skipped.append("no mesh section recorded (run bench.py --mesh)")
+        return violations, skipped
+    for schema, sec in sorted(mesh.items()):
+        if schema == "run_error":
+            if sec:
+                skipped.append(f"mesh run_error: {sec}")
+            continue
+        if not isinstance(sec, dict):
+            continue
+        if sec.get("error"):
+            skipped.append(f"mesh.{schema}: bench errored: {sec['error']}")
+            continue
+        for path in PROFILE_ZERO:
+            v = _dig(sec, path)
+            if v is None:
+                continue  # older sections without the field
+            if v != 0:
+                violations.append(
+                    f"mesh.{schema}.{'.'.join(path)} = {v} (expected 0: "
+                    "warm executions must not retrace)"
+                )
+        counters = _dig(sec, ("profile", "counters")) or {}
+        for name in PROFILE_COUNTER_ZERO:
+            if counters.get(name, 0) != 0:
+                violations.append(
+                    f"mesh.{schema}.profile.counters.{name} = "
+                    f"{counters[name]} (expected 0: host batches must not "
+                    "re-enter the mesh between fragments)"
+                )
+        q3 = sec.get("q3_counters")
+        if isinstance(q3, dict):
+            for name in Q3_ZERO:
+                if q3.get(name, 0) != 0:
+                    violations.append(
+                        f"mesh.{schema}.q3_counters.{name} = {q3[name]} "
+                        "(expected 0 under co-partitioned layouts)"
+                    )
+        # the registry snapshot bench.py records into the section is the
+        # fresh-run diff surface: apply the process-lifetime expectations
+        snap = sec.get("metrics")
+        if isinstance(snap, dict):
+            violations.extend(
+                f"mesh.{schema}: {v}" for v in check_snapshot(snap)
+            )
+    return violations, skipped
+
+
+def check_snapshot(snapshot: dict) -> list:
+    """Gate a fresh registry snapshot (REGISTRY.snapshot() flat form:
+    'name{labels}' -> value) against the zero-counter expectations."""
+    violations = []
+    for key, value in sorted(snapshot.items()):
+        if not key.startswith("trino_tpu_mesh_events_total"):
+            continue
+        for label in SNAPSHOT_ZERO_LABELS:
+            if f'counter="{label}"' in key and value != 0:
+                violations.append(
+                    f"registry snapshot {key} = {value} (expected 0)"
+                )
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="counter regression gate over BENCH_EXTRA.json"
+    )
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap.add_argument(
+        "--extra",
+        default=os.path.join(root, "BENCH_EXTRA.json"),
+        help="bench side file to gate (default: repo BENCH_EXTRA.json)",
+    )
+    ap.add_argument(
+        "--snapshot",
+        default=None,
+        help="fresh metrics-registry snapshot JSON to diff against the "
+        "same zero-counter expectations",
+    )
+    args = ap.parse_args(argv)
+    try:
+        with open(args.extra, "r", encoding="utf-8") as fh:
+            extra = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"compare_bench: cannot read {args.extra}: {e}")
+        return 1
+    violations, skipped = check_extra(extra)
+    if args.snapshot:
+        try:
+            with open(args.snapshot, "r", encoding="utf-8") as fh:
+                violations.extend(check_snapshot(json.load(fh)))
+        except (OSError, ValueError) as e:
+            print(f"compare_bench: cannot read snapshot {args.snapshot}: {e}")
+            return 1
+    for s in skipped:
+        print(f"compare_bench: skipped: {s}")
+    for v in violations:
+        print(f"compare_bench: DRIFT: {v}")
+    if violations:
+        print(f"compare_bench: {len(violations)} counter invariant(s) drifted")
+        return 1
+    print("compare_bench: all counter invariants hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,10 @@ pays.  This linter walks `trino_tpu/ops/`, `trino_tpu/parallel/`, and
                     | host boundaries)
   untyped-symbol    | `Symbol(name)` built without a type — untyped
                     | PlanNode construction poisons downstream typing
+  raw-perf-counter  | `time.perf_counter()` phase timing in device code —
+                    | use `trino_tpu.telemetry.now` (the shared clock spans
+                    | and MeshProfile phases read) so wall attribution
+                    | stays comparable across the telemetry surfaces
 
 Suppression: append `# lint: allow(<rule>)` (comma-separate several rules,
 or `allow(*)` for all) to the offending line or to the enclosing `def` /
@@ -51,6 +55,8 @@ RULES = {
     "host-transfer": "explicit device->host transfer outside a declared "
                      "host boundary",
     "untyped-symbol": "Symbol constructed without a type",
+    "raw-perf-counter": "raw time.perf_counter() phase timing outside "
+                        "telemetry/ and query_stats.py",
 }
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
@@ -171,6 +177,21 @@ class _Linter(ast.NodeVisitor):
                 "host-transfer", node,
                 f"`{transfer}` moves device data to the host; allowed only "
                 "at declared boundaries (# lint: allow(host-transfer))",
+            )
+        # time.perf_counter() / perf_counter() — phase timing belongs to the
+        # telemetry clock (trino_tpu.telemetry.now), which spans and
+        # MeshProfile phases share; raw readings drift out of the trace
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "perf_counter"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "time"
+        ) or (isinstance(fn, ast.Name) and fn.id == "perf_counter"):
+            self._flag(
+                "raw-perf-counter", node,
+                "raw `perf_counter()` phase timing in device code; import "
+                "`now` from trino_tpu.telemetry (the shared span/profile "
+                "clock) instead",
             )
         # Symbol("name") without a type
         if (

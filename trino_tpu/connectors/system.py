@@ -5,10 +5,17 @@ NodeSystemTable, system.runtime schema) — the observability surface that
 makes the engine inspectable from its own SQL prompt.
 
 Tables (schema `runtime`):
-  queries          — query history from the event pipeline
+  queries          — query history from the event pipeline (wall, state,
+                     rows, error + error_type classification)
+  spans            — flattened span trees of recently traced queries
+                     (query_trace session property; telemetry/spans)
+  metrics          — the process metrics registry (telemetry/metrics)
   nodes            — mesh workers and their liveness
   session_properties — property values in effect
   caches           — buffer-pool tiers (bytes, hits, misses)
+
+Schema `metrics` re-exposes the registry as `system.metrics.metrics` (the
+Prometheus surface's SQL twin).
 """
 
 from __future__ import annotations
@@ -46,8 +53,10 @@ class QueryHistory(EventListener):
             "query": e.sql,
             "create_time": e.create_time,
             "end_time": None,
+            "wall_s": None,
             "rows": None,
             "error": None,
+            "error_type": None,
         }
         self._running[e.query_id] = row
         self.entries.append(row)
@@ -62,6 +71,8 @@ class QueryHistory(EventListener):
         row["end_time"] = e.end_time
         row["rows"] = e.rows
         row["error"] = e.error
+        row["error_type"] = getattr(e, "error_type", None)
+        row["wall_s"] = e.wall_s
 
 
 _TABLES = {
@@ -71,8 +82,25 @@ _TABLES = {
         ("query", T.VARCHAR),
         ("create_time", T.DOUBLE),
         ("end_time", T.DOUBLE),
+        ("wall_s", T.DOUBLE),
         ("rows", T.BIGINT),
         ("error", T.VARCHAR),
+        ("error_type", T.VARCHAR),
+    ],
+    "spans": [
+        ("query_id", T.VARCHAR),
+        ("span_id", T.BIGINT),
+        ("parent_id", T.BIGINT),
+        ("name", T.VARCHAR),
+        ("start_ms", T.DOUBLE),
+        ("duration_ms", T.DOUBLE),
+        ("attributes", T.VARCHAR),
+    ],
+    "metrics": [
+        ("name", T.VARCHAR),
+        ("kind", T.VARCHAR),
+        ("labels", T.VARCHAR),
+        ("value", T.DOUBLE),
     ],
     "nodes": [
         ("node_id", T.VARCHAR),
@@ -94,13 +122,17 @@ _TABLES = {
 
 class _SystemMetadata(ConnectorMetadata):
     def list_schemas(self):
-        return ["runtime"]
+        return ["metrics", "runtime"]
 
     def list_tables(self, schema: str):
-        return sorted(_TABLES) if schema == "runtime" else []
+        if schema == "runtime":
+            return sorted(_TABLES)
+        if schema == "metrics":
+            return ["metrics"]
+        return []
 
     def table_metadata(self, schema: str, table: str) -> TableMetadata:
-        if schema != "runtime" or table not in _TABLES:
+        if table not in self.list_tables(schema):
             raise KeyError(f"system table not found: {schema}.{table}")
         return TableMetadata(
             schema, table, tuple(ColumnMeta(n, t) for n, t in _TABLES[table])
@@ -175,10 +207,27 @@ class SystemConnector(Connector):
             return [
                 (
                     e["query_id"], e["state"], e["query"], e["create_time"],
-                    e["end_time"], e["rows"], e["error"],
+                    e["end_time"], e.get("wall_s"), e["rows"], e["error"],
+                    e.get("error_type"),
                 )
                 for e in hist.entries
             ]
+        if table == "spans":
+            out = []
+            for qid, spans in getattr(r, "traces", ()):
+                for s in spans:
+                    out.append(
+                        (
+                            s["query_id"] or qid, s["span_id"],
+                            s["parent_id"], s["name"], s["start_ms"],
+                            s["duration_ms"], s["attributes"],
+                        )
+                    )
+            return out
+        if table == "metrics":
+            from trino_tpu.telemetry import REGISTRY
+
+            return REGISTRY.rows()
         if table == "nodes":
             det = getattr(r, "failure_detector", None)
             if det is not None:

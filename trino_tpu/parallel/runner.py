@@ -45,7 +45,6 @@ bench JSON so mesh regressions are visible per fragment.
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 import jax
@@ -104,6 +103,7 @@ from trino_tpu.planner.fragmenter import (
 from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
 from trino_tpu.runtime.memory import batch_bytes
 from trino_tpu.runtime.query_stats import MeshProfile
+from trino_tpu.telemetry import now
 from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
 from trino_tpu.planner.functions import HOLISTIC_AGGS, PARTITIONABLE_HOLISTIC
 
@@ -242,11 +242,13 @@ class DistributedQueryRunner(LocalQueryRunner):
         dead = self.failure_detector.failed_workers()
         if dead:
             raise RuntimeError(f"workers failed heartbeat: {sorted(dead)}")
+        tr = self._tracer
         plan = self.plan_query(query)
-        sub = self.create_subplan(plan)
+        with tr.span("fragment"):
+            sub = self.create_subplan(plan)
         # EXPLAIN ANALYZE runs the SAME distributed path, with the profile
         # in blocking mode so per-phase times measure device work
-        profile = MeshProfile(blocking=stats is not None)
+        profile = MeshProfile(blocking=stats is not None, tracer=tr)
         executor = StageExecutor(
             self.catalogs, self.wm, self.properties,
             query_id=getattr(self, "_current_qid", "q"),
@@ -255,10 +257,11 @@ class DistributedQueryRunner(LocalQueryRunner):
         #: kept for tests / EXPLAIN evidence (dynamic filter pruning counts)
         self.last_stage_executor = executor
         self.last_mesh_profile = profile
-        host = executor.run(sub)
-        rows = []
-        for batch in host.stream:
-            rows.extend(tuple(r) for r in batch.to_pylist())
+        with tr.span("schedule"):
+            host = executor.run(sub)
+            rows = []
+            for batch in host.stream:
+                rows.extend(tuple(r) for r in batch.to_pylist())
         if stats is not None:
             stats.mesh_profile = profile
         return MaterializedResult(
@@ -338,16 +341,23 @@ class StageExecutor:
         prof = self.profile
         owner = self._current_fid if fid is None else fid
         r0 = TRACE_CACHE.retraces
-        t0 = time.perf_counter()
+        t0 = now()
         out = fn(*args)
         if prof.blocking:
             out = jax.block_until_ready(out)  # lint: allow(host-transfer)
-        dt = time.perf_counter() - t0
+        dt = now() - t0
         if TRACE_CACHE.retraces > r0:
             TRACE_CACHE.trace_s += dt
-            prof.add_phase(owner, "trace", dt)
+            booked = "trace"
         else:
-            prof.add_phase(owner, phase, dt)
+            booked = phase
+        prof.add_phase(owner, booked, dt)
+        tr = prof.tracer
+        if tr.enabled:
+            # child span per SPMD launch, carrying the phase attribution
+            tr.record(
+                "launch", t0, t0 + dt, {"phase": booked, "fragment": owner}
+            )
         if owner != self._current_fid:
             # cross-fragment attribution: move the wall with the phase so
             # BOTH fragments keep the phases-sum-to-wall invariant — the
@@ -394,11 +404,15 @@ class StageExecutor:
                 self.spool.close()
 
     def _finalize_profile(self) -> None:
+        from trino_tpu.telemetry.metrics import query_retraces_counter
+
         prof = self.profile
         h0, m0, r0 = self._trace_base
         prof.trace_hits = TRACE_CACHE.hits - h0
         prof.trace_misses = TRACE_CACHE.misses - m0
         prof.retraces = TRACE_CACHE.retraces - r0
+        if prof.retraces:
+            query_retraces_counter().inc(prof.retraces)
         for fid, sub in self._subplans.items():
             if fid in prof.fragments:
                 prof.fragments[fid].kind = str(sub.fragment.partitioning)
@@ -447,30 +461,34 @@ class StageExecutor:
         prev_fid = self._current_fid
         self._current_fid = fid
         self._frame_stack.append({"child_s": 0.0})
-        t0 = time.perf_counter()
+        t0 = now()
         try:
-            for _ in range(attempts):
-                try:
-                    FAILURE_INJECTOR.maybe_fail(f"stage:{fid}")
-                    if sub.fragment.partitioning.kind in _DIST_KINDS:
-                        res = self._exec(sub.fragment.root)
-                    else:
-                        out = self._local_fragment(sub)
-                        res = ("host", list(out.stream), out.symbols)
-                    # fires after the body ran (children memoized/spooled): a
-                    # failure here retries ONLY this stage
-                    FAILURE_INJECTOR.maybe_fail(f"stage:{fid}:finish")
-                    self._spool(fid, res)
-                    return res
-                except RETRYABLE as e:
-                    last = e
-            if not self.retry_task:
-                raise last  # keep the original (QUERY-level-retryable) error
-            raise StageFailedException(
-                f"stage {fid} failed after {attempts} attempts: {last}"
-            ) from last
+            with self.profile.tracer.span(
+                f"fragment-{fid}", kind=str(sub.fragment.partitioning)
+            ):
+                for _ in range(attempts):
+                    try:
+                        FAILURE_INJECTOR.maybe_fail(f"stage:{fid}")
+                        if sub.fragment.partitioning.kind in _DIST_KINDS:
+                            res = self._exec(sub.fragment.root)
+                        else:
+                            out = self._local_fragment(sub)
+                            res = ("host", list(out.stream), out.symbols)
+                        # fires after the body ran (children memoized/
+                        # spooled): a failure here retries ONLY this stage
+                        FAILURE_INJECTOR.maybe_fail(f"stage:{fid}:finish")
+                        self._spool(fid, res)
+                        return res
+                    except RETRYABLE as e:
+                        last = e
+                if not self.retry_task:
+                    # keep the original (QUERY-level-retryable) error
+                    raise last
+                raise StageFailedException(
+                    f"stage {fid} failed after {attempts} attempts: {last}"
+                ) from last
         finally:
-            elapsed = time.perf_counter() - t0
+            elapsed = now() - t0
             frame = self._frame_stack.pop()
             self.profile.fragment(fid).wall_s += elapsed - frame["child_s"]
             if self._frame_stack:

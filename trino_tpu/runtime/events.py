@@ -2,15 +2,57 @@
 eventlistener/EventListenerManager.java -> spi eventlistener plugins).
 
 Listeners receive QueryCreatedEvent / QueryCompletedEvent; failures carry the
-error.  The bundled LoggingEventListener mirrors trino-http-event-listener's
-role as the simplest sink.
+error string plus an error TYPE classification (USER_ERROR | INTERNAL_ERROR;
+reference role: spi ErrorCode/ErrorType), and completions carry a
+QueryStatistics payload (wall, phase totals, counters, peak memory — what
+EXPLAIN ANALYZE sees, reference: spi eventlistener QueryStatistics).  The
+bundled FileEventListener mirrors trino-http-event-listener's role as the
+simplest sink.
 """
 
 from __future__ import annotations
 
-import time
+import logging
 from dataclasses import dataclass, field
 from typing import Optional
+
+log = logging.getLogger("trino_tpu.events")
+
+#: error-type vocabulary (reference: spi ErrorType — the subset the engine
+#: distinguishes; resource/external classes fold into INTERNAL here)
+USER_ERROR = "USER_ERROR"
+INTERNAL_ERROR = "INTERNAL_ERROR"
+
+
+def classify_error(exc: BaseException) -> str:
+    """Exception -> error type.  Parse/analysis/semantic errors (the
+    engine raises them as ValueError subclasses — ParseError,
+    AnalysisError — plus KeyError for missing objects and
+    NotImplementedError for unsupported SQL) are the user's; everything
+    else is the engine's."""
+    if isinstance(exc, (ValueError, KeyError, NotImplementedError)):
+        return USER_ERROR
+    return INTERNAL_ERROR
+
+
+@dataclass
+class QueryStatistics:
+    """Per-query execution statistics delivered with QueryCompletedEvent
+    (reference: spi eventlistener QueryStatistics — listeners see what
+    EXPLAIN ANALYZE sees, machine-readable)."""
+
+    wall_s: float = 0.0
+    rows: int = 0
+    #: per-phase seconds summed over distributed fragments (empty for
+    #: purely local executions)
+    phase_totals_s: dict = field(default_factory=dict)
+    #: MeshProfile counters of the execution (empty when local)
+    counters: dict = field(default_factory=dict)
+    #: trace-cache hits/misses/retraces attributed to this query
+    trace_cache: dict = field(default_factory=dict)
+    peak_memory_bytes: int = 0
+    #: spans recorded by the query tracer (0 when tracing is off)
+    spans: int = 0
 
 
 @dataclass
@@ -29,6 +71,9 @@ class QueryCompletedEvent:
     end_time: float
     rows: int = 0
     error: Optional[str] = None
+    #: USER_ERROR | INTERNAL_ERROR when state == FAILED (classify_error)
+    error_type: Optional[str] = None
+    statistics: Optional[QueryStatistics] = None
 
     @property
     def wall_s(self) -> float:
@@ -46,23 +91,37 @@ class EventListener:
 class EventListenerManager:
     def __init__(self):
         self.listeners: list[EventListener] = []
+        #: (listener class name, event kind) pairs already warned about —
+        #: a broken audit sink logs ONE rate-limited warning per listener
+        #: class per event type instead of failing silently forever
+        self._warned: set = set()
 
     def add(self, listener: EventListener) -> None:
         self.listeners.append(listener)
 
-    def query_created(self, event: QueryCreatedEvent) -> None:
+    def _deliver(self, method: str, event) -> None:
         for l in self.listeners:
             try:
-                l.query_created(event)
+                getattr(l, method)(event)
             except Exception:
-                pass  # listeners must not break queries
+                # listeners must not break queries, but a dead sink must be
+                # VISIBLE: warn once per (listener class, event type)
+                key = (type(l).__name__, method)
+                if key not in self._warned:
+                    self._warned.add(key)
+                    log.warning(
+                        "event listener %s failed handling %s (suppressing "
+                        "further warnings for this listener/event pair)",
+                        type(l).__name__,
+                        method,
+                        exc_info=True,
+                    )
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        self._deliver("query_created", event)
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
-        for l in self.listeners:
-            try:
-                l.query_completed(event)
-            except Exception:
-                pass
+        self._deliver("query_completed", event)
 
 
 class FileEventListener(EventListener):
@@ -103,6 +162,7 @@ class FileEventListener(EventListener):
                 "wall_s": e.wall_s,
                 "rows": e.rows,
                 "error": e.error,
+                "error_type": e.error_type,
             }
         )
 

@@ -18,6 +18,9 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from trino_tpu.telemetry import NULL_TRACER, now
+from trino_tpu.telemetry.metrics import mesh_events_counter
+
 
 #: phase vocabulary of the mesh fragment profile (order = render order)
 MESH_PHASES = ("trace", "compute", "collective", "transfer", "other")
@@ -78,8 +81,12 @@ class MeshProfile:
     breakdown measures device time, not dispatch time — measurement mode
     only, it serializes the async pipeline."""
 
-    def __init__(self, blocking: bool = False):
+    def __init__(self, blocking: bool = False, tracer=NULL_TRACER):
         self.blocking = blocking
+        #: per-query span tracer (telemetry.spans): launch/transfer phases
+        #: recorded here are also emitted as child spans of the enclosing
+        #: fragment span; NULL_TRACER when tracing is off
+        self.tracer = tracer
         self.fragments: dict[int, FragmentStats] = {}
         #: query-wide event counters: host_gather (device->host exchanges),
         #: host_restack (host->device re-stacks BETWEEN fragments — zero on
@@ -97,20 +104,35 @@ class MeshProfile:
 
     def bump(self, counter: str, n: int = 1) -> None:
         self.counters[counter] = self.counters.get(counter, 0) + n
+        # single-home mirror: every mesh event also lands in the process
+        # metrics registry (served at /v1/metrics), labeled by counter name
+        mesh_events_counter().labels(counter).inc(n)
 
     @contextmanager
     def phase(self, fid: int, name: str):
         """Time a phase of fragment `fid` (caller blocks inside the window
         when self.blocking, so the phase measures device time)."""
-        t0 = time.perf_counter()
+        t0 = now()
         try:
             yield
         finally:
-            self.add_phase(fid, name, time.perf_counter() - t0)
+            t1 = now()
+            self.add_phase(fid, name, t1 - t0)
+            if self.tracer.enabled:
+                self.tracer.record(name, t0, t1, {"fragment": fid})
 
     def add_phase(self, fid: int, name: str, seconds: float) -> None:
         st = self.fragment(fid)
         st.phases[name] = st.phases.get(name, 0.0) + seconds
+
+    def phase_totals(self) -> dict:
+        """Query-wide per-phase seconds summed over fragments (the
+        QueryStatistics payload event listeners receive)."""
+        totals: dict[str, float] = {}
+        for st in self.fragments.values():
+            for k, v in st.phases.items():
+                totals[k] = totals.get(k, 0.0) + v
+        return {k: round(v, 6) for k, v in totals.items()}
 
     def render(self) -> str:
         lines = [

@@ -89,6 +89,20 @@ class LocalQueryRunner:
             sysconn = self.catalogs.get("system")
         if getattr(sysconn, "runner", None) is None:
             sysconn.runner = self
+        # telemetry: per-query span tracer (telemetry/spans; NULL when the
+        # query_trace session property is off) + recent trace history
+        # feeding system.runtime.spans and the coordinator trace endpoint
+        from collections import deque
+
+        from trino_tpu.telemetry import NULL_TRACER
+
+        self._tracer = NULL_TRACER
+        #: Chrome-trace/Perfetto JSON of the most recent traced query
+        self.last_trace = None
+        #: (query_id, flattened spans) ring buffer (system.runtime.spans)
+        self.traces = deque(maxlen=64)
+        #: peak device-memory reservation of the last local execution
+        self._last_peak_memory = 0
 
     @property
     def in_transaction(self) -> bool:
@@ -107,11 +121,14 @@ class LocalQueryRunner:
         return self.plan_query(stmt.query)
 
     def plan_query(self, query: ast.Query) -> OutputNode:
-        query = self._expand_recursive_ctes(query)
-        plan = LogicalPlanner(
-            self.catalogs, self.session, views=self.views
-        ).plan(query)
-        return self.optimize(plan)
+        tr = self._tracer
+        with tr.span("analyze"):
+            query = self._expand_recursive_ctes(query)
+            plan = LogicalPlanner(
+                self.catalogs, self.session, views=self.views
+            ).plan(query)
+        with tr.span("optimize"):
+            return self.optimize(plan)
 
     def optimize(self, plan: OutputNode) -> OutputNode:
         from trino_tpu.planner.optimizer import optimize
@@ -130,13 +147,23 @@ class LocalQueryRunner:
     def execute(self, sql: str) -> MaterializedResult:
         """Execute any supported statement (reference role: the statement
         dispatch of LocalQueryRunner.executeInternal + DDL *Task executors
-        under execution/), with query events and retry-policy handling."""
+        under execution/), with query events, telemetry (root span +
+        registry metrics + QueryStatistics payload), and retry-policy
+        handling."""
         import time as _time
 
-        from trino_tpu.runtime.events import QueryCompletedEvent, QueryCreatedEvent
+        from trino_tpu.runtime.events import (
+            QueryCompletedEvent,
+            QueryCreatedEvent,
+            classify_error,
+        )
         from trino_tpu.runtime.retry import execute_with_retry
-
         from trino_tpu.runtime.session import CURRENT_USER
+        from trino_tpu.telemetry import NULL_TRACER, SpanTracer
+        from trino_tpu.telemetry.metrics import (
+            queries_counter,
+            query_wall_histogram,
+        )
 
         self.access_control.check_can_execute_query(self.user)
         CURRENT_USER.set(self.user)
@@ -146,25 +173,86 @@ class LocalQueryRunner:
             raise NotImplementedError(f"statement: {type(stmt).__name__}")
         qid = f"query_{next(self._query_ids)}"
         self._current_qid = qid  # correlates events with executor/spool ids
+        tracer = (
+            SpanTracer(query_id=qid)
+            if self.properties.get("query_trace")
+            else NULL_TRACER
+        )
+        prev_tracer = self._tracer  # nested execute (EXECUTE stmt) restores
+        self._tracer = tracer
+        # stale-profile guard: only attribute a mesh profile to THIS query's
+        # statistics if the execution actually produced a fresh one; peak
+        # memory resets for the same reason (a failed or distributed query
+        # must not inherit the previous local execution's peak)
+        prof_before = getattr(self, "last_mesh_profile", None)
+        self._last_peak_memory = 0
         t0 = _time.time()
         self.events.query_created(QueryCreatedEvent(qid, sql, t0))
         try:
-            result = execute_with_retry(
-                lambda: m(stmt), self.properties.get("retry_policy")
-            )
+            with tracer.span("query", query_id=qid, sql=sql[:200]):
+                result = execute_with_retry(
+                    lambda: m(stmt), self.properties.get("retry_policy")
+                )
         except BaseException as e:
+            end = _time.time()
+            etype = classify_error(e)
+            queries_counter().labels("FAILED", etype).inc()
+            query_wall_histogram().observe(end - t0)
+            self._finish_trace(qid, tracer, prev_tracer)
             self.events.query_completed(
                 QueryCompletedEvent(
-                    qid, sql, "FAILED", t0, _time.time(), error=str(e)
+                    qid, sql, "FAILED", t0, end, error=str(e),
+                    error_type=etype,
+                    statistics=self._query_statistics(
+                        end - t0, 0, tracer, prof_before
+                    ),
                 )
             )
             raise
+        end = _time.time()
+        queries_counter().labels("FINISHED", "").inc()
+        query_wall_histogram().observe(end - t0)
+        self._finish_trace(qid, tracer, prev_tracer)
         self.events.query_completed(
             QueryCompletedEvent(
-                qid, sql, "FINISHED", t0, _time.time(), rows=result.row_count
+                qid, sql, "FINISHED", t0, end, rows=result.row_count,
+                statistics=self._query_statistics(
+                    end - t0, result.row_count, tracer, prof_before
+                ),
             )
         )
         return result
+
+    def _finish_trace(self, qid: str, tracer, prev_tracer) -> None:
+        """Export the finished query's spans (Chrome JSON + the flattened
+        history row feeding system.runtime.spans)."""
+        self._tracer = prev_tracer
+        if not tracer.enabled:
+            return
+        self.last_trace = tracer.to_chrome_trace()
+        self.traces.append((qid, tracer.flat_spans()))
+
+    def _query_statistics(self, wall_s: float, rows: int, tracer,
+                          prof_before=None):
+        """Build the QueryStatistics event payload from the execution's
+        telemetry (mesh profile when distributed, span count, peak
+        memory)."""
+        from trino_tpu.runtime.events import QueryStatistics
+
+        stats = QueryStatistics(wall_s=round(wall_s, 6), rows=rows)
+        prof = getattr(self, "last_mesh_profile", None)
+        if prof is not None and prof is not prof_before:
+            stats.phase_totals_s = prof.phase_totals()
+            stats.counters = dict(prof.counters)
+            stats.trace_cache = {
+                "hits": prof.trace_hits,
+                "misses": prof.trace_misses,
+                "retraces": prof.retraces,
+            }
+        stats.peak_memory_bytes = getattr(self, "_last_peak_memory", 0)
+        if tracer.enabled:
+            stats.spans = len(tracer.flat_spans())
+        return stats
 
     def _check_table_access(self, plan) -> None:
         """check_can_select for every scanned table (the reference checks in
@@ -308,15 +396,18 @@ class LocalQueryRunner:
         self._check_table_access(plan)
 
         def run() -> MaterializedResult:
-            physical = LocalExecutionPlanner(
-                self.catalogs,
-                target_splits=self.target_splits,
-                stats=stats,
-                properties=self.properties,
-            ).plan(plan)
-            rows = []
-            for batch in physical.stream:
-                rows.extend(tuple(r) for r in batch.to_pylist())
+            with self._tracer.span("execute"):
+                lp = LocalExecutionPlanner(
+                    self.catalogs,
+                    target_splits=self.target_splits,
+                    stats=stats,
+                    properties=self.properties,
+                )
+                physical = lp.plan(plan)
+                rows = []
+                for batch in physical.stream:
+                    rows.extend(tuple(r) for r in batch.to_pylist())
+                self._last_peak_memory = lp.memory.peak
             return MaterializedResult(
                 list(plan.column_names), rows, [s.type for s in plan.symbols]
             )
@@ -350,6 +441,17 @@ class LocalQueryRunner:
             collector = StatsCollector()
             self._run_query(inner.query, stats=collector)
             text = collector.render()
+            if stmt.verbose:
+                # VERBOSE: append the span tree + the Perfetto-loadable
+                # Chrome-trace JSON (one line, machine-extractable)
+                import json as _json
+
+                tr = self._tracer
+                text += "\n" + tr.render_text()
+                if tr.enabled:
+                    text += "\nTrace JSON: " + _json.dumps(
+                        tr.to_chrome_trace()
+                    )
         elif stmt.explain_type == "distributed":
             # fragments + partitioning handles, even from a local runner
             # (reference: EXPLAIN (TYPE DISTRIBUTED) -> PlanFragmenter)

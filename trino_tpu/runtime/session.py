@@ -118,6 +118,14 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "",
         ),
         PropertyMetadata(
+            "query_trace",
+            "per-query span tracing from admission through SPMD launches "
+            "(runner.last_trace / EXPLAIN ANALYZE VERBOSE / "
+            "GET /v1/query/{id}/trace; false = zero-overhead off)",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
             "pallas_agg",
             "use the Pallas MXU one-hot-matmul kernel for eligible "
             "small-domain float aggregations",

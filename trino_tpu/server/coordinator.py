@@ -35,21 +35,32 @@ class _Query:
         self.state = "QUEUED"
         self.result = None
         self.error: Optional[dict] = None
+        #: Chrome-trace/Perfetto JSON captured at completion (query_trace)
+        self.trace: Optional[dict] = None
         self.done = threading.Event()
 
     def run(self, runner) -> None:
         self.state = "RUNNING"
+        trace_before = getattr(runner, "last_trace", None)
         try:
             self.result = runner.execute(self.sql)
             self.state = "FINISHED"
         except Exception as e:  # surface as protocol error object
+            from trino_tpu.runtime.events import classify_error
+
             self.state = "FAILED"
             self.error = {
                 "message": str(e),
                 "errorName": type(e).__name__,
+                "errorType": classify_error(e),
                 "stack": traceback.format_exc(),
             }
         finally:
+            # span trace of THIS query (GET /v1/query/{id}/trace): the
+            # engine lock serializes executions, so a CHANGED last_trace is
+            # ours (unchanged = tracing off for this query, store nothing)
+            trace_after = getattr(runner, "last_trace", None)
+            self.trace = trace_after if trace_after is not trace_before else None
             self.done.set()
 
     def columns_json(self) -> list:
@@ -212,7 +223,44 @@ class CoordinatorServer:
                         self.end_headers()
                         self.wfile.write(body)
                         return
+                if self.path == "/v1/metrics":
+                    # Prometheus text exposition (telemetry/metrics)
+                    from trino_tpu.telemetry import REGISTRY
+
+                    body = REGISTRY.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 parts = self.path.strip("/").split("/")
+                # /v1/query/{id}/trace — Perfetto/Chrome-trace JSON
+                if (
+                    len(parts) == 4
+                    and parts[:2] == ["v1", "query"]
+                    and parts[3] == "trace"
+                ):
+                    q = server.query(parts[2])
+                    if q is None:
+                        return self._send(
+                            404, {"error": {"message": "no such query"}}
+                        )
+                    q.done.wait(timeout=1.0)
+                    if q.trace is None:
+                        return self._send(
+                            404,
+                            {
+                                "error": {
+                                    "message": "no trace for this query "
+                                    "(still running, or query_trace off)"
+                                }
+                            },
+                        )
+                    return self._send(200, q.trace)
                 # /v1/statement/executing/{id}/{token}
                 if len(parts) != 5 or parts[:3] != ["v1", "statement", "executing"]:
                     return self._send(404, {"error": {"message": "not found"}})

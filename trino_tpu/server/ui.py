@@ -44,6 +44,13 @@ def _stats(server) -> dict:
         pool = POOL.stats()
     except Exception:
         pass
+    trace_cache = {}
+    try:
+        from trino_tpu.parallel.spmd import TRACE_CACHE
+
+        trace_cache = TRACE_CACHE.stats()
+    except Exception:
+        pass
     workers = []
     fd = getattr(getattr(server, "runner", None), "failure_detector", None)
     if fd is not None:
@@ -58,6 +65,10 @@ def _stats(server) -> dict:
         "failedQueries": states.get("FAILED", 0),
         "activeWorkers": workers or ["local"],
         "bufferPool": pool,
+        # compiled-SPMD-program cache health (retraces must stay 0 warm);
+        # the full registry is the Prometheus text at /v1/metrics
+        "traceCache": trace_cache,
+        "metricsUri": "/v1/metrics",
     }
 
 
@@ -110,7 +121,9 @@ async function refresh() {
     `<span>running ${s.runningQueries}</span>` +
     `<span>queued ${s.queuedQueries}</span>` +
     `<span>finished ${s.finishedQueries}</span>` +
-    `<span>failed ${s.failedQueries}</span>`;
+    `<span>failed ${s.failedQueries}</span>` +
+    `<span>retraces ${(s.traceCache || {}).retraces ?? '-'}</span>` +
+    `<span><a href="/v1/metrics" style="color:#7fd4ff">metrics</a></span>`;
   const qs = await (await fetch('/ui/api/query')).json();
   const t = document.getElementById('queries');
   t.innerHTML = '<tr><th>id</th><th>state</th><th>sql</th></tr>' +

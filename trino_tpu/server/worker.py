@@ -166,6 +166,17 @@ class WorkerServer:
                 if parts == ["v1", "info"]:
                     self._bytes(200, b'{"state": "ACTIVE"}', "application/json")
                     return
+                if parts == ["v1", "metrics"]:
+                    # same Prometheus surface as the coordinator, so one
+                    # scrape config covers both tiers
+                    from trino_tpu.telemetry import REGISTRY
+
+                    self._bytes(
+                        200,
+                        REGISTRY.render_prometheus().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    return
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                     t = worker._tasks.get(parts[2])
                     if t is None:

@@ -393,6 +393,9 @@ class ExplainStatement(Node):
     statement: Node
     analyze: bool = False
     explain_type: str = "logical"  # logical | distributed
+    #: EXPLAIN ANALYZE VERBOSE: append the query's span trace (text tree +
+    #: Chrome-trace JSON) to the statistics rendering
+    verbose: bool = False
 
 
 @dataclass(frozen=True)

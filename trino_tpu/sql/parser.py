@@ -104,6 +104,11 @@ class Parser:
         if t.is_kw("explain"):
             self.next()
             analyze = self.accept_kw("analyze") is not None
+            # VERBOSE is a non-reserved word (an ident token, like the
+            # reference's non-reserved EXPLAIN option keywords)
+            verbose = analyze and self._peek_ident(0, "verbose")
+            if verbose:
+                self.next()
             # (TYPE DISTRIBUTED|LOGICAL) honored; other options accepted
             # and ignored (reference: SqlBase.g4 explainOption)
             explain_type = "logical"
@@ -123,7 +128,8 @@ class Parser:
                 if "type" in toks and "distributed" in toks:
                     explain_type = "distributed"
             return ast.ExplainStatement(
-                self._statement(), analyze=analyze, explain_type=explain_type
+                self._statement(), analyze=analyze, explain_type=explain_type,
+                verbose=verbose,
             )
         if t.is_kw("create") and self._peek_ident(1, "role"):
             self.next()

@@ -1,0 +1,38 @@
+"""Unified query telemetry: span tracer + process-wide metrics registry.
+
+Reference roles: the OpenTelemetry Tracer the reference threads from
+dispatch through exchange, QueryMonitor/QueryStatistics (the per-query
+stats payload event listeners receive), and the JMX/airlift metrics beans
+served here as Prometheus text at GET /v1/metrics.
+
+  * `spans` — per-query span trees (query -> analyze -> optimize ->
+    fragment -> schedule -> per-fragment SPMD launches), exportable as
+    Chrome-trace/Perfetto JSON; zero-overhead NULL_TRACER when off.
+  * `metrics` — counters/gauges/histograms registered once and bumped
+    everywhere; the single home for the engine's formerly scattered
+    counters (MeshProfile, trace cache, buffer pool).
+"""
+
+from trino_tpu.telemetry.metrics import (
+    REGISTRY,
+    CallbackGauge,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from trino_tpu.telemetry.spans import NULL_TRACER, NullTracer, Span, SpanTracer, now
+
+__all__ = [
+    "REGISTRY",
+    "CallbackGauge",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NULL_TRACER",
+    "NullTracer",
+    "Span",
+    "SpanTracer",
+    "now",
+]

@@ -1,0 +1,487 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Reference roles: the airlift metrics the reference exports over JMX
+(TaskManager/QueryManager stats beans) plus the jmx_exporter-style Prometheus
+text rendering; this module is the SINGLE home for the engine's formerly
+scattered counters (MeshProfile.counters, spmd.TRACE_CACHE hit/miss/retrace,
+buffer-pool bytes/hits, per-query wall histograms).
+
+Shape:
+
+  * `REGISTRY.counter/gauge/histogram(name, help, labelnames)` registers
+    once and returns the existing metric on re-registration — callers bump
+    without caring who registered;
+  * `gauge_fn` registers a PULL metric: a callback evaluated at
+    snapshot/render time (how TRACE_CACHE and the buffer pool surface
+    without import cycles or double bookkeeping);
+  * `render_prometheus()` emits the text exposition format served at
+    GET /v1/metrics on coordinator and worker;
+  * everything is host-side integers/floats — bumping a metric can never
+    introduce a device sync (the verify/residency contract).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional, Sequence
+
+_PREFIX = "trino_tpu_"
+
+#: default histogram buckets (seconds): query walls from sub-ms to minutes
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 120.0, 300.0,
+)
+
+
+#: guards per-metric series-dict RESIZE against concurrent scrapes: HTTP
+#: handler threads render /v1/metrics while the query thread bumps.  Bumping
+#: an EXISTING series never resizes its dict and stays lock-free (the hot
+#: path); only first-touch inserts and the scrape-side copies take the lock.
+_SERIES_LOCK = threading.Lock()
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _format_labels(labelnames: Sequence[str], labelvalues: Sequence) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape_label(v)}"' for n, v in zip(labelnames, labelvalues)
+    )
+    return "{" + inner + "}"
+
+
+def _format_value(v) -> str:
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        return repr(v)
+    return str(v)
+
+
+class _Child:
+    """One (metric, label values) series."""
+
+    __slots__ = ("metric", "labelvalues")
+
+    def __init__(self, metric: "Metric", labelvalues: tuple):
+        self.metric = metric
+        self.labelvalues = labelvalues
+
+    def inc(self, n=1) -> None:
+        self.metric._inc(self.labelvalues, n)
+
+    def set(self, v) -> None:
+        self.metric._set(self.labelvalues, v)
+
+    def observe(self, v) -> None:
+        self.metric._observe(self.labelvalues, v)
+
+    def value(self):
+        return self.metric.value(self.labelvalues)
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._values: dict = {}  # labelvalues tuple -> number
+
+    # -- label plumbing -------------------------------------------------------
+
+    def labels(self, *values, **kv) -> _Child:
+        if kv:
+            values = tuple(kv[n] for n in self.labelnames)
+        lv = tuple(str(v) for v in values)
+        if len(lv) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {lv}"
+            )
+        return _Child(self, lv)
+
+    # -- unlabeled shortcuts --------------------------------------------------
+
+    def inc(self, n=1) -> None:
+        self._inc((), n)
+
+    def set(self, v) -> None:
+        self._set((), v)
+
+    def observe(self, v) -> None:
+        self._observe((), v)
+
+    def value(self, labelvalues: tuple = ()):
+        return self._values.get(labelvalues, 0)
+
+    # -- storage (the engine runs one statement at a time, so bump-vs-bump
+    # needs no lock; _SERIES_LOCK covers resize-vs-scrape only) ---------------
+
+    def _inc(self, lv: tuple, n) -> None:
+        try:
+            self._values[lv] += n  # existing series: no resize, no lock
+        except KeyError:
+            with _SERIES_LOCK:
+                self._values[lv] = self._values.get(lv, 0) + n
+
+    def _set(self, lv: tuple, v) -> None:
+        if lv in self._values:
+            self._values[lv] = v  # overwrite: no resize, no lock
+            return
+        with _SERIES_LOCK:
+            self._values[lv] = v
+
+    def _observe(self, lv: tuple, v) -> None:
+        raise TypeError(f"{self.kind} metric {self.name} has no observe()")
+
+    def touch(self, *labelvalues) -> None:
+        """Pre-register a series at 0 so it renders before the first bump
+        ('registered once, bumped everywhere' — scrapes see the full
+        vocabulary, not just counters that happened to fire)."""
+        lv = tuple(str(v) for v in labelvalues)
+        with _SERIES_LOCK:
+            self._values.setdefault(lv, 0)
+
+    # -- export ---------------------------------------------------------------
+
+    def series(self) -> list:
+        """[(suffix, labelnames, labelvalues, value)] for rendering."""
+        with _SERIES_LOCK:
+            items = list(self._values.items())
+        return [("", self.labelnames, lv, v) for lv, v in sorted(items)]
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def _set(self, lv, v):
+        raise TypeError(f"counter {self.name} cannot be set(); use inc()")
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+
+class CallbackGauge(Metric):
+    """Pull-style metric: `fn` is evaluated at render/snapshot time and
+    returns either a scalar (unlabeled) or {labelvalues tuple: value}.
+    `kind_hint` lets a monotonically-increasing source render as a counter
+    (TRACE_CACHE.hits is a counter even though we read it by callback)."""
+
+    def __init__(self, name, help="", labelnames=(), fn: Callable = None,
+                 kind_hint: str = "gauge"):
+        super().__init__(name, help, labelnames)
+        self.fn = fn
+        self.kind = kind_hint
+
+    def _inc(self, lv, n):
+        raise TypeError(f"callback metric {self.name} is read-only")
+
+    _set = _inc
+
+    def series(self) -> list:
+        try:
+            out = self.fn()
+        except Exception:
+            return []
+        if not isinstance(out, dict):
+            return [("", self.labelnames, (), out)]
+        return [
+            ("", self.labelnames, tuple(str(x) for x in (lv if isinstance(lv, tuple) else (lv,))), v)
+            for lv, v in sorted(out.items())
+        ]
+
+    def value(self, labelvalues: tuple = ()):
+        for _, _, lv, v in self.series():
+            if lv == tuple(str(x) for x in labelvalues):
+                return v
+        return 0
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(), buckets=None):
+        super().__init__(name, help, labelnames)
+        bs = tuple(sorted(buckets if buckets is not None else DEFAULT_BUCKETS))
+        if not bs or bs[-1] != float("inf"):
+            bs = bs + (float("inf"),)
+        self.buckets = bs
+        # labelvalues -> [counts per bucket, sum, count]
+        self._obs: dict = {}
+
+    def _observe(self, lv: tuple, v) -> None:
+        st = self._obs.get(lv)
+        if st is None:
+            with _SERIES_LOCK:  # first observe for this series: dict insert
+                st = self._obs.setdefault(
+                    lv, [[0] * len(self.buckets), 0.0, 0]
+                )
+        counts, _, _ = st
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                counts[i] += 1
+                break
+        st[1] += v
+        st[2] += 1
+
+    def _inc(self, lv, n):
+        raise TypeError(f"histogram {self.name} has no inc(); use observe()")
+
+    _set = _inc
+
+    def value(self, labelvalues: tuple = ()):
+        st = self._obs.get(tuple(labelvalues))
+        return 0 if st is None else st[2]
+
+    def series(self) -> list:
+        out = []
+        with _SERIES_LOCK:
+            items = list(self._obs.items())
+        for lv, (counts, total, n) in sorted(items):
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                le = "+Inf" if math.isinf(b) else _format_value(float(b))
+                out.append(
+                    ("_bucket", self.labelnames + ("le",), lv + (le,), cum)
+                )
+            out.append(("_sum", self.labelnames, lv, total))
+            out.append(("_count", self.labelnames, lv, n))
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: OrderedDict[str, Metric] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def _register(self, cls, name: str, help: str, labelnames, **kw) -> Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise TypeError(
+                        f"metric {name} already registered as {m.kind}"
+                    )
+                return m
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def gauge_fn(self, name, help, fn, labelnames=(),
+                 kind_hint: str = "gauge") -> CallbackGauge:
+        return self._register(
+            CallbackGauge, name, help, labelnames, fn=fn, kind_hint=kind_hint
+        )
+
+    def histogram(self, name, help="", labelnames=(), buckets=None) -> Histogram:
+        return self._register(
+            Histogram, name, help, labelnames, buckets=buckets
+        )
+
+    def get(self, name: str) -> Optional[Metric]:
+        return self._metrics.get(name)
+
+    # -- export ---------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """{metric name (+series suffix/labels): value} — the flat form
+        bench.py records into BENCH_EXTRA.json and compare_bench.py diffs."""
+        out: dict = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            for suffix, lnames, lvalues, v in m.series():
+                key = m.name + suffix + _format_labels(lnames, lvalues)
+                out[key] = v
+        return out
+
+    def rows(self) -> list:
+        """[(name, kind, labels, value)] — the system.metrics table feed."""
+        out = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            for suffix, lnames, lvalues, v in m.series():
+                out.append(
+                    (
+                        m.name + suffix,
+                        m.kind,
+                        _format_labels(lnames, lvalues).strip("{}"),
+                        float(v),
+                    )
+                )
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format (served at GET /v1/metrics)."""
+        lines = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for suffix, lnames, lvalues, v in m.series():
+                lines.append(
+                    m.name
+                    + suffix
+                    + _format_labels(lnames, lvalues)
+                    + " "
+                    + _format_value(v)
+                )
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Drop all registered metrics (tests only)."""
+        with self._lock:
+            self._metrics.clear()
+        _register_engine_metrics(self)
+
+
+#: the process-wide registry (one engine process per host, like a worker JVM)
+REGISTRY = MetricsRegistry()
+
+
+# -- engine metric vocabulary -------------------------------------------------
+
+#: MeshProfile counter names pre-registered so /v1/metrics exposes the full
+#: vocabulary (exchange/speculation counters included) before any query runs;
+#: names track verify/residency.ALLOWED_COUNTERS plus the violation counters
+#: that must stay zero.
+MESH_COUNTER_NAMES = (
+    "host_restack",
+    "host_gather",
+    "result_gather",
+    "state_gather",
+    "scan_cache_hit",
+    "scan_cache_miss",
+    "scan_bucketize",
+    "dynamic_filter_sync",
+    "spool_read",
+    "spool_write",
+    "exchange_elided",
+    "repartition_collective",
+    "join_overflow_check",
+    "join_capacity_sync",
+    "join_speculative_retry",
+)
+
+
+def _trace_cache_series(stat: str):
+    def read():
+        from trino_tpu.parallel.spmd import TRACE_CACHE
+
+        return getattr(TRACE_CACHE, stat)
+
+    return read
+
+
+def _pool_series(stat_suffix: str):
+    def read():
+        from trino_tpu.runtime.buffer_pool import POOL
+
+        s = POOL.stats()
+        return {
+            ("host",): s[f"host_{stat_suffix}"],
+            ("device",): s[f"device_{stat_suffix}"],
+        }
+
+    return read
+
+
+def _register_engine_metrics(reg: MetricsRegistry) -> None:
+    """Register the engine-wide vocabulary once (import time + reset)."""
+    mesh = reg.counter(
+        _PREFIX + "mesh_events_total",
+        "mesh execution events by counter name (MeshProfile counters: "
+        "transfers, cache hits, exchange elision, speculation)",
+        labelnames=("counter",),
+    )
+    for name in MESH_COUNTER_NAMES:
+        mesh.touch(name)
+    completed = reg.counter(
+        _PREFIX + "queries_total",
+        "completed queries by state and error type",
+        labelnames=("state", "error_type"),
+    )
+    completed.touch("FINISHED", "")
+    completed.touch("FAILED", "USER_ERROR")
+    completed.touch("FAILED", "INTERNAL_ERROR")
+    reg.histogram(
+        _PREFIX + "query_wall_seconds",
+        "end-to-end statement wall time",
+    )
+    reg.counter(
+        _PREFIX + "query_retraces_total",
+        "SPMD retraces attributed to completed distributed queries "
+        "(bumped per query by the stage executor; zero warm)",
+    )
+    for stat, hint in (
+        ("hits", "counter"),
+        ("misses", "counter"),
+        ("retraces", "counter"),
+    ):
+        reg.gauge_fn(
+            _PREFIX + f"trace_cache_{stat}_total",
+            f"process-wide compiled-SPMD-program cache {stat}",
+            _trace_cache_series(stat),
+            kind_hint=hint,
+        )
+    reg.gauge_fn(
+        _PREFIX + "trace_cache_entries",
+        "live compiled programs in the trace cache",
+        _trace_cache_entries,
+    )
+    for suffix, help_txt in (
+        ("bytes", "buffer-pool resident bytes per tier"),
+        ("hits", "buffer-pool hits per tier"),
+        ("misses", "buffer-pool misses per tier"),
+    ):
+        reg.gauge_fn(
+            _PREFIX + f"buffer_pool_{suffix}",
+            help_txt,
+            _pool_series(suffix),
+            labelnames=("tier",),
+            kind_hint="counter" if suffix != "bytes" else "gauge",
+        )
+
+
+def _trace_cache_entries():
+    from trino_tpu.parallel.spmd import TRACE_CACHE
+
+    return TRACE_CACHE.stats()["entries"]
+
+
+def mesh_events_counter() -> Counter:
+    """The labeled mesh-event counter MeshProfile.bump mirrors into."""
+    return REGISTRY.counter(_PREFIX + "mesh_events_total")
+
+
+def queries_counter() -> Counter:
+    return REGISTRY.counter(_PREFIX + "queries_total")
+
+
+def query_retraces_counter() -> Counter:
+    return REGISTRY.counter(_PREFIX + "query_retraces_total")
+
+
+def query_wall_histogram() -> Histogram:
+    return REGISTRY.histogram(_PREFIX + "query_wall_seconds")
+
+
+_register_engine_metrics(REGISTRY)

@@ -260,6 +260,12 @@ def device_residency(
         raise ResidencyViolation(
             f"device residency violated for {sql!r}: " + "; ".join(problems)
         )
+    # telemetry contract: the report records whether span tracing was live
+    # during the verified replay — a residency pass with tracing_enabled
+    # proves the tracer added no host syncs (spans time host wall only)
+    props = getattr(runner, "properties", None)
+    tracing = bool(props is not None and props.get("query_trace"))
+    trace = getattr(runner, "last_trace", None)
     return {
         "sql": sql,
         "retraces": prof.retraces,
@@ -267,4 +273,6 @@ def device_residency(
         "trace_misses": prof.trace_misses,
         "counters": dict(prof.counters),
         "cache_keys_checked": auditor.checked if auditor else 0,
+        "tracing_enabled": tracing,
+        "spans": len(trace["traceEvents"]) if (tracing and trace) else 0,
     }
