@@ -1,0 +1,327 @@
+"""Chaos suite: injected task failures, latency spikes, flaky connections,
+and dead workers across the multi-host + FTE paths.
+
+The contract under test (the tentpole's acceptance bar): EVERY query either
+returns rows equal to the local runner or fails/cancels with a CLASSIFIED
+error before its deadline — never hangs, never returns wrong rows.
+
+Marked `slow` (excluded from tier-1): these tests run real HTTP workers and
+real injected latency.  The deterministic-clock halves of the machinery
+(state machine, breaker transitions, backoff schedule, memory-kill victim
+choice) run in tier-1 via tests/test_lifecycle.py.
+"""
+
+import time
+import urllib.request
+
+import pytest
+
+from tests.test_e2e import assert_rows_match
+from trino_tpu.parallel.remote import MultiHostQueryRunner
+from trino_tpu.runtime.lifecycle import (
+    QueryAbortedException,
+    QueryCanceledException,
+    QueryDeadlineExceeded,
+)
+from trino_tpu.runtime.retry import BREAKERS, FAILURE_INJECTOR, InjectedFailure
+from trino_tpu.runtime.runner import LocalQueryRunner
+from trino_tpu.server.worker import WorkerServer
+
+pytestmark = [pytest.mark.slow, pytest.mark.heavy]
+
+#: generous wall deadline: chaos queries must finish (or abort) well inside
+#: it — a hang is the one outcome this suite exists to forbid
+DEADLINE_S = 60.0
+
+
+@pytest.fixture(scope="module")
+def workers():
+    ws = [WorkerServer(port=0).start() for _ in range(2)]
+    yield ws
+    for w in ws:
+        w.shutdown()
+
+
+@pytest.fixture(scope="module")
+def local():
+    return LocalQueryRunner(catalog="tpch", schema="tiny")
+
+
+@pytest.fixture()
+def mh(workers):
+    r = MultiHostQueryRunner(
+        [w.url for w in workers], catalog="tpch", schema="tiny"
+    )
+    r.properties.set("query_max_run_time", DEADLINE_S)
+    return r
+
+
+@pytest.fixture(autouse=True)
+def clean_chaos():
+    FAILURE_INJECTOR.clear()
+    BREAKERS.reset()
+    yield
+    FAILURE_INJECTOR.clear()
+    BREAKERS.reset()
+
+
+QUERIES = [
+    # source fragment + gather
+    "select count(*), sum(l_quantity) from lineitem",
+    # hash-partitioned aggregation over an exchange
+    "select l_returnflag, count(*), sum(l_extendedprice) "
+    "from lineitem group by l_returnflag",
+    # partitioned join (both sides repartition on the key hash)
+    "select count(*) from lineitem, orders where l_orderkey = o_orderkey",
+]
+
+#: (injection point pattern, mode, times) — the sweep axis.  Points cover
+#: task submission and the HTTP pull data plane (result pulls AND worker
+#: input pulls share the `fetch:` hook).
+INJECTIONS = [
+    ("submit:", "flap", 1),
+    ("submit:", "flap", 2),
+    ("submit:", "error", 1),
+    ("fetch:", "flap", 1),
+    ("fetch:", "flap", 3),
+    ("fetch:", "error", 1),
+    ("fetch:", "latency", 1),
+]
+
+
+def _run_bounded(mh, local, sql):
+    """The chaos contract: rows == local, or a classified error, and either
+    way the query resolves well before its deadline."""
+    t0 = time.monotonic()
+    try:
+        got = mh.execute(sql).rows
+    except (QueryAbortedException, RuntimeError, OSError) as e:
+        # classified abort, or a task/worker failure the engine surfaced
+        # loudly — acceptable; silence and wrong rows are not
+        assert str(e), "failure must carry a message"
+        return time.monotonic() - t0, None
+    wall = time.monotonic() - t0
+    assert_rows_match(got, local.execute(sql).rows, ordered=False)
+    return wall, got
+
+
+@pytest.mark.parametrize("point,mode,times", INJECTIONS)
+def test_chaos_sweep_multihost(mh, local, point, mode, times):
+    """Sweep failure/latency/flaky-connection injections across the
+    multi-host path: every query matches local or fails classified — and
+    resolves inside the deadline either way."""
+    for sql in QUERIES:
+        FAILURE_INJECTOR.clear()
+        BREAKERS.reset()
+        if mode == "flap":
+            FAILURE_INJECTOR.inject_connection_flap(point, times=times)
+        elif mode == "latency":
+            FAILURE_INJECTOR.inject_latency(point, delay_s=0.5, times=times)
+        else:
+            FAILURE_INJECTOR.inject(point, times=times, error=InjectedFailure)
+        wall, got = _run_bounded(mh, local, sql)
+        assert wall < DEADLINE_S, f"{point}/{mode} blew the deadline on {sql}"
+        if mode in ("flap", "latency"):
+            # transient chaos must be ABSORBED (retry w/ backoff, task
+            # replacement), not surfaced: rows equal local
+            assert got is not None, f"{point}/{mode}({times}) failed {sql}"
+
+
+def test_chaos_latency_spike_absorbed(mh, local):
+    """A one-off latency spike on the data plane stalls but does not break
+    or mis-answer the query."""
+    FAILURE_INJECTOR.inject_latency("fetch:", delay_s=1.0, times=1)
+    sql = QUERIES[1]
+    wall, got = _run_bounded(mh, local, sql)
+    assert got is not None and wall < DEADLINE_S
+
+
+def test_chaos_deadline_cuts_off_stalled_query(mh, local):
+    """With the data plane stalled past query_max_run_time, the query fails
+    CLASSIFIED (EXCEEDED_TIME_LIMIT) promptly after the stall — it neither
+    hangs nor burns the full injected latency budget."""
+    mh.properties.set("query_max_run_time", 0.5)
+    FAILURE_INJECTOR.inject_latency("fetch:", delay_s=1.0, times=50)
+    t0 = time.monotonic()
+    with pytest.raises(QueryDeadlineExceeded) as ei:
+        mh.execute(QUERIES[0])
+    wall = time.monotonic() - t0
+    mh.properties.set("query_max_run_time", DEADLINE_S)
+    assert ei.value.error_code == "EXCEEDED_TIME_LIMIT"
+    assert wall < 15.0, "deadline abort must not drain the whole stall budget"
+    # the engine recovered: a clean follow-up query runs normally
+    FAILURE_INJECTOR.clear()
+    wall, got = _run_bounded(mh, local, QUERIES[0])
+    assert got is not None
+
+
+def test_chaos_dead_worker_breaker_opens_and_queries_survive(local):
+    """A worker that dies keeps failing its probes/submits: the per-worker
+    circuit breaker trips OPEN (visible in system.runtime.metrics) and
+    queries keep answering correctly from the surviving workers."""
+    ws = [WorkerServer(port=0).start() for _ in range(3)]
+    victim = ws[2]
+    try:
+        mh = MultiHostQueryRunner(
+            [w.url for w in ws], catalog="tpch", schema="tiny"
+        )
+        mh.properties.set("query_max_run_time", DEADLINE_S)
+        victim.shutdown()
+        for sql in QUERIES:
+            # fresh probe evidence each query (the TTL cache would hide
+            # the repeated failures the breaker needs to see)
+            mh._worker_health.clear()
+            wall, got = _run_bounded(mh, local, sql)
+            assert got is not None and wall < DEADLINE_S
+        states = BREAKERS.states()
+        assert states.get(victim.url) == "open", states
+        # the OPEN breaker is queryable as a labeled gauge (the system
+        # catalog is coordinator-resident: query it through the local
+        # runner — the breaker registry is process-wide)
+        rows = local.execute(
+            "select labels, value from system.runtime.metrics "
+            "where name = 'trino_tpu_breaker_state'"
+        ).rows
+        assert any(victim.url in labels and value == 2.0
+                   for labels, value in rows), rows
+    finally:
+        for w in ws:
+            try:
+                w.shutdown()
+            except Exception:
+                pass
+
+
+def test_chaos_worker_task_cancel_is_real(workers):
+    """DELETE /v1/task/{id} aborts a RUNNING task at its next cooperative
+    check instead of letting it burn the slot to completion."""
+    from trino_tpu.server.worker import _http_get
+
+    # no deadline on the descriptor: the long-poll would wait RESULT_WAIT_S
+    url = workers[0].url
+    with urllib.request.urlopen(f"{url}/v1/info", timeout=5.0) as r:
+        r.read()
+    # a task id that was never submitted: DELETE must still answer 200
+    req = urllib.request.Request(f"{url}/v1/task/never_there", method="DELETE")
+    with urllib.request.urlopen(req, timeout=5.0) as r:
+        assert r.status == 200
+
+
+def test_chaos_coordinator_delete_cancels_running_query(workers, local):
+    """DELETE /v1/query/{id} is a REAL cancel: the running statement aborts
+    at its next cooperative check, shows CANCELED on the protocol, and the
+    engine survives to run the next query."""
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    mh = MultiHostQueryRunner(
+        [w.url for w in workers], catalog="tpch", schema="tiny"
+    )
+    server = CoordinatorServer(runner=mh, port=0)
+    server.start()
+    try:
+        # stall the data plane so the query is mid-flight when DELETE lands
+        FAILURE_INJECTOR.inject_latency("fetch:", delay_s=1.5, times=10)
+        q = server.submit(QUERIES[0])
+        time.sleep(0.3)  # let the executor enter the stalled fetch
+        req = urllib.request.Request(
+            f"http://{server.host}:{server.port}/v1/query/{q.id}",
+            method="DELETE",
+        )
+        with urllib.request.urlopen(req, timeout=5.0) as r:
+            assert r.status == 204
+        assert q.done.wait(timeout=30.0), "canceled query must terminate"
+        assert q.state == "CANCELED"
+        assert q.error["errorCode"] == "USER_CANCELED"
+        assert q.error["errorType"] == "USER_ERROR"
+        # the engine is healthy afterwards
+        FAILURE_INJECTOR.clear()
+        q2 = server.submit("select count(*) from region")
+        assert q2.done.wait(timeout=30.0) and q2.state == "FINISHED"
+        # the query history records the CANCELED state + kill reason (the
+        # system catalog is coordinator-resident — read it directly rather
+        # than distributing a system scan to the workers)
+        entries = [
+            (e["state"], e["error_code"]) for e in mh.query_history.entries
+        ]
+        assert ("CANCELED", "USER_CANCELED") in entries
+    finally:
+        server.shutdown()
+
+
+def test_chaos_coordinator_delete_while_queued(workers):
+    """A DELETE racing statement submission cancels the query BEFORE it
+    occupies the engine (cancel-while-queued)."""
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    mh = MultiHostQueryRunner(
+        [w.url for w in workers], catalog="tpch", schema="tiny"
+    )
+    server = CoordinatorServer(runner=mh, port=0)
+    server.start()
+    try:
+        FAILURE_INJECTOR.inject_latency("fetch:", delay_s=1.0, times=5)
+        q1 = server.submit(QUERIES[0])  # occupies the engine lock
+        q2 = server.submit(QUERIES[1])  # queued behind it
+        q2.cancel()
+        assert q2.done.wait(timeout=30.0) or q2.state == "QUEUED"
+        assert q1.done.wait(timeout=30.0)
+        assert q2.done.wait(timeout=30.0)
+        assert q2.state == "CANCELED"
+    finally:
+        server.shutdown()
+
+
+def test_chaos_fte_stage_failures_and_latency(local):
+    """The in-mesh FTE path (retry_policy=TASK, spooled stages) under the
+    new injection modes: stage failures + latency spikes retry/absorb and
+    the answer still equals local."""
+    from trino_tpu.parallel import DistributedQueryRunner
+
+    r = DistributedQueryRunner(n_workers=8)
+    r.properties.set("retry_policy", "TASK")
+    r.properties.set("query_max_run_time", DEADLINE_S)
+    sql = (
+        "select l_returnflag, count(*) c, sum(l_quantity) q "
+        "from lineitem group by l_returnflag order by l_returnflag"
+    )
+    FAILURE_INJECTOR.inject("stage:", times=2, error=InjectedFailure)
+    FAILURE_INJECTOR.inject_latency("stage:", delay_s=0.3, times=2)
+    t0 = time.monotonic()
+    got = r.execute(sql).rows
+    wall = time.monotonic() - t0
+    assert got == local.execute(sql).rows
+    assert wall < DEADLINE_S
+
+
+def test_chaos_cancel_inmesh_mid_query():
+    """Cooperative cancellation on the in-mesh SPMD path: a cancel armed
+    between fragment launches aborts the query with CANCELED classification
+    instead of finishing it."""
+    from trino_tpu.parallel import DistributedQueryRunner
+
+    r = DistributedQueryRunner(n_workers=8)
+    cancel_after = {"n": 2}
+    orig = FAILURE_INJECTOR.maybe_fail
+
+    def cancel_hook(point):
+        if point.startswith("stage:"):
+            cancel_after["n"] -= 1
+            if cancel_after["n"] == 0:
+                ctx = __import__(
+                    "trino_tpu.runtime.lifecycle", fromlist=["current_query"]
+                ).current_query()
+                if ctx is not None:
+                    ctx.cancel("chaos cancel")
+        return orig(point)
+
+    FAILURE_INJECTOR.maybe_fail = cancel_hook
+    try:
+        with pytest.raises(QueryCanceledException):
+            r.execute(
+                "select count(*) from lineitem, orders "
+                "where l_orderkey = o_orderkey"
+            )
+    finally:
+        FAILURE_INJECTOR.maybe_fail = orig
+    # the engine survives: the next statement runs clean
+    assert r.execute("select count(*) from region").rows == [(5,)]

@@ -1,0 +1,758 @@
+"""Query lifecycle hardening: state machine, deadlines, cooperative
+cancellation, low-memory kill, backoff + circuit breakers, and the
+robustness satellites (resource-group timeout race, spool GC, the
+raw-http-timeout lint rule).
+
+Everything here runs on DETERMINISTIC clocks / rngs / sleeps — no real
+waits — so the whole file stays inside the tier-1 budget.  The multi-host
+injection sweeps (real HTTP workers, real latency) live in test_chaos.py
+behind the `slow` marker.
+"""
+
+import threading
+
+import pytest
+
+from trino_tpu.runtime import lifecycle
+from trino_tpu.runtime.lifecycle import (
+    CANCELED,
+    FAILED,
+    FINISHED,
+    FINISHING,
+    QUEUED,
+    RUNNING,
+    InvalidStateTransition,
+    LowMemoryKiller,
+    QueryCanceledException,
+    QueryContext,
+    QueryDeadlineExceeded,
+    QueryKilledException,
+    QueryTracker,
+)
+from trino_tpu.runtime.retry import (
+    BREAKERS,
+    FAILURE_INJECTOR,
+    Backoff,
+    CircuitBreaker,
+    CircuitBreakerRegistry,
+    FailureInjector,
+    InjectedFailure,
+    execute_with_retry,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeSleep:
+    def __init__(self):
+        self.calls: list = []
+
+    def __call__(self, s: float) -> None:
+        self.calls.append(s)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    FAILURE_INJECTOR.clear()
+    BREAKERS.reset()
+    yield
+    FAILURE_INJECTOR.clear()
+    BREAKERS.reset()
+
+
+# -- state machine ------------------------------------------------------------
+
+
+def test_state_machine_happy_path():
+    ctx = QueryContext("q1")
+    assert ctx.state == QUEUED
+    ctx.begin()
+    assert ctx.state == RUNNING
+    ctx.finishing()
+    assert ctx.state == FINISHING
+    ctx.transition(FINISHED)
+    assert ctx.done
+
+
+def test_state_machine_rejects_illegal_transitions():
+    ctx = QueryContext("q1")
+    with pytest.raises(InvalidStateTransition):
+        ctx.transition(FINISHED)  # QUEUED cannot jump to FINISHED
+    ctx.begin()
+    ctx.transition(FAILED)
+    # terminal states are frozen
+    for to in (RUNNING, FINISHED, CANCELED):
+        with pytest.raises(InvalidStateTransition):
+            ctx.transition(to)
+
+
+def test_fail_maps_cancel_to_canceled_state():
+    ctx = QueryContext("q1")
+    ctx.begin()
+    assert ctx.fail(QueryCanceledException("x")) == CANCELED
+    ctx2 = QueryContext("q2")
+    ctx2.begin()
+    assert ctx2.fail(RuntimeError("boom")) == FAILED
+    # fail() on an already-terminal query does not move it
+    assert ctx2.fail(QueryCanceledException("late")) == CANCELED
+    assert ctx2.state == FAILED
+
+
+# -- deadlines + cancellation token -------------------------------------------
+
+
+def test_deadline_enforced_by_check():
+    clock = FakeClock()
+    ctx = QueryContext("q1", max_run_time_s=10.0, clock=clock)
+    ctx.check()  # inside the deadline: no-op
+    clock.advance(10.5)
+    with pytest.raises(QueryDeadlineExceeded, match="query_max_run_time"):
+        ctx.check()
+    assert ctx.kill_reason == "deadline"
+
+
+def test_planning_deadline_separate_from_run_deadline():
+    clock = FakeClock()
+    ctx = QueryContext(
+        "q1", max_run_time_s=100.0, max_planning_time_s=5.0, clock=clock
+    )
+    clock.advance(6.0)
+    ctx.check()  # run deadline (100s) still fine
+    with pytest.raises(QueryDeadlineExceeded, match="query_max_planning_time"):
+        ctx.check_planning()
+
+
+def test_cancel_aborts_at_next_check_and_first_reason_wins():
+    ctx = QueryContext("q1")
+    ctx.kill("memory", detail="killed by the low-memory killer")
+    ctx.cancel()  # later reason must NOT overwrite the kill
+    assert ctx.kill_reason == "memory"
+    with pytest.raises(QueryKilledException, match="low-memory killer"):
+        ctx.check()
+
+
+def test_cancel_fans_out_to_registered_tasks():
+    canceled = []
+
+    class FakeTask:
+        def __init__(self, n):
+            self.n = n
+
+        def cancel(self):
+            canceled.append(self.n)
+
+    ctx = QueryContext("q1")
+    ctx.register_task(FakeTask(1))
+    ctx.register_task(FakeTask(2))
+    ctx.cancel()
+    assert sorted(canceled) == [1, 2]
+    # registering onto an armed context still lets a later abort sweep it
+    ctx.register_task(FakeTask(3))
+    ctx.cancel_tasks()
+    assert sorted(canceled) == [1, 2, 3]
+
+
+def test_http_timeout_derives_from_remaining_deadline():
+    clock = FakeClock()
+    ctx = QueryContext("q1", max_run_time_s=10.0, clock=clock)
+    assert ctx.http_timeout(600.0) == pytest.approx(10.0)
+    clock.advance(7.0)
+    assert ctx.http_timeout(600.0) == pytest.approx(3.0)
+    assert ctx.http_timeout(1.0) == pytest.approx(1.0)  # default still caps
+    clock.advance(5.0)  # deadline passed: the request would be pointless
+    with pytest.raises(QueryDeadlineExceeded):
+        ctx.http_timeout(600.0)
+    # unbounded queries keep the default
+    assert QueryContext("q2").http_timeout(600.0) == 600.0
+
+
+def test_request_timeout_uses_contextvar():
+    assert lifecycle.request_timeout(42.0) == 42.0  # no executing query
+    clock = FakeClock()
+    ctx = QueryContext("q1", max_run_time_s=5.0, clock=clock)
+    token = lifecycle.set_current(ctx)
+    try:
+        assert lifecycle.request_timeout(600.0) == pytest.approx(5.0)
+    finally:
+        lifecycle.reset_current(token)
+    assert lifecycle.request_timeout(600.0) == 600.0
+
+
+def test_result_wait_bounded_by_task_deadline():
+    from trino_tpu.server.worker import RESULT_WAIT_S, TaskDescriptor, _Task
+
+    def task(deadline):
+        return _Task(
+            TaskDescriptor(
+                task_id="t", fragment_root=None, output_symbols=(),
+                inputs={}, deadline_s=deadline,
+            )
+        )
+
+    from trino_tpu.server.worker import _result_wait_s
+
+    assert _result_wait_s(task(None)) == RESULT_WAIT_S
+    assert _result_wait_s(task(5.0)) == pytest.approx(5.0, abs=0.5)
+    assert _result_wait_s(task(10_000.0)) == RESULT_WAIT_S
+    assert _result_wait_s(task(0.0)) == 0.001  # already expired: don't hang
+    # the bound SHRINKS as the task ages: a late re-fetch must not pin a
+    # server thread past the query's death
+    t = task(5.0)
+    t.lifecycle.clock = lambda: t.lifecycle.created_at + 4.0
+    assert _result_wait_s(t) == pytest.approx(1.0)
+    t.lifecycle.clock = lambda: t.lifecycle.created_at + 99.0
+    assert _result_wait_s(t) == 0.001
+
+
+# -- tracker ------------------------------------------------------------------
+
+
+def test_tracker_reads_session_properties():
+    from trino_tpu.runtime.session import SessionProperties
+
+    clock = FakeClock()
+    props = SessionProperties()
+    props.set("query_max_run_time", 30)
+    props.set("query_max_planning_time", 5)
+    tracker = QueryTracker(clock=clock)
+    ctx = tracker.create("q1", props)
+    assert ctx.deadline == pytest.approx(clock.t + 30)
+    assert ctx.planning_deadline == pytest.approx(clock.t + 5)
+    assert tracker.get("q1") is ctx
+    tracker.remove(ctx)
+    assert tracker.get("q1") is None
+
+
+def test_tracker_cancel_live_and_precancel_queued():
+    tracker = QueryTracker()
+    ctx = tracker.create("q1")
+    assert tracker.cancel("q1") is True
+    with pytest.raises(QueryCanceledException):
+        ctx.check()
+    # unknown id: pre-cancel — the query aborts the moment it registers
+    assert tracker.cancel("q_future") is False
+    late = tracker.create("q_future")
+    with pytest.raises(QueryCanceledException, match="before execution"):
+        late.check()
+
+
+# -- error classification -----------------------------------------------------
+
+
+def test_lifecycle_errors_classify_before_generic_rules():
+    from trino_tpu.runtime.events import classify_error
+    from trino_tpu.runtime.memory import ExceededMemoryLimitException
+
+    assert classify_error(QueryCanceledException("x")) == "USER_ERROR"
+    assert classify_error(QueryDeadlineExceeded("x")) == "RESOURCE_ERROR"
+    assert classify_error(QueryKilledException("x")) == "RESOURCE_ERROR"
+    assert classify_error(ExceededMemoryLimitException("x")) == "RESOURCE_ERROR"
+    assert classify_error(ValueError("x")) == "USER_ERROR"
+    assert classify_error(RuntimeError("x")) == "INTERNAL_ERROR"
+
+
+# -- backoff ------------------------------------------------------------------
+
+
+def test_backoff_full_jitter_schedule():
+    import random
+
+    b = Backoff(base_s=0.1, cap_s=1.0, rng=random.Random(7), sleep=FakeSleep())
+    for attempt in range(8):
+        ceiling = min(1.0, 0.1 * 2**attempt)
+        for _ in range(50):
+            d = b.delay(attempt)
+            assert 0.0 <= d <= ceiling
+
+
+def test_backoff_wait_uses_injected_sleep():
+    import random
+
+    sleep = FakeSleep()
+    b = Backoff(base_s=0.5, cap_s=4.0, rng=random.Random(3), sleep=sleep)
+    total = sum(b.wait(k) for k in range(5))
+    assert sleep.calls and total == pytest.approx(b.total_wait_s)
+    with pytest.raises(ValueError):
+        Backoff(base_s=0.0)
+
+
+# -- execute_with_retry -------------------------------------------------------
+
+
+def test_retry_validates_attempts_and_backs_off():
+    sleep = FakeSleep()
+    backoff = Backoff(base_s=0.1, sleep=sleep)
+    with pytest.raises(ValueError, match="max_attempts"):
+        execute_with_retry(lambda: 1, "QUERY", max_attempts=0)
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise InjectedFailure("boom")
+        return "ok"
+
+    assert (
+        execute_with_retry(flaky, "QUERY", max_attempts=4, backoff=backoff)
+        == "ok"
+    )
+    assert calls["n"] == 3
+    assert len(sleep.calls) == 2  # each retry waited
+
+
+def test_retry_never_reruns_aborted_queries():
+    calls = {"n": 0}
+
+    def canceled():
+        calls["n"] += 1
+        raise QueryCanceledException("user said stop")
+
+    with pytest.raises(QueryCanceledException):
+        execute_with_retry(canceled, "QUERY", max_attempts=4)
+    assert calls["n"] == 1  # an abort is not transient
+
+
+def test_retry_exhaustion_raises_last_error():
+    sleep = FakeSleep()
+
+    def always():
+        raise InjectedFailure("persistent")
+
+    with pytest.raises(InjectedFailure, match="persistent"):
+        execute_with_retry(
+            always, "QUERY", max_attempts=3, backoff=Backoff(sleep=sleep)
+        )
+    assert len(sleep.calls) == 2
+
+
+# -- circuit breakers ---------------------------------------------------------
+
+
+def test_breaker_trips_after_consecutive_failures():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=3, cooldown_s=5.0, clock=clock)
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed" and b.allow()
+    b.record_success()  # success resets the consecutive count
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()  # third consecutive: trip
+    assert b.state == "open" and not b.allow()
+
+
+def test_breaker_half_open_probe_then_close_or_reopen():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=clock)
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    clock.advance(5.1)
+    assert b.allow()  # cooldown over: ONE half-open probe
+    assert b.state == "half_open"
+    assert not b.allow()  # second request held while the probe is out
+    b.record_failure()  # probe failed: re-open, cooldown restarts
+    assert b.state == "open" and not b.allow()
+    clock.advance(5.1)
+    assert b.allow()
+    b.record_success()  # probe succeeded: closed, traffic resumes
+    assert b.state == "closed" and b.allow()
+    with pytest.raises(ValueError):
+        CircuitBreaker(failure_threshold=0)
+
+
+def test_breaker_registry_states_surface_in_metrics():
+    from trino_tpu.telemetry.metrics import REGISTRY
+
+    reg = CircuitBreakerRegistry(failure_threshold=1, clock=FakeClock())
+    assert reg.get("http://w1") is reg.get("http://w1")
+
+    # the process-wide registry feeds the trino_tpu_breaker_state gauge
+    for _ in range(3):
+        BREAKERS.get("http://w9").record_failure()
+    rows = [
+        r for r in REGISTRY.rows() if r[0] == "trino_tpu_breaker_state"
+    ]
+    assert any("http://w9" in r[2] and r[3] == 2.0 for r in rows), rows
+    text = REGISTRY.render_prometheus()
+    assert "trino_tpu_breaker_state" in text
+
+
+# -- low-memory killer --------------------------------------------------------
+
+
+class _Owner:
+    def __init__(self):
+        self.kills: list = []
+
+    def kill(self, reason, detail=None):
+        self.kills.append((reason, detail))
+
+
+def _killer_pool(limit=1000):
+    from trino_tpu.runtime.memory import MemoryPool
+
+    pool = MemoryPool()
+    pool.root.limit_bytes = limit
+    pool.root.on_exceeded = LowMemoryKiller()
+    return pool
+
+
+def test_killer_shoots_largest_reservation_not_requester():
+    from trino_tpu.telemetry.metrics import memory_kills_counter
+
+    before = memory_kills_counter().value()
+    pool = _killer_pool(1000)
+    big = pool.query_context("big")
+    big.owner = _Owner()
+    small = pool.query_context("small")
+    small.owner = _Owner()
+    big.add_bytes(800)
+    small.add_bytes(100)
+    small.add_bytes(300)  # would exceed: the killer frees `big`, we retry
+    assert big.owner.kills and big.owner.kills[0][0] == "memory"
+    assert not small.owner.kills
+    assert big.reserved == 0 and big.parent is None  # detached
+    assert pool.root.reserved == 400
+    assert memory_kills_counter().value() == before + 1
+    # the victim aborts at its next cooperative check
+    ctx = QueryContext("big")
+    ctx.kill("memory", detail="killed by the low-memory killer")
+    with pytest.raises(QueryKilledException):
+        ctx.check()
+
+
+def test_killer_never_shoots_smaller_bystander():
+    from trino_tpu.runtime.memory import ExceededMemoryLimitException
+
+    pool = _killer_pool(1000)
+    big = pool.query_context("big")
+    big.owner = _Owner()
+    small = pool.query_context("small")
+    small.owner = _Owner()
+    small.add_bytes(100)
+    big.add_bytes(800)
+    # the requester already holds the largest reservation: failing ITS
+    # reservation is the kill — the smaller bystander survives
+    with pytest.raises(ExceededMemoryLimitException):
+        big.add_bytes(500)
+    assert not small.owner.kills and not big.owner.kills
+    assert pool.root.reserved == 900  # failed reservation fully rolled back
+
+
+def test_force_release_detaches_subtree_from_pool():
+    pool = _killer_pool(0)
+    q = pool.query_context("q")
+    op = q.child("op")
+    op.add_bytes(500)
+    assert pool.root.reserved == 500
+    q.force_release()
+    assert pool.root.reserved == 0 and q not in pool.root.query_children
+    # a late operator close() from the dying query cannot corrupt the pool
+    op.close()
+    assert pool.root.reserved == 0
+
+
+def test_per_query_budget_still_propagates_to_requester():
+    """A per-query limit (no killer hook at that node) keeps raising to the
+    operator — that exception is the wave/spill fallback's signal."""
+    from trino_tpu.runtime.memory import ExceededMemoryLimitException, MemoryPool
+
+    ctx = MemoryPool().query_context("q", limit_bytes=100)
+    with pytest.raises(ExceededMemoryLimitException):
+        ctx.add_bytes(200)
+
+
+# -- runner integration -------------------------------------------------------
+
+
+@pytest.fixture()
+def runner():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    return LocalQueryRunner()
+
+
+def test_query_max_run_time_aborts_with_classified_event(runner):
+    from trino_tpu.runtime.events import CollectingEventListener
+
+    listener = CollectingEventListener()
+    runner.events.add(listener)
+    runner.properties.set("query_max_run_time", 1e-9)
+    with pytest.raises(QueryDeadlineExceeded):
+        runner.execute("select count(*) from region")
+    runner.properties.set("query_max_run_time", 0)
+    done = listener.completed[-1]
+    assert done.state == "FAILED"
+    assert done.error_type == "RESOURCE_ERROR"
+    assert done.error_code == "EXCEEDED_TIME_LIMIT"
+    # the engine recovered: the next statement runs normally
+    assert runner.execute("select count(*) from region").rows == [(5,)]
+
+
+def test_query_max_planning_time_property(runner):
+    runner.properties.set("query_max_planning_time", 1e-9)
+    with pytest.raises(QueryDeadlineExceeded, match="planning"):
+        runner.execute("select count(*) from region")
+    runner.properties.set("query_max_planning_time", 0)
+
+
+def test_cancel_surfaces_as_canceled_query(runner):
+    from trino_tpu.runtime.events import CollectingEventListener
+
+    listener = CollectingEventListener()
+    runner.events.add(listener)
+    # the coordinator attaches its cancel surface through this hook; firing
+    # it immediately models DELETE racing query start
+    runner._query_context_cb = lambda ctx: ctx.cancel("canceled by test")
+    with pytest.raises(QueryCanceledException):
+        runner.execute("select count(*) from region")
+    done = listener.completed[-1]
+    assert done.state == "CANCELED"
+    assert done.error_type == "USER_ERROR"
+    assert done.error_code == "USER_CANCELED"
+
+
+def test_system_runtime_queries_shows_kill_reason(runner):
+    runner.properties.set("query_max_run_time", 1e-9)
+    with pytest.raises(QueryDeadlineExceeded):
+        runner.execute("select 1")
+    runner.properties.set("query_max_run_time", 0)
+    rows = runner.execute(
+        "select state, error_type, error_code from system.runtime.queries "
+        "where error_code is not null"
+    ).rows
+    assert ("FAILED", "RESOURCE_ERROR", "EXCEEDED_TIME_LIMIT") in rows
+
+
+def test_tracker_registry_cleans_up_after_statement(runner):
+    runner.execute("select 1")
+    assert runner.query_tracker.live() == []
+
+
+# -- failure injector: latency + connection-flap modes ------------------------
+
+
+def test_injector_latency_mode_uses_injectable_sleep():
+    sleep = FakeSleep()
+    inj = FailureInjector(sleep=sleep)
+    inj.inject_latency("fetch", 0.7, times=2)
+    inj.maybe_fail("fetch:w1")
+    inj.maybe_fail("fetch:w2")
+    inj.maybe_fail("fetch:w3")  # budget exhausted: no stall
+    assert sleep.calls == [0.7, 0.7]
+    assert inj.visits["fetch:w1"] == 1
+    inj.clear()
+    assert inj.sleep is sleep  # clear() keeps the constructor's sleep
+
+
+def test_injector_connection_flap_raises_retryable():
+    from trino_tpu.runtime.retry import RETRYABLE
+
+    inj = FailureInjector()
+    inj.inject_connection_flap("http", times=1)
+    with pytest.raises(ConnectionResetError):
+        inj.maybe_fail("http:w1")
+    inj.maybe_fail("http:w1")  # second call passes
+    assert isinstance(ConnectionResetError("x"), RETRYABLE)
+
+
+# -- resource group timeout race (satellite) ----------------------------------
+
+
+def test_resource_group_timeout_raises_and_leaks_no_slot():
+    from trino_tpu.runtime.resource_groups import (
+        ResourceGroup,
+        ResourceGroupConfig,
+    )
+
+    g = ResourceGroup(ResourceGroupConfig("t", hard_concurrency=1))
+    g.acquire()
+    with pytest.raises(TimeoutError):
+        g.acquire(timeout=0.01)
+    assert len(g.queued) == 0  # the timed-out gate left the queue
+    g.release()
+    g.acquire(timeout=0.01)  # the slot is free again: no leak
+    g.release()
+
+
+def test_resource_group_timeout_grant_race_hands_slot_onward():
+    """REGRESSION: a waiter whose wait() times out just as release() signals
+    its gate must hand the granted slot to the next waiter (or back to the
+    pool) and still raise TimeoutError — not silently absorb the slot."""
+    from trino_tpu.runtime.resource_groups import (
+        ResourceGroup,
+        ResourceGroupConfig,
+    )
+
+    enqueued = threading.Event()
+    released = threading.Event()
+
+    class RacingGate(threading.Event):
+        """wait() 'times out' only AFTER release() has signaled the gate —
+        the exact interleaving of the race, made deterministic."""
+
+        def wait(self, timeout=None):
+            enqueued.set()
+            released.wait(timeout=5.0)
+            return False  # simulate: the timeout fired despite the grant
+
+    class RacingGroup(ResourceGroup):
+        def _make_gate(self):
+            return RacingGate()
+
+    g = RacingGroup(ResourceGroupConfig("t", hard_concurrency=1))
+    g.acquire()  # main holds the only slot
+
+    result: dict = {}
+
+    def waiter():
+        try:
+            g.acquire(timeout=0.01)
+            result["outcome"] = "admitted"
+        except TimeoutError:
+            result["outcome"] = "timeout"
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert enqueued.wait(timeout=5.0)
+    g.release()  # pops the waiter's gate and grants it the slot...
+    released.set()  # ...but the waiter's wait() already expired
+    t.join(timeout=5.0)
+    assert result["outcome"] == "timeout"
+    # the granted slot was handed onward, not leaked: the group is idle
+    # and a fresh acquire succeeds without any release
+    assert g.running == 0 and len(g.queued) == 0
+    g.acquire(timeout=0.01)
+    g.release()
+
+
+# -- spool GC (satellite) -----------------------------------------------------
+
+
+def test_spool_gc_sweeps_orphans_by_age(tmp_path):
+    import os
+
+    from trino_tpu.runtime.fte import SpoolManager
+
+    d = tmp_path / "spool"
+    d.mkdir()
+    now = 1_000_000.0
+    old = d / "q_dead_f0.npz"
+    old.write_bytes(b"x")
+    os.utime(old, (now - 7200, now - 7200))
+    fresh = d / "q_live_f1.npz"
+    fresh.write_bytes(b"x")
+    os.utime(fresh, (now - 60, now - 60))
+    foreign = d / "not_a_spool.txt"
+    foreign.write_bytes(b"keep me")
+    os.utime(foreign, (now - 7200, now - 7200))
+
+    # construction on a SHARED directory sweeps orphans past the age bound
+    sm = SpoolManager(str(d), orphan_max_age_s=3600, clock=lambda: now)
+    assert not old.exists()
+    assert fresh.exists()
+    assert foreign.exists()  # never touch files the spool didn't write
+
+    # explicit entry point: tighter bound removes the remaining file
+    removed = sm.gc(max_age_s=30)
+    assert [p.endswith("q_live_f1.npz") for p in removed] == [True]
+    assert not fresh.exists() and foreign.exists()
+
+
+def test_spool_close_still_cleans_owned_directory():
+    from trino_tpu.runtime.fte import SpoolManager
+
+    sm = SpoolManager()  # owns a fresh temp dir: no GC needed, none run
+    import os
+
+    assert os.path.isdir(sm.dir)
+    sm.close()
+    assert not os.path.isdir(sm.dir)
+
+
+# -- raw-http-timeout lint rule (satellite) -----------------------------------
+
+
+def _lint_snippet(tmp_path, rel, source):
+    import tools.lint_tpu as lint
+
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return lint.lint_file(str(p))
+
+
+def test_lint_flags_timeout_literals_in_http_tier(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        "trino_tpu/server/thing.py",
+        "import urllib.request\n"
+        "def f(req):\n"
+        "    return urllib.request.urlopen(req, timeout=600)\n",
+    )
+    assert [f.rule for f in findings] == ["raw-http-timeout"]
+
+
+def test_lint_accepts_derived_and_named_timeouts(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        "trino_tpu/server/thing.py",
+        "import urllib.request\n"
+        "from trino_tpu.runtime.lifecycle import request_timeout\n"
+        "WAIT_S = 600.0\n"
+        "def f(req, t):\n"
+        "    urllib.request.urlopen(req, timeout=request_timeout(WAIT_S))\n"
+        "    urllib.request.urlopen(req, timeout=WAIT_S)\n"
+        "    t.done.wait(timeout=WAIT_S)\n",
+    )
+    assert findings == []
+
+
+def test_lint_timeout_rule_suppressible_and_path_scoped(tmp_path):
+    # explicit suppression works like every other rule
+    findings = _lint_snippet(
+        tmp_path,
+        "trino_tpu/server/thing.py",
+        "import urllib.request\n"
+        "def f(req):\n"
+        "    return urllib.request.urlopen(req, timeout=5)"
+        "  # lint: allow(raw-http-timeout)\n",
+    )
+    assert findings == []
+    # device code is NOT subject to the http rule (and server code is not
+    # subject to the device rules — host transfers are legal there)
+    findings = _lint_snippet(
+        tmp_path,
+        "trino_tpu/ops/thing.py",
+        "def f(ev):\n    ev.wait(timeout=600)\n",
+    )
+    assert findings == []
+    findings = _lint_snippet(
+        tmp_path,
+        "trino_tpu/server/thing.py",
+        "import jax\ndef f(x):\n    return jax.device_get(x)\n",
+    )
+    assert findings == []
+
+
+def test_http_tier_is_clean_under_the_timeout_rule():
+    import os
+
+    import tools.lint_tpu as lint
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = lint.run_lint(
+        ["trino_tpu/server", "trino_tpu/parallel/remote.py"], root=root
+    )
+    assert findings == [], [str(f) for f in findings]

@@ -21,6 +21,14 @@ pays.  This linter walks `trino_tpu/ops/`, `trino_tpu/parallel/`, and
                     | use `trino_tpu.telemetry.now` (the shared clock spans
                     | and MeshProfile phases read) so wall attribution
                     | stays comparable across the telemetry surfaces
+  raw-http-timeout  | `timeout=<number>` literals in the HTTP tier
+                    | (trino_tpu/server/ + parallel/remote.py) — socket
+                    | waits must derive from the query deadline
+                    | (`lifecycle.request_timeout`) or a named constant
+
+Rules are path-scoped: device rules run over ops/parallel/expr;
+raw-http-timeout runs over trino_tpu/server/ and parallel/remote.py (and
+only that rule runs over server/ — host transfers are legal there).
 
 Suppression: append `# lint: allow(<rule>)` (comma-separate several rules,
 or `allow(*)` for all) to the offending line or to the enclosing `def` /
@@ -46,7 +54,14 @@ import sys
 from dataclasses import dataclass
 
 #: directories holding device code (paths relative to the repo root)
-DEFAULT_PATHS = ("trino_tpu/ops", "trino_tpu/parallel", "trino_tpu/expr")
+DEFAULT_PATHS = (
+    "trino_tpu/ops",
+    "trino_tpu/parallel",
+    "trino_tpu/expr",
+    # HTTP tier: linted ONLY for raw-http-timeout (see _rules_for_path) —
+    # host transfers are legal there, hardcoded socket timeouts are not
+    "trino_tpu/server",
+)
 
 RULES = {
     "host-sync-item": ".item() blocks on a device->host transfer",
@@ -57,7 +72,26 @@ RULES = {
     "untyped-symbol": "Symbol constructed without a type",
     "raw-perf-counter": "raw time.perf_counter() phase timing outside "
                         "telemetry/ and query_stats.py",
+    "raw-http-timeout": "hardcoded timeout literal on an intra-cluster "
+                        "call — derive it from the query deadline "
+                        "(lifecycle.request_timeout) or a named constant",
 }
+
+#: rules that only make sense in device code (ops/parallel/expr)
+_DEVICE_RULES = frozenset(RULES) - {"raw-http-timeout"}
+#: the HTTP tier: every socket wait must be bounded by what the query has
+#: left to live (runtime/lifecycle.request_timeout), so numeric timeout
+#: literals are flagged here (reference: HttpRemoteTask deriving every
+#: request deadline from the query's remaining time)
+_HTTP_PATHS = ("trino_tpu/server/", "trino_tpu/parallel/remote.py")
+
+
+def _rules_for_path(path: str) -> frozenset:
+    p = path.replace(os.sep, "/")
+    http = any(h in p for h in _HTTP_PATHS)
+    if "trino_tpu/server/" in p:
+        return frozenset({"raw-http-timeout"})
+    return frozenset(RULES) if http else _DEVICE_RULES
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
 
@@ -94,10 +128,12 @@ def _contains_jnp(node: ast.AST) -> bool:
 
 
 class _Linter(ast.NodeVisitor):
-    def __init__(self, path: str, source: str):
+    def __init__(self, path: str, source: str, rules=None):
         self.path = path
         self.findings: list[Finding] = []
         self.allow = _allowances(source)
+        #: rules enabled for this file (path-scoped; None = all)
+        self.rules = frozenset(RULES) if rules is None else frozenset(rules)
         #: stack of (def/class line, end line) carrying def-level allowances
         self._scopes: list[tuple[int, int]] = []
 
@@ -111,7 +147,7 @@ class _Linter(ast.NodeVisitor):
         return False
 
     def _flag(self, rule: str, node: ast.AST, message: str) -> None:
-        if not self._allowed(rule, node.lineno):
+        if rule in self.rules and not self._allowed(rule, node.lineno):
             self.findings.append(
                 Finding(self.path, node.lineno, rule, message)
             )
@@ -193,6 +229,23 @@ class _Linter(ast.NodeVisitor):
                 "`now` from trino_tpu.telemetry (the shared span/profile "
                 "clock) instead",
             )
+        # timeout=<numeric literal> on an intra-cluster call: socket waits
+        # in the HTTP tier must shrink with the query's remaining run time
+        # (runtime/lifecycle.request_timeout) or at minimum come from a
+        # named module constant reviewers can reason about in one place
+        for kw in node.keywords:
+            if (
+                kw.arg == "timeout"
+                and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, (int, float))
+                and not isinstance(kw.value.value, bool)
+            ):
+                self._flag(
+                    "raw-http-timeout", node,
+                    f"hardcoded timeout={kw.value.value!r}; derive the bound "
+                    "from the query deadline (lifecycle.request_timeout) or "
+                    "a named constant",
+                )
         # Symbol("name") without a type
         if (
             (isinstance(fn, ast.Name) and fn.id == "Symbol")
@@ -221,7 +274,7 @@ def lint_file(path: str) -> list:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [Finding(path, e.lineno or 0, "syntax-error", str(e))]
-    linter = _Linter(path, source)
+    linter = _Linter(path, source, rules=_rules_for_path(path))
     linter.visit(tree)
     return linter.findings
 

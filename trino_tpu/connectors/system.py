@@ -57,6 +57,7 @@ class QueryHistory(EventListener):
             "rows": None,
             "error": None,
             "error_type": None,
+            "error_code": None,
         }
         self._running[e.query_id] = row
         self.entries.append(row)
@@ -72,6 +73,9 @@ class QueryHistory(EventListener):
         row["rows"] = e.rows
         row["error"] = e.error
         row["error_type"] = getattr(e, "error_type", None)
+        # lifecycle kill reason (USER_CANCELED | EXCEEDED_TIME_LIMIT |
+        # CLUSTER_OUT_OF_MEMORY) — why a query stopped, not just that it did
+        row["error_code"] = getattr(e, "error_code", None)
         row["wall_s"] = e.wall_s
 
 
@@ -86,6 +90,7 @@ _TABLES = {
         ("rows", T.BIGINT),
         ("error", T.VARCHAR),
         ("error_type", T.VARCHAR),
+        ("error_code", T.VARCHAR),
     ],
     "spans": [
         ("query_id", T.VARCHAR),
@@ -208,7 +213,7 @@ class SystemConnector(Connector):
                 (
                     e["query_id"], e["state"], e["query"], e["create_time"],
                     e["end_time"], e.get("wall_s"), e["rows"], e["error"],
-                    e.get("error_type"),
+                    e.get("error_type"), e.get("error_code"),
                 )
                 for e in hist.entries
             ]

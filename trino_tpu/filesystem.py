@@ -44,6 +44,11 @@ class FileSystem:
     def list(self, prefix: str) -> Iterable[str]:
         raise NotImplementedError
 
+    def mtime(self, path: str) -> float:
+        """Last-modified time, epoch seconds (spool GC ages files by it).
+        Raises OSError when the path vanished."""
+        raise NotImplementedError
+
     def mkdirs(self, path: str) -> None:
         raise NotImplementedError
 
@@ -87,6 +92,9 @@ class LocalFileSystem(FileSystem):
         return sorted(
             os.path.join(prefix, n) for n in os.listdir(prefix)
         )
+
+    def mtime(self, path: str) -> float:
+        return os.path.getmtime(path)
 
     def mkdirs(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)

@@ -35,11 +35,48 @@ from trino_tpu.planner.fragmenter import (
     add_exchanges,
     create_subplans,
 )
+from trino_tpu.runtime import lifecycle
+from trino_tpu.runtime.lifecycle import (
+    CANCEL_TIMEOUT_S,
+    PROBE_TIMEOUT_S,
+    SUBMIT_TIMEOUT_S,
+    QueryAbortedException,
+    check_current,
+)
 from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
+from trino_tpu.runtime.retry import BREAKERS, FAILURE_INJECTOR, RETRYABLE, Backoff
 from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
 from trino_tpu.server.worker import TaskDescriptor, _http_get
 
 _DIST = (SOURCE, FIXED_HASH, FIXED_ARBITRARY)
+
+#: transient-submit retry budget against one worker before it is declared
+#: dead and the task moves on (REFUSED/RESET skips the retries — that
+#: worker is definitively gone)
+SUBMIT_ATTEMPTS = 3
+
+
+def _is_refused(exc: BaseException) -> bool:
+    """REFUSED = nothing is listening on the socket — the one failure shape
+    where retrying the same worker is pointless (vs RESET/timeouts, which
+    flaky networks produce on perfectly healthy workers)."""
+    if isinstance(exc, ConnectionRefusedError):
+        return True
+    return isinstance(exc, urllib.error.URLError) and isinstance(
+        exc.reason, ConnectionRefusedError
+    )
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Connection-shaped failures worth a backed-off retry against the SAME
+    worker (vs HTTPError = the worker answered; its task failed)."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return False
+    if isinstance(exc, RETRYABLE):
+        return True
+    if isinstance(exc, urllib.error.URLError):
+        return isinstance(exc.reason, (ConnectionError, TimeoutError, OSError))
+    return isinstance(exc, OSError)
 
 
 class RemoteTaskClient:
@@ -52,6 +89,7 @@ class RemoteTaskClient:
     def submit(self, desc: TaskDescriptor) -> None:
         from trino_tpu.server.worker import cluster_secret, sign_body
 
+        FAILURE_INJECTOR.maybe_fail(f"submit:{self.worker_url}")
         body = pickle.dumps(desc, protocol=pickle.HIGHEST_PROTOCOL)
         headers = {}
         secret = cluster_secret()
@@ -60,7 +98,9 @@ class RemoteTaskClient:
         req = urllib.request.Request(
             f"{self.worker_url}/v1/task", data=body, headers=headers, method="POST"
         )
-        with urllib.request.urlopen(req, timeout=60) as r:
+        with urllib.request.urlopen(
+            req, timeout=lifecycle.request_timeout(SUBMIT_TIMEOUT_S)
+        ) as r:
             r.read()
 
     def state(self) -> str:
@@ -79,7 +119,7 @@ class RemoteTaskClient:
             f"{self.worker_url}/v1/task/{self.task_id}", method="DELETE"
         )
         try:
-            with urllib.request.urlopen(req, timeout=10) as r:
+            with urllib.request.urlopen(req, timeout=CANCEL_TIMEOUT_S) as r:
                 r.read()
         except Exception:
             pass
@@ -122,6 +162,7 @@ class MultiHostQueryRunner(LocalQueryRunner):
         out = _StageScheduler(self).run(sub)
         rows = []
         for batch in out.stream:
+            check_current()  # cancel/deadline between result batches
             rows.extend(tuple(r) for r in batch.to_pylist())
         return MaterializedResult(
             list(plan.column_names), rows, [s.type for s in plan.symbols]
@@ -169,23 +210,40 @@ class _StageScheduler:
     PROBE_TTL_S = 15.0
 
     def _alive(self, url: str) -> bool:
-        """Liveness = the socket answers.  Only a REFUSED/RESET connection is
-        definitive death; a slow probe (single-core box, a worker thread
-        holding the GIL inside an XLA compile) is BUSY, not dead — treating
-        it as dead cascades into blacklisting the whole cluster
-        (reference: HeartbeatFailureDetector's grace semantics).  Verdicts
-        cache on the runner so healthy clusters pay no per-query probes."""
+        """Liveness = the socket answers AND the worker's circuit breaker
+        admits traffic.  Only a REFUSED/RESET connection is definitive
+        death; a slow probe (single-core box, a worker thread holding the
+        GIL inside an XLA compile) is BUSY, not dead — treating it as dead
+        cascades into blacklisting the whole cluster (reference:
+        HeartbeatFailureDetector's grace semantics).  Verdicts cache on the
+        runner so healthy clusters pay no per-query probes; an OPEN breaker
+        overrides the cache (repeated request failures are fresher evidence
+        than a stale probe), and its half-open window forces a REAL probe
+        whose outcome closes or re-opens it."""
         if url in self._dead:
             return False
+        from trino_tpu.runtime.retry import BREAKER_HALF_OPEN
+
+        breaker = BREAKERS.get(url)
+        if not breaker.allow():
+            return False  # open: hold traffic until the half-open window
         import time as _time
 
         now = _time.monotonic()
         cached = self.runner._worker_health.get(url)
-        if cached is not None and now - cached[0] < self.PROBE_TTL_S:
-            ok = cached[1]
+        if (
+            breaker.state != BREAKER_HALF_OPEN
+            and cached is not None
+            and now - cached[0] < self.PROBE_TTL_S
+        ):
+            ok = cached[1]  # cache hit: no new evidence for the breaker
         else:
             ok = self._probe(url)
             self.runner._worker_health[url] = (now, ok)
+            if ok:
+                breaker.record_success()
+            else:
+                breaker.record_failure()
         if not ok:
             self._dead.add(url)
         return ok
@@ -193,7 +251,9 @@ class _StageScheduler:
     @staticmethod
     def _probe(url: str) -> bool:
         try:
-            with urllib.request.urlopen(f"{url}/v1/info", timeout=5.0) as r:
+            with urllib.request.urlopen(
+                f"{url}/v1/info", timeout=PROBE_TIMEOUT_S
+            ) as r:
                 r.read()
             return True
         except Exception as exc:
@@ -227,22 +287,48 @@ class _StageScheduler:
         urls = [preferred] + [u for u in self.workers if u != preferred]
         last: Optional[Exception] = None
         for url in urls:
+            check_current()  # canceled queries stop scheduling work
             if url in self._dead:
                 continue
+            breaker = BREAKERS.get(url)
+            if not breaker.allow():
+                continue  # breaker open: this worker is cooling down
             client = RemoteTaskClient(url, desc.task_id)
-            try:
-                client.submit(desc)
-                self._descs[desc.task_id] = desc
-                return client
-            except Exception as exc:
-                last = exc
-                if self._is_conn_dead(exc):
-                    import time as _time
+            backoff = Backoff(base_s=0.05, cap_s=1.0)
+            submitted = False
+            for attempt in range(SUBMIT_ATTEMPTS):
+                if attempt:
+                    backoff.wait(attempt - 1)
+                try:
+                    client.submit(desc)
+                    submitted = True
+                    break
+                except QueryAbortedException:
+                    raise  # lifecycle abort: stop scheduling entirely
+                except Exception as exc:
+                    last = exc
+                    if _is_refused(exc):
+                        breaker.record_failure()
+                        break  # REFUSED: nobody listening, don't retry
+                    if _is_transient(exc) or self._is_conn_dead(exc):
+                        # flaky connection (RESET included): a backed-off
+                        # retry against the SAME worker absorbs it — one
+                        # flap must not blacklist a healthy worker
+                        breaker.record_failure()
+                        continue
+                    raise  # a real error must not masquerade as dead
+            if not submitted:
+                import time as _time
 
-                    self._dead.add(url)  # worker gone: try the next one
-                    self.runner._worker_health[url] = (_time.monotonic(), False)
-                    continue
-                raise  # a real error must not masquerade as a dead worker
+                self._dead.add(url)  # worker gone: try the next one
+                self.runner._worker_health[url] = (_time.monotonic(), False)
+                continue
+            breaker.record_success()
+            self._descs[desc.task_id] = desc
+            # abort propagation: the executing query cancels this task if
+            # it is killed (RemoteTaskClient.cancel fan-out)
+            lifecycle.register_task(client)
+            return client
         raise RuntimeError(f"no live worker accepted {desc.task_id}: {last}")
 
     def _replace_task(self, fid: int, idx: int):
@@ -312,6 +398,11 @@ class _StageScheduler:
             return self._stage_tasks[fid]
         w = len(self.workers)
         tasks = []
+        # tasks inherit what's left of the query deadline: a worker bounds
+        # its own run AND its input-pull timeouts by it, so no task outlives
+        # the query that scheduled it (HttpRemoteTask deadline derivation)
+        qctx = lifecycle.current_query()
+        deadline_s = qctx.remaining_s() if qctx is not None else None
         for i, url in enumerate(self.workers):
             desc = TaskDescriptor(
                 task_id=f"t{next(self.runner._task_seq)}_f{fid}_w{i}",
@@ -323,6 +414,7 @@ class _StageScheduler:
                 properties=dict(self.runner.properties._values),
                 dynamic_ranges=dict(self._pending_ranges.get(fid, {})),
                 collect_ranges=fid in self._want_ranges,
+                deadline_s=deadline_s,
             )
             tasks.append(self._submit_on_live(desc, url))
         self._stage_tasks[fid] = tasks
@@ -388,14 +480,19 @@ class _StageScheduler:
             if isinstance(t, _LocalResult):
                 return {}
             try:
-                # the /dynamic endpoint blocks on task completion itself
+                # the /dynamic endpoint blocks on task completion itself;
+                # the state poll sits INSIDE the try too — a transient flap
+                # on either request must degrade to "no dynamic filter",
+                # never fail the query
                 body = _http_get(
                     f"{t.worker_url}/v1/task/{t.task_id}/dynamic"
                 )
                 ranges = _json.loads(body.decode())
+                if t.state() != "FINISHED":
+                    return {}
+            except QueryAbortedException:
+                raise  # canceled/expired is not an optimization miss
             except Exception:
-                return {}
-            if t.state() != "FINISHED":
                 return {}
             for name, (lo, hi) in ranges.items():
                 if name in merged:
@@ -485,6 +582,8 @@ class _StageScheduler:
                 for i, t in enumerate(list(producers)):
                     try:
                         bs = bytes_to_batches(_fetch_ok(t))
+                    except QueryAbortedException:
+                        raise  # canceled/expired: stop, don't reschedule
                     except Exception:
                         # worker died (or its task failed) after submission:
                         # reassign to a live worker and re-read
@@ -551,12 +650,43 @@ def _take_host(batch, idx):
     return Batch(cols, np.ones(len(idx), bool))
 
 
-def _fetch_ok(task: RemoteTaskClient) -> bytes:
-    """Fetch bucket 0, surfacing worker-side failures."""
-    try:
-        return _http_get(task.result_url(0))
-    except urllib.error.HTTPError as e:
-        raise RuntimeError(
-            f"task {task.task_id} failed on {task.worker_url}: "
-            f"{e.read().decode()[:2000]}"
-        ) from None
+#: transient-fetch retry budget against the SAME worker before the caller
+#: falls back to task replacement (a flaky connection is absorbed here; a
+#: dead worker exhausts it fast and reschedules)
+FETCH_ATTEMPTS = 3
+
+
+def _fetch_ok(task: RemoteTaskClient, backoff: Optional[Backoff] = None) -> bytes:
+    """Fetch bucket 0, surfacing worker-side failures.  Transient
+    connection failures retry against the same worker behind capped
+    exponential backoff with full jitter (reference: Backoff.java wait in
+    the HttpPageBufferClient pull loop); each outcome feeds the worker's
+    circuit breaker.  An HTTPError means the worker ANSWERED — its task
+    failed — so it raises immediately (retrying can't fix the task, and
+    the worker itself is healthy)."""
+    backoff = backoff or Backoff(base_s=0.05, cap_s=1.0)
+    breaker = BREAKERS.get(task.worker_url)
+    last: Optional[BaseException] = None
+    for attempt in range(FETCH_ATTEMPTS):
+        check_current()  # canceled/expired queries stop pulling results
+        if attempt:
+            backoff.wait(attempt - 1)
+        try:
+            body = _http_get(task.result_url(0))
+        except urllib.error.HTTPError as e:
+            breaker.record_success()  # the socket answered; the TASK failed
+            raise RuntimeError(
+                f"task {task.task_id} failed on {task.worker_url}: "
+                f"{e.read().decode()[:2000]}"
+            ) from None
+        except QueryAbortedException:
+            raise  # lifecycle abort, not worker evidence: no breaker vote
+        except Exception as e:
+            last = e
+            breaker.record_failure()
+            if _is_transient(e):
+                continue
+            raise
+        breaker.record_success()
+        return body
+    raise last

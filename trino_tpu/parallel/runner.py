@@ -100,6 +100,7 @@ from trino_tpu.planner.fragmenter import (
     create_subplans,
     fragment_text,
 )
+from trino_tpu.runtime.lifecycle import check_current
 from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
 from trino_tpu.runtime.memory import batch_bytes
 from trino_tpu.runtime.query_stats import MeshProfile
@@ -261,6 +262,7 @@ class DistributedQueryRunner(LocalQueryRunner):
             host = executor.run(sub)
             rows = []
             for batch in host.stream:
+                check_current()  # cancel/deadline between result batches
                 rows.extend(tuple(r) for r in batch.to_pylist())
         if stats is not None:
             stats.mesh_profile = profile
@@ -338,6 +340,7 @@ class StageExecutor:
         the phase measures device time.  `fid` overrides the charged
         fragment (deferred chains bill their producer, not the consumer
         that materializes them)."""
+        check_current()  # cooperative cancel/deadline point per SPMD launch
         prof = self.profile
         owner = self._current_fid if fid is None else fid
         r0 = TRACE_CACHE.retraces
@@ -467,6 +470,7 @@ class StageExecutor:
                 f"fragment-{fid}", kind=str(sub.fragment.partitioning)
             ):
                 for _ in range(attempts):
+                    check_current()  # fragment-boundary cancellation point
                     try:
                         FAILURE_INJECTOR.maybe_fail(f"stage:{fid}")
                         if sub.fragment.partitioning.kind in _DIST_KINDS:

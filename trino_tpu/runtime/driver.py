@@ -20,10 +20,20 @@ class Driver:
         self.operators = list(operators)
 
     def run(self) -> Iterator[Batch]:
+        from trino_tpu.runtime.lifecycle import check_current
+
         stream: Iterable[Batch] = self.source
         for op in self.operators:
             stream = op.process(stream)
-        return iter(stream)
+
+        def guarded(s: Iterable[Batch]) -> Iterator[Batch]:
+            # cooperative cancellation per batch: a canceled/expired query
+            # aborts between pages instead of draining the whole chain
+            for b in s:
+                check_current()
+                yield b
+
+        return guarded(stream)
 
     def collect(self) -> list[Batch]:
         return list(self.run())

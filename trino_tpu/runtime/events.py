@@ -19,17 +19,32 @@ from typing import Optional
 log = logging.getLogger("trino_tpu.events")
 
 #: error-type vocabulary (reference: spi ErrorType — the subset the engine
-#: distinguishes; resource/external classes fold into INTERNAL here)
+#: distinguishes; external classes fold into INTERNAL here)
 USER_ERROR = "USER_ERROR"
 INTERNAL_ERROR = "INTERNAL_ERROR"
+#: deadline / memory-kill / admission aborts (reference: INSUFFICIENT_
+#: RESOURCES — the class a serving stack pages on differently from bugs)
+RESOURCE_ERROR = "RESOURCE_ERROR"
 
 
 def classify_error(exc: BaseException) -> str:
-    """Exception -> error type.  Parse/analysis/semantic errors (the
-    engine raises them as ValueError subclasses — ParseError,
-    AnalysisError — plus KeyError for missing objects and
-    NotImplementedError for unsupported SQL) are the user's; everything
-    else is the engine's."""
+    """Exception -> error type.  Lifecycle aborts classify first (a user
+    cancel is the user's, a deadline/memory kill is a resource verdict —
+    both are RuntimeErrors, so they must not fall through to INTERNAL).
+    Parse/analysis/semantic errors (the engine raises them as ValueError
+    subclasses — ParseError, AnalysisError — plus KeyError for missing
+    objects and NotImplementedError for unsupported SQL) are the user's;
+    everything else is the engine's."""
+    from trino_tpu.runtime.lifecycle import (
+        QueryAbortedException,
+        QueryCanceledException,
+    )
+    from trino_tpu.runtime.memory import ExceededMemoryLimitException
+
+    if isinstance(exc, QueryCanceledException):
+        return USER_ERROR
+    if isinstance(exc, (QueryAbortedException, ExceededMemoryLimitException)):
+        return RESOURCE_ERROR
     if isinstance(exc, (ValueError, KeyError, NotImplementedError)):
         return USER_ERROR
     return INTERNAL_ERROR
@@ -66,13 +81,17 @@ class QueryCreatedEvent:
 class QueryCompletedEvent:
     query_id: str
     sql: str
-    state: str  # FINISHED | FAILED
+    state: str  # FINISHED | FAILED | CANCELED
     create_time: float
     end_time: float
     rows: int = 0
     error: Optional[str] = None
-    #: USER_ERROR | INTERNAL_ERROR when state == FAILED (classify_error)
+    #: USER_ERROR | INTERNAL_ERROR | RESOURCE_ERROR when not FINISHED
     error_type: Optional[str] = None
+    #: lifecycle kill reason when the query was aborted (USER_CANCELED |
+    #: EXCEEDED_TIME_LIMIT | CLUSTER_OUT_OF_MEMORY; reference: ErrorCode
+    #: name) — the `system.runtime.queries` kill-reason column
+    error_code: Optional[str] = None
     statistics: Optional[QueryStatistics] = None
 
     @property
@@ -163,6 +182,7 @@ class FileEventListener(EventListener):
                 "rows": e.rows,
                 "error": e.error,
                 "error_type": e.error_type,
+                "error_code": e.error_code,
             }
         )
 

@@ -25,14 +25,27 @@ from typing import Callable, Optional
 import numpy as np
 
 
+#: spool files older than this are orphans (their query is long gone — a
+#: crashed coordinator never reaches SpoolManager.close); swept on
+#: construction of any manager sharing the directory (reference:
+#: FileSystemExchangeManager's exchange-directory cleanup on startup)
+SPOOL_ORPHAN_MAX_AGE_S = 6 * 3600.0
+
+
 class SpoolManager:
     """Persist per-fragment outputs to local files (reference role:
     FileSystemExchangeManager / LocalFileSystemExchangeStorage)."""
 
-    def __init__(self, directory: Optional[str] = None):
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        orphan_max_age_s: float = SPOOL_ORPHAN_MAX_AGE_S,
+        clock: Callable[[], float] = time.time,
+    ):
         from trino_tpu.filesystem import filesystem_for, strip_scheme
 
         self._own = directory is None
+        self.clock = clock
         # the filesystem SPI resolves the location (and rejects remote
         # schemes loudly until an object-store implementation lands)
         self.fs = filesystem_for(directory)
@@ -40,6 +53,11 @@ class SpoolManager:
             directory or tempfile.mkdtemp(prefix="trino_tpu_spool_")
         )
         self.fs.mkdirs(self.dir)
+        if not self._own:
+            # a SHARED directory accumulates {qid}_f{fid}.npz orphans from
+            # queries that crashed before close(); sweep them by age so the
+            # spool volume is bounded by live work, not by failure history
+            self.gc(orphan_max_age_s)
 
     def _path(self, query_id: str, fragment_id: int) -> str:
         return os.path.join(self.dir, f"{query_id}_f{fragment_id}.npz")
@@ -80,6 +98,26 @@ class SpoolManager:
 
     def exists(self, query_id: str, fragment_id: int) -> bool:
         return self.fs.exists(self._path(query_id, fragment_id))
+
+    def gc(self, max_age_s: float) -> list:
+        """Delete spool files not modified within `max_age_s` seconds;
+        returns the paths removed.  Age-based (not liveness-based) on
+        purpose: the writer may be a coordinator in another process, so
+        mtime is the only signal every deployment shape shares.  All IO
+        (list/mtime/delete) rides the filesystem SPI, so GC follows the
+        spool to whatever storage implementation hosts it."""
+        cutoff = self.clock() - max_age_s
+        removed = []
+        for p in list(self.fs.list(self.dir)):
+            if not p.endswith(".npz"):
+                continue  # never touch files the spool didn't write
+            try:
+                if self.fs.mtime(p) < cutoff:
+                    self.fs.delete(p)
+                    removed.append(p)
+            except OSError:
+                continue  # deleted concurrently (another manager's sweep)
+        return removed
 
     def close(self) -> None:
         """Remove spooled intermediates (query finished); only directories

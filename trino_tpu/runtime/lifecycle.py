@@ -1,0 +1,498 @@
+"""Query lifecycle: state machine, deadlines, cooperative cancellation,
+and the low-memory killer.
+
+Reference roles: execution/QueryTracker.java (enforceTimeLimits — the
+query_max_run_time / query_max_planning_time sweep), QueryStateMachine
+(QUEUED -> RUNNING -> FINISHING -> FINISHED|FAILED|CANCELED, with terminal
+states frozen), memory/LowMemoryKiller.java +
+TotalReservationLowMemoryKiller (pick the query with the largest
+reservation when the pool blocks), and the per-request deadline derivation
+of HttpRemoteTask (every RPC timeout bounded by what is left of the query).
+
+Engine mapping: one `QueryContext` per statement, created by
+`LocalQueryRunner.execute` and published through a contextvar so deep call
+sites (driver loop, SPMD launches, multi-host stage polls, HTTP helpers)
+can consult it without threading a handle through every signature.
+Cancellation is COOPERATIVE: `check()` is called at fragment boundaries,
+between result batches, before each SPMD launch, and inside remote fetch
+retries — a canceled or expired query aborts at the next boundary with a
+classified error instead of hanging.  Aborts propagate
+`RemoteTaskClient.cancel` to every live remote task.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from typing import Callable, Optional
+
+# -- error surface ------------------------------------------------------------
+
+
+class QueryAbortedException(RuntimeError):
+    """Base for lifecycle aborts.  Deliberately NOT a ConnectionError /
+    TimeoutError subclass: retry machinery must never classify an abort as
+    transient and re-run the query past its deadline."""
+
+    #: reference: spi ErrorCode name carried into QueryCompletedEvent
+    error_code: str = "ABORTED"
+
+
+class QueryCanceledException(QueryAbortedException):
+    """DELETE /v1/query/{id} or QueryTracker.cancel (USER_ERROR/CANCELED)."""
+
+    error_code = "USER_CANCELED"
+
+
+class QueryDeadlineExceeded(QueryAbortedException):
+    """query_max_run_time / query_max_planning_time expired
+    (INSUFFICIENT_RESOURCES / EXCEEDED_TIME_LIMIT)."""
+
+    error_code = "EXCEEDED_TIME_LIMIT"
+
+
+class QueryKilledException(QueryAbortedException):
+    """Chosen as the low-memory killer's victim
+    (INSUFFICIENT_RESOURCES / CLUSTER_OUT_OF_MEMORY)."""
+
+    error_code = "CLUSTER_OUT_OF_MEMORY"
+
+
+#: QueryContext.kill reason -> exception class raised at the next check()
+_REASON_EXC = {
+    "canceled": QueryCanceledException,
+    "deadline": QueryDeadlineExceeded,
+    "memory": QueryKilledException,
+}
+
+
+# -- state machine ------------------------------------------------------------
+
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+FINISHING = "FINISHING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+
+TERMINAL_STATES = frozenset({FINISHED, FAILED, CANCELED})
+
+#: legal transitions (reference: execution/QueryState.java's ordering —
+#: terminal states are frozen, and the machine never moves backwards)
+_TRANSITIONS = {
+    QUEUED: {RUNNING, FAILED, CANCELED},
+    RUNNING: {FINISHING, FAILED, CANCELED},
+    FINISHING: {FINISHED, FAILED, CANCELED},
+    FINISHED: set(),
+    FAILED: set(),
+    CANCELED: set(),
+}
+
+
+class InvalidStateTransition(RuntimeError):
+    pass
+
+
+#: default per-request HTTP timeout when no query deadline bounds it
+#: (the old hardcoded 600 s scattered through server/ + remote.py)
+DEFAULT_HTTP_TIMEOUT_S = 600.0
+#: task submission POST (small body, worker answers immediately)
+SUBMIT_TIMEOUT_S = 60.0
+#: best-effort task cancel DELETE
+CANCEL_TIMEOUT_S = 10.0
+#: worker liveness probe GET /v1/info
+PROBE_TIMEOUT_S = 5.0
+
+
+class QueryContext:
+    """Per-query lifecycle handle: state machine + deadline + cancellation
+    token + registered remote tasks + attached memory contexts."""
+
+    def __init__(
+        self,
+        query_id: str,
+        max_run_time_s: float = 0.0,
+        max_planning_time_s: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.query_id = query_id
+        self.clock = clock
+        self.created_at = clock()
+        self.state = QUEUED
+        #: absolute deadlines on the injectable clock (None = unbounded)
+        self.deadline = (
+            self.created_at + max_run_time_s if max_run_time_s > 0 else None
+        )
+        self.planning_deadline = (
+            self.created_at + max_planning_time_s
+            if max_planning_time_s > 0
+            else None
+        )
+        self._lock = threading.Lock()
+        self._cancel = threading.Event()
+        #: why the token fired: canceled | deadline | memory
+        self.kill_reason: Optional[str] = None
+        #: human-readable detail surfaced in the raised exception
+        self.kill_detail: Optional[str] = None
+        #: live RemoteTaskClient handles (multi-host); canceled on abort
+        self._tasks: list = []
+        #: query-level MemoryContexts reserved on the shared pool; released
+        #: when the statement finishes (success OR failure)
+        self._memory: list = []
+
+    # -- state machine --------------------------------------------------------
+
+    def transition(self, to: str) -> None:
+        with self._lock:
+            if to not in _TRANSITIONS.get(self.state, set()):
+                raise InvalidStateTransition(
+                    f"query {self.query_id}: illegal transition "
+                    f"{self.state} -> {to}"
+                )
+            self.state = to
+
+    @property
+    def done(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def begin(self) -> None:
+        self.transition(RUNNING)
+
+    def finishing(self) -> None:
+        if self.state == RUNNING:
+            self.transition(FINISHING)
+
+    def finish(self) -> None:
+        if self.state in (QUEUED, RUNNING):
+            # short statements (SET SESSION) may finish without FINISHING
+            self.transition(FINISHING) if self.state == RUNNING else None
+        if self.state == FINISHING:
+            self.transition(FINISHED)
+        elif self.state == QUEUED:
+            self.state = FINISHED
+
+    def fail(self, exc: BaseException) -> str:
+        """Move to the terminal failure state for `exc`; returns the event
+        state string (CANCELED for user cancels, FAILED otherwise)."""
+        state = CANCELED if isinstance(exc, QueryCanceledException) else FAILED
+        with self._lock:
+            if self.state not in TERMINAL_STATES:
+                self.state = state
+        self.cancel_tasks()
+        return state
+
+    # -- cancellation token ---------------------------------------------------
+
+    def cancel(self, detail: Optional[str] = None) -> None:
+        """User-initiated cancel (DELETE /v1/query/{id})."""
+        self.kill(reason="canceled", detail=detail or "canceled by user")
+
+    def kill(self, reason: str, detail: Optional[str] = None) -> None:
+        """Arm the token; the query aborts at its next cooperative check.
+        First reason wins (a memory kill is not overwritten by a later
+        deadline sweep)."""
+        with self._lock:
+            if self.kill_reason is None:
+                self.kill_reason = reason
+                self.kill_detail = detail
+        self._cancel.set()
+        self.cancel_tasks()
+
+    @property
+    def canceled(self) -> bool:
+        return self._cancel.is_set()
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds left until the run deadline (None = unbounded)."""
+        if self.deadline is None:
+            return None
+        return self.deadline - self.clock()
+
+    def check(self) -> None:
+        """Cooperative cancellation point: raises the classified abort when
+        the token fired or the deadline passed.  Cheap (one Event.is_set +
+        one clock read) — safe at per-batch / per-launch granularity."""
+        if self._cancel.is_set():
+            exc = _REASON_EXC.get(self.kill_reason, QueryCanceledException)
+            raise exc(
+                f"query {self.query_id} "
+                f"{self.kill_detail or self.kill_reason or 'canceled'}"
+            )
+        if self.deadline is not None and self.clock() > self.deadline:
+            # arm through kill() so live remote tasks get their cancel
+            self.kill(
+                "deadline",
+                detail=(
+                    f"exceeded query_max_run_time "
+                    f"({self.deadline - self.created_at:.3f}s)"
+                ),
+            )
+            raise QueryDeadlineExceeded(
+                f"query {self.query_id} exceeded query_max_run_time "
+                f"({self.deadline - self.created_at:.3f}s)"
+            )
+
+    def check_planning(self) -> None:
+        """Planning-phase deadline (query_max_planning_time); also enforces
+        the run deadline and the token."""
+        self.check()
+        if (
+            self.planning_deadline is not None
+            and self.clock() > self.planning_deadline
+        ):
+            self.kill(
+                "deadline",
+                detail=(
+                    f"exceeded query_max_planning_time "
+                    f"({self.planning_deadline - self.created_at:.3f}s)"
+                ),
+            )
+            raise QueryDeadlineExceeded(
+                f"query {self.query_id} exceeded query_max_planning_time "
+                f"({self.planning_deadline - self.created_at:.3f}s)"
+            )
+
+    def http_timeout(self, default: float = DEFAULT_HTTP_TIMEOUT_S) -> float:
+        """Per-request timeout derived from the deadline: never wait on a
+        socket longer than the query has left to live.  Raises when the
+        deadline already passed (the request would be pointless)."""
+        self.check()
+        rem = self.remaining_s()
+        if rem is None:
+            return default
+        return max(min(default, rem), 0.001)
+
+    # -- abort propagation ----------------------------------------------------
+
+    def register_task(self, client) -> None:
+        """Track a live remote task so aborts propagate its cancel."""
+        with self._lock:
+            self._tasks.append(client)
+
+    def cancel_tasks(self) -> None:
+        """Best-effort RemoteTaskClient.cancel on every registered task
+        (reference: SqlStageExecution cancel fan-out on query failure)."""
+        with self._lock:
+            tasks, self._tasks = self._tasks, []
+        for t in tasks:
+            try:
+                t.cancel()
+            except Exception:
+                pass
+
+    # -- memory ---------------------------------------------------------------
+
+    def attach_memory(self, ctx) -> None:
+        with self._lock:
+            self._memory.append(ctx)
+
+    def memory_reserved(self) -> int:
+        with self._lock:
+            return sum(m.reserved for m in self._memory)
+
+    def release_memory(self) -> None:
+        with self._lock:
+            mem, self._memory = self._memory, []
+        for m in mem:
+            try:
+                m.force_release()
+            except Exception:
+                pass
+
+
+# -- current-query contextvar -------------------------------------------------
+
+_CURRENT: "contextvars.ContextVar[Optional[QueryContext]]" = (
+    contextvars.ContextVar("trino_tpu_current_query", default=None)
+)
+
+
+def current_query() -> Optional[QueryContext]:
+    return _CURRENT.get()
+
+
+def set_current(ctx: Optional[QueryContext]):
+    """Install `ctx` as the executing query; returns the reset token."""
+    return _CURRENT.set(ctx)
+
+
+def reset_current(token) -> None:
+    _CURRENT.reset(token)
+
+
+def check_current() -> None:
+    """Cooperative cancellation point for call sites without a handle."""
+    ctx = _CURRENT.get()
+    if ctx is not None:
+        ctx.check()
+
+
+def check_current_planning() -> None:
+    """Planning-phase cancellation point (query_max_planning_time)."""
+    ctx = _CURRENT.get()
+    if ctx is not None:
+        ctx.check_planning()
+
+
+def request_timeout(default: float = DEFAULT_HTTP_TIMEOUT_S) -> float:
+    """HTTP timeout for the executing query (the lifecycle deadline helper
+    the raw-http-timeout lint rule routes call sites through): bounded by
+    the query's remaining run time, `default` when no query or no
+    deadline."""
+    ctx = _CURRENT.get()
+    if ctx is None:
+        return default
+    return ctx.http_timeout(default)
+
+
+def register_task(client) -> None:
+    """Attach a remote task to the executing query (no-op without one)."""
+    ctx = _CURRENT.get()
+    if ctx is not None:
+        ctx.register_task(client)
+
+
+# -- tracker ------------------------------------------------------------------
+
+
+class QueryTracker:
+    """Live-query registry (reference: execution/QueryTracker.java).  One
+    per runner; DELETE /v1/query/{id} resolves through it.  Canceling an id
+    that has not registered yet pre-cancels it (cancel-while-queued)."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._live: dict[str, QueryContext] = {}
+        self._precanceled: set[str] = set()
+
+    def create(self, query_id: str, properties=None) -> QueryContext:
+        max_run = max_plan = 0.0
+        if properties is not None:
+            try:
+                max_run = float(properties.get("query_max_run_time"))
+                max_plan = float(properties.get("query_max_planning_time"))
+            except KeyError:  # pragma: no cover - older property sets
+                pass
+        ctx = QueryContext(
+            query_id,
+            max_run_time_s=max_run,
+            max_planning_time_s=max_plan,
+            clock=self.clock,
+        )
+        with self._lock:
+            self._live[query_id] = ctx
+            pre = query_id in self._precanceled
+            self._precanceled.discard(query_id)
+        if pre:
+            ctx.cancel("canceled before execution started")
+        return ctx
+
+    def get(self, query_id: str) -> Optional[QueryContext]:
+        with self._lock:
+            return self._live.get(query_id)
+
+    def live(self) -> list:
+        with self._lock:
+            return list(self._live.values())
+
+    def cancel(self, query_id: str) -> bool:
+        """True when a live query was canceled; unknown ids pre-cancel (the
+        query may be queued and not yet registered)."""
+        with self._lock:
+            ctx = self._live.get(query_id)
+            if ctx is None:
+                self._precanceled.add(query_id)
+        if ctx is None:
+            return False
+        ctx.cancel()
+        return True
+
+    def remove(self, ctx: QueryContext) -> None:
+        with self._lock:
+            if self._live.get(ctx.query_id) is ctx:
+                del self._live[ctx.query_id]
+
+
+# -- low-memory killer --------------------------------------------------------
+
+
+class LowMemoryKiller:
+    """TotalReservationLowMemoryKiller analog: when a reservation would
+    exceed the shared pool, kill the query holding the LARGEST reservation
+    — never the reserving one while another query holds more — reclaim its
+    accounting, and let the blocked reservation retry.  The victim aborts
+    at its next cooperative check with CLUSTER_OUT_OF_MEMORY."""
+
+    def __call__(self, pool_root, requesting, delta: int) -> bool:
+        """memory.MemoryContext on_exceeded hook: True = freed something,
+        retry the reservation; False = raise to the requester."""
+        req_query = requesting.query_root()
+        candidates = [
+            q
+            for q in getattr(pool_root, "query_children", ())
+            if q.reserved > 0
+        ]
+        if not candidates:
+            return False
+        victim = max(candidates, key=lambda q: q.reserved)
+        if victim is req_query:
+            # the requester already holds the largest reservation: failing
+            # its reservation IS the kill (never shoot a smaller bystander)
+            return False
+        owner = getattr(victim, "owner", None)
+        from trino_tpu.telemetry.metrics import memory_kills_counter
+
+        memory_kills_counter().inc()
+        if owner is not None:
+            owner.kill(
+                "memory",
+                detail=(
+                    f"killed by the low-memory killer: largest reservation "
+                    f"({victim.reserved} bytes) when "
+                    f"{requesting.name} requested {delta} more"
+                ),
+            )
+        victim.force_release()
+        return True
+
+
+#: process-wide device-memory pool shared by all queries in this process
+#: (reference: memory/MemoryPool.java's GENERAL pool).  Unlimited by
+#: default — set_memory_pool_limit arms the low-memory killer.
+_GLOBAL_POOL = None
+_POOL_LOCK = threading.Lock()
+
+
+def memory_pool():
+    """The process memory pool with the low-memory killer installed."""
+    global _GLOBAL_POOL
+    with _POOL_LOCK:
+        if _GLOBAL_POOL is None:
+            from trino_tpu.runtime.memory import MemoryPool
+
+            _GLOBAL_POOL = MemoryPool()
+            _GLOBAL_POOL.root.on_exceeded = LowMemoryKiller()
+        return _GLOBAL_POOL
+
+
+def set_memory_pool_limit(limit_bytes: int) -> None:
+    """Arm (limit > 0) or disarm (0) the shared pool limit."""
+    memory_pool().root.limit_bytes = int(limit_bytes)
+
+
+def query_memory_context(limit_bytes: int = 0):
+    """Per-query memory context for the local execution planner: on the
+    SHARED pool (killer-visible, released by the runner at statement end)
+    when a query is executing, else a private throwaway pool (direct
+    planner construction in tests / worker tasks)."""
+    ctx = current_query()
+    if ctx is None:
+        from trino_tpu.runtime.memory import MemoryPool
+
+        return MemoryPool().query_context("query", limit_bytes)
+    mem = memory_pool().query_context(ctx.query_id, limit_bytes)
+    mem.owner = ctx
+    ctx.attach_memory(mem)
+    return mem

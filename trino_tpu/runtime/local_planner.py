@@ -76,7 +76,7 @@ class LocalExecutionPlanner:
         stats=None,
         properties=None,
     ):
-        from trino_tpu.runtime.memory import MemoryPool
+        from trino_tpu.runtime.lifecycle import query_memory_context
         from trino_tpu.runtime.session import SessionProperties
 
         self.catalogs = catalogs
@@ -85,9 +85,11 @@ class LocalExecutionPlanner:
         self.properties = properties or SessionProperties()
         #: per-query device-memory budget tree (reference:
         #: lib/trino-memory-context AggregatedMemoryContext + MemoryPool);
-        #: blocking operators reserve through children of this context
-        self.memory = MemoryPool().query_context(
-            "query", self.properties.get("query_max_memory_bytes")
+        #: blocking operators reserve through children of this context.
+        #: When a query is executing this lives on the SHARED process pool,
+        #: where the LowMemoryKiller can see (and shoot) it.
+        self.memory = query_memory_context(
+            self.properties.get("query_max_memory_bytes")
         )
         if stats is not None:
             stats.memory = self.memory

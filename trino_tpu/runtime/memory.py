@@ -17,7 +17,11 @@ import numpy as np
 
 
 class ExceededMemoryLimitException(RuntimeError):
-    pass
+    def __init__(self, message: str, node: Optional["MemoryContext"] = None):
+        super().__init__(message)
+        #: the tree node whose limit blocked the reservation (the pool root
+        #: for cluster-wide pressure, a query context for per-query budgets)
+        self.node = node
 
 
 def batch_bytes(batch) -> int:
@@ -43,15 +47,48 @@ class MemoryContext:
         self.limit_bytes = limit_bytes  # 0 = unlimited (checked at this node)
         self.reserved = 0
         self.peak = 0
+        #: pool-root hook (reference: LowMemoryKiller): called as
+        #: hook(blocked_node, requesting_ctx, delta) when a reservation
+        #: exceeds this node's limit; True = something was freed, retry
+        self.on_exceeded = None
+        #: query roots registered on a pool root (killer victim candidates)
+        self.query_children: list = []
+        #: lifecycle QueryContext for query roots (killed victims abort
+        #: through it at their next cooperative check)
+        self.owner = None
 
     def child(self, name: str) -> "MemoryContext":
         return MemoryContext(self, name)
+
+    def query_root(self) -> "MemoryContext":
+        """The query-level ancestor of this node (self when directly under
+        the pool root, or detached)."""
+        node = self
+        while node.parent is not None and node.parent.parent is not None:
+            node = node.parent
+        return node
 
     def set_bytes(self, n: int) -> None:
         delta = n - self.reserved
         self.add_bytes(delta)
 
     def add_bytes(self, delta: int) -> None:
+        while True:
+            try:
+                return self._reserve(delta)
+            except ExceededMemoryLimitException as e:
+                # the low-memory-killer hook lives on the pool root; a
+                # per-query budget (no hook) propagates to the requester,
+                # which is the wave/spill fallback's signal
+                hook = getattr(e.node, "on_exceeded", None)
+                if (
+                    hook is None
+                    or delta <= 0
+                    or not hook(e.node, self, delta)
+                ):
+                    raise
+
+    def _reserve(self, delta: int) -> None:
         visited = []
         node = self
         try:
@@ -61,7 +98,8 @@ class MemoryContext:
                 if node.limit_bytes and node.reserved > node.limit_bytes:
                     raise ExceededMemoryLimitException(
                         f"memory limit exceeded at {node.name}: "
-                        f"{node.reserved} > {node.limit_bytes} bytes"
+                        f"{node.reserved} > {node.limit_bytes} bytes",
+                        node=node,
                     )
                 node.peak = max(node.peak, node.reserved)
                 node = node.parent
@@ -73,6 +111,24 @@ class MemoryContext:
     def close(self) -> None:
         self.add_bytes(-self.reserved)
 
+    def force_release(self) -> None:
+        """Reclaim this subtree's accounting without cooperating with its
+        operators (the killer's reclaim + end-of-statement cleanup): the
+        reservation is subtracted from every ancestor and the node DETACHES
+        from the tree, so late operator close() calls from a dying query can
+        no longer corrupt the shared pool."""
+        root = self
+        while root.parent is not None:
+            root = root.parent
+        if self in root.query_children:
+            root.query_children.remove(self)
+        node, delta = self.parent, -self.reserved
+        while node is not None:
+            node.reserved += delta
+            node = node.parent
+        self.reserved = 0
+        self.parent = None
+
 
 class MemoryPool:
     """Per-query (or per-process) pool root (reference: MemoryPool.java:44)."""
@@ -83,4 +139,5 @@ class MemoryPool:
     def query_context(self, query_id: str, limit_bytes: int = 0) -> MemoryContext:
         ctx = self.root.child(f"query:{query_id}")
         ctx.limit_bytes = limit_bytes
+        self.root.query_children.append(ctx)
         return ctx

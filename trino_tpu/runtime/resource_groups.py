@@ -41,7 +41,12 @@ class ResourceGroup:
 
     def acquire(self, timeout: Optional[float] = None) -> None:
         """Block until admitted; raise QueryQueueFullError when the queue
-        is at max_queued (reference: InternalResourceGroup.run)."""
+        is at max_queued (reference: InternalResourceGroup.run).  A timeout
+        ALWAYS raises TimeoutError: when the wait expires but release() has
+        already signaled our gate (the timeout/grant race), the granted slot
+        is handed to the next waiter under the lock instead of being
+        swallowed by a caller that has given up — a leaked slot there
+        permanently shrinks the group's effective concurrency."""
         gate = None
         with self.lock:
             if self.running < self.config.hard_concurrency:
@@ -53,7 +58,7 @@ class ResourceGroup:
                     f"resource group {self.config.name} queue is full "
                     f"({self.config.max_queued})"
                 )
-            gate = threading.Event()
+            gate = self._make_gate()
             self.queued.append(gate)
             self.total_queued += 1
         if not gate.wait(timeout=timeout):
@@ -61,20 +66,32 @@ class ResourceGroup:
                 try:
                     self.queued.remove(gate)
                 except ValueError:
-                    # raced with release(): the slot was granted
-                    return
+                    # raced with release(): the slot was granted to us after
+                    # we timed out — pass it on, we are no longer waiting
+                    self.total_admitted -= 1  # the grant never ran
+                    self._hand_off_locked()
             raise TimeoutError(
                 f"queued in resource group {self.config.name} past timeout"
             )
 
+    def _make_gate(self) -> threading.Event:
+        """Seam for the timeout/grant race regression test (a gate whose
+        wait() deterministically 'times out' after release() signals it)."""
+        return threading.Event()
+
+    def _hand_off_locked(self) -> None:
+        """Transfer one held slot onward (caller holds self.lock): wake the
+        next waiter, or return the slot to the pool when nobody waits."""
+        if self.queued:
+            gate = self.queued.popleft()
+            self.total_admitted += 1
+            gate.set()
+        else:
+            self.running = max(0, self.running - 1)
+
     def release(self) -> None:
         with self.lock:
-            if self.queued:
-                gate = self.queued.popleft()
-                self.total_admitted += 1
-                gate.set()  # hand the slot to the next queued query
-            else:
-                self.running = max(0, self.running - 1)
+            self._hand_off_locked()
 
     def stats(self) -> dict:
         with self.lock:

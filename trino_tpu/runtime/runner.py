@@ -70,6 +70,16 @@ class LocalQueryRunner:
         self.grants = GrantManager()
         self.user = "user"
         self._query_ids = __import__("itertools").count(1)
+        # query lifecycle (runtime/lifecycle; reference: QueryTracker +
+        # QueryStateMachine): per-query deadline + cooperative cancellation;
+        # DELETE /v1/query/{id} and the low-memory killer resolve through it
+        from trino_tpu.runtime.lifecycle import QueryTracker
+
+        self.query_tracker = QueryTracker()
+        #: one-shot hook: called with the next statement's QueryContext as
+        #: soon as it exists (the coordinator attaches its cancel surface
+        #: race-free — the engine lock serializes executions around it)
+        self._query_context_cb = None
         # system.runtime observability (connector/system/ role): query
         # history + nodes + session properties queryable via SQL
         from trino_tpu.connectors.system import QueryHistory, SystemConnector
@@ -121,14 +131,20 @@ class LocalQueryRunner:
         return self.plan_query(stmt.query)
 
     def plan_query(self, query: ast.Query) -> OutputNode:
+        from trino_tpu.runtime.lifecycle import check_current_planning
+
         tr = self._tracer
+        check_current_planning()  # query_max_planning_time / cancel token
         with tr.span("analyze"):
             query = self._expand_recursive_ctes(query)
             plan = LogicalPlanner(
                 self.catalogs, self.session, views=self.views
             ).plan(query)
+        check_current_planning()
         with tr.span("optimize"):
-            return self.optimize(plan)
+            out = self.optimize(plan)
+        check_current_planning()
+        return out
 
     def optimize(self, plan: OutputNode) -> OutputNode:
         from trino_tpu.planner.optimizer import optimize
@@ -171,8 +187,19 @@ class LocalQueryRunner:
         m = getattr(self, "_exec_" + type(stmt).__name__, None)
         if m is None:
             raise NotImplementedError(f"statement: {type(stmt).__name__}")
+        from trino_tpu.runtime import lifecycle
+
         qid = f"query_{next(self._query_ids)}"
         self._current_qid = qid  # correlates events with executor/spool ids
+        # lifecycle context: deadline from the query_max_run_time /
+        # query_max_planning_time session properties, cancellation token
+        # consulted at fragment/batch/launch boundaries, published through
+        # the contextvar so deep call sites need no handle
+        ctx = self.query_tracker.create(qid, self.properties)
+        cb, self._query_context_cb = self._query_context_cb, None
+        if cb is not None:
+            cb(ctx)
+        token = lifecycle.set_current(ctx)
         tracer = (
             SpanTracer(query_id=qid)
             if self.properties.get("query_trace")
@@ -189,26 +216,34 @@ class LocalQueryRunner:
         t0 = _time.time()
         self.events.query_created(QueryCreatedEvent(qid, sql, t0))
         try:
+            ctx.begin()
             with tracer.span("query", query_id=qid, sql=sql[:200]):
                 result = execute_with_retry(
                     lambda: m(stmt), self.properties.get("retry_policy")
                 )
+            ctx.finish()
         except BaseException as e:
             end = _time.time()
+            state = ctx.fail(e)  # CANCELED for user cancels, else FAILED
             etype = classify_error(e)
-            queries_counter().labels("FAILED", etype).inc()
+            queries_counter().labels(state, etype).inc()
             query_wall_histogram().observe(end - t0)
             self._finish_trace(qid, tracer, prev_tracer)
             self.events.query_completed(
                 QueryCompletedEvent(
-                    qid, sql, "FAILED", t0, end, error=str(e),
+                    qid, sql, state, t0, end, error=str(e),
                     error_type=etype,
+                    error_code=getattr(e, "error_code", None),
                     statistics=self._query_statistics(
                         end - t0, 0, tracer, prof_before
                     ),
                 )
             )
             raise
+        finally:
+            lifecycle.reset_current(token)
+            ctx.release_memory()  # shared-pool reservations end with us
+            self.query_tracker.remove(ctx)
         end = _time.time()
         queries_counter().labels("FINISHED", "").inc()
         query_wall_histogram().observe(end - t0)
@@ -396,6 +431,8 @@ class LocalQueryRunner:
         self._check_table_access(plan)
 
         def run() -> MaterializedResult:
+            from trino_tpu.runtime.lifecycle import check_current
+
             with self._tracer.span("execute"):
                 lp = LocalExecutionPlanner(
                     self.catalogs,
@@ -406,6 +443,7 @@ class LocalQueryRunner:
                 physical = lp.plan(plan)
                 rows = []
                 for batch in physical.stream:
+                    check_current()  # cancel/deadline between result batches
                     rows.extend(tuple(r) for r in batch.to_pylist())
                 self._last_peak_memory = lp.memory.peak
             return MaterializedResult(

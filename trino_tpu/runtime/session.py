@@ -50,6 +50,21 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             0,
         ),
         PropertyMetadata(
+            "query_max_run_time",
+            "wall-clock deadline for a whole statement in seconds; the "
+            "query aborts with EXCEEDED_TIME_LIMIT at its next cooperative "
+            "check (0 = unbounded; reference: QueryTracker.enforceTimeLimits)",
+            float,
+            0.0,
+        ),
+        PropertyMetadata(
+            "query_max_planning_time",
+            "wall-clock deadline for analysis + optimization in seconds "
+            "(0 = unbounded)",
+            float,
+            0.0,
+        ),
+        PropertyMetadata(
             "retry_policy",
             "NONE | QUERY (re-execute the query) | TASK (per-stage retry "
             "with spooled intermediates)",

@@ -26,6 +26,8 @@ from typing import Optional
 from trino_tpu.server import protocol
 
 RESULT_PAGE_ROWS = 4096
+#: long-poll bound on statement/trace GETs (reference: the async responses)
+POLL_WAIT_S = 1.0
 
 
 class _Query:
@@ -38,24 +40,57 @@ class _Query:
         #: Chrome-trace/Perfetto JSON captured at completion (query_trace)
         self.trace: Optional[dict] = None
         self.done = threading.Event()
+        self._lock = threading.Lock()
+        #: runtime lifecycle handle, attached the moment the engine creates
+        #: it (LocalQueryRunner._query_context_cb); DELETE resolves here
+        self.lifecycle = None
+        #: cancel arrived before execution started (cancel-while-queued)
+        self.cancel_requested = False
+
+    def cancel(self) -> None:
+        """DELETE /v1/query/{id}: a REAL cancel — the running statement
+        aborts at its next cooperative check and fans the cancel out to its
+        remote tasks; a queued one aborts before it starts."""
+        with self._lock:
+            self.cancel_requested = True
+            ctx = self.lifecycle
+        if ctx is not None:
+            ctx.cancel("canceled via DELETE /v1/query")
+
+    def _attach(self, ctx) -> None:
+        with self._lock:
+            self.lifecycle = ctx
+            pre = self.cancel_requested
+        if pre:
+            ctx.cancel("canceled via DELETE /v1/query")
 
     def run(self, runner) -> None:
+        from trino_tpu.runtime.lifecycle import QueryCanceledException
+
         self.state = "RUNNING"
         trace_before = getattr(runner, "last_trace", None)
+        runner._query_context_cb = self._attach
         try:
             self.result = runner.execute(self.sql)
             self.state = "FINISHED"
         except Exception as e:  # surface as protocol error object
             from trino_tpu.runtime.events import classify_error
 
-            self.state = "FAILED"
+            self.state = (
+                "CANCELED" if isinstance(e, QueryCanceledException) else "FAILED"
+            )
             self.error = {
                 "message": str(e),
                 "errorName": type(e).__name__,
                 "errorType": classify_error(e),
+                "errorCode": getattr(e, "error_code", None),
                 "stack": traceback.format_exc(),
             }
         finally:
+            # execute can raise BEFORE consuming the one-shot callback
+            # (parse/access-control errors): clear it so a later statement
+            # never attaches ITS context to this dead query's cancel surface
+            runner._query_context_cb = None
             # span trace of THIS query (GET /v1/query/{id}/trace): the
             # engine lock serializes executions, so a CHANGED last_trace is
             # ours (unchanged = tracing off for this query, store nothing)
@@ -125,6 +160,18 @@ class CoordinatorServer:
                     "errorName": "QUERY_QUEUE_FULL",
                 }
                 q.done.set()
+                return
+            if q.cancel_requested:
+                # canceled while queued: never occupy the engine
+                q.state = "CANCELED"
+                q.error = {
+                    "message": "canceled via DELETE /v1/query",
+                    "errorName": "USER_CANCELED",
+                    "errorType": "USER_ERROR",
+                    "errorCode": "USER_CANCELED",
+                }
+                q.done.set()
+                group.release()
                 return
             try:
                 with self._engine_lock:
@@ -249,7 +296,7 @@ class CoordinatorServer:
                         return self._send(
                             404, {"error": {"message": "no such query"}}
                         )
-                    q.done.wait(timeout=1.0)
+                    q.done.wait(timeout=POLL_WAIT_S)
                     if q.trace is None:
                         return self._send(
                             404,
@@ -268,11 +315,12 @@ class CoordinatorServer:
                 q = server.query(qid)
                 if q is None:
                     return self._send(404, {"error": {"message": "no such query"}})
-                # long-poll up to 1s like the reference's async responses
-                q.done.wait(timeout=1.0)
-                if q.state == "FAILED":
+                # long-poll like the reference's async responses
+                q.done.wait(timeout=POLL_WAIT_S)
+                if q.state in ("FAILED", "CANCELED"):
                     return self._send(
-                        200, protocol.query_results(q.id, state="FAILED", error=q.error)
+                        200,
+                        protocol.query_results(q.id, state=q.state, error=q.error),
                     )
                 if not q.done.is_set():
                     return self._send(
@@ -310,8 +358,22 @@ class CoordinatorServer:
                 except AuthenticationError:
                     return
                 parts = self.path.strip("/").split("/")
+                # DELETE /v1/query/{id} — a REAL cancel (reference:
+                # QueuedStatementResource cancel): the running statement
+                # aborts at its next cooperative check, remote tasks get
+                # their cancel fan-out, and the query shows CANCELED
+                if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+                    q = server.query(parts[2])
+                    if q is None:
+                        return self._send(
+                            404, {"error": {"message": "no such query"}}
+                        )
+                    q.cancel()
+                    return self._send(204, {})
                 if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
-                    server._queries.pop(parts[3], None)
+                    q = server._queries.pop(parts[3], None)
+                    if q is not None:
+                        q.cancel()  # abandoning the result cancels the query
                     return self._send(204, {})
                 self._send(404, {"error": {"message": "not found"}})
 

@@ -67,8 +67,12 @@ class ProxyServer:
             v = handler.headers.get(h)
             if v:
                 req.add_header(h, v)
+        from trino_tpu.runtime.lifecycle import DEFAULT_HTTP_TIMEOUT_S
+
         try:
-            with urllib.request.urlopen(req, timeout=600) as resp:
+            with urllib.request.urlopen(
+                req, timeout=DEFAULT_HTTP_TIMEOUT_S
+            ) as resp:
                 payload = resp.read()
                 status = resp.status
                 ctype = resp.headers.get("Content-Type", "application/json")

@@ -68,6 +68,11 @@ class TaskDescriptor:
     #: compute the dynamic-filter range summary for this task's output
     #: (set only on build-side fragments the coordinator will query)
     collect_ranges: bool = False
+    #: seconds the owning query had left at submission (None = unbounded);
+    #: bounds the task's own run AND its input-pull HTTP timeouts, so a
+    #: worker never outlives the query that scheduled it (reference:
+    #: HttpRemoteTask's per-request deadline derivation)
+    deadline_s: Optional[float] = None
 
 
 class _FilteringConnector:
@@ -96,6 +101,8 @@ class _FilteringConnector:
 
 class _Task:
     def __init__(self, desc: TaskDescriptor):
+        from trino_tpu.runtime.lifecycle import QueryContext
+
         self.desc = desc
         self.state = "RUNNING"
         self.error: Optional[str] = None
@@ -104,6 +111,12 @@ class _Task:
         #: (the dynamic-filter summary the coordinator may collect)
         self.ranges: dict = {}
         self.done = threading.Event()
+        #: task-local lifecycle handle: DELETE /v1/task/{id} cancels it, the
+        #: descriptor deadline bounds it, and cooperative checks inside the
+        #: execution abort through it
+        self.lifecycle = QueryContext(
+            desc.task_id, max_run_time_s=desc.deadline_s or 0.0
+        )
 
 
 class WorkerServer:
@@ -181,7 +194,7 @@ class WorkerServer:
                     t = worker._tasks.get(parts[2])
                     if t is None:
                         return self._bytes(404, b"no such task", "text/plain")
-                    t.done.wait(timeout=1.0)
+                    t.done.wait(timeout=STATUS_WAIT_S)
                     body = (
                         t.state
                         if t.error is None
@@ -196,7 +209,7 @@ class WorkerServer:
                     t = worker._tasks.get(parts[2])
                     if t is None:
                         return self._bytes(404, b"no such task", "text/plain")
-                    t.done.wait(timeout=600)
+                    t.done.wait(timeout=_result_wait_s(t))
                     import json as _json
 
                     return self._bytes(
@@ -210,7 +223,7 @@ class WorkerServer:
                     t = worker._tasks.get(parts[2])
                     if t is None:
                         return self._bytes(404, b"no such task", "text/plain")
-                    t.done.wait(timeout=600)
+                    t.done.wait(timeout=_result_wait_s(t))
                     if t.state != "FINISHED":
                         return self._bytes(
                             500, (t.error or "task failed").encode(), "text/plain"
@@ -222,7 +235,11 @@ class WorkerServer:
             def do_DELETE(self):
                 parts = self.path.strip("/").split("/")
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-                    worker._tasks.pop(parts[2], None)
+                    t = worker._tasks.pop(parts[2], None)
+                    if t is not None:
+                        # REAL cancel: a running task aborts at its next
+                        # cooperative check instead of burning the slot
+                        t.lifecycle.cancel("task canceled by coordinator")
                 self._bytes(200, b"ok", "text/plain")
 
         self._httpd = ThreadingHTTPServer((self._host, port), Handler)
@@ -255,14 +272,28 @@ class WorkerServer:
         return t
 
     def _run(self, t: _Task) -> None:
+        from trino_tpu.runtime.lifecycle import (
+            QueryAbortedException,
+            reset_current,
+            set_current,
+        )
+
         self._slots.acquire()
+        # publish the task's lifecycle handle in THIS worker thread: the
+        # execution's cooperative checks and its input-pull HTTP timeouts
+        # (request_timeout) derive from the task deadline
+        token = set_current(t.lifecycle)
         try:
             t.buckets, t.ranges = self._execute(t.desc)
             t.state = "FINISHED"
+        except QueryAbortedException as e:
+            t.state = "CANCELED"
+            t.error = str(e)
         except Exception:
             t.state = "FAILED"
             t.error = traceback.format_exc()
         finally:
+            reset_current(token)
             self._slots.release()
             t.done.set()
 
@@ -307,7 +338,12 @@ class WorkerServer:
 
         lp.plan = hook
         out = lp.plan(desc.fragment_root)
-        batches = [b for b in out.stream]
+        from trino_tpu.runtime.lifecycle import check_current
+
+        batches = []
+        for b in out.stream:
+            check_current()  # canceled/expired tasks abort between batches
+            batches.append(b)
         if not batches:
             empty = [batches_to_bytes([])] * (
                 desc.output_partitioning[1] if desc.output_partitioning else 1
@@ -379,7 +415,39 @@ class _FilteringCatalogs:
         self._inner.register(name, connector)
 
 
-def _http_get(url: str, timeout: float = 600.0) -> bytes:
+#: long-poll bound on a task's result/dynamic endpoints when the descriptor
+#: carries no deadline (the old hardcoded 600 s, now in ONE place)
+RESULT_WAIT_S = 600.0
+#: short status long-poll (reference: the async task-status responses)
+STATUS_WAIT_S = 1.0
+
+
+def _result_wait_s(t: _Task) -> float:
+    """Result long-poll bound: never wait on a task longer than its owning
+    query has LEFT to live — the task lifecycle's remaining time, not the
+    original budget (a late re-fetch after retries must not pin a server
+    thread past the query's death)."""
+    if t.desc.deadline_s is None:
+        return RESULT_WAIT_S
+    rem = t.lifecycle.remaining_s()
+    if rem is None:  # deadline_s <= 0: the owning query is out of time
+        return 0.001
+    return max(0.001, min(RESULT_WAIT_S, rem))
+
+
+def _http_get(url: str, timeout: Optional[float] = None) -> bytes:
+    """Intra-cluster GET.  The timeout derives from the executing query's
+    remaining run time (lifecycle.request_timeout) unless the caller passes
+    an explicit bound — no HTTP call outlives its query."""
+    from trino_tpu.runtime.lifecycle import request_timeout
+    from trino_tpu.runtime.retry import FAILURE_INJECTOR
+
+    # chaos hook for the pull data plane (result + input fetches).  Named
+    # `fetch:` — NOT `http:` — so injection patterns don't accidentally
+    # match the scheme inside every point's url suffix
+    FAILURE_INJECTOR.maybe_fail(f"fetch:{url}")
+    if timeout is None:
+        timeout = request_timeout(RESULT_WAIT_S)
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return r.read()
 

@@ -422,6 +422,8 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
     completed.touch("FINISHED", "")
     completed.touch("FAILED", "USER_ERROR")
     completed.touch("FAILED", "INTERNAL_ERROR")
+    completed.touch("FAILED", "RESOURCE_ERROR")
+    completed.touch("CANCELED", "USER_ERROR")
     reg.histogram(
         _PREFIX + "query_wall_seconds",
         "end-to-end statement wall time",
@@ -430,6 +432,21 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
         _PREFIX + "query_retraces_total",
         "SPMD retraces attributed to completed distributed queries "
         "(bumped per query by the stage executor; zero warm)",
+    )
+    reg.counter(
+        _PREFIX + "memory_kills_total",
+        "queries killed by the low-memory killer (largest reservation "
+        "reclaimed when the shared pool blocks)",
+    )
+    reg.counter(
+        _PREFIX + "breaker_trips_total",
+        "circuit-breaker transitions to OPEN on the multi-host HTTP tier",
+    )
+    reg.gauge_fn(
+        _PREFIX + "breaker_state",
+        "per-worker circuit breaker state (0 closed, 1 half-open, 2 open)",
+        _breaker_series,
+        labelnames=("worker",),
     )
     for stat, hint in (
         ("hits", "counter"),
@@ -467,6 +484,15 @@ def _trace_cache_entries():
     return TRACE_CACHE.stats()["entries"]
 
 
+def _breaker_series():
+    from trino_tpu.runtime.retry import BREAKER_STATE_CODES, BREAKERS
+
+    return {
+        (worker,): BREAKER_STATE_CODES[state]
+        for worker, state in BREAKERS.states().items()
+    }
+
+
 def mesh_events_counter() -> Counter:
     """The labeled mesh-event counter MeshProfile.bump mirrors into."""
     return REGISTRY.counter(_PREFIX + "mesh_events_total")
@@ -482,6 +508,15 @@ def query_retraces_counter() -> Counter:
 
 def query_wall_histogram() -> Histogram:
     return REGISTRY.histogram(_PREFIX + "query_wall_seconds")
+
+
+def memory_kills_counter() -> Counter:
+    """Victims chosen by the LowMemoryKiller (runtime/lifecycle)."""
+    return REGISTRY.counter(_PREFIX + "memory_kills_total")
+
+
+def breaker_trips_counter() -> Counter:
+    return REGISTRY.counter(_PREFIX + "breaker_trips_total")
 
 
 _register_engine_metrics(REGISTRY)
