@@ -199,10 +199,10 @@ def test_dynamic_ranges_delivered(mh):
     seen = {}
     orig = w.WorkerServer._execute
 
-    def spy(self, desc):
+    def spy(self, desc, tracer=None):
         if desc.dynamic_ranges:
             seen[desc.task_id] = dict(desc.dynamic_ranges)
-        return orig(self, desc)
+        return orig(self, desc, tracer=tracer)
 
     w.WorkerServer._execute = spy
     try:
@@ -217,3 +217,59 @@ def test_dynamic_ranges_delivered(mh):
     finally:
         w.WorkerServer._execute = orig
         mh.properties.set("join_distribution_type", "AUTOMATIC")
+
+
+# -- cross-host trace propagation (PR 6) --------------------------------------
+
+
+def test_multihost_merged_trace_parents_worker_spans(mh):
+    """The coordinator's trace is ONE cross-host timeline: each scheduled
+    stage gets a coordinator fragment span, every worker task's span tree
+    is grafted under its stage's fragment span, and the worker-side
+    execute_fragment spans ride along — the PR-4 carried gap (multi-host
+    tasks emitted no spans at all) closed."""
+    import json
+
+    mh.execute(
+        "select l_returnflag, sum(l_quantity) from lineitem "
+        "group by l_returnflag"
+    )
+    qid, flat = mh.traces[-1]
+    by_id = {s["span_id"]: s for s in flat}
+    fragments = [s for s in flat if s["name"] == "fragment"]
+    tasks = [s for s in flat if s["name"] == "task"]
+    assert fragments, "scheduled stages must open coordinator fragment spans"
+    # 2 workers x >=1 scheduled stage: every task's tree was pulled
+    assert len(tasks) >= 2, "worker task span trees must be merged"
+    for t in tasks:
+        parent = by_id[t["parent_id"]]
+        assert parent["name"] == "fragment", (
+            "worker task spans must parent under coordinator fragment spans"
+        )
+        attrs = json.loads(t["attributes"])
+        # the context the descriptor carried IS the span it grafted under
+        assert attrs["coordinator_span"] == parent["span_id"]
+        # graft anchors the worker clock at the coordinator-observed
+        # submission instant: never before its fragment span opens
+        assert t["start_ms"] >= parent["start_ms"]
+    # worker-side execution detail survives the merge
+    execs = [s for s in flat if s["name"] == "execute_fragment"]
+    assert execs and all(
+        by_id[s["parent_id"]]["name"] == "task" for s in execs
+    )
+    # and the Perfetto export renders the merged tree (coordinator serves
+    # this dict at GET /v1/query/{id}/trace)
+    names = {e["name"] for e in mh.last_trace["traceEvents"]}
+    assert {"query", "execute", "fragment", "task"} <= names
+
+
+def test_multihost_trace_off_no_task_spans(mh):
+    """query_trace=false propagates: descriptors carry no trace context and
+    workers run with the null tracer (zero observability overhead)."""
+    mh.execute("set session query_trace = false")
+    before = mh.last_trace
+    try:
+        mh.execute("select count(*) from region")
+        assert mh.last_trace is before  # nothing recorded on either side
+    finally:
+        mh.execute("set session query_trace = true")

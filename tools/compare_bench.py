@@ -52,6 +52,16 @@ Q3_ZERO = (
     "join_speculative_retry",
 )
 
+#: coldstart (compile observatory) per-query keys that must be present when
+#: a mesh section records a `coldstart` block — the cold/warm decomposition
+#: is only evidence if the ratio, compile attribution, AND the
+#: warm-replay-zero probe are all there (a dropped warm_replay_events key
+#: would turn the "warm replays compile nothing" gate into a no-op)
+COLDSTART_KEYS = (
+    "cold_s", "warm_s", "cold_over_warm", "compile_s",
+    "compile_events", "warm_replay_events",
+)
+
 #: registry-snapshot series (telemetry/metrics names) that must be zero in a
 #: fresh `bench.py --mesh` snapshot.  The snapshot is PROCESS-LIFETIME, so
 #: only counters that must never fire even cold belong here —
@@ -114,6 +124,29 @@ def check_extra(extra: dict) -> tuple:
                     violations.append(
                         f"mesh.{schema}.q3_counters.{name} = {q3[name]} "
                         "(expected 0 under co-partitioned layouts)"
+                    )
+        # compile-observatory coldstart block (PR 6): a warm replay must
+        # compile NOTHING — any nonzero warm_replay_events means the
+        # workload's compile-key set is not closed and the prewarm manifest
+        # under-covers it; the cold/warm ratio must be recorded so the
+        # ROADMAP item-3 trajectory is measurable
+        cold = sec.get("coldstart")
+        if isinstance(cold, dict):
+            for qname, qsec in sorted(cold.items()):
+                if not isinstance(qsec, dict):
+                    continue
+                if qsec.get("warm_replay_events", 0) != 0:
+                    violations.append(
+                        f"mesh.{schema}.coldstart.{qname}"
+                        f".warm_replay_events = "
+                        f"{qsec['warm_replay_events']} (expected 0: warm "
+                        "replays must not compile)"
+                    )
+                missing = [k for k in COLDSTART_KEYS if k not in qsec]
+                if missing:
+                    violations.append(
+                        f"mesh.{schema}.coldstart.{qname} missing "
+                        f"{missing} (cold/warm decomposition incomplete)"
                     )
         # the registry snapshot bench.py records into the section is the
         # fresh-run diff surface: apply the process-lifetime expectations

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Dump the compile-key manifest a workload needs (the AOT prewarm input).
+
+Runs the given statements on a DistributedQueryRunner and writes the compile
+observatory's manifest: the deduplicated (step, bucket, mesh) key set the
+workload had to trace+compile, with per-key compile seconds.  ROADMAP item 3
+(persistent compile cache + AOT prewarm) consumes this enumeration — compile
+exactly these keys at server start / after mesh resize instead of paying
+them at first query.
+
+By default every statement runs twice and the tool FAILS (exit 2) if the
+second pass still compiles anything: a manifest is only a usable prewarm
+input when the workload's key set is closed under replay.
+
+Usage:
+  python tools/prewarm_manifest.py --schema tiny --workers 8 --queries 1,6,3
+  python tools/prewarm_manifest.py --sql "select count(*) from lineitem" -o m.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dump the compile observatory's prewarm manifest"
+    )
+    ap.add_argument("--schema", default="tiny")
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument(
+        "--queries", default="6",
+        help="comma-separated TPC-H query numbers (default: 6)",
+    )
+    ap.add_argument(
+        "--sql", action="append", default=[],
+        help="raw SQL statement (repeatable; overrides --queries)",
+    )
+    ap.add_argument(
+        "--runs", type=int, default=2,
+        help="executions per statement; >= 2 proves the key set is closed "
+        "(the non-first passes must add zero compile events)",
+    )
+    ap.add_argument("-o", "--out", default=None, help="output file (default: stdout)")
+    args = ap.parse_args(argv)
+
+    # mirror the test/bench environment: a CPU box serves an 8-virtual-device
+    # mesh; a real accelerator deployment leaves JAX_PLATFORMS alone
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.workers}"
+        ).strip()
+    sys.path.insert(0, ROOT)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.parallel import DistributedQueryRunner
+    from trino_tpu.telemetry.compile_events import OBSERVATORY
+
+    runner = DistributedQueryRunner(n_workers=args.workers, schema=args.schema)
+    stmts = args.sql or [QUERIES[int(q)] for q in args.queries.split(",")]
+    warm_events = 0
+    for sql in stmts:
+        runner.execute(sql)
+        mark = OBSERVATORY.mark()
+        for _ in range(max(1, args.runs) - 1):
+            runner.execute(sql)
+        warm_events += OBSERVATORY.count - mark
+
+    doc = {
+        "schema": args.schema,
+        "workers": runner.wm.n,
+        "statements": len(stmts),
+        "compile_events": OBSERVATORY.count,
+        "compile_s": round(OBSERVATORY.total_wall_s, 4),
+        "warm_replay_events": warm_events,
+        "manifest": runner.compile_manifest(),
+    }
+    text = json.dumps(doc, indent=1, default=str)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+    else:
+        print(text)
+    if warm_events:
+        print(
+            f"prewarm_manifest: WARNING: {warm_events} compile event(s) on "
+            "warm replays — the key set is not closed; prewarming this "
+            "manifest will not make cold starts fully warm",
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

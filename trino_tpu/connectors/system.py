@@ -9,6 +9,8 @@ Tables (schema `runtime`):
                      rows, error + error_type classification)
   spans            — flattened span trees of recently traced queries
                      (query_trace session property; telemetry/spans)
+  compilations     — recent SPMD compile events (step, bucket, mesh, wall
+                     seconds; telemetry/compile_events ring)
   metrics          — the process metrics registry (telemetry/metrics)
   nodes            — mesh workers and their liveness
   session_properties — property values in effect
@@ -100,6 +102,17 @@ _TABLES = {
         ("start_ms", T.DOUBLE),
         ("duration_ms", T.DOUBLE),
         ("attributes", T.VARCHAR),
+    ],
+    "compilations": [
+        ("seq", T.BIGINT),
+        ("step", T.VARCHAR),
+        ("bucket", T.BIGINT),
+        ("mesh", T.VARCHAR),
+        ("query_id", T.VARCHAR),
+        ("fragment", T.BIGINT),
+        ("wall_s", T.DOUBLE),
+        ("key_fp", T.VARCHAR),
+        ("key", T.VARCHAR),
     ],
     "metrics": [
         ("name", T.VARCHAR),
@@ -229,6 +242,10 @@ class SystemConnector(Connector):
                         )
                     )
             return out
+        if table == "compilations":
+            from trino_tpu.telemetry.compile_events import OBSERVATORY
+
+            return OBSERVATORY.rows()
         if table == "metrics":
             from trino_tpu.telemetry import REGISTRY
 

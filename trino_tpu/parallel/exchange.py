@@ -133,13 +133,21 @@ def _exchange_kernel(key_channels, n_workers, slot_cap):
 
 
 def exchange_slot_cap(
-    stacked: Batch, key_channels: Sequence[int], wm: WorkerMesh
+    stacked: Batch, key_channels: Sequence[int], wm: WorkerMesh,
+    profile=None, fid: Optional[int] = None,
 ) -> int:
     """Phase 1 of the two-step exchange: a (cached) jitted counts pass, one
     tiny [W, W] host sync, and the pow2 slot-capacity bucket.  The bucket is
-    what lets the fused phase-2 program cache across executions."""
-    from trino_tpu.parallel.spmd import cached_spmd_step
+    what lets the fused phase-2 program cache across executions.  `profile`
+    attributes the counts sync as capacity-sizing collective bytes and
+    closes the compile event a cold counts pass opens (this call runs
+    OUTSIDE the runner's instrumented `_call` window)."""
+    from trino_tpu.parallel.spmd import TRACE_CACHE, cached_spmd_step, mesh_key
+    from trino_tpu.telemetry import now
+    from trino_tpu.telemetry.compile_events import OBSERVATORY
 
+    r0 = TRACE_CACHE.retraces
+    t0 = now()
     counts_fn = cached_spmd_step(
         wm,
         ("exchange_counts", tuple(key_channels), wm.n),
@@ -147,6 +155,23 @@ def exchange_slot_cap(
         collective=True,
     )
     counts = np.asarray(counts_fn(stacked))  # [W, W]
+    if TRACE_CACHE.retraces > r0:
+        from trino_tpu.runtime.lifecycle import check_current
+
+        bucket = (
+            stacked.columns[0].data.shape[-1] if stacked.columns else None
+        )
+        OBSERVATORY.close_open(
+            now() - t0, bucket=bucket, fragment=fid, mesh=mesh_key(wm)
+        )
+        # deadline watchdog: same contract as the runner's _call — a
+        # compile-event close re-checks the cancellation token so a long
+        # counts-pass compile can't overshoot query_max_run_time silently
+        check_current()
+    if profile is not None:
+        profile.add_collective(
+            fid, int(counts.nbytes), "gather", "capacity_sizing"
+        )
     return next_pow2(max(1, int(counts.max())), floor=64)
 
 

@@ -47,6 +47,7 @@ from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
 from trino_tpu.runtime.retry import BREAKERS, FAILURE_INJECTOR, RETRYABLE, Backoff
 from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
 from trino_tpu.server.worker import TaskDescriptor, _http_get
+from trino_tpu.telemetry import now
 
 _DIST = (SOURCE, FIXED_HASH, FIXED_ARBITRARY)
 
@@ -114,6 +115,22 @@ class RemoteTaskClient:
     def result_url(self, bucket: int) -> str:
         return f"{self.worker_url}/v1/task/{self.task_id}/results/{bucket}"
 
+    def spans(self) -> Optional[dict]:
+        """The finished task's span tree (worker-local clock), or None —
+        tracing is an observability surface, never a correctness
+        dependency, so ANY failure degrades to 'no worker spans'.  That
+        includes abort signals: this runs after every result batch has
+        been materialized, and a deadline expiring during span collection
+        must not fail a query whose rows are already complete (cancel and
+        deadline still fire at the execution's own cooperative checks)."""
+        import json as _json
+
+        try:
+            body = _http_get(f"{self.worker_url}/v1/task/{self.task_id}/spans")
+            return _json.loads(body.decode()) or None
+        except Exception:
+            return None
+
     def cancel(self) -> None:
         req = urllib.request.Request(
             f"{self.worker_url}/v1/task/{self.task_id}", method="DELETE"
@@ -159,11 +176,17 @@ class MultiHostQueryRunner(LocalQueryRunner):
             n_workers=len(self.worker_urls), colocate=False,
         )
         sub = create_subplans(dplan, properties=self.properties)
-        out = _StageScheduler(self).run(sub)
-        rows = []
-        for batch in out.stream:
-            check_current()  # cancel/deadline between result batches
-            rows.extend(tuple(r) for r in batch.to_pylist())
+        sched = _StageScheduler(self)
+        with self._tracer.span("execute"):
+            out = sched.run(sub)
+            rows = []
+            for batch in out.stream:
+                check_current()  # cancel/deadline between result batches
+                rows.extend(tuple(r) for r in batch.to_pylist())
+            # tasks are complete (results are pulled eagerly): merge their
+            # span trees so GET /v1/query/{id}/trace renders ONE cross-host
+            # timeline with coordinator AND worker spans
+            sched.collect_spans()
         return MaterializedResult(
             list(plan.column_names), rows, [s.type for s in plan.symbols]
         )
@@ -194,6 +217,12 @@ class _StageScheduler:
         self._subplans: dict[int, SubPlan] = {}
         #: task_id -> TaskDescriptor (for replacement resubmission)
         self._descs: dict[str, TaskDescriptor] = {}
+        #: cross-host tracing (query_trace on): per-fragment coordinator
+        #: spans the workers' task span trees merge under, and the
+        #: coordinator-clock submission instant each worker tree anchors to
+        self.tracer = runner._tracer
+        self._fragment_spans: dict = {}
+        self._submit_t: dict = {}
 
     @staticmethod
     def _is_conn_dead(exc: Exception) -> bool:
@@ -325,6 +354,7 @@ class _StageScheduler:
                 continue
             breaker.record_success()
             self._descs[desc.task_id] = desc
+            self._submit_t[desc.task_id] = now()
             # abort propagation: the executing query cancels this task if
             # it is killed (RemoteTaskClient.cancel fan-out)
             lifecycle.register_task(client)
@@ -376,6 +406,38 @@ class _StageScheduler:
             self._ensure_stage(child)
         return self._coordinator_fragment(root)
 
+    def collect_spans(self) -> None:
+        """Pull every completed task's span tree and graft it under its
+        stage's coordinator fragment span, producing ONE merged cross-host
+        trace (reference: the coordinator folding the distributed
+        task-event stream into the query-level view).  Worker `now()`
+        clocks are per-process perf counters with unrelated epochs, so
+        each tree is anchored at the submission instant the coordinator
+        observed for that task — relative timing within a worker tree is
+        exact, cross-host alignment is submit-instant approximate."""
+        tr = self.tracer
+        if not tr.enabled:
+            return
+        for fid, tasks in self._stage_tasks.items():
+            if isinstance(tasks, _LocalResult):
+                continue
+            fsp = self._fragment_spans.get(fid)
+            if fsp is None:
+                continue
+            end = fsp.end_s
+            for t in tasks:
+                tree = t.spans()
+                if not tree:
+                    continue  # task failed / worker gone: no worker spans
+                anchor = self._submit_t.get(t.task_id, fsp.start_s)
+                sp = tr.graft(
+                    fsp, tree, offset_s=anchor - float(tree["start_s"])
+                )
+                end = sp.end_s if end is None else max(end, sp.end_s)
+            # the fragment span covers submission through its last task's
+            # completion (zero-width when no task returned spans)
+            fsp.end_s = end if end is not None else fsp.start_s
+
     def _register(self, sub: SubPlan) -> None:
         self._subplans[sub.fragment.id] = sub
         for c in sub.children:
@@ -403,6 +465,20 @@ class _StageScheduler:
         # the query that scheduled it (HttpRemoteTask deadline derivation)
         qctx = lifecycle.current_query()
         deadline_s = qctx.remaining_s() if qctx is not None else None
+        # cross-host trace context: one coordinator-side fragment span per
+        # stage; its (trace id, span id) rides every task descriptor like
+        # deadline_s does, and collect_spans() grafts the workers' trees
+        # under it (the W3C traceparent analog)
+        trace_context = None
+        if self.tracer.enabled:
+            t_sub = now()
+            fsp = self.tracer.record(
+                "fragment", t_sub, t_sub,
+                {"fragment_id": fid,
+                 "kind": sub.fragment.partitioning.kind, "tasks": w},
+            )
+            self._fragment_spans[fid] = fsp
+            trace_context = (self.tracer.query_id, fsp.span_id)
         for i, url in enumerate(self.workers):
             desc = TaskDescriptor(
                 task_id=f"t{next(self.runner._task_seq)}_f{fid}_w{i}",
@@ -415,6 +491,7 @@ class _StageScheduler:
                 dynamic_ranges=dict(self._pending_ranges.get(fid, {})),
                 collect_ranges=fid in self._want_ranges,
                 deadline_s=deadline_s,
+                trace_context=trace_context,
             )
             tasks.append(self._submit_on_live(desc, url))
         self._stage_tasks[fid] = tasks

@@ -105,6 +105,7 @@ from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
 from trino_tpu.runtime.memory import batch_bytes
 from trino_tpu.runtime.query_stats import MeshProfile
 from trino_tpu.telemetry import now
+from trino_tpu.telemetry.compile_events import OBSERVATORY
 from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
 from trino_tpu.planner.functions import HOLISTIC_AGGS, PARTITIONABLE_HOLISTIC
 
@@ -349,18 +350,38 @@ class StageExecutor:
         if prof.blocking:
             out = jax.block_until_ready(out)  # lint: allow(host-transfer)
         dt = now() - t0
+        events = ()
         if TRACE_CACHE.retraces > r0:
             TRACE_CACHE.trace_s += dt
             booked = "trace"
+            # close the compile events this launch's misses opened (shape
+            # bucket read off the first stacked argument — a host-side
+            # shape attribute, never a device sync)
+            bucket = next(
+                (_trailing_cap(a) for a in args if isinstance(a, Batch)),
+                None,
+            )
+            events = OBSERVATORY.close_open(
+                dt, bucket=bucket, fragment=owner, mesh=mesh_key(self.wm)
+            )
         else:
             booked = phase
         prof.add_phase(owner, booked, dt)
         tr = prof.tracer
         if tr.enabled:
             # child span per SPMD launch, carrying the phase attribution
-            tr.record(
+            sp = tr.record(
                 "launch", t0, t0 + dt, {"phase": booked, "fragment": owner}
             )
+            # compile stalls nest as children of the launch span, so
+            # EXPLAIN ANALYZE VERBOSE and Perfetto separate compile from
+            # compute instead of one undifferentiated launch block
+            for ev in events:
+                tr.attach(
+                    sp, "compile", t0, t0 + ev.wall_s,
+                    {"step": ev.step, "key": ev.key_fp,
+                     "bucket": ev.bucket},
+                )
         if owner != self._current_fid:
             # cross-fragment attribution: move the wall with the phase so
             # BOTH fragments keep the phases-sum-to-wall invariant — the
@@ -369,6 +390,12 @@ class StageExecutor:
             prof.fragment(owner).wall_s += dt
             if self._frame_stack:
                 self._frame_stack[-1]["child_s"] += dt
+        if events:
+            # deadline watchdog: a long XLA compile is a host-side wait with
+            # no cooperative check inside — re-check as the compile event
+            # closes so an overshoot classifies as EXCEEDED_TIME_LIMIT now
+            # instead of silently running past query_max_run_time
+            check_current()
         return out
 
     def _run_chain(self, stacked: Batch, pending: list) -> Batch:
@@ -399,6 +426,10 @@ class StageExecutor:
             if isinstance(out, _Dist):  # defensive: root should be SINGLE
                 host = unstack_batch(device_get_async(out.stacked))  # lint: allow(host-transfer)
                 self.profile.bump("result_gather")
+                self.profile.add_collective(
+                    self._root_fid, batch_bytes(host), "gather",
+                    "result_gather",
+                )
                 return PhysicalPlan(iter([host]), out.symbols)
             return out
         finally:
@@ -632,6 +663,9 @@ class StageExecutor:
         with self.profile.phase(self._current_fid, "transfer"):
             summ = np.asarray(device_get_async(reduced))  # lint: allow(host-transfer)
         self.profile.bump("dynamic_filter_sync")
+        self.profile.add_collective(
+            self._current_fid, int(summ.nbytes), "reduce", "dynamic_filter"
+        )
         # [W, k, 3] -> per-criterion global (lo, hi, n)
         for i, (name, _) in enumerate(pairs):
             lo = int(summ[:, i, 0].min())
@@ -657,10 +691,12 @@ class StageExecutor:
             stacked = child.stacked  # deferred chain runs as its own phase
             with self.profile.phase(fid, "transfer"):
                 batch = unstack_batch(device_get_async(stacked))  # lint: allow(host-transfer)
-        self.profile.bump(
-            "result_gather" if fid == self._root_fid else "host_gather"
-        )
+        purpose = "result_gather" if fid == self._root_fid else "host_gather"
+        self.profile.bump(purpose)
         self.profile.fragment(fid).bytes_to_host += batch_bytes(batch)
+        self.profile.add_collective(
+            fid, batch_bytes(batch), "gather", purpose
+        )
         return PhysicalPlan(iter([batch]), child.symbols)
 
     def _merge_gather(self, child: _Dist, node: RemoteSourceNode) -> Batch:
@@ -689,8 +725,8 @@ class StageExecutor:
             out = self._call(
                 ex.broadcast, stacked.stacked, self.wm, phase="collective"
             )
-            self.profile.fragment(self._current_fid).collective_bytes += (
-                batch_bytes(out)
+            self.profile.add_collective(
+                self._current_fid, batch_bytes(out), "all_gather", "broadcast"
             )
             return self._dist(out, stacked.symbols, realigned=True)
         if node.exchange_kind == "repartition":
@@ -1050,7 +1086,10 @@ class StageExecutor:
         # fused exchange: bucketize + all_to_all + the FINAL aggregation
         # step run as one compiled program (phase 1 sizes the slot bucket)
         chans = list(range(ngroups))
-        slot_cap = ex.exchange_slot_cap(states, chans, self.wm)
+        slot_cap = ex.exchange_slot_cap(
+            states, chans, self.wm, profile=self.profile,
+            fid=self._current_fid,
+        )
         fcap = self.wm.n * slot_cap
 
         def final_step(b: Batch) -> Batch:
@@ -1067,8 +1106,8 @@ class StageExecutor:
             slot_cap,
             phase="collective",
         )
-        self.profile.fragment(self._current_fid).collective_bytes += (
-            batch_bytes(out)
+        self.profile.add_collective(
+            self._current_fid, batch_bytes(out), "all_to_all", "repartition"
         )
         return self._dist(
             out, node.outputs,
@@ -1123,7 +1162,10 @@ class StageExecutor:
         ngroups = len(node.group_symbols)
         key_channels = [src.channel(s.name) for s in node.group_symbols]
         stacked = src.stacked
-        slot_cap = ex.exchange_slot_cap(stacked, key_channels, self.wm)
+        slot_cap = ex.exchange_slot_cap(
+            stacked, key_channels, self.wm, profile=self.profile,
+            fid=self._current_fid,
+        )
         fcap = self.wm.n * slot_cap
         ex_dist = self._dist(stacked, src.symbols)  # layout proxy
         pre_dd = None
@@ -1160,8 +1202,8 @@ class StageExecutor:
             slot_cap,
             phase="collective",
         )
-        self.profile.fragment(self._current_fid).collective_bytes += (
-            batch_bytes(out)
+        self.profile.add_collective(
+            self._current_fid, batch_bytes(out), "all_to_all", "repartition"
         )
         return self._dist(
             out, node.outputs,
@@ -1287,8 +1329,9 @@ class StageExecutor:
             build_stacked = self._call(
                 ex.broadcast, build.stacked, self.wm, phase="collective"
             )
-            self.profile.fragment(self._current_fid).collective_bytes += (
-                batch_bytes(build_stacked)
+            self.profile.add_collective(
+                self._current_fid, batch_bytes(build_stacked),
+                "all_gather", "broadcast",
             )
         else:
             build = self._place_join_side(
@@ -1444,6 +1487,10 @@ class StageExecutor:
             with self.profile.phase(fid, "transfer"):
                 over_h, total_h, live_h = self._host_pull(over, total, live)
             self.profile.bump("join_overflow_check")
+            self.profile.add_collective(
+                fid, int(over_h.nbytes + total_h.nbytes + live_h.nbytes),
+                "gather", "capacity_sizing",
+            )
             if not over_h.any():
                 CAP_HISTORY.record(hist_key, out_cap)
                 if compact_probe:
@@ -1473,6 +1520,10 @@ class StageExecutor:
         with self.profile.phase(fid, "transfer"):
             totals, lives = self._host_pull(total_dev, live_dev)
         self.profile.bump("join_capacity_sync")
+        self.profile.add_collective(
+            fid, int(totals.nbytes + lives.nbytes), "gather",
+            "capacity_sizing",
+        )
         cap = next_pow2(max(1, int(totals.max())), floor=1024)
 
         def build_expand(oc=cap):
@@ -1604,8 +1655,8 @@ class StageExecutor:
         bcast = self._call(
             ex.broadcast, filt.stacked, self.wm, phase="collective"
         )
-        self.profile.fragment(self._current_fid).collective_bytes += (
-            batch_bytes(bcast)
+        self.profile.add_collective(
+            self._current_fid, batch_bytes(bcast), "all_gather", "broadcast"
         )
         cap_b = _trailing_cap(bcast)
         has_null = _global_has_null(bcast)
@@ -1638,8 +1689,9 @@ class StageExecutor:
             ex.repartition, side.stacked, chans, self.wm, phase="collective"
         )
         self.profile.bump("repartition_collective")
-        self.profile.fragment(self._current_fid).collective_bytes += (
-            batch_bytes(stacked)
+        self.profile.add_collective(
+            self._current_fid, batch_bytes(stacked), "all_to_all",
+            "repartition",
         )
         return self._dist(
             stacked, side.symbols,

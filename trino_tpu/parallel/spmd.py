@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from trino_tpu.columnar import Batch, Column
 from trino_tpu.ops.common import next_pow2
+from trino_tpu.telemetry.compile_events import OBSERVATORY
 
 
 class TraceCache:
@@ -45,6 +46,9 @@ class TraceCache:
         self.hits = 0
         self.misses = 0
         self.retraces = 0
+        #: entries dropped by the LRU bound — manifest coverage vs cache
+        #: pressure: a prewarm manifest larger than the cache limit churns
+        self.evictions = 0
         #: wall seconds spent inside calls that traced (trace + XLA compile)
         self.trace_s = 0.0
         #: audit hook (verify.cache_key_audit): called as audit(key, build)
@@ -62,20 +66,33 @@ class TraceCache:
                 self._fns.move_to_end(key)
                 self.hits += 1
                 return fn
-        fn = build()
+        # miss: a trace+compile is coming — open the structured compile
+        # event (the launch site attributes wall/bucket/fragment at close)
+        ev = OBSERVATORY.open_miss(key)
+        try:
+            fn = build()
+        except BaseException:
+            # a failed build never compiles: withdraw the open event so the
+            # NEXT traced launch doesn't inherit it (and its wall share)
+            OBSERVATORY.abort(ev)
+            raise
         with self._lock:
             self.misses += 1
             self._fns[key] = fn
             while len(self._fns) > self.limit:
                 self._fns.popitem(last=False)
+                self.evictions += 1
         return fn
 
     def stats(self) -> dict:
+        with self._lock:  # len(dict) during a concurrent resize is racy
+            entries = len(self._fns)
         return {
-            "entries": len(self._fns),
+            "entries": entries,
             "hits": self.hits,
             "misses": self.misses,
             "retraces": self.retraces,
+            "evictions": self.evictions,
             "trace_s": round(self.trace_s, 4),
         }
 

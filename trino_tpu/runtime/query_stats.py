@@ -19,8 +19,17 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from trino_tpu.telemetry import NULL_TRACER, now
-from trino_tpu.telemetry.metrics import mesh_events_counter
+from trino_tpu.telemetry.metrics import (
+    collective_bytes_counter,
+    mesh_events_counter,
+)
 
+
+#: collective kinds that move bytes across the mesh interconnect — only
+#: these bump the aggregate collective_bytes (pre-existing semantics:
+#: all_to_all repartitions + all_gather broadcasts, now plus the psum
+#: dynamic-filter reduce); "gather" attributions are host pulls
+COLLECTIVE_KINDS = ("all_to_all", "all_gather", "reduce")
 
 #: phase vocabulary of the mesh fragment profile (order = render order)
 MESH_PHASES = ("trace", "compute", "collective", "transfer", "other")
@@ -43,6 +52,15 @@ class FragmentStats:
     bytes_to_device: int = 0
     bytes_to_host: int = 0
     collective_bytes: int = 0
+    #: per-collective attribution: (kind, purpose) -> bytes.  Entries whose
+    #: kind is a mesh collective (COLLECTIVE_KINDS) also land in
+    #: collective_bytes, so the collective breakdown sums to the aggregate
+    #: by construction (the Q3 "collective/expand bound" claim as a
+    #: measured per-collective split, not one undifferentiated number).
+    #: "gather" entries are host-side pulls — attributed here for the
+    #: purpose split but NOT in collective_bytes (full-batch gathers are
+    #: already counted in bytes_to_host; tiny capacity syncs never were).
+    collective_by: dict = field(default_factory=dict)
 
     def close(self) -> None:
         tracked = sum(v for k, v in self.phases.items() if k != "other")
@@ -52,12 +70,18 @@ class FragmentStats:
         ph = " ".join(
             f"{k}={self.phases.get(k, 0.0) * 1e3:.1f}ms" for k in MESH_PHASES
         )
+        by = ""
+        if self.collective_by:
+            by = " " + " ".join(
+                f"{k}/{p}={b}"
+                for (k, p), b in sorted(self.collective_by.items())
+            )
         return (
             f"Fragment {self.fragment_id} [{self.kind}] "
             f"wall={self.wall_s * 1e3:.1f}ms {ph} "
             f"bytes(to_device={self.bytes_to_device} "
             f"to_host={self.bytes_to_host} "
-            f"collective={self.collective_bytes})"
+            f"collective={self.collective_bytes}{by})"
         )
 
     def to_json(self) -> dict:
@@ -71,6 +95,10 @@ class FragmentStats:
             "bytes_to_device": self.bytes_to_device,
             "bytes_to_host": self.bytes_to_host,
             "collective_bytes": self.collective_bytes,
+            "collective_bytes_by": {
+                f"{k}/{p}": b
+                for (k, p), b in sorted(self.collective_by.items())
+            },
         }
 
 
@@ -108,6 +136,21 @@ class MeshProfile:
         # metrics registry (served at /v1/metrics), labeled by counter name
         mesh_events_counter().labels(counter).inc(n)
 
+    def add_collective(self, fid: int, nbytes: int, kind: str,
+                       purpose: str) -> None:
+        """Attribute collective/gather traffic: bumps the fragment's
+        (kind, purpose) breakdown and the labeled
+        trino_tpu_collective_bytes_total series, and — for mesh-collective
+        kinds only — the aggregate collective_bytes.  ONE path, so the
+        collective entries always sum to the aggregate, and host-side
+        gathers (already in bytes_to_host) never inflate it."""
+        st = self.fragment(fid)
+        if kind in COLLECTIVE_KINDS:
+            st.collective_bytes += nbytes
+        key = (kind, purpose)
+        st.collective_by[key] = st.collective_by.get(key, 0) + nbytes
+        collective_bytes_counter().labels(kind, purpose).inc(nbytes)
+
     @contextmanager
     def phase(self, fid: int, name: str):
         """Time a phase of fragment `fid` (caller blocks inside the window
@@ -124,6 +167,14 @@ class MeshProfile:
     def add_phase(self, fid: int, name: str, seconds: float) -> None:
         st = self.fragment(fid)
         st.phases[name] = st.phases.get(name, 0.0) + seconds
+
+    def collective_totals(self) -> dict:
+        """Query-wide (kind, purpose) -> bytes summed over fragments."""
+        totals: dict = {}
+        for st in self.fragments.values():
+            for key, b in st.collective_by.items():
+                totals[key] = totals.get(key, 0) + b
+        return totals
 
     def phase_totals(self) -> dict:
         """Query-wide per-phase seconds summed over fragments (the
@@ -152,6 +203,14 @@ class MeshProfile:
                     f"{k}={v}" for k, v in sorted(self.counters.items())
                 )
             )
+        coll = self.collective_totals()
+        if coll:
+            lines.append(
+                "  collective bytes: "
+                + " ".join(
+                    f"{k}/{p}={b}" for (k, p), b in sorted(coll.items())
+                )
+            )
         return "\n".join(lines)
 
     def to_json(self) -> dict:
@@ -166,6 +225,10 @@ class MeshProfile:
                 "retraces": self.retraces,
             },
             "counters": dict(self.counters),
+            "collective_bytes_by": {
+                f"{k}/{p}": b
+                for (k, p), b in sorted(self.collective_totals().items())
+            },
         }
 
 

@@ -267,6 +267,17 @@ class LocalQueryRunner:
         self.last_trace = tracer.to_chrome_trace()
         self.traces.append((qid, tracer.flat_spans()))
 
+    def compile_manifest(self) -> list:
+        """The deduplicated (step, bucket, mesh) compile-key set this
+        process's workload has needed, with per-key compile seconds — the
+        compile observatory's prewarm manifest (the enumeration input for
+        AOT prewarm / ROADMAP item 3; dumped by tools/prewarm_manifest.py).
+        A workload whose warm replays add zero entries has a closed key
+        set: prewarming exactly this manifest makes its cold start warm."""
+        from trino_tpu.telemetry.compile_events import OBSERVATORY
+
+        return OBSERVATORY.manifest()
+
     def _query_statistics(self, wall_s: float, rows: int, tracer,
                           prof_before=None):
         """Build the QueryStatistics event payload from the execution's

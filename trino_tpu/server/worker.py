@@ -73,6 +73,12 @@ class TaskDescriptor:
     #: worker never outlives the query that scheduled it (reference:
     #: HttpRemoteTask's per-request deadline derivation)
     deadline_s: Optional[float] = None
+    #: coordinator trace context: (query_id, parent span id) — rides the
+    #: descriptor the same way deadline_s does (the W3C traceparent analog
+    #: of the reference's opentelemetry context propagation).  The worker
+    #: opens its task/execution spans under it and serves the finished tree
+    #: at GET /v1/task/{id}/spans for the coordinator to merge.
+    trace_context: Optional[tuple] = None
 
 
 class _FilteringConnector:
@@ -107,6 +113,10 @@ class _Task:
         self.state = "RUNNING"
         self.error: Optional[str] = None
         self.buckets: list = []
+        #: nested span tree of this task's execution (Span.to_dict form),
+        #: set at completion when the descriptor carried a trace context;
+        #: the coordinator grafts it under its fragment span
+        self.spans: Optional[dict] = None
         #: per-output-symbol (lo, hi) value bounds of this task's result
         #: (the dynamic-filter summary the coordinator may collect)
         self.ranges: dict = {}
@@ -204,6 +214,24 @@ class WorkerServer:
                 if (
                     len(parts) == 4
                     and parts[:2] == ["v1", "task"]
+                    and parts[3] == "spans"
+                ):
+                    # cross-host tracing pull: the finished task's span tree
+                    # (Span.to_dict form, worker-local clock) for the
+                    # coordinator to graft under its fragment span; null
+                    # when the descriptor carried no trace context
+                    t = worker._tasks.get(parts[2])
+                    if t is None:
+                        return self._bytes(404, b"no such task", "text/plain")
+                    t.done.wait(timeout=_result_wait_s(t))
+                    import json as _json
+
+                    return self._bytes(
+                        200, _json.dumps(t.spans).encode(), "application/json"
+                    )
+                if (
+                    len(parts) == 4
+                    and parts[:2] == ["v1", "task"]
                     and parts[3] == "dynamic"
                 ):
                     t = worker._tasks.get(parts[2])
@@ -277,14 +305,24 @@ class WorkerServer:
             reset_current,
             set_current,
         )
+        from trino_tpu.telemetry import NULL_TRACER, SpanTracer
 
         self._slots.acquire()
         # publish the task's lifecycle handle in THIS worker thread: the
         # execution's cooperative checks and its input-pull HTTP timeouts
         # (request_timeout) derive from the task deadline
         token = set_current(t.lifecycle)
+        # cross-host tracing: the descriptor's trace context makes this
+        # task's spans part of the coordinator's query trace (PR-4 carried
+        # gap: multi-host tasks emitted no spans at all)
+        tc = t.desc.trace_context
+        tracer = SpanTracer(query_id=tc[0]) if tc else NULL_TRACER
         try:
-            t.buckets, t.ranges = self._execute(t.desc)
+            with tracer.span(
+                "task", task_id=t.desc.task_id, worker=self.url,
+                coordinator_span=(tc[1] if tc else None),
+            ):
+                t.buckets, t.ranges = self._execute(t.desc, tracer=tracer)
             t.state = "FINISHED"
         except QueryAbortedException as e:
             t.state = "CANCELED"
@@ -293,11 +331,13 @@ class WorkerServer:
             t.state = "FAILED"
             t.error = traceback.format_exc()
         finally:
+            if tracer.enabled and tracer.root is not None:
+                t.spans = tracer.root.to_dict()
             reset_current(token)
             self._slots.release()
             t.done.set()
 
-    def _execute(self, desc: TaskDescriptor) -> list:
+    def _execute(self, desc: TaskDescriptor, tracer=None) -> list:
         from trino_tpu.columnar.batch import concat_batches
         from trino_tpu.parallel.serde import (
             batches_to_bytes,
@@ -310,7 +350,9 @@ class WorkerServer:
             PhysicalPlan,
         )
         from trino_tpu.runtime.session import SessionProperties
+        from trino_tpu.telemetry import NULL_TRACER
 
+        tracer = tracer if tracer is not None else NULL_TRACER
         catalogs = self.catalogs
         if desc.split_mod is not None:
             index, total = desc.split_mod
@@ -331,19 +373,26 @@ class WorkerServer:
         def hook(node):
             if isinstance(node, RemoteSourceNode):
                 batches = []
-                for url in desc.inputs.get(node.fragment_id, ()):
-                    batches.extend(bytes_to_batches(_http_get(url)))
+                # input pulls are the task's DCN wait: a distinct span per
+                # remote source so the merged cross-host timeline separates
+                # exchange stall from fragment compute
+                with tracer.span(
+                    "input_fetch", source_fragment=node.fragment_id
+                ):
+                    for url in desc.inputs.get(node.fragment_id, ()):
+                        batches.extend(bytes_to_batches(_http_get(url)))
                 return PhysicalPlan(iter(batches), node.symbols)
             return saved(node)
 
         lp.plan = hook
-        out = lp.plan(desc.fragment_root)
         from trino_tpu.runtime.lifecycle import check_current
 
-        batches = []
-        for b in out.stream:
-            check_current()  # canceled/expired tasks abort between batches
-            batches.append(b)
+        with tracer.span("execute_fragment", task_id=desc.task_id):
+            out = lp.plan(desc.fragment_root)
+            batches = []
+            for b in out.stream:
+                check_current()  # canceled/expired tasks abort between batches
+                batches.append(b)
         if not batches:
             empty = [batches_to_bytes([])] * (
                 desc.output_partitioning[1] if desc.output_partitioning else 1

@@ -1,0 +1,271 @@
+"""Compile observatory: every SPMD trace-cache miss as a structured event.
+
+Reference roles: the reference's per-operator OperatorStats record *where*
+time went, and its event stream records *which* tasks did what — but an
+XLA-backed engine has a cost class the reference never had: trace + XLA
+compile stalls, keyed by (step semantics, shape bucket, mesh).  Cold walls
+are compile-dominated (Q6 SF10 mesh-8: 76.6 s cold vs 12.7 s warm) and
+`TRACE_CACHE.trace_s` was one undifferentiated number, so nothing could say
+WHICH keys cost what or what a prewarm pass should compile.
+
+This module is the single home for that attribution:
+
+  * `OBSERVATORY` — a process-wide ring of `CompileEvent`s.  `TraceCache.get`
+    opens an event on every miss (key fingerprint, step label, mesh
+    signature, owning query); the launch site that detects the trace closes
+    it with the measured wall seconds, shape bucket, and owning fragment
+    (`parallel/runner._call`), mirroring each close into the
+    `trino_tpu_compile_seconds` histogram.  A warm replay records ZERO new
+    events — an assertable fact, not an assumption.
+  * the **prewarm manifest** — the deduplicated (step, bucket, mesh) key set
+    a workload has needed, with per-key compile seconds.  This is the
+    enumeration input for ROADMAP item 3's AOT prewarm: compile exactly
+    these keys at server start / after mesh resize instead of paying them at
+    first query.  `LocalQueryRunner.compile_manifest()` and
+    `tools/prewarm_manifest.py` expose it.
+  * `system.runtime.compilations` — the ring as a SQL table
+    (connectors/system.py), so compile cost is queryable from the engine's
+    own prompt like every other runtime surface.
+
+Everything here is host-side bookkeeping on the compile (miss) path only:
+a cache hit never touches the lock, so the observatory cannot perturb the
+warm path `verify.device_residency` gates.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Optional
+
+from trino_tpu.runtime.lifecycle import current_query
+from trino_tpu.telemetry.spans import now
+
+#: recent-event ring size (the system.runtime.compilations window)
+RING_LIMIT = 512
+#: distinct compile keys the manifest tracks before evicting oldest
+MANIFEST_LIMIT = 4096
+
+
+def key_fingerprint(key) -> str:
+    """Stable short fingerprint of a trace-cache key (manifest identity)."""
+    return hashlib.blake2s(repr(key).encode()).hexdigest()[:16]
+
+
+def _parse_key(key) -> tuple:
+    """(step label, mesh signature) best-effort from a trace-cache key.
+
+    `cached_spmd_step` keys are ("spmd", collective, out_replicated,
+    mesh_key, <caller key...>) where the caller key leads with a string tag
+    ("chain", "fused_exchange", "locate", ...) — the step label of the
+    compile event."""
+    step: str = "?"
+    mesh: tuple = ()
+    rest = key if isinstance(key, tuple) else (key,)
+    if len(rest) >= 4 and rest[0] == "spmd":
+        if isinstance(rest[3], tuple):
+            mesh = rest[3]
+        rest = rest[4:]
+    for el in rest:
+        if isinstance(el, str):
+            step = el
+            break
+    return step, mesh
+
+
+@dataclass
+class CompileEvent:
+    """One trace-cache miss: a program this process had to trace+compile."""
+
+    seq: int
+    step: str
+    key_fp: str
+    #: truncated repr of the full cache key (debug/manifest readability)
+    key: str
+    #: mesh signature the program was compiled for (workers, device ids)
+    mesh: tuple
+    #: trailing row capacity of the launch's first stacked batch (the pow2
+    #: shape bucket); None until the launch site closes the event
+    bucket: Optional[int] = None
+    query_id: str = ""
+    fragment: Optional[int] = None
+    #: trace + XLA compile wall seconds (attributed at close)
+    wall_s: float = 0.0
+    #: telemetry.now() timestamp of the miss
+    at_s: float = 0.0
+    closed: bool = False
+
+
+class CompileObservatory:
+    """Process-wide compile-event ring + prewarm manifest (see module doc).
+
+    Protocol: `open_miss(key)` on every trace-cache miss; the launch site
+    that detects its call traced closes ALL open events with
+    `close_open(dt, ...)` — the engine dispatches one launch at a time, so
+    every open event belongs to the imminent traced launch (the miss fires
+    when the program is BUILT, which precedes the instrumented call).  A
+    traced call with no open event (a jit retrace under an existing key)
+    synthesizes a `retrace` event so compile seconds never vanish from the
+    record."""
+
+    def __init__(self, ring_limit: int = RING_LIMIT,
+                 manifest_limit: int = MANIFEST_LIMIT):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=ring_limit)
+        #: events awaiting wall attribution by their launch site
+        self._open: list = []
+        #: key_fp -> manifest entry (insertion-ordered for bounded eviction)
+        self._manifest: OrderedDict = OrderedDict()
+        self._manifest_limit = manifest_limit
+        #: events ever opened (monotonic — the warm-replay-zero assertion)
+        self.count = 0
+        #: total attributed compile wall seconds (monotonic)
+        self.total_wall_s = 0.0
+
+    # -- recording ------------------------------------------------------------
+
+    def mark(self) -> int:
+        """Watermark for close_since (the current event count)."""
+        return self.count
+
+    def open_miss(self, key) -> CompileEvent:
+        """Record a trace-cache miss (called by TraceCache.get)."""
+        step, mesh = _parse_key(key)
+        ctx = current_query()
+        ev = CompileEvent(
+            seq=0,
+            step=step,
+            key_fp=key_fingerprint(key),
+            key=repr(key)[:240],
+            mesh=mesh,
+            query_id=ctx.query_id if ctx is not None else "",
+            at_s=now(),
+        )
+        with self._lock:
+            self.count += 1
+            ev.seq = self.count
+            self._ring.append(ev)
+            self._open.append(ev)
+            self._note_open(ev)
+        return ev
+
+    def abort(self, ev: CompileEvent) -> None:
+        """Withdraw an open event whose build raised (nothing compiled):
+        remove it from the pending set so the next traced launch doesn't
+        inherit its attribution.  The ring keeps the row (wall 0.0,
+        closed=False) — the attempt is part of the record."""
+        with self._lock:
+            if ev in self._open:
+                self._open.remove(ev)
+
+    def close_open(self, wall_s: float, bucket: Optional[int] = None,
+                   fragment: Optional[int] = None, mesh: tuple = ()) -> list:
+        """Attribute `wall_s` to every open event; returns them.
+        Synthesizes a `retrace` event when a traced call opened none (jax
+        retraced an existing key on a new shape/aux signature)."""
+        with self._lock:
+            events, self._open = self._open, []
+            if not events:
+                ctx = current_query()
+                self.count += 1
+                ev = CompileEvent(
+                    seq=self.count,
+                    step="retrace",
+                    key_fp="",
+                    key="",
+                    mesh=mesh,
+                    query_id=ctx.query_id if ctx is not None else "",
+                    at_s=now(),
+                )
+                self._ring.append(ev)
+                self._note_open(ev)
+                events = [ev]
+            share = wall_s / len(events)
+            for ev in events:
+                ev.wall_s = share
+                ev.closed = True
+                if ev.bucket is None:
+                    ev.bucket = bucket
+                if ev.fragment is None:
+                    ev.fragment = fragment
+                self.total_wall_s += share
+                self._note_close(ev)
+        from trino_tpu.telemetry.metrics import compile_seconds_histogram
+
+        hist = compile_seconds_histogram()
+        for ev in events:
+            hist.observe(ev.wall_s)
+        return events
+
+    # -- manifest (the AOT prewarm enumeration) -------------------------------
+
+    def _note_open(self, ev: CompileEvent) -> None:
+        fp = ev.key_fp or f"retrace:{ev.step}"
+        entry = self._manifest.get(fp)
+        if entry is None:
+            entry = self._manifest[fp] = {
+                "key_fp": fp,
+                "step": ev.step,
+                "mesh": str(ev.mesh),
+                "key": ev.key,
+                "buckets": set(),
+                "count": 0,
+                "compile_s": 0.0,
+            }
+            while len(self._manifest) > self._manifest_limit:
+                self._manifest.popitem(last=False)
+        entry["count"] += 1
+
+    def _note_close(self, ev: CompileEvent) -> None:
+        fp = ev.key_fp or f"retrace:{ev.step}"
+        entry = self._manifest.get(fp)
+        if entry is None:  # evicted under manifest pressure
+            return
+        entry["compile_s"] += ev.wall_s
+        if ev.bucket is not None:
+            entry["buckets"].add(int(ev.bucket))
+
+    def manifest(self) -> list:
+        """The deduplicated compile-key set this process has needed, most
+        expensive first: [{key_fp, step, mesh, key, buckets, count,
+        compile_s}].  The prewarm input for ROADMAP item 3."""
+        with self._lock:
+            entries = [
+                dict(e, buckets=sorted(e["buckets"]),
+                     compile_s=round(e["compile_s"], 4))
+                for e in self._manifest.values()
+            ]
+        return sorted(entries, key=lambda e: (-e["compile_s"], e["step"]))
+
+    # -- export ---------------------------------------------------------------
+
+    def events(self) -> list:
+        """Recent events, oldest first (the ring window)."""
+        with self._lock:
+            return list(self._ring)
+
+    def rows(self) -> list:
+        """system.runtime.compilations feed: (seq, step, bucket, mesh,
+        query_id, fragment, wall_s, key_fp, key) per recent event."""
+        return [
+            (
+                e.seq, e.step, e.bucket, str(e.mesh), e.query_id,
+                e.fragment, round(e.wall_s, 6), e.key_fp, e.key,
+            )
+            for e in self.events()
+        ]
+
+    def clear(self) -> None:
+        """Drop all recorded state (tests only)."""
+        with self._lock:
+            self._ring.clear()
+            self._open = []
+            self._manifest.clear()
+            self.count = 0
+            self.total_wall_s = 0.0
+
+
+#: the process-wide observatory (one engine process per host)
+OBSERVATORY = CompileObservatory()

@@ -382,6 +382,25 @@ MESH_COUNTER_NAMES = (
 )
 
 
+#: (kind, purpose) label pairs pre-registered on the per-collective byte
+#: counter so scrapes see the attribution vocabulary before any query runs;
+#: runner/exchange call sites bump through MeshProfile.add_collective.
+COLLECTIVE_VOCABULARY = (
+    ("all_to_all", "repartition"),
+    ("all_gather", "broadcast"),
+    ("reduce", "dynamic_filter"),
+    ("gather", "capacity_sizing"),
+    ("gather", "result_gather"),
+    ("gather", "host_gather"),
+)
+
+
+def _compile_events_total():
+    from trino_tpu.telemetry.compile_events import OBSERVATORY
+
+    return OBSERVATORY.count
+
+
 def _trace_cache_series(stat: str):
     def read():
         from trino_tpu.parallel.spmd import TRACE_CACHE
@@ -448,10 +467,31 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
         _breaker_series,
         labelnames=("worker",),
     )
+    reg.histogram(
+        _PREFIX + "compile_seconds",
+        "wall seconds per SPMD trace+XLA-compile (compile observatory "
+        "events; see system.runtime.compilations)",
+    )
+    reg.gauge_fn(
+        _PREFIX + "compile_events_total",
+        "trace-cache misses recorded by the compile observatory "
+        "(zero new events on warm replays)",
+        _compile_events_total,
+        kind_hint="counter",
+    )
+    collective = reg.counter(
+        _PREFIX + "collective_bytes_total",
+        "bytes moved by mesh collectives/gathers, by collective kind and "
+        "purpose (the per-collective split of MeshProfile collective_bytes)",
+        labelnames=("kind", "purpose"),
+    )
+    for kind, purpose in COLLECTIVE_VOCABULARY:
+        collective.touch(kind, purpose)
     for stat, hint in (
         ("hits", "counter"),
         ("misses", "counter"),
         ("retraces", "counter"),
+        ("evictions", "counter"),
     ):
         reg.gauge_fn(
             _PREFIX + f"trace_cache_{stat}_total",
@@ -517,6 +557,17 @@ def memory_kills_counter() -> Counter:
 
 def breaker_trips_counter() -> Counter:
     return REGISTRY.counter(_PREFIX + "breaker_trips_total")
+
+
+def compile_seconds_histogram() -> Histogram:
+    """Per-event compile wall (bumped by the compile observatory)."""
+    return REGISTRY.histogram(_PREFIX + "compile_seconds")
+
+
+def collective_bytes_counter() -> Counter:
+    """The labeled per-collective byte counter MeshProfile.add_collective
+    mirrors into."""
+    return REGISTRY.counter(_PREFIX + "collective_bytes_total")
 
 
 _register_engine_metrics(REGISTRY)

@@ -140,9 +140,11 @@ class SpanTracer:
         return _OpenSpan(self, sp)
 
     def record(self, name: str, start_s: float, end_s: float,
-               attrs: Optional[dict] = None) -> None:
+               attrs: Optional[dict] = None) -> Optional[Span]:
         """Append an already-measured leaf span under the innermost open
-        span (launch sites know their duration only after the fact)."""
+        span (launch sites know their duration only after the fact).
+        Returns the span so the caller can attach() children to it (compile
+        stalls nest under their launch)."""
         parent = self._stack[-1] if self._stack else self.root
         sp = Span(
             next(self._ids),
@@ -156,6 +158,35 @@ class SpanTracer:
             parent.children.append(sp)
         elif self.root is None:
             self.root = sp
+        return sp
+
+    def attach(self, parent: Span, name: str, start_s: float, end_s: float,
+               attrs: Optional[dict] = None) -> Span:
+        """Graft an already-closed span under an explicit parent (compile
+        child spans of a launch; worker span trees merged under the
+        coordinator's fragment span by the multi-host scheduler)."""
+        sp = Span(next(self._ids), parent.span_id, name, start_s, attrs)
+        sp.end_s = end_s
+        parent.children.append(sp)
+        return sp
+
+    def graft(self, parent: Span, tree: dict, offset_s: float = 0.0) -> Span:
+        """Merge a foreign span tree (Span.to_dict form — e.g. a worker
+        task's spans pulled over HTTP) under `parent`, re-issuing span ids
+        from THIS tracer so the merged trace has one id space.  `offset_s`
+        shifts the foreign clock onto ours: worker `now()` readings are
+        per-process perf counters with unrelated epochs, so the caller
+        anchors the foreign root at a locally-observed instant (task
+        submission) and every descendant keeps its relative position."""
+        start = float(tree["start_s"]) + offset_s
+        sp = self.attach(
+            parent, tree["name"], start,
+            start + float(tree.get("duration_ms", 0.0)) / 1e3,
+            dict(tree.get("attrs") or {}),
+        )
+        for child in tree.get("children", ()):
+            self.graft(sp, child, offset_s)
+        return sp
 
     # -- export ---------------------------------------------------------------
 
@@ -246,6 +277,12 @@ class NullTracer:
         return _NULL_CTX
 
     def record(self, name, start_s, end_s, attrs=None) -> None:
+        pass
+
+    def attach(self, parent, name, start_s, end_s, attrs=None) -> None:
+        pass
+
+    def graft(self, parent, tree, offset_s=0.0) -> None:
         pass
 
     def flat_spans(self) -> list:
