@@ -11,6 +11,7 @@ real injected latency.  The deterministic-clock halves of the machinery
 choice) run in tier-1 via tests/test_lifecycle.py.
 """
 
+import threading
 import time
 import urllib.request
 
@@ -269,6 +270,169 @@ def test_chaos_coordinator_delete_while_queued(workers):
         assert q2.state == "CANCELED"
     finally:
         server.shutdown()
+
+
+def test_chaos_worker_killed_mid_query_replans_at_w_minus_1(local):
+    """The tentpole's acceptance bar: a worker dying MID-QUERY (tasks
+    already placed on it) triggers mesh-shrink re-planning — the query
+    re-fragments against the survivors (W-1) and still answers rows ==
+    local inside the deadline, instead of retrying forever against the
+    corpse."""
+    ws = [WorkerServer(port=0).start() for _ in range(3)]
+    victim = ws[2]
+    killed = {"done": False}
+    orig = FAILURE_INJECTOR.maybe_fail
+
+    def kill_hook(point):
+        # first data-plane pull: the victim dies under the running query
+        if point.startswith("fetch:") and not killed["done"]:
+            killed["done"] = True
+            threading.Thread(target=victim.shutdown, daemon=True).start()
+            time.sleep(0.2)  # let the socket actually close
+        return orig(point)
+
+    FAILURE_INJECTOR.maybe_fail = kill_hook
+    try:
+        mh = MultiHostQueryRunner(
+            [w.url for w in ws], catalog="tpch", schema="tiny"
+        )
+        mh.properties.set("query_max_run_time", DEADLINE_S)
+        sql = QUERIES[1]
+        t0 = time.monotonic()
+        got = mh.execute(sql).rows
+        wall = time.monotonic() - t0
+        assert wall < DEADLINE_S
+        assert_rows_match(got, local.execute(sql).rows, ordered=False)
+        assert killed["done"], "the kill hook never fired"
+        assert mh.membership.state(victim.url) == "DEAD"
+        assert len(mh.last_plan_workers) == 2, mh.last_plan_workers
+        # the shrunk mesh is stable: the next query plans at W-1 directly
+        FAILURE_INJECTOR.maybe_fail = orig
+        got = mh.execute(sql).rows
+        assert_rows_match(got, local.execute(sql).rows, ordered=False)
+        assert mh.last_replans == 0 and len(mh.last_plan_workers) == 2
+    finally:
+        FAILURE_INJECTOR.maybe_fail = orig
+        for w in ws:
+            try:
+                w.shutdown()
+            except Exception:
+                pass
+
+
+def test_chaos_drain_mid_query_finishes_or_replans(local):
+    """Graceful drain landing mid-query: the draining worker finishes its
+    running tasks but refuses new submissions (503/REFUSED, no breaker
+    vote), so the query either completes on the old mesh or re-plans
+    without the drainee — rows == local either way, inside the deadline."""
+    ws = [WorkerServer(port=0).start() for _ in range(3)]
+    drainee = ws[1]
+    drained = {"done": False}
+    orig = FAILURE_INJECTOR.maybe_fail
+
+    def drain_hook(point):
+        # drain lands while the coordinator is mid-submission fan-out
+        if point.startswith(f"submit:{drainee.url}") and not drained["done"]:
+            drained["done"] = True
+            drainee.begin_drain(exit_on_idle=False)
+        return orig(point)
+
+    FAILURE_INJECTOR.maybe_fail = drain_hook
+    try:
+        mh = MultiHostQueryRunner(
+            [w.url for w in ws], catalog="tpch", schema="tiny"
+        )
+        mh.properties.set("query_max_run_time", DEADLINE_S)
+        for sql in QUERIES:
+            t0 = time.monotonic()
+            got = mh.execute(sql).rows
+            wall = time.monotonic() - t0
+            assert wall < DEADLINE_S
+            assert_rows_match(got, local.execute(sql).rows, ordered=False)
+        assert drained["done"], "the drain hook never fired"
+        # the drain was by choice, not failure: no breaker opened for it
+        assert BREAKERS.states().get(drainee.url, "closed") != "open"
+        assert drainee.url not in mh.last_plan_workers
+    finally:
+        FAILURE_INJECTOR.maybe_fail = orig
+        for w in ws:
+            try:
+                w.shutdown()
+            except Exception:
+                pass
+
+
+def test_chaos_grow_mid_query_joins_next_mesh_only(local):
+    """A worker registering while a query runs never mutates the running
+    mesh: the in-flight query completes on the mesh it was planned for,
+    and the NEW worker serves from the next query on."""
+    ws = [WorkerServer(port=0).start() for _ in range(2)]
+    w3 = WorkerServer(port=0).start()
+    try:
+        mh = MultiHostQueryRunner(
+            [w.url for w in ws], catalog="tpch", schema="tiny"
+        )
+        mh.properties.set("query_max_run_time", DEADLINE_S)
+        # stall the data plane so the grow lands mid-flight
+        FAILURE_INJECTOR.inject_latency("fetch:", delay_s=0.5, times=2)
+        grown = threading.Timer(0.2, mh.add_worker, args=(w3.url,))
+        grown.start()
+        sql = QUERIES[0]
+        got = mh.execute(sql).rows
+        grown.join()
+        assert_rows_match(got, local.execute(sql).rows, ordered=False)
+        assert w3.url not in mh.last_plan_workers, (
+            "a grow must never join a running query's mesh"
+        )
+        # ... but the next query's mesh includes it
+        FAILURE_INJECTOR.clear()
+        got = mh.execute(sql).rows
+        assert_rows_match(got, local.execute(sql).rows, ordered=False)
+        assert w3.url in mh.last_plan_workers
+        assert len(mh.last_plan_workers) == 3
+    finally:
+        for w in ws + [w3]:
+            try:
+                w.shutdown()
+            except Exception:
+                pass
+
+
+def test_chaos_membership_sweep_kill_each_worker(local):
+    """Kill sweep: whichever worker dies mid-query, the answer is rows ==
+    local or a classified failure — never a hang, never wrong rows."""
+    for victim_idx in range(3):
+        ws = [WorkerServer(port=0).start() for _ in range(3)]
+        orig = FAILURE_INJECTOR.maybe_fail
+        fired = {"done": False}
+
+        def kill_hook(point, _v=ws[victim_idx]):
+            if point.startswith("fetch:") and not fired["done"]:
+                fired["done"] = True
+                threading.Thread(target=_v.shutdown, daemon=True).start()
+                time.sleep(0.2)
+            return orig(point)
+
+        FAILURE_INJECTOR.maybe_fail = kill_hook
+        try:
+            BREAKERS.reset()
+            mh = MultiHostQueryRunner(
+                [w.url for w in ws], catalog="tpch", schema="tiny"
+            )
+            mh.properties.set("query_max_run_time", DEADLINE_S)
+            wall, got = _run_bounded(mh, local, QUERIES[2])
+            assert wall < DEADLINE_S, f"victim {victim_idx} blew the deadline"
+            assert got is not None, (
+                f"victim {victim_idx}: a single death must be absorbed by "
+                "mesh-shrink re-planning"
+            )
+        finally:
+            FAILURE_INJECTOR.maybe_fail = orig
+            for w in ws:
+                try:
+                    w.shutdown()
+                except Exception:
+                    pass
 
 
 def test_chaos_fte_stage_failures_and_latency(local):

@@ -188,7 +188,11 @@ def test_request_timeout_uses_contextvar():
 
 
 def test_result_wait_bounded_by_task_deadline():
-    from trino_tpu.server.worker import RESULT_WAIT_S, TaskDescriptor, _Task
+    from trino_tpu.server.worker import (
+        TaskDescriptor,
+        _Task,
+        result_wait_default,
+    )
 
     def task(deadline):
         return _Task(
@@ -200,9 +204,12 @@ def test_result_wait_bounded_by_task_deadline():
 
     from trino_tpu.server.worker import _result_wait_s
 
-    assert _result_wait_s(task(None)) == RESULT_WAIT_S
+    # the unbounded default now comes from the typed config
+    # (worker.result-wait; compiled-in default = PR 5's 600 s)
+    assert result_wait_default() == 600.0
+    assert _result_wait_s(task(None)) == result_wait_default()
     assert _result_wait_s(task(5.0)) == pytest.approx(5.0, abs=0.5)
-    assert _result_wait_s(task(10_000.0)) == RESULT_WAIT_S
+    assert _result_wait_s(task(10_000.0)) == result_wait_default()
     assert _result_wait_s(task(0.0)) == 0.001  # already expired: don't hang
     # the bound SHRINKS as the task ages: a late re-fetch must not pin a
     # server thread past the query's death

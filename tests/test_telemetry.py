@@ -395,6 +395,7 @@ def test_phase_totals_rollup():
 
 def _clean_extra():
     return {
+        "membership": _clean_membership(),
         "mesh": {
             "sf1": {
                 "error": None,
@@ -408,7 +409,20 @@ def _clean_extra():
                     "join_speculative_retry": 0,
                 },
             }
-        }
+        },
+    }
+
+
+def _clean_membership():
+    return {
+        "workers": 3,
+        "baseline": {"rows_match": True, "plan_workers": 3, "replans": 0},
+        "shrink": {"rows_match": True, "plan_workers": 2, "replans": 1},
+        "grow": {"rows_match": True, "plan_workers": 3, "replans": 0},
+        "post_roundtrip_warm": {
+            "rows_match": True, "plan_workers": 3, "replans": 0, "retraces": 0,
+        },
+        "run_error": None,
     }
 
 
@@ -433,7 +447,42 @@ def test_compare_bench_flags_drift():
 def test_compare_bench_skips_errored_sections():
     extra = {"mesh": {"sf1": {"error": "mesh child rc=1"}}}
     violations, skipped = _compare_bench().check_extra(extra)
-    assert violations == [] and len(skipped) == 1
+    # the errored mesh section AND the absent membership section are both
+    # reported as skips, never as violations
+    assert violations == []
+    assert any("mesh child rc=1" in s for s in skipped)
+    assert any("membership" in s for s in skipped)
+
+
+def test_compare_bench_membership_gate():
+    """The shrink->grow round-trip gate (PR 7): every attempt must match
+    local, the shrink must have re-planned, the grow must restore W, and
+    the post-round-trip warm repeat must be clean."""
+    check_extra = _compare_bench().check_extra
+    bad = {"membership": _clean_membership()}
+    bad["membership"]["shrink"]["replans"] = 0
+    bad["membership"]["grow"]["plan_workers"] = 2
+    bad["membership"]["post_roundtrip_warm"]["retraces"] = 1
+    bad["membership"]["baseline"]["rows_match"] = False
+    violations, _ = check_extra(bad)
+    assert any("shrink.replans" in v for v in violations)
+    assert any("grow.plan_workers" in v for v in violations)
+    assert any("retraces" in v for v in violations)
+    assert any("baseline.rows_match" in v for v in violations)
+    # an errored membership bench is a skip, not a drift
+    violations, skipped = check_extra(
+        {"membership": {"run_error": "no workers"}}
+    )
+    assert violations == [] and any("no workers" in s for s in skipped)
+    # a MISSING attempt section is flagged exactly once (no follow-up
+    # counter violations computed over an empty dict)
+    partial = {"membership": _clean_membership()}
+    del partial["membership"]["shrink"]
+    violations, _ = check_extra(partial)
+    assert [v for v in violations if "shrink" in v] == [
+        "membership.shrink missing (round trip incomplete — re-run "
+        "tools/membership_bench.py)"
+    ]
 
 
 def test_compare_bench_snapshot_gate():

@@ -14,7 +14,11 @@ alone may hide (a retrace can cost little on tiny data and 30x on SF10):
     layouts the probe repartition is elided (PR 3);
   * `q3_counters.join_capacity_sync == 0` and
     `q3_counters.join_speculative_retry == 0` — the warm speculative join
-    neither blocks on capacities nor retries its expand.
+    neither blocks on capacities nor retries its expand;
+  * `membership.*` (tools/membership_bench.py): every attempt of the
+    shrink->grow round trip matches local, the shrink re-planned, the grow
+    restored W, and the post-round-trip warm repeat re-plans and retraces
+    NOTHING (PR 7 — membership churn must not dirty the warm path).
 
 Modes:
   python tools/compare_bench.py                 # gate the checked-in file
@@ -73,6 +77,67 @@ SNAPSHOT_ZERO_LABELS = (
 )
 
 
+#: membership round-trip (tools/membership_bench.py) invariants: every
+#: attempt of the shrink->grow story must match local, the shrink must
+#: actually have re-planned, the grow must restore the full W, and the warm
+#: repeat after the round trip must be clean (no re-plans, no retraces) —
+#: membership churn must not leave the warm path dirty
+MEMBERSHIP_ATTEMPTS = ("baseline", "shrink", "grow", "post_roundtrip_warm")
+
+
+def check_membership(sec: dict) -> tuple:
+    """-> (violations, skipped) over the BENCH_EXTRA `membership` section
+    (the shrink->grow round trip tools/membership_bench.py records)."""
+    violations: list[str] = []
+    skipped: list[str] = []
+    if sec.get("run_error"):
+        skipped.append(f"membership: bench errored: {sec['run_error']}")
+        return violations, skipped
+    for name in MEMBERSHIP_ATTEMPTS:
+        att = sec.get(name)
+        if not isinstance(att, dict):
+            violations.append(f"membership.{name} missing (round trip "
+                              "incomplete — re-run tools/membership_bench.py)")
+            continue
+        if att.get("rows_match") is not True:
+            violations.append(
+                f"membership.{name}.rows_match = {att.get('rows_match')} "
+                "(expected true: every membership state must answer rows "
+                "== local)"
+            )
+    # counter checks only on sections that exist — a missing section was
+    # already flagged above, a second violation over {} is noise
+    shrink = sec.get("shrink")
+    if isinstance(shrink, dict) and shrink.get("replans", 0) < 1:
+        violations.append(
+            "membership.shrink.replans = "
+            f"{shrink.get('replans', 0)} (expected >= 1: the kill must "
+            "have triggered mesh-shrink re-planning)"
+        )
+    workers = sec.get("workers")
+    grow = sec.get("grow")
+    if (
+        isinstance(grow, dict)
+        and workers is not None
+        and grow.get("plan_workers") != workers
+    ):
+        violations.append(
+            f"membership.grow.plan_workers = {grow.get('plan_workers')} "
+            f"(expected {workers}: the grown worker must rejoin the next "
+            "query's mesh)"
+        )
+    warm = sec.get("post_roundtrip_warm")
+    if isinstance(warm, dict):
+        for counter in ("replans", "retraces"):
+            if warm.get(counter, 0) != 0:
+                violations.append(
+                    f"membership.post_roundtrip_warm.{counter} = "
+                    f"{warm[counter]} (expected 0: a shrink->grow round "
+                    "trip must leave the warm path clean)"
+                )
+    return violations, skipped
+
+
 def _dig(d: dict, path: tuple):
     cur = d
     for p in path:
@@ -86,6 +151,15 @@ def check_extra(extra: dict) -> tuple:
     """-> (violations, skipped) over every mesh schema section."""
     violations: list[str] = []
     skipped: list[str] = []
+    membership = extra.get("membership")
+    if isinstance(membership, dict):
+        mv, ms = check_membership(membership)
+        violations.extend(mv)
+        skipped.extend(ms)
+    else:
+        skipped.append(
+            "no membership section recorded (run tools/membership_bench.py)"
+        )
     mesh = extra.get("mesh")
     if not isinstance(mesh, dict):
         skipped.append("no mesh section recorded (run bench.py --mesh)")

@@ -75,10 +75,17 @@ RULES = {
     "raw-http-timeout": "hardcoded timeout literal on an intra-cluster "
                         "call — derive it from the query deadline "
                         "(lifecycle.request_timeout) or a named constant",
+    "module-level-knob": "module/class-level numeric knob literal — load "
+                         "it from the typed config (trino_tpu/config) so "
+                         "deployments can tune it without a code change",
 }
 
 #: rules that only make sense in device code (ops/parallel/expr)
-_DEVICE_RULES = frozenset(RULES) - {"raw-http-timeout"}
+_DEVICE_RULES = frozenset(RULES) - {"raw-http-timeout", "module-level-knob"}
+#: files whose tunables must ALL live in the typed config: PR 5 flagged the
+#: fixed breaker/retry knobs in the remote tier, PR 7 moved them into
+#: trino_tpu/config — this rule keeps new numeric knobs from creeping back
+_KNOB_FREE_PATHS = ("trino_tpu/parallel/remote.py",)
 #: the HTTP tier: every socket wait must be bounded by what the query has
 #: left to live (runtime/lifecycle.request_timeout), so numeric timeout
 #: literals are flagged here (reference: HttpRemoteTask deriving every
@@ -91,7 +98,10 @@ def _rules_for_path(path: str) -> frozenset:
     http = any(h in p for h in _HTTP_PATHS)
     if "trino_tpu/server/" in p:
         return frozenset({"raw-http-timeout"})
-    return frozenset(RULES) if http else _DEVICE_RULES
+    rules = frozenset(RULES) if http else _DEVICE_RULES
+    if not any(k in p for k in _KNOB_FREE_PATHS):
+        rules = rules - {"module-level-knob"}
+    return rules
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
 
@@ -157,11 +167,50 @@ class _Linter(ast.NodeVisitor):
         self.generic_visit(node)
         self._scopes.pop()
 
-    visit_FunctionDef = _visit_scope
-    visit_AsyncFunctionDef = _visit_scope
+    def _visit_fn_scope(self, node) -> None:
+        self._fn_depth += 1
+        self._visit_scope(node)
+        self._fn_depth -= 1
+
+    visit_FunctionDef = _visit_fn_scope
+    visit_AsyncFunctionDef = _visit_fn_scope
     visit_ClassDef = _visit_scope
 
+    #: nesting depth inside function bodies (0 = module/class level)
+    _fn_depth = 0
+
     # -- rules ----------------------------------------------------------------
+
+    @staticmethod
+    def _numeric_constant(node) -> bool:
+        if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)
+        ):
+            node = node.operand
+        return (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool)
+        )
+
+    def _check_knob(self, node, value) -> None:
+        """module/class-level `NAME = <number>` in a knob-free file: the
+        tunable belongs in the typed config, not in code."""
+        if self._fn_depth == 0 and self._numeric_constant(value):
+            self._flag(
+                "module-level-knob", node,
+                "numeric knob literal at module/class level; declare it in "
+                "trino_tpu/config (a ConfigSection knob) instead",
+            )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check_knob(node, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_knob(node, node.value)
+        self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
         fn = node.func

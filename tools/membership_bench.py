@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Record a shrink->grow membership round trip into BENCH_EXTRA.json.
+
+The elastic-membership acceptance evidence (PR 7): on a W-worker multi-host
+cluster,
+
+  1. baseline  — a query answers rows == local at W;
+  2. shrink    — a worker is killed; the SAME query re-plans at W-1
+                 (mesh-shrink re-planning, >= 1 replan) and still matches;
+  3. grow      — a replacement worker registers (PUT /v1/worker/register
+                 semantics, here via the runner API) and the next query
+                 plans at W again with ZERO replans;
+  4. post_roundtrip_warm — a warm repeat at the restored W re-plans nothing
+                 and retraces nothing: membership churn must not leave the
+                 warm path dirty (`tools/compare_bench.py` gates these
+                 counters at zero).
+
+Writes the `membership` section of BENCH_EXTRA.json (merged, never
+rewriting sibling sections) and prints it to stdout.
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/membership_bench.py
+  python tools/membership_bench.py --workers 3 --no-record   # stdout only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+SQL = (
+    "select l_returnflag, count(*), sum(l_extendedprice) "
+    "from lineitem group by l_returnflag"
+)
+
+
+def _trace_stats() -> dict:
+    from trino_tpu.parallel.spmd import TRACE_CACHE
+
+    return TRACE_CACHE.stats()
+
+
+def run_round_trip(n_workers: int = 3, sql: str = SQL, schema: str = "tiny") -> dict:
+    from trino_tpu.parallel.remote import MultiHostQueryRunner
+    from trino_tpu.runtime.retry import BREAKERS
+    from trino_tpu.runtime.runner import LocalQueryRunner
+    from trino_tpu.server.worker import WorkerServer
+    from trino_tpu.telemetry.metrics import membership_events_counter
+
+    local = LocalQueryRunner(catalog="tpch", schema=schema)
+    want = sorted(local.execute(sql).rows)
+
+    def attempt(mh) -> dict:
+        got = sorted(mh.execute(sql).rows)
+        return {
+            "rows_match": got == want,
+            "plan_workers": len(mh.last_plan_workers),
+            "replans": mh.last_replans,
+        }
+
+    ws = [WorkerServer(port=0).start() for _ in range(n_workers)]
+    replacement = None
+    try:
+        mh = MultiHostQueryRunner(
+            [w.url for w in ws], catalog="tpch", schema=schema
+        )
+        baseline = attempt(mh)
+
+        # shrink: kill the last worker; the query discovers the corpse and
+        # re-plans at W-1 (fresh probe evidence — the TTL cache would hide
+        # the death for remote.probe-ttl seconds, which is correct in
+        # production and noise here)
+        ws[-1].shutdown()
+        mh._worker_health.clear()
+        BREAKERS.reset()
+        shrink = attempt(mh)
+
+        # grow: a replacement registers and serves from the NEXT query on
+        replacement = WorkerServer(port=0).start()
+        mh.add_worker(replacement.url)
+        grow = attempt(mh)
+
+        # warm repeat at the restored W: a stable mesh re-plans nothing,
+        # and the trace cache must not retrace across the churn
+        t0 = _trace_stats()
+        warm = attempt(mh)
+        t1 = _trace_stats()
+        warm["retraces"] = t1.get("retraces", 0) - t0.get("retraces", 0)
+
+        counter = membership_events_counter()
+        events = {
+            kind: counter.value((kind,))
+            for kind in ("join", "drain", "death", "rejoin", "shrink_replan")
+        }
+        return {
+            "workers": n_workers,
+            "sql": sql,
+            "baseline": baseline,
+            "shrink": shrink,
+            "grow": grow,
+            "post_roundtrip_warm": warm,
+            "events": events,
+            "run_error": None,
+        }
+    finally:
+        for w in ws[:-1] + ([replacement] if replacement else []):
+            try:
+                w.shutdown()
+            except Exception:
+                pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="shrink->grow membership round trip into BENCH_EXTRA.json"
+    )
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--schema", default="tiny")
+    ap.add_argument(
+        "--no-record", action="store_true",
+        help="print the section without merging it into BENCH_EXTRA.json",
+    )
+    args = ap.parse_args(argv)
+    try:
+        section = run_round_trip(args.workers, schema=args.schema)
+    except Exception as exc:  # a bench that cannot run is recorded, not hidden
+        section = {"run_error": f"{type(exc).__name__}: {exc}"[:500]}
+    print(json.dumps({"membership": section}, indent=1))
+    if not args.no_record:
+        from bench import _merge_extra
+
+        _merge_extra({"membership": section})
+    return 0 if section.get("run_error") is None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,9 +12,19 @@ By default every statement runs twice and the tool FAILS (exit 2) if the
 second pass still compiles anything: a manifest is only a usable prewarm
 input when the workload's key set is closed under replay.
 
+Capacity learning counts as COLD: a speculative join's first run measures
+its tight output capacity (partitioning/speculative.CAP_HISTORY) and the
+next run compiles the fused expand at that bucket — so a run that LEARNED a
+capacity (CAP_HISTORY.version moved) gets one follow-up cold run before
+the closure watermark.  The learned entries are persisted in the manifest
+(`cap_history`); seeding them back (`--seed prior_manifest.json`, what a
+prewarm executor does at server start) makes the key set close on run 1 —
+the Q3 gap PR 6's observatory surfaced.
+
 Usage:
   python tools/prewarm_manifest.py --schema tiny --workers 8 --queries 1,6,3
   python tools/prewarm_manifest.py --sql "select count(*) from lineitem" -o m.json
+  python tools/prewarm_manifest.py --queries 3 --seed m.json   # closes on run 1
 """
 
 from __future__ import annotations
@@ -46,6 +56,12 @@ def main(argv=None) -> int:
         help="executions per statement; >= 2 proves the key set is closed "
         "(the non-first passes must add zero compile events)",
     )
+    ap.add_argument(
+        "--seed", default=None,
+        help="prior manifest JSON whose cap_history seeds the speculative-"
+        "join capacity history before running (the prewarm-executor path: "
+        "capacity-learning statements then close on run 1)",
+    )
     ap.add_argument("-o", "--out", default=None, help="output file (default: stdout)")
     args = ap.parse_args(argv)
 
@@ -65,13 +81,38 @@ def main(argv=None) -> int:
 
     from trino_tpu.connectors.tpch.queries import QUERIES
     from trino_tpu.parallel import DistributedQueryRunner
+    from trino_tpu.partitioning import CAP_HISTORY
     from trino_tpu.telemetry.compile_events import OBSERVATORY
+
+    if args.seed:
+        with open(args.seed, "r", encoding="utf-8") as fh:
+            seeded = CAP_HISTORY.seed(json.load(fh).get("cap_history"))
+        print(f"prewarm_manifest: seeded {seeded} capacity entries",
+              file=sys.stderr)
 
     runner = DistributedQueryRunner(n_workers=args.workers, schema=args.schema)
     stmts = args.sql or [QUERIES[int(q)] for q in args.queries.split(",")]
     warm_events = 0
     for sql in stmts:
+        # cold phase: the first run, PLUS one follow-up per run that
+        # LEARNED a speculative-join capacity — the next run compiles the
+        # fused expand at the learned bucket, which is part of the closed
+        # key set, not a closure failure (seeded histories learn nothing
+        # and go straight to the watermark)
+        cap_version = CAP_HISTORY.version
         runner.execute(sql)
+        extra = 0
+        while CAP_HISTORY.version != cap_version and extra < 4:
+            cap_version = CAP_HISTORY.version
+            runner.execute(sql)
+            extra += 1
+        if extra:
+            print(
+                f"prewarm_manifest: {extra} capacity-learning run(s) before "
+                "the closure watermark (seed a prior manifest to close on "
+                "run 1)",
+                file=sys.stderr,
+            )
         mark = OBSERVATORY.mark()
         for _ in range(max(1, args.runs) - 1):
             runner.execute(sql)
@@ -85,6 +126,10 @@ def main(argv=None) -> int:
         "compile_s": round(OBSERVATORY.total_wall_s, 4),
         "warm_replay_events": warm_events,
         "manifest": runner.compile_manifest(),
+        # learned speculative-join capacities: seed these back (--seed, or
+        # the prewarm executor at server start) so the first run takes the
+        # fused path at the right bucket and the key set closes on run 1
+        "cap_history": CAP_HISTORY.snapshot(),
     }
     text = json.dumps(doc, indent=1, default=str)
     if args.out:

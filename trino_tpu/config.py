@@ -1,0 +1,350 @@
+"""Typed engine configuration (the airlift ``@Config`` analog, SURVEY §5.6).
+
+PR 5 left every robustness knob a process-wide constant: the circuit-breaker
+trip threshold (3) and half-open cooldown (5 s) were baked into
+`runtime/retry.py`, the HTTP-tier timeouts into `runtime/lifecycle.py`, and
+the remote retry budgets into `parallel/remote.py`.  This module replaces
+them with declarative config classes — one dataclass per subsystem, every
+field carrying its properties key — loaded from a ``config.properties``
+file (the launcher etc/ layout `runtime/config.py` already parses) with
+environment-variable overrides, exactly the reference's
+``io.airlift.configuration`` binding order.
+
+Resolution order for a knob (first hit wins):
+
+  1. environment: ``TRINO_TPU_<KEY>`` with ``.``/``-`` -> ``_`` and
+     uppercased (``breaker.failure-threshold`` ->
+     ``TRINO_TPU_BREAKER_FAILURE_THRESHOLD``);
+  2. per-worker override: ``<key>@<token>`` where ``<token>`` is a
+     substring of the worker id/url (``breaker.failure-threshold@8123=5``
+     tunes only the worker whose url contains ``8123``);
+  3. the properties file: ``<key>=<value>``;
+  4. the dataclass default — the PR 5 constants, so behaviour is unchanged
+     when nothing is set.
+
+The process-wide instance is ``get_config()``; ``install_config`` /
+``load_config`` swap it (``runtime/config.load_etc`` installs one from
+``etc/config.properties`` automatically) and ``reset_config`` restores
+defaults for tests.  Consumers read through the accessor at USE time, so a
+late install still takes effect (breakers are created lazily per worker).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+ENV_PREFIX = "TRINO_TPU_"
+
+
+def knob(default, key: str, help: str = ""):
+    """A config field bound to a properties key (the ``@Config`` marker)."""
+    return field(default=default, metadata={"key": key, "help": help})
+
+
+def _env_name(key: str) -> str:
+    return ENV_PREFIX + key.upper().replace(".", "_").replace("-", "_")
+
+
+def _coerce(value: str, typ: type):
+    if typ is bool:
+        low = str(value).strip().lower()
+        if low in ("true", "yes", "on", "1"):
+            return True
+        if low in ("false", "no", "off", "0"):
+            return False
+        raise ValueError(f"not a boolean: {value!r}")
+    return typ(value)
+
+
+class ConfigSection:
+    """Base for typed config dataclasses: `from_properties` resolves every
+    `knob()` field through env > per-worker override > properties > default."""
+
+    @classmethod
+    def from_properties(cls, props: Optional[dict] = None, env=None,
+                        worker: Optional[str] = None):
+        props = props or {}
+        env = os.environ if env is None else env
+        values = {}
+        for f in fields(cls):
+            key = f.metadata.get("key")
+            if key is None:
+                continue
+            typ = type(f.default)
+            raw = env.get(_env_name(key))
+            if raw is None and worker is not None:
+                raw = _worker_override(props, key, worker)
+            if raw is None:
+                raw = props.get(key)
+            if raw is None:
+                continue
+            try:
+                values[f.name] = _coerce(raw, typ)
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"bad value for config key {key!r}: {raw!r}"
+                ) from e
+        return cls(**values)
+
+    def describe(self) -> list:
+        """[(properties key, value, help)] — the config's SQL/debug view."""
+        out = []
+        for f in fields(self):
+            key = f.metadata.get("key")
+            if key is not None:
+                out.append((key, getattr(self, f.name), f.metadata.get("help", "")))
+        return out
+
+
+def _worker_override(props: dict, key: str, worker: str) -> Optional[str]:
+    """``<key>@<token>`` entries whose token occurs in the worker id win
+    over the base key (longest matching token wins — the most specific
+    override).  Tokens are substrings because worker ids are urls and the
+    properties syntax cannot carry ``:`` inside a key."""
+    best = None
+    best_len = -1
+    prefix = key + "@"
+    for k, v in props.items():
+        if not k.startswith(prefix):
+            continue
+        token = k[len(prefix):]
+        if token and token in worker and len(token) > best_len:
+            best, best_len = v, len(token)
+    return best
+
+
+# -- subsystem sections --------------------------------------------------------
+
+
+@dataclass
+class BreakerConfig(ConfigSection):
+    """Per-worker circuit breakers on the multi-host HTTP tier (PR 5's
+    fixed knobs, now loadable; reference: the failure-detection half of
+    HttpRemoteTask)."""
+
+    failure_threshold: int = knob(
+        3, "breaker.failure-threshold",
+        "consecutive failures before a worker's breaker trips OPEN",
+    )
+    cooldown_s: float = knob(
+        5.0, "breaker.cooldown",
+        "seconds an OPEN breaker holds traffic before one half-open probe",
+    )
+
+
+@dataclass
+class HeartbeatConfig(ConfigSection):
+    """Coordinator-side heartbeat failure detection (reference:
+    failuredetector/HeartbeatFailureDetector)."""
+
+    interval_s: float = knob(
+        1.0, "heartbeat.interval",
+        "seconds between failure-detector probe rounds",
+    )
+    miss_threshold: int = knob(
+        3, "heartbeat.miss-threshold",
+        "consecutive missed probes before a worker is declared DEAD",
+    )
+    probe_timeout_s: float = knob(
+        5.0, "heartbeat.probe-timeout",
+        "per-probe HTTP timeout (GET /v1/info)",
+    )
+
+
+@dataclass
+class LifecycleConfig(ConfigSection):
+    """HTTP-tier timeout bounds (PR 5's lifecycle constants): every socket
+    wait is additionally capped by the executing query's remaining run time
+    via `lifecycle.request_timeout`."""
+
+    request_timeout_s: float = knob(
+        600.0, "lifecycle.request-timeout",
+        "default per-request HTTP bound when no query deadline caps it",
+    )
+    submit_timeout_s: float = knob(
+        60.0, "lifecycle.submit-timeout",
+        "task submission POST bound (small body, worker answers fast)",
+    )
+    cancel_timeout_s: float = knob(
+        10.0, "lifecycle.cancel-timeout",
+        "best-effort task cancel DELETE bound",
+    )
+    probe_timeout_s: float = knob(
+        5.0, "lifecycle.probe-timeout",
+        "worker liveness probe bound (GET /v1/info)",
+    )
+
+
+@dataclass
+class RemoteConfig(ConfigSection):
+    """Coordinator-side remote scheduling knobs (parallel/remote.py — the
+    module the no-module-level-knob lint now keeps literal-free)."""
+
+    submit_attempts: int = knob(
+        3, "remote.submit-attempts",
+        "transient-submit retries against one worker before it is "
+        "declared gone (REFUSED skips them)",
+    )
+    fetch_attempts: int = knob(
+        3, "remote.fetch-attempts",
+        "transient result-fetch retries against the SAME worker before "
+        "task replacement",
+    )
+    probe_ttl_s: float = knob(
+        15.0, "remote.probe-ttl",
+        "seconds a cached liveness-probe verdict stays fresh",
+    )
+    backoff_base_s: float = knob(
+        0.05, "remote.backoff-base",
+        "full-jitter backoff base for submit/fetch retries",
+    )
+    backoff_cap_s: float = knob(
+        1.0, "remote.backoff-cap",
+        "full-jitter backoff ceiling for submit/fetch retries",
+    )
+    max_replans: int = knob(
+        8, "remote.max-replans",
+        "mesh-shrink re-planning attempts per query before giving up",
+    )
+
+
+@dataclass
+class WorkerConfig(ConfigSection):
+    """Worker-server execution knobs (server/worker.py)."""
+
+    max_concurrent_tasks: int = knob(
+        4, "worker.max-concurrent-tasks",
+        "tasks running concurrently on one worker (TaskExecutor slots)",
+    )
+    result_wait_s: float = knob(
+        600.0, "worker.result-wait",
+        "result long-poll bound when a task carries no deadline",
+    )
+    status_wait_s: float = knob(
+        1.0, "worker.status-wait",
+        "task status long-poll bound",
+    )
+    drain_task_wait_s: float = knob(
+        600.0, "worker.drain-task-wait",
+        "max seconds graceful drain waits on each running task",
+    )
+    drain_grace_s: float = knob(
+        5.0, "worker.drain-grace",
+        "seconds a drained server lingers after its last task finishes so "
+        "downstream consumers can still pull its results",
+    )
+
+
+@dataclass
+class CoordinatorConfig(ConfigSection):
+    """Coordinator protocol knobs (server/coordinator.py)."""
+
+    result_page_rows: int = knob(
+        4096, "coordinator.result-page-rows",
+        "rows per paged statement response",
+    )
+    poll_wait_s: float = knob(
+        1.0, "coordinator.poll-wait",
+        "statement/trace long-poll bound",
+    )
+
+
+@dataclass
+class MemoryConfig(ConfigSection):
+    """Shared-pool memory knobs (runtime/lifecycle LowMemoryKiller)."""
+
+    pool_limit_bytes: int = knob(
+        0, "memory.pool-limit-bytes",
+        "shared device-memory pool limit arming the low-memory killer "
+        "(0 = unlimited)",
+    )
+
+
+@dataclass
+class ClusterConfig:
+    """All subsystem sections plus the raw properties (kept for per-worker
+    override resolution at breaker-creation time)."""
+
+    breaker: BreakerConfig = field(default_factory=BreakerConfig)
+    heartbeat: HeartbeatConfig = field(default_factory=HeartbeatConfig)
+    lifecycle: LifecycleConfig = field(default_factory=LifecycleConfig)
+    remote: RemoteConfig = field(default_factory=RemoteConfig)
+    worker: WorkerConfig = field(default_factory=WorkerConfig)
+    coordinator: CoordinatorConfig = field(default_factory=CoordinatorConfig)
+    memory: MemoryConfig = field(default_factory=MemoryConfig)
+    properties: dict = field(default_factory=dict)
+
+    def breaker_for(self, worker: str) -> BreakerConfig:
+        """Breaker knobs for ONE worker: base config plus any
+        ``breaker.<knob>@<token>`` overrides matching its id."""
+        return BreakerConfig.from_properties(
+            self.properties, env=self._env, worker=worker
+        )
+
+    #: env mapping captured at load so breaker_for stays reproducible
+    _env = None
+
+
+def load_cluster_config(props: Optional[dict] = None, env=None) -> ClusterConfig:
+    """Build a ClusterConfig from a properties dict (e.g. the parsed
+    ``etc/config.properties``) + environment overrides."""
+    props = dict(props or {})
+    env = os.environ if env is None else env
+    cfg = ClusterConfig(
+        breaker=BreakerConfig.from_properties(props, env),
+        heartbeat=HeartbeatConfig.from_properties(props, env),
+        lifecycle=LifecycleConfig.from_properties(props, env),
+        remote=RemoteConfig.from_properties(props, env),
+        worker=WorkerConfig.from_properties(props, env),
+        coordinator=CoordinatorConfig.from_properties(props, env),
+        memory=MemoryConfig.from_properties(props, env),
+        properties=props,
+    )
+    cfg._env = env
+    return cfg
+
+
+def load_config(path: Optional[str] = None, props: Optional[dict] = None,
+                env=None) -> ClusterConfig:
+    """Load + install the process config from a .properties file path or a
+    dict; returns the installed ClusterConfig."""
+    if path is not None:
+        from trino_tpu.runtime.config import load_properties
+
+        props = load_properties(path)
+    cfg = load_cluster_config(props, env)
+    install_config(cfg)
+    return cfg
+
+
+# -- process-wide instance -----------------------------------------------------
+
+_LOCK = threading.Lock()
+_CURRENT = ClusterConfig()
+
+
+def get_config() -> ClusterConfig:
+    """The installed process configuration (defaults when none loaded)."""
+    return _CURRENT
+
+
+def install_config(cfg: ClusterConfig) -> None:
+    global _CURRENT
+    with _LOCK:
+        _CURRENT = cfg
+    # memory knob takes effect on install (the only eager side effect —
+    # everything else is read at use time)
+    if cfg.memory.pool_limit_bytes:
+        from trino_tpu.runtime.lifecycle import set_memory_pool_limit
+
+        set_memory_pool_limit(cfg.memory.pool_limit_bytes)
+
+
+def reset_config() -> None:
+    """Restore compiled-in defaults (tests only)."""
+    global _CURRENT
+    with _LOCK:
+        _CURRENT = ClusterConfig()

@@ -123,6 +123,11 @@ _TABLES = {
     "nodes": [
         ("node_id", T.VARCHAR),
         ("state", T.VARCHAR),
+        # seconds since the worker's last successful heartbeat (NULL when
+        # the node never heartbeat — e.g. local mesh devices)
+        ("heartbeat_age_s", T.DOUBLE),
+        # the worker's circuit-breaker state (closed | half_open | open)
+        ("breaker_state", T.VARCHAR),
     ],
     "session_properties": [
         ("name", T.VARCHAR),
@@ -251,16 +256,30 @@ class SystemConnector(Connector):
 
             return REGISTRY.rows()
         if table == "nodes":
+            # cluster membership (runtime/membership) is authoritative when
+            # present: worker id, ACTIVE|DRAINING|DEAD, heartbeat age, and
+            # the worker's breaker state in one row
+            membership = getattr(r, "membership", None)
+            if membership is not None:
+                return list(membership.snapshot())
             det = getattr(r, "failure_detector", None)
-            if det is not None:
+            if det is not None and hasattr(det, "failed_workers"):
                 failed = det.failed_workers()
+                clk = det.clock()
                 return [
-                    (w, "FAILED" if w in failed else "ACTIVE")
+                    (
+                        w,
+                        "DEAD" if w in failed else "ACTIVE",
+                        round(clk - det._last[w], 3),
+                        None,
+                    )
                     for w in sorted(det._last)
                 ]
             import jax
 
-            return [(str(d.id), "ACTIVE") for d in jax.devices()]
+            return [
+                (str(d.id), "ACTIVE", None, None) for d in jax.devices()
+            ]
         if table == "session_properties":
             return [
                 (name, str(value), meta.description)

@@ -22,6 +22,7 @@ import pickle
 import urllib.request
 from typing import Optional, Sequence
 
+from trino_tpu.config import get_config
 from trino_tpu.connectors.api import CatalogManager
 from trino_tpu.planner import plan as P
 from trino_tpu.planner.fragmenter import (
@@ -36,14 +37,15 @@ from trino_tpu.planner.fragmenter import (
     create_subplans,
 )
 from trino_tpu.runtime import lifecycle
-from trino_tpu.runtime.lifecycle import (
-    CANCEL_TIMEOUT_S,
-    PROBE_TIMEOUT_S,
-    SUBMIT_TIMEOUT_S,
-    QueryAbortedException,
-    check_current,
-)
+from trino_tpu.runtime.lifecycle import QueryAbortedException, check_current
 from trino_tpu.runtime.local_planner import LocalExecutionPlanner, PhysicalPlan
+from trino_tpu.runtime.membership import (
+    ClusterMembership,
+    HeartbeatDetector,
+    MeshChangedError,
+    WorkerDrainingError,
+    invalidate_mesh_scans,
+)
 from trino_tpu.runtime.retry import BREAKERS, FAILURE_INJECTOR, RETRYABLE, Backoff
 from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
 from trino_tpu.server.worker import TaskDescriptor, _http_get
@@ -51,10 +53,10 @@ from trino_tpu.telemetry import now
 
 _DIST = (SOURCE, FIXED_HASH, FIXED_ARBITRARY)
 
-#: transient-submit retry budget against one worker before it is declared
-#: dead and the task moves on (REFUSED/RESET skips the retries — that
-#: worker is definitively gone)
-SUBMIT_ATTEMPTS = 3
+# NOTE: this module deliberately holds NO module-level numeric knobs — the
+# transient submit/fetch retry budgets, probe-verdict TTL, and backoff
+# bounds all live in the typed config (trino_tpu/config: remote.*), and the
+# `module-level-knob` lint rule (tools/lint_tpu.py) keeps it that way.
 
 
 def _is_refused(exc: BaseException) -> bool:
@@ -99,10 +101,23 @@ class RemoteTaskClient:
         req = urllib.request.Request(
             f"{self.worker_url}/v1/task", data=body, headers=headers, method="POST"
         )
-        with urllib.request.urlopen(
-            req, timeout=lifecycle.request_timeout(SUBMIT_TIMEOUT_S)
-        ) as r:
-            r.read()
+        try:
+            with urllib.request.urlopen(
+                req,
+                timeout=lifecycle.request_timeout(
+                    get_config().lifecycle.submit_timeout_s
+                ),
+            ) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                # graceful drain: the worker is healthy but leaving — the
+                # REFUSED classification (skip retries against it) without
+                # a breaker vote
+                raise WorkerDrainingError(
+                    f"{self.worker_url} is draining"
+                ) from None
+            raise
 
     def state(self) -> str:
         body = _http_get(f"{self.worker_url}/v1/task/{self.task_id}").decode()
@@ -136,7 +151,9 @@ class RemoteTaskClient:
             f"{self.worker_url}/v1/task/{self.task_id}", method="DELETE"
         )
         try:
-            with urllib.request.urlopen(req, timeout=CANCEL_TIMEOUT_S) as r:
+            with urllib.request.urlopen(
+                req, timeout=get_config().lifecycle.cancel_timeout_s
+            ) as r:
                 r.read()
         except Exception:
             pass
@@ -145,7 +162,15 @@ class RemoteTaskClient:
 class MultiHostQueryRunner(LocalQueryRunner):
     """Executes queries across worker servers (urls).  The workers must be
     able to reconstruct catalog data from configuration (generator/file
-    connectors) — coordinator-resident state (memory tables) stays local."""
+    connectors) — coordinator-resident state (memory tables) stays local.
+
+    Cluster membership (runtime/membership) makes the worker set MUTABLE:
+    `add_worker` registers a new worker that joins the NEXT query's mesh
+    (never a running one), `drain_worker` gracefully retires one, and a
+    worker discovered dead or draining mid-query triggers mesh-shrink
+    re-planning — the query's fragments re-plan against the shrunk set
+    (W-1) and replay (pull exchanges re-read deterministically) instead of
+    retrying forever against a corpse."""
 
     def __init__(
         self,
@@ -161,6 +186,49 @@ class MultiHostQueryRunner(LocalQueryRunner):
         #: per-query scheduling doesn't pay serial HTTP probes (reference:
         #: the background HeartbeatFailureDetector, polled not per-query)
         self._worker_health: dict = {}
+        #: coordinator-side membership registry: every query's mesh is the
+        #: ACTIVE set at ITS start (grow/drain/death visible to the next
+        #: query; a running one re-plans on MeshChangedError)
+        self.membership = ClusterMembership(self.worker_urls)
+        #: heartbeat failure detector over the registry; `tick()` manually
+        #: or `start()` a background probe loop (heartbeat.interval)
+        self.failure_detector = HeartbeatDetector(self.membership)
+        #: mesh-shrink re-plans performed by the LAST statement (evidence)
+        self.last_replans = 0
+        #: worker set the LAST statement's plan was fragmented against
+        self.last_plan_workers: list = []
+
+    # -- membership (grow / drain) --------------------------------------------
+
+    def add_worker(self, url: str) -> None:
+        """Grow path: register a worker; it serves from the next query on
+        (reference: DiscoveryNodeManager announcement)."""
+        if url not in self.worker_urls:
+            self.worker_urls.append(url)
+        self.membership.register(url)
+        self._worker_health.pop(url, None)
+
+    def drain_worker(self, url: str) -> None:
+        """Gracefully retire a worker: PUT /v1/worker/shutdown (it finishes
+        running tasks, refuses new ones, exits) and mark it DRAINING so the
+        next query's mesh excludes it."""
+        from trino_tpu.server.worker import cluster_secret, sign_body
+
+        headers = {}
+        secret = cluster_secret()
+        if secret is not None:
+            headers["X-Cluster-Auth"] = sign_body(secret, b"")
+        req = urllib.request.Request(
+            f"{url}/v1/worker/shutdown", headers=headers, method="PUT"
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=get_config().lifecycle.cancel_timeout_s
+            ) as r:
+                r.read()
+        except Exception:
+            pass  # already gone: membership still records the intent
+        self.membership.drain(url)
 
     # -- execution ------------------------------------------------------------
 
@@ -168,28 +236,105 @@ class MultiHostQueryRunner(LocalQueryRunner):
         if stats is not None:
             return super()._run_query(query, stats=stats)
         plan = self.plan_query(query)
+        if self._system_only(plan):
+            # system tables are coordinator-resident (the reference's
+            # GlobalSystemConnector): membership/metrics/query state live in
+            # THIS process, and workers don't even mount the catalog —
+            # execute locally instead of distributing the scan
+            return self._execute_local(plan)
+        self.last_replans = 0
+        max_replans = get_config().remote.max_replans
+        while True:
+            check_current()  # canceled queries stop re-planning too
+            workers = self.membership.active_workers()
+            if not workers:
+                raise RuntimeError("no live workers")
+            try:
+                return self._execute_on(plan, workers)
+            except MeshChangedError as e:
+                # mesh-shrink re-planning: record the membership change,
+                # drop caches keyed by the old mesh, and re-fragment the
+                # query against the survivors (W-1).  Spooled/pull
+                # exchanges make the replay deterministic; layouts whose
+                # bucket_count no longer divides the new W lose their
+                # placement claims at re-plan time (scan_partitioning).
+                for w in e.dead:
+                    # mark_dead itself skips the breaker trip for DRAINING
+                    # workers (their exit is the drain completing by choice)
+                    self.membership.mark_dead(w)
+                    self._worker_health[w] = (_monotonic(), False)
+                for w in e.drained:
+                    self.membership.drain(w)
+                if self.last_replans >= max_replans:
+                    raise RuntimeError(
+                        f"query re-planned {self.last_replans} times without "
+                        f"a stable mesh (last change: {e})"
+                    ) from e
+                self.last_replans += 1
+                invalidate_mesh_scans()
+                from trino_tpu.telemetry.metrics import (
+                    membership_events_counter,
+                )
+
+                membership_events_counter().labels("shrink_replan").inc()
+
+    @staticmethod
+    def _system_only(plan) -> bool:
+        """True when every table the plan scans is a system catalog table
+        (then there is at least one scan — pure-values plans distribute
+        fine and stay on the normal path)."""
+        from trino_tpu.planner.plan import TableScanNode, walk
+
+        catalogs = {
+            n.handle.catalog
+            for n in walk(plan)
+            if isinstance(n, TableScanNode)
+        }
+        return catalogs == {"system"}
+
+    def _execute_local(self, plan) -> MaterializedResult:
+        """Run an already-planned query in-process on the coordinator."""
+        self._check_table_access(plan)
+        return self._execute_plan(plan)
+
+    def _execute_on(self, plan, workers: list) -> MaterializedResult:
+        """One scheduling attempt against a FIXED worker set (the mesh a
+        membership change never mutates — it re-plans instead)."""
+        self.last_plan_workers = list(workers)
         # colocate=False: HTTP workers shard scans by split_mod, not by the
         # exchange hash — layout placements would be claims the data plane
         # does not realize (the in-process mesh runner is the elision home)
         dplan = add_exchanges(
             plan, self.catalogs, self.properties,
-            n_workers=len(self.worker_urls), colocate=False,
+            n_workers=len(workers), colocate=False,
         )
         sub = create_subplans(dplan, properties=self.properties)
-        sched = _StageScheduler(self)
-        with self._tracer.span("execute"):
-            out = sched.run(sub)
-            rows = []
-            for batch in out.stream:
-                check_current()  # cancel/deadline between result batches
-                rows.extend(tuple(r) for r in batch.to_pylist())
-            # tasks are complete (results are pulled eagerly): merge their
-            # span trees so GET /v1/query/{id}/trace renders ONE cross-host
-            # timeline with coordinator AND worker spans
-            sched.collect_spans()
+        sched = _StageScheduler(self, workers)
+        try:
+            with self._tracer.span("execute"):
+                out = sched.run(sub)
+                rows = []
+                for batch in out.stream:
+                    check_current()  # cancel/deadline between result batches
+                    rows.extend(tuple(r) for r in batch.to_pylist())
+                # tasks are complete (results are pulled eagerly): merge
+                # their span trees so GET /v1/query/{id}/trace renders ONE
+                # cross-host timeline with coordinator AND worker spans
+                sched.collect_spans()
+        except MeshChangedError:
+            # abandon this attempt cleanly: live tasks of the old mesh are
+            # canceled so surviving workers free their slots for the replay
+            sched.cancel_all()
+            raise
         return MaterializedResult(
             list(plan.column_names), rows, [s.type for s in plan.symbols]
         )
+
+
+def _monotonic() -> float:
+    import time as _time
+
+    return _time.monotonic()
 
 
 class _StageScheduler:
@@ -202,10 +347,20 @@ class _StageScheduler:
     worker (the task re-reads its splits/inputs — deterministic replay, the
     EventDrivenFaultTolerantQueryScheduler retry property)."""
 
-    def __init__(self, runner: MultiHostQueryRunner):
+    def __init__(self, runner: MultiHostQueryRunner, workers=None):
         self.runner = runner
-        self._dead: set = set()
-        self.workers = [u for u in runner.worker_urls if self._alive(u)]
+        candidates = list(
+            runner.worker_urls if workers is None else workers
+        )
+        # a worker in the planned mesh that a fresh probe CONFIRMS dead:
+        # don't schedule a W-wide plan on W-k workers — re-plan at the
+        # smaller W.  A worker whose breaker is merely OPEN (cooling down
+        # from transient flaps) stays in the mesh: it is alive, just not
+        # preferred — _submit_on_live routes around it per task.
+        confirmed = [u for u in candidates if self._confirmed_dead(u)]
+        if confirmed:
+            raise MeshChangedError(dead=confirmed)
+        self.workers = candidates
         if not self.workers:
             raise RuntimeError("no live workers")
         #: fragment_id -> list[RemoteTaskClient] (producing tasks)
@@ -234,54 +389,58 @@ class _StageScheduler:
             )
         return False
 
-    #: how long a probe verdict stays fresh (dead workers get re-probed too,
-    #: so a restarted worker rejoins)
-    PROBE_TTL_S = 15.0
-
-    def _alive(self, url: str) -> bool:
-        """Liveness = the socket answers AND the worker's circuit breaker
-        admits traffic.  Only a REFUSED/RESET connection is definitive
-        death; a slow probe (single-core box, a worker thread holding the
-        GIL inside an XLA compile) is BUSY, not dead — treating it as dead
-        cascades into blacklisting the whole cluster (reference:
-        HeartbeatFailureDetector's grace semantics).  Verdicts cache on the
-        runner so healthy clusters pay no per-query probes; an OPEN breaker
-        overrides the cache (repeated request failures are fresher evidence
-        than a stale probe), and its half-open window forces a REAL probe
-        whose outcome closes or re-opens it."""
-        if url in self._dead:
-            return False
-        from trino_tpu.runtime.retry import BREAKER_HALF_OPEN
-
-        breaker = BREAKERS.get(url)
-        if not breaker.allow():
-            return False  # open: hold traffic until the half-open window
+    def _confirmed_dead(self, url: str) -> bool:
+        """Death needs SOCKET evidence: a fresh/cached
+        probe fails (only REFUSED/RESET — a slow probe is BUSY, a worker
+        thread holding the GIL inside an XLA compile, not dead; treating
+        it as dead cascades into blacklisting the whole cluster).  A
+        breaker that is merely OPEN is NOT death — it is a live worker
+        cooling down from transient flaps, and declaring it dead would
+        stickily evict it from membership (only an explicit re-register
+        resurrects a DEAD worker).  Failed probes vote on the breaker;
+        probe successes never vote, so a probe cannot short-circuit an
+        open breaker's cooldown.  Verdicts cache on the runner
+        (remote.probe-ttl) so healthy clusters pay no per-query probes."""
         import time as _time
 
         now = _time.monotonic()
         cached = self.runner._worker_health.get(url)
         if (
-            breaker.state != BREAKER_HALF_OPEN
-            and cached is not None
-            and now - cached[0] < self.PROBE_TTL_S
+            cached is not None
+            and now - cached[0] < get_config().remote.probe_ttl_s
         ):
-            ok = cached[1]  # cache hit: no new evidence for the breaker
-        else:
-            ok = self._probe(url)
-            self.runner._worker_health[url] = (now, ok)
-            if ok:
-                breaker.record_success()
-            else:
-                breaker.record_failure()
+            return not cached[1]
+        ok = self._probe(url)
+        self.runner._worker_health[url] = (now, ok)
         if not ok:
-            self._dead.add(url)
-        return ok
+            BREAKERS.get(url).record_failure()
+        return not ok
+
+    def _confirmed_draining(self, url: str) -> bool:
+        """A 503 submit refusal CLAIMS the worker is draining — verify
+        against its own /v1/info state before stickily excluding it from
+        future meshes (a reverse-proxy or overload 503 is not a drain)."""
+        try:
+            with urllib.request.urlopen(
+                f"{url}/v1/info",
+                timeout=get_config().lifecycle.probe_timeout_s,
+            ) as r:
+                import json
+
+                return json.loads(r.read()).get("state") == "DRAINING"
+        except Exception:
+            return False  # unreachable: the death path owns that verdict
 
     @staticmethod
     def _probe(url: str) -> bool:
+        # DELIBERATELY stricter than membership.http_probe: the scheduler
+        # acts on ONE probe, so only REFUSED/RESET (nobody listening) is
+        # death — the detector can afford to count timeouts as misses
+        # because it requires miss-threshold CONSECUTIVE ones.
         try:
             with urllib.request.urlopen(
-                f"{url}/v1/info", timeout=PROBE_TIMEOUT_S
+                f"{url}/v1/info",
+                timeout=get_config().lifecycle.probe_timeout_s,
             ) as r:
                 r.read()
             return True
@@ -305,27 +464,26 @@ class _StageScheduler:
                     )
                     if url:
                         load[url] += 1
-        live = [u for u in self.workers if u not in self._dead]
-        if not live:
-            live = list(self.workers)
-        return min(live, key=lambda u: load[u])
+        return min(self.workers, key=lambda u: load[u])
 
     def _submit_on_live(self, desc: TaskDescriptor, preferred: str):
-        """Submit, falling over to any live worker if the preferred one is
-        gone."""
+        """Submit to the preferred worker, absorbing transient flaps with
+        backed-off retries.  A worker discovered DEAD (refused/exhausted)
+        or DRAINING raises MeshChangedError: the mesh this plan was
+        fragmented for no longer exists, and the runner re-plans at the
+        smaller W instead of cramming a W-wide plan onto W-1 workers."""
+        cfg = get_config().remote
         urls = [preferred] + [u for u in self.workers if u != preferred]
         last: Optional[Exception] = None
         for url in urls:
             check_current()  # canceled queries stop scheduling work
-            if url in self._dead:
-                continue
             breaker = BREAKERS.get(url)
             if not breaker.allow():
                 continue  # breaker open: this worker is cooling down
             client = RemoteTaskClient(url, desc.task_id)
-            backoff = Backoff(base_s=0.05, cap_s=1.0)
+            backoff = Backoff(base_s=cfg.backoff_base_s, cap_s=cfg.backoff_cap_s)
             submitted = False
-            for attempt in range(SUBMIT_ATTEMPTS):
+            for attempt in range(cfg.submit_attempts):
                 if attempt:
                     backoff.wait(attempt - 1)
                 try:
@@ -334,6 +492,16 @@ class _StageScheduler:
                     break
                 except QueryAbortedException:
                     raise  # lifecycle abort: stop scheduling entirely
+                except WorkerDrainingError:
+                    # 503 CLAIMS a graceful drain — confirm against
+                    # /v1/info before the sticky exclusion (a proxy or
+                    # overload 503 must not silently retire a healthy
+                    # worker).  Confirmed: the mesh shrank by choice, no
+                    # breaker vote, re-plan without it.  Unconfirmed:
+                    # another worker takes this task, the mesh stays.
+                    if self._confirmed_draining(url):
+                        raise MeshChangedError(drained=[url])
+                    break
                 except Exception as exc:
                     last = exc
                     if _is_refused(exc):
@@ -347,10 +515,15 @@ class _StageScheduler:
                         continue
                     raise  # a real error must not masquerade as dead
             if not submitted:
-                import time as _time
-
-                self._dead.add(url)  # worker gone: try the next one
-                self.runner._worker_health[url] = (_time.monotonic(), False)
+                # refused/exhausted submits are strong but not sufficient
+                # evidence (a restart blip or backlog overflow refuses one
+                # connection on a healthy worker): confirm with a fresh
+                # probe before the sticky eviction.  Confirmed dead →
+                # shrink the mesh; still answering → another worker takes
+                # this task and the mesh stays W-wide.
+                self.runner._worker_health.pop(url, None)
+                if self._confirmed_dead(url):
+                    raise MeshChangedError(dead=[url])
                 continue
             breaker.record_success()
             self._descs[desc.task_id] = desc
@@ -362,20 +535,25 @@ class _StageScheduler:
         raise RuntimeError(f"no live worker accepted {desc.task_id}: {last}")
 
     def _replace_task(self, fid: int, idx: int):
-        """Reassign task `idx` of stage `fid` to a live worker.  Producers
-        below are repaired first so the refreshed input URLs resolve."""
+        """Reassign task `idx` of stage `fid` after it failed.  Producers
+        below are repaired first so the refreshed input URLs resolve.  A
+        FAILED task does not imply a dead worker (it may have failed
+        pulling inputs from one that died): the old worker is probed on
+        fresh evidence — alive means the task re-runs on a live worker at
+        the SAME W; dead means the mesh shrank and the whole query
+        re-plans (MeshChangedError)."""
         import dataclasses
 
         sub = self._subplans[fid]
         for child in sub.children:
             self._repair_stage(child.fragment.id)
         old = self._stage_tasks[fid][idx]
-        # a FAILED task does not imply a dead worker (it may have failed
-        # pulling inputs from one that died): probe before blacklisting —
-        # an alive worker happily re-runs the replacement itself.  The
-        # failure is fresh evidence, so bypass the cached verdict.
+        # the failure is fresh evidence: bypass the cached verdict.  Only a
+        # CONFIRMED-dead worker shrinks the mesh — an alive one (including
+        # breaker-open cooling) just gets the task re-run elsewhere.
         self.runner._worker_health.pop(old.worker_url, None)
-        self._alive(old.worker_url)
+        if self._confirmed_dead(old.worker_url):
+            raise MeshChangedError(dead=[old.worker_url])
         desc = self._descs[old.task_id]
         desc = dataclasses.replace(
             desc,
@@ -395,10 +573,23 @@ class _StageScheduler:
             self._repair_stage(child.fragment.id)
         for i, t in enumerate(list(tasks)):
             # repairs run on failure evidence: cached health is stale by
-            # definition here, probe fresh
+            # definition here, probe fresh — and only CONFIRMED death (a
+            # failed socket probe, not an open breaker) shrinks the mesh
             self.runner._worker_health.pop(t.worker_url, None)
-            if not self._alive(t.worker_url):
-                self._replace_task(fid, i)
+            if self._confirmed_dead(t.worker_url):
+                raise MeshChangedError(dead=[t.worker_url])
+
+    def cancel_all(self) -> None:
+        """Best-effort cancel of every submitted task (an abandoned
+        scheduling attempt must not pin worker slots through the replay)."""
+        for tasks in self._stage_tasks.values():
+            if isinstance(tasks, _LocalResult):
+                continue
+            for t in tasks:
+                try:
+                    t.cancel()
+                except Exception:
+                    pass
 
     def run(self, root: SubPlan) -> PhysicalPlan:
         self._register(root)
@@ -727,12 +918,6 @@ def _take_host(batch, idx):
     return Batch(cols, np.ones(len(idx), bool))
 
 
-#: transient-fetch retry budget against the SAME worker before the caller
-#: falls back to task replacement (a flaky connection is absorbed here; a
-#: dead worker exhausts it fast and reschedules)
-FETCH_ATTEMPTS = 3
-
-
 def _fetch_ok(task: RemoteTaskClient, backoff: Optional[Backoff] = None) -> bytes:
     """Fetch bucket 0, surfacing worker-side failures.  Transient
     connection failures retry against the same worker behind capped
@@ -740,11 +925,16 @@ def _fetch_ok(task: RemoteTaskClient, backoff: Optional[Backoff] = None) -> byte
     the HttpPageBufferClient pull loop); each outcome feeds the worker's
     circuit breaker.  An HTTPError means the worker ANSWERED — its task
     failed — so it raises immediately (retrying can't fix the task, and
-    the worker itself is healthy)."""
-    backoff = backoff or Backoff(base_s=0.05, cap_s=1.0)
+    the worker itself is healthy).  The retry budget (`remote.fetch-
+    attempts`) bounds how long a dead worker stalls the pull before the
+    caller falls back to task replacement / mesh-shrink re-planning."""
+    cfg = get_config().remote
+    backoff = backoff or Backoff(
+        base_s=cfg.backoff_base_s, cap_s=cfg.backoff_cap_s
+    )
     breaker = BREAKERS.get(task.worker_url)
     last: Optional[BaseException] = None
-    for attempt in range(FETCH_ATTEMPTS):
+    for attempt in range(cfg.fetch_attempts):
         check_current()  # canceled/expired queries stop pulling results
         if attempt:
             backoff.wait(attempt - 1)

@@ -27,12 +27,24 @@ CAP_FLOOR = 1024
 
 
 class CapacityHistory:
-    """join fingerprint -> last good pow2 out_cap (process-wide, bounded)."""
+    """join fingerprint -> last good pow2 out_cap (process-wide, bounded).
+
+    `version` bumps only when a record CHANGES the mapping (new key or new
+    cap), so callers can tell "this run LEARNED a capacity" apart from the
+    warm path's re-record of the same value — the signal
+    tools/prewarm_manifest.py uses to treat capacity learning as part of
+    the cold phase.  `snapshot`/`seed` serialize the history through the
+    prewarm manifest: a restarted (or prewarming) process seeds the learned
+    caps so its FIRST run takes the fused speculative path at the right
+    bucket instead of re-learning — the Q3 fused_expand recompile PR 6
+    flagged."""
 
     def __init__(self, limit: int = 1024):
         self.limit = limit
         self._caps: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
+        #: bumped on every mapping CHANGE (never on a same-value re-record)
+        self.version = 0
 
     def guess(self, key, default: int) -> int:
         with self._lock:
@@ -44,14 +56,44 @@ class CapacityHistory:
 
     def record(self, key, cap: int) -> None:
         with self._lock:
+            if self._caps.get(key) != cap:
+                self.version += 1
             self._caps[key] = cap
             self._caps.move_to_end(key)
             while len(self._caps) > self.limit:
                 self._caps.popitem(last=False)
 
+    def snapshot(self) -> list:
+        """JSON-serializable [{key, cap}] (keys as reprs — they are tuples
+        of strings/ints by construction, so `seed` can literal_eval them)."""
+        with self._lock:
+            return [
+                {"key": repr(k), "cap": int(v)} for k, v in self._caps.items()
+            ]
+
+    def seed(self, entries) -> int:
+        """Restore entries from a `snapshot()` (e.g. a prewarm manifest's
+        cap_history section); returns how many were installed.  Entries
+        whose key repr does not literal_eval (a future key shape) are
+        skipped — seeding is an optimization, never a correctness
+        dependency."""
+        import ast
+
+        n = 0
+        for e in entries or ():
+            try:
+                key = ast.literal_eval(e["key"])
+                cap = int(e["cap"])
+            except (KeyError, TypeError, ValueError, SyntaxError):
+                continue
+            self.record(key, cap)
+            n += 1
+        return n
+
     def clear(self) -> None:
         with self._lock:
             self._caps.clear()
+            self.version += 1
 
 
 #: the process-wide history (cleared only by tests)

@@ -120,6 +120,19 @@ class BufferPool:
         with self.lock:
             self.host.put(key, list(batches), nbytes)
 
+    def invalidate_device(self, stale) -> int:
+        """Drop device-tier entries whose key satisfies `stale(key)`;
+        returns how many were dropped.  Used by membership's mesh-shrink
+        re-planning to evict stacked-scan batches keyed by a mesh signature
+        that no longer exists (runtime/membership.invalidate_mesh_scans)."""
+        dropped = 0
+        with self.lock:
+            for key in [k for k in self.device.entries if stale(k)]:
+                _, nbytes = self.device.entries.pop(key)
+                self.device.ctx.add_bytes(-nbytes)
+                dropped += 1
+        return dropped
+
     def clear(self) -> None:
         with self.lock:
             self.host.clear()

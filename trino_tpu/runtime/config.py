@@ -84,22 +84,33 @@ def _factories() -> dict:
 class EtcConfig:
     """Everything loaded from an etc/ directory."""
 
-    def __init__(self, node_properties: dict, catalogs, session_defaults: dict):
+    def __init__(self, node_properties: dict, catalogs, session_defaults: dict,
+                 cluster=None):
         self.node_properties = node_properties
         self.catalogs = catalogs
         self.session_defaults = session_defaults
+        #: the typed ClusterConfig (trino_tpu/config) parsed from the same
+        #: config.properties — breaker/heartbeat/lifecycle/remote knobs
+        self.cluster = cluster
 
 
-def load_etc(etc_dir: str) -> EtcConfig:
+def load_etc(etc_dir: str, install: bool = True) -> EtcConfig:
     """Load config.properties + etc/catalog/*.properties into a CatalogManager
     and node/session settings (reference: the server launcher's config
-    loading + CatalogStore)."""
+    loading + CatalogStore).  The same properties feed the TYPED config
+    system (trino_tpu/config): breaker/heartbeat/lifecycle/remote/worker
+    knobs, installed process-wide unless `install=False`."""
     from trino_tpu.connectors.api import CatalogManager
 
     node_props: dict = {}
     cfg = os.path.join(etc_dir, "config.properties")
     if os.path.exists(cfg):
         node_props = load_properties(cfg)
+    from trino_tpu.config import install_config, load_cluster_config
+
+    cluster = load_cluster_config(node_props)
+    if install:
+        install_config(cluster)
     cm = CatalogManager()
     factories = _factories()
     cat_dir = os.path.join(etc_dir, "catalog")
@@ -121,7 +132,7 @@ def load_etc(etc_dir: str) -> EtcConfig:
     for k, v in node_props.items():
         if k.startswith("session."):
             session_defaults[k[len("session."):]] = _coerce(v)
-    return EtcConfig(node_props, cm, session_defaults)
+    return EtcConfig(node_props, cm, session_defaults, cluster=cluster)
 
 
 def _coerce(v: str):

@@ -95,7 +95,10 @@ class InvalidStateTransition(RuntimeError):
 
 
 #: default per-request HTTP timeout when no query deadline bounds it
-#: (the old hardcoded 600 s scattered through server/ + remote.py)
+#: (the old hardcoded 600 s scattered through server/ + remote.py).  These
+#: four are now the compiled-in DEFAULTS of the typed config's lifecycle
+#: section (trino_tpu/config: lifecycle.request-timeout etc.) — load a
+#: config.properties / set TRINO_TPU_LIFECYCLE_* to override them.
 DEFAULT_HTTP_TIMEOUT_S = 600.0
 #: task submission POST (small body, worker answers immediately)
 SUBMIT_TIMEOUT_S = 60.0
@@ -335,11 +338,17 @@ def check_current_planning() -> None:
         ctx.check_planning()
 
 
-def request_timeout(default: float = DEFAULT_HTTP_TIMEOUT_S) -> float:
+def request_timeout(default: Optional[float] = None) -> float:
     """HTTP timeout for the executing query (the lifecycle deadline helper
     the raw-http-timeout lint rule routes call sites through): bounded by
     the query's remaining run time, `default` when no query or no
-    deadline."""
+    deadline.  `default=None` reads the typed config's
+    `lifecycle.request-timeout` (trino_tpu/config) — the old hardcoded
+    600 s is now just that knob's compiled-in default."""
+    if default is None:
+        from trino_tpu.config import get_config
+
+        default = get_config().lifecycle.request_timeout_s
     ctx = _CURRENT.get()
     if ctx is None:
         return default

@@ -197,12 +197,35 @@ class CircuitBreaker:
 
             breaker_trips_counter().inc()
 
+    def trip(self) -> None:
+        """Force the breaker OPEN immediately (the heartbeat failure
+        detector declared this worker DEAD: definitive evidence outranks
+        the consecutive-failure count)."""
+        with self._lock:
+            tripped = self.state != BREAKER_OPEN
+            self.state = BREAKER_OPEN
+            self._consecutive_failures = max(
+                self._consecutive_failures, self.failure_threshold
+            )
+            self._opened_at = self.clock()
+        if tripped:
+            from trino_tpu.telemetry.metrics import breaker_trips_counter
+
+            breaker_trips_counter().inc()
+
 
 class CircuitBreakerRegistry:
     """Worker url -> breaker; surfaced as the
-    `trino_tpu_breaker_state{worker=...}` gauge in system.runtime.metrics."""
+    `trino_tpu_breaker_state{worker=...}` gauge in system.runtime.metrics.
 
-    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 5.0,
+    Knobs default to the typed config (`breaker.failure-threshold` /
+    `breaker.cooldown` with per-worker `@token` overrides, trino_tpu/config);
+    explicit constructor values — tests, embedded registries — win over
+    config.  Breakers are created lazily per worker, so a config installed
+    after import still applies to workers seen afterwards."""
+
+    def __init__(self, failure_threshold: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
@@ -210,13 +233,32 @@ class CircuitBreakerRegistry:
         self._lock = threading.Lock()
         self._breakers: dict[str, CircuitBreaker] = {}
 
+    def _knobs_for(self, worker: str) -> tuple:
+        """Each knob resolves independently: the explicit constructor value
+        when given, the typed config (with per-worker overrides) otherwise
+        — a registry pinning only one knob must not mute the config for
+        the other."""
+        cfg = None
+        if self.failure_threshold is None or self.cooldown_s is None:
+            from trino_tpu.config import get_config
+
+            cfg = get_config().breaker_for(worker)
+        threshold = (
+            self.failure_threshold
+            if self.failure_threshold is not None
+            else cfg.failure_threshold
+        )
+        cooldown = (
+            self.cooldown_s if self.cooldown_s is not None else cfg.cooldown_s
+        )
+        return threshold, cooldown
+
     def get(self, worker: str) -> CircuitBreaker:
         with self._lock:
             b = self._breakers.get(worker)
             if b is None:
-                b = CircuitBreaker(
-                    self.failure_threshold, self.cooldown_s, self.clock
-                )
+                threshold, cooldown = self._knobs_for(worker)
+                b = CircuitBreaker(threshold, cooldown, self.clock)
                 self._breakers[worker] = b
             return b
 

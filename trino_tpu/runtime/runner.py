@@ -437,29 +437,34 @@ class LocalQueryRunner:
             tuple(new_ctes), False,
         )
 
+    def _execute_plan(self, plan, stats=None) -> MaterializedResult:
+        """Run an already-planned query in THIS process (also the multihost
+        runner's path for coordinator-resident system-catalog queries)."""
+        from trino_tpu.runtime.lifecycle import check_current
+
+        with self._tracer.span("execute"):
+            lp = LocalExecutionPlanner(
+                self.catalogs,
+                target_splits=self.target_splits,
+                stats=stats,
+                properties=self.properties,
+            )
+            physical = lp.plan(plan)
+            rows = []
+            for batch in physical.stream:
+                check_current()  # cancel/deadline between result batches
+                rows.extend(tuple(r) for r in batch.to_pylist())
+            self._last_peak_memory = lp.memory.peak
+        return MaterializedResult(
+            list(plan.column_names), rows, [s.type for s in plan.symbols]
+        )
+
     def _run_query(self, query: ast.Query, stats=None) -> MaterializedResult:
         plan = self.plan_query(query)
         self._check_table_access(plan)
 
         def run() -> MaterializedResult:
-            from trino_tpu.runtime.lifecycle import check_current
-
-            with self._tracer.span("execute"):
-                lp = LocalExecutionPlanner(
-                    self.catalogs,
-                    target_splits=self.target_splits,
-                    stats=stats,
-                    properties=self.properties,
-                )
-                physical = lp.plan(plan)
-                rows = []
-                for batch in physical.stream:
-                    check_current()  # cancel/deadline between result batches
-                    rows.extend(tuple(r) for r in batch.to_pylist())
-                self._last_peak_memory = lp.memory.peak
-            return MaterializedResult(
-                list(plan.column_names), rows, [s.type for s in plan.symbols]
-            )
+            return self._execute_plan(plan, stats=stats)
 
         profile_dir = self.properties.get("profile_dir")
         if profile_dir:

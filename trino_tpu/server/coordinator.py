@@ -25,9 +25,20 @@ from typing import Optional
 
 from trino_tpu.server import protocol
 
-RESULT_PAGE_ROWS = 4096
-#: long-poll bound on statement/trace GETs (reference: the async responses)
-POLL_WAIT_S = 1.0
+def result_page_rows() -> int:
+    """Rows per paged statement response (typed config
+    coordinator.result-page-rows; compiled-in default 4096)."""
+    from trino_tpu.config import get_config
+
+    return get_config().coordinator.result_page_rows
+
+
+def poll_wait_s() -> float:
+    """Long-poll bound on statement/trace GETs (reference: the async
+    responses; typed config coordinator.poll-wait)."""
+    from trino_tpu.config import get_config
+
+    return get_config().coordinator.poll_wait_s
 
 
 class _Query:
@@ -296,7 +307,7 @@ class CoordinatorServer:
                         return self._send(
                             404, {"error": {"message": "no such query"}}
                         )
-                    q.done.wait(timeout=POLL_WAIT_S)
+                    q.done.wait(timeout=poll_wait_s())
                     if q.trace is None:
                         return self._send(
                             404,
@@ -316,7 +327,7 @@ class CoordinatorServer:
                 if q is None:
                     return self._send(404, {"error": {"message": "no such query"}})
                 # long-poll like the reference's async responses
-                q.done.wait(timeout=POLL_WAIT_S)
+                q.done.wait(timeout=poll_wait_s())
                 if q.state in ("FAILED", "CANCELED"):
                     return self._send(
                         200,
@@ -332,8 +343,9 @@ class CoordinatorServer:
                         ),
                     )
                 rows = q.result.rows
-                page = rows[token * RESULT_PAGE_ROWS : (token + 1) * RESULT_PAGE_ROWS]
-                has_more = (token + 1) * RESULT_PAGE_ROWS < len(rows)
+                page_sz = result_page_rows()
+                page = rows[token * page_sz : (token + 1) * page_sz]
+                has_more = (token + 1) * page_sz < len(rows)
                 self._send(
                     200,
                     protocol.query_results(
@@ -349,6 +361,45 @@ class CoordinatorServer:
                         stats={"rows": len(rows)},
                     ),
                 )
+
+            def do_PUT(self):
+                from trino_tpu.server.security import AuthenticationError
+
+                try:
+                    self._authenticate()
+                except AuthenticationError:
+                    return
+                # PUT /v1/worker/register — the grow path (reference:
+                # DiscoveryNodeManager announcement): body = worker url; it
+                # joins the NEXT query's mesh, never a running one
+                if self.path == "/v1/worker/register":
+                    n = int(self.headers.get("Content-Length", 0))
+                    url = self.rfile.read(n).decode().strip()
+                    add = getattr(server.runner, "add_worker", None)
+                    if not url or add is None:
+                        return self._send(
+                            400,
+                            {"error": {"message": "runner is not multi-host "
+                                       "or no worker url given"}},
+                        )
+                    add(url)
+                    return self._send(200, {"registered": url})
+                # PUT /v1/worker/drain — graceful retirement: body = worker
+                # url; the worker finishes running tasks, refuses new ones,
+                # exits, and the next query's mesh excludes it
+                if self.path == "/v1/worker/drain":
+                    n = int(self.headers.get("Content-Length", 0))
+                    url = self.rfile.read(n).decode().strip()
+                    drain = getattr(server.runner, "drain_worker", None)
+                    if not url or drain is None:
+                        return self._send(
+                            400,
+                            {"error": {"message": "runner is not multi-host "
+                                       "or no worker url given"}},
+                        )
+                    drain(url)
+                    return self._send(200, {"draining": url})
+                self._send(404, {"error": {"message": "not found"}})
 
             def do_DELETE(self):
                 from trino_tpu.server.security import AuthenticationError

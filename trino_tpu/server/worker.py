@@ -27,6 +27,7 @@ import hmac as _hmac
 import os
 import pickle
 import threading
+import time
 import traceback
 import urllib.request
 from dataclasses import dataclass, field
@@ -43,6 +44,12 @@ def cluster_secret() -> Optional[bytes]:
 
 def sign_body(secret: bytes, body: bytes) -> str:
     return _hmac.new(secret, body, hashlib.sha256).hexdigest()
+
+
+class WorkerDraining(RuntimeError):
+    """A submission raced past the handler's DRAINING fast-path but lost
+    the atomic admission check in `WorkerServer.submit` — mapped to the
+    same 503 the fast path answers."""
 
 
 @dataclass
@@ -138,10 +145,13 @@ class WorkerServer:
         catalogs=None,
         port: int = 0,
         host: str = "127.0.0.1",
-        max_concurrent_tasks: int = 4,
+        max_concurrent_tasks: Optional[int] = None,
     ):
+        from trino_tpu.config import get_config
         from trino_tpu.connectors.api import default_catalogs
 
+        if max_concurrent_tasks is None:
+            max_concurrent_tasks = get_config().worker.max_concurrent_tasks
         self.catalogs = catalogs or default_catalogs()
         self._tasks: dict[str, _Task] = {}
         #: TaskExecutor analog (reference: execution/executor/
@@ -149,6 +159,15 @@ class WorkerServer:
         #: tasks; excess submissions queue on the semaphore instead of
         #: oversubscribing the host
         self._slots = threading.Semaphore(max(1, max_concurrent_tasks))
+        #: graceful-shutdown state (GracefulShutdownHandler role): ACTIVE
+        #: serves everything; DRAINING finishes running tasks, refuses new
+        #: submissions with 503 (REFUSED semantics on the client), then
+        #: exits once idle.  `drained` is set when the last task finished.
+        self.state = "ACTIVE"
+        self._state_lock = threading.Lock()
+        self.drained = threading.Event()
+        #: injectable for tests (the drain-grace linger must not slow them)
+        self._sleep = time.sleep
         self._secret = cluster_secret()
         if host not in ("127.0.0.1", "localhost") and self._secret is None:
             raise ValueError(
@@ -172,6 +191,11 @@ class WorkerServer:
             def do_POST(self):
                 if self.path != "/v1/task":
                     return self._bytes(404, b"not found", "text/plain")
+                if worker.state != "ACTIVE":
+                    # draining: refuse BEFORE reading/unpickling — the
+                    # coordinator's submit maps 503 to REFUSED (skip this
+                    # worker, never retry it) and re-plans without us
+                    return self._bytes(503, b"DRAINING", "text/plain")
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
                 secret = worker._secret
@@ -181,13 +205,38 @@ class WorkerServer:
                         # reject BEFORE unpickling: the codec executes code
                         return self._bytes(401, b"bad signature", "text/plain")
                 desc = pickle.loads(body)
-                t = worker.submit(desc)
+                try:
+                    t = worker.submit(desc)
+                except WorkerDraining:
+                    # lost the race with begin_drain's state flip: same
+                    # refusal as the fast path above
+                    return self._bytes(503, b"DRAINING", "text/plain")
                 self._bytes(200, t.desc.task_id.encode(), "text/plain")
+
+            def do_PUT(self):
+                if self.path != "/v1/worker/shutdown":
+                    return self._bytes(404, b"not found", "text/plain")
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                secret = worker._secret
+                if secret is not None:
+                    # shutdown is as privileged as task submission: same
+                    # HMAC gate (an unauthenticated PUT per worker would
+                    # let any peer drain the whole cluster)
+                    sig = self.headers.get("X-Cluster-Auth", "")
+                    if not _hmac.compare_digest(sig, sign_body(secret, body)):
+                        return self._bytes(401, b"bad signature", "text/plain")
+                # graceful drain (GracefulShutdownHandler analog): answer
+                # immediately; a background waiter finishes running tasks,
+                # sets `drained`, and shuts the server down
+                worker.begin_drain()
+                self._bytes(200, b"DRAINING", "text/plain")
 
             def do_GET(self):
                 parts = self.path.strip("/").split("/")
                 if parts == ["v1", "info"]:
-                    self._bytes(200, b'{"state": "ACTIVE"}', "application/json")
+                    body = ('{"state": "%s"}' % worker.state).encode()
+                    self._bytes(200, body, "application/json")
                     return
                 if parts == ["v1", "metrics"]:
                     # same Prometheus surface as the coordinator, so one
@@ -204,7 +253,7 @@ class WorkerServer:
                     t = worker._tasks.get(parts[2])
                     if t is None:
                         return self._bytes(404, b"no such task", "text/plain")
-                    t.done.wait(timeout=STATUS_WAIT_S)
+                    t.done.wait(timeout=status_wait_default())
                     body = (
                         t.state
                         if t.error is None
@@ -289,11 +338,52 @@ class WorkerServer:
         self._httpd.shutdown()
         self._httpd.server_close()
 
+    def begin_drain(self, exit_on_idle: bool = True) -> None:
+        """Graceful shutdown (reference: GracefulShutdownHandler, SURVEY
+        §5.3): flip to DRAINING (new submissions get 503/REFUSED), wait for
+        every running task to finish, set `drained`, linger for
+        `worker.drain-grace` seconds so downstream consumers can still PULL
+        the finished tasks' results (task completion is not result
+        delivery — the reference sleeps out a grace period for exactly this
+        reason), then stop the HTTP server.  Idempotent — a second PUT
+        while draining is a no-op."""
+        with self._state_lock:
+            if self.state != "ACTIVE":
+                return
+            self.state = "DRAINING"
+            # snapshot under the same lock submit() admits under: every
+            # task that slipped in before the flip is in it
+            running = list(self._tasks.values())
+        worker = self
+
+        def waiter():
+            from trino_tpu.config import get_config
+
+            cfg = get_config().worker
+            for t in running:
+                t.done.wait(timeout=cfg.drain_task_wait_s)
+            worker.drained.set()
+            if exit_on_idle:
+                self._sleep(cfg.drain_grace_s)
+                try:
+                    worker.shutdown()
+                except Exception:
+                    pass
+
+        threading.Thread(target=waiter, daemon=True, name="drain").start()
+
     # -- task execution (SqlTaskExecution role) ------------------------------
 
     def submit(self, desc: TaskDescriptor) -> _Task:
         t = _Task(desc)
-        self._tasks[desc.task_id] = t
+        # admission is atomic with the drain flip: a submission that read
+        # ACTIVE before begin_drain either registers HERE (so the drain
+        # waiter's snapshot sees it and waits for it) or observes DRAINING
+        # and is refused — no task can slip past the waiter's snapshot
+        with self._state_lock:
+            if self.state != "ACTIVE":
+                raise WorkerDraining(f"worker is {self.state}")
+            self._tasks[desc.task_id] = t
         threading.Thread(
             target=self._run, args=(t,), daemon=True, name=desc.task_id
         ).start()
@@ -464,11 +554,21 @@ class _FilteringCatalogs:
         self._inner.register(name, connector)
 
 
-#: long-poll bound on a task's result/dynamic endpoints when the descriptor
-#: carries no deadline (the old hardcoded 600 s, now in ONE place)
-RESULT_WAIT_S = 600.0
-#: short status long-poll (reference: the async task-status responses)
-STATUS_WAIT_S = 1.0
+def result_wait_default() -> float:
+    """Long-poll bound on a task's result/dynamic endpoints when the
+    descriptor carries no deadline (PR 5 moved the hardcoded 600 s into ONE
+    place; the typed config now owns it: `worker.result-wait`)."""
+    from trino_tpu.config import get_config
+
+    return get_config().worker.result_wait_s
+
+
+def status_wait_default() -> float:
+    """Short status long-poll (reference: the async task-status responses;
+    typed config `worker.status-wait`)."""
+    from trino_tpu.config import get_config
+
+    return get_config().worker.status_wait_s
 
 
 def _result_wait_s(t: _Task) -> float:
@@ -476,12 +576,13 @@ def _result_wait_s(t: _Task) -> float:
     query has LEFT to live — the task lifecycle's remaining time, not the
     original budget (a late re-fetch after retries must not pin a server
     thread past the query's death)."""
+    bound = result_wait_default()
     if t.desc.deadline_s is None:
-        return RESULT_WAIT_S
+        return bound
     rem = t.lifecycle.remaining_s()
     if rem is None:  # deadline_s <= 0: the owning query is out of time
         return 0.001
-    return max(0.001, min(RESULT_WAIT_S, rem))
+    return max(0.001, min(bound, rem))
 
 
 def _http_get(url: str, timeout: Optional[float] = None) -> bytes:
@@ -496,7 +597,7 @@ def _http_get(url: str, timeout: Optional[float] = None) -> bytes:
     # match the scheme inside every point's url suffix
     FAILURE_INJECTOR.maybe_fail(f"fetch:{url}")
     if timeout is None:
-        timeout = request_timeout(RESULT_WAIT_S)
+        timeout = request_timeout(result_wait_default())
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return r.read()
 

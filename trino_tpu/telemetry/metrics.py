@@ -395,6 +395,11 @@ COLLECTIVE_VOCABULARY = (
 )
 
 
+#: membership transition vocabulary, pre-registered so scrapes see
+#: join/drain/death at 0 before any transition fires
+MEMBERSHIP_EVENT_KINDS = ("join", "drain", "death", "rejoin", "shrink_replan")
+
+
 def _compile_events_total():
     from trino_tpu.telemetry.compile_events import OBSERVATORY
 
@@ -465,6 +470,21 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
         _PREFIX + "breaker_state",
         "per-worker circuit breaker state (0 closed, 1 half-open, 2 open)",
         _breaker_series,
+        labelnames=("worker",),
+    )
+    membership = reg.counter(
+        _PREFIX + "membership_events_total",
+        "cluster membership transitions by kind (runtime/membership: "
+        "worker join/drain/death, rejoin after death, and mesh-shrink "
+        "re-plans of running queries)",
+        labelnames=("kind",),
+    )
+    for kind in MEMBERSHIP_EVENT_KINDS:
+        membership.touch(kind)
+    reg.gauge(
+        _PREFIX + "worker_alive",
+        "per-worker liveness from the heartbeat failure detector "
+        "(1 = ACTIVE/DRAINING, 0 = DEAD)",
         labelnames=("worker",),
     )
     reg.histogram(
@@ -557,6 +577,16 @@ def memory_kills_counter() -> Counter:
 
 def breaker_trips_counter() -> Counter:
     return REGISTRY.counter(_PREFIX + "breaker_trips_total")
+
+
+def membership_events_counter() -> Counter:
+    """Cluster membership transitions (runtime/membership)."""
+    return REGISTRY.counter(_PREFIX + "membership_events_total")
+
+
+def worker_alive_gauge() -> Gauge:
+    """Per-worker liveness set by the heartbeat failure detector."""
+    return REGISTRY.gauge(_PREFIX + "worker_alive")
 
 
 def compile_seconds_histogram() -> Histogram:
