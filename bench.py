@@ -481,6 +481,111 @@ print(json.dumps({
 """
 
 
+#: restart-resilience probe (ROADMAP item 3): three FRESH processes run the
+#: same first query — cold (populates a persistent XLA cache + saves a
+#: workload manifest), persistent (same cache dir: re-traces, reloads
+#: executables), prewarmed (cache + manifest replay at start; the query
+#: itself must compile NOTHING — tools/compare_bench.py gates
+#: prewarmed.query_events == 0).  One JSON line per child.
+_RESTART_CODE = """
+import json, time
+import jax
+jax.config.update("jax_enable_x64", True)
+cache_dir = @CACHE_DIR@
+manifest_path = @MANIFEST@
+save_manifest = @SAVE@
+if cache_dir:
+    from trino_tpu.parallel.spmd import configure_persistent_cache
+    configure_persistent_cache(cache_dir)
+from trino_tpu.parallel import DistributedQueryRunner
+from trino_tpu.connectors.tpch.queries import QUERIES
+from trino_tpu.runtime.prewarm import PrewarmExecutor
+from trino_tpu.telemetry.compile_events import OBSERVATORY
+sql = QUERIES[@Q@]
+runner = DistributedQueryRunner(n_workers=8, schema="@SCHEMA@")
+ex = PrewarmExecutor(runner, manifest_path) if manifest_path else None
+prewarm_s = 0.0
+if ex is not None and not save_manifest:
+    t0 = time.perf_counter()
+    ex.run(reason="start", wait=True)
+    prewarm_s = time.perf_counter() - t0
+mark = OBSERVATORY.mark()
+t0 = time.perf_counter()
+runner.execute(sql)
+wall = time.perf_counter() - t0
+if ex is not None and save_manifest:
+    # the cold process records the replay set + learned capacities the
+    # prewarmed process will restore
+    ex.record(sql)
+    ex.save()
+print(json.dumps({
+    "wall_s": round(wall, 4),
+    "prewarm_s": round(prewarm_s, 4),
+    "compile_s": round(OBSERVATORY.total_wall_s, 4),
+    "compile_events": OBSERVATORY.count,
+    "query_events": OBSERVATORY.count - mark,
+    "prewarm_state": (ex.state if ex is not None and not save_manifest
+                      else None),
+}), flush=True)
+"""
+
+
+def _run_restart(args, schema: str) -> dict:
+    """First-run walls of restarted processes: cold vs persistent-cache vs
+    prewarmed (see _RESTART_CODE).  Returns the `coldstart.restart` block
+    (phases keyed cold/persistent/prewarmed, or {'error': ...})."""
+    import shutil
+    import tempfile
+
+    from _cleanenv import cpu_env
+
+    env = cpu_env(os.environ, n_virtual_devices=8)
+    tmp = tempfile.mkdtemp(prefix="trino_tpu_restart_")
+    cache_dir = os.path.join(tmp, "xla-cache")
+    manifest = os.path.join(tmp, "manifest.json")
+    timeout = float(os.environ.get("BENCH_RESTART_TIMEOUT", 600))
+    out: dict = {}
+    try:
+        phases = (
+            ("cold", cache_dir, manifest, True),
+            ("persistent", cache_dir, None, False),
+            ("prewarmed", cache_dir, manifest, False),
+        )
+        for name, cdir, mpath, save in phases:
+            # repr(), not json.dumps(): the placeholders must be PYTHON
+            # literals (None, not null) inside the child's source
+            code = (
+                _RESTART_CODE
+                .replace("@CACHE_DIR@", repr(cdir))
+                .replace("@MANIFEST@", repr(mpath))
+                .replace("@SAVE@", "True" if save else "False")
+                .replace("@SCHEMA@", schema)
+                .replace("@Q@", "6")
+            )
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", code],
+                    env=env, capture_output=True, text=True, timeout=timeout,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+            except subprocess.TimeoutExpired:
+                out[name] = {"error": f"timed out after {timeout:.0f}s"}
+                continue
+            lines = [
+                l for l in (r.stdout or "").splitlines() if l.startswith("{")
+            ]
+            if r.returncode != 0 or not lines:
+                tail = " | ".join((r.stderr or "").strip().splitlines()[-3:])
+                out[name] = {"error": f"rc={r.returncode}: {tail}"[:500]}
+                continue
+            # "error": None clears a stale failure a previous run may have
+            # deep-merged into this phase (BENCH_EXTRA merges, not rewrites)
+            out[name] = {"error": None, **json.loads(lines[-1])}
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _run_mesh(args) -> dict:
     """Mesh-vs-local Q6 walls + per-fragment profile, recorded under the
     'mesh' section keyed by schema (so sf1/sf10 runs coexist).  The child
@@ -511,9 +616,20 @@ def _run_mesh(args) -> dict:
         return {
             schema: {"error": f"mesh child rc={r.returncode}: {tail}"[:500]}
         }
+    sec = json.loads(lines[-1])
+    # restart-resilience phases (fresh processes; persistent cache +
+    # prewarm manifest) ride the same mesh section's coldstart block
+    try:
+        sec.setdefault("coldstart", {})["restart"] = _run_restart(
+            args, schema
+        )
+    except Exception as exc:
+        sec.setdefault("coldstart", {})["restart"] = {
+            "error": f"{type(exc).__name__}: {exc}"[:500]
+        }
     # "error": None clears a stale failure key a previous run may have
     # deep-merged into this schema's section
-    return {schema: {"error": None, **json.loads(lines[-1])}}
+    return {schema: {"error": None, **sec}}
 
 
 def _schema_for_sf(sf: float) -> str:
@@ -605,6 +721,11 @@ def _extra_child_budget(args) -> float:
             extra += float(os.environ.get("BENCH_MESH_TIMEOUT", 1200)) + 60
         except ValueError:
             extra += 1260
+        # three restart-phase children (cold / persistent / prewarmed)
+        try:
+            extra += 3 * float(os.environ.get("BENCH_RESTART_TIMEOUT", 600))
+        except ValueError:
+            extra += 1800
     return extra
 
 

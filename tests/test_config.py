@@ -69,6 +69,75 @@ def test_unknown_connector_rejected(tmp_path):
         load_etc(str(tmp_path))
 
 
+# -- per-catalog config overrides (key@catalog) --------------------------------
+
+
+def test_catalog_override_resolution_order():
+    """env > per-catalog `key@catalog` (exact name) > per-worker `key@token`
+    (substring) > base properties > default."""
+    from trino_tpu.config import BreakerConfig
+
+    props = {
+        "breaker.failure-threshold": "4",
+        "breaker.failure-threshold@tpch": "6",
+        "breaker.failure-threshold@8123": "9",
+    }
+    # base key only
+    assert BreakerConfig.from_properties(props).failure_threshold == 4
+    # catalog override beats the base AND the worker tier
+    got = BreakerConfig.from_properties(
+        props, worker="http://127.0.0.1:8123", catalog="tpch"
+    )
+    assert got.failure_threshold == 6
+    # no catalog in scope: the worker override wins as before
+    got = BreakerConfig.from_properties(props, worker="http://127.0.0.1:8123")
+    assert got.failure_threshold == 9
+    # env beats everything
+    got = BreakerConfig.from_properties(
+        props,
+        env={"TRINO_TPU_BREAKER_FAILURE_THRESHOLD": "2"},
+        worker="http://127.0.0.1:8123",
+        catalog="tpch",
+    )
+    assert got.failure_threshold == 2
+
+
+def test_catalog_override_is_exact_match():
+    """Catalog tokens are exact names — `@tpch` must not leak onto catalog
+    'tpch_backup' (unlike worker tokens, which are url substrings)."""
+    from trino_tpu.config import BreakerConfig
+
+    props = {"breaker.failure-threshold@tpch": "6"}
+    assert (
+        BreakerConfig.from_properties(props, catalog="tpch_backup")
+        .failure_threshold
+        == 3  # the PR 5 default: the override did not apply
+    )
+    assert (
+        BreakerConfig.from_properties(props, catalog="tpch").failure_threshold
+        == 6
+    )
+
+
+def test_cluster_config_section_for():
+    from trino_tpu.config import load_cluster_config
+
+    cfg = load_cluster_config(
+        {
+            "remote.fetch-attempts": "5",
+            "remote.fetch-attempts@hive": "7",
+            "worker.drain-grace@8200": "1.5",
+        },
+        env={},
+    )
+    assert cfg.remote.fetch_attempts == 5
+    assert cfg.section_for("remote", catalog="hive").fetch_attempts == 7
+    assert cfg.section_for("remote", catalog="tpch").fetch_attempts == 5
+    assert (
+        cfg.section_for("worker", worker="http://h:8200").drain_grace_s == 1.5
+    )
+
+
 def test_file_event_listener(etc_dir, tmp_path):
     import json
 

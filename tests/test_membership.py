@@ -628,11 +628,14 @@ def test_nodes_table_reports_membership():
 
     class _Stub:
         membership = ClusterMembership(["wa", "wb"], clock=FakeClock())
+        prewarm = None
 
     _Stub.membership.drain("wb")
     conn = SystemConnector(runner=_Stub())
-    rows = {r[0]: r for r in _Stub.membership.snapshot()}
+    rows = {r[0]: r for r in conn._rows("nodes")}
     assert rows["wa"][1] == ACTIVE and rows["wb"][1] == DRAINING
+    # no prewarm executor attached: the prewarm column is NULL
+    assert rows["wa"][4] is None
     # column count matches the declared system.runtime.nodes schema
     from trino_tpu.connectors.system import _TABLES
 

@@ -66,6 +66,61 @@ COLDSTART_KEYS = (
     "compile_events", "warm_replay_events",
 )
 
+#: restart phases (bench.py `coldstart.restart`): first-run wall of a FRESH
+#: process — cold (empty XLA cache, and the phase that populates it),
+#: persistent (same on-disk cache dir: re-traces but reloads executables),
+#: prewarmed (cache + manifest replay at start: the query itself must
+#: compile NOTHING)
+RESTART_PHASES = ("cold", "persistent", "prewarmed")
+RESTART_KEYS = ("wall_s", "compile_s", "compile_events", "query_events")
+
+
+def check_restart(schema: str, sec: dict) -> list:
+    """Violations over one mesh section's coldstart.restart block: every
+    phase recorded with its decomposition, and the prewarmed process's
+    query ran without a single compile event above its prewarm watermark
+    (the restart-resilience acceptance bar)."""
+    violations = []
+    if sec.get("error"):
+        return violations  # reported as skipped by the caller
+    for phase in RESTART_PHASES:
+        p = sec.get(phase)
+        if not isinstance(p, dict):
+            violations.append(
+                f"mesh.{schema}.coldstart.restart.{phase} missing "
+                "(re-run bench.py --mesh)"
+            )
+            continue
+        if p.get("error"):
+            # a failed phase FAILS the gate: BENCH_EXTRA deep-merges, so
+            # stale green numbers from a previous run sit right next to
+            # the error — skipping here would gate on ghosts
+            violations.append(
+                f"mesh.{schema}.coldstart.restart.{phase} errored: "
+                f"{p['error']} (stale sibling keys are not evidence)"
+            )
+            continue
+        missing = [k for k in RESTART_KEYS if k not in p]
+        if missing:
+            violations.append(
+                f"mesh.{schema}.coldstart.restart.{phase} missing {missing}"
+            )
+    pre = sec.get("prewarmed")
+    if isinstance(pre, dict) and not pre.get("error"):
+        if pre.get("query_events", 1) != 0:
+            violations.append(
+                f"mesh.{schema}.coldstart.restart.prewarmed.query_events = "
+                f"{pre.get('query_events')} (expected 0: after the manifest "
+                "replay the first real query must compile nothing)"
+            )
+        if pre.get("prewarm_state") not in (None, "WARM"):
+            violations.append(
+                f"mesh.{schema}.coldstart.restart.prewarmed.prewarm_state = "
+                f"{pre.get('prewarm_state')} (expected WARM: the executor's "
+                "verify replay found the key set unclosed or failed)"
+            )
+    return violations
+
 #: registry-snapshot series (telemetry/metrics names) that must be zero in a
 #: fresh `bench.py --mesh` snapshot.  The snapshot is PROCESS-LIFETIME, so
 #: only counters that must never fire even cold belong here —
@@ -208,6 +263,17 @@ def check_extra(extra: dict) -> tuple:
         if isinstance(cold, dict):
             for qname, qsec in sorted(cold.items()):
                 if not isinstance(qsec, dict):
+                    continue
+                if qname == "restart":
+                    # restart-resilience block: its own phase shape, not
+                    # the per-query cold/warm decomposition
+                    if qsec.get("error"):
+                        skipped.append(
+                            f"mesh.{schema}.coldstart.restart: bench "
+                            f"errored: {qsec['error']}"
+                        )
+                    else:
+                        violations.extend(check_restart(schema, qsec))
                     continue
                 if qsec.get("warm_replay_events", 0) != 0:
                     violations.append(

@@ -82,6 +82,11 @@ def main(argv=None) -> int:
     from trino_tpu.connectors.tpch.queries import QUERIES
     from trino_tpu.parallel import DistributedQueryRunner
     from trino_tpu.partitioning import CAP_HISTORY
+    from trino_tpu.runtime.prewarm import (
+        WorkloadManifest,
+        replay_statements,
+        save_manifest,
+    )
     from trino_tpu.telemetry.compile_events import OBSERVATORY
 
     if args.seed:
@@ -98,14 +103,9 @@ def main(argv=None) -> int:
         # LEARNED a speculative-join capacity — the next run compiles the
         # fused expand at the learned bucket, which is part of the closed
         # key set, not a closure failure (seeded histories learn nothing
-        # and go straight to the watermark)
-        cap_version = CAP_HISTORY.version
-        runner.execute(sql)
-        extra = 0
-        while CAP_HISTORY.version != cap_version and extra < 4:
-            cap_version = CAP_HISTORY.version
-            runner.execute(sql)
-            extra += 1
+        # and go straight to the watermark).  Same loop the in-process
+        # PrewarmExecutor runs at server start (runtime/prewarm).
+        extra = replay_statements(runner, [sql]) - 1
         if extra:
             print(
                 f"prewarm_manifest: {extra} capacity-learning run(s) before "
@@ -118,30 +118,40 @@ def main(argv=None) -> int:
             runner.execute(sql)
         warm_events += OBSERVATORY.count - mark
 
-    doc = {
+    watermark = OBSERVATORY.mark()
+    manifest = WorkloadManifest(
+        statements=stmts,
+        # learned speculative-join capacities: seed these back (--seed, or
+        # the prewarm executor at server start) so the first run takes the
+        # fused path at the right bucket and the key set closes on run 1
+        cap_history=CAP_HISTORY.snapshot(),
+        watermark=watermark,
+        closed=warm_events == 0,
+        workers=runner.wm.n,
+        compile_keys=runner.compile_manifest(),
+    )
+    extra_fields = {
         "schema": args.schema,
-        "workers": runner.wm.n,
         "statements": len(stmts),
         "compile_events": OBSERVATORY.count,
         "compile_s": round(OBSERVATORY.total_wall_s, 4),
         "warm_replay_events": warm_events,
-        "manifest": runner.compile_manifest(),
-        # learned speculative-join capacities: seed these back (--seed, or
-        # the prewarm executor at server start) so the first run takes the
-        # fused path at the right bucket and the key set closes on run 1
-        "cap_history": CAP_HISTORY.snapshot(),
     }
-    text = json.dumps(doc, indent=1, default=str)
     if args.out:
-        with open(args.out, "w", encoding="utf-8") as fh:
-            fh.write(text + "\n")
+        # the filesystem SPI path a PrewarmExecutor loads at server start
+        save_manifest(manifest, args.out, extra=extra_fields)
     else:
-        print(text)
+        doc = manifest.to_json()
+        doc.update(extra_fields)
+        print(json.dumps(doc, indent=1, default=str))
     if warm_events:
+        # a hard failure, not advice: CI trusts this exit code as the
+        # prewarm-closure gate (an unclosed manifest under-covers the
+        # workload, so prewarming it cannot make cold starts fully warm)
         print(
-            f"prewarm_manifest: WARNING: {warm_events} compile event(s) on "
-            "warm replays — the key set is not closed; prewarming this "
-            "manifest will not make cold starts fully warm",
+            f"prewarm_manifest: ERROR: {warm_events} compile event(s) on "
+            "warm replays remain above the closure watermark "
+            f"({watermark - warm_events}) — the key set is not closed",
             file=sys.stderr,
         )
         return 2

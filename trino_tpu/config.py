@@ -15,11 +15,15 @@ Resolution order for a knob (first hit wins):
   1. environment: ``TRINO_TPU_<KEY>`` with ``.``/``-`` -> ``_`` and
      uppercased (``breaker.failure-threshold`` ->
      ``TRINO_TPU_BREAKER_FAILURE_THRESHOLD``);
-  2. per-worker override: ``<key>@<token>`` where ``<token>`` is a
+  2. per-catalog override: ``<key>@<catalog>`` where ``<catalog>`` is the
+     EXACT catalog name a resolution is scoped to (catalog names are clean
+     identifiers, so exact match — no substring ambiguity with worker
+     tokens);
+  3. per-worker override: ``<key>@<token>`` where ``<token>`` is a
      substring of the worker id/url (``breaker.failure-threshold@8123=5``
      tunes only the worker whose url contains ``8123``);
-  3. the properties file: ``<key>=<value>``;
-  4. the dataclass default — the PR 5 constants, so behaviour is unchanged
+  4. the properties file: ``<key>=<value>``;
+  5. the dataclass default — the PR 5 constants, so behaviour is unchanged
      when nothing is set.
 
 The process-wide instance is ``get_config()``; ``install_config`` /
@@ -65,7 +69,8 @@ class ConfigSection:
 
     @classmethod
     def from_properties(cls, props: Optional[dict] = None, env=None,
-                        worker: Optional[str] = None):
+                        worker: Optional[str] = None,
+                        catalog: Optional[str] = None):
         props = props or {}
         env = os.environ if env is None else env
         values = {}
@@ -75,6 +80,8 @@ class ConfigSection:
                 continue
             typ = type(f.default)
             raw = env.get(_env_name(key))
+            if raw is None and catalog is not None:
+                raw = props.get(f"{key}@{catalog}")
             if raw is None and worker is not None:
                 raw = _worker_override(props, key, worker)
             if raw is None:
@@ -236,6 +243,12 @@ class WorkerConfig(ConfigSection):
         "seconds a drained server lingers after its last task finishes so "
         "downstream consumers can still pull its results",
     )
+    coordinator_url: str = knob(
+        "", "worker.coordinator-url",
+        "coordinator base url a starting worker announces itself to "
+        "(PUT /v1/worker/register) so a restarted worker resurrects its "
+        "membership entry without operator action; empty = no announce",
+    )
 
 
 @dataclass
@@ -249,6 +262,63 @@ class CoordinatorConfig(ConfigSection):
     poll_wait_s: float = knob(
         1.0, "coordinator.poll-wait",
         "statement/trace long-poll bound",
+    )
+
+
+@dataclass
+class CompileCacheConfig(ConfigSection):
+    """Persistent on-disk XLA compilation cache (JAX's native
+    ``jax_compilation_cache_dir``), wired through the filesystem SPI
+    (trino_tpu/filesystem.py).  `spmd.TRACE_CACHE` is process-local and
+    dies with the process, but the XLA compile — the expensive half of a
+    cold start — can be reloaded from disk: a restarted worker re-traces
+    but skips recompiles.  Remote object-store locations degrade to a
+    loud no-op until the scheme is implemented (runtime/prewarm.
+    enable_persistent_compile_cache).  The cache is per-host: XLA CPU
+    entries embed machine features, so point workers at host-local dirs."""
+
+    dir: str = knob(
+        "", "compile-cache.dir",
+        "on-disk XLA compilation cache location (empty = disabled); "
+        "resolved through the filesystem SPI, so file:// and plain paths "
+        "work and object-store schemes fail loudly at configuration time",
+    )
+    enabled: bool = knob(
+        True, "compile-cache.enabled",
+        "master switch for the persistent compile cache (a set dir can be "
+        "disabled without unsetting it)",
+    )
+    min_compile_time_s: float = knob(
+        0.0, "compile-cache.min-compile-time",
+        "only compiles at least this slow persist (0 = persist everything; "
+        "engine SPMD programs are all worth caching)",
+    )
+    min_entry_size_bytes: int = knob(
+        -1, "compile-cache.min-entry-size-bytes",
+        "only cache entries at least this large persist (-1 = everything)",
+    )
+
+
+@dataclass
+class PrewarmConfig(ConfigSection):
+    """AOT prewarm executor (runtime/prewarm.py): replay a persisted
+    workload manifest at server start / after mesh growth so the first
+    real query finds every (step, bucket, mesh) key already traced."""
+
+    manifest_path: str = knob(
+        "", "prewarm.manifest-path",
+        "workload-manifest location (filesystem SPI; empty = prewarm off): "
+        "SQL replay set + cap_history seed + closure watermark",
+    )
+    on_start: bool = knob(
+        True, "prewarm.on-start",
+        "replay the manifest in a background thread at coordinator/worker "
+        "server start",
+    )
+    on_grow: bool = knob(
+        True, "prewarm.on-grow",
+        "replay the manifest after add_worker grows the mesh, re-tracing "
+        "at the NEW mesh signature before the next query arrives",
     )
 
 
@@ -275,6 +345,10 @@ class ClusterConfig:
     worker: WorkerConfig = field(default_factory=WorkerConfig)
     coordinator: CoordinatorConfig = field(default_factory=CoordinatorConfig)
     memory: MemoryConfig = field(default_factory=MemoryConfig)
+    compile_cache: CompileCacheConfig = field(
+        default_factory=CompileCacheConfig
+    )
+    prewarm: PrewarmConfig = field(default_factory=PrewarmConfig)
     properties: dict = field(default_factory=dict)
 
     def breaker_for(self, worker: str) -> BreakerConfig:
@@ -282,6 +356,17 @@ class ClusterConfig:
         ``breaker.<knob>@<token>`` overrides matching its id."""
         return BreakerConfig.from_properties(
             self.properties, env=self._env, worker=worker
+        )
+
+    def section_for(self, section: str, worker: Optional[str] = None,
+                    catalog: Optional[str] = None) -> ConfigSection:
+        """Re-resolve one subsystem section ('breaker', 'worker', ...)
+        scoped to a worker and/or catalog: ``<key>@<catalog>`` (exact
+        catalog name, between env and the per-worker tier) and
+        ``<key>@<token>`` overrides apply on top of the base config."""
+        cls = type(getattr(self, section))
+        return cls.from_properties(
+            self.properties, env=self._env, worker=worker, catalog=catalog
         )
 
     #: env mapping captured at load so breaker_for stays reproducible
@@ -301,6 +386,8 @@ def load_cluster_config(props: Optional[dict] = None, env=None) -> ClusterConfig
         worker=WorkerConfig.from_properties(props, env),
         coordinator=CoordinatorConfig.from_properties(props, env),
         memory=MemoryConfig.from_properties(props, env),
+        compile_cache=CompileCacheConfig.from_properties(props, env),
+        prewarm=PrewarmConfig.from_properties(props, env),
         properties=props,
     )
     cfg._env = env
@@ -335,12 +422,28 @@ def install_config(cfg: ClusterConfig) -> None:
     global _CURRENT
     with _LOCK:
         _CURRENT = cfg
-    # memory knob takes effect on install (the only eager side effect —
-    # everything else is read at use time)
+    # memory + compile-cache knobs take effect on install (the eager side
+    # effects — everything else is read at use time).  The compile cache
+    # must apply BEFORE the first jit, so install time — which load_etc
+    # hits during server bring-up — is exactly right.
     if cfg.memory.pool_limit_bytes:
         from trino_tpu.runtime.lifecycle import set_memory_pool_limit
 
         set_memory_pool_limit(cfg.memory.pool_limit_bytes)
+    if cfg.compile_cache.enabled and cfg.compile_cache.dir:
+        from trino_tpu.runtime.prewarm import enable_persistent_compile_cache
+
+        enable_persistent_compile_cache(cfg)
+    else:
+        # a reload that turns the cache OFF (enabled=false, or dir unset)
+        # must actually detach it — the master switch is a switch, not a
+        # one-way latch.  Only when a cache is live: a pure-config process
+        # that never touched jax must not import it here.
+        import sys as _sys
+
+        spmd = _sys.modules.get("trino_tpu.parallel.spmd")
+        if spmd is not None and spmd.PERSISTENT_CACHE_DIR:
+            spmd.configure_persistent_cache(None)
 
 
 def reset_config() -> None:

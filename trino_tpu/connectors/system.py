@@ -128,6 +128,9 @@ _TABLES = {
         ("heartbeat_age_s", T.DOUBLE),
         # the worker's circuit-breaker state (closed | half_open | open)
         ("breaker_state", T.VARCHAR),
+        # the process's prewarm-executor state (runtime/prewarm: IDLE |
+        # RUNNING | WARM | UNCLOSED | FAILED; NULL = no executor attached)
+        ("prewarm", T.VARCHAR),
     ],
     "session_properties": [
         ("name", T.VARCHAR),
@@ -257,11 +260,16 @@ class SystemConnector(Connector):
             return REGISTRY.rows()
         if table == "nodes":
             # cluster membership (runtime/membership) is authoritative when
-            # present: worker id, ACTIVE|DRAINING|DEAD, heartbeat age, and
-            # the worker's breaker state in one row
+            # present: worker id, ACTIVE|DRAINING|DEAD, heartbeat age, the
+            # worker's breaker state, and the process's prewarm state in
+            # one row
+            pw = getattr(r, "prewarm", None)
+            pstate = pw.state if pw is not None else None
             membership = getattr(r, "membership", None)
             if membership is not None:
-                return list(membership.snapshot())
+                return [
+                    row + (pstate,) for row in membership.snapshot()
+                ]
             det = getattr(r, "failure_detector", None)
             if det is not None and hasattr(det, "failed_workers"):
                 failed = det.failed_workers()
@@ -272,13 +280,15 @@ class SystemConnector(Connector):
                         "DEAD" if w in failed else "ACTIVE",
                         round(clk - det._last[w], 3),
                         None,
+                        pstate,
                     )
                     for w in sorted(det._last)
                 ]
             import jax
 
             return [
-                (str(d.id), "ACTIVE", None, None) for d in jax.devices()
+                (str(d.id), "ACTIVE", None, None, pstate)
+                for d in jax.devices()
             ]
         if table == "session_properties":
             return [

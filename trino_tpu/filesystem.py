@@ -6,8 +6,9 @@ S3FileSystemExchangeStorage) — every reference component that persists state
 (FTE spool, iceberg metadata/data, hive splits) goes through ONE interface so
 remote object stores are a configuration choice, not a code change.
 
-This engine's consumers (runtime/fte.py spool, connectors/iceberg.py) resolve
-their filesystem through `filesystem_for(location)`:
+This engine's consumers (runtime/fte.py spool, connectors/iceberg.py, the
+persistent XLA compile cache and prewarm manifests in runtime/prewarm.py)
+resolve their filesystem through `filesystem_for(location)`:
 
   * plain paths / `file://` -> LocalFileSystem (the only implementation this
     image can exercise — it has no object-store endpoint and zero egress)
@@ -121,7 +122,8 @@ def filesystem_for(location: Optional[str]) -> FileSystem:
         if loc.startswith(scheme):
             raise NotImplementedError(
                 f"remote filesystem scheme {scheme!r} is not implemented on "
-                "this build; spool/iceberg locations must be local paths"
+                "this build; storage locations (spool, iceberg, compile "
+                "cache, prewarm manifests) must be local paths"
             )
     return LocalFileSystem()
 

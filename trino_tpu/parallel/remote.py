@@ -202,11 +202,18 @@ class MultiHostQueryRunner(LocalQueryRunner):
 
     def add_worker(self, url: str) -> None:
         """Grow path: register a worker; it serves from the next query on
-        (reference: DiscoveryNodeManager announcement)."""
+        (reference: DiscoveryNodeManager announcement).  The attached
+        prewarm executor (runtime/prewarm) then replays the workload
+        manifest in the background at the GROWN worker set — the next
+        query plans at the new W against warm plan/trace state instead of
+        paying the re-fragmentation cold (PR 7 gap (d))."""
+        from trino_tpu.runtime.prewarm import kick_grow_prewarm
+
         if url not in self.worker_urls:
             self.worker_urls.append(url)
         self.membership.register(url)
         self._worker_health.pop(url, None)
+        kick_grow_prewarm(self)
 
     def drain_worker(self, url: str) -> None:
         """Gracefully retire a worker: PUT /v1/worker/shutdown (it finishes

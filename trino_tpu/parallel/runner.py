@@ -206,6 +206,8 @@ class DistributedQueryRunner(LocalQueryRunner):
         from trino_tpu.runtime.fte import HeartbeatFailureDetector
 
         super().__init__(catalogs, catalog=catalog, schema=schema)
+        #: device pool resize_mesh slices from (None = jax.devices())
+        self._devices = devices
         self.wm = WorkerMesh(devices, n_workers)
         #: coordinator-side worker liveness (HeartbeatFailureDetector.java:78);
         #: in-process mesh workers share our liveness, so they are refreshed
@@ -215,6 +217,52 @@ class DistributedQueryRunner(LocalQueryRunner):
             self.failure_detector.register(f"worker-{i}")
         #: MeshProfile of the most recent distributed query (bench evidence)
         self.last_mesh_profile = None
+
+    # -- mesh growth (grow = new mesh signature = fresh compile-key set) -------
+
+    def resize_mesh(self, n_workers: int) -> None:
+        """Re-shape the device mesh for subsequent queries.  A changed W is
+        a NEW mesh signature: every trace-cache key re-traces and the old
+        signature's device-resident scan entries are dead weight — they are
+        dropped here, and the attached prewarm executor (runner.prewarm,
+        runtime/prewarm) replays the workload manifest at the new signature
+        in the background so the next query arrives warm instead of paying
+        the whole compile wall.
+
+        Deliberately NOT named `add_worker`: that name is the coordinator
+        register endpoint's protocol (`add_worker(url)` on the multihost
+        runner) — an int-growing method under the same name would crash
+        `PUT /v1/worker/register` against an in-process runner, which must
+        keep answering 400.  Call between queries — resizing does not
+        serialize with an execution in flight (a server's engine lock
+        already provides that when queries go through it)."""
+        from trino_tpu.parallel.spmd import mesh_key
+        from trino_tpu.runtime.membership import invalidate_mesh_scans
+        from trino_tpu.runtime.prewarm import kick_grow_prewarm
+
+        import jax as _jax
+
+        available = list(
+            self._devices if self._devices is not None else _jax.devices()
+        )
+        if not 1 <= n_workers <= len(available):
+            raise ValueError(
+                f"mesh size {n_workers} out of range (1..{len(available)} "
+                "devices available)"
+            )
+        if n_workers == self.wm.n:
+            return
+        old_sig = mesh_key(self.wm)
+        old_n = self.wm.n
+        self.wm = WorkerMesh(self._devices, n_workers)
+        for i in range(self.wm.n):
+            self.failure_detector.register(f"worker-{i}")
+        # a SHRINK must forget the dropped workers: a stale detector entry
+        # would time out and fail every later query's liveness check
+        for i in range(self.wm.n, old_n):
+            self.failure_detector.unregister(f"worker-{i}")
+        invalidate_mesh_scans(old_sig)
+        kick_grow_prewarm(self)
 
     # -- planning -------------------------------------------------------------
 

@@ -105,6 +105,56 @@ class TraceCache:
 TRACE_CACHE = TraceCache()
 
 
+#: local dir the persistent XLA cache currently points at (None = off);
+#: TRACE_CACHE dies with the process, this survives it — a restarted worker
+#: re-traces every key but reloads the XLA executable from disk
+PERSISTENT_CACHE_DIR: Optional[str] = None
+
+
+def configure_persistent_cache(
+    cache_dir: Optional[str],
+    min_compile_time_s: float = 0.0,
+    min_entry_size_bytes: int = -1,
+) -> bool:
+    """Point JAX's native on-disk compilation cache at `cache_dir` (None
+    disables).  Returns False when this jax build has no persistent-cache
+    knob — callers degrade to a no-op (policy, filesystem-SPI resolution,
+    and warnings live in runtime/prewarm.enable_persistent_compile_cache).
+
+    The threshold knobs are best-effort across jax versions: the dir knob
+    alone still caches with that build's defaults."""
+    global PERSISTENT_CACHE_DIR
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except (AttributeError, ValueError):
+        return False
+    # jax initializes its cache AT MOST ONCE, at the first compile — a dir
+    # configured after that (a server installing config post-import, or a
+    # dir change) would be silently ignored without a reset.  Best-effort:
+    # the module is private, and the flag alone still works when the dir
+    # lands before the first compile.
+    try:
+        from jax._src.compilation_cache import reset_cache
+
+        reset_cache()
+    except Exception:
+        pass
+    PERSISTENT_CACHE_DIR = cache_dir
+    if cache_dir is None:
+        return True
+    for knob, value in (
+        ("jax_persistent_cache_min_compile_time_secs",
+         float(min_compile_time_s)),
+        ("jax_persistent_cache_min_entry_size_bytes",
+         int(min_entry_size_bytes)),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except (AttributeError, ValueError):
+            pass
+    return True
+
+
 def mesh_key(wm: "WorkerMesh") -> tuple:
     """Stable fingerprint of the mesh for trace-cache keys."""
     return (wm.n, tuple(str(d) for d in wm.devices))

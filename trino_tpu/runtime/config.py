@@ -99,7 +99,10 @@ def load_etc(etc_dir: str, install: bool = True) -> EtcConfig:
     and node/session settings (reference: the server launcher's config
     loading + CatalogStore).  The same properties feed the TYPED config
     system (trino_tpu/config): breaker/heartbeat/lifecycle/remote/worker
-    knobs, installed process-wide unless `install=False`."""
+    knobs, installed process-wide unless `install=False` — installation
+    also applies the eager sections (memory pool limit; the persistent
+    XLA compile cache from `compile-cache.dir`, which must be in effect
+    before the first jit)."""
     from trino_tpu.connectors.api import CatalogManager
 
     node_props: dict = {}
@@ -188,6 +191,13 @@ def runner_from_etc(etc_dir: str, **kw):
         from trino_tpu.runtime.events import FileEventListener
 
         r.events.add(FileEventListener(el_props["file.path"]))
+    # restart resilience: an etc/-driven runner gets its prewarm executor
+    # (runtime/prewarm) when `prewarm.manifest-path` is configured — the
+    # CoordinatorServer then replays it at start, and grow paths re-trace
+    # at the new mesh signature (no-op without the knob)
+    from trino_tpu.runtime.prewarm import attach_prewarm
+
+    attach_prewarm(r)
     ac_file = cfg.node_properties.get("access-control.config-file")
     if ac_file:
         import json

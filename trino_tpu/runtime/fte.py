@@ -148,6 +148,13 @@ class HeartbeatFailureDetector:
         self._last[worker] = self.clock()
         self._failed.discard(worker)
 
+    def unregister(self, worker: str) -> None:
+        """Forget a worker entirely (a mesh SHRINK removes it by intent —
+        the stale entry must not time out and fail liveness checks that no
+        longer concern it)."""
+        self._last.pop(worker, None)
+        self._failed.discard(worker)
+
     def heartbeat(self, worker: str) -> None:
         self._last[worker] = self.clock()
         self._failed.discard(worker)

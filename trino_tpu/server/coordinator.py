@@ -151,6 +151,10 @@ class CoordinatorServer:
         self._engine_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self.started_at = time.monotonic()
+        #: True when start() launched the runner's heartbeat failure
+        #: detector (so shutdown() knows to stop it — PR 7 gap (a): the
+        #: coordinator owns the probe loop, callers no longer opt in)
+        self._detector_started = False
 
     # -- query lifecycle ------------------------------------------------------
 
@@ -190,6 +194,11 @@ class CoordinatorServer:
                     # the per-statement user is race-free
                     self.runner.user = user or "user"
                     q.run(self.runner)
+                # successful SELECTs feed the prewarm replay set: the
+                # manifest a restarted server replays IS the live workload
+                pw = getattr(self.runner, "prewarm", None)
+                if pw is not None and q.state == "FINISHED":
+                    pw.record(q.sql)
             finally:
                 group.release()
 
@@ -369,12 +378,38 @@ class CoordinatorServer:
                     self._authenticate()
                 except AuthenticationError:
                     return
-                # PUT /v1/worker/register — the grow path (reference:
-                # DiscoveryNodeManager announcement): body = worker url; it
-                # joins the NEXT query's mesh, never a running one
+                if self.path not in (
+                    "/v1/worker/register", "/v1/worker/drain"
+                ):
+                    return self._send(
+                        404, {"error": {"message": "not found"}}
+                    )
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                # membership mutation is as privileged as task submission:
+                # when the cluster secret is set, register/drain need the
+                # intra-cluster HMAC (an unauthenticated PUT would let any
+                # peer grow or shrink the mesh) — the same gate the
+                # worker's own /v1/worker/shutdown enforces
+                from trino_tpu.server.worker import cluster_secret, sign_body
+
+                secret = cluster_secret()
+                if secret is not None:
+                    import hmac as _hmac
+
+                    sig = self.headers.get("X-Cluster-Auth", "")
+                    if not _hmac.compare_digest(
+                        sig, sign_body(secret, body)
+                    ):
+                        return self._send(
+                            401, {"error": {"message": "bad signature"}}
+                        )
+                url = body.decode().strip()
                 if self.path == "/v1/worker/register":
-                    n = int(self.headers.get("Content-Length", 0))
-                    url = self.rfile.read(n).decode().strip()
+                    # the grow path (reference: DiscoveryNodeManager
+                    # announcement): body = worker url; it joins the NEXT
+                    # query's mesh, never a running one.  A restarted
+                    # worker announces itself here (auto-rejoin).
                     add = getattr(server.runner, "add_worker", None)
                     if not url or add is None:
                         return self._send(
@@ -387,19 +422,15 @@ class CoordinatorServer:
                 # PUT /v1/worker/drain — graceful retirement: body = worker
                 # url; the worker finishes running tasks, refuses new ones,
                 # exits, and the next query's mesh excludes it
-                if self.path == "/v1/worker/drain":
-                    n = int(self.headers.get("Content-Length", 0))
-                    url = self.rfile.read(n).decode().strip()
-                    drain = getattr(server.runner, "drain_worker", None)
-                    if not url or drain is None:
-                        return self._send(
-                            400,
-                            {"error": {"message": "runner is not multi-host "
-                                       "or no worker url given"}},
-                        )
-                    drain(url)
-                    return self._send(200, {"draining": url})
-                self._send(404, {"error": {"message": "not found"}})
+                drain = getattr(server.runner, "drain_worker", None)
+                if not url or drain is None:
+                    return self._send(
+                        400,
+                        {"error": {"message": "runner is not multi-host "
+                                   "or no worker url given"}},
+                    )
+                drain(url)
+                return self._send(200, {"draining": url})
 
             def do_DELETE(self):
                 from trino_tpu.server.security import AuthenticationError
@@ -434,8 +465,55 @@ class CoordinatorServer:
         self._httpd = ThreadingHTTPServer((self.host, self.port), self._handler())
         self.port = self._httpd.server_address[1]
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        self._start_background()
+
+    def _start_background(self) -> None:
+        """Server-owned background services (started with the listener,
+        stopped by shutdown()):
+
+        * the runner's heartbeat failure detector probe loop — PR 7 left
+          `HeartbeatDetector.start()` to callers; the server is the only
+          process that should own it (only membership-backed detectors
+          have a start/stop loop — the in-mesh detector refreshes at query
+          start and needs none);
+        * the prewarm executor (runtime/prewarm): attach one from
+          `prewarm.manifest-path` when the runner has none, and replay the
+          persisted workload manifest in the background so restart cost is
+          paid before the first query, not by it."""
+        det = getattr(self.runner, "failure_detector", None)
+        if det is not None and callable(getattr(det, "start", None)) \
+                and callable(getattr(det, "stop", None)):
+            det.start()
+            self._detector_started = True
+        from trino_tpu.config import get_config
+
+        pw = getattr(self.runner, "prewarm", None)
+        if pw is None and get_config().prewarm.manifest_path:
+            from trino_tpu.runtime.prewarm import attach_prewarm
+
+            pw = attach_prewarm(self.runner)
+        if pw is not None:
+            # adopt even a pre-attached executor (runner_from_etc creates
+            # one with a private lock): replays — start AND later grow
+            # kicks — must serialize with live queries under the SAME lock
+            pw.use_lock(self._engine_lock)
+            if get_config().prewarm.on_start:
+                pw.run(reason="start")
 
     def shutdown(self) -> None:
+        if self._detector_started:
+            det = getattr(self.runner, "failure_detector", None)
+            if det is not None:
+                det.stop()
+            self._detector_started = False
+        pw = getattr(self.runner, "prewarm", None)
+        if pw is not None:
+            try:
+                # the replay set observed this incarnation persists for the
+                # next one (no-op without a manifest location / statements)
+                pw.save()
+            except Exception:
+                pass
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
@@ -443,4 +521,5 @@ class CoordinatorServer:
     def serve(self) -> None:
         self._httpd = ThreadingHTTPServer((self.host, self.port), self._handler())
         print(f"trino-tpu coordinator listening on {self.host}:{self.port}")
+        self._start_background()
         self._httpd.serve_forever()

@@ -146,6 +146,7 @@ class WorkerServer:
         port: int = 0,
         host: str = "127.0.0.1",
         max_concurrent_tasks: Optional[int] = None,
+        coordinator_url: Optional[str] = None,
     ):
         from trino_tpu.config import get_config
         from trino_tpu.connectors.api import default_catalogs
@@ -168,6 +169,14 @@ class WorkerServer:
         self.drained = threading.Event()
         #: injectable for tests (the drain-grace linger must not slow them)
         self._sleep = time.sleep
+        #: injectable clock: the drain waiter's wait+grace bound and its
+        #: force-kill escalation run deterministically in tier-1
+        self._clock = time.monotonic
+        #: coordinator to announce to at start (auto-rejoin); falls back to
+        #: the `worker.coordinator-url` config knob
+        self._coordinator_url = coordinator_url
+        #: set once a register announce succeeded (test/ops evidence)
+        self.registered = threading.Event()
         self._secret = cluster_secret()
         if host not in ("127.0.0.1", "localhost") and self._secret is None:
             raise ValueError(
@@ -328,11 +337,59 @@ class WorkerServer:
         return f"http://{self._host}:{self.port}"
 
     def start(self) -> "WorkerServer":
+        from trino_tpu.config import get_config
+
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="worker"
         )
         self._thread.start()
+        # auto-rejoin (reference: DiscoveryNodeManager announcement): a
+        # RESTARTED worker resurrects its membership entry by announcing
+        # itself — no operator action.  Background + best-effort: a worker
+        # must come up even while its coordinator is still restarting.
+        coord = self._coordinator_url or get_config().worker.coordinator_url
+        if coord:
+            threading.Thread(
+                target=self.announce, args=(coord,), daemon=True,
+                name="worker-register",
+            ).start()
         return self
+
+    def announce(self, coordinator_url: str,
+                 attempts: Optional[int] = None) -> bool:
+        """PUT /v1/worker/register at the coordinator (HMAC'd when the
+        cluster secret is set), with backed-off retries so a worker that
+        restarts FASTER than its coordinator still rejoins."""
+        from trino_tpu.config import get_config
+        from trino_tpu.runtime.retry import Backoff
+
+        cfg = get_config()
+        body = self.url.encode()
+        headers = {}
+        if self._secret is not None:
+            headers["X-Cluster-Auth"] = sign_body(self._secret, body)
+        backoff = Backoff(
+            base_s=cfg.remote.backoff_base_s, cap_s=cfg.remote.backoff_cap_s,
+            sleep=self._sleep,
+        )
+        n = attempts if attempts is not None else cfg.remote.submit_attempts
+        for attempt in range(max(1, n)):
+            if attempt:
+                backoff.wait(attempt - 1)
+            req = urllib.request.Request(
+                f"{coordinator_url}/v1/worker/register", data=body,
+                headers=headers, method="PUT",
+            )
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=cfg.lifecycle.probe_timeout_s
+                ) as r:
+                    r.read()
+            except Exception:
+                continue
+            self.registered.set()
+            return True
+        return False
 
     def shutdown(self) -> None:
         self._httpd.shutdown()
@@ -341,12 +398,18 @@ class WorkerServer:
     def begin_drain(self, exit_on_idle: bool = True) -> None:
         """Graceful shutdown (reference: GracefulShutdownHandler, SURVEY
         §5.3): flip to DRAINING (new submissions get 503/REFUSED), wait for
-        every running task to finish, set `drained`, linger for
-        `worker.drain-grace` seconds so downstream consumers can still PULL
-        the finished tasks' results (task completion is not result
-        delivery — the reference sleeps out a grace period for exactly this
-        reason), then stop the HTTP server.  Idempotent — a second PUT
-        while draining is a no-op."""
+        running tasks to finish under ONE shared `worker.drain-task-wait`
+        deadline, set `drained`, linger for `worker.drain-grace` seconds so
+        downstream consumers can still PULL the finished tasks' results
+        (task completion is not result delivery — the reference sleeps out
+        a grace period for exactly this reason), then stop the HTTP server.
+
+        Forced-kill escalation: tasks still running when the wait expires
+        are canceled through their task-lifecycle tokens (they abort at
+        their next cooperative check, with the grace window to honor it)
+        and the server exits REGARDLESS — total drain time is bounded by
+        wait + grace, so a wedged task can never wedge a drain.
+        Idempotent — a second PUT while draining is a no-op."""
         with self._state_lock:
             if self.state != "ACTIVE":
                 return
@@ -358,10 +421,21 @@ class WorkerServer:
 
         def waiter():
             from trino_tpu.config import get_config
+            from trino_tpu.telemetry.metrics import drain_force_kills_counter
 
             cfg = get_config().worker
+            deadline = worker._clock() + cfg.drain_task_wait_s
             for t in running:
-                t.done.wait(timeout=cfg.drain_task_wait_s)
+                t.done.wait(timeout=max(0.0, deadline - worker._clock()))
+            for t in running:
+                if not t.done.is_set():
+                    # the escalation: a cooperative task aborts inside the
+                    # grace window; a truly wedged one is abandoned when
+                    # the server exits below — either way the drain ends
+                    t.lifecycle.cancel(
+                        "drain force-kill: worker.drain-task-wait expired"
+                    )
+                    drain_force_kills_counter().inc()
             worker.drained.set()
             if exit_on_idle:
                 self._sleep(cfg.drain_grace_s)

@@ -246,6 +246,15 @@ class CompileObservatory:
         with self._lock:
             return list(self._ring)
 
+    def events_above(self, watermark: int) -> list:
+        """Events recorded after a `mark()` watermark (closure forensics:
+        a prewarmed replay that still compiles names the leaking steps
+        instead of just counting them).  Bounded by the ring window — the
+        COUNT above the watermark is always `count - watermark` even when
+        the ring has rotated past some of the events."""
+        with self._lock:
+            return [e for e in self._ring if e.seq > watermark]
+
     def rows(self) -> list:
         """system.runtime.compilations feed: (seq, step, bucket, mesh,
         query_id, fragment, wall_s, key_fp, key) per recent event."""

@@ -400,6 +400,17 @@ COLLECTIVE_VOCABULARY = (
 MEMBERSHIP_EVENT_KINDS = ("join", "drain", "death", "rejoin", "shrink_replan")
 
 
+#: prewarm-run vocabulary, pre-registered so scrapes see every
+#: (trigger, outcome) cell at 0 before the first replay fires
+PREWARM_REASONS = ("start", "grow", "manual")
+PREWARM_OUTCOMES = ("warm", "unclosed", "failed", "empty")
+
+#: prewarm executor state -> trino_tpu_prewarm_state gauge code
+PREWARM_STATE_CODES = {
+    "IDLE": 0, "RUNNING": 1, "WARM": 2, "UNCLOSED": 3, "FAILED": 4,
+}
+
+
 def _compile_events_total():
     from trino_tpu.telemetry.compile_events import OBSERVATORY
 
@@ -486,6 +497,30 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
         "per-worker liveness from the heartbeat failure detector "
         "(1 = ACTIVE/DRAINING, 0 = DEAD)",
         labelnames=("worker",),
+    )
+    prewarm = reg.counter(
+        _PREFIX + "prewarm_runs_total",
+        "prewarm-executor replays by trigger reason and outcome "
+        "(runtime/prewarm: warm = closed key set, unclosed = the verify "
+        "replay still compiled, failed = a statement raised)",
+        labelnames=("reason", "outcome"),
+    )
+    for reason in PREWARM_REASONS:
+        for outcome in PREWARM_OUTCOMES:
+            prewarm.touch(reason, outcome)
+    reg.counter(
+        _PREFIX + "prewarm_statements_total",
+        "statement executions performed by prewarm replays",
+    )
+    reg.gauge(
+        _PREFIX + "prewarm_state",
+        "prewarm executor state (0 idle, 1 running, 2 warm, 3 unclosed, "
+        "4 failed)",
+    )
+    reg.counter(
+        _PREFIX + "drain_force_kills_total",
+        "tasks force-canceled because worker.drain-task-wait expired "
+        "during a graceful drain (the bounded-drain escalation)",
     )
     reg.histogram(
         _PREFIX + "compile_seconds",
@@ -598,6 +633,25 @@ def collective_bytes_counter() -> Counter:
     """The labeled per-collective byte counter MeshProfile.add_collective
     mirrors into."""
     return REGISTRY.counter(_PREFIX + "collective_bytes_total")
+
+
+def prewarm_runs_counter() -> Counter:
+    """Prewarm replays by (reason, outcome) — runtime/prewarm."""
+    return REGISTRY.counter(_PREFIX + "prewarm_runs_total")
+
+
+def prewarm_statements_counter() -> Counter:
+    return REGISTRY.counter(_PREFIX + "prewarm_statements_total")
+
+
+def prewarm_state_gauge() -> Gauge:
+    """Executor state as a code (PREWARM_STATE_CODES)."""
+    return REGISTRY.gauge(_PREFIX + "prewarm_state")
+
+
+def drain_force_kills_counter() -> Counter:
+    """Tasks force-canceled by the bounded-drain escalation."""
+    return REGISTRY.counter(_PREFIX + "drain_force_kills_total")
 
 
 _register_engine_metrics(REGISTRY)
