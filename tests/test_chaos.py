@@ -35,8 +35,23 @@ pytestmark = [pytest.mark.slow, pytest.mark.heavy]
 DEADLINE_S = 60.0
 
 
+@pytest.fixture(scope="module", autouse=True)
+def lockgraph():
+    """Instrumented-lock mode (verify.lockgraph): every lock created
+    during the chaos module — servers, runners, registries, background
+    waiters — reports its acquisition order, and the module fails if the
+    recorded graph has a cycle.  An order inversion is a deadlock waiting
+    for the right interleaving, so this gate fires even on runs where the
+    chaos happened not to hang."""
+    from trino_tpu.verify import lockgraph as lg
+
+    with lg.capture() as graph:
+        yield graph
+    graph.assert_acyclic()
+
+
 @pytest.fixture(scope="module")
-def workers():
+def workers(lockgraph):
     ws = [WorkerServer(port=0).start() for _ in range(2)]
     yield ws
     for w in ws:

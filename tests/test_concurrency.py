@@ -1,0 +1,422 @@
+"""Tests for the concurrency analyzer (verify/concurrency.py) and the
+dynamic lock-order verifier (verify/lockgraph.py): guarded-state inference
+over synthetic classes, the triage baseline, thread discipline, static
+nested-with lock-order extraction, the instrumented-lock graph with a
+seeded deadlock, and the repo-wide gates.  Everything is deterministic —
+the lock-order tests prove deadlocks from ORDER, not interleaving, so no
+test ever sleeps or races."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from trino_tpu.verify.concurrency import (
+    analyze_paths,
+    analyze_source,
+    apply_baseline,
+    find_cycles,
+    unguarded_findings,
+)
+from trino_tpu.verify.lockgraph import (
+    InstrumentedLock,
+    LockGraph,
+    LockOrderViolation,
+    capture,
+    instrument_attr,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _findings(src: str):
+    reports, threads, edges = analyze_source("mod.py", src)
+    return unguarded_findings(reports), threads, edges
+
+
+GUARDED = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self.sink = None
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def drain(self):
+        out = self._items  # unguarded read
+        self._items = []   # unguarded write
+        return out
+"""
+
+
+class TestGuardedStateInference:
+    def test_flags_unguarded_read_and_write(self):
+        found, _, _ = _findings(GUARDED)
+        kinds = {(f.line, "read" in f.message) for f in found}
+        assert len(found) == 2
+        assert all(f.rule == "unguarded-state" for f in found)
+        assert all(f.key == "mod.py:Box._items" for f in found)
+        assert {True, False} == {r for _, r in kinds}
+
+    def test_init_is_exempt_and_immutable_attrs_unflaggable(self):
+        src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cfg = 1
+    def read(self):
+        with self._lock:
+            a = self.cfg   # guarded read of an attr nobody mutates
+        return self.cfg    # unguarded read: still fine (immutable)
+"""
+        found, _, _ = _findings(src)
+        assert found == []
+
+    def test_attribute_calls_are_behavior_not_state(self):
+        src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.clock = None
+        self.n = 0
+    def tick(self):
+        with self._lock:
+            self.n += 1
+            now = self.clock()
+    def outside(self):
+        return self.clock()   # calling an attr is not a state access
+"""
+        found, _, _ = _findings(src)
+        assert found == []
+
+    def test_self_alias_reaches_nested_class(self):
+        src = """
+import threading
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "ACTIVE"
+        server = self
+
+        class Handler:
+            def handle(self):
+                return server.state   # cross-thread read via the alias
+
+    def drain(self):
+        with self._lock:
+            self.state = "DRAINING"
+"""
+        found, _, _ = _findings(src)
+        assert len(found) == 1
+        assert found[0].key == "mod.py:Server.state"
+
+    def test_mutator_method_is_a_write(self):
+        found, _, _ = _findings(GUARDED)
+        # .append under the lock is what marks _items guarded in the first
+        # place — remove the with and nothing is guarded
+        src = GUARDED.replace("with self._lock:\n            ", "")
+        none_found, _, _ = _findings(src)
+        assert found and none_found == []
+
+    def test_line_and_def_level_allow(self):
+        src = GUARDED.replace(
+            "out = self._items  # unguarded read",
+            "out = self._items  # lint: allow(unguarded-state)",
+        )
+        reports, _, _ = analyze_source("mod.py", src)
+        raw = unguarded_findings(reports)
+        assert len(raw) == 2  # suppression applies at the gate, not here
+        import trino_tpu.verify.concurrency as C
+
+        allow = C._allowances(src)
+        scopes = C._scope_index(src)
+        live = [f for f in raw if not C._suppressed(f, allow, scopes)]
+        assert len(live) == 1  # the annotated line is suppressed
+
+    def test_nested_def_resets_held_locks(self):
+        src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.x = 0
+    def spawn(self):
+        with self._lock:
+            self.x = 1
+            def waiter():
+                return self.x   # runs later, on another thread: unguarded
+            return waiter
+"""
+        found, _, _ = _findings(src)
+        assert len(found) == 1
+        assert "read" in found[0].message
+
+    def test_baseline_split(self):
+        found, _, _ = _findings(GUARDED)
+        new, stale = apply_baseline(
+            found, {"mod.py:Box._items": "drained by the single owner"}
+        )
+        assert new == [] and stale == []
+        new, stale = apply_baseline(found, {"mod.py:Box.other": "gone"})
+        assert len(new) == 2 and stale == ["mod.py:Box.other"]
+
+    def test_repo_is_triaged(self):
+        """The analyzer over trino_tpu/ has no finding outside the
+        checked-in baseline — every unguarded access is a fix or a
+        justified, reviewed entry."""
+        import json
+
+        findings, _ = analyze_paths(["trino_tpu"], root=REPO_ROOT)
+        with open(
+            os.path.join(REPO_ROOT, "tools", "lint_baseline.json")
+        ) as fh:
+            baseline = json.load(fh)["unguarded_state"]
+        assert all(isinstance(v, str) and v for v in baseline.values()), (
+            "every baseline entry needs its one-line justification"
+        )
+        new, stale = apply_baseline(findings, baseline)
+        assert new == [], "\n".join(str(f) for f in new)
+        assert stale == [], f"ratchet the baseline down: {stale}"
+
+
+class TestThreadDiscipline:
+    def test_flags_missing_name_and_daemon(self):
+        src = """
+import threading
+def go(fn):
+    threading.Thread(target=fn).start()
+    threading.Thread(target=fn, name="ok").start()
+    threading.Thread(target=fn, daemon=True).start()
+    threading.Thread(target=fn, name="ok", daemon=True).start()
+"""
+        _, threads, _ = _findings(src)
+        msgs = sorted(t.message for t in threads)
+        assert len(threads) == 3
+        assert any("name and daemon" in m for m in msgs)
+
+    def test_repo_threads_are_attributable(self):
+        findings, _ = analyze_paths(["trino_tpu"], root=REPO_ROOT)
+        bad = [f for f in findings if f.rule == "thread-discipline"]
+        assert bad == [], "\n".join(str(f) for f in bad)
+
+
+class TestStaticLockOrder:
+    def test_nested_with_inconsistent_order_is_a_cycle(self):
+        src = """
+import threading
+class A:
+    def __init__(self, peer_lock):
+        self._lock = threading.Lock()
+        self._peer_lock = peer_lock  # adopted lock
+    def forward(self):
+        with self._lock:
+            with self._peer_lock:
+                pass
+    def backward(self):
+        with self._peer_lock:
+            with self._lock:
+                pass
+"""
+        _, _, edges = _findings(src)
+        cycles = find_cycles(edges)
+        assert cycles, edges
+        flat = {n for cyc in cycles for n in cyc}
+        assert "A._lock" in flat and "A._peer_lock" in flat
+
+    def test_repo_static_order_is_acyclic(self):
+        findings, edges = analyze_paths(["trino_tpu"], root=REPO_ROOT)
+        assert [f for f in findings if f.rule == "lock-order-cycle"] == []
+        # the engine's one static nesting today: prewarm's engine lock
+        # wraps its state lock — assert the graph sees it, so this test
+        # would notice the extractor going blind
+        assert any(
+            a == "PrewarmExecutor._engine_lock"
+            and b == "PrewarmExecutor._state_lock"
+            for a, b, _ in edges
+        ), sorted(set((a, b) for a, b, _ in edges))
+
+
+class TestLockGraph:
+    def test_seeded_deadlock_fires_the_detector(self):
+        """The seeded AB/BA inversion: one thread, two locks, two nesting
+        orders — no interleaving, no hang, and the cycle detector fires
+        with witness sites.  This is the dynamic analog of the deadlock
+        chaos would only find by luck."""
+        g = LockGraph()
+        a = InstrumentedLock("engine", g)
+        b = InstrumentedLock("state", g)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert g.cycles() == [["engine", "state", "engine"]]
+        with pytest.raises(LockOrderViolation) as ei:
+            g.assert_acyclic()
+        assert "engine -> state" in str(ei.value)
+        assert "test_concurrency.py" in str(ei.value)  # witness site
+
+    def test_consistent_order_across_threads_is_acyclic(self):
+        g = LockGraph()
+        a = InstrumentedLock("a", g)
+        b = InstrumentedLock("b", g)
+
+        def use():
+            with a:
+                with b:
+                    pass
+
+        ts = [
+            threading.Thread(target=use, name=f"t{i}", daemon=True)
+            for i in range(4)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        g.assert_acyclic()
+        assert g.edges() and all(k == ("a", "b") for k in g.edges())
+
+    def test_reentrant_and_nonblocking_protocol(self):
+        g = LockGraph()
+        a = InstrumentedLock("a", g, inner=threading.RLock())
+        with a:
+            with a:  # reentrant: no self-edge
+                pass
+        b = InstrumentedLock("b", g)
+        assert b.acquire(blocking=False)
+        assert b.locked()
+        b.release()
+        assert not b.locked()
+        assert g.cycles() == []
+
+    def test_failed_try_acquire_records_no_edge(self):
+        """`if a.acquire(False): ... else: back off` is the standard way to
+        SIDESTEP an ordering constraint and can never deadlock — a failed
+        try-acquire must not fabricate a cycle edge."""
+        g = LockGraph()
+        a = InstrumentedLock("a", g)
+        b = InstrumentedLock("b", g)
+        with a:
+            with b:
+                pass
+        a._inner.acquire()  # someone else holds a
+        try:
+            with b:
+                assert not a.acquire(blocking=False)  # try-lock backs off
+        finally:
+            a._inner.release()
+        assert g.cycles() == []
+        # a SUCCESSFUL try-acquire does hold both locks, so it records
+        with b:
+            assert a.acquire(blocking=False)
+            a.release()
+        assert ("b", "a") in g.edges()
+        assert g.cycles()  # now a genuine inversion exists
+
+    def test_capture_instruments_new_locks_and_restores(self):
+        real = threading.Lock
+        with capture(singletons=False) as g:
+            l1 = threading.Lock()
+            l2 = threading.Lock()
+            with l1:
+                with l2:
+                    pass
+        assert threading.Lock is real
+        assert len(g.edges()) == 1
+        ((outer, inner),) = g.edges()
+        assert outer.startswith("lock@") and inner.startswith("lock@")
+
+    def test_instrument_attr_wraps_in_place(self):
+        class Obj:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        g = LockGraph()
+        o = Obj()
+        restore = instrument_attr(o, "_lock", "Obj._lock", g)
+        with o._lock:
+            pass
+        restore()
+        assert isinstance(o._lock, type(threading.Lock()))
+
+    def test_engine_locks_compose_acyclically(self):
+        """Drive the real prewarm/lifecycle lock pairs under instrumented
+        locks (deterministically, one thread) and assert the recorded
+        order graph is acyclic — the tier-1 half of the chaos-suite
+        lockgraph gate."""
+        from trino_tpu.runtime.lifecycle import QueryContext, QueryTracker
+        from trino_tpu.runtime.prewarm import PrewarmExecutor
+
+        g = LockGraph()
+
+        class _Runner:
+            def execute(self, sql):
+                return None
+
+        pw = PrewarmExecutor(_Runner(), manifest_location=None, verify=False)
+        instrument_attr(pw, "_engine_lock", "prewarm.engine", g)
+        instrument_attr(pw, "_state_lock", "prewarm.state", g)
+        pw.record("select 1")
+        pw.run(statements=["select 1"], wait=True)
+        tracker = QueryTracker()
+        instrument_attr(tracker, "_lock", "tracker", g)
+        ctx = tracker.create("q1")
+        instrument_attr(ctx, "_lock", "query", g)
+        ctx.begin()
+        ctx.finish()
+        tracker.remove(ctx)
+        g.assert_acyclic()
+        assert ("prewarm.engine", "prewarm.state") in g.edges()
+
+
+class TestLifecycleRaceRegression:
+    def test_finish_cannot_resurrect_a_terminal_state(self):
+        from trino_tpu.runtime import lifecycle as L
+
+        ctx = L.QueryContext("q")
+        ctx.begin()
+        ctx.fail(RuntimeError("boom"))
+        assert ctx.state == L.FAILED
+        ctx.finish()  # the pre-fix race path: must be a no-op now
+        assert ctx.state == L.FAILED
+        ctx.finishing()
+        assert ctx.state == L.FAILED
+        assert ctx.done
+
+    def test_detector_double_start_leaks_no_second_loop(self):
+        from trino_tpu.runtime.membership import (
+            ClusterMembership,
+            HeartbeatDetector,
+        )
+
+        class Cfg:
+            miss_threshold = 3
+            interval_s = 0.0
+            probe_timeout_s = 0.1
+
+        stop_spin = threading.Event()
+        det = HeartbeatDetector(
+            ClusterMembership(),  # no workers: ticks are no-ops
+            prober=lambda w: True,
+            config=Cfg(),
+            sleep=lambda s: stop_spin.wait(0.01),
+        )
+        det.start()
+        first = det._thread
+        assert det.start() is det  # idempotent
+        assert det._thread is first
+        det.stop()
+        stop_spin.set()
+        first.join(timeout=5)
+        assert not first.is_alive()

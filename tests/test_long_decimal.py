@@ -333,3 +333,76 @@ def test_holistic_aggs_over_long(runner):
         runner.execute("select array_agg(v) from ht")
     with _pt.raises(Exception, match="long-decimal"):
         runner.execute("select k, sum(v) over (partition by k) from ht")
+
+
+class TestSum128FastPath:
+    """The provably-exact i64 fast path of _sum128 on the CPU fallback
+    (segmented) path: when the input's declared precision bounds every
+    partial sum inside i64, ONE i64 segment sum runs — statically, with no
+    lax.cond and no runtime fits scan — for 1-D AND limb-plane (2-D)
+    inputs (ROADMAP item 2's decimal(38) headline regression)."""
+
+    def _sum(self, vals, gid, nseg, prec, two_d):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from trino_tpu.ops.aggregation import _sum128
+        from trino_tpu.types.int128 import join_py, split_py
+
+        if two_d:
+            h = np.array([split_py(v)[0] for v in vals], np.int64)
+            l = np.array([split_py(v)[1] for v in vals], np.int64)
+            d = jnp.stack([jnp.asarray(h), jnp.asarray(l)], axis=-1)
+        else:
+            d = jnp.asarray(np.array(vals, np.int64))
+        out = np.asarray(
+            _sum128(d, jnp.asarray(np.array(gid)), nseg, None,
+                    in_precision=prec)
+        )
+        return [join_py(int(out[s, 0]), int(out[s, 1])) for s in range(nseg)]
+
+    @pytest.mark.parametrize("two_d", [False, True])
+    def test_exact_at_the_boundary(self, two_d):
+        vals = [10**12 - 1, -(10**12 - 1), 7, 10**12 - 1]
+        gid = [0, 0, 1, 1]
+        got = self._sum(vals, gid, 2, 12, two_d)
+        assert got == [0, 10**12 + 6]
+
+    def test_wide_values_still_exact(self):
+        vals = [10**37, 10**37, -(10**36), 3]
+        got = self._sum(vals, [0, 0, 1, 1], 2, 38, True)
+        assert got == [2 * 10**37, 3 - 10**36]
+
+    @pytest.mark.parametrize("two_d", [False, True])
+    def test_provable_precision_compiles_no_cond(self, two_d):
+        """The static proof removes the runtime branch entirely: the jaxpr
+        of a provably-narrow sum contains NO cond primitive; an unprovable
+        (wide) precision keeps the runtime-adaptive cond."""
+        import jax
+        import jax.numpy as jnp
+
+        from trino_tpu.ops.aggregation import _sum128
+
+        shape = (8, 2) if two_d else (8,)
+
+        def jaxpr(prec):
+            return str(
+                jax.make_jaxpr(
+                    lambda d, g: _sum128(d, g, 2, None, in_precision=prec)
+                )(jnp.zeros(shape, jnp.int64), jnp.zeros(8, jnp.int64))
+            )
+
+        assert "cond" not in jaxpr(12)
+        assert "cond" in jaxpr(38)
+
+    def test_sum_of_narrow_decimal_widened_result(self, runner):
+        """End to end: sum(decimal(12,2)) with a decimal(38) result — the
+        common TPC-H shape the fast path exists for."""
+        runner.execute("create table nr (k bigint, v decimal(12,2))")
+        runner.execute(
+            "insert into nr values (1, decimal '9999999999.99'), "
+            "(1, decimal '0.01'), (2, decimal '-0.50'), (2, null)"
+        )
+        assert runner.execute(
+            "select k, sum(v) from nr group by k order by k"
+        ).rows == [(1, Decimal("10000000000.00")), (2, Decimal("-0.50"))]

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""AST lint for host-sync hazards in device code (stdlib `ast` only).
+"""Multi-pass AST analyzer: host-sync hazards in device code plus the
+concurrency passes (stdlib `ast` only).
 
 The mesh pipeline's performance rests on fragment chains staying
 device-resident; one stray `.item()` or `np.asarray` on a device value
@@ -25,6 +26,20 @@ pays.  This linter walks `trino_tpu/ops/`, `trino_tpu/parallel/`, and
                     | (trino_tpu/server/ + parallel/remote.py) — socket
                     | waits must derive from the query deadline
                     | (`lifecycle.request_timeout`) or a named constant
+
+A second pass — the concurrency analyzer (trino_tpu/verify/concurrency.py)
+— runs over ALL of trino_tpu/:
+
+  unguarded-state   | read/write of a lock-guarded `self._x` attribute
+                    | outside any lock in its class (guarded-state
+                    | inference); survivors triage through the
+                    | `unguarded_state` baseline map in
+                    | tools/lint_baseline.json, one justification per entry
+  thread-discipline | `threading.Thread(...)` without `name=` or an
+                    | explicit `daemon=`
+  lock-order-cycle  | nested `with <lock>:` statements whose repo-wide
+                    | acquisition-order graph has a cycle (the static half;
+                    | verify.lockgraph is the dynamic half)
 
 Rules are path-scoped: device rules run over ops/parallel/expr;
 raw-http-timeout runs over trino_tpu/server/ and parallel/remote.py (and
@@ -78,7 +93,15 @@ RULES = {
     "module-level-knob": "module/class-level numeric knob literal — load "
                          "it from the typed config (trino_tpu/config) so "
                          "deployments can tune it without a code change",
+    # concurrency pass (verify/concurrency.py)
+    "unguarded-state": "lock-guarded attribute accessed outside any lock",
+    "thread-discipline": "threading.Thread without name= / explicit daemon=",
+    "lock-order-cycle": "inconsistent nested lock acquisition order",
 }
+
+#: paths the concurrency pass walks (everything; locks live in runtime/,
+#: server/, telemetry/, parallel/, partitioning/, config)
+CONCURRENCY_PATHS = ("trino_tpu",)
 
 #: rules that only make sense in device code (ops/parallel/expr)
 _DEVICE_RULES = frozenset(RULES) - {"raw-http-timeout", "module-level-knob"}
@@ -388,25 +411,89 @@ def check_suppression_budget(paths=None, root: str = ".") -> list:
     return []
 
 
+def unguarded_state_baseline(root: str = ".") -> dict:
+    """{file:Class.attr -> justification} from tools/lint_baseline.json."""
+    import json
+
+    path = os.path.join(root, "tools", "lint_baseline.json")
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return dict(json.load(fh).get("unguarded_state") or {})
+    except (OSError, ValueError):
+        return {}
+
+
+def _load_concurrency(root: str):
+    """Load verify/concurrency.py by FILE PATH, not package import: the
+    trino_tpu package imports jax at init, and this lint must keep running
+    in the dependency-free CI lint job (the analyzer itself is pure
+    stdlib-ast)."""
+    import importlib.util
+
+    path = os.path.join(root, "trino_tpu", "verify", "concurrency.py")
+    spec = importlib.util.spec_from_file_location("_lint_concurrency", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass processing resolves cls.__module__ through sys.modules
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_concurrency(root: str = ".", baseline=None):
+    """The concurrency pass (verify/concurrency.py) over trino_tpu/:
+    guarded-state inference + thread discipline + static lock-order cycles,
+    with the unguarded-state findings triaged through the baseline.
+    Returns (failing findings, stale baseline keys)."""
+    conc = _load_concurrency(root)
+    findings, _ = conc.analyze_paths(CONCURRENCY_PATHS, root=root)
+    if baseline is None:
+        baseline = unguarded_state_baseline(root)
+    return conc.apply_baseline(findings, baseline)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="AST lint for host-sync hazards in TPU device code"
+        description="multi-pass AST analyzer: host-sync hazards in TPU "
+        "device code + the concurrency passes"
     )
     ap.add_argument(
         "paths", nargs="*", default=None,
-        help=f"files/dirs to lint (default: {', '.join(DEFAULT_PATHS)})",
+        help=f"files/dirs to lint (default: {', '.join(DEFAULT_PATHS)}; "
+        "when given, only the device pass runs)",
     )
     ap.add_argument(
         "--root", default=None,
         help="repo root (default: parent of this script's directory)",
     )
+    ap.add_argument(
+        "--only", choices=("device", "concurrency"), default=None,
+        help="run a single pass (default: all)",
+    )
     args = ap.parse_args(argv)
+    if args.only == "concurrency" and args.paths:
+        # the concurrency pass is repo-wide (its lock-order graph and
+        # baseline are whole-tree artifacts): path-scoping it would
+        # silently verify nothing
+        ap.error("--only concurrency does not take path arguments "
+                 "(the pass is repo-wide)")
     root = args.root or os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))
     )
-    findings = run_lint(args.paths or None, root=root)
+    findings = []
+    if args.only != "concurrency":
+        findings.extend(run_lint(args.paths or None, root=root))
+    stale = []
+    if args.only != "device" and not args.paths:
+        conc, stale = run_concurrency(root)
+        findings.extend(conc)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
     for f in findings:
         print(f)
+    for k in stale:
+        print(
+            f"note: baseline entry {k!r} has no live finding — ratchet "
+            "tools/lint_baseline.json (unguarded_state) down"
+        )
     budget_errors = []
     if not args.paths:  # budget is repo-wide; skip for targeted runs
         budget_errors = check_suppression_budget(None, root)

@@ -318,16 +318,34 @@ def _sum128(d, gid, nseg: int, valid, in_precision: int = None):
         if valid is not None:
             h = jnp.where(valid, h, 0)
             l = jnp.where(valid, l, 0)
+        if (
+            in_precision is not None
+            and (10**in_precision) * rows < (1 << 63)
+        ):
+            # STATIC narrow proof for limb-plane inputs (the CPU fallback
+            # of the one-hot matmul path): |v| < 10**p bounds every value
+            # inside i64 — the high limb is pure sign extension by the
+            # type's range contract — and `rows` addends provably sum
+            # inside i64, so ONE i64 segment sum is exact with no runtime
+            # fits scan and no lax.cond (a widened-but-narrow column never
+            # pays the limb-plane cost).
+            return jnp.stack(
+                i128.widen64(jax.ops.segment_sum(l, gid, nseg)), axis=-1
+            )
         # Runtime-adaptive narrow path (the common TPC-H shape: a product
         # typed decimal(25+) whose actual values are ~10 digits).  One cheap
-        # pass proves the batch's values are i64 (high limb == sign
+        # FUSED pass proves the batch's values are i64 (high limb == sign
         # extension) and small enough that `rows` of them can't overflow an
         # i64 accumulator; lax.cond then runs a single segment sum instead
         # of the 3-4 chunk-plane sums.  Exact either way — the check reads
-        # the data, not the (over-wide) declared precision.
-        fits = jnp.logical_and(
-            jnp.all(h == (l >> 63)),
-            jnp.logical_and(jnp.max(l) < thr, jnp.min(l) > -thr),
+        # the data, not the (over-wide) declared precision.  The per-row
+        # conjunction folds the three reductions the old form paid
+        # (all/max/min) into one elementwise pass + one all-reduce.
+        fits = jnp.all(
+            jnp.logical_and(
+                h == (l >> 63),
+                jnp.logical_and(l < thr, l > -thr),
+            )
         )
         hi_direct = (
             in_precision is not None

@@ -267,15 +267,22 @@ class DistributedQueryRunner(LocalQueryRunner):
     # -- planning -------------------------------------------------------------
 
     def create_subplan(self, plan: P.OutputNode) -> SubPlan:
+        from trino_tpu.verify.collectives import collective_signature
+
         dplan = add_exchanges(
             plan, self.catalogs, self.properties, n_workers=self.wm.n
         )
-        return create_subplans(
+        sub = create_subplans(
             dplan,
             properties=self.properties,
             catalogs=self.catalogs,
             n_workers=self.wm.n,
         )
+        # the statically enumerated per-fragment collective sequence of the
+        # MOST RECENT subplan: verify.device_residency holds warm replays
+        # to it (a warm run must issue exactly the recorded collectives)
+        self.last_collective_signature = collective_signature(sub)
+        return sub
 
     def explain_distributed(self, sql: str) -> str:
         return fragment_text(self.create_subplan(self.create_plan(sql)))

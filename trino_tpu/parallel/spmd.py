@@ -85,16 +85,15 @@ class TraceCache:
         return fn
 
     def stats(self) -> dict:
-        with self._lock:  # len(dict) during a concurrent resize is racy
-            entries = len(self._fns)
-        return {
-            "entries": entries,
-            "hits": self.hits,
-            "misses": self.misses,
-            "retraces": self.retraces,
-            "evictions": self.evictions,
-            "trace_s": round(self.trace_s, 4),
-        }
+        with self._lock:  # counters + len(dict) move under the lock
+            return {
+                "entries": len(self._fns),
+                "hits": self.hits,
+                "misses": self.misses,
+                "retraces": self.retraces,
+                "evictions": self.evictions,
+                "trace_s": round(self.trace_s, 4),
+            }
 
     def clear(self) -> None:
         with self._lock:

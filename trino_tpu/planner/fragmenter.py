@@ -561,10 +561,17 @@ def create_subplans(
     resolver = LayoutResolver(catalogs, properties)
     sub = _Fragmenter(resolver, n_workers).fragment(distributed_plan)
     # fragment invariants: unique fragment ids, every RemoteSourceNode names
-    # an existing fragment whose root outputs match symbol-for-symbol
+    # an existing fragment whose root outputs match symbol-for-symbol —
+    # plus the collective-uniformity pass: every distributed fragment's
+    # statically enumerated collective sequence is divergence-free (never
+    # conditional on per-worker data), so an SPMD program can't hang the
+    # mesh on a collective one worker skips
     mode = _verify_mode(properties)
     if mode != "off":
+        from trino_tpu.verify.collectives import check_collective_uniformity
+
         V.enforce(V.check_subplan(sub), mode)
+        V.enforce(check_collective_uniformity(sub), mode)
     return sub
 
 

@@ -148,32 +148,43 @@ class QueryContext:
 
     def transition(self, to: str) -> None:
         with self._lock:
-            if to not in _TRANSITIONS.get(self.state, set()):
-                raise InvalidStateTransition(
-                    f"query {self.query_id}: illegal transition "
-                    f"{self.state} -> {to}"
-                )
-            self.state = to
+            self._transition_locked(to)
+
+    def _transition_locked(self, to: str) -> None:  # lint: allow(unguarded-state)
+        """Caller holds self._lock."""
+        if to not in _TRANSITIONS.get(self.state, set()):
+            raise InvalidStateTransition(
+                f"query {self.query_id}: illegal transition "
+                f"{self.state} -> {to}"
+            )
+        self.state = to
 
     @property
     def done(self) -> bool:
-        return self.state in TERMINAL_STATES
+        with self._lock:
+            return self.state in TERMINAL_STATES
 
     def begin(self) -> None:
         self.transition(RUNNING)
 
     def finishing(self) -> None:
-        if self.state == RUNNING:
-            self.transition(FINISHING)
+        # check-then-transition is atomic: a concurrent fail() cannot slip
+        # between the read and the write (the unguarded-state race the
+        # concurrency analyzer flagged — finish() could resurrect a FAILED
+        # query to FINISHED)
+        with self._lock:
+            if self.state == RUNNING:
+                self._transition_locked(FINISHING)
 
     def finish(self) -> None:
-        if self.state in (QUEUED, RUNNING):
-            # short statements (SET SESSION) may finish without FINISHING
-            self.transition(FINISHING) if self.state == RUNNING else None
-        if self.state == FINISHING:
-            self.transition(FINISHED)
-        elif self.state == QUEUED:
-            self.state = FINISHED
+        with self._lock:
+            if self.state == RUNNING:
+                # short statements (SET SESSION) may finish without FINISHING
+                self._transition_locked(FINISHING)
+            if self.state == FINISHING:
+                self._transition_locked(FINISHED)
+            elif self.state == QUEUED:
+                self.state = FINISHED
 
     def fail(self, exc: BaseException) -> str:
         """Move to the terminal failure state for `exc`; returns the event
@@ -217,10 +228,11 @@ class QueryContext:
         the token fired or the deadline passed.  Cheap (one Event.is_set +
         one clock read) — safe at per-batch / per-launch granularity."""
         if self._cancel.is_set():
-            exc = _REASON_EXC.get(self.kill_reason, QueryCanceledException)
+            with self._lock:  # reason/detail are written under the lock
+                reason, detail = self.kill_reason, self.kill_detail
+            exc = _REASON_EXC.get(reason, QueryCanceledException)
             raise exc(
-                f"query {self.query_id} "
-                f"{self.kill_detail or self.kill_reason or 'canceled'}"
+                f"query {self.query_id} {detail or reason or 'canceled'}"
             )
         if self.deadline is not None and self.clock() > self.deadline:
             # arm through kill() so live remote tasks get their cancel

@@ -299,6 +299,9 @@ class HeartbeatDetector:
             lambda w: http_probe(w, self._get_config().probe_timeout_s)
         )
         self._sleep = sleep
+        #: guards the start()/stop() check-then-act on _stop/_thread — a
+        #: double start() racing itself must never leak a second probe loop
+        self._loop_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         #: probe rounds completed (test/telemetry evidence)
@@ -343,34 +346,39 @@ class HeartbeatDetector:
         return died
 
     def start(self, interval_s: Optional[float] = None) -> "HeartbeatDetector":
-        """Background probe loop (daemon thread)."""
-        if self._thread is not None:
-            return self
-        # each loop owns ITS stop event: a stopped loop's event stays set
-        # forever, so a stop()/start() cycle can never leak a second live
-        # loop racing the new one (the old thread may still be inside its
-        # sleep when the new one starts)
-        stop = threading.Event()
-        self._stop = stop
+        """Background probe loop (daemon thread).  Idempotent AND atomic:
+        two concurrent start() calls (e.g. two embedded servers adopting
+        one runner) race on the _thread check — the loop lock makes the
+        check-then-spawn a single step, so exactly one loop ever runs."""
+        with self._loop_lock:
+            if self._thread is not None:
+                return self
+            # each loop owns ITS stop event: a stopped loop's event stays
+            # set forever, so a stop()/start() cycle can never leak a
+            # second live loop racing the new one (the old thread may still
+            # be inside its sleep when the new one starts)
+            stop = threading.Event()
+            self._stop = stop
 
-        def loop():
-            while not stop.is_set():
-                self.tick()
-                self._sleep(
-                    interval_s
-                    if interval_s is not None
-                    else self._get_config().interval_s
-                )
+            def loop():
+                while not stop.is_set():
+                    self.tick()
+                    self._sleep(
+                        interval_s
+                        if interval_s is not None
+                        else self._get_config().interval_s
+                    )
 
-        self._thread = threading.Thread(
-            target=loop, daemon=True, name="heartbeat-detector"
-        )
-        self._thread.start()
+            self._thread = threading.Thread(
+                target=loop, daemon=True, name="heartbeat-detector"
+            )
+            self._thread.start()
         return self
 
     def stop(self) -> None:
-        self._stop.set()
-        self._thread = None
+        with self._loop_lock:
+            self._stop.set()
+            self._thread = None
 
 
 # -- mesh-signature cache invalidation -----------------------------------------

@@ -343,7 +343,7 @@ class PrewarmExecutor:
                 follow.join()
         return t
 
-    def _spawn(self, reason: str, statements: Optional[list]):
+    def _spawn(self, reason: str, statements: Optional[list]):  # lint: allow(unguarded-state)
         """Start a replay thread (caller holds _state_lock)."""
         t = threading.Thread(
             target=self._replay, args=(reason, statements),
@@ -425,9 +425,10 @@ class PrewarmExecutor:
             outcome = "warm"
             self._set_state("WARM")
         except Exception as e:
+            msg = f"{type(e).__name__}: {e}"
             with self._state_lock:
-                self.last_error = f"{type(e).__name__}: {e}"
-            log.warning("prewarm replay failed: %s", self.last_error)
+                self.last_error = msg
+            log.warning("prewarm replay failed: %s", msg)
             self._set_state("FAILED")
         finally:
             self.runs += 1

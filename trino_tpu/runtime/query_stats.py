@@ -61,6 +61,11 @@ class FragmentStats:
     #: purpose split but NOT in collective_bytes (full-batch gathers are
     #: already counted in bytes_to_host; tiny capacity syncs never were).
     collective_by: dict = field(default_factory=dict)
+    #: ISSUE-ordered (kind, purpose) sequence of this stage's mesh
+    #: collectives (COLLECTIVE_KINDS only) — the observed half of the
+    #: collective-uniformity contract: verify.device_residency compares it
+    #: against the statically recorded signature (verify/collectives.py)
+    collective_seq: list = field(default_factory=list)
 
     def close(self) -> None:
         tracked = sum(v for k, v in self.phases.items() if k != "other")
@@ -147,9 +152,19 @@ class MeshProfile:
         st = self.fragment(fid)
         if kind in COLLECTIVE_KINDS:
             st.collective_bytes += nbytes
+            st.collective_seq.append((kind, purpose))
         key = (kind, purpose)
         st.collective_by[key] = st.collective_by.get(key, 0) + nbytes
         collective_bytes_counter().labels(kind, purpose).inc(nbytes)
+
+    def collective_sequences(self) -> dict:
+        """{fragment id: ((kind, purpose), ...)} of mesh collectives in
+        issue order (the shape signature_problems compares)."""
+        return {
+            fid: tuple(st.collective_seq)
+            for fid, st in self.fragments.items()
+            if st.collective_seq
+        }
 
     @contextmanager
     def phase(self, fid: int, name: str):

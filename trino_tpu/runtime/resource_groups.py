@@ -79,7 +79,7 @@ class ResourceGroup:
         wait() deterministically 'times out' after release() signals it)."""
         return threading.Event()
 
-    def _hand_off_locked(self) -> None:
+    def _hand_off_locked(self) -> None:  # lint: allow(unguarded-state)
         """Transfer one held slot onward (caller holds self.lock): wake the
         next waiter, or return the slot to the pool when nobody waits."""
         if self.queued:

@@ -202,7 +202,9 @@ class CoordinatorServer:
             finally:
                 group.release()
 
-        threading.Thread(target=work, daemon=True).start()
+        threading.Thread(
+            target=work, daemon=True, name=f"statement-{q.id}"
+        ).start()
         return q
 
     def query(self, qid: str) -> Optional[_Query]:
@@ -464,7 +466,10 @@ class CoordinatorServer:
     def start(self) -> None:
         self._httpd = ThreadingHTTPServer((self.host, self.port), self._handler())
         self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="coordinator-http",
+        ).start()
         self._start_background()
 
     def _start_background(self) -> None:

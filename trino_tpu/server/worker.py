@@ -200,7 +200,7 @@ class WorkerServer:
             def do_POST(self):
                 if self.path != "/v1/task":
                     return self._bytes(404, b"not found", "text/plain")
-                if worker.state != "ACTIVE":
+                if worker.lifecycle_state() != "ACTIVE":
                     # draining: refuse BEFORE reading/unpickling — the
                     # coordinator's submit maps 503 to REFUSED (skip this
                     # worker, never retry it) and re-plans without us
@@ -244,7 +244,7 @@ class WorkerServer:
             def do_GET(self):
                 parts = self.path.strip("/").split("/")
                 if parts == ["v1", "info"]:
-                    body = ('{"state": "%s"}' % worker.state).encode()
+                    body = ('{"state": "%s"}' % worker.lifecycle_state()).encode()
                     self._bytes(200, body, "application/json")
                     return
                 if parts == ["v1", "metrics"]:
@@ -259,7 +259,7 @@ class WorkerServer:
                     )
                     return
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-                    t = worker._tasks.get(parts[2])
+                    t = worker.task(parts[2])
                     if t is None:
                         return self._bytes(404, b"no such task", "text/plain")
                     t.done.wait(timeout=status_wait_default())
@@ -278,7 +278,7 @@ class WorkerServer:
                     # (Span.to_dict form, worker-local clock) for the
                     # coordinator to graft under its fragment span; null
                     # when the descriptor carried no trace context
-                    t = worker._tasks.get(parts[2])
+                    t = worker.task(parts[2])
                     if t is None:
                         return self._bytes(404, b"no such task", "text/plain")
                     t.done.wait(timeout=_result_wait_s(t))
@@ -292,7 +292,7 @@ class WorkerServer:
                     and parts[:2] == ["v1", "task"]
                     and parts[3] == "dynamic"
                 ):
-                    t = worker._tasks.get(parts[2])
+                    t = worker.task(parts[2])
                     if t is None:
                         return self._bytes(404, b"no such task", "text/plain")
                     t.done.wait(timeout=_result_wait_s(t))
@@ -306,7 +306,7 @@ class WorkerServer:
                     and parts[:2] == ["v1", "task"]
                     and parts[3] == "results"
                 ):
-                    t = worker._tasks.get(parts[2])
+                    t = worker.task(parts[2])
                     if t is None:
                         return self._bytes(404, b"no such task", "text/plain")
                     t.done.wait(timeout=_result_wait_s(t))
@@ -321,7 +321,7 @@ class WorkerServer:
             def do_DELETE(self):
                 parts = self.path.strip("/").split("/")
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-                    t = worker._tasks.pop(parts[2], None)
+                    t = worker.pop_task(parts[2])
                     if t is not None:
                         # REAL cancel: a running task aborts at its next
                         # cooperative check instead of burning the slot
@@ -445,6 +445,23 @@ class WorkerServer:
                     pass
 
         threading.Thread(target=waiter, daemon=True, name="drain").start()
+
+    # -- task registry (locked accessors: the HTTP handler threads and the
+    # drain waiter share _tasks with submit; every touch goes through
+    # _state_lock so the drain snapshot can never race a handler mutation) --
+
+    def task(self, task_id: str) -> Optional[_Task]:
+        with self._state_lock:
+            return self._tasks.get(task_id)
+
+    def pop_task(self, task_id: str) -> Optional[_Task]:
+        with self._state_lock:
+            return self._tasks.pop(task_id, None)
+
+    def lifecycle_state(self) -> str:
+        """ACTIVE | DRAINING for /v1/info (the detector's probe surface)."""
+        with self._state_lock:
+            return self.state
 
     # -- task execution (SqlTaskExecution role) ------------------------------
 

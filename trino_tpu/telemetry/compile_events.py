@@ -128,7 +128,8 @@ class CompileObservatory:
 
     def mark(self) -> int:
         """Watermark for close_since (the current event count)."""
-        return self.count
+        with self._lock:
+            return self.count
 
     def open_miss(self, key) -> CompileEvent:
         """Record a trace-cache miss (called by TraceCache.get)."""
@@ -201,7 +202,8 @@ class CompileObservatory:
 
     # -- manifest (the AOT prewarm enumeration) -------------------------------
 
-    def _note_open(self, ev: CompileEvent) -> None:
+    def _note_open(self, ev: CompileEvent) -> None:  # lint: allow(unguarded-state)
+        # caller holds self._lock (open_miss / close_open)
         fp = ev.key_fp or f"retrace:{ev.step}"
         entry = self._manifest.get(fp)
         if entry is None:
@@ -218,7 +220,8 @@ class CompileObservatory:
                 self._manifest.popitem(last=False)
         entry["count"] += 1
 
-    def _note_close(self, ev: CompileEvent) -> None:
+    def _note_close(self, ev: CompileEvent) -> None:  # lint: allow(unguarded-state)
+        # caller holds self._lock (close_open)
         fp = ev.key_fp or f"retrace:{ev.step}"
         entry = self._manifest.get(fp)
         if entry is None:  # evicted under manifest pressure

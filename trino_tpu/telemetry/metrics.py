@@ -294,7 +294,8 @@ class MetricsRegistry:
         )
 
     def get(self, name: str) -> Optional[Metric]:
-        return self._metrics.get(name)
+        with self._lock:
+            return self._metrics.get(name)
 
     # -- export ---------------------------------------------------------------
 

@@ -12,7 +12,18 @@ Three layers:
     zero-warm-retrace contracts, and checks trace-cache key completeness
     against step-closure free variables;
   * AST lint (`tools/lint_tpu.py`) — flags host-sync hazards in device code
-    at review time; wired into CI and the tier-1 test run.
+    at review time; wired into CI and the tier-1 test run;
+  * concurrency analyzer (`concurrency.py`, run by the same lint tool) —
+    guarded-state inference (`unguarded-state`), thread discipline, and
+    static nested-with lock-order extraction, with a justified findings
+    baseline in tools/lint_baseline.json;
+  * dynamic lock-order verification (`lockgraph.py`) — instrumented locks
+    record the acquisition-order graph during tests (chaos suite + seeded
+    deadlock test) and fail on cycles;
+  * collective-uniformity pass (`collectives.py`) — statically enumerates
+    each distributed fragment's collective sequence, proves it
+    divergence-free (never conditional on per-worker data), and records
+    the signature `device_residency` holds warm replays to.
 
 Enforcement of the plan checkers follows the `verify_plan` session property
 (strict | warn | off; default strict under pytest, warn in benches).
@@ -28,6 +39,16 @@ from trino_tpu.verify.plan_checker import (
     resolve_mode,
 )
 from trino_tpu.verify.partitioning import check_partitioning
+from trino_tpu.verify.collectives import (
+    check_collective_uniformity,
+    collective_signature,
+    signature_problems,
+)
+from trino_tpu.verify.lockgraph import (
+    InstrumentedLock,
+    LockGraph,
+    LockOrderViolation,
+)
 from trino_tpu.verify.residency import (
     CacheKeyViolation,
     ResidencyViolation,
@@ -50,4 +71,10 @@ __all__ = [
     "cache_key_audit",
     "closure_fingerprint",
     "device_residency",
+    "check_collective_uniformity",
+    "collective_signature",
+    "signature_problems",
+    "InstrumentedLock",
+    "LockGraph",
+    "LockOrderViolation",
 ]

@@ -1,0 +1,268 @@
+"""Dynamic lock-order verification: record the acquisition-order graph at
+runtime and fail on cycles.
+
+The static lint (verify/concurrency.py) sees lexically nested `with`
+statements — the cheap 80%.  What it cannot see is cross-function nesting:
+thread A takes the engine lock and calls into the prewarm executor (which
+takes its state lock); thread B, inside a state-locked section, kicks
+something that waits on the engine lock.  Each call chain looks fine alone;
+together they deadlock.  This module catches that class at TEST time:
+
+  * `InstrumentedLock` wraps a real `threading.Lock`, reporting every
+    acquire/release to a `LockGraph`.  Each thread's currently-held set is
+    tracked; acquiring L while holding K records the edge K -> L with a
+    witness call site.  Reentrant acquires of one lock never self-edge.
+  * `LockGraph.assert_acyclic()` raises `LockOrderViolation` naming the
+    cycle and the witness sites — an order inversion is a deadlock waiting
+    for the right interleaving, so the graph test fails even when the run
+    happened not to hang.
+  * `capture()` monkeypatches `threading.Lock` for a scope so every lock
+    *created inside it* is instrumented automatically, named by its
+    allocation site — the chaos suite wraps its fixtures in this, which is
+    how the engine's servers, runners, and registries all join the graph
+    without per-class plumbing.
+  * `instrument_attr(obj, "_lock", name)` wraps one existing lock in place
+    (for process singletons created before the capture began);
+    `instrument_singletons()` does it for the engine's well-known ones.
+
+Everything is deterministic: the graph is about ORDER, not interleaving, so
+a single thread acquiring A->B then B->A is enough to prove the hazard —
+the seeded-deadlock test does exactly that, with zero sleeps.
+"""
+
+from __future__ import annotations
+
+import _thread
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+
+class LockOrderViolation(Exception):
+    """The recorded acquisition-order graph has a cycle."""
+
+
+def _site(skip_internal: bool = True) -> str:
+    """file:line of the acquiring frame (first frame outside this module)."""
+    import sys
+
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename.endswith("lockgraph.py"):
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}"
+
+
+class LockGraph:
+    """Thread-safe acquisition-order edge set over named locks."""
+
+    def __init__(self):
+        # raw _thread lock: the graph's own mutex must never be an
+        # InstrumentedLock (capture() patches threading.Lock)
+        self._mu = _thread.allocate_lock()
+        #: (held, acquired) -> first witness "thread | site"
+        self._edges: dict = {}
+        self._local = threading.local()
+
+    def _held(self) -> list:
+        held = getattr(self._local, "held", None)
+        if held is None:
+            held = self._local.held = []
+        return held
+
+    # -- recording ------------------------------------------------------------
+
+    def note_acquire(self, name: str) -> None:
+        held = self._held()
+        if name in held:  # reentrant / same-name: no self-edges
+            held.append(name)
+            return
+        if held:
+            site = _site()
+            tname = threading.current_thread().name
+            with self._mu:
+                for h in held:
+                    if h != name:
+                        self._edges.setdefault(
+                            (h, name), f"{tname} at {site}"
+                        )
+        held.append(name)
+
+    def note_release(self, name: str) -> None:
+        held = self._held()
+        # release the most recent acquisition of this name (lock discipline
+        # is not necessarily LIFO across different locks)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    # -- queries ---------------------------------------------------------------
+
+    def edges(self) -> dict:
+        with self._mu:
+            return dict(self._edges)
+
+    def cycles(self) -> list:
+        """Closed walks in the edge set, each as [a, b, ..., a]."""
+        from trino_tpu.verify.concurrency import find_cycles
+
+        return find_cycles([(a, b) for (a, b) in self.edges()])
+
+    def assert_acyclic(self) -> None:
+        cycles = self.cycles()
+        if not cycles:
+            return
+        edges = self.edges()
+        lines = []
+        for cyc in cycles:
+            pairs = list(zip(cyc, cyc[1:]))
+            witness = "; ".join(
+                f"{a} -> {b} ({edges.get((a, b), '?')})" for a, b in pairs
+            )
+            lines.append(" -> ".join(cyc) + f" [{witness}]")
+        raise LockOrderViolation(
+            "lock acquisition order has "
+            f"{len(cycles)} cycle(s) — a deadlock waiting for the right "
+            "interleaving:\n  " + "\n  ".join(lines)
+        )
+
+
+#: graph used when none is passed explicitly (tests usually scope their own)
+DEFAULT_GRAPH = LockGraph()
+
+
+class InstrumentedLock:
+    """A threading.Lock wrapper reporting acquisition order to a LockGraph.
+    Supports the full Lock protocol (context manager, blocking/timeout,
+    locked) so it drops into any `with self._lock:` site unchanged."""
+
+    def __init__(self, name: str, graph: Optional[LockGraph] = None,
+                 inner=None):
+        self._name = name
+        self._graph = graph or DEFAULT_GRAPH
+        self._inner = inner if inner is not None else _thread.allocate_lock()
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking and timeout == -1:
+            # record the INTENT edge before an indefinite block: if this
+            # acquire deadlocks, the graph already holds the evidence a
+            # watchdog would need (a blocking acquire that returns False
+            # cannot happen, so the edge is never spurious)
+            self._graph.note_acquire(self._name)
+            try:
+                return self._inner.acquire(blocking)
+            except BaseException:
+                self._graph.note_release(self._name)
+                raise
+        # try-lock / bounded acquire: record only on SUCCESS — a FAILED
+        # try-acquire backs off instead of waiting, so it can never
+        # deadlock, and its edge would fabricate cycles for the standard
+        # ordering-sidestep pattern (`if a.acquire(False): ... else: ...`)
+        ok = (
+            self._inner.acquire(blocking, timeout)
+            if timeout != -1
+            else self._inner.acquire(blocking)
+        )
+        if ok:
+            self._graph.note_acquire(self._name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._graph.note_release(self._name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self):
+        return f"<InstrumentedLock {self._name} {self._inner!r}>"
+
+
+def instrument_attr(obj, attr: str, name: Optional[str] = None,
+                    graph: Optional[LockGraph] = None):
+    """Wrap an existing lock attribute in place; returns a restore
+    callable.  `obj` may be an object or a module."""
+    inner = getattr(obj, attr)
+    if isinstance(inner, InstrumentedLock):  # already wrapped
+        return lambda: None
+    label = name or f"{type(obj).__name__}.{attr}"
+    setattr(obj, attr, InstrumentedLock(label, graph, inner=inner))
+
+    def restore():
+        setattr(obj, attr, inner)
+
+    return restore
+
+
+def instrument_singletons(graph: Optional[LockGraph] = None) -> list:
+    """Wrap the engine's well-known process-wide locks (created at import
+    time, before any capture() could see them).  Returns restore callables.
+    Best-effort: a singleton that moved or lost its lock is skipped — the
+    graph should never fail a test for structural drift here."""
+    restores = []
+
+    def _try(fn):
+        try:
+            restores.append(fn())
+        except Exception:
+            pass
+
+    def _wrap(obj, attr, name):
+        return lambda: instrument_attr(obj, attr, name, graph)
+
+    from trino_tpu.parallel import spmd
+    from trino_tpu.runtime import buffer_pool, lifecycle, retry
+    from trino_tpu import config as cfg
+    from trino_tpu.telemetry import compile_events, metrics
+
+    _try(_wrap(spmd.TRACE_CACHE, "_lock", "TRACE_CACHE._lock"))
+    _try(_wrap(buffer_pool.POOL, "lock", "POOL.lock"))
+    _try(_wrap(retry.BREAKERS, "_lock", "BREAKERS._lock"))
+    _try(_wrap(lifecycle, "_POOL_LOCK", "lifecycle:_POOL_LOCK"))
+    _try(_wrap(cfg, "_LOCK", "config:_LOCK"))
+    _try(_wrap(compile_events.OBSERVATORY, "_lock", "OBSERVATORY._lock"))
+    _try(_wrap(metrics, "_SERIES_LOCK", "metrics:_SERIES_LOCK"))
+    _try(_wrap(metrics.REGISTRY, "_lock", "REGISTRY._lock"))
+    return restores
+
+
+@contextmanager
+def capture(graph: Optional[LockGraph] = None, singletons: bool = True):
+    """Scope in which every `threading.Lock()` creation yields an
+    InstrumentedLock named by its allocation site, feeding `graph` (a fresh
+    LockGraph when None — yielded to the caller).  With `singletons`, the
+    engine's import-time locks are wrapped for the scope too.
+
+    The patch is process-global for the scope: locks created by OTHER
+    threads during it are instrumented as well — which is the point, the
+    engine's background threads are where the ordering bugs live."""
+    g = graph or LockGraph()
+    real_lock = threading.Lock
+
+    def make_lock():
+        return InstrumentedLock(f"lock@{_site()}", g, inner=real_lock())
+
+    restores = instrument_singletons(g) if singletons else []
+    threading.Lock = make_lock
+    try:
+        yield g
+    finally:
+        threading.Lock = real_lock
+        for r in restores:
+            try:
+                r()
+            except Exception:
+                pass

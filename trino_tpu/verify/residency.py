@@ -179,6 +179,28 @@ def cache_key_audit():
 
 # -- device residency ---------------------------------------------------------
 
+
+def _collective_problems(runner, prof, prev_seq) -> list:
+    """The warm run's per-fragment mesh-collective sequence must equal the
+    previous run's (replays issue the recorded sequence) and match the
+    static signature the uniformity pass enumerated at planning time."""
+    problems = []
+    seq = prof.collective_sequences()
+    if prev_seq is not None and seq != prev_seq:
+        for fid in sorted(set(seq) | set(prev_seq)):
+            a, b = prev_seq.get(fid, ()), seq.get(fid, ())
+            if a != b:
+                problems.append(
+                    f"fragment {fid} issued a different collective "
+                    f"sequence on the warm run: {b} (previous run: {a})"
+                )
+    expected = getattr(runner, "last_collective_signature", None)
+    if expected is not None:
+        from trino_tpu.verify.collectives import signature_problems
+
+        problems.extend(signature_problems(expected, seq))
+    return problems
+
 #: mesh-profile counters that are LEGITIMATE host boundaries: explicit
 #: gathers at SINGLE-fragment/result edges, the batched dynamic-filter sync,
 #: scan-cache bookkeeping, and FTE spooling.  `host_restack` is deliberately
@@ -212,6 +234,7 @@ def device_residency(
     warmups: int = 1,
     allowed_counters: tuple = ALLOWED_COUNTERS,
     audit_cache_keys: bool = True,
+    check_collectives: bool = True,
 ) -> dict:
     """Replay `sql` on a warmed mesh and assert the device-residency
     contracts of the distributed pipeline:
@@ -222,6 +245,12 @@ def device_residency(
       * zero unexpected host transfers — no counter outside
         `allowed_counters` fires, in particular `host_restack` (a host
         batch re-entering the mesh between distributed fragments);
+      * collective-sequence stability — the warm run issues exactly the
+        per-fragment mesh-collective sequence the previous run issued AND
+        the sequence the static uniformity pass recorded
+        (`runner.last_collective_signature`, verify/collectives.py): an
+        extra, missing, or reordered collective on a warm replay is a
+        divergence hazard even when nothing hung this time;
       * (optional) cache-key completeness over the replay's cache traffic.
 
     Returns a report dict on success; raises ResidencyViolation on failure.
@@ -230,11 +259,15 @@ def device_residency(
     """
     auditor: Optional[CacheKeyAuditor] = None
     ctx = cache_key_audit() if audit_cache_keys else None
+    prev_seq = None
     try:
         if ctx is not None:
             auditor = ctx.__enter__()
         for _ in range(max(0, warmups)):
             runner.execute(sql)
+            prev = getattr(runner, "last_mesh_profile", None)
+            if prev is not None:
+                prev_seq = prev.collective_sequences()
         runner.execute(sql)
     finally:
         if ctx is not None:
@@ -256,6 +289,8 @@ def device_residency(
                 f"unexpected host transfer: counter '{name}' fired {n}x "
                 "on the warm run"
             )
+    if check_collectives:
+        problems.extend(_collective_problems(runner, prof, prev_seq))
     if problems:
         raise ResidencyViolation(
             f"device residency violated for {sql!r}: " + "; ".join(problems)
