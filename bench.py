@@ -386,6 +386,27 @@ local_cold = time.perf_counter() - t0
 local_warm = warm(local)
 prof = dist.last_mesh_profile
 
+# Q1: the decimal headline.  The proof-licensed i64 sum fast path
+# (verify.numeric range certificates) must compile ZERO runtime fits
+# checks for the whole cold+warm phase: decimal_fastpath_total deltas are
+# TRACE-time path selections, so runtime_check == 0 across the phase
+# proves even the cold compile never emitted a lax.cond fits probe
+# (tools/compare_bench.py gates this section).
+from trino_tpu.telemetry.metrics import DECIMAL_FASTPATHS, decimal_fastpath_counter
+_fp = decimal_fastpath_counter()
+
+def fp_snap():
+    return {p: int(_fp.value((p,))) for p in DECIMAL_FASTPATHS}
+
+fp0 = fp_snap()
+q1_rows, q1_mesh_cold, q1_mesh_warm, q1_coldstart = coldstart_run(1)
+fp1 = fp_snap()
+decimal_fastpath = {p: fp1[p] - fp0[p] for p in DECIMAL_FASTPATHS}
+t0 = time.perf_counter()
+l1_rows = local.execute(QUERIES[1]).rows
+q1_local_cold = time.perf_counter() - t0
+q1_local_warm = warm_q(local, 1)
+
 # Q3 under co-partitioned lineitem/orders layouts: the partitioned-join gap
 # (probe repartition elided + speculative capacity — no host count sync)
 dist.execute(
@@ -445,6 +466,17 @@ print(json.dumps({
         q3_mesh_warm / max(q3_local_warm, 1e-9), 3
     ),
     "q3_matches_local": sorted(map(str, d3_rows)) == sorted(map(str, l3_rows)),
+    # Q1 decimal-headline evidence: proof-licensed i64 sums, zero runtime
+    # fits checks, rows equal to the local oracle
+    "q1_local_warm_s": round(q1_local_warm, 4),
+    "q1_local_cold_s": round(q1_local_cold, 4),
+    "q1_mesh8_warm_s": round(q1_mesh_warm, 4),
+    "q1_mesh8_cold_s": round(q1_mesh_cold, 4),
+    "q1_mesh_over_local_warm": round(
+        q1_mesh_warm / max(q1_local_warm, 1e-9), 3
+    ),
+    "q1_matches_local": sorted(map(str, q1_rows)) == sorted(map(str, l1_rows)),
+    "decimal_fastpath": decimal_fastpath,
     # elision + speculation evidence: warm Q3 must show zero speculative
     # retries and zero probe repartitions under the layouts
     "q3_counters": {
@@ -466,6 +498,7 @@ print(json.dumps({
     # contract per benched query (tools/compare_bench.py gates this)
     "coldstart": {
         "q6": q6_coldstart,
+        "q1": q1_coldstart,
         "q3": q3_coldstart,
         "manifest_keys": len(dist.compile_manifest()),
         "total_compile_s": round(OBSERVATORY.total_wall_s, 4),

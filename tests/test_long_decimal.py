@@ -184,14 +184,16 @@ def test_long_mul_div_mod(runner):
 
 
 def test_short_mul_widens_to_long(runner):
-    # (18,0) * (18,0) types as decimal(36,0): product needs two limbs
+    # (18,0) * (18,0) types as decimal(36,0): product needs two limbs.
+    # Expectation computed as an exact python int — Decimal * Decimal in
+    # the default 28-digit context would ROUND the 36-digit product (the
+    # engine's folder used to share that bug; the differential corpus in
+    # tests/test_constant_fold_diff.py now keeps both exact)
     rows = runner.execute(
         "select cast(999999999999999999 as decimal(18,0)) * "
         "cast(999999999999999999 as decimal(18,0))"
     ).rows
-    assert rows[0][0] == Decimal(999999999999999999) * Decimal(
-        999999999999999999
-    )
+    assert rows[0][0] == Decimal(999999999999999999**2)
 
 
 def test_cast_negative_double_to_long(runner):
@@ -331,8 +333,15 @@ def test_holistic_aggs_over_long(runner):
 
     with _pt.raises(Exception, match="long-decimal"):
         runner.execute("select array_agg(v) from ht")
-    with _pt.raises(Exception, match="long-decimal"):
-        runner.execute("select k, sum(v) over (partition by k) from ht")
+    # window sum over long decimals runs the exact limb-plane path (the
+    # tpcds q12 fix; see also tests/test_window.py)
+    assert runner.execute(
+        "select k, sum(v) over (partition by k) from ht order by k"
+    ).rows == [
+        (1, Decimal("112345678901234567889.37")),
+        (1, Decimal("112345678901234567889.37")),
+        (2, Decimal("-5.00")),
+    ]
 
 
 class TestSum128FastPath:
@@ -406,3 +415,92 @@ class TestSum128FastPath:
         assert runner.execute(
             "select k, sum(v) from nr group by k order by k"
         ).rows == [(1, Decimal("10000000000.00")), (2, Decimal("-0.50"))]
+
+
+class TestSumBoundLicense:
+    """Boundary behavior of the range-certificate license (_sum128's
+    sum_bound parameter, verify.numeric.sum_certificate): exact values at
+    the 2**63-1 edges, mixed-sign cancellation, and limb-plane (2-D)
+    inputs must all choose the proven path or correctly fall back."""
+
+    def _sum(self, vals, gid, nseg, two_d, sum_bound):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from trino_tpu.ops.aggregation import _sum128
+        from trino_tpu.types.int128 import join_py, split_py
+
+        if two_d:
+            h = np.array([split_py(v)[0] for v in vals], np.int64)
+            l = np.array([split_py(v)[1] for v in vals], np.int64)
+            d = jnp.stack([jnp.asarray(h), jnp.asarray(l)], axis=-1)
+        else:
+            d = jnp.asarray(np.array(vals, np.int64))
+        out = np.asarray(
+            _sum128(d, jnp.asarray(np.array(gid)), nseg, None,
+                    sum_bound=sum_bound)
+        )
+        return [join_py(int(out[s, 0]), int(out[s, 1])) for s in range(nseg)]
+
+    def _jaxpr(self, two_d, sum_bound):
+        import jax
+        import jax.numpy as jnp
+
+        from trino_tpu.ops.aggregation import _sum128
+
+        shape = (8, 2) if two_d else (8,)
+        return str(
+            jax.make_jaxpr(
+                lambda d, g: _sum128(d, g, 2, None, sum_bound=sum_bound)
+            )(jnp.zeros(shape, jnp.int64), jnp.zeros(8, jnp.int64))
+        )
+
+    @pytest.mark.parametrize("two_d", [False, True])
+    def test_licensed_exact_at_i64_edge(self, two_d):
+        """Values right at the proof bound: a certificate asserting the
+        exact partial-sum bound keeps the single-plane path exact."""
+        edge = (1 << 62) - 1
+        vals = [edge, edge, -edge, 1]
+        gid = [0, 1, 1, 1]
+        # |any partial sum| <= edge (the true bound for these groups)
+        got = self._sum(vals, gid, 2, two_d, sum_bound=edge)
+        assert got == [edge, 1]
+
+    @pytest.mark.parametrize("two_d", [False, True])
+    def test_mixed_sign_cancellation(self, two_d):
+        """Cancellation must be exact under the licensed path: partial
+        sums visit both extremes before collapsing to a small result."""
+        big = (1 << 61) + 12345
+        vals = [big, -big, big, -big, 42]
+        gid = [0] * 5
+        got = self._sum(vals, gid, 1, two_d, sum_bound=(1 << 62))
+        assert got == [42]
+
+    @pytest.mark.parametrize("two_d", [False, True])
+    def test_license_compiles_no_cond(self, two_d):
+        """A licensed sum compiles with NO cond primitive (zero runtime
+        fits checks); without a license the runtime probe survives."""
+        assert "cond" not in self._jaxpr(two_d, sum_bound=10**12)
+        assert "cond" in self._jaxpr(two_d, sum_bound=None)
+
+    @pytest.mark.parametrize("two_d", [False, True])
+    def test_bound_at_or_over_i64_falls_back(self, two_d):
+        """sum_bound >= 2**63-1 proves nothing: the kernel must keep the
+        runtime check and stay exact for sums ABOVE int64."""
+        assert "cond" in self._jaxpr(two_d, sum_bound=(1 << 63) - 1)
+        if two_d:
+            over = (1 << 63) + 7  # needs the second limb
+            got = self._sum([over // 2 + 1, over // 2, over - 1, 1],
+                            [0, 0, 1, 1], 2, True, sum_bound=(1 << 70))
+            assert got == [over, over]
+
+    def test_certificate_refuses_unprovable(self):
+        """sum_certificate licenses exactly when max_abs*rows < 2**63."""
+        from trino_tpu.verify.ranges import Interval, certificate
+
+        ok = certificate(Interval(-(10**10), 10**10), 2, 10**6)
+        assert ok.licensed_i64_sum_bound() == 10**16
+        edge = certificate(Interval(0, (1 << 62)), 2, 2)
+        assert edge.licensed_i64_sum_bound() is None
+        unbounded = certificate(Interval(None, 5), 2, 10)
+        assert unbounded is None

@@ -349,3 +349,90 @@ def test_nth_value_validation(runner):
         runner.execute(
             "select nth_value(n_name, 0) over (order by n_nationkey) from nation"
         )
+
+
+# -- long-decimal (Int128) window sum/avg — the tpcds q12 shape ---------------
+
+
+def test_window_sum_over_long_decimal(runner):
+    """sum() over a decimal(38,s) limb-plane input column: the tpcds q12
+    regression (window-over-aggregate widens the input to Int128)."""
+    res = runner.execute(
+        "select l_returnflag, s, sum(s) over (partition by l_returnflag) "
+        "from (select l_returnflag, l_linestatus, "
+        "      sum(l_extendedprice) s from lineitem "
+        "      group by l_returnflag, l_linestatus) t"
+    )
+    li = tpch_pandas("tiny", "lineitem")
+    inner = li.groupby(["l_returnflag", "l_linestatus"]).l_extendedprice.sum()
+    outer = inner.groupby(level=0).sum()
+    got = {
+        (flag, str(s), str(tot)) for flag, s, tot in res.rows
+    }
+    expected = {
+        (flag, f"{inner[(flag, ls)]:.2f}", f"{outer[flag]:.2f}")
+        for flag, ls in inner.index
+    }
+    assert got == expected
+
+
+def test_window_running_sum_long_decimal(runner):
+    """Running (ORDER BY) frame over limb planes: exact prefix-sum path."""
+    res = runner.execute(
+        "select l_linestatus, sum(s) over (order by l_linestatus) "
+        "from (select l_linestatus, sum(l_extendedprice) s "
+        "      from lineitem group by l_linestatus) t"
+    )
+    li = tpch_pandas("tiny", "lineitem")
+    inner = li.groupby("l_linestatus").l_extendedprice.sum().sort_index()
+    running = inner.cumsum()
+    got = {(ls, str(v)) for ls, v in res.rows}
+    expected = {(ls, f"{running[ls]:.2f}") for ls in inner.index}
+    assert got == expected
+
+
+def test_window_avg_long_decimal(runner):
+    """avg() over limb planes: exact Int128 divide, round half away."""
+    res = runner.execute(
+        "select l_returnflag, avg(s) over (partition by l_returnflag) "
+        "from (select l_returnflag, l_linestatus, "
+        "      sum(l_extendedprice) s from lineitem "
+        "      group by l_returnflag, l_linestatus) t"
+    )
+    from decimal import ROUND_HALF_UP, Decimal
+
+    li = tpch_pandas("tiny", "lineitem")
+    inner = li.groupby(["l_returnflag", "l_linestatus"]).l_extendedprice.sum()
+    got = {(flag, str(v)) for flag, v in res.rows}
+    expected = set()
+    for flag in inner.index.get_level_values(0).unique():
+        grp = inner[flag]
+        cents = [int(round(x * 100)) for x in grp]
+        avg = (Decimal(sum(cents)) / len(cents)).quantize(
+            Decimal(1), rounding=ROUND_HALF_UP
+        )
+        expected.add((flag, f"{Decimal(avg) / 100:.2f}"))
+    assert got == expected
+
+
+def test_window_long_decimal_null_inputs(runner):
+    """Validity threads through the limb-plane frame sums: NULL inputs
+    do not contribute, all-NULL partitions yield NULL (not zero)."""
+    runner.execute("drop table if exists memory.default.wld")
+    runner.execute(
+        "create table memory.default.wld as select * from (values "
+        "(1, cast(10.50 as decimal(38,2))), "
+        "(1, cast(null as decimal(38,2))), "
+        "(1, cast(2.25 as decimal(38,2))), "
+        "(2, cast(null as decimal(38,2))), "
+        "(2, cast(null as decimal(38,2)))) t(k, x)"
+    )
+    rows = runner.execute(
+        "select k, sum(x) over (partition by k), "
+        "avg(x) over (partition by k) from memory.default.wld"
+    ).rows
+    by_k = {}
+    for k, s, a in rows:
+        by_k[k] = (None if s is None else str(s), None if a is None else str(a))
+    assert by_k[1] == ("12.75", "6.38")  # 12.75/2 = 6.375 -> half away
+    assert by_k[2] == (None, None)

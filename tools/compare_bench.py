@@ -56,6 +56,15 @@ Q3_ZERO = (
     "join_speculative_retry",
 )
 
+#: decimal fast-path contract over the Q1 bench phase (PR 10): path
+#: selections are TRACE-time, so across cold+warm the licensed workload
+#: must compile ZERO runtime fits probes and at least one proven kernel —
+#: the `vs_baseline 0.80 -> 0.95+` evidence is structural, not just a wall
+DECIMAL_FASTPATH_RULES = (
+    ("runtime_check", "== 0", lambda v: v == 0),
+    ("proven", "> 0", lambda v: v > 0),
+)
+
 #: coldstart (compile observatory) per-query keys that must be present when
 #: a mesh section records a `coldstart` block — the cold/warm decomposition
 #: is only evidence if the ratio, compile attribution, AND the
@@ -254,6 +263,22 @@ def check_extra(extra: dict) -> tuple:
                         f"mesh.{schema}.q3_counters.{name} = {q3[name]} "
                         "(expected 0 under co-partitioned layouts)"
                     )
+        fp = sec.get("decimal_fastpath")
+        if isinstance(fp, dict):
+            for name, desc, ok in DECIMAL_FASTPATH_RULES:
+                v = fp.get(name, 0)
+                if not ok(v):
+                    violations.append(
+                        f"mesh.{schema}.decimal_fastpath.{name} = {v} "
+                        f"(expected {desc}: Q1 decimal sums must run the "
+                        "proof-licensed i64 path with no runtime fits "
+                        "checks — see verify.numeric.license_decimal_sums)"
+                    )
+            if sec.get("q1_matches_local") is False:
+                violations.append(
+                    f"mesh.{schema}.q1_matches_local = False (the licensed "
+                    "fast path changed Q1's rows vs the local oracle)"
+                )
         # compile-observatory coldstart block (PR 6): a warm replay must
         # compile NOTHING — any nonzero warm_replay_events means the
         # workload's compile-key set is not closed and the prewarm manifest

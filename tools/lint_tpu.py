@@ -90,6 +90,11 @@ RULES = {
     "raw-http-timeout": "hardcoded timeout literal on an intra-cluster "
                         "call — derive it from the query deadline "
                         "(lifecycle.request_timeout) or a named constant",
+    "numeric-safety": "numeric hazard in device code: a narrowing integer "
+                      "astype with no visible bound (silent wrap) or a "
+                      "validity-aware function constructing a Column with "
+                      "its validity plane dropped; triage survivors "
+                      "through tools/lint_baseline.json `numeric_safety`",
     "module-level-knob": "module/class-level numeric knob literal — load "
                          "it from the typed config (trino_tpu/config) so "
                          "deployments can tune it without a code change",
@@ -135,6 +140,9 @@ class Finding:
     line: int
     rule: str
     message: str
+    #: stable triage key for baseline-mapped rules (numeric-safety:
+    #: `relpath:qualname:pattern`), None for immediate-fail rules
+    baseline_key: str = None
 
     def __str__(self):
         return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
@@ -160,15 +168,85 @@ def _contains_jnp(node: ast.AST) -> bool:
     return False
 
 
+#: narrow integer dtype names: an astype to one of these can silently wrap
+#: values that fit the wider source representation
+_NARROW_INT_DTYPES = frozenset(
+    {"int8", "int16", "int32", "uint8", "uint16", "uint32"}
+)
+
+#: call names that visibly BOUND a value before a narrowing cast — the
+#: sound reasons a narrow astype cannot wrap
+_BOUNDING_CALLS = frozenset(
+    {"clip", "searchsorted", "argsort", "argmax", "argmin", "sign",
+     "minimum", "maximum", "mod", "remainder", "zeros", "ones", "arange"}
+)
+
+
+def _narrow_dtype_of(node):
+    """'int32' when the AST node names a narrow integer dtype (jnp.int32 /
+    np.int32 / 'int32'), else None."""
+    if isinstance(node, ast.Attribute) and node.attr in _NARROW_INT_DTYPES:
+        if isinstance(node.value, ast.Name) and node.value.id in ("jnp", "np"):
+            return node.attr
+    if isinstance(node, ast.Constant) and node.value in _NARROW_INT_DTYPES:
+        return node.value
+    return None
+
+
+def _is_bool_dtype(node) -> bool:
+    return (
+        (isinstance(node, ast.Name) and node.id == "bool")
+        or (isinstance(node, ast.Attribute) and node.attr in ("bool_", "bool"))
+        or (isinstance(node, ast.Constant) and node.value == "bool")
+    )
+
+
+def _visibly_bounded(node) -> bool:
+    """The value subtree carries a visible bound: modulo/mask/shift
+    arithmetic, a clip-family call, a comparison result, a bool source, or
+    a `where` selecting among constants."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.BinOp) and isinstance(
+            n.op, (ast.Mod, ast.BitAnd, ast.RShift)
+        ):
+            return True
+        if isinstance(n, ast.Compare):
+            return True
+        if isinstance(n, ast.Call):
+            fn = n.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name in _BOUNDING_CALLS:
+                return True
+            if name == "astype" and n.args and _is_bool_dtype(n.args[0]):
+                return True
+            if (
+                name == "where"
+                and len(n.args) == 3
+                and all(
+                    isinstance(a, (ast.Constant, ast.UnaryOp, ast.IfExp))
+                    for a in n.args[1:3]
+                )
+            ):
+                return True
+    return False
+
+
 class _Linter(ast.NodeVisitor):
-    def __init__(self, path: str, source: str, rules=None):
+    def __init__(self, path: str, source: str, rules=None, relpath=None):
         self.path = path
+        self.relpath = (relpath or path).replace(os.sep, "/")
         self.findings: list[Finding] = []
         self.allow = _allowances(source)
         #: rules enabled for this file (path-scoped; None = all)
         self.rules = frozenset(RULES) if rules is None else frozenset(rules)
         #: stack of (def/class line, end line) carrying def-level allowances
         self._scopes: list[tuple[int, int]] = []
+        #: qualname stack for numeric-safety baseline keys (Class.method)
+        self._names: list[str] = []
+        #: stack of "enclosing function reads a `.valid` attribute" flags
+        self._valid_aware: list[bool] = []
 
     # -- suppression ----------------------------------------------------------
 
@@ -187,17 +265,67 @@ class _Linter(ast.NodeVisitor):
 
     def _visit_scope(self, node) -> None:
         self._scopes.append((node.lineno, node.end_lineno or node.lineno))
+        self._names.append(node.name)
         self.generic_visit(node)
+        self._names.pop()
         self._scopes.pop()
 
     def _visit_fn_scope(self, node) -> None:
         self._fn_depth += 1
+        self._valid_aware.append(
+            any(
+                isinstance(n, ast.Attribute) and n.attr == "valid"
+                for n in ast.walk(node)
+            )
+        )
         self._visit_scope(node)
+        self._valid_aware.pop()
         self._fn_depth -= 1
 
     visit_FunctionDef = _visit_fn_scope
     visit_AsyncFunctionDef = _visit_fn_scope
     visit_ClassDef = _visit_scope
+
+    #: ranges of `if` bodies whose test mentions a bool dtype — a narrowing
+    #: astype under such a guard converts a bool column (bounded 0/1)
+    _bool_if_ranges: list = None
+
+    def visit_If(self, node: ast.If) -> None:
+        mentions_bool = any(
+            (isinstance(n, ast.Attribute) and n.attr in ("bool_", "bool"))
+            or (isinstance(n, ast.Name) and n.id == "bool")
+            for n in ast.walk(node.test)
+        )
+        if mentions_bool:
+            if self._bool_if_ranges is None:
+                self._bool_if_ranges = []
+            self._bool_if_ranges.append(
+                (node.lineno, node.end_lineno or node.lineno)
+            )
+        self.generic_visit(node)
+
+    def _under_bool_guard(self, line: int) -> bool:
+        return any(
+            s <= line <= e for s, e in (self._bool_if_ranges or ())
+        )
+
+    def _qualname(self) -> str:
+        return ".".join(self._names) if self._names else "<module>"
+
+    def _flag_numeric(self, node: ast.AST, pattern: str, message: str) -> None:
+        """numeric-safety findings carry a stable baseline key
+        (relpath:qualname:pattern) and triage through the numeric_safety
+        map instead of failing immediately."""
+        if "numeric-safety" not in self.rules or self._allowed(
+            "numeric-safety", node.lineno
+        ):
+            return
+        self.findings.append(
+            Finding(
+                self.path, node.lineno, "numeric-safety", message,
+                baseline_key=f"{self.relpath}:{self._qualname()}:{pattern}",
+            )
+        )
 
     #: nesting depth inside function bodies (0 = module/class level)
     _fn_depth = 0
@@ -318,6 +446,49 @@ class _Linter(ast.NodeVisitor):
                     "from the query deadline (lifecycle.request_timeout) or "
                     "a named constant",
                 )
+        # numeric-safety pass 1: narrowing integer astype with no visible
+        # bound on the value — the kernel wraps silently where the
+        # reference engine would raise
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "astype"
+            and node.args
+        ):
+            dt = _narrow_dtype_of(node.args[0])
+            if (
+                dt is not None
+                and not _visibly_bounded(fn.value)
+                and not self._under_bool_guard(node.lineno)
+            ):
+                self._flag_numeric(
+                    node, "astype-narrow",
+                    f"narrowing astype({dt}) with no visible bound on the "
+                    "value (no clip/mask/modulo in sight): values wider "
+                    f"than {dt} wrap silently — prove the bound and record "
+                    "it in the numeric_safety baseline, or clip explicitly",
+                )
+        # (jnp.asarray(x, int32) is NOT flagged: with an explicit dtype it
+        # declares the representation — dictionary codes and gather indices
+        # are int32 by construction throughout the columnar layer)
+        # numeric-safety pass 2: a validity-AWARE function (it reads some
+        # column's .valid) constructing a Column with an explicit None
+        # validity plane — the dropped-validity hazard surface
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id == "Column"
+            and len(node.args) >= 3
+            and isinstance(node.args[2], ast.Constant)
+            and node.args[2].value is None
+            and self._valid_aware
+            and self._valid_aware[-1]
+        ):
+            self._flag_numeric(
+                node, "validity-drop",
+                "validity-aware function builds a Column with validity "
+                "None: NULLs upstream resurface as values — thread the "
+                "plane through, or justify the drop in the "
+                "numeric_safety baseline",
+            )
         # Symbol("name") without a type
         if (
             (isinstance(fn, ast.Name) and fn.id == "Symbol")
@@ -339,14 +510,20 @@ class _Linter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def lint_file(path: str) -> list:
+def lint_file(path: str, root: str = None) -> list:
     with open(path, "r", encoding="utf-8") as fh:
         source = fh.read()
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [Finding(path, e.lineno or 0, "syntax-error", str(e))]
-    linter = _Linter(path, source, rules=_rules_for_path(path))
+    rel = path
+    if root is not None:
+        try:
+            rel = os.path.relpath(path, root)
+        except ValueError:
+            rel = path
+    linter = _Linter(path, source, rules=_rules_for_path(path), relpath=rel)
     linter.visit(tree)
     return linter.findings
 
@@ -366,14 +543,60 @@ def _lint_files(paths, root: str) -> list:
     return sorted(files)
 
 
-def run_lint(paths=None, root: str = ".") -> list:
-    """Lint every .py file under `paths` (files or directories, relative to
-    `root`); returns all findings sorted by location."""
+def _run_lint_full(paths=None, root: str = "."):
+    """-> (surviving findings, stale numeric_safety AST keys)."""
     findings = []
     for f in _lint_files(paths, root):
-        findings.extend(lint_file(f))
+        findings.extend(lint_file(f, root=root))
+    findings, stale = apply_numeric_baseline(
+        findings, numeric_safety_baseline(root)
+    )
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
-    return findings
+    return findings, stale
+
+
+def run_lint(paths=None, root: str = ".") -> list:
+    """Lint every .py file under `paths` (files or directories, relative to
+    `root`); returns all findings sorted by location.  numeric-safety
+    findings are triaged through the `numeric_safety` baseline map
+    (tools/lint_baseline.json) — a baselined finding is dropped here."""
+    return _run_lint_full(paths, root)[0]
+
+
+def numeric_safety_baseline(root: str = ".") -> dict:
+    """{key -> justification} from tools/lint_baseline.json
+    `numeric_safety`.  Keys are either `relpath:qualname:pattern` (the AST
+    pass here) or `rule:signature` (the expression sweep in
+    trino_tpu/verify/numeric.py) — one shared triage map.  DELIBERATE twin
+    of verify/numeric.numeric_safety_baseline: this module must stay
+    stdlib-only for the dependency-free CI lint job, so the two passes
+    share the JSON contract, not code — change it in BOTH places."""
+    import json
+
+    path = os.path.join(root, "tools", "lint_baseline.json")
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return dict(json.load(fh).get("numeric_safety") or {})
+    except (OSError, ValueError):
+        return {}
+
+
+def apply_numeric_baseline(findings, baseline: dict):
+    """-> (surviving findings, stale AST-pass baseline keys)."""
+    kept, used = [], set()
+    for f in findings:
+        key = getattr(f, "baseline_key", None)
+        if key is not None and key in baseline:
+            used.add(key)
+            continue
+        kept.append(f)
+    # only AST-pass keys (path-prefixed) are checked for staleness here;
+    # rule:signature keys belong to the expression sweep
+    stale = sorted(
+        k for k in baseline
+        if k.startswith("trino_tpu/") and k not in used
+    )
+    return kept, stale
 
 
 def count_suppressions(paths=None, root: str = ".") -> int:
@@ -480,8 +703,10 @@ def main(argv=None) -> int:
         os.path.dirname(os.path.abspath(__file__))
     )
     findings = []
+    numeric_stale = []
     if args.only != "concurrency":
-        findings.extend(run_lint(args.paths or None, root=root))
+        device, numeric_stale = _run_lint_full(args.paths or None, root=root)
+        findings.extend(device)
     stale = []
     if args.only != "device" and not args.paths:
         conc, stale = run_concurrency(root)
@@ -489,11 +714,19 @@ def main(argv=None) -> int:
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     for f in findings:
         print(f)
+        if getattr(f, "baseline_key", None):
+            print(f"  baseline key: {f.baseline_key!r}")
     for k in stale:
         print(
             f"note: baseline entry {k!r} has no live finding — ratchet "
             "tools/lint_baseline.json (unguarded_state) down"
         )
+    if not args.paths:
+        for k in numeric_stale:
+            print(
+                f"note: numeric_safety baseline entry {k!r} has no live "
+                "finding — ratchet tools/lint_baseline.json down"
+            )
     budget_errors = []
     if not args.paths:  # budget is repo-wide; skip for targeted runs
         budget_errors = check_suppression_budget(None, root)

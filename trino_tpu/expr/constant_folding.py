@@ -62,6 +62,8 @@ def _try_fold_uncached(e: Expr, _memo: dict) -> Expr:
             if e.name == "$neg":
                 return _from_py(-vals[0], e.type, wrap_ints=True)
             if e.name in ("$add", "$sub", "$mul", "$div"):
+                from decimal import Context, localcontext
+
                 a, b = _to_py(kids[0]), _to_py(kids[1])
 
                 def _int_div():
@@ -72,16 +74,21 @@ def _try_fold_uncached(e: Expr, _memo: dict) -> Expr:
                     q = abs(a) // abs(b)
                     return q if (a >= 0) == (b >= 0) else -q
 
-                out = {
-                    "$add": lambda: a + b,
-                    "$sub": lambda: a - b,
-                    "$mul": lambda: a * b,
-                    "$div": lambda: (
-                        _int_div()
-                        if T.is_integer_kind(e.type)
-                        else (a / b if b else None)
-                    ),
-                }[e.name]()
+                # decimal(38) products/sums need up to ~77 digits before
+                # the result rescale: the DEFAULT 28-digit context would
+                # silently round what the device's Int128 limbs carry
+                # exactly (caught by tests/test_constant_fold_diff.py)
+                with localcontext(Context(prec=80)):
+                    out = {
+                        "$add": lambda: a + b,
+                        "$sub": lambda: a - b,
+                        "$mul": lambda: a * b,
+                        "$div": lambda: (
+                            _int_div()
+                            if T.is_integer_kind(e.type)
+                            else (a / b if b else None)
+                        ),
+                    }[e.name]()
                 # integer arithmetic wraps (matching the device column
                 # path's two's-complement overflow); only CASTS null
                 return _from_py(out, e.type, wrap_ints=True)
@@ -202,10 +209,43 @@ def _from_py(v, t: T.Type, wrap_ints: bool = False) -> Literal:
     if v is None:
         return Literal(None, t)
     if isinstance(t, T.DecimalType):
-        return Literal(Decimal(str(v)), t)
+        from decimal import ROUND_HALF_UP, Context, localcontext
+
+        with localcontext(Context(prec=80)):
+            # quantize to the DECLARED scale, half away from zero: the
+            # device rescales at every op (_rescale_decimal), so a folded
+            # literal carrying extra fractional digits would diverge one
+            # unit on every downstream round
+            d = Decimal(str(v)).quantize(
+                Decimal(1).scaleb(-t.scale), rounding=ROUND_HALF_UP
+            )
+            if d == 0:
+                d = abs(d)  # no -0: integer device units carry no sign bit
+            if not wrap_ints:
+                # CAST path: NULL on overflow of the declared precision,
+                # matching compile_cast (arithmetic folds keep the exact
+                # value; the numeric-safety verifier owns flagging device
+                # wrap there)
+                scaled = abs(int(d.scaleb(t.scale)))
+                if scaled >= 10**t.precision:
+                    return Literal(None, t)
+        return Literal(d, t)
     if T.is_integer_kind(t):
         import numpy as np
 
+        if isinstance(v, (float, Decimal)):
+            # float/decimal -> integer rounds HALF AWAY FROM ZERO, matching
+            # the device cast kernels (sign * floor(|x| + 0.5) and the
+            # symmetric _rescale_decimal); plain int() truncation would
+            # diverge on every x.5 and every x.9
+            from decimal import ROUND_HALF_UP
+
+            try:
+                v = int(
+                    Decimal(str(v)).quantize(Decimal(1), rounding=ROUND_HALF_UP)
+                )
+            except ArithmeticError:
+                return Literal(None, t)  # nan/inf: null, like the kernel
         iv = int(v)
         info = np.iinfo(t.np_dtype)
         if not int(info.min) <= iv <= int(info.max):

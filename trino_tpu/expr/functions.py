@@ -81,10 +81,18 @@ def _to_planes(v: Val, to_scale: int):
 
 
 def _planes_val(h, l, rt: T.Type, valid) -> Val:
-    """Stack (hi, lo) planes into a long-decimal Val ([..., 2])."""
+    """Stack (hi, lo) planes into a long-decimal Val ([..., 2]).
+
+    Scalar planes keep an explicit leading row axis ([1, 2]): a bare (2,)
+    array is indistinguishable from two SHORT-valued rows downstream
+    (ExprCompiler.column widens 1-D data under a long type row-wise), so a
+    constant-folded long product must never collapse to 1-D."""
     h = jnp.asarray(h, jnp.int64)
     l = jnp.asarray(l, jnp.int64)
     h, l = jnp.broadcast_arrays(h, l)
+    if jnp.ndim(h) == 0:
+        h = h[None]
+        l = l[None]
     return Val(jnp.stack([h, l], axis=-1), valid, rt)
 
 
@@ -136,9 +144,12 @@ def _rescale_decimal(data, from_scale: int, to_scale: int):
         return data
     if to_scale > from_scale:
         return data * (10 ** (to_scale - from_scale))
-    # round half up on downscale
+    # round half AWAY FROM ZERO on downscale, symmetric in sign: the old
+    # `(data + sign*(f//2)) // f` floor-divides the bumped NEGATIVE value
+    # one whole unit too low (-0.01 at scale 0 became -1, not 0 — caught
+    # by tests/test_constant_fold_diff.py)
     f = 10 ** (from_scale - to_scale)
-    return (data + jnp.sign(data) * (f // 2)) // f
+    return jnp.sign(data) * ((jnp.abs(data) + f // 2) // f)
 
 
 def _result_as(call_type: T.Type, data, valid) -> Val:
@@ -167,6 +178,12 @@ def _arith(ctx, call, a, b, int_op, float_op):
         bh, bl = _to_planes(b, s)
         op = i128.add128 if int_op is jnp.add else i128.sub128
         h, l = op(ah, al, bh, bl)
+        if isinstance(rt, T.DecimalType) and not rt.is_long:
+            # short declared result from long operands: the caller asserts
+            # the value fits, so the low limb carries it exactly (same
+            # contract as $mul and _finalize) — planes under a short type
+            # would corrupt every downstream row-shape assumption
+            return Val(l, valid, rt)
         return _planes_val(h, l, rt, valid)
     ad, bd, hint = _align_numeric(a, b)
     if rt.name in ("real", "double") or hint is T.DOUBLE:
@@ -295,11 +312,16 @@ def _div(ctx, call, a, b):
         r = jnp.abs(num) - q * jnp.abs(den)
         adj = jnp.where(2 * r >= jnp.abs(den), 1, 0)
         return Val(sign * (q + adj), valid, rt)
-    # integer division truncates toward zero (SQL), unlike python floor-div
+    # integer division truncates toward zero (SQL), unlike python floor-div.
+    # Formulated as floor-div + mixed-sign adjustment rather than via abs():
+    # jnp.abs(INT64_MIN) wraps to itself, so the abs form silently corrupts
+    # quotients at the int64 edge (caught by tests/test_constant_fold_diff.py)
     ad = jnp.asarray(a.data, jnp.int64)
     bd = jnp.where(bzero, 1, jnp.asarray(b.data, jnp.int64))
-    out = jnp.sign(ad) * jnp.sign(bd) * (jnp.abs(ad) // jnp.abs(bd))
-    return Val(out.astype(rt.np_dtype), valid, rt)
+    qf = ad // bd
+    rem = ad - qf * bd
+    adjust = jnp.logical_and(rem != 0, (ad < 0) ^ (bd < 0)).astype(jnp.int64)
+    return Val((qf + adjust).astype(rt.np_dtype), valid, rt)
 
 
 @register("$mod")
@@ -1394,21 +1416,52 @@ def compile_cast(ctx: ExprCompiler, v: Val, to: T.Type) -> Val:
             )
             return Val(l, _and_valid(v.valid, fits), to)
         if isinstance(frm, T.DecimalType):
-            return Val(
-                _rescale_decimal(jnp.asarray(v.data, jnp.int64), frm.scale, to.scale),
-                v.valid,
-                to,
-            )
+            # short -> short decimal rescale, NULL when the value can
+            # overflow the DECLARED precision (checked before the upscale
+            # multiply so the check itself cannot wrap); statically skipped
+            # when the source precision provably fits
+            d = jnp.asarray(v.data, jnp.int64)
+            valid = v.valid
+            delta = to.scale - frm.scale
+            if delta >= 0:
+                lim = (10**to.precision - 1) // (10**delta)
+                if 10**frm.precision - 1 > lim:
+                    valid = _and_valid(
+                        valid, jnp.logical_and(d >= -lim, d <= lim)
+                    )
+                out = d * (10**delta)
+            else:
+                out = _rescale_decimal(d, frm.scale, to.scale)
+                f = 10 ** (-delta)
+                lim = 10**to.precision - 1
+                if (10**frm.precision - 1 + f // 2) // f > lim:
+                    valid = _and_valid(
+                        valid, jnp.logical_and(out >= -lim, out <= lim)
+                    )
+            return Val(out, valid, to)
         if frm.name in ("double", "real"):
             f = _to_float(v) * to.scale_factor
-            return Val(
-                (jnp.sign(f) * jnp.floor(jnp.abs(f) + 0.5)).astype(jnp.int64),
-                v.valid,
-                to,
+            r = jnp.sign(f) * jnp.floor(jnp.abs(f) + 0.5)
+            # NULL on overflow of the declared precision (or NaN): .astype
+            # of an out-of-range float is undefined garbage, and the cast
+            # family's contract is null-never-wrap
+            bound = float(min(10**to.precision, (1 << 63) - 1))
+            fits = jnp.logical_and(
+                jnp.logical_not(jnp.isnan(f)), jnp.abs(r) < bound
             )
-        return Val(
-            jnp.asarray(v.data, jnp.int64) * to.scale_factor, v.valid, to
-        )
+            return Val(
+                r.astype(jnp.int64), _and_valid(v.valid, fits), to
+            )
+        # integer -> short decimal: same NULL-on-precision-overflow
+        # contract, checked before the scale multiply; statically skipped
+        # when the integer width provably fits the target precision
+        d = jnp.asarray(v.data, jnp.int64)
+        valid = v.valid
+        digits = T.INT_DIGITS.get(frm.name)
+        lim = (10**to.precision - 1) // to.scale_factor
+        if digits is None or 10**digits - 1 > lim:
+            valid = _and_valid(valid, jnp.logical_and(d >= -lim, d <= lim))
+        return Val(d * to.scale_factor, valid, to)
     if _is_long_dec(frm):
         # long decimal -> double/bigint
         if to.name in ("double", "real"):
@@ -1452,11 +1505,33 @@ def compile_cast(ctx: ExprCompiler, v: Val, to: T.Type) -> Val:
             return Val(r.astype(to.np_dtype), valid, to)
         if frm.name in ("double", "real"):
             f = _to_float(v)
-            return Val(
-                (jnp.sign(f) * jnp.floor(jnp.abs(f) + 0.5)).astype(to.np_dtype),
-                v.valid,
-                to,
+            r = jnp.sign(f) * jnp.floor(jnp.abs(f) + 0.5)
+            # NULL on overflow/NaN, matching the decimal- and long-decimal
+            # cast contract above (the folder's _from_py nulls identically)
+            info = np.iinfo(to.np_dtype)
+            fits = jnp.logical_and(
+                jnp.logical_not(jnp.isnan(f)),
+                jnp.logical_and(
+                    r >= float(int(info.min)), r <= float(int(info.max))
+                ),
             )
+            return Val(
+                r.astype(to.np_dtype), _and_valid(v.valid, fits), to
+            )
+        if (
+            jnp.issubdtype(jnp.asarray(v.data).dtype, jnp.integer)
+            and np.iinfo(to.np_dtype).bits
+            < np.iinfo(jnp.asarray(v.data).dtype).bits
+        ):
+            # narrowing integer cast: NULL on overflow — .astype would wrap
+            # two's-complement (cast(2**40 as integer) must not be 0); the
+            # arithmetic ops wrap by contract, CASTS never do
+            d = jnp.asarray(v.data, jnp.int64)
+            info = np.iinfo(to.np_dtype)
+            fits = jnp.logical_and(
+                d >= int(info.min), d <= int(info.max)
+            )
+            return Val(d.astype(to.np_dtype), _and_valid(v.valid, fits), to)
         return Val(jnp.asarray(v.data).astype(to.np_dtype), v.valid, to)
     if to is T.DATE and frm is T.TIMESTAMP:
         return Val(jnp.asarray(v.data, jnp.int64) // 86_400_000_000, v.valid, to)

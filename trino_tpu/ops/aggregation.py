@@ -54,6 +54,10 @@ class AggSpec:
     out_type: T.Type
     param: object = None  # percentile fraction
     arg2: Optional[int] = None  # second input channel (map_agg values)
+    #: proof-licensed |partial sum| bound for decimal sum/avg (planner
+    #: range certificate, plan.Aggregation.sum_bound): _sum128 compiles the
+    #: single-plane i64 path with no runtime fits check when set
+    sum_bound: Optional[int] = None
 
 
 from trino_tpu.planner.functions import HOLISTIC_AGGS
@@ -299,36 +303,61 @@ def _reduce128(d, gid, nseg: int, kind: str, valid):
     raise NotImplementedError(f"long decimal {kind}")
 
 
-def _sum128(d, gid, nseg: int, valid, in_precision: int = None):
+def _note_fastpath(path: str) -> None:
+    """Record the trace-time decimal-sum path choice (proven |
+    runtime_check | limb).  Called while a kernel TRACES — the choice is
+    static per compiled program, so warm replays add nothing and a warm
+    run's zero runtime_check delta is a real guarantee (gated by
+    tools/compare_bench.py over the bench.py --mesh Q1 section)."""
+    from trino_tpu.telemetry.metrics import decimal_fastpath_counter
+
+    decimal_fastpath_counter().labels(path).inc()
+
+
+def _sum128(
+    d, gid, nseg: int, valid, in_precision: int = None, sum_bound: int = None
+):
     """Exact i128 segmented sum -> [nseg, 2] limb planes.  Input is either a
     short scaled-i64 column (1-D, widened) or long planes ([n, 2]).
 
-    Fast path: when the input's declared precision bounds every partial sum
-    inside i64 (10**p * rows < 2**63 — static per trace), ONE i64
-    segment_sum is provably exact and the result widens per group (the
-    group-count-sized widen is free next to the row-sized reduction)."""
+    Fast paths, strongest proof first:
+
+      * `sum_bound` — a range-certificate license (verify.numeric
+        sum_certificate): every partial sum of every subset of contributing
+        rows is statically bounded by |s| <= sum_bound < 2**63, from
+        per-column generator stats / literal bounds x a sound total-row
+        bound.  ONE i64 segment_sum is provably exact: values individually
+        fit i64 (|v| <= sum_bound), so the high limb is pure sign
+        extension and never needs summing.
+      * declared-precision proof — 10**in_precision * rows < 2**63 (static
+        per trace): the type's range contract alone bounds the batch.
+      * otherwise a fused runtime fits probe picks narrow/wide per batch
+        under lax.cond (exact either way, but the probe and the compiled
+        wide branch are the cost the certificates exist to delete)."""
     from trino_tpu.types import int128 as i128
 
     rows = d.shape[0]
     #: per-row magnitude under which `rows` addends provably sum inside i64
     thr = ((1 << 63) - 1) // max(rows, 1)
+    licensed = sum_bound is not None and sum_bound < (1 << 63) - 1
     if d.ndim == 2:
         h = jnp.asarray(d[:, 0], jnp.int64)
         l = jnp.asarray(d[:, 1], jnp.int64)
         if valid is not None:
             h = jnp.where(valid, h, 0)
             l = jnp.where(valid, l, 0)
-        if (
+        if licensed or (
             in_precision is not None
             and (10**in_precision) * rows < (1 << 63)
         ):
             # STATIC narrow proof for limb-plane inputs (the CPU fallback
-            # of the one-hot matmul path): |v| < 10**p bounds every value
-            # inside i64 — the high limb is pure sign extension by the
-            # type's range contract — and `rows` addends provably sum
-            # inside i64, so ONE i64 segment sum is exact with no runtime
-            # fits scan and no lax.cond (a widened-but-narrow column never
-            # pays the limb-plane cost).
+            # of the one-hot matmul path): |v| is bounded inside i64 by the
+            # range certificate or by 10**p — the high limb is pure sign
+            # extension by that bound — and every partial sum provably
+            # stays inside i64, so ONE i64 segment sum is exact with no
+            # runtime fits scan and no lax.cond (a widened-but-narrow
+            # column never pays the limb-plane cost).
+            _note_fastpath("proven")
             return jnp.stack(
                 i128.widen64(jax.ops.segment_sum(l, gid, nseg)), axis=-1
             )
@@ -341,6 +370,7 @@ def _sum128(d, gid, nseg: int, valid, in_precision: int = None):
         # the data, not the (over-wide) declared precision.  The per-row
         # conjunction folds the three reductions the old form paid
         # (all/max/min) into one elementwise pass + one all-reduce.
+        _note_fastpath("runtime_check")
         fits = jnp.all(
             jnp.logical_and(
                 h == (l >> 63),
@@ -365,13 +395,15 @@ def _sum128(d, gid, nseg: int, valid, in_precision: int = None):
         d = jnp.asarray(d, jnp.int64)
         if valid is not None:
             d = jnp.where(valid, d, 0)
-        if (
+        if licensed or (
             in_precision is not None
             and (10**in_precision) * rows < (1 << 63)
         ):
+            _note_fastpath("proven")
             red = jax.ops.segment_sum(d, gid, nseg)
             h, l = i128.widen64(red)
         else:
+            _note_fastpath("runtime_check")
             fits = jnp.logical_and(jnp.max(d) < thr, jnp.min(d) > -thr)
 
             def _fast(_):
@@ -1589,7 +1621,9 @@ class AggregationOperator:
                     and col.type.is_long
                 ):
                     # merging Int128 partial-sum states
-                    red2 = _sum128(d, gid, nseg, v)[:out_cap]
+                    red2 = _sum128(
+                        d, gid, nseg, v, sum_bound=spec.sum_bound
+                    )[:out_cap]
                     state_cols.append(Column(red2, col.type, None))
                     ch += 1
                     continue
@@ -1656,7 +1690,10 @@ class AggregationOperator:
                     if isinstance(col.type, T.DecimalType)
                     else None
                 )
-                red2 = _sum128(d, gid, nseg, v, in_precision=prec)[:out_cap]
+                red2 = _sum128(
+                    d, gid, nseg, v, in_precision=prec,
+                    sum_bound=spec.sum_bound,
+                )[:out_cap]
                 out.append(Column(red2, st, None))
                 continue
             if (
@@ -1782,7 +1819,13 @@ class AggregationOperator:
                     ):
                         gid0 = jnp.zeros(col.data.shape[0], dtype=jnp.int64)
                         states.append(
-                            Column(_sum128(col.data, gid0, 1, v), col.type, None)
+                            Column(
+                                _sum128(
+                                    col.data, gid0, 1, v,
+                                    sum_bound=spec.sum_bound,
+                                ),
+                                col.type, None,
+                            )
                         )
                         ch += 1
                         continue
@@ -1907,7 +1950,10 @@ class AggregationOperator:
                         )
                         states.append(
                             Column(
-                                _sum128(d, gid0, 1, v, in_precision=prec),
+                                _sum128(
+                                    d, gid0, 1, v, in_precision=prec,
+                                    sum_bound=spec.sum_bound,
+                                ),
                                 st,
                                 None,
                             )
@@ -2053,7 +2099,10 @@ class AggregationOperator:
         merger = AggregationOperator(
             list(range(len(self.group_channels))),
             [
-                AggSpec(s.name, self._state_channel(i), s.out_type, param=s.param)
+                AggSpec(
+                    s.name, self._state_channel(i), s.out_type,
+                    param=s.param, sum_bound=s.sum_bound,
+                )
                 for i, s in enumerate(self.aggregates)
             ],
             [c.type for c in states_batch.columns],

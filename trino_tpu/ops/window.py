@@ -56,6 +56,11 @@ class WindowSpec:
     # IGNORE NULLS for lag/lead/first_value/last_value (reference:
     # operator/window/LagFunction.java ignoreNulls handling)
     ignore_nulls: bool = False
+    #: proof-licensed |frame sum| bound for decimal sum/avg (planner range
+    #: certificate, plan.WindowFunction.sum_bound): a long-decimal input
+    #: whose every frame sum provably fits int64 runs the single-plane
+    #: prefix-sum kernel instead of limb-plane arithmetic
+    sum_bound: Optional[int] = None
 
 
 _WINDOW_STEP_CACHE: dict = {}
@@ -84,6 +89,7 @@ class WindowOperator:
                     sp.name, sp.arg, sp.out_type.name, sp.offset,
                     sp.default_channel, sp.n_buckets, sp.frame,
                     sp.start_off, sp.end_off, sp.ignore_nulls,
+                    sp.sum_bound,
                 )
                 for sp in self.specs
             ),
@@ -380,9 +386,13 @@ class WindowOperator:
         if name in ("sum", "avg", "count"):
             if d.ndim > 1:
                 if name != "count":
-                    raise NotImplementedError(
-                        "window sum/avg over a long-decimal input column "
-                        "(cast to decimal(18,s) or double first)"
+                    # long-decimal (two-limb) input: exact frame sums over
+                    # limb planes — or, when the planner attached a range
+                    # certificate proving every frame sum fits int64, the
+                    # single-plane licensed kernel
+                    return self._long_decimal_sum_avg(
+                        spec, name, d, v, whole, pid, nseg, safe_pid,
+                        lo, hi, frame_n, cap,
                     )
                 # count reads only the validity mask: a 1-D surrogate keeps
                 # the shared sum/count reduction below shape-correct
@@ -425,64 +435,170 @@ class WindowOperator:
                     "window min/max over a long-decimal input column "
                     "(cast to decimal(18,s) or double first)"
                 )
-            sent = _max_sentinel(d.dtype) if name == "min" else _min_sentinel(d.dtype)
-            dd = jnp.where(v, d, sent)
-            if whole:
-                red = (
-                    jax.ops.segment_min(dd, pid, nseg)
-                    if name == "min"
-                    else jax.ops.segment_max(dd, pid, nseg)
-                )[safe_pid]
-                cnt = jax.ops.segment_sum(v.astype(jnp.int64), pid, nseg)[safe_pid]
-                return Column(red, spec.out_type, cnt > 0, col.dictionary)
-            op = jnp.minimum if name == "min" else jnp.maximum
-            hi_c = jnp.clip(hi, 0, cap - 1)
-            if spec.start_off is not None:
-                # bounded sliding min/max: sparse-table range query
-                # (O(n log n) build of power-of-two block minima, O(1)
-                # two-block query per row — fully vectorized; the TPU-native
-                # substitute for the reference's per-row frame re-scan)
-                levels = [dd]
-                width = 1
-                while width < cap:
-                    prev = levels[-1]
-                    shifted = jnp.concatenate(
-                        [prev[width:], jnp.full(width, sent, dd.dtype)]
-                    )
-                    levels.append(op(prev, shifted))
-                    width *= 2
-                table = jnp.stack(levels)  # [L, cap]; level j covers 2^j rows
-                length = jnp.maximum(hi - lo + 1, 1)
-                j = (
-                    jnp.floor(jnp.log2(length.astype(jnp.float64)))
-                ).astype(jnp.int64)
-                j = jnp.clip(j, 0, len(levels) - 1)
-                lo_c = jnp.clip(lo, 0, cap - 1)
-                start2 = jnp.clip(hi - (jnp.int64(1) << j) + 1, 0, cap - 1)
-                flat = table.reshape(-1)
-                a_val = jnp.take(flat, j * cap + lo_c, mode="clip")
-                b_val = jnp.take(flat, j * cap + start2, mode="clip")
-                red = op(a_val, b_val)
-            else:
-                # running min/max: prefix scan reset at partition starts —
-                # cummax over (partition-tagged) values via associative_scan
-                def scan_fn(a, b):
-                    a_pid, a_val = a
-                    b_pid, b_val = b
-                    merged = jnp.where(a_pid == b_pid, op(a_val, b_val), b_val)
-                    return (b_pid, merged)
-
-                _, red = jax.lax.associative_scan(scan_fn, (pid, dd))
-                red = jnp.take(red, hi_c, mode="clip")
-            runc = jnp.cumsum(v.astype(jnp.int64))
-            before = jnp.where(
-                lo > 0, jnp.take(runc, jnp.clip(lo - 1, 0, cap - 1), mode="clip"), 0
+            return self._minmax(
+                spec, name, d, v, whole, pid, nseg, safe_pid, lo, hi,
+                frame_n, cap, col,
             )
-            cnt = jnp.where(
-                frame_n > 0, jnp.take(runc, hi_c, mode="clip") - before, 0
-            )
-            return Column(red, spec.out_type, cnt > 0, col.dictionary)
         raise NotImplementedError(f"window function {name}")
+
+    def _long_decimal_sum_avg(
+        self, spec, name, d, v, whole, pid, nseg, safe_pid, lo, hi,
+        frame_n, cap,
+    ) -> Column:
+        """sum/avg over a long-decimal (limb-plane) input column.
+
+        Validity contract: invalid rows are zeroed before every reduction
+        (additive identity) and the output plane is scnt > 0 — NULLs can
+        never resurface as values (the dropped-validity hazard the
+        numeric verifier polices).
+
+        Licensed path: the planner's range certificate (WindowSpec
+        .sum_bound, from verify.numeric.license_decimal_sums) proves every
+        value AND every frame sum lies inside int64, so the low limb IS
+        the value (high limb pure sign extension) and one i64 prefix /
+        segment sum is exact — no limb traffic, no runtime check.
+
+        Limb path: exact i128 frame sums.  Whole-partition frames reduce
+        via segment_sum128; running frames build prefix sums over the four
+        32-bit chunk planes (each prefix stays under cap * 2**32 < 2**63,
+        the recombine4 contract) and difference them per frame with a full
+        128-bit borrow."""
+        from trino_tpu.ops.aggregation import _note_fastpath
+        from trino_tpu.types import int128 as i128
+
+        h = jnp.asarray(d[:, 0], jnp.int64)
+        l = jnp.asarray(d[:, 1], jnp.int64)
+        h = jnp.where(v, h, 0)
+        l = jnp.where(v, l, 0)
+        cnt_inc = v.astype(jnp.int64)
+
+        def run_at(r, i):
+            return jnp.take(r, jnp.clip(i, 0, cap - 1), mode="clip")
+
+        if whole:
+            scnt = jax.ops.segment_sum(cnt_inc, pid, nseg)[safe_pid]
+        else:
+            runc = jnp.cumsum(cnt_inc)
+            beforec = jnp.where(lo > 0, run_at(runc, lo - 1), 0)
+            scnt = jnp.where(frame_n > 0, run_at(runc, hi) - beforec, 0)
+
+        licensed = (
+            spec.sum_bound is not None and spec.sum_bound < (1 << 63) - 1
+        )
+        if licensed:
+            _note_fastpath("proven")
+            # |value| <= sum_bound < 2**63: the low limb is the value
+            if whole:
+                ssum = jax.ops.segment_sum(l, pid, nseg)[safe_pid]
+            else:
+                run = jnp.cumsum(l)
+                before = jnp.where(lo > 0, run_at(run, lo - 1), 0)
+                ssum = jnp.where(frame_n > 0, run_at(run, hi) - before, 0)
+            sh, sl = i128.widen64(ssum)
+        else:
+            _note_fastpath("limb")
+            if whole:
+                sh, sl = i128.segment_sum128(h, l, pid, nseg)
+                sh = sh[safe_pid]
+                sl = sl[safe_pid]
+            else:
+                mask32 = jnp.int64(0xFFFFFFFF)
+                planes = (l & mask32, (l >> 32) & mask32, h & mask32, h >> 32)
+                runs = [jnp.cumsum(p) for p in planes]
+
+                def frame_at(i, present):
+                    vals = [
+                        jnp.where(present, run_at(r, i), 0) for r in runs
+                    ]
+                    return i128.recombine4(*vals)
+
+                eh, el = frame_at(hi, frame_n > 0)
+                bh, bl = frame_at(lo - 1, jnp.logical_and(frame_n > 0, lo > 0))
+                sh, sl = i128.sub128(eh, el, bh, bl)
+
+        if name == "sum":
+            if spec.out_type.is_long:
+                data = jnp.stack([sh, sl], axis=-1)
+            else:
+                # a short declared result asserts the values fit: the low
+                # limb carries them exactly (same contract as _finalize)
+                data = sl
+            return Column(data, spec.out_type, scnt > 0)
+        # avg: exact integer division, round half away from zero —
+        # mirroring _finalize's DecimalAverageAggregation path bit for bit
+        den = jnp.maximum(scnt, 1)
+        qh, ql, r = i128.divmod128_by_vec(sh, sl, den)
+        half = jnp.where(2 * jnp.abs(r) >= den, 1, 0)
+        neg = sh < 0
+        bump = jnp.where(neg, -half, half)
+        qh2, ql2 = i128.add128(qh, ql, bump >> 63, bump)
+        if spec.out_type.is_long:
+            data = jnp.stack([qh2, ql2], axis=-1)
+        else:
+            data = ql2
+        return Column(data, spec.out_type, scnt > 0)
+
+    def _minmax(
+        self, spec, name, d, v, whole, pid, nseg, safe_pid, lo, hi,
+        frame_n, cap, col,
+    ) -> Column:
+        sent = _max_sentinel(d.dtype) if name == "min" else _min_sentinel(d.dtype)
+        dd = jnp.where(v, d, sent)
+        if whole:
+            red = (
+                jax.ops.segment_min(dd, pid, nseg)
+                if name == "min"
+                else jax.ops.segment_max(dd, pid, nseg)
+            )[safe_pid]
+            cnt = jax.ops.segment_sum(v.astype(jnp.int64), pid, nseg)[safe_pid]
+            return Column(red, spec.out_type, cnt > 0, col.dictionary)
+        op = jnp.minimum if name == "min" else jnp.maximum
+        hi_c = jnp.clip(hi, 0, cap - 1)
+        if spec.start_off is not None:
+            # bounded sliding min/max: sparse-table range query
+            # (O(n log n) build of power-of-two block minima, O(1)
+            # two-block query per row — fully vectorized; the TPU-native
+            # substitute for the reference's per-row frame re-scan)
+            levels = [dd]
+            width = 1
+            while width < cap:
+                prev = levels[-1]
+                shifted = jnp.concatenate(
+                    [prev[width:], jnp.full(width, sent, dd.dtype)]
+                )
+                levels.append(op(prev, shifted))
+                width *= 2
+            table = jnp.stack(levels)  # [L, cap]; level j covers 2^j rows
+            length = jnp.maximum(hi - lo + 1, 1)
+            j = (
+                jnp.floor(jnp.log2(length.astype(jnp.float64)))
+            ).astype(jnp.int64)
+            j = jnp.clip(j, 0, len(levels) - 1)
+            lo_c = jnp.clip(lo, 0, cap - 1)
+            start2 = jnp.clip(hi - (jnp.int64(1) << j) + 1, 0, cap - 1)
+            flat = table.reshape(-1)
+            a_val = jnp.take(flat, j * cap + lo_c, mode="clip")
+            b_val = jnp.take(flat, j * cap + start2, mode="clip")
+            red = op(a_val, b_val)
+        else:
+            # running min/max: prefix scan reset at partition starts —
+            # cummax over (partition-tagged) values via associative_scan
+            def scan_fn(a, b):
+                a_pid, a_val = a
+                b_pid, b_val = b
+                merged = jnp.where(a_pid == b_pid, op(a_val, b_val), b_val)
+                return (b_pid, merged)
+
+            _, red = jax.lax.associative_scan(scan_fn, (pid, dd))
+            red = jnp.take(red, hi_c, mode="clip")
+        runc = jnp.cumsum(v.astype(jnp.int64))
+        before = jnp.where(
+            lo > 0, jnp.take(runc, jnp.clip(lo - 1, 0, cap - 1), mode="clip"), 0
+        )
+        cnt = jnp.where(
+            frame_n > 0, jnp.take(runc, hi_c, mode="clip") - before, 0
+        )
+        return Column(red, spec.out_type, cnt > 0, col.dictionary)
 
     # -- host-side ------------------------------------------------------------
 

@@ -1109,7 +1109,10 @@ class StageExecutor:
         # look at dtypes (2.5s per query on an 8-way CPU mesh)
         state_types = [c.type for c in states.columns]
         merge_specs = [
-            AggSpec(s.name, partial_op._state_channel(i), s.out_type, param=s.param)
+            AggSpec(
+                s.name, partial_op._state_channel(i), s.out_type,
+                param=s.param, sum_bound=s.sum_bound,
+            )
             for i, s in enumerate(specs)
         ]
         ngroups = len(partial_op.group_channels)
@@ -1821,6 +1824,7 @@ class StageExecutor:
                     start_off=fn.start_off,
                     end_off=fn.end_off,
                     ignore_nulls=fn.ignore_nulls,
+                    sum_bound=getattr(fn, "sum_bound", None),
                 )
             )
         op = WindowOperator(part, order, specs)

@@ -492,6 +492,13 @@ def optimize(plan: P.OutputNode, rules=None, catalogs=None, verify=None) -> P.Ou
     plan = prune(plan)
     if vmode != "off":
         V.enforce(V.check_plan(plan), vmode)
+    # numeric licensing (verify/numeric.py): attach range-certificate
+    # sum bounds to decimal sum/avg aggregations and window functions —
+    # provably-exact single-plane i64 kernels downstream, no runtime fits
+    # checks.  Proof-only: the pass never changes plan shape or results.
+    from trino_tpu.verify.numeric import license_decimal_sums
+
+    license_decimal_sums(plan, catalogs)
     assert isinstance(plan, P.OutputNode)
     return plan
 

@@ -138,6 +138,11 @@ class Aggregation:
     distinct: bool = False
     filter: Optional[Expr] = None
     param: object = None  # literal parameter (approx_percentile fraction)
+    #: proof-licensed |partial sum| bound for decimal sum/avg: attached by
+    #: verify.numeric.license_decimal_sums when a range certificate proves
+    #: every partial sum fits int64 — the kernels then compile single-plane
+    #: i64 segment sums with no runtime fits check (None = no proof)
+    sum_bound: Optional[int] = None
 
 
 @dataclass
@@ -254,6 +259,9 @@ class WindowFunction:
     start_off: object = None
     end_off: object = 0
     ignore_nulls: bool = False  # lag/lead/first_value/last_value
+    #: proof-licensed |frame sum| bound for decimal sum/avg over the
+    #: window (see Aggregation.sum_bound); None = no proof
+    sum_bound: Optional[int] = None
 
 
 @dataclass

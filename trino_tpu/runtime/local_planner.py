@@ -530,6 +530,7 @@ class LocalExecutionPlanner:
                     start_off=fn.start_off,
                     end_off=fn.end_off,
                     ignore_nulls=fn.ignore_nulls,
+                    sum_bound=getattr(fn, "sum_bound", None),
                 )
             )
         budget = self.properties.get("query_max_memory_bytes")
@@ -986,6 +987,10 @@ def build_agg_inputs(node: "P.AggregationNode", src) -> tuple:
                 out_sym.type,
                 param=getattr(agg, "param", None),
                 arg2=arg2_ch,
+                # planner range-certificate license (verify.numeric
+                # license_decimal_sums): rides the plan node so the local,
+                # partial, and merge kernels all read the same proof
+                sum_bound=getattr(agg, "sum_bound", None),
             )
         )
     return proj, specs, input_types

@@ -396,6 +396,12 @@ COLLECTIVE_VOCABULARY = (
 )
 
 
+#: decimal-sum kernel path vocabulary (ops/aggregation._sum128 + the
+#: window frame sums), pre-registered so the zero-runtime-check gate in
+#: tools/compare_bench.py reads real zeros, not absent series
+DECIMAL_FASTPATHS = ("proven", "runtime_check", "limb")
+
+
 #: membership transition vocabulary, pre-registered so scrapes see
 #: join/drain/death at 0 before any transition fires
 MEMBERSHIP_EVENT_KINDS = ("join", "drain", "death", "rejoin", "shrink_replan")
@@ -535,6 +541,17 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
         _compile_events_total,
         kind_hint="counter",
     )
+    fastpath = reg.counter(
+        _PREFIX + "decimal_fastpath_total",
+        "decimal-sum kernel path selections at TRACE time (ops/aggregation "
+        "+ ops/window): proven = statically licensed single-plane i64 sum "
+        "(range certificate or precision proof, no runtime check), "
+        "runtime_check = a lax.cond fits probe was compiled in, limb = "
+        "unconditional limb-plane arithmetic",
+        labelnames=("path",),
+    )
+    for p in DECIMAL_FASTPATHS:
+        fastpath.touch(p)
     collective = reg.counter(
         _PREFIX + "collective_bytes_total",
         "bytes moved by mesh collectives/gathers, by collective kind and "
@@ -592,6 +609,15 @@ def _breaker_series():
 def mesh_events_counter() -> Counter:
     """The labeled mesh-event counter MeshProfile.bump mirrors into."""
     return REGISTRY.counter(_PREFIX + "mesh_events_total")
+
+
+def decimal_fastpath_counter() -> Counter:
+    """Trace-time decimal-sum path selections, labeled path=proven|
+    runtime_check|limb.  Bumped when a kernel TRACES (path choice is
+    static per compiled program): warm replays add nothing, so a warm run
+    with runtime_check deltas == 0 proves the workload runs entirely on
+    statically-licensed sums."""
+    return REGISTRY.counter(_PREFIX + "decimal_fastpath_total")
 
 
 def queries_counter() -> Counter:

@@ -23,7 +23,16 @@ Three layers:
   * collective-uniformity pass (`collectives.py`) — statically enumerates
     each distributed fragment's collective sequence, proves it
     divergence-free (never conditional on per-worker data), and records
-    the signature `device_residency` holds warm replays to.
+    the signature `device_residency` holds warm replays to;
+  * numeric-safety verifier (`numeric.py` + `ranges.py`) — abstract
+    interpretation of (dtype, decimal precision/scale, value interval,
+    nullability) over the expression IR: flags silent overflow wraps /
+    scale mismatches / float contamination / dropped validity (sweep:
+    `python -m trino_tpu.verify.numeric`, baseline in
+    tools/lint_baseline.json `numeric_safety`), and emits range
+    certificates that license provably-exact single-plane i64 decimal
+    sum kernels (`license_decimal_sums`, run at the end of plan
+    optimization).
 
 Enforcement of the plan checkers follows the `verify_plan` session property
 (strict | warn | off; default strict under pytest, warn in benches).

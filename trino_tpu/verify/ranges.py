@@ -1,0 +1,298 @@
+"""Value-range lattice and range certificates for the numeric verifier.
+
+The abstract domain of `verify.numeric` is a closed interval over exact
+python integers, measured in the SCALED units of the value's own SQL type:
+a `decimal(12,2)` literal 19.99 is the point interval [1999, 1999], an
+`integer` column is its int32 dtype range, a DATE is day numbers.  Python
+int arithmetic never wraps, so interval bounds computed here are sound for
+the device's fixed-width kernels — an operation is proven wrap-free exactly
+when its result interval fits the kernel's accumulator width.
+
+Two artifacts come out of the domain:
+
+  * `Interval` — the lattice element (None endpoint = unbounded on that
+    side; TOP = (None, None), BOTTOM is not represented: unreachable code
+    simply isn't analyzed).
+  * `RangeCertificate` — a machine-checkable proof record that licenses a
+    narrow kernel: per-row |scaled value| <= max_abs, over at most
+    rows_bound contributing rows, so every partial sum of any subset stays
+    inside [-max_abs*rows_bound, +max_abs*rows_bound].  The planner attaches
+    one to an aggregation / window spec when `licensed_i64_sum_bound()`
+    proves the whole reduction fits a single int64 plane; the kernels then
+    compile the one-plane segment sum with NO runtime fits check and NO
+    limb-plane traffic (the `_sum128` static-proof framework, generalized).
+
+Provenance strings record where each bound came from (`stats:<column>`,
+`literal`, `type:<name>`, `rows:<source>`), so a certificate can be audited
+end to end: the proof is only as strong as its weakest source, and only
+connector generator statistics (exact by construction) or declared type
+precisions are admissible — never CBO estimates.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from trino_tpu import types as T
+
+#: the int64 accumulator's representable magnitude: a sum proven strictly
+#: under this bound can never wrap a single-plane segment sum
+I64_MAX = (1 << 63) - 1
+
+#: dtype range of each integer-kind device representation
+_INT_RANGES = {
+    "tinyint": (-(1 << 7), (1 << 7) - 1),
+    "smallint": (-(1 << 15), (1 << 15) - 1),
+    "integer": (-(1 << 31), (1 << 31) - 1),
+    "bigint": (-(1 << 63), (1 << 63) - 1),
+}
+
+
+@dataclass(frozen=True)
+class Interval:
+    """Closed integer interval; None = unbounded on that side."""
+
+    lo: Optional[int] = None
+    hi: Optional[int] = None
+
+    # -- constructors ---------------------------------------------------------
+
+    @staticmethod
+    def top() -> "Interval":
+        return Interval(None, None)
+
+    @staticmethod
+    def point(v: int) -> "Interval":
+        return Interval(int(v), int(v))
+
+    @property
+    def bounded(self) -> bool:
+        return self.lo is not None and self.hi is not None
+
+    def max_abs(self) -> Optional[int]:
+        """|v| bound, or None when either side is unbounded."""
+        if not self.bounded:
+            return None
+        return max(abs(self.lo), abs(self.hi))
+
+    # -- lattice --------------------------------------------------------------
+
+    def union(self, other: "Interval") -> "Interval":
+        lo = None if self.lo is None or other.lo is None else min(self.lo, other.lo)
+        hi = None if self.hi is None or other.hi is None else max(self.hi, other.hi)
+        return Interval(lo, hi)
+
+    def within(self, other: "Interval") -> bool:
+        """self ⊆ other (unbounded `other` sides always contain)."""
+        if other.lo is not None and (self.lo is None or self.lo < other.lo):
+            return False
+        if other.hi is not None and (self.hi is None or self.hi > other.hi):
+            return False
+        return True
+
+    # -- arithmetic transfer functions ---------------------------------------
+
+    def add(self, other: "Interval") -> "Interval":
+        lo = None if self.lo is None or other.lo is None else self.lo + other.lo
+        hi = None if self.hi is None or other.hi is None else self.hi + other.hi
+        return Interval(lo, hi)
+
+    def sub(self, other: "Interval") -> "Interval":
+        return self.add(other.neg())
+
+    def neg(self) -> "Interval":
+        return Interval(
+            None if self.hi is None else -self.hi,
+            None if self.lo is None else -self.lo,
+        )
+
+    def mul(self, other: "Interval") -> "Interval":
+        if not (self.bounded and other.bounded):
+            return Interval.top()
+        prods = [
+            self.lo * other.lo, self.lo * other.hi,
+            self.hi * other.lo, self.hi * other.hi,
+        ]
+        return Interval(min(prods), max(prods))
+
+    def scale_pow10(self, k: int) -> "Interval":
+        """Rescale by 10**k (k may be negative: truncating/rounding divide —
+        conservative: magnitude never grows on downscale)."""
+        if k == 0:
+            return self
+        if k > 0:
+            f = 10 ** k
+            return Interval(
+                None if self.lo is None else self.lo * f,
+                None if self.hi is None else self.hi * f,
+            )
+        f = 10 ** (-k)
+        # rounding half-away divide: |result| <= (|v| + f/2) / f <= |v|/f + 1
+        lo = None if self.lo is None else -(abs(self.lo) // f + 1)
+        hi = None if self.hi is None else self.hi // f + 1
+        if self.lo is not None and self.lo >= 0:
+            lo = 0
+        if self.hi is not None and self.hi <= 0:
+            hi = 0
+        return Interval(lo, hi)
+
+    def truncdiv(self, other: "Interval") -> "Interval":
+        """Truncate-toward-zero division: |q| <= |a| (divisor magnitude
+        >= 1 whenever the result is non-null, and div-by-zero nulls)."""
+        m = self.max_abs()
+        if m is None:
+            return Interval.top()
+        return Interval(-m, m)
+
+    def scaled_sum_bound(self, rows: int) -> Optional[int]:
+        """|any partial sum of <= rows addends| bound."""
+        m = self.max_abs()
+        if m is None:
+            return None
+        return m * int(rows)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        lo = "-inf" if self.lo is None else str(self.lo)
+        hi = "+inf" if self.hi is None else str(self.hi)
+        return f"[{lo}, {hi}]"
+
+
+TOP = Interval.top()
+
+#: int64 device accumulator as an interval
+I64_INTERVAL = Interval(-(1 << 63), I64_MAX)
+#: two-limb i128 planes
+I128_INTERVAL = Interval(-(1 << 127), (1 << 127) - 1)
+
+
+def dtype_interval(t: T.Type) -> Interval:
+    """The device representation's own range (what silent wrap is measured
+    against), NOT the SQL-declared range."""
+    if isinstance(t, T.DecimalType):
+        return I128_INTERVAL if t.is_long else I64_INTERVAL
+    r = _INT_RANGES.get(t.name)
+    if r is not None:
+        return Interval(*r)
+    if t.name == "boolean":
+        return Interval(0, 1)
+    if t is T.DATE:
+        return Interval(*_INT_RANGES["integer"])
+    if t.np_dtype.kind == "i":
+        return Interval(*_INT_RANGES["bigint"])
+    return TOP
+
+
+def type_interval(t: T.Type) -> Interval:
+    """Widest value interval the DECLARED type admits, in scaled units:
+    the fallback bound when no stats or literal narrows it."""
+    if isinstance(t, T.DecimalType):
+        m = 10 ** t.precision - 1
+        return Interval(-m, m)
+    r = _INT_RANGES.get(t.name)
+    if r is not None:
+        return Interval(*r)
+    if t.name == "boolean":
+        return Interval(0, 1)
+    if t is T.DATE:
+        # civil day numbers: comfortably within +-1e7 (year ~29379);
+        # the generous bound keeps date arithmetic provably i64
+        return Interval(-10_000_000, 10_000_000)
+    if t.name in ("timestamp", "time", "interval day to second"):
+        # microsecond encodings of civil instants: |v| < 2**55 keeps
+        # +-256 additions provably inside i64
+        return Interval(-(1 << 55), (1 << 55) - 1)
+    if T.is_string_kind(t) or isinstance(t, T.VarbinaryType):
+        # dictionary codes: int32 indices
+        return Interval(0, (1 << 31) - 1)
+    if t.np_dtype.kind == "i":
+        return Interval(*_INT_RANGES["bigint"])
+    return TOP  # floats / composites: no exact-range reasoning
+
+
+def is_exact_type(t: T.Type) -> bool:
+    """Types whose device representation is exact integer arithmetic."""
+    return not (t.name in ("real", "double") or t.np_dtype.kind == "f")
+
+
+def stats_interval(t: T.Type, low, high) -> Optional[Interval]:
+    """Connector column statistics (logical-unit floats) -> a scaled-int
+    interval, rounded OUTWARD with a one-unit cushion so float conversion
+    error can never tighten a bound below the truth."""
+    if low is None or high is None:
+        return None
+    if not is_exact_type(t):
+        return None
+    factor = t.scale_factor if isinstance(t, T.DecimalType) else 1
+    try:
+        lo = int(math.floor(float(low) * factor)) - 1
+        hi = int(math.ceil(float(high) * factor)) + 1
+    except (OverflowError, ValueError):
+        return None
+    return Interval(lo, hi)
+
+
+# -- certificates --------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RangeCertificate:
+    """Machine-checkable proof that a reduction over a column fits i64.
+
+    Contract: every contributing row's scaled value v satisfies
+    |v| <= max_abs, and at most rows_bound rows ever contribute (across ALL
+    batches/workers of the query — padding rows are masked to zero and do
+    not count).  Then every partial sum of every subset, in any association
+    order, lies in [-max_abs*rows_bound, +max_abs*rows_bound]: the licensed
+    kernel is exact iff that bound is strictly inside int64.
+    """
+
+    max_abs: int
+    scale: int
+    rows_bound: Optional[int]
+    provenance: tuple = field(default_factory=tuple)
+
+    def sum_bound(self) -> Optional[int]:
+        if self.rows_bound is None:
+            return None
+        return int(self.max_abs) * int(self.rows_bound)
+
+    def licensed_i64_sum_bound(self) -> Optional[int]:
+        """The static sum bound when it proves a one-plane i64 reduction,
+        else None (caller falls back to runtime checks / limb planes)."""
+        b = self.sum_bound()
+        if b is not None and b < I64_MAX:
+            return b
+        return None
+
+    def to_json(self) -> dict:
+        return {
+            "max_abs": int(self.max_abs),
+            "scale": int(self.scale),
+            "rows_bound": (
+                None if self.rows_bound is None else int(self.rows_bound)
+            ),
+            "sum_bound": self.sum_bound(),
+            "licenses_i64_sum": self.licensed_i64_sum_bound() is not None,
+            "provenance": list(self.provenance),
+        }
+
+
+def certificate(
+    interval: Interval,
+    scale: int,
+    rows_bound: Optional[int],
+    provenance=(),
+) -> Optional[RangeCertificate]:
+    """Build a certificate from an analyzed value interval, or None when
+    the interval is unbounded (no proof exists)."""
+    m = interval.max_abs()
+    if m is None:
+        return None
+    return RangeCertificate(
+        max_abs=m,
+        scale=scale,
+        rows_bound=rows_bound,
+        provenance=tuple(provenance),
+    )
