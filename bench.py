@@ -441,12 +441,30 @@ for _ in range(trace_runs):
     q6_warm_trace_on = min(q6_warm_trace_on, time.perf_counter() - t0)
 
 # registry snapshot: the trajectory carries COUNTERS, not just walls
-# (tools/compare_bench.py gates the zero-invariants on this section)
+# (tools/compare_bench.py gates the zero-invariants on this section).
+# Taken BEFORE the pressure phase: constrained waves may legitimately
+# retry speculative expands, and those must not dirty the unconstrained
+# zero-counter evidence
 from trino_tpu.telemetry import REGISTRY
 metrics_snapshot = {
     k: v for k, v in sorted(REGISTRY.snapshot().items())
     if not k.startswith("trino_tpu_query_wall_seconds_bucket")
 }
+
+# pressure: Q18 under a pool limit smaller than its build side must
+# complete in k>1 partition waves with filesystem-SPI spill and rows ==
+# the unconstrained local oracle — and every unconstrained query above
+# must have recorded ZERO waves/spill/revocations (degradation is free
+# when there is no pressure).  tools/compare_bench.py gates this section;
+# a probe failure records {"error": ...} (the gate's skip path) instead of
+# killing the whole mesh child and losing every other section.
+from trino_tpu.bench_pressure import run_pressure
+try:
+    pressure = run_pressure(local, dist, QUERIES[18])
+except Exception as e:
+    from trino_tpu.runtime.lifecycle import set_memory_pool_limit
+    set_memory_pool_limit(0)  # never leave the probe's limit armed
+    pressure = {"error": f"{type(e).__name__}: {e}"}
 
 print(json.dumps({
     "schema": schema,
@@ -503,6 +521,8 @@ print(json.dumps({
         "manifest_keys": len(dist.compile_manifest()),
         "total_compile_s": round(OBSERVATORY.total_wall_s, 4),
     },
+    # memory-pressure degradation proof (budget -> revoke -> wave -> kill)
+    "pressure": pressure,
     # telemetry-on overhead (acceptance: on/off ratio < 1.05 warm)
     "q6_mesh8_warm_trace_off_s": round(q6_warm_trace_off, 4),
     "q6_mesh8_warm_trace_on_s": round(q6_warm_trace_on, 4),

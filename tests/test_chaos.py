@@ -504,3 +504,113 @@ def test_chaos_cancel_inmesh_mid_query():
         FAILURE_INJECTOR.maybe_fail = orig
     # the engine survives: the next statement runs clean
     assert r.execute("select count(*) from region").rows == [(5,)]
+
+
+def test_chaos_pool_shrink_mid_query_revokes_join_into_waves(local):
+    """Memory-pressure chaos (a): the shared pool limit SHRINKS while a
+    join is mid-probe — the escalation's revoke tier asks the running
+    build to spill, the probe remainder finishes in partition waves, and
+    rows still equal the unconstrained local oracle (exceed -> revoke ->
+    wave, killer never fires)."""
+    from trino_tpu.ops.join import HashJoinOperator
+    from trino_tpu.runtime import spill as S
+    from trino_tpu.runtime.lifecycle import set_memory_pool_limit
+    from trino_tpu.telemetry.metrics import (
+        memory_kills_counter,
+        memory_revocations_counter,
+    )
+
+    sql = (
+        "select o_orderpriority, count(*), sum(l_quantity) from orders "
+        "join lineitem on o_orderkey = l_orderkey group by o_orderpriority"
+    )
+    base = sorted(local.execute(sql).rows)
+    rev0 = memory_revocations_counter().value()
+    kills0 = memory_kills_counter().value()
+    shrunk = threading.Event()
+    shrinkers: list = []
+    orig = HashJoinOperator._join_batch
+
+    def shrinking(self, pb):
+        out = orig(self, pb)
+        if not shrunk.is_set():
+            shrunk.set()
+            # an operator watchdog shrinking the pool under live queries
+            # to well below the join build's reservation (the query's
+            # residual state still fits, so it can finish degraded)
+            t = threading.Thread(
+                target=set_memory_pool_limit, args=(400_000,),
+                name="chaos-shrink", daemon=True,
+            )
+            shrinkers.append(t)
+            t.start()
+        return out
+
+    HashJoinOperator._join_batch = shrinking
+    try:
+        r = LocalQueryRunner(catalog="tpch", schema="tiny", target_splits=4)
+        t0 = time.monotonic()
+        rows = sorted(r.execute(sql).rows)
+        wall = time.monotonic() - t0
+    finally:
+        HashJoinOperator._join_batch = orig
+        for t in shrinkers:
+            t.join()  # a late shrink must not land AFTER the reset below
+        set_memory_pool_limit(0)
+    assert shrunk.is_set()
+    assert wall < DEADLINE_S
+    assert rows == base
+    assert memory_revocations_counter().value() > rev0
+    assert memory_kills_counter().value() == kills0  # killer never fired
+    assert not S.REVOCABLES.live()
+
+
+def test_chaos_pool_pressure_and_worker_kill_compose(local):
+    """Memory-pressure chaos (b): a constrained budget AND a mid-query
+    worker kill compose — the W-1 re-plan re-executes under the SAME
+    budget (waves and all) and still answers rows == local, or fails
+    classified inside its deadline.  Degradation tiers must not interfere
+    with elastic membership."""
+    ws = [WorkerServer(port=0).start() for _ in range(3)]
+    victim = ws[2]
+    killed = {"done": False}
+    orig = FAILURE_INJECTOR.maybe_fail
+
+    def kill_hook(point):
+        if point.startswith("fetch:") and not killed["done"]:
+            killed["done"] = True
+            threading.Thread(target=victim.shutdown, daemon=True).start()
+            time.sleep(0.2)
+        return orig(point)
+
+    FAILURE_INJECTOR.maybe_fail = kill_hook
+    try:
+        mh = MultiHostQueryRunner(
+            [w.url for w in ws], catalog="tpch", schema="tiny"
+        )
+        mh.properties.set("query_max_run_time", DEADLINE_S)
+        mh.properties.set("query_max_memory", 250_000)
+        sql = QUERIES[2]
+        t0 = time.monotonic()
+        try:
+            got = mh.execute(sql).rows
+        except (QueryAbortedException, RuntimeError, OSError) as e:
+            assert str(e), "failure must carry a message"
+            got = None
+        wall = time.monotonic() - t0
+        assert wall < DEADLINE_S
+        assert killed["done"], "the kill hook never fired"
+        if got is not None:
+            assert_rows_match(got, local.execute(sql).rows, ordered=False)
+            assert len(mh.last_plan_workers) == 2
+        # the shrunk mesh keeps answering under the same budget
+        FAILURE_INJECTOR.maybe_fail = orig
+        got = mh.execute(sql).rows
+        assert_rows_match(got, local.execute(sql).rows, ordered=False)
+    finally:
+        FAILURE_INJECTOR.maybe_fail = orig
+        for w in ws:
+            try:
+                w.shutdown()
+            except Exception:
+                pass

@@ -63,6 +63,26 @@ def test_batch_bytes():
     assert batch_bytes(b) == 8 * 8 + 8 + 8 * 4 + 8
 
 
+def test_batch_bytes_includes_dictionary_footprint():
+    """Dictionary-coded columns account their dictionary (i32 lookup table
+    + validity byte per entry + value bytes), not just the code column —
+    the round-3 accounting ignored dictionary storage entirely."""
+    from trino_tpu.columnar.dictionary import StringDictionary
+    from trino_tpu.runtime.memory import dictionary_bytes
+
+    d = StringDictionary(["ab", "cde", "f"])  # 6 value bytes, 3 entries
+    assert dictionary_bytes(d) == 3 * 4 + 3 + 6
+    plain = Batch(
+        [Column(np.zeros(4, np.int32), T.VARCHAR, np.ones(4, bool))],
+        np.ones(4, bool),
+    )
+    coded = Batch(
+        [Column(np.zeros(4, np.int32), T.VARCHAR, np.ones(4, bool), d)],
+        np.ones(4, bool),
+    )
+    assert batch_bytes(coded) == batch_bytes(plain) + dictionary_bytes(d)
+
+
 # -- wired into the query path (round-3: operators reserve through the pool,
 # join builds overflow into partition waves) ---------------------------------
 

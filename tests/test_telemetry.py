@@ -408,8 +408,22 @@ def _clean_extra():
                     "join_capacity_sync": 0,
                     "join_speculative_retry": 0,
                 },
+                "pressure": _clean_pressure(),
             }
         },
+    }
+
+
+def _clean_pressure():
+    return {
+        "unconstrained": {
+            "memory_waves_total": 0,
+            "spill_bytes_total": 0,
+            "memory_revocations_total": 0,
+        },
+        "pool_limit_bytes": 1 << 20,
+        "local": {"rows_match": True, "waves": 4, "spill_bytes": 100},
+        "mesh": {"rows_match": True, "waves": 4, "spill_bytes": 100},
     }
 
 
@@ -429,6 +443,31 @@ def _clean_membership():
 def test_compare_bench_clean():
     violations, skipped = _compare_bench().check_extra(_clean_extra())
     assert violations == [] and skipped == []
+
+
+def test_compare_bench_pressure_gate():
+    """The PR 12 degradation gate: unconstrained runs must be wave/spill
+    free, constrained runs must have actually degraded (k>1 waves, SPI
+    spill, rows == oracle)."""
+    check_extra = _compare_bench().check_extra
+    bad = _clean_extra()
+    p = bad["mesh"]["sf1"]["pressure"]
+    p["unconstrained"]["memory_waves_total"] = 3  # idle must be free
+    p["local"]["waves"] = 1  # k>1 required
+    p["mesh"]["rows_match"] = False  # degraded rows must equal oracle
+    p["mesh"]["spill_bytes"] = 0  # waves must spill through the SPI
+    violations, _ = check_extra(bad)
+    text = "\n".join(violations)
+    assert "pressure.unconstrained.memory_waves_total" in text
+    assert "pressure.local.waves" in text
+    assert "pressure.mesh.rows_match" in text
+    assert "pressure.mesh.spill_bytes" in text
+    # a missing pressure section is reported as skipped, not violated
+    missing = _clean_extra()
+    del missing["mesh"]["sf1"]["pressure"]
+    violations, skipped = check_extra(missing)
+    assert violations == []
+    assert any("no pressure section" in s for s in skipped)
 
 
 def test_compare_bench_flags_drift():

@@ -130,6 +130,67 @@ def check_restart(schema: str, sec: dict) -> list:
             )
     return violations
 
+#: pressure-section degradation counters that must be ZERO over the
+#: unconstrained benched runs (graceful degradation must cost nothing when
+#: there is no pressure — PR 12's zero-cost-when-idle bar)
+PRESSURE_IDLE_ZEROS = (
+    "memory_waves_total",
+    "spill_bytes_total",
+    "memory_revocations_total",
+)
+
+
+def check_pressure(schema: str, sec: dict) -> list:
+    """Violations over one mesh section's `pressure` block (bench.py
+    --mesh / tools/pressure_bench.py): Q18 under a pool limit smaller
+    than its unconstrained peak must complete in k > 1 partition waves
+    with filesystem-SPI spill and rows == the unconstrained local oracle,
+    on the local AND mesh paths; the unconstrained runs must have
+    recorded zero waves/spill/revocations."""
+    violations = []
+    unc = sec.get("unconstrained")
+    if not isinstance(unc, dict):
+        violations.append(
+            f"mesh.{schema}.pressure.unconstrained missing (re-run "
+            "tools/pressure_bench.py)"
+        )
+    else:
+        for name in PRESSURE_IDLE_ZEROS:
+            if unc.get(name, 0) != 0:
+                violations.append(
+                    f"mesh.{schema}.pressure.unconstrained.{name} = "
+                    f"{unc.get(name)} (expected 0: degradation must cost "
+                    "nothing without pressure)"
+                )
+    for side in ("local", "mesh"):
+        s = sec.get(side)
+        if not isinstance(s, dict):
+            violations.append(
+                f"mesh.{schema}.pressure.{side} missing (degradation "
+                "proof incomplete — re-run tools/pressure_bench.py)"
+            )
+            continue
+        if s.get("rows_match") is not True:
+            violations.append(
+                f"mesh.{schema}.pressure.{side}.rows_match = "
+                f"{s.get('rows_match')} (expected true: constrained "
+                "execution must answer the unconstrained oracle's rows)"
+            )
+        if s.get("waves", 0) < 2:
+            violations.append(
+                f"mesh.{schema}.pressure.{side}.waves = "
+                f"{s.get('waves', 0)} (expected > 1: the pool limit must "
+                "have forced multi-wave execution)"
+            )
+        if s.get("spill_bytes", 0) <= 0:
+            violations.append(
+                f"mesh.{schema}.pressure.{side}.spill_bytes = "
+                f"{s.get('spill_bytes', 0)} (expected > 0: waves must "
+                "have spilled through the filesystem SPI)"
+            )
+    return violations
+
+
 #: registry-snapshot series (telemetry/metrics names) that must be zero in a
 #: fresh `bench.py --mesh` snapshot.  The snapshot is PROCESS-LIFETIME, so
 #: only counters that must never fire even cold belong here —
@@ -313,6 +374,21 @@ def check_extra(extra: dict) -> tuple:
                         f"mesh.{schema}.coldstart.{qname} missing "
                         f"{missing} (cold/warm decomposition incomplete)"
                     )
+        # memory-pressure degradation proof (PR 12): waves+spill under a
+        # constrained pool, zero cost unconstrained
+        p = sec.get("pressure")
+        if isinstance(p, dict):
+            if p.get("error"):
+                skipped.append(
+                    f"mesh.{schema}.pressure: bench errored: {p['error']}"
+                )
+            else:
+                violations.extend(check_pressure(schema, p))
+        else:
+            skipped.append(
+                f"mesh.{schema}: no pressure section recorded (run "
+                "tools/pressure_bench.py)"
+            )
         # the registry snapshot bench.py records into the section is the
         # fresh-run diff surface: apply the process-lifetime expectations
         snap = sec.get("metrics")

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Record the memory-pressure degradation probe into BENCH_EXTRA.json's
+`mesh.<schema>.pressure` section (the same block `bench.py --mesh`
+records inline; this tool re-measures it standalone).
+
+The probe (trino_tpu/bench_pressure.py): Q18 under a pool limit derived
+from its MEASURED unconstrained peak must complete in k > 1 partition
+waves with filesystem-SPI spill, rows == the unconstrained local oracle,
+on both the local and mesh-8 paths — while the unconstrained runs record
+zero waves/spill/revocations.  Gated by tools/compare_bench.py.
+
+Usage: python tools/pressure_bench.py [--schema tiny] [--workers 8]
+       [--query 18] [-o BENCH_EXTRA.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _deep_merge(base: dict, updates: dict) -> dict:
+    out = dict(base)
+    for k, v in updates.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--schema", default="tiny")
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--query", type=int, default=18)
+    ap.add_argument("-o", "--out",
+                    default=os.path.join(ROOT, "BENCH_EXTRA.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from trino_tpu.bench_pressure import run_pressure
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.parallel import DistributedQueryRunner
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    local = LocalQueryRunner(schema=args.schema, target_splits=8)
+    dist = DistributedQueryRunner(n_workers=args.workers, schema=args.schema)
+    # warm the unconstrained paths first: the `unconstrained` zeros then
+    # cover real executions, not an empty process
+    sql = QUERIES[args.query]
+    dist.execute(sql)
+    pressure = run_pressure(local, dist, sql)
+    print(json.dumps(pressure, indent=2))
+
+    extra = {}
+    if os.path.exists(args.out):
+        with open(args.out, "r", encoding="utf-8") as fh:
+            extra = json.load(fh)
+    merged = _deep_merge(
+        extra, {"mesh": {args.schema: {"pressure": pressure}}}
+    )
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(merged, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    # the SAME bar check_pressure() gates in CI — the tool must never
+    # print OK for a recording compare_bench would reject
+    ok = all(v == 0 for v in pressure["unconstrained"].values()) and all(
+        side.get("rows_match") is True
+        and side.get("waves", 0) > 1
+        and side.get("spill_bytes", 0) > 0
+        for side in (pressure["local"], pressure.get("mesh", {}))
+    )
+    print("pressure probe:", "OK" if ok else "DEGRADATION PROOF INCOMPLETE")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

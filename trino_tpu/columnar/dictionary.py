@@ -27,7 +27,10 @@ class StringDictionary:
     column validity mask with a device fill value of 0.
     """
 
-    __slots__ = ("values", "_index", "_hash")
+    #: _nbytes: lazily cached device-adjacent footprint
+    #: (runtime/memory.dictionary_bytes) — cached on the object because an
+    #: id()-keyed side table would survive address recycling
+    __slots__ = ("values", "_index", "_hash", "_nbytes")
 
     def __init__(self, values):
         vals = tuple(values)
@@ -37,6 +40,7 @@ class StringDictionary:
         object.__setattr__(self, "values", vals)
         object.__setattr__(self, "_index", None)
         object.__setattr__(self, "_hash", None)
+        object.__setattr__(self, "_nbytes", None)
 
     def __setattr__(self, name, value):  # immutability
         raise AttributeError("StringDictionary is immutable")

@@ -328,8 +328,13 @@ class MemoryConfig(ConfigSection):
 
     pool_limit_bytes: int = knob(
         0, "memory.pool-limit-bytes",
-        "shared device-memory pool limit arming the low-memory killer "
-        "(0 = unlimited)",
+        "shared device-memory pool limit arming the revoke -> kill "
+        "escalation (0 = unlimited)",
+    )
+    spill_dir: str = knob(
+        "", "memory.spill-dir",
+        "directory for partition-wave spill files (filesystem SPI; "
+        "empty = a per-process temp directory)",
     )
 
 

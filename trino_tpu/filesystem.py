@@ -53,6 +53,13 @@ class FileSystem:
     def mkdirs(self, path: str) -> None:
         raise NotImplementedError
 
+    def delete_recursive(self, path: str) -> None:
+        """Remove a directory tree (spool/spill cleanup).  Lives on the SPI
+        so cleanup follows the files to whatever storage hosts them — an
+        object-store implementation expresses this as a prefix delete, not
+        a local rmtree."""
+        raise NotImplementedError
+
     def open_input(self, path: str):
         """File-like handle for libraries that stream (pyarrow, numpy)."""
         raise NotImplementedError
@@ -99,6 +106,11 @@ class LocalFileSystem(FileSystem):
 
     def mkdirs(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
+
+    def delete_recursive(self, path: str) -> None:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
 
     def open_input(self, path: str):
         return open(path, "rb")

@@ -2042,8 +2042,6 @@ class AggregationOperator:
     def push(self, batch: Batch) -> None:
         """Accumulate one input batch (streamed per-batch reduction when
         `streaming`)."""
-        from trino_tpu.runtime.memory import batch_bytes
-
         if self.streaming:
             self._acc.append(self.reduce_batch(batch))
             if len(self._acc) >= self.fold_every:
@@ -2051,12 +2049,27 @@ class AggregationOperator:
         else:
             self._acc.append(batch)
         if self.memory_ctx is not None:
-            self.memory_ctx.set_bytes(sum(batch_bytes(b) for b in self._acc))
+            from trino_tpu.runtime.memory import (
+                ExceededMemoryLimitException,
+                batches_bytes,
+            )
+
+            try:
+                self.memory_ctx.set_bytes(batches_bytes(self._acc))
+            except ExceededMemoryLimitException:
+                # graceful-degradation hook: folding compacts accumulated
+                # states to live groups, often freeing enough to fit; only
+                # re-raise when pressure survives the fold (the wave
+                # fallback's / killer's signal)
+                if not self.streaming or len(self._acc) <= 1:
+                    raise
+                self._fold_states()
+                self.memory_ctx.set_bytes(batches_bytes(self._acc))
 
     def state_bytes(self) -> int:
-        from trino_tpu.runtime.memory import batch_bytes
+        from trino_tpu.runtime.memory import batches_bytes
 
-        return sum(batch_bytes(b) for b in self._acc)
+        return batches_bytes(self._acc)
 
     def process(self, stream):
         for batch in stream:

@@ -315,6 +315,19 @@ class _SortedBuildJoinBase:
             lambda: jax.jit(_locate_table),
         )
 
+    def release_build(self) -> None:
+        """Drop every device reference to the indexed build side (the
+        memory-revocation hook, reference HashBuilderOperator
+        .startMemoryRevoke: once the build has been spilled host-side the
+        operator releases its HBM so the pool reservation it gave back is
+        physically real).  The operator is unusable afterwards; callers
+        switch to partition-wave execution against the spilled build."""
+        self.build = None
+        self._build_canon = None
+        self._n_match = 0
+        self._table = None
+        self._recode = {}
+
     def _index_build(self, build: Batch) -> None:
         (
             self.build,

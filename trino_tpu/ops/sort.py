@@ -27,9 +27,17 @@ _STEP_CACHE: dict = {}
 class OrderByOperator:
     """Full materialized sort; emits one sorted, compacted batch."""
 
-    def __init__(self, keys: Sequence[SortKey], memory_ctx=None):
+    def __init__(self, keys: Sequence[SortKey], memory_ctx=None,
+                 spill_factory=None, observer=None):
         self.keys = list(keys)
         self.memory_ctx = memory_ctx
+        #: lazy filesystem-SPI spill store (runtime/spill.SpillManager)
+        #: for over-budget runs; None / factory-returns-None = host RAM
+        self._spill_factory = spill_factory
+        self._spiller = None
+        self._spiller_made = False
+        self._spill_runs = 0
+        self.observer = observer
         self._acc: list[Batch] = []
         key = ("orderby", tuple(keys))
         if key not in _STEP_CACHE:
@@ -41,12 +49,21 @@ class OrderByOperator:
         live = jnp.take(batch.mask(), perm, mode="clip")
         return batch.gather(perm, valid=live)
 
-    def _spill_chunk(self) -> Batch:
-        """Compact the accumulated batches to live rows and move them to
-        HOST memory (freeing HBM) as one spill run.  Runs are NOT
-        per-run sorted: the finish-time merge is a full host lexsort, so a
-        per-run device sort would be thrown-away work; the single-run case
-        re-sorts on device at finish."""
+    def _get_spiller(self):
+        if not self._spiller_made:
+            self._spiller_made = True
+            if self._spill_factory is not None:
+                self._spiller = self._spill_factory()
+        return self._spiller
+
+    def _spill_chunk(self) -> object:
+        """Compact the accumulated batches to live rows and move them OFF
+        device as one spill run — to the filesystem SPI when a spiller is
+        attached (reference: GenericSpiller in OrderByOperator.java's
+        revoke path), host RAM otherwise.  Runs are NOT per-run sorted:
+        the finish-time merge is a full host lexsort, so a per-run device
+        sort would be thrown-away work; the single-run case re-sorts on
+        device at finish.  Returns the host run, or an int disk-run id."""
         from trino_tpu.columnar.batch import device_get_async
 
         big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
@@ -59,7 +76,27 @@ class OrderByOperator:
                 Batch.compact_device, static_argnames=("out_capacity",)
             )
         compact = _STEP_CACHE[ckey](big, out_capacity=cap)
-        return device_get_async(compact)  # lint: allow(host-transfer)
+        host = device_get_async(compact)  # lint: allow(host-transfer)
+        spiller = self._get_spiller()
+        if spiller is None:
+            return host
+        run = self._spill_runs
+        self._spill_runs += 1
+        spiller.save("run", run, [host])
+        return run
+
+    def _load_runs(self, runs: list) -> list:
+        """Rehydrate disk-run ids back to host batches (in-RAM runs pass
+        through).  The merge is ONE vectorized host lexsort over all runs,
+        so host-RAM peak at finish equals the in-RAM staging path — the
+        SPI spill buys DEVICE residency (runs leave HBM as they form) and
+        the object-store-ready storage seam, not a host peak reduction;
+        an incremental k-way merge is the follow-up that would."""
+        spiller = self._spiller
+        return [
+            spiller.load("run", r)[0] if isinstance(r, int) else r
+            for r in runs
+        ]
 
     def process(self, stream):
         """In-memory device sort; over budget, fall back to an EXTERNAL sort
@@ -70,49 +107,56 @@ class OrderByOperator:
         kernel), so device memory stays bounded by one chunk."""
         from trino_tpu.runtime.memory import (
             ExceededMemoryLimitException,
-            batch_bytes,
+            batches_bytes,
         )
 
-        runs: list[Batch] = []
-        total = 0
-        for b in stream:
-            self._acc.append(b)
-            if self.memory_ctx is not None:
-                total += batch_bytes(b)
-                try:
-                    self.memory_ctx.set_bytes(total)
-                except ExceededMemoryLimitException:
-                    runs.append(self._spill_chunk())
-                    total = 0
-                    self.memory_ctx.set_bytes(0)
-        if not self._acc and not runs:
-            return
-        if not runs:
-            big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
-            big = _pad_device(big, next_pow2(big.capacity, floor=1))
-            out = self._step(big)
-            if self.memory_ctx is not None:
-                self.memory_ctx.close()
-            yield out
-            return
-        if self._acc:
-            runs.append(self._spill_chunk())
-        if len(runs) == 1:
-            # one run = the budget tripped at the very end; a device sort of
-            # the whole set is what the in-memory path would have done
-            big = jax.device_put(runs[0])
-            out = self._step(_pad_device(big, next_pow2(big.capacity, floor=1)))
-            if self.memory_ctx is not None:
-                self.memory_ctx.close()
-            yield out
-            return
-        from trino_tpu.ops.merge import merge_sorted_shards
+        runs: list = []
+        try:
+            for b in stream:
+                self._acc.append(b)
+                if self.memory_ctx is not None:
+                    # recomputed over the accumulation so a dictionary
+                    # shared by every batch is counted once, not per batch
+                    try:
+                        self.memory_ctx.set_bytes(batches_bytes(self._acc))
+                    except ExceededMemoryLimitException:
+                        runs.append(self._spill_chunk())
+                        self.memory_ctx.set_bytes(0)
+            if not self._acc and not runs:
+                return
+            if not runs:
+                big = self._acc[0] if len(self._acc) == 1 else concat_batches(self._acc)
+                big = _pad_device(big, next_pow2(big.capacity, floor=1))
+                out = self._step(big)
+                if self.memory_ctx is not None:
+                    self.memory_ctx.close()
+                yield out
+                return
+            if self._acc:
+                runs.append(self._spill_chunk())
+            if self.observer is not None:
+                # external-sort waves: one run merged per pass slice
+                self.observer.waves("sort", len(runs))
+            runs = self._load_runs(runs)
+            if len(runs) == 1:
+                # one run = the budget tripped at the very end; a device sort
+                # of the whole set is what the in-memory path would have done
+                big = jax.device_put(runs[0])
+                out = self._step(_pad_device(big, next_pow2(big.capacity, floor=1)))
+                if self.memory_ctx is not None:
+                    self.memory_ctx.close()
+                yield out
+                return
+            from trino_tpu.ops.merge import merge_sorted_shards
 
-        runs = _unify_host_dictionaries(runs)
-        out = merge_sorted_shards(runs, self.keys)
-        if self.memory_ctx is not None:
-            self.memory_ctx.close()
-        yield out
+            runs = _unify_host_dictionaries(runs)
+            out = merge_sorted_shards(runs, self.keys)
+            if self.memory_ctx is not None:
+                self.memory_ctx.close()
+            yield out
+        finally:
+            if self._spiller is not None:
+                self._spiller.close()
 
 
 class TopNOperator:

@@ -372,6 +372,23 @@ class StageExecutor:
             from trino_tpu.runtime.fte import SpoolManager
 
             self.spool = SpoolManager()
+        # per-query device budget tree for the MESH path: blocking
+        # operators (join builds, the fused-exchange aggregation output)
+        # reserve BEFORE materializing; an over-budget reservation degrades
+        # to partition waves (runtime/spill) instead of dying.  Lives on
+        # the shared process pool when a query is executing, where the
+        # revoke tier and the low-memory killer can see it.
+        from trino_tpu.runtime.lifecycle import query_memory_context
+        from trino_tpu.runtime.spill import session_budget
+
+        self.memory = query_memory_context(session_budget(properties))
+
+    def _budget(self) -> int:
+        """Effective device budget (0 = unconstrained), re-read at each
+        reservation so a pool limit shrunk mid-query takes effect."""
+        from trino_tpu.runtime.spill import effective_budget
+
+        return effective_budget(self.properties, self.memory)
 
     # -- instrumented step dispatch -------------------------------------------
 
@@ -1149,29 +1166,113 @@ class StageExecutor:
             fid=self._current_fid,
         )
         fcap = self.wm.n * slot_cap
+        # budget enforcement: the fused exchange materializes a [W, fcap]
+        # output next to the input states — reserve that footprint BEFORE
+        # dispatching; over budget, the exchange+final runs in group-hash
+        # waves (group-disjoint, so per-wave merges are exact)
+        from trino_tpu.runtime import spill as _spill
+        from trino_tpu.runtime.memory import ExceededMemoryLimitException
 
-        def final_step(b: Batch) -> Batch:
-            return final_op._reduce_step(b, out_cap=fcap)
+        s_bytes = batch_bytes(states)
+        cap_s = _trailing_cap(states)
+        row_bytes = max(1, s_bytes // max(1, self.wm.n * cap_s))
+        need = s_bytes + self.wm.n * fcap * row_bytes
+        ctx = self.memory.child("agg_final")
+        wave_k = 0
+        try:
+            ctx.add_bytes(need)
+        except ExceededMemoryLimitException:
+            wave_k = _spill.wave_count(need, self._budget(), self.properties)
+        if wave_k:
+            out = self._wave_agg_exchange(
+                node, states, chans, final_op, specs, wave_k, ctx
+            )
+        else:
+            def final_step(b: Batch) -> Batch:
+                return final_op._reduce_step(b, out_cap=fcap)
 
-        out = self._call(
-            ex.fused_repartition,
-            states,
-            chans,
-            self.wm,
-            final_step,
-            ("agg_final", _spec_sig(specs), fcap,
-             _sig(node.outputs)),
-            slot_cap,
-            phase="collective",
-        )
-        self.profile.add_collective(
-            self._current_fid, batch_bytes(out), "all_to_all", "repartition"
-        )
+            out = self._call(
+                ex.fused_repartition,
+                states,
+                chans,
+                self.wm,
+                final_step,
+                ("agg_final", _spec_sig(specs), fcap,
+                 _sig(node.outputs)),
+                slot_cap,
+                phase="collective",
+            )
+            self.profile.add_collective(
+                self._current_fid, batch_bytes(out), "all_to_all",
+                "repartition",
+            )
+            ctx.close()
         return self._dist(
             out, node.outputs,
             placements=((tuple(s.name for s in node.group_symbols),)),
             realigned=True,
         )
+
+    def _wave_agg_exchange(self, node, states, chans, final_op, specs,
+                           n_waves: int, ctx) -> Batch:
+        """Group-hash wave execution of the aggregation's fused exchange
+        (HashAggregationOperator.startMemoryRevoke on the mesh): each wave
+        device-filters the partial states to the groups whose exchange
+        row hash lands in the wave, runs the SAME fused
+        repartition+final program shape at the wave's (smaller) slot
+        bucket, and the per-wave outputs concatenate.  Hashing the full
+        group key keeps every group inside exactly one wave, so results
+        are exact; peak exchange-output footprint shrinks ~k-fold."""
+        from trino_tpu.runtime import spill as _spill
+
+        observer = _spill.PressureObserver(sink=self.profile)
+        observer.waves("aggregation", n_waves)
+        fid = self._current_fid
+        cap_s = _trailing_cap(states)
+
+        def build_filter(wave):
+            def step(b: Batch) -> Batch:
+                h = ex._hash_rows(b, chans)
+                sel = (h % jnp.uint64(n_waves)).astype(jnp.int64) == wave
+                return b.filter(jnp.logical_and(b.mask(), sel))
+
+            return lambda: step
+
+        outs = []
+        for wave in range(n_waves):
+            fn = cached_spmd_step(
+                self.wm,
+                ("agg_wave_filter", n_waves, wave, tuple(chans), cap_s,
+                 _sig(node.outputs)),
+                build_filter(wave),
+            )
+            filt = self._call(fn, states)
+            slot_w = ex.exchange_slot_cap(
+                filt, chans, self.wm, profile=self.profile, fid=fid
+            )
+            fcap_w = self.wm.n * slot_w
+            _spill.reserve_wave_working_set(ctx, batch_bytes(filt))
+
+            def final_step(b: Batch, fc=fcap_w) -> Batch:
+                return final_op._reduce_step(b, out_cap=fc)
+
+            out_w = self._call(
+                ex.fused_repartition,
+                filt,
+                chans,
+                self.wm,
+                final_step,
+                ("agg_final", _spec_sig(specs), fcap_w, _sig(node.outputs)),
+                slot_w,
+                phase="collective",
+            )
+            self.profile.add_collective(
+                fid, batch_bytes(out_w), "all_to_all", "repartition"
+            )
+            outs.append(out_w)
+        out = _concat_stacked(outs)
+        ctx.close()
+        return out
 
     def _colocated_agg(self, node: P.AggregationNode, src: _Dist) -> _Dist:
         """Single-stage grouped aggregation over an already-placed child
@@ -1419,6 +1520,48 @@ class StageExecutor:
         probe_stacked = probe.stacked
         probe_types = [s.type for s in probe.symbols]
 
+        # budget enforcement: reserve the build's device footprint (raw +
+        # sorted copy) BEFORE the expansion materializes; over budget the
+        # join degrades to hash-partition waves with filesystem-SPI spill
+        # instead of dying (runtime/spill, SURVEY §5.7's k-pass loop)
+        from trino_tpu.runtime import spill as _spill
+        from trino_tpu.runtime.memory import ExceededMemoryLimitException
+
+        ctx = self.memory.child("join_build")
+        need = 2 * batch_bytes(build_stacked)
+        wave_k = 0
+        try:
+            ctx.add_bytes(need)
+        except ExceededMemoryLimitException:
+            wave_k = _spill.wave_count(need, self._budget(), self.properties)
+        if wave_k:
+            out = self._wave_join(
+                node, op, probe_stacked, build_stacked, pk, bk, jkey,
+                probe_types, wave_k, ctx,
+            )
+        else:
+            locate, device_emit_total, expand = self._join_step_fns(
+                node, op, pk, bk, _trailing_cap(build_stacked), probe_types
+            )
+            out = self._sized_expansion(
+                ("join",) + jkey, probe_stacked, build_stacked,
+                locate, device_emit_total, expand, compact_probe=True,
+                stats_key=("join",) + jkey + (probe_fp,),
+            )
+            ctx.close()
+        return self._dist(
+            out, out_symbols,
+            placements=join_output_placements(
+                probe.placements, node.criteria, node.kind
+            ),
+            realigned=probe.realigned or node.distribution != "broadcast",
+        )
+
+    def _join_step_fns(self, node, op, pk, bk, cap_b: int, probe_types):
+        """(locate, device_emit_total, expand) closures for one build
+        capacity — shared by the direct path and the per-wave path (which
+        runs them at the wave's smaller build bucket)."""
+
         def device_emit_total(pb: Batch, count):
             """Per-worker emitted-row total, ON DEVICE (what the pre-PR
             path synced the whole count matrix to the host to compute)."""
@@ -1464,18 +1607,151 @@ class StageExecutor:
                 out = concat_batches([out, tail])
             return out
 
-        out = self._sized_expansion(
-            ("join",) + jkey, probe_stacked, build_stacked,
-            locate, device_emit_total, expand, compact_probe=True,
-            stats_key=("join",) + jkey + (probe_fp,),
+        return locate, device_emit_total, expand
+
+    def _wave_join(self, node, op, probe_stacked, build_stacked, pk, bk,
+                   jkey, probe_types, n_waves: int, ctx) -> Batch:
+        """Mesh partition-wave join (SpillingJoinProcessor on the mesh):
+        both stacked sides pull host-side, hash-partition per worker shard
+        by the exchange row-value hash into `n_waves` partitions (spilled
+        through the filesystem SPI under `spill_enabled`), and the join
+        runs wave by wave at ONE shared shape bucket — the same compiled
+        locate/expand programs serve every wave, so after wave 1 the loop
+        retraces nothing.  Worker-shard identity is preserved through the
+        spill so each wave restacks onto the same mesh alignment."""
+        from trino_tpu.parallel.serde import partition_batches
+        from trino_tpu.runtime import spill as _spill
+
+        fid = self._current_fid
+        observer = _spill.PressureObserver(sink=self.profile)
+        spiller = (
+            _spill.SpillManager(observer=observer)
+            if _spill.spill_to_disk(self.properties)
+            else None
         )
-        return self._dist(
-            out, out_symbols,
-            placements=join_output_placements(
-                probe.placements, node.criteria, node.kind
-            ),
-            realigned=probe.realigned or node.distribution != "broadcast",
-        )
+        observer.waves("join", n_waves)
+        W = self.wm.n
+        try:
+            with self.profile.phase(fid, "transfer"):
+                # the spill tier's declared host boundary
+                bh, ph = _spill.pull_host(build_stacked, probe_stacked)
+            self.profile.fragment(fid).bytes_to_host += (
+                batch_bytes(bh) + batch_bytes(ph)
+            )
+
+            def shard_parts(host, keys):
+                """([wave][worker] -> host Batch or None, dead template).
+                Partitioning runs PER worker shard so wave loads restack
+                onto the same mesh alignment."""
+                shards = [
+                    jax.tree.map(lambda x, w=w: np.asarray(x)[w], host)
+                    for w in range(W)
+                ]
+                template = _dead_batch_like(shards[0])
+                per_shard = [
+                    partition_batches([s], list(keys), n_waves)
+                    for s in shards
+                ]
+                parts = [
+                    [
+                        (per_shard[w][wave][0] if per_shard[w][wave] else None)
+                        for w in range(W)
+                    ]
+                    for wave in range(n_waves)
+                ]
+                return parts, template
+
+            b_parts, b_dead = shard_parts(bh, bk)
+            p_parts, p_dead = shard_parts(ph, pk)
+            del bh, ph
+
+            def side_cap(parts) -> int:
+                rows = max(
+                    (b.capacity for wave in parts for b in wave
+                     if b is not None),
+                    default=1,
+                )
+                return next_pow2(max(rows, 1), floor=64)
+
+            # ONE shape bucket per side shared by every wave: the compiled
+            # locate/expand programs from wave 0/1 serve all later waves
+            cap_b = side_cap(b_parts)
+            cap_p = side_cap(p_parts)
+
+            def store(tag, parts):
+                """Spill each wave's present shards to the SPI; returns a
+                loader of [worker] -> Batch|None."""
+                if spiller is None:
+                    return lambda wave: parts[wave]
+                present: dict = {}
+                for wave in range(n_waves):
+                    real = [
+                        (w, b) for w, b in enumerate(parts[wave])
+                        if b is not None
+                    ]
+                    present[wave] = [w for w, _ in real]
+                    if real:
+                        spiller.save(tag, wave, [b for _, b in real])
+                    parts[wave] = None  # free RAM as waves land on disk
+
+                def load(wave):
+                    cells: list = [None] * W
+                    loaded = spiller.load(tag, wave)
+                    for w, b in zip(present[wave], loaded):
+                        cells[w] = b
+                    return cells
+
+                return load
+
+            b_load = store("jb", b_parts)
+            p_load = store("jp", p_parts)
+
+            locate, emit_total, expand = self._join_step_fns(
+                node, op, pk, bk, cap_b, probe_types
+            )
+            wkey = ("join_wave", n_waves, cap_b, cap_p) + jkey
+            outs = []
+            for wave in range(n_waves):
+                b_cells = b_load(wave)
+                p_cells = p_load(wave)
+                if all(c is None for c in p_cells) and node.kind != "full":
+                    continue  # no probe rows and no build tail: no output
+                if all(c is None for c in b_cells):
+                    b_cells[0] = b_dead  # empty build wave still probes
+                if all(c is None for c in p_cells):
+                    p_cells[0] = p_dead  # full outer: tail-only wave
+                build_w = stack_batches(b_cells, self.wm, cap=cap_b)
+                probe_w = stack_batches(p_cells, self.wm, cap=cap_p)
+                _spill.reserve_wave_working_set(
+                    ctx, 2 * batch_bytes(build_w)
+                )
+                outs.append(
+                    self._sized_expansion(
+                        wkey, probe_w, build_w, locate, emit_total, expand,
+                        compact_probe=False, stats_key=wkey,
+                    )
+                )
+            if not outs:
+                # every wave empty (all-dead inputs): one dead wave still
+                # runs so downstream sees a properly-shaped empty output
+                build_w = stack_batches(
+                    [b_dead] + [None] * (W - 1), self.wm, cap=cap_b
+                )
+                probe_w = stack_batches(
+                    [p_dead] + [None] * (W - 1), self.wm, cap=cap_p
+                )
+                outs.append(
+                    self._sized_expansion(
+                        wkey, probe_w, build_w, locate, emit_total, expand,
+                        compact_probe=False, stats_key=wkey,
+                    )
+                )
+            out = _concat_stacked(outs)
+            ctx.close()
+            return out
+        finally:
+            if spiller is not None:
+                spiller.close()
 
     # -- capacity-sized expansions (joins / residual semi joins) --------------
 
@@ -1918,3 +2194,61 @@ def _trailing_cap(stacked: Batch) -> int:
     if stacked.columns:
         return stacked.columns[0].data.shape[-1]
     return stacked.row_mask.shape[-1]
+
+
+def _dead_batch_like(b: Batch) -> Batch:
+    """Capacity-1 all-dead host batch with `b`'s schema (shape-compatible
+    placeholder for empty wave partitions)."""
+    cols = []
+    for c in b.columns:
+        data = np.asarray(c.data)
+        cols.append(
+            Column(
+                np.zeros((1,) + data.shape[1:], dtype=data.dtype),
+                c.type,
+                np.zeros(1, dtype=bool) if c.valid is not None else None,
+                c.dictionary,
+                (
+                    np.zeros(1, dtype=np.asarray(c.lengths).dtype)
+                    if c.lengths is not None
+                    else None
+                ),
+            )
+        )
+    return Batch(cols, np.zeros(1, dtype=bool))
+
+
+def _concat_stacked(batches: list) -> Batch:
+    """Concatenate stacked [W, cap_i] batches along the per-worker row axis
+    (wave outputs -> one distributed intermediate).  All inputs must share
+    schema and per-column dictionaries — wave partitions of one stacked
+    source always do."""
+    if len(batches) == 1:
+        return batches[0]
+    first = batches[0]
+    cols = []
+    for ci, c0 in enumerate(first.columns):
+        cs = [b.columns[ci] for b in batches]
+        for c in cs[1:]:
+            if c.dictionary is not c0.dictionary and c.dictionary != c0.dictionary:
+                raise AssertionError(
+                    "wave outputs diverged dictionaries; cannot concat"
+                )
+        data = jnp.concatenate([c.data for c in cs], axis=1)
+        valid = None
+        if any(c.valid is not None for c in cs):
+            valid = jnp.concatenate(
+                [
+                    c.valid
+                    if c.valid is not None
+                    else jnp.ones(c.data.shape[:2], dtype=bool)
+                    for c in cs
+                ],
+                axis=1,
+            )
+        lengths = None
+        if any(c.lengths is not None for c in cs):
+            lengths = jnp.concatenate([c.lengths for c in cs], axis=1)
+        cols.append(Column(data, c0.type, valid, c0.dictionary, lengths))
+    mask = jnp.concatenate([b.mask() for b in batches], axis=1)
+    return Batch(cols, mask)

@@ -71,29 +71,59 @@ class SpoolManager:
                 arrays[f"b{bi}_c{ci}_data"] = np.asarray(c.data)
                 if c.valid is not None:
                     arrays[f"b{bi}_c{ci}_valid"] = np.asarray(c.valid)
+                if c.lengths is not None:
+                    # array columns: per-row element counts ride along so a
+                    # spilled/spooled batch rehydrates exactly
+                    arrays[f"b{bi}_c{ci}_len"] = np.asarray(c.lengths)
         path = self._path(query_id, fragment_id)
         with self.fs.open_output(path) as f:  # streaming: no double-buffer
             np.savez(f, **arrays)
         return path
 
     def load(self, query_id: str, fragment_id: int, symbols, dictionaries):
-        """Rehydrate spooled batches (schema from the fragment's symbols)."""
+        """Rehydrate spooled batches (schema from the fragment's symbols).
+
+        `dictionaries` is validated against the stored codes instead of
+        taken on faith: a stale or mis-keyed dictionary list would decode
+        spooled codes into the WRONG strings silently — a clear error at
+        load beats corrupt results downstream."""
         from trino_tpu.columnar import Batch, Column
 
         path = self._path(query_id, fragment_id)
         if not self.fs.exists(path):
             return None
+        if len(dictionaries) != len(symbols):
+            raise ValueError(
+                f"spool load {query_id}/f{fragment_id}: {len(dictionaries)} "
+                f"dictionaries for {len(symbols)} columns"
+            )
         z = np.load(self.fs.open_input(path), allow_pickle=False)
         out = []
         for bi in range(int(z["__nbatches__"])):
             cols = []
+            mask = z[f"b{bi}_mask"]
             for ci, sym in enumerate(symbols):
                 data = z[f"b{bi}_c{ci}_data"]
                 valid = z.get(f"b{bi}_c{ci}_valid")
+                d = dictionaries[ci]
+                if d is not None and data.size:
+                    live = mask.astype(bool)
+                    if valid is not None:
+                        live = live & valid.astype(bool)
+                    codes = data[live] if live.any() else data[:0]
+                    if codes.size and int(codes.max()) >= len(d):
+                        raise ValueError(
+                            f"spool load {query_id}/f{fragment_id} column "
+                            f"{sym.name}: stored code {int(codes.max())} out "
+                            f"of range for dictionary of {len(d)} values — "
+                            "the dictionary list does not match the spooled "
+                            "batches"
+                        )
                 cols.append(
-                    Column(data, sym.type, valid, dictionaries[ci])
+                    Column(data, sym.type, valid, d,
+                           z.get(f"b{bi}_c{ci}_len"))
                 )
-            out.append(Batch(cols, z[f"b{bi}_mask"]))
+            out.append(Batch(cols, mask))
         return out
 
     def exists(self, query_id: str, fragment_id: int) -> bool:
@@ -121,15 +151,13 @@ class SpoolManager:
 
     def close(self) -> None:
         """Remove spooled intermediates (query finished); only directories
-        this manager created are deleted."""
+        this manager created are deleted.  Everything routes through the
+        filesystem SPI — including the directory removal — so cleanup
+        follows object-store spool implementations when they land."""
         if self._own:
-            # through the SPI: spool cleanup must follow the files wherever
-            # they live, not assume a local tree
             for p in list(self.fs.list(self.dir)):
                 self.fs.delete(p)
-            import shutil
-
-            shutil.rmtree(self.dir, ignore_errors=True)
+            self.fs.delete_recursive(self.dir)
 
 
 class HeartbeatFailureDetector:
@@ -161,7 +189,11 @@ class HeartbeatFailureDetector:
 
     def refresh(self) -> None:
         now = self.clock()
-        for w, t in self._last.items():
+        # snapshot: concurrent heartbeat()/register() calls resize the dict
+        # mid-iteration (RuntimeError under load).  dict.copy() is one
+        # atomic C-level operation under the GIL; list(items()) is NOT —
+        # its iteration can still observe the resize
+        for w, t in self._last.copy().items():
             if now - t > self.timeout_s:
                 self._failed.add(w)
 

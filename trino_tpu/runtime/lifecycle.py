@@ -487,14 +487,18 @@ _POOL_LOCK = threading.Lock()
 
 
 def memory_pool():
-    """The process memory pool with the low-memory killer installed."""
+    """The process memory pool with the escalation hook installed: the
+    revoke tier (runtime/spill.MemoryEscalation — the largest registered
+    wave-capable operator spills and releases) runs first, the low-memory
+    killer stays the last resort with its victim choice unchanged."""
     global _GLOBAL_POOL
     with _POOL_LOCK:
         if _GLOBAL_POOL is None:
             from trino_tpu.runtime.memory import MemoryPool
+            from trino_tpu.runtime.spill import MemoryEscalation
 
             _GLOBAL_POOL = MemoryPool()
-            _GLOBAL_POOL.root.on_exceeded = LowMemoryKiller()
+            _GLOBAL_POOL.root.on_exceeded = MemoryEscalation(LowMemoryKiller())
         return _GLOBAL_POOL
 
 

@@ -87,10 +87,8 @@ class LocalExecutionPlanner:
         #: lib/trino-memory-context AggregatedMemoryContext + MemoryPool);
         #: blocking operators reserve through children of this context.
         #: When a query is executing this lives on the SHARED process pool,
-        #: where the LowMemoryKiller can see (and shoot) it.
-        self.memory = query_memory_context(
-            self.properties.get("query_max_memory_bytes")
-        )
+        #: where the revoke tier and the LowMemoryKiller can see it.
+        self.memory = query_memory_context(self._session_budget())
         if stats is not None:
             stats.memory = self.memory
         self._depth = 0
@@ -98,6 +96,39 @@ class LocalExecutionPlanner:
         #: join build sides (reference: server/DynamicFilterService.java:107 +
         #: DynamicFilterSourceOperator — build-side ranges prune probe scans)
         self.dynamic_filters: dict = {}
+
+    def _session_budget(self) -> int:
+        """Per-query session budget in bytes (query_max_memory / legacy
+        query_max_memory_bytes, whichever is tighter)."""
+        from trino_tpu.runtime.spill import session_budget
+
+        return session_budget(self.properties)
+
+    def _budget(self) -> int:
+        """The effective device budget blocking operators plan against:
+        session budget AND any shared pool limit (memory.pool-limit-bytes),
+        whichever is tighter.  0 = unconstrained — no wave machinery runs."""
+        from trino_tpu.runtime.spill import effective_budget
+
+        return effective_budget(self.properties, self.memory)
+
+    def _observer(self):
+        """Wave/spill event sink: the metrics registry plus EXPLAIN
+        ANALYZE's StatsCollector counters when one is attached."""
+        from trino_tpu.runtime.spill import PressureObserver
+
+        return PressureObserver(sink=self.stats)
+
+    def _make_spiller(self):
+        """A filesystem-SPI spill store for one wave operation, or None
+        when the `spill_enabled` session knob stages partitions in host
+        RAM instead.  Callers invoke this LAZILY (first spill), so an
+        unconstrained query never touches the filesystem."""
+        from trino_tpu.runtime.spill import SpillManager, spill_to_disk
+
+        if not spill_to_disk(self.properties):
+            return None
+        return SpillManager(observer=self._observer())
 
     def plan(self, node: P.PlanNode) -> PhysicalPlan:
         method = getattr(self, "_visit_" + type(node).__name__, None)
@@ -315,7 +346,7 @@ class LocalExecutionPlanner:
             s.name in HOLISTIC_AGGS for s in specs
         )
 
-        budget = self.properties.get("query_max_memory_bytes")
+        budget = self._budget()
         # Fuse the agg-input projection INTO the jitted partial-reduce
         # program when possible: projection outputs (decimal products etc.)
         # then never materialize between operators — the whole-fragment
@@ -350,7 +381,9 @@ class LocalExecutionPlanner:
         feed = src.stream if pre_raw is not None else pre.process(src.stream)
         if budget and ngroups:
             stream = _agg_wave_stream(
-                make_op, feed, list(range(ngroups)), int(budget)
+                make_op, feed, list(range(ngroups)), int(budget),
+                observer=self._observer(), spill_factory=self._make_spiller,
+                properties=self.properties,
             )
         else:
             stream = make_op().process(feed)
@@ -448,29 +481,113 @@ class LocalExecutionPlanner:
                 residual_key=residual_key,
             )
 
-        # reserve the dense build footprint; on budget overflow fall back to
-        # hash-partitioned waves (the HBM analog of build-side spill:
-        # HashBuilderOperator.startMemoryRevoke + SpillingJoinProcessor)
+        # reserve the dense build footprint BEFORE materializing on device;
+        # on budget overflow degrade to hash-partitioned waves (the HBM
+        # analog of build-side spill: HashBuilderOperator.startMemoryRevoke
+        # + GenericPartitioningSpiller + SpillingJoinProcessor)
+        from trino_tpu.runtime import spill as _spill
+
         ctx = self.memory.child("join_build")
-        build_bytes = sum(batch_bytes(b) for b in build_batches)
+        observer = self._observer()
+        from trino_tpu.runtime.memory import batches_bytes
+
+        build_bytes = batches_bytes(build_batches)
+        need = 2 * build_bytes  # raw batches + compacted copy
         try:
-            ctx.add_bytes(2 * build_bytes)  # raw batches + compacted copy
+            ctx.add_bytes(need)
         except ExceededMemoryLimitException:
-            limit = self.memory.limit_bytes
-            n_waves = max(2, -(-2 * build_bytes // max(limit // 2, 1)))
-            return PhysicalPlan(
-                _wave_join_stream(
-                    make_op, build_batches, probe.stream,
-                    probe_keys, build_keys, n_waves, ctx,
-                ),
-                out_symbols,
+            n_waves = _spill.wave_count(
+                need, self._budget(), self.properties
             )
+            spiller = self._make_spiller()
+            build_host = device_get_async(list(build_batches))
+            build_batches.clear()
+            build_side = _spill.partition_side(
+                build_host, build_keys, n_waves, spiller, "jb"
+            )
+            del build_host
+
+            def wave_stream():
+                try:
+                    probe_host = device_get_async(list(probe.stream))
+                    probe_side = _spill.partition_side(
+                        probe_host, probe_keys, n_waves, spiller, "jp"
+                    )
+                    del probe_host
+                    yield from _spill.partition_wave_join(
+                        make_op, build_side, probe_side, n_waves, ctx,
+                        observer,
+                    )
+                finally:
+                    if spiller is not None:
+                        spiller.close()
+
+            return PhysicalPlan(wave_stream(), out_symbols)
         op = make_op()
         op.set_build(build_batches)
+        if node.kind == "full":
+            # full outer tracks build-side matched flags across the whole
+            # probe; a mid-stream revoke cannot split that state exactly,
+            # so full joins stay non-revocable (waves still cover them on
+            # the up-front over-budget path above)
+            def stream():
+                yield from op.process(probe.stream)
+                ctx.close()
+
+            return PhysicalPlan(stream(), out_symbols)
+
+        # register as REVOCABLE (HashBuilderOperator.startMemoryRevoke):
+        # under shared-pool pressure — another query reserving, or a pool
+        # limit shrunk mid-query — the escalation hook asks this build to
+        # spill its partitions and release; the probe loop notices at its
+        # next batch and finishes in waves against the spilled build
+        holder: dict = {}
+
+        def revoke_spill() -> int:
+            # runs on the REQUESTING thread under the handle lock; the
+            # owner may be mid-batch against op's device build, so only
+            # the raw build batches are copied out here — the owner drops
+            # its own device references at its next batch boundary
+            spiller = self._make_spiller()
+            k = _spill.wave_count(need, self._budget(), self.properties)
+            host = device_get_async(list(build_batches))
+            holder["side"] = _spill.partition_side(
+                host, build_keys, k, spiller, "jb"
+            )
+            holder["spiller"] = spiller
+            holder["k"] = k
+            build_batches.clear()
+            freed = ctx.reserved
+            ctx.set_bytes(0)
+            return freed
+
+        handle = _spill.REVOCABLES.register(
+            _spill.RevocableOperator("join", ctx, revoke_spill)
+        )
 
         def stream():
-            yield from op.process(probe.stream)
-            ctx.close()
+            try:
+                it = iter(probe.stream)
+                for pb in it:
+                    if handle.revoked:
+                        # build spilled by the revoke tier: drop our device
+                        # references, then this batch and the rest of the
+                        # probe finish in waves against the spilled build
+                        import itertools
+
+                        op.release_build()
+                        yield from _revoked_join_remainder(
+                            make_op, holder, probe_keys,
+                            itertools.chain([pb], it), ctx, observer,
+                        )
+                        return
+                    yield op._join_batch(pb)
+                ctx.close()
+            finally:
+                handle.finish()
+                sp = holder.get("spiller")
+                if sp is not None:
+                    sp.close()
 
         return PhysicalPlan(stream(), out_symbols)
 
@@ -533,13 +650,15 @@ class LocalExecutionPlanner:
                     sum_bound=getattr(fn, "sum_bound", None),
                 )
             )
-        budget = self.properties.get("query_max_memory_bytes")
+        budget = self._budget()
         if budget and part:
             stream = _window_wave_stream(
                 lambda: WindowOperator(part, order, specs),
                 src.stream,
                 list(part),
                 int(budget),
+                observer=self._observer(), spill_factory=self._make_spiller,
+                properties=self.properties,
             )
         else:
             # global windows (no PARTITION BY) need every row at once —
@@ -561,6 +680,8 @@ class LocalExecutionPlanner:
         op = OrderByOperator(
             self._sort_keys(src, node.orderings),
             memory_ctx=self.memory.child("sort"),
+            spill_factory=self._make_spiller,
+            observer=self._observer(),
         )
         return PhysicalPlan(op.process(src.stream), src.symbols)
 
@@ -647,230 +768,240 @@ class LocalExecutionPlanner:
         return PhysicalPlan(src.stream, node.symbols)
 
 
-def _wave_join_stream(
-    make_op, build_batches, probe_stream, probe_keys, build_keys,
-    n_waves: int, ctx,
-):
-    """k-pass partition-wave join under memory pressure (reference:
-    operator/join/SpillingJoinProcessor.java + HashBuilderOperator
-    .startMemoryRevoke:372).  Both sides are hash-partitioned on the join
-    keys into `n_waves` partitions; each wave builds only its slice of the
-    build side on device while both sides re-feed from host RAM — host
-    memory is the spill tier of a TPU engine.  Partitioning both sides by
-    the same key hash preserves exact results for inner/left/full joins:
-    every potential match pair lands in the same wave, and each row is
-    emitted by exactly one wave."""
-    import jax
-    import jax.numpy as jnp
+def _revoked_join_remainder(make_op, holder, probe_keys, probe_iter, ctx,
+                            observer):
+    """Finish a revoked join: the build already sits in spilled partitions
+    (holder, written by the revoke callback); the unprocessed remainder of
+    the probe stream partitions the same way and the join completes in
+    waves.  Probe batches emitted BEFORE the revoke were fully joined
+    against the complete build, so the split point is exact."""
+    from trino_tpu.runtime import spill as _spill
 
-    from trino_tpu.parallel.exchange import _hash_rows
-    from trino_tpu.runtime.memory import batch_bytes
-
-    # spill both sides to host RAM (device_get frees HBM references)
-    build_host = device_get_async(list(build_batches))
-    probe_host = device_get_async(list(probe_stream))
-    build_batches.clear()
-
-    def make_filter(key_channels):
-        def step(batch: Batch, wave):
-            h = _hash_rows(batch, key_channels)
-            sel = (h % jnp.uint64(n_waves)).astype(jnp.int64) == wave
-            return batch.filter(jnp.logical_and(batch.mask(), sel))
-
-        return jax.jit(step)
-
-    bf = make_filter(build_keys)
-    pf = make_filter(probe_keys)
-    compact = jax.jit(Batch.compact_device, static_argnames=("out_capacity",))
-    from trino_tpu.ops.common import next_pow2
-
-    for wave in range(n_waves):
-        w = jnp.asarray(wave, jnp.int64)
-        # compact each filtered build batch immediately so peak HBM per wave
-        # is one full batch + this wave's (small) slice, not the whole build
-        wave_build = []
-        wave_bytes = 0
-        for b in build_host:
-            fb = bf(jax.device_put(b), w)
-            n = fb.num_rows_host()
-            fb = compact(fb, out_capacity=next_pow2(max(n, 1), floor=1))
-            wave_build.append(fb)
-            wave_bytes += batch_bytes(fb)
-        ctx.set_bytes(2 * wave_bytes)
-        op = make_op()
-        op.set_build(wave_build)
-
-        def probe_feed():
-            for hb in probe_host:
-                yield pf(jax.device_put(hb), w)
-
-        yield from op.process(probe_feed())
-    ctx.close()
+    probe_host = device_get_async(list(probe_iter))
+    probe_side = _spill.partition_side(
+        probe_host, probe_keys, holder["k"], holder["spiller"], "jp"
+    )
+    del probe_host
+    yield from _spill.partition_wave_join(
+        make_op, holder["side"], probe_side, holder["k"], ctx, observer
+    )
 
 
-def _agg_wave_stream(make_op, feed, key_channels: list, budget: int):
+def _agg_wave_stream(make_op, feed, key_channels: list, budget: int,
+                     observer=None, spill_factory=None, properties=None):
     """Memory-bounded grouped aggregation: group-hash STATE waves.
 
     Reference role: HashAggregationOperator.startMemoryRevoke:449.  Input
     batches reduce to partial states immediately; when accumulated device
-    state crosses a fraction of the budget it SPILLS to host RAM (the spill
-    tier of a TPU engine — only states move, never raw input).  The final
-    merge then runs in group-hash waves over the spilled states: hashing by
-    the full group key keeps every group inside one wave, so per-wave merges
-    are exact and group-disjoint.  Under-budget queries never spill and
-    never copy: one device-side merge, identical to the unbudgeted path.
+    state crosses a fraction of the budget it SPILLS — through the
+    filesystem SPI (runtime/spill.SpillManager npz partitions) when
+    `spill_enabled`, host RAM otherwise.  The final merge then runs in
+    group-hash waves over the spilled states: hashing by the full group
+    key keeps every group inside one wave, so per-wave merges are exact
+    and group-disjoint.  Under-budget queries never spill and never copy:
+    one device-side merge, identical to the unbudgeted path.
+
+    The accumulating state is registered REVOCABLE: cross-query pressure
+    can flush it to the spill tier early instead of killing a query.
 
     Aggregates without streamable partials (percentile) fall back to
-    spooling RAW input and re-feeding each wave — the only shape that needs
-    every group row at once.
+    spooling RAW input and re-feeding each wave — the only shape that
+    needs every group row at once.
     """
-    import math
-
     import jax
 
     from trino_tpu.columnar.batch import concat_batches
-    from trino_tpu.runtime.memory import batch_bytes
+    from trino_tpu.runtime import spill as _spill
+    from trino_tpu.runtime.memory import (
+        ExceededMemoryLimitException,
+        batch_bytes,
+    )
 
+    if observer is None:
+        observer = _spill.PressureObserver()
     op = make_op()
     if not op.streaming:
-        yield from _agg_raw_wave_stream(make_op, op, feed, key_channels, budget)
+        yield from _agg_raw_wave_stream(
+            make_op, op, feed, key_channels, budget, observer,
+            spill_factory, properties,
+        )
         return
     out_mode = "merge" if op.mode in ("partial", "merge") else "final"
     spill_at = max(budget // 4, 1)
-    device_states: list[Batch] = []
-    host_states: list = []
-    dev_bytes = 0
+    spiller = None
+    spiller_made = False
+
+    def get_spiller():
+        nonlocal spiller, spiller_made
+        if not spiller_made:
+            spiller_made = True
+            spiller = spill_factory() if spill_factory is not None else None
+        return spiller
+
+    acc: list = [None]  # created on first flush (lazy SpillingAccumulator)
+    state = {"device": [], "bytes": 0}
+
+    def flush() -> int:
+        """Move accumulated device states to the spill tier; returns bytes
+        freed.  Called by the owner (over spill_at) AND by the revoke tier
+        (under the handle's reentrant lock)."""
+        with handle.lock:
+            if not state["device"]:
+                return 0
+            if acc[0] is None:
+                acc[0] = _spill.SpillingAccumulator(get_spiller(), "aggstate")
+            acc[0].push_chunk(device_get_async(list(state["device"])))
+            state["device"].clear()
+            freed = state["bytes"]
+            state["bytes"] = 0
+        if op.memory_ctx is not None:
+            op.memory_ctx.set_bytes(0)
+        return freed
+
+    handle = _spill.REVOCABLES.register(
+        _spill.RevocableOperator("aggregation", op.memory_ctx, flush)
+    )
     seen_any = False
-    for b in feed:
-        seen_any = True
-        s = op.reduce_batch(b)
-        device_states.append(s)
-        dev_bytes += batch_bytes(s)
-        if op.memory_ctx is not None:
-            op.memory_ctx.set_bytes(dev_bytes)
-        if dev_bytes > spill_at:
-            host_states.extend(device_get_async(list(device_states)))
-            device_states.clear()
-            dev_bytes = 0
+    try:
+        for b in feed:
+            seen_any = True
+            s = op.reduce_batch(b)
+            with handle.lock:
+                state["device"].append(s)
+                state["bytes"] += batch_bytes(s)
+                cur = state["bytes"]
+            over = cur > spill_at
             if op.memory_ctx is not None:
-                op.memory_ctx.set_bytes(0)
-    if not seen_any:
-        op._acc = []
-        yield op.finish()
-        if op.memory_ctx is not None:
-            op.memory_ctx.close()
-        return
-    if not host_states:
-        # under budget: plain device-side merge, no host round-trip
-        yield op._combine(
-            device_states[0]
-            if len(device_states) == 1
-            else concat_batches(device_states),
-            out_mode,
-        )
-        if op.memory_ctx is not None:
-            op.memory_ctx.close()
-        return
-    host_states.extend(device_get_async(list(device_states)))
-    device_states.clear()
-    total = sum(batch_bytes(b) for b in host_states)
-    n_waves = min(64, max(2, math.ceil(2.0 * total / budget)))
-    for wave in range(n_waves):
-        # wave selection happens HOST-side by dictionary VALUE hash
-        # (state batches carry batch-local dictionaries, so device code
-        # hashes would split one group across waves) and each part is
-        # compacted before it returns to the device — per-wave footprint
-        # is ~total/n_waves, which is what the budget bought
-        parts = [
-            jax.device_put(p)
-            for hb in host_states
-            for p in [_host_wave_slice(hb, key_channels, n_waves, wave)]
-            if p is not None
-        ]
-        if not parts:
-            continue
-        yield op._combine(
-            parts[0] if len(parts) == 1 else concat_batches(parts), out_mode
-        )
-    if op.memory_ctx is not None:
-        op.memory_ctx.close()
-
-
-def _host_wave_slice(hb: Batch, key_channels: list, n_waves: int, wave: int):
-    """Rows of a HOST batch whose group-key VALUE hash lands in `wave`,
-    compacted to a dense host batch (None when empty)."""
-    import numpy as np
-
-    from trino_tpu.parallel.serde import stable_row_hash
-
-    h = stable_row_hash(hb, key_channels)
-    keep = np.asarray(hb.mask()) & ((h % np.uint64(n_waves)) == np.uint64(wave))
-    n = int(keep.sum())
-    if n == 0:
-        return None
-    idx = np.nonzero(keep)[0]
-    cols = []
-    for c in hb.columns:
-        cols.append(
-            Column(
-                np.asarray(c.data)[idx],
-                c.type,
-                None if c.valid is None else np.asarray(c.valid)[idx],
-                c.dictionary,
-                None if c.lengths is None else np.asarray(c.lengths)[idx],
+                try:
+                    op.memory_ctx.set_bytes(cur)
+                except ExceededMemoryLimitException:
+                    over = True  # the reservation tree is the breach signal
+                with handle.lock:
+                    # a concurrent revoke may have flushed (and released)
+                    # between our read of `cur` and the set_bytes above —
+                    # re-sync so freed memory is not re-reserved; at most
+                    # one revoke can ever fire per handle, so one
+                    # correction pass closes the window
+                    resync = (
+                        state["bytes"] if state["bytes"] != cur else None
+                    )
+                if resync is not None:
+                    try:
+                        op.memory_ctx.set_bytes(resync)
+                    except ExceededMemoryLimitException:
+                        over = True
+            if over:
+                flush()
+        handle.finish()  # merge phase: no longer revocable
+        if not seen_any:
+            op._acc = []
+            yield op.finish()
+            if op.memory_ctx is not None:
+                op.memory_ctx.close()
+            return
+        if acc[0] is None:
+            # under budget: plain device-side merge, no host round-trip
+            device_states = state["device"]
+            yield op._combine(
+                device_states[0]
+                if len(device_states) == 1
+                else concat_batches(device_states),
+                out_mode,
             )
-        )
-    return Batch(cols, np.ones(n, dtype=bool))
+            if op.memory_ctx is not None:
+                op.memory_ctx.close()
+            return
+        flush()
+        total = acc[0].total_bytes
+        n_waves = _spill.wave_count(2 * total, budget, properties)
+        observer.waves("aggregation", n_waves)
+        for wave in range(n_waves):
+            # wave selection happens HOST-side by dictionary VALUE hash
+            # (state batches carry batch-local dictionaries, so device
+            # code hashes would split one group across waves) and each
+            # part is compacted before it returns to the device —
+            # per-wave footprint is ~total/n_waves, what the budget bought
+            parts = [
+                jax.device_put(p)
+                for p in acc[0].wave_parts(key_channels, n_waves, wave)
+            ]
+            if not parts:
+                continue
+            yield op._combine(
+                parts[0] if len(parts) == 1 else concat_batches(parts),
+                out_mode,
+            )
+        if op.memory_ctx is not None:
+            op.memory_ctx.close()
+    finally:
+        handle.finish()
+        if spiller is not None:
+            spiller.close()
 
 
-def _window_wave_stream(make_op, feed, key_channels: list, budget: int):
+def _window_wave_stream(make_op, feed, key_channels: list, budget: int,
+                        observer=None, spill_factory=None, properties=None):
     """Memory-bounded window execution: window functions only ever look
     within ONE partition, so hash-partitioning the input by the PARTITION BY
     keys into waves is exact — each wave materializes and sorts only its
     slice on device (reference role: the spill path of WindowOperator.java/
-    PagesIndex, reshaped as partition-disjoint waves)."""
-    import math
-
+    PagesIndex, reshaped as partition-disjoint waves).  Over-budget input
+    stages through the filesystem SPI when `spill_enabled`."""
     import jax
 
+    from trino_tpu.runtime import spill as _spill
     from trino_tpu.runtime.memory import batch_bytes
 
-    acc: list = []
+    if observer is None:
+        observer = _spill.PressureObserver()
+    acc_dev: list = []
+    store = None
+    spiller = None
     total = 0
-    over = False
-    for b in feed:
-        if over:
-            acc.append(device_get_async(b))
-        else:
-            acc.append(b)
-        total += batch_bytes(b)
-        if not over and total > budget:
-            over = True
-            acc = device_get_async(list(acc))  # device memory -> host spool
-    if not over:
-        yield from make_op().process(iter(acc))
-        return
-    n_waves = min(64, max(2, math.ceil(2.0 * total / budget)))
-    for wave in range(n_waves):
-        parts = []
-        for hb in acc:
-            p = _host_wave_slice(hb, key_channels, n_waves, wave)
-            if p is not None:
-                parts.append(p)
-        if not parts:
-            continue
-        yield from make_op().process(jax.device_put(p) for p in parts)
+    seen_dicts: set = set()
+    try:
+        for b in feed:
+            # shared dictionaries counted once across the accumulation
+            total += batch_bytes(b, _seen_dicts=seen_dicts)
+            if store is not None:
+                store.push_chunk(device_get_async([b]))
+            else:
+                acc_dev.append(b)
+                if total > budget:
+                    spiller = (
+                        spill_factory() if spill_factory is not None else None
+                    )
+                    store = _spill.SpillingAccumulator(spiller, "window")
+                    # device memory -> spill tier
+                    store.push_chunk(device_get_async(list(acc_dev)))
+                    acc_dev.clear()
+        if store is None:
+            yield from make_op().process(iter(acc_dev))
+            return
+        n_waves = _spill.wave_count(2 * total, budget, properties)
+        observer.waves("window", n_waves)
+        for wave in range(n_waves):
+            parts = store.wave_parts(key_channels, n_waves, wave)
+            if not parts:
+                continue
+            yield from make_op().process(jax.device_put(p) for p in parts)
+    finally:
+        if spiller is not None:
+            spiller.close()
 
 
-def _agg_raw_wave_stream(make_op, op, feed, key_channels: list, budget: int):
+def _agg_raw_wave_stream(make_op, op, feed, key_channels: list, budget: int,
+                         observer=None, spill_factory=None, properties=None):
     """Raw-input waves for non-streamable aggregates (percentile): spool
-    input to host once the budget is breached, then re-feed per wave."""
-    import math
-
+    input to the spill tier once the budget is breached, then re-feed per
+    wave."""
     import jax
 
+    from trino_tpu.runtime import spill as _spill
     from trino_tpu.runtime.memory import ExceededMemoryLimitException
 
+    if observer is None:
+        observer = _spill.PressureObserver()
     it = iter(feed)
     spool = []
     over = False
@@ -893,19 +1024,29 @@ def _agg_raw_wave_stream(make_op, op, feed, key_channels: list, budget: int):
     spool.extend(device_get_async(list(it)))
     frac = consumed / max(len(spool), 1)
     projected = op.state_bytes() / max(frac, 1e-3)
-    n_waves = min(64, max(2, math.ceil(2.0 * projected / budget)))
+    n_waves = _spill.wave_count(int(2 * projected), budget, properties)
     if op.memory_ctx is not None:
         op.memory_ctx.close()
     del op  # free the over-budget device state before wave 1
-    for wave in range(n_waves):
-        wop = make_op()
-        for hb in spool:
-            p = _host_wave_slice(hb, key_channels, n_waves, wave)
-            if p is not None:
+    spiller = spill_factory() if spill_factory is not None else None
+    # n_waves is known BEFORE anything is written, so the raw input
+    # partitions at write time (one file per wave, each read exactly once)
+    # — the state-wave accumulator's k-pass re-read would multiply disk
+    # I/O by k over data that is the RAW input, not compacted states
+    side = _spill.partition_side(spool, key_channels, n_waves, spiller, "aggraw")
+    spool = None
+    observer.waves("aggregation", n_waves)
+    try:
+        for wave in range(n_waves):
+            wop = make_op()
+            for p in side.load_part(wave):
                 wop.push(jax.device_put(p))
-        yield wop.finish()
-        if wop.memory_ctx is not None:
-            wop.memory_ctx.close()
+            yield wop.finish()
+            if wop.memory_ctx is not None:
+                wop.memory_ctx.close()
+    finally:
+        if spiller is not None:
+            spiller.close()
 
 
 def supports_uniform_distinct(node: "P.AggregationNode") -> bool:

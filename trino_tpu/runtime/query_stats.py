@@ -277,6 +277,13 @@ class StatsCollector:
         #: MeshProfile attached by the distributed runner so EXPLAIN ANALYZE
         #: renders the per-fragment collective/compute/transfer breakdown
         self.mesh_profile = None
+        #: local-execution pressure counters (memory_wave / spill_bytes),
+        #: bumped by runtime/spill's PressureObserver so EXPLAIN ANALYZE
+        #: shows the degradation a constrained query took
+        self.counters: dict = {}
+
+    def bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n
 
     def register(self, name: str, detail: str = "", depth: int = 0) -> OperatorStats:
         st = OperatorStats(self._next_id, name, detail, depth=depth)
@@ -324,6 +331,13 @@ class StatsCollector:
             lines.append(st.line())
         total_dev = sum(st.device_s for st in self.operators)
         lines.append(f"total device-blocked: {total_dev * 1e3:.1f}ms")
+        if self.counters:
+            lines.append(
+                "memory pressure: "
+                + " ".join(
+                    f"{k}={v}" for k, v in sorted(self.counters.items())
+                )
+            )
         if self.memory is not None:
             lines.append(
                 f"peak device memory reserved: {self.memory.peak} bytes"

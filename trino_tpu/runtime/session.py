@@ -50,6 +50,31 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             0,
         ),
         PropertyMetadata(
+            "query_max_memory",
+            "per-query device memory budget in bytes (0 = unlimited; the "
+            "reservation ceiling blocking operators check before "
+            "materializing — exceeding it degrades to partition-wave "
+            "execution with filesystem-SPI spill instead of failing; "
+            "reference: SystemSessionProperties QUERY_MAX_MEMORY)",
+            int,
+            0,
+        ),
+        PropertyMetadata(
+            "spill_enabled",
+            "spill non-resident partition-wave data host-side through the "
+            "filesystem SPI (false = waves stage in host RAM only; "
+            "reference: SystemSessionProperties SPILL_ENABLED)",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
+            "memory_wave_partitions",
+            "override the partition-wave fan-out k under memory pressure "
+            "(0 = auto: next_pow2(need / budget))",
+            int,
+            0,
+        ),
+        PropertyMetadata(
             "query_max_run_time",
             "wall-clock deadline for a whole statement in seconds; the "
             "query aborts with EXCEEDED_TIME_LIMIT at its next cooperative "

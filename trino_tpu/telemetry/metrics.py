@@ -380,7 +380,15 @@ MESH_COUNTER_NAMES = (
     "join_overflow_check",
     "join_capacity_sync",
     "join_speculative_retry",
+    "memory_wave",
+    "spill_bytes",
 )
+
+
+#: wave-capable operator vocabulary for trino_tpu_memory_waves_total,
+#: pre-registered so the compare_bench zero-when-unconstrained gate reads
+#: real zeros, not absent series
+MEMORY_WAVE_OPERATORS = ("join", "aggregation", "window", "sort")
 
 
 #: (kind, purpose) label pairs pre-registered on the per-collective byte
@@ -479,6 +487,28 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
         _PREFIX + "memory_kills_total",
         "queries killed by the low-memory killer (largest reservation "
         "reclaimed when the shared pool blocks)",
+    )
+    waves = reg.counter(
+        _PREFIX + "memory_waves_total",
+        "partition waves executed under memory pressure, by operator "
+        "(runtime/spill: an over-budget build/agg/window/sort degrades to "
+        "k hash-partition waves instead of dying; zero when unconstrained)",
+        labelnames=("operator",),
+    )
+    for op in MEMORY_WAVE_OPERATORS:
+        waves.touch(op)
+    reg.counter(
+        _PREFIX + "spill_bytes_total",
+        "bytes spilled host-side through the filesystem SPI by "
+        "partition-wave execution (the FTE SpoolManager npz format; zero "
+        "when unconstrained)",
+    )
+    reg.counter(
+        _PREFIX + "memory_revocations_total",
+        "memory revocations: a registered wave-capable operator asked to "
+        "spill and release its reservation before the low-memory killer "
+        "fires (the revoke tier of the exceed -> revoke -> wave -> kill "
+        "escalation ladder)",
     )
     reg.counter(
         _PREFIX + "breaker_trips_total",
@@ -635,6 +665,24 @@ def query_wall_histogram() -> Histogram:
 def memory_kills_counter() -> Counter:
     """Victims chosen by the LowMemoryKiller (runtime/lifecycle)."""
     return REGISTRY.counter(_PREFIX + "memory_kills_total")
+
+
+def memory_waves_counter() -> Counter:
+    """Partition waves executed under memory pressure, labeled by the
+    wave-capable operator (runtime/spill)."""
+    return REGISTRY.counter(_PREFIX + "memory_waves_total")
+
+
+def spill_bytes_counter() -> Counter:
+    """Bytes spilled through the filesystem SPI by partition-wave
+    execution (runtime/spill SpillManager)."""
+    return REGISTRY.counter(_PREFIX + "spill_bytes_total")
+
+
+def memory_revocations_counter() -> Counter:
+    """Revoke-tier activations: an operator spilled + released before the
+    killer fired (runtime/spill MemoryEscalation)."""
+    return REGISTRY.counter(_PREFIX + "memory_revocations_total")
 
 
 def breaker_trips_counter() -> Counter:
