@@ -685,6 +685,35 @@ def _run_mesh(args) -> dict:
     return {schema: {"error": None, **sec}}
 
 
+def _run_serve(args) -> dict:
+    """Concurrent-serving bench (trino_tpu/bench_serve): K clients replay
+    a TPC-H mix through the dispatcher — local lanes + the 8-worker mesh
+    (zero warm compile events, shared trace cache).  Runs in a sanitized
+    child like the mesh bench (the virtual mesh needs the device-count
+    flag before jax initializes); records the top-level `serve` section
+    tools/compare_bench.py `check_serve` gates."""
+    from _cleanenv import cpu_env
+
+    env = cpu_env(os.environ, n_virtual_devices=8)
+    timeout = float(os.environ.get("BENCH_SERVE_TIMEOUT", 900))
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "trino_tpu.bench_serve"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"serve bench timed out after {timeout:.0f}s"}
+    lines = [l for l in (r.stdout or "").splitlines() if l.startswith("{")]
+    if r.returncode != 0 or not lines:
+        tail = " | ".join((r.stderr or "").strip().splitlines()[-3:])
+        return {"error": f"serve child rc={r.returncode}: {tail}"[:500]}
+    return {"error": None, **json.loads(lines[-1])}
+
+
 def _schema_for_sf(sf: float) -> str:
     try:
         from trino_tpu.connectors.tpch.schema import SCHEMAS
@@ -751,6 +780,16 @@ def _child_main(args) -> None:
             _merge_extra(
                 {"mesh": {"run_error": f"{type(exc).__name__}: {exc}"[:500]}}
             )
+    if (
+        getattr(args, "serve", False)
+        or os.environ.get("BENCH_SERVE") == "1"
+    ):
+        try:
+            _merge_extra({"serve": {**_run_serve(args), "run_error": None}})
+        except Exception as exc:
+            _merge_extra(
+                {"serve": {"run_error": f"{type(exc).__name__}: {exc}"[:500]}}
+            )
 
 
 def _extra_child_budget(args) -> float:
@@ -779,6 +818,14 @@ def _extra_child_budget(args) -> float:
             extra += 3 * float(os.environ.get("BENCH_RESTART_TIMEOUT", 600))
         except ValueError:
             extra += 1800
+    if (
+        getattr(args, "serve", False)
+        or os.environ.get("BENCH_SERVE") == "1"
+    ):
+        try:
+            extra += float(os.environ.get("BENCH_SERVE_TIMEOUT", 900)) + 60
+        except ValueError:
+            extra += 960
     return extra
 
 
@@ -848,6 +895,13 @@ def main() -> None:
         "and Q3 (co-partitioned layouts; elision/speculative-retry "
         "counters) walls + per-fragment profile into BENCH_EXTRA.json's "
         "mesh section",
+    )
+    ap.add_argument(
+        "--serve",
+        action="store_true",
+        help="after the headline line, run the concurrent-serving bench "
+        "(K clients x TPC-H mix through the dispatcher, local lanes + "
+        "mesh) into BENCH_EXTRA.json's serve section",
     )
     ap.add_argument(
         "--tpu-timeout",

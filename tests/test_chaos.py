@@ -614,3 +614,114 @@ def test_chaos_pool_pressure_and_worker_kill_compose(local):
                 w.shutdown()
             except Exception:
                 pass
+
+
+def test_chaos_concurrent_serving_kill_and_pool_shrink(local):
+    """PR 13 acceptance composition: K=8 concurrent clients admitted
+    through weighted-fair resource groups x a worker kill at W-1 x a
+    mid-run shared-pool shrink.  Every statement either answers the local
+    oracle's rows or fails CLASSIFIED (canceled | queued-time | deadline |
+    memory | shed | loud worker failure) inside its deadline — zero
+    hangs, and ZERO cross-group memory kills (each group's escalation
+    log only ever names its own group)."""
+    from trino_tpu.runtime.dispatcher import QueryDispatcher, QueryShedError
+    from trino_tpu.runtime.lifecycle import set_memory_pool_limit
+    from trino_tpu.runtime.resource_groups import (
+        ResourceGroupConfig,
+        ResourceGroupManager,
+    )
+
+    ws = [WorkerServer(port=0).start() for _ in range(3)]
+    mh = MultiHostQueryRunner(
+        [w.url for w in ws], catalog="tpch", schema="tiny"
+    )
+    mh.properties.set("query_max_run_time", DEADLINE_S)
+    mh.properties.set("query_max_queued_time", DEADLINE_S)
+    mgr = ResourceGroupManager(
+        ResourceGroupConfig("global", hard_concurrency=2, max_queued=16)
+    )
+    mgr.add(
+        ResourceGroupConfig(
+            "a", hard_concurrency=2, max_queued=16, weight=2,
+            memory_limit_bytes=64 << 20,
+        )
+    )
+    mgr.add(
+        ResourceGroupConfig(
+            "b", hard_concurrency=2, max_queued=16, weight=1,
+            memory_limit_bytes=64 << 20,
+        )
+    )
+    mgr.add_user_rule("ua", "a")
+    mgr.add_user_rule("ub", "b")
+    dispatcher = QueryDispatcher(mh, mgr)  # multi-host: one lane
+    oracles = {sql: local.execute(sql).rows for sql in QUERIES}
+    outcomes = []
+    olock = threading.Lock()
+
+    def serve_client(i):
+        user = "ua" if i % 2 == 0 else "ub"
+        for j in range(2):
+            sql = QUERIES[(i + j) % len(QUERIES)]
+            t0 = time.monotonic()
+            try:
+                ticket = dispatcher.enqueue(user=user)
+                ticket.wait()
+                got = dispatcher.run_admitted(
+                    ticket, lambda r: r.execute(sql)
+                ).rows
+            except QueryShedError:
+                got = "shed"
+            except (QueryAbortedException, RuntimeError, OSError) as e:
+                assert str(e), "failure must carry a message"
+                got = None
+            wall = time.monotonic() - t0
+            assert wall < DEADLINE_S, f"client {i} blew its deadline"
+            with olock:
+                if got not in (None, "shed"):
+                    assert_rows_match(got, oracles[sql], ordered=False)
+                    outcomes.append("ok")
+                else:
+                    outcomes.append(got or "classified")
+
+    def chaos_monkey():
+        time.sleep(0.3)
+        ws[2].shutdown()  # worker kill: survivors re-plan at W-1
+        time.sleep(0.2)
+        set_memory_pool_limit(1 << 20)  # mid-run pool shrink
+        time.sleep(0.3)
+        set_memory_pool_limit(0)
+
+    monkey = threading.Thread(target=chaos_monkey, daemon=True)
+    try:
+        clients = [
+            threading.Thread(target=serve_client, args=(i,), daemon=True)
+            for i in range(8)
+        ]
+        monkey.start()
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join(timeout=DEADLINE_S * 3)
+            assert not t.is_alive(), "serving hung under chaos"
+        monkey.join(timeout=10)
+        assert outcomes.count("ok") >= 1, outcomes  # progress under chaos
+        # zero cross-group memory kills: every group-escalation kill (if
+        # any fired) names its OWN group — a bystander group was never
+        # shot for another group's pressure
+        from trino_tpu.runtime.lifecycle import memory_pool
+
+        root = memory_pool().root
+        for name in ("a", "b"):
+            ctx = mgr.groups[name].memory_context(root)
+            esc = ctx.on_exceeded
+            assert all(g == name for g, _victim in esc.kill_log), (
+                name, esc.kill_log
+            )
+    finally:
+        set_memory_pool_limit(0)
+        for w in ws:
+            try:
+                w.shutdown()
+            except Exception:
+                pass

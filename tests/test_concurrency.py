@@ -235,12 +235,12 @@ class A:
     def test_repo_static_order_is_acyclic(self):
         findings, edges = analyze_paths(["trino_tpu"], root=REPO_ROOT)
         assert [f for f in findings if f.rule == "lock-order-cycle"] == []
-        # the engine's one static nesting today: prewarm's engine lock
-        # wraps its state lock — assert the graph sees it, so this test
-        # would notice the extractor going blind
+        # the engine's canonical static nesting today: the dispatcher's
+        # scheduler lock wraps the resource group's admission lock
+        # (runtime/dispatcher enqueue/release) — assert the graph sees
+        # it, so this test would notice the extractor going blind
         assert any(
-            a == "PrewarmExecutor._engine_lock"
-            and b == "PrewarmExecutor._state_lock"
+            a == "QueryDispatcher._lock" and b == "QueryDispatcher.lock"
             for a, b, _ in edges
         ), sorted(set((a, b) for a, b, _ in edges))
 

@@ -539,8 +539,9 @@ def test_coordinator_prewarm_on_start_and_records(tmp_path):
 
 def test_coordinator_adopts_preattached_executor_lock(tmp_path):
     """An executor attached BEFORE the server (runner_from_etc) must adopt
-    the server's engine lock, or prewarm replays would interleave with
-    live queries on the non-thread-safe runner."""
+    the server's dispatcher admission (the system.prewarm resource group),
+    or prewarm replays would interleave with live queries on the primary
+    runner instead of queueing fairly for its lane."""
     from trino_tpu.runtime.runner import LocalQueryRunner
     from trino_tpu.server.coordinator import CoordinatorServer
 
@@ -552,9 +553,12 @@ def test_coordinator_adopts_preattached_executor_lock(tmp_path):
     srv.start()
     try:
         assert r.prewarm is pre
-        assert pre._engine_lock is srv._engine_lock
+        assert pre._admission is not None  # dispatcher admission adopted
         pre._thread.join(timeout=30)
         assert pre.state == "WARM"
+        # the replay went through the system.prewarm group, not a lock
+        stats = {s["name"]: s for s in srv.dispatcher.stats()}
+        assert stats["system.prewarm"]["total_admitted"] >= 1
     finally:
         srv.shutdown()
 

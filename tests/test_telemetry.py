@@ -396,6 +396,7 @@ def test_phase_totals_rollup():
 def _clean_extra():
     return {
         "membership": _clean_membership(),
+        "serve": _clean_serve(),
         "mesh": {
             "sf1": {
                 "error": None,
@@ -424,6 +425,21 @@ def _clean_pressure():
         "pool_limit_bytes": 1 << 20,
         "local": {"rows_match": True, "waves": 4, "spill_bytes": 100},
         "mesh": {"rows_match": True, "waves": 4, "spill_bytes": 100},
+    }
+
+
+def _clean_serve():
+    phase = {
+        "clients": 8, "queries_total": 24, "qps": 20.0,
+        "p50_s": 0.3, "p95_s": 0.4, "p99_s": 0.5,
+        "shed_total": 0, "rows_match": True,
+    }
+    return {
+        "run_error": None,
+        "error": None,
+        "schema": "tiny",
+        "local": dict(phase),
+        "mesh": {**phase, "warm_compile_events": 0},
     }
 
 
@@ -468,6 +484,34 @@ def test_compare_bench_pressure_gate():
     violations, skipped = check_extra(missing)
     assert violations == []
     assert any("no pressure section" in s for s in skipped)
+
+
+def test_compare_bench_serve_gate():
+    """The PR 13 serving gate: concurrent statements must answer the
+    serial oracle (or shed), and warm mesh serving must compile NOTHING
+    above the warm-up watermark (shared trace cache)."""
+    check_extra = _compare_bench().check_extra
+    bad = _clean_extra()
+    bad["serve"]["local"]["rows_match"] = False
+    bad["serve"]["mesh"]["warm_compile_events"] = 2
+    bad["serve"]["mesh"]["clients"] = 1
+    violations, _ = check_extra(bad)
+    text = "\n".join(violations)
+    assert "serve.local.rows_match" in text
+    assert "serve.mesh.warm_compile_events" in text
+    assert "serve.mesh.clients" in text
+    # a missing serve section is reported as skipped, not violated
+    missing = _clean_extra()
+    del missing["serve"]
+    violations, skipped = check_extra(missing)
+    assert violations == []
+    assert any("no serve section" in s for s in skipped)
+    # a serve bench that could not run is skipped too
+    errored = _clean_extra()
+    errored["serve"] = {"run_error": "boom"}
+    violations, skipped = check_extra(errored)
+    assert violations == []
+    assert any("serve: bench errored" in s for s in skipped)
 
 
 def test_compare_bench_flags_drift():

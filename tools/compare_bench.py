@@ -263,6 +263,60 @@ def check_membership(sec: dict) -> tuple:
     return violations, skipped
 
 
+#: serve-section per-phase keys (bench.py --serve / trino_tpu/bench_serve):
+#: the concurrency headline is only evidence with percentiles, throughput,
+#: AND the correctness bit all present
+SERVE_KEYS = (
+    "clients", "queries_total", "qps", "p50_s", "p95_s", "p99_s",
+    "shed_total", "rows_match",
+)
+
+
+def check_serve(sec: dict) -> list:
+    """Violations over the top-level `serve` section: K >= 2 concurrent
+    clients on local lanes AND the mesh, every statement answering the
+    serial oracle (or shed — never wrong, never hung), and warm mesh
+    serving recording ZERO compile events above the warm-up watermark
+    (shared trace cache => near-zero marginal compile cost per client)."""
+    violations = []
+    for phase in ("local", "mesh"):
+        p = sec.get(phase)
+        if not isinstance(p, dict):
+            violations.append(
+                f"serve.{phase} missing (re-run bench.py --serve)"
+            )
+            continue
+        missing = [k for k in SERVE_KEYS if k not in p]
+        if missing:
+            violations.append(f"serve.{phase} missing {missing}")
+            continue
+        if p.get("rows_match") is not True:
+            violations.append(
+                f"serve.{phase}.rows_match = {p.get('rows_match')} "
+                f"(expected true: every concurrently served statement "
+                f"must answer the serial oracle or be shed; errors: "
+                f"{p.get('errors')})"
+            )
+        if p.get("clients", 0) < 2:
+            violations.append(
+                f"serve.{phase}.clients = {p.get('clients')} (expected "
+                ">= 2: a single client proves nothing about serving)"
+            )
+        if not p.get("qps", 0) > 0:
+            violations.append(
+                f"serve.{phase}.qps = {p.get('qps')} (expected > 0)"
+            )
+    mesh = sec.get("mesh")
+    if isinstance(mesh, dict) and mesh.get("warm_compile_events", 1) != 0:
+        violations.append(
+            f"serve.mesh.warm_compile_events = "
+            f"{mesh.get('warm_compile_events')} (expected 0: warm "
+            "concurrent serving must share the single warmed trace-cache "
+            "key set and compile nothing)"
+        )
+    return violations
+
+
 def _dig(d: dict, path: tuple):
     cur = d
     for p in path:
@@ -284,6 +338,19 @@ def check_extra(extra: dict) -> tuple:
     else:
         skipped.append(
             "no membership section recorded (run tools/membership_bench.py)"
+        )
+    serve = extra.get("serve")
+    if isinstance(serve, dict):
+        if serve.get("run_error") or serve.get("error"):
+            skipped.append(
+                "serve: bench errored: "
+                f"{serve.get('run_error') or serve.get('error')}"
+            )
+        else:
+            violations.extend(check_serve(serve))
+    else:
+        skipped.append(
+            "no serve section recorded (run bench.py --serve)"
         )
     mesh = extra.get("mesh")
     if not isinstance(mesh, dict):

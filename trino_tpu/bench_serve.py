@@ -1,0 +1,194 @@
+"""Concurrent-serving bench probe (the BENCH_EXTRA `serve` section, gated
+by tools/compare_bench.py `check_serve`).
+
+The serving contract under measurement: K concurrent clients replaying a
+TPC-H mix through the dispatcher (runtime/dispatcher) must
+
+  * all answer the serial oracle's rows (or be counted as errors — the
+    gate fails on any),
+  * record latency percentiles and queries/sec (the `serve` headline),
+  * and, on the MESH path, compile NOTHING once warm: the whole mix is
+    traced by one serial warm-up pass, and concurrent serving afterwards
+    shares that one trace-cache key set — `warm_compile_events == 0` is
+    the shared-trace-cache contract (near-zero marginal compile cost per
+    added client), asserted through the compile observatory.
+
+Run standalone (prints one JSON line):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m trino_tpu.bench_serve
+
+or through `bench.py --serve`, which runs it in a sanitized child and
+merges the result into BENCH_EXTRA.json's top-level `serve` section.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+#: the TPC-H mix concurrent clients replay (aggregation, scan-filter,
+#: join — the three fragment shapes a dashboard workload cycles through)
+MIX_QUERIES = (1, 6, 3)
+
+
+def _percentile(walls: list, p: float):
+    if not walls:
+        return None
+    i = min(len(walls) - 1, int(p * len(walls)))
+    return round(walls[i], 4)
+
+
+def _serve_once(dispatcher, mix: list, oracle: dict,
+                clients: int, rounds: int) -> dict:
+    """Drive K client threads through the dispatcher; returns the stats
+    block (walls, qps, correctness, shed/queue counters)."""
+    from trino_tpu.runtime.dispatcher import QueryShedError
+
+    walls: list = []
+    errors: list = []
+    mismatches = [0]
+    shed = [0]
+    lock = threading.Lock()
+
+    def client(i: int) -> None:
+        for j in range(rounds):
+            sql = mix[(i + j) % len(mix)]
+            t0 = time.perf_counter()
+            try:
+                ticket = dispatcher.enqueue()
+                ticket.wait()
+                res = dispatcher.run_admitted(
+                    ticket, lambda r: r.execute(sql)
+                )
+            except QueryShedError:
+                with lock:
+                    shed[0] += 1
+                continue
+            except Exception as e:  # classified failures fail the gate
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+                continue
+            wall = time.perf_counter() - t0
+            ok = sorted(map(str, res.rows)) == oracle[sql]
+            with lock:
+                walls.append(wall)
+                if not ok:
+                    mismatches[0] += 1
+
+    t_start = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True,
+                         name=f"serve-client-{i}")
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    elapsed = time.perf_counter() - t_start
+    hung = sum(1 for t in threads if t.is_alive())
+    walls.sort()
+    groups = {s["name"]: s for s in dispatcher.stats()}
+    return {
+        "clients": clients,
+        "rounds": rounds,
+        "lanes": dispatcher.lanes,
+        "queries_total": len(walls),
+        "qps": round(len(walls) / max(elapsed, 1e-9), 3),
+        "wall_s": round(elapsed, 4),
+        "p50_s": _percentile(walls, 0.50),
+        "p95_s": _percentile(walls, 0.95),
+        "p99_s": _percentile(walls, 0.99),
+        "shed_total": shed[0],
+        "queued_total": groups.get("global", {}).get(
+            "dispatcher_queued_total", 0
+        ),
+        "errors": errors[:5],
+        "rows_match": (
+            hung == 0
+            and mismatches[0] == 0
+            and not errors
+            and len(walls) + shed[0] == clients * rounds
+        ),
+    }
+
+
+def _mix_and_oracle(runner) -> tuple:
+    from trino_tpu.connectors.tpch.queries import QUERIES
+
+    mix = [QUERIES[q] for q in MIX_QUERIES]
+    oracle = {
+        sql: sorted(map(str, runner.execute(sql).rows)) for sql in mix
+    }
+    return mix, oracle
+
+
+def run_serve(schema: str = "tiny", clients: int = 8, rounds: int = 3,
+              lanes: int = 4) -> dict:
+    """The `serve` section: a local concurrent phase (host planning /
+    serialization overlap across engine lanes) and a mesh phase (one
+    execution lane over the 8-worker device mesh, concurrent admission,
+    zero-compile warm serving asserted through the observatory)."""
+    from trino_tpu.parallel import DistributedQueryRunner
+    from trino_tpu.runtime.dispatcher import QueryDispatcher
+    from trino_tpu.runtime.resource_groups import (
+        ResourceGroupConfig,
+        ResourceGroupManager,
+    )
+    from trino_tpu.runtime.runner import LocalQueryRunner
+    from trino_tpu.telemetry.compile_events import OBSERVATORY
+
+    out: dict = {"schema": schema}
+
+    # -- local lanes phase ----------------------------------------------------
+    local = LocalQueryRunner(catalog="tpch", schema=schema, target_splits=8)
+    mix, oracle = _mix_and_oracle(local)  # serial warm-up + oracle
+    mgr = ResourceGroupManager(
+        ResourceGroupConfig(
+            "global", hard_concurrency=lanes,
+            max_queued=max(16, 2 * clients),
+        )
+    )
+    d = QueryDispatcher(local, mgr, lanes=lanes)
+    out["local"] = _serve_once(d, mix, oracle, clients, rounds)
+
+    # -- mesh phase (shared trace cache => zero warm compile events) -----------
+    dist = DistributedQueryRunner(n_workers=8, schema=schema)
+    mix, oracle = _mix_and_oracle(dist)  # traces every key the mix needs
+    # settle speculative-join capacity learning before the watermark: a
+    # capacity-learning statement legitimately compiles its fused expand
+    # once more on its NEXT run (Q3's key set closes on run 2 — PR 6)
+    from trino_tpu.runtime.prewarm import replay_statements
+
+    replay_statements(dist, mix)
+    watermark = OBSERVATORY.mark()
+    mgr_m = ResourceGroupManager(
+        ResourceGroupConfig(
+            "global", hard_concurrency=1, max_queued=max(16, 2 * clients)
+        )
+    )
+    dm = QueryDispatcher(dist, mgr_m, lanes=1)  # mesh runner: one lane
+    mesh = _serve_once(dm, mix, oracle, clients, rounds)
+    mesh["warm_compile_events"] = OBSERVATORY.mark() - watermark
+    out["mesh"] = mesh
+    return out
+
+
+def main() -> None:
+    import json
+    import os
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    schema = os.environ.get("BENCH_SERVE_SCHEMA", "tiny")
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    rounds = int(os.environ.get("BENCH_SERVE_ROUNDS", 3))
+    print(json.dumps(run_serve(schema=schema, clients=clients,
+                               rounds=rounds)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -323,6 +323,34 @@ class PrewarmConfig(ConfigSection):
 
 
 @dataclass
+class DispatcherConfig(ConfigSection):
+    """Concurrent query dispatcher (runtime/dispatcher.QueryDispatcher):
+    admission control, weighted-fair resource groups, load shedding."""
+
+    lanes: int = knob(
+        4, "dispatcher.lanes",
+        "engine lanes (concurrent query executions) the dispatcher "
+        "interleaves onto the device; runners that cannot be cloned "
+        "(multi-host) are clamped to 1",
+    )
+    retry_after_s: float = knob(
+        1.0, "dispatcher.retry-after",
+        "Retry-After seconds a shed statement (HTTP 429: resource-group "
+        "queue full) advertises to clients",
+    )
+    drain_wait_s: float = knob(
+        30.0, "dispatcher.drain-wait",
+        "seconds a dispatcher drain waits for running queries before "
+        "force-killing them through their lifecycle tokens",
+    )
+    drain_grace_s: float = knob(
+        5.0, "dispatcher.drain-grace",
+        "seconds a drain waits AFTER force-kill for the canceled queries "
+        "to reach their next cooperative check and release their lanes",
+    )
+
+
+@dataclass
 class MemoryConfig(ConfigSection):
     """Shared-pool memory knobs (runtime/lifecycle LowMemoryKiller)."""
 
@@ -349,6 +377,7 @@ class ClusterConfig:
     remote: RemoteConfig = field(default_factory=RemoteConfig)
     worker: WorkerConfig = field(default_factory=WorkerConfig)
     coordinator: CoordinatorConfig = field(default_factory=CoordinatorConfig)
+    dispatcher: DispatcherConfig = field(default_factory=DispatcherConfig)
     memory: MemoryConfig = field(default_factory=MemoryConfig)
     compile_cache: CompileCacheConfig = field(
         default_factory=CompileCacheConfig
@@ -390,6 +419,7 @@ def load_cluster_config(props: Optional[dict] = None, env=None) -> ClusterConfig
         remote=RemoteConfig.from_properties(props, env),
         worker=WorkerConfig.from_properties(props, env),
         coordinator=CoordinatorConfig.from_properties(props, env),
+        dispatcher=DispatcherConfig.from_properties(props, env),
         memory=MemoryConfig.from_properties(props, env),
         compile_cache=CompileCacheConfig.from_properties(props, env),
         prewarm=PrewarmConfig.from_properties(props, env),

@@ -132,6 +132,19 @@ _TABLES = {
         # RUNNING | WARM | UNCLOSED | FAILED; NULL = no executor attached)
         ("prewarm", T.VARCHAR),
     ],
+    "resource_groups": [
+        ("name", T.VARCHAR),
+        ("weight", T.BIGINT),
+        ("max_concurrency", T.BIGINT),
+        ("max_queued", T.BIGINT),
+        ("memory_limit_bytes", T.BIGINT),
+        ("memory_reserved_bytes", T.BIGINT),
+        ("running", T.BIGINT),
+        ("queued", T.BIGINT),
+        ("total_admitted", T.BIGINT),
+        ("total_queued", T.BIGINT),
+        ("shed", T.BIGINT),
+    ],
     "session_properties": [
         ("name", T.VARCHAR),
         ("value", T.VARCHAR),
@@ -289,6 +302,28 @@ class SystemConnector(Connector):
             return [
                 (str(d.id), "ACTIVE", None, None, pstate)
                 for d in jax.devices()
+            ]
+        if table == "resource_groups":
+            # live admission state: the dispatcher when attached (serving
+            # coordinator), else any standalone resource-group manager the
+            # runner carries; an embedded runner with neither has no rows
+            d = getattr(r, "dispatcher", None)
+            stats = (
+                d.stats()
+                if d is not None
+                else getattr(
+                    getattr(r, "resource_groups", None), "stats", lambda: []
+                )()
+            )
+            return [
+                (
+                    s["name"], s.get("weight", 1), s["hard_concurrency"],
+                    s.get("max_queued"), s.get("memory_limit_bytes", 0),
+                    s.get("memory_reserved_bytes", 0), s["running"],
+                    s["queued"], s["total_admitted"], s["total_queued"],
+                    s.get("shed_total", 0),
+                )
+                for s in stats
             ]
         if table == "session_properties":
             return [

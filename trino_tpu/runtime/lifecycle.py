@@ -52,6 +52,15 @@ class QueryDeadlineExceeded(QueryAbortedException):
     error_code = "EXCEEDED_TIME_LIMIT"
 
 
+class QueryQueuedTimeExceeded(QueryAbortedException):
+    """query_max_queued_time expired while the query waited for admission
+    (reference: QueryTracker.enforceTimeLimits' queued-time sweep /
+    EXCEEDED_QUEUED_TIME_LIMIT).  Raised by the dispatcher's admission
+    wait, BEFORE the query ever occupies an engine lane."""
+
+    error_code = "EXCEEDED_QUEUED_TIME_LIMIT"
+
+
 class QueryKilledException(QueryAbortedException):
     """Chosen as the low-memory killer's victim
     (INSUFFICIENT_RESOURCES / CLUSTER_OUT_OF_MEMORY)."""
@@ -143,6 +152,12 @@ class QueryContext:
         #: query-level MemoryContexts reserved on the shared pool; released
         #: when the statement finishes (success OR failure)
         self._memory: list = []
+        #: live SpillManagers owned by this query (runtime/spill registers
+        #: them at construction): a query killed or canceled mid-wave must
+        #: release its spill partitions through the filesystem SPI NOW,
+        #: not when the abandoned wave generator happens to be GC'd or the
+        #: hours-scale orphan sweep runs
+        self._spills: list = []
 
     # -- state machine --------------------------------------------------------
 
@@ -315,6 +330,31 @@ class QueryContext:
             except Exception:
                 pass
 
+    # -- spill ----------------------------------------------------------------
+
+    def register_spill(self, spiller) -> None:
+        """Track a live SpillManager so aborts delete its partitions."""
+        with self._lock:
+            self._spills.append(spiller)
+
+    def unregister_spill(self, spiller) -> None:
+        with self._lock:
+            if spiller in self._spills:
+                self._spills.remove(spiller)
+
+    def release_spills(self) -> None:
+        """Close every still-open SpillManager (statement end, success OR
+        abort): partitions delete through the filesystem SPI
+        (`delete_recursive` for owned spill dirs).  Close is idempotent,
+        so a wave loop's own finally running later is harmless."""
+        with self._lock:
+            spills, self._spills = self._spills, []
+        for s in spills:
+            try:
+                s.close()
+            except Exception:
+                pass
+
 
 # -- current-query contextvar -------------------------------------------------
 
@@ -372,6 +412,57 @@ def register_task(client) -> None:
     ctx = _CURRENT.get()
     if ctx is not None:
         ctx.register_task(client)
+
+
+def register_spill(spiller) -> None:
+    """Attach a SpillManager to the executing query/task (no-op without
+    one): its partitions are released at statement end even when the wave
+    generator that owns it is abandoned mid-stream by an abort."""
+    ctx = _CURRENT.get()
+    if ctx is not None:
+        ctx.register_spill(spiller)
+
+
+# -- dispatcher admission context ---------------------------------------------
+
+#: the resource-group memory sub-pool the executing query was admitted
+#: under (runtime/dispatcher sets it around each admitted run): when set,
+#: query_memory_context parents query reservations under the GROUP node so
+#: the group's memory_limit_bytes bounds them
+_GROUP_MEMORY: "contextvars.ContextVar" = contextvars.ContextVar(
+    "trino_tpu_group_memory", default=None
+)
+
+#: (group name, queued seconds) of the executing query's admission — the
+#: tracer's queue span and EXPLAIN ANALYZE read it
+_ADMISSION: "contextvars.ContextVar" = contextvars.ContextVar(
+    "trino_tpu_admission", default=None
+)
+
+
+def set_group_memory(ctx):
+    return _GROUP_MEMORY.set(ctx)
+
+
+def reset_group_memory(token) -> None:
+    _GROUP_MEMORY.reset(token)
+
+
+def current_group_memory():
+    return _GROUP_MEMORY.get()
+
+
+def set_admission_info(info):
+    """info = (group name, queued seconds)."""
+    return _ADMISSION.set(info)
+
+
+def reset_admission_info(token) -> None:
+    _ADMISSION.reset(token)
+
+
+def current_admission():
+    return _ADMISSION.get()
 
 
 # -- tracker ------------------------------------------------------------------
@@ -511,13 +602,32 @@ def query_memory_context(limit_bytes: int = 0):
     """Per-query memory context for the local execution planner: on the
     SHARED pool (killer-visible, released by the runner at statement end)
     when a query is executing, else a private throwaway pool (direct
-    planner construction in tests / worker tasks)."""
+    planner construction in tests / worker tasks).
+
+    When the query was admitted through a resource group with a memory
+    limit (dispatcher sets the group sub-pool contextvar), the query node
+    parents under the GROUP node: the group limit bounds the reservation
+    (spill.effective_budget sees it on the ancestor walk, so waves plan
+    against it) and a breach escalates within the group only.  The node
+    registers as a victim candidate on BOTH the group and the pool root —
+    group-limit escalation is group-scoped, cluster pressure still sees
+    every query."""
     ctx = current_query()
     if ctx is None:
         from trino_tpu.runtime.memory import MemoryPool
 
         return MemoryPool().query_context("query", limit_bytes)
-    mem = memory_pool().query_context(ctx.query_id, limit_bytes)
+    pool = memory_pool()
+    group_ctx = current_group_memory()
+    if group_ctx is None:
+        mem = pool.query_context(ctx.query_id, limit_bytes)
+    else:
+        mem = group_ctx.child(f"query:{ctx.query_id}")
+        mem.limit_bytes = limit_bytes
+        mem.is_query_root = True
+        with pool.root._lock:
+            group_ctx.query_children.append(mem)
+            pool.root.query_children.append(mem)
     mem.owner = ctx
     ctx.attach_memory(mem)
     return mem

@@ -113,6 +113,11 @@ class MemoryContext:
         self.on_exceeded = None
         #: query roots registered on a pool root (killer victim candidates)
         self.query_children: list = []
+        #: True for per-query root nodes (set by MemoryPool.query_context /
+        #: lifecycle.query_memory_context): with resource-group sub-pools
+        #: between the pool root and the query layer, depth no longer
+        #: identifies the query node — the flag does
+        self.is_query_root = False
         #: lifecycle QueryContext for query roots (killed victims abort
         #: through it at their next cooperative check)
         self.owner = None
@@ -124,9 +129,16 @@ class MemoryContext:
         return MemoryContext(self, name)
 
     def query_root(self) -> "MemoryContext":
-        """The query-level ancestor of this node (self when directly under
-        the pool root, or detached)."""
+        """The query-level ancestor of this node: the nearest ancestor
+        (or self) flagged `is_query_root`, falling back to the old
+        depth-based rule (self when directly under the pool root, or
+        detached) for trees built without the flag."""
         with self._lock:
+            node = self
+            while node is not None:
+                if node.is_query_root:
+                    return node
+                node = node.parent
             node = self
             while node.parent is not None and node.parent.parent is not None:
                 node = node.parent
@@ -208,13 +220,14 @@ class MemoryContext:
         from the tree, so late operator close() calls from a dying query can
         no longer corrupt the shared pool."""
         with self._lock:
-            root = self
-            while root.parent is not None:
-                root = root.parent
-            if self in root.query_children:
-                root.query_children.remove(self)
             node, delta = self.parent, -self.reserved
             while node is not None:
+                # a query root may be registered on BOTH its resource
+                # group's sub-pool and the shared pool root — deregister
+                # from every ancestor so neither escalation tier can pick
+                # a detached victim
+                if self in node.query_children:
+                    node.query_children.remove(self)
                 node.reserved += delta
                 node = node.parent
             self.reserved = 0
@@ -230,6 +243,7 @@ class MemoryPool:
     def query_context(self, query_id: str, limit_bytes: int = 0) -> MemoryContext:
         ctx = self.root.child(f"query:{query_id}")
         ctx.limit_bytes = limit_bytes
+        ctx.is_query_root = True
         with self.root._lock:
             self.root.query_children.append(ctx)
         return ctx

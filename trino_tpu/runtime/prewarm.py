@@ -238,6 +238,11 @@ class PrewarmExecutor:
         #: engine lock so prewarm never interleaves with a statement on the
         #: shared (not concurrency-safe) runner
         self._engine_lock = lock or threading.Lock()
+        #: dispatcher-mode admission (use_admission): a factory returning a
+        #: context manager that admits the replay through the weight-capped
+        #: system.prewarm resource group onto the primary engine lane —
+        #: replays become fair queue participants instead of lock holders
+        self._admission = None
         self._state_lock = threading.Lock()
         self.state = "IDLE"
         #: observatory count at closure (None until a replay completed)
@@ -263,6 +268,23 @@ class PrewarmExecutor:
         first replay — an in-flight replay keeps the lock it started
         with."""
         self._engine_lock = lock
+
+    def use_admission(self, factory) -> None:
+        """Adopt a dispatcher admission (CoordinatorServer passes
+        `dispatcher.system_admission`): replays serialize with live
+        queries by admitting through the system.prewarm resource group
+        instead of holding a lock — a post-grow replay waits its fair
+        turn and other engine lanes keep serving users meanwhile.
+        Supersedes use_lock when set."""
+        self._admission = factory
+
+    def _serialized(self):
+        """The context manager one replay runs under (admission when a
+        dispatcher adopted us, the engine lock otherwise)."""
+        return (
+            self._admission() if self._admission is not None
+            else self._engine_lock
+        )
 
     # -- recording (the serving-path manifest source) -------------------------
 
@@ -392,11 +414,12 @@ class PrewarmExecutor:
                 outcome = "empty"
                 self._set_state("IDLE")
                 return
-            with self._engine_lock:
+            with self._serialized():
                 n = replay_statements(self.runner, stmts)
                 prewarm_statements_counter().inc(n)
+                wm = OBSERVATORY.mark()
                 with self._state_lock:
-                    self.watermark = OBSERVATORY.mark()
+                    self.watermark = wm
                 if self.verify:
                     # closure is MEASURED: one more replay must record zero
                     # compile events above the watermark (capacity learning
@@ -406,13 +429,12 @@ class PrewarmExecutor:
                             self.runner, stmts, max_capacity_rounds=0
                         )
                     )
-                    above = OBSERVATORY.mark() - self.watermark
+                    above = OBSERVATORY.mark() - wm
                     with self._state_lock:
                         self.verify_events = above
                     if above:
                         leaks = sorted(
-                            {e.step for e in OBSERVATORY.events_above(
-                                self.watermark)}
+                            {e.step for e in OBSERVATORY.events_above(wm)}
                         )
                         log.warning(
                             "prewarm replay is not closed: %d compile "

@@ -114,6 +114,43 @@ class LocalQueryRunner:
         #: peak device-memory reservation of the last local execution
         self._last_peak_memory = 0
 
+    def clone_for_dispatch(self) -> "Optional[LocalQueryRunner]":
+        """An engine-lane clone for the concurrent dispatcher
+        (runtime/dispatcher.QueryDispatcher): shares everything whose
+        identity matters across lanes — catalogs (and through them the
+        system connector bound to THIS runner), the query tracker and id
+        counter (DELETE-cancel and unique ids resolve process-wide), the
+        event pipeline + query history (one system.runtime.queries), the
+        session-property store (SET SESSION keeps its engine-wide
+        semantics), views/prepared/grants/access control, and the span
+        ring — while per-statement state (tracer, last_trace, peak memory,
+        mesh profile, user) stays lane-private so host-side planning and
+        result serialization overlap safely.  Subclasses (distributed /
+        multi-host runners) return None: their worker management cannot be
+        cloned, so the dispatcher degrades to one lane."""
+        if type(self) is not LocalQueryRunner:
+            return None
+        lane = LocalQueryRunner(
+            self.catalogs, self.session.catalog, self.session.schema
+        )
+        lane.session = self.session
+        lane.properties = self.properties
+        # ONE transaction state across lanes: the HTTP protocol has no
+        # session affinity, so a BEGIN landing on lane 3 and its COMMIT on
+        # lane 2 must see the same TransactionManager (exactly the single
+        # shared runner's pre-dispatcher semantics)
+        lane.transactions = self.transactions
+        lane.events = self.events
+        lane.query_history = self.query_history
+        lane.query_tracker = self.query_tracker
+        lane._query_ids = self._query_ids
+        lane.views = self.views
+        lane.prepared = self.prepared
+        lane.grants = self.grants
+        lane.access_control = self.access_control
+        lane.traces = self.traces
+        return lane
+
     @property
     def in_transaction(self) -> bool:
         return self.transactions.active
@@ -218,6 +255,7 @@ class LocalQueryRunner:
         try:
             ctx.begin()
             with tracer.span("query", query_id=qid, sql=sql[:200]):
+                self._record_queue_span(tracer)
                 result = execute_with_retry(
                     lambda: m(stmt), self.properties.get("retry_policy")
                 )
@@ -242,6 +280,7 @@ class LocalQueryRunner:
             raise
         finally:
             lifecycle.reset_current(token)
+            ctx.release_spills()  # aborted waves must not leak npz files
             ctx.release_memory()  # shared-pool reservations end with us
             self.query_tracker.remove(ctx)
         end = _time.time()
@@ -257,6 +296,26 @@ class LocalQueryRunner:
             )
         )
         return result
+
+    def _record_queue_span(self, tracer) -> None:
+        """When this statement came through the dispatcher's admission
+        queue, record its wait as a `queued` span under the query root so
+        the trace shows admission latency next to execution (reference:
+        the DispatchManager queued-state span)."""
+        if not tracer.enabled:
+            return
+        from trino_tpu.runtime.lifecycle import current_admission
+        from trino_tpu.telemetry.spans import now as _now
+
+        adm = current_admission()
+        if adm is None:
+            return
+        group, queued_s = adm
+        end = _now()
+        tracer.record(
+            "queued", end - max(0.0, queued_s), end,
+            {"group": group, "queued_s": round(queued_s, 6)},
+        )
 
     def _finish_trace(self, qid: str, tracer, prev_tracer) -> None:
         """Export the finished query's spans (Chrome JSON + the flattened
@@ -439,19 +498,34 @@ class LocalQueryRunner:
 
     def _execute_plan(self, plan, stats=None) -> MaterializedResult:
         """Run an already-planned query in THIS process (also the multihost
-        runner's path for coordinator-resident system-catalog queries)."""
+        runner's path for coordinator-resident system-catalog queries).
+
+        Concurrent serving: each device step — pipeline construction
+        (which drains blocking builds) and every batch pull — runs under
+        the process-wide `device_slice()` gate, so concurrent engine lanes
+        interleave device work at fragment/batch boundaries (feed/step/
+        drain, no preemption) while row serialization below stays outside
+        the gate and overlaps other lanes' device time."""
+        from trino_tpu.runtime.dispatcher import device_slice
         from trino_tpu.runtime.lifecycle import check_current
 
         with self._tracer.span("execute"):
-            lp = LocalExecutionPlanner(
-                self.catalogs,
-                target_splits=self.target_splits,
-                stats=stats,
-                properties=self.properties,
-            )
-            physical = lp.plan(plan)
+            with device_slice():
+                lp = LocalExecutionPlanner(
+                    self.catalogs,
+                    target_splits=self.target_splits,
+                    stats=stats,
+                    properties=self.properties,
+                )
+                physical = lp.plan(plan)
             rows = []
-            for batch in physical.stream:
+            it = iter(physical.stream)
+            done = object()
+            while True:
+                with device_slice():
+                    batch = next(it, done)
+                if batch is done:
+                    break
                 check_current()  # cancel/deadline between result batches
                 rows.extend(tuple(r) for r in batch.to_pylist())
             self._last_peak_memory = lp.memory.peak

@@ -90,6 +90,15 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             0.0,
         ),
         PropertyMetadata(
+            "query_max_queued_time",
+            "wall-clock bound on admission-queue wait in seconds; a query "
+            "still queued past it fails with EXCEEDED_QUEUED_TIME_LIMIT "
+            "without ever occupying an engine lane (0 = unbounded; "
+            "reference: QueryTracker's queued-time sweep)",
+            float,
+            0.0,
+        ),
+        PropertyMetadata(
             "retry_policy",
             "NONE | QUERY (re-execute the query) | TASK (per-stage retry "
             "with spooled intermediates)",

@@ -168,7 +168,16 @@ class SpillManager:
         self._meta: dict = {}
         self._seq: dict = {}
         self.bytes_spilled = 0
+        self._closed = False
         self.observer = observer if observer is not None else PressureObserver()
+        # abort hygiene: a query killed or canceled mid-wave abandons its
+        # wave generator, whose finally-close only runs at GC — register
+        # with the owning query's lifecycle so the statement-end path
+        # (runner.execute / worker task finally) deletes our partitions
+        # through the filesystem SPI immediately
+        from trino_tpu.runtime.lifecycle import register_spill
+
+        register_spill(self)
 
     def _fid(self, tag: str, part: int) -> int:
         key = (tag, part)
@@ -216,6 +225,17 @@ class SpillManager:
         return out if out is not None else []
 
     def close(self) -> None:
+        # idempotent: the abort path (lifecycle.release_spills) and the
+        # wave loop's own finally may both close — a double delete of a
+        # tempdir-owned spool would raise on the second fs.list
+        if self._closed:
+            return
+        self._closed = True
+        from trino_tpu.runtime.lifecycle import current_query
+
+        ctx = current_query()
+        if ctx is not None:
+            ctx.unregister_spill(self)
         # a CONFIGURED spill dir is shared: the spool only removes
         # directories it created, and the orphan sweep is an hours-scale
         # backstop — delete our own partition files (we know every

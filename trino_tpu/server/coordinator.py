@@ -55,16 +55,23 @@ class _Query:
         #: runtime lifecycle handle, attached the moment the engine creates
         #: it (LocalQueryRunner._query_context_cb); DELETE resolves here
         self.lifecycle = None
+        #: dispatcher admission ticket (runtime/dispatcher): DELETE on a
+        #: still-queued query dequeues it here, without ever acquiring an
+        #: admission slot or engine time
+        self.ticket = None
         #: cancel arrived before execution started (cancel-while-queued)
         self.cancel_requested = False
 
     def cancel(self) -> None:
         """DELETE /v1/query/{id}: a REAL cancel — the running statement
         aborts at its next cooperative check and fans the cancel out to its
-        remote tasks; a queued one aborts before it starts."""
+        remote tasks; a queued one dequeues before it starts."""
         with self._lock:
             self.cancel_requested = True
             ctx = self.lifecycle
+            ticket = self.ticket
+        if ticket is not None:
+            ticket.cancel()
         if ctx is not None:
             ctx.cancel("canceled via DELETE /v1/query")
 
@@ -74,6 +81,13 @@ class _Query:
             pre = self.cancel_requested
         if pre:
             ctx.cancel("canceled via DELETE /v1/query")
+
+    def _attach_ticket(self, ticket) -> None:
+        with self._lock:
+            self.ticket = ticket
+            pre = self.cancel_requested
+        if pre:
+            ticket.cancel()
 
     def run(self, runner) -> None:
         from trino_tpu.runtime.lifecycle import QueryCanceledException
@@ -128,7 +142,10 @@ class CoordinatorServer:
         resource_groups=None,
         authenticator=None,
         access_control=None,
+        dispatcher=None,
     ):
+        from trino_tpu.config import get_config
+        from trino_tpu.runtime.dispatcher import QueryDispatcher
         from trino_tpu.runtime.resource_groups import ResourceGroupManager
         from trino_tpu.runtime.runner import LocalQueryRunner
 
@@ -143,12 +160,32 @@ class CoordinatorServer:
         self._qid = itertools.count(1)
         #: admission control (resource-group tree): the engine/device is the
         #: shared resource, hard_concurrency bounds concurrent executions
-        #: (reference: InternalResourceGroupManager)
-        self.resource_groups = resource_groups or ResourceGroupManager()
-        #: engine-wide serialization: resource groups bound ADMISSION, but
-        #: the shared LocalQueryRunner (session state, query ids, device) is
-        #: not concurrency-safe — one execution at a time regardless of group
-        self._engine_lock = threading.Lock()
+        #: (reference: InternalResourceGroupManager); group definitions load
+        #: from `resource-groups.*` config properties when no manager is
+        #: passed in
+        if resource_groups is None:
+            props = get_config().properties
+            resource_groups = ResourceGroupManager.from_properties(props)
+            if not any(
+                k.startswith("resource-groups.global.") for k in props
+            ):
+                # unconfigured default: let the global group use every
+                # engine lane (the pre-dispatcher default of 1 modeled the
+                # old global engine lock, which is gone)
+                resource_groups.default.config.hard_concurrency = max(
+                    1, int(get_config().dispatcher.lanes)
+                )
+        self.resource_groups = resource_groups
+        #: the concurrent dispatcher (runtime/dispatcher): replaces the old
+        #: global engine lock — statements admit through weighted-fair
+        #: resource groups onto engine lanes, overload sheds, queued time
+        #: is bounded, and drain is graceful
+        self.dispatcher = dispatcher or QueryDispatcher(
+            self.runner, self.resource_groups
+        )
+        #: SQL surface: system.runtime.resource_groups reads live admission
+        #: state through the runner binding
+        self.runner.dispatcher = self.dispatcher
         self._httpd: Optional[ThreadingHTTPServer] = None
         self.started_at = time.monotonic()
         #: True when start() launched the runner's heartbeat failure
@@ -159,25 +196,47 @@ class CoordinatorServer:
     # -- query lifecycle ------------------------------------------------------
 
     def submit(self, sql: str, user: Optional[str] = None) -> _Query:
-        from trino_tpu.runtime.resource_groups import QueryQueueFullError
+        from trino_tpu.runtime.dispatcher import (
+            DispatcherStoppedError,
+            QueryShedError,
+        )
+        from trino_tpu.runtime.lifecycle import (
+            QueryCanceledException,
+            QueryQueuedTimeExceeded,
+        )
 
         q = _Query(f"q_{next(self._qid)}", sql)
         self._queries[q.id] = q
-        group = self.resource_groups.select(user)
+
+        def fail(exc, name: str, etype: str, **extra) -> None:
+            q.state = "FAILED"
+            q.error = {
+                "message": str(exc),
+                "errorName": name,
+                "errorType": etype,
+                "errorCode": getattr(exc, "error_code", name),
+                **extra,
+            }
+            q.done.set()
 
         def work():
             try:
-                group.acquire()
-            except QueryQueueFullError as e:
-                q.state = "FAILED"
-                q.error = {
-                    "message": str(e),
-                    "errorName": "QUERY_QUEUE_FULL",
-                }
-                q.done.set()
+                ticket = self.dispatcher.enqueue(user=user)
+            except QueryShedError as e:
+                fail(
+                    e, "QUERY_QUEUE_FULL", "RESOURCE_ERROR",
+                    retryable=True, retryAfterSeconds=e.retry_after_s,
+                )
                 return
-            if q.cancel_requested:
-                # canceled while queued: never occupy the engine
+            except DispatcherStoppedError as e:
+                fail(e, "SERVER_SHUTTING_DOWN", "RESOURCE_ERROR")
+                return
+            ticket.on_force_kill = q.cancel
+            q._attach_ticket(ticket)
+            try:
+                ticket.wait()
+            except QueryCanceledException:
+                # canceled while queued: never occupied the engine
                 q.state = "CANCELED"
                 q.error = {
                     "message": "canceled via DELETE /v1/query",
@@ -186,21 +245,39 @@ class CoordinatorServer:
                     "errorCode": "USER_CANCELED",
                 }
                 q.done.set()
-                group.release()
                 return
+            except QueryQueuedTimeExceeded as e:
+                fail(e, "EXCEEDED_QUEUED_TIME_LIMIT", "RESOURCE_ERROR")
+                return
+            except DispatcherStoppedError as e:
+                fail(e, "SERVER_SHUTTING_DOWN", "RESOURCE_ERROR")
+                return
+
+            def run(lane_runner):
+                # statement identity: a lane runs one statement at a time,
+                # so the per-statement user is race-free
+                lane_runner.user = user or "user"
+                q.run(lane_runner)
+
             try:
-                with self._engine_lock:
-                    # statement identity: the lock serializes executions, so
-                    # the per-statement user is race-free
-                    self.runner.user = user or "user"
-                    q.run(self.runner)
-                # successful SELECTs feed the prewarm replay set: the
-                # manifest a restarted server replays IS the live workload
-                pw = getattr(self.runner, "prewarm", None)
-                if pw is not None and q.state == "FINISHED":
-                    pw.record(q.sql)
-            finally:
-                group.release()
+                self.dispatcher.run_admitted(ticket, run)
+            except QueryCanceledException:
+                # cancel won the race against admission: slot handed back,
+                # no engine time consumed
+                q.state = "CANCELED"
+                q.error = {
+                    "message": "canceled via DELETE /v1/query",
+                    "errorName": "USER_CANCELED",
+                    "errorType": "USER_ERROR",
+                    "errorCode": "USER_CANCELED",
+                }
+                q.done.set()
+                return
+            # successful SELECTs feed the prewarm replay set: the
+            # manifest a restarted server replays IS the live workload
+            pw = getattr(self.runner, "prewarm", None)
+            if pw is not None and q.state == "FINISHED":
+                pw.record(q.sql)
 
         threading.Thread(
             target=work, daemon=True, name=f"statement-{q.id}"
@@ -232,13 +309,45 @@ class CoordinatorServer:
 
                 if self.path != "/v1/statement":
                     return self._send(404, {"error": {"message": "not found"}})
-                n = int(self.headers.get("Content-Length", 0))
-                sql = self.rfile.read(n).decode()
                 try:
                     auth_user = self._authenticate()
                 except AuthenticationError:
                     return
                 user = auth_user or self.headers.get("X-Trino-User")
+                # load shedding BEFORE the body is read (reference:
+                # DispatchManager queue-full rejection): a full resource-
+                # group queue answers 429 + Retry-After without touching
+                # the statement text, so overload costs the coordinator a
+                # header parse, not a body read + parse + thread
+                shed_after = server.dispatcher.shed_probe(user)
+                if shed_after is not None:
+                    self.close_connection = True  # body intentionally unread
+                    body = json.dumps(
+                        {
+                            "error": {
+                                "message": (
+                                    "resource group queue is full; retry "
+                                    f"after {shed_after:.1f}s"
+                                ),
+                                "errorName": "QUERY_QUEUE_FULL",
+                                "errorType": "RESOURCE_ERROR",
+                                "errorCode": "QUERY_QUEUE_FULL",
+                                "retryable": True,
+                            }
+                        }
+                    ).encode()
+                    self.send_response(429)
+                    self.send_header(
+                        "Retry-After", str(max(1, int(shed_after + 0.999)))
+                    )
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                sql = self.rfile.read(n).decode()
                 q = server.submit(sql, user=user)
                 self._send(
                     200,
@@ -500,12 +609,23 @@ class CoordinatorServer:
         if pw is not None:
             # adopt even a pre-attached executor (runner_from_etc creates
             # one with a private lock): replays — start AND later grow
-            # kicks — must serialize with live queries under the SAME lock
-            pw.use_lock(self._engine_lock)
+            # kicks — admit through the dispatcher's weight-capped
+            # system.prewarm resource group, so a replay waits its fair
+            # turn on the primary lane and can never starve live user
+            # queries the way the old engine-lock adoption could
+            pw.use_admission(self.dispatcher.system_admission)
             if get_config().prewarm.on_start:
                 pw.run(reason="start")
 
     def shutdown(self) -> None:
+        # graceful dispatcher drain FIRST: admission closes, queued
+        # statements fail classified (SERVER_SHUTTING_DOWN), running ones
+        # finish inside dispatcher.drain-wait or are force-killed through
+        # their lifecycle tokens (the PR 8 bounded force-kill contract)
+        try:
+            self.dispatcher.drain()
+        except Exception:
+            pass
         if self._detector_started:
             det = getattr(self.runner, "failure_detector", None)
             if det is not None:

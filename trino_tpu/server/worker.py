@@ -514,6 +514,9 @@ class WorkerServer:
         finally:
             if tracer.enabled and tracer.root is not None:
                 t.spans = tracer.root.to_dict()
+            # a task aborted mid-wave must release its spill partitions
+            # now, not when the abandoned wave generator is GC'd
+            t.lifecycle.release_spills()
             reset_current(token)
             self._slots.release()
             t.done.set()

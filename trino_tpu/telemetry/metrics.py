@@ -415,6 +415,12 @@ DECIMAL_FASTPATHS = ("proven", "runtime_check", "limb")
 MEMBERSHIP_EVENT_KINDS = ("join", "drain", "death", "rejoin", "shrink_replan")
 
 
+#: resource groups pre-registered on the serving metrics so scrapes see
+#: the admission vocabulary before the first statement; the dispatcher
+#: touches further groups at construction
+DEFAULT_SERVE_GROUPS = ("global", "system.prewarm")
+
+
 #: prewarm-run vocabulary, pre-registered so scrapes see every
 #: (trigger, outcome) cell at 0 before the first replay fires
 PREWARM_REASONS = ("start", "grow", "manual")
@@ -478,6 +484,33 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
         _PREFIX + "query_wall_seconds",
         "end-to-end statement wall time",
     )
+    reg.histogram(
+        _PREFIX + "query_queued_seconds",
+        "seconds a statement waited in its resource group's admission "
+        "queue before an engine lane ran it (runtime/dispatcher); "
+        "observed on admission, cancel, expiry, and shed",
+    )
+    queued = reg.gauge(
+        _PREFIX + "queries_queued",
+        "statements waiting in each resource group's admission queue",
+        labelnames=("group",),
+    )
+    running = reg.gauge(
+        _PREFIX + "queries_running",
+        "statements running on engine lanes per resource group",
+        labelnames=("group",),
+    )
+    shed = reg.counter(
+        _PREFIX + "queries_shed_total",
+        "statements shed because a resource group's queue was full "
+        "(HTTP 429 + Retry-After before the body is read — a retryable "
+        "client error, never a hang)",
+        labelnames=("group",),
+    )
+    for g in DEFAULT_SERVE_GROUPS:
+        queued.touch(g)
+        running.touch(g)
+        shed.touch(g)
     reg.counter(
         _PREFIX + "query_retraces_total",
         "SPMD retraces attributed to completed distributed queries "
@@ -660,6 +693,26 @@ def query_retraces_counter() -> Counter:
 
 def query_wall_histogram() -> Histogram:
     return REGISTRY.histogram(_PREFIX + "query_wall_seconds")
+
+
+def query_queued_histogram() -> Histogram:
+    """Admission-queue wait per statement (runtime/dispatcher)."""
+    return REGISTRY.histogram(_PREFIX + "query_queued_seconds")
+
+
+def queries_queued_gauge() -> Gauge:
+    """Queued statements per resource group (dispatcher-maintained)."""
+    return REGISTRY.gauge(_PREFIX + "queries_queued")
+
+
+def queries_running_gauge() -> Gauge:
+    """Running statements per resource group (dispatcher-maintained)."""
+    return REGISTRY.gauge(_PREFIX + "queries_running")
+
+
+def queries_shed_counter() -> Counter:
+    """Statements shed on a full resource-group queue (HTTP 429)."""
+    return REGISTRY.counter(_PREFIX + "queries_shed_total")
 
 
 def memory_kills_counter() -> Counter:
