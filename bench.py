@@ -343,6 +343,18 @@ runs = @RUNS@
 local = LocalQueryRunner(schema=schema, target_splits=8)
 dist = DistributedQueryRunner(n_workers=8, schema=schema)
 
+# profile archive riding the mesh bench (telemetry/profile_store): every
+# benched execution's artifact is archived, and the section records the
+# refs — this run becomes next run's profile_diff baseline
+import os as _os, tempfile as _tempfile
+from trino_tpu.telemetry.profile_store import ProfileStore, attach_profile_store
+_profile_dir = _os.environ.get("BENCH_PROFILE_DIR") or _os.path.join(
+    _tempfile.gettempdir(), "trino_tpu_profile_archive", schema
+)
+_profile_store = attach_profile_store(
+    dist, ProfileStore(archive_dir=_profile_dir)
+)
+
 def warm_q(r, q):
     best = float("inf")
     for _ in range(runs):
@@ -466,6 +478,20 @@ except Exception as e:
     set_memory_pool_limit(0)  # never leave the probe's limit armed
     pressure = {"error": f"{type(e).__name__}: {e}"}
 
+# archived profile-artifact refs for this bench's executions: the
+# comparable record tools/profile_diff.py consumes next run.  A failed
+# flush is recorded — refs to files that never landed must not read as a
+# usable baseline
+_profile_refs = {
+    "archive_dir": _profile_dir,
+    "flushed": _profile_store.flush(),
+    "count": len(_profile_store.refs()),
+    "recent": [
+        {k: r[k] for k in ("key", "query_id", "sql_hash")}
+        for r in _profile_store.refs()[-6:]
+    ],
+}
+
 print(json.dumps({
     "schema": schema,
     "workers": dist.wm.n,
@@ -529,6 +555,7 @@ print(json.dumps({
     "trace_overhead_ratio": round(
         q6_warm_trace_on / max(q6_warm_trace_off, 1e-9), 3
     ),
+    "profile_artifacts": _profile_refs,
     "metrics": metrics_snapshot,
 }), flush=True)
 """

@@ -393,10 +393,31 @@ def test_phase_totals_rollup():
 # -- counter regression gate (tools/compare_bench.py) -------------------------
 
 
+def _clean_drift():
+    return {
+        "schema": "sf1",
+        "query": "q3",
+        "baseline": {"ref": "PR3", "mesh_warm_s": 5.985,
+                     "local_warm_s": 3.6998, "ratio": 1.618},
+        "current": {"mesh_warm_s": 3.6, "local_warm_s": 1.45,
+                    "ratio": 2.5, "matches_local": True,
+                    "profile_ref": {"key": "k"}},
+        "mesh_wall_delta_s": -2.4,
+        "local_wall_delta_s": -2.25,
+        "ratio_factors": {"mesh": 0.6, "local_inverse": 2.55},
+        "attribution": {"dominant_phase": "transfer",
+                        "dominant_fragment": 1, "sums_to_wall": True,
+                        "phases_s": {}},
+        "null_diff": {"query": "q6", "pass": True, "sums_to_wall": True,
+                      "wall_delta_s": 0.001, "max_phase_delta_s": 0.002},
+    }
+
+
 def _clean_extra():
     return {
         "membership": _clean_membership(),
         "serve": _clean_serve(),
+        "drift": _clean_drift(),
         "mesh": {
             "sf1": {
                 "error": None,

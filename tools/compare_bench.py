@@ -18,7 +18,11 @@ alone may hide (a retrace can cost little on tiny data and 30x on SF10):
   * `membership.*` (tools/membership_bench.py): every attempt of the
     shrink->grow round trip matches local, the shrink re-planned, the grow
     restored W, and the post-round-trip warm repeat re-plans and retraces
-    NOTHING (PR 7 — membership churn must not dirty the warm path).
+    NOTHING (PR 7 — membership churn must not dirty the warm path);
+  * `drift.*` (tools/drift_bench.py): the recorded Q3 drift attribution
+    names a dominant (phase, fragment), its phase decomposition sums to
+    the measured wall, and the warm-Q6 null-diff self check passes (two
+    warm archives of one statement must profile_diff to ~zero).
 
 Modes:
   python tools/compare_bench.py                 # gate the checked-in file
@@ -317,6 +321,62 @@ def check_serve(sec: dict) -> list:
     return violations
 
 
+#: drift-section keys the attribution is only evidence WITH: the era walls
+#: on both sides, the multiplicative ratio decomposition, and the named
+#: dominant (phase, fragment) of the current profile
+DRIFT_KEYS = (
+    "schema", "query", "baseline", "current", "mesh_wall_delta_s",
+    "local_wall_delta_s", "ratio_factors", "attribution", "null_diff",
+)
+
+
+def check_drift(sec: dict) -> list:
+    """Violations over the top-level `drift` section (tools/drift_bench.py
+    + tools/profile_diff.py): the ROADMAP item-2 drift must arrive
+    ATTRIBUTED — dominant phase and fragment named from an archived
+    profile whose phases sum to its wall (conservative and complete), and
+    the warm-Q6 null-diff self check must pass (two warm archives of the
+    same statement diff to ~zero), or the diff tool itself is not to be
+    trusted."""
+    violations = []
+    missing = [k for k in DRIFT_KEYS if k not in sec]
+    if missing:
+        return [f"drift section missing {missing} (re-run "
+                "tools/drift_bench.py)"]
+    att = sec.get("attribution") or {}
+    if not att.get("dominant_phase"):
+        violations.append(
+            "drift.attribution.dominant_phase missing (the attribution "
+            "must NAME the dominant phase, not just record walls)"
+        )
+    if att.get("dominant_fragment") is None:
+        violations.append(
+            "drift.attribution.dominant_fragment missing (the attribution "
+            "must name the fragment the time lives in)"
+        )
+    if att.get("sums_to_wall") is not True:
+        violations.append(
+            f"drift.attribution.sums_to_wall = {att.get('sums_to_wall')} "
+            "(expected true: the per-phase decomposition must sum to the "
+            "measured wall — attribution is conservative and complete)"
+        )
+    cur = sec.get("current") or {}
+    if cur.get("matches_local") is not True:
+        violations.append(
+            f"drift.current.matches_local = {cur.get('matches_local')} "
+            "(the profiled run must still answer the local oracle)"
+        )
+    null = sec.get("null_diff") or {}
+    for key, want in (("pass", True), ("sums_to_wall", True)):
+        if null.get(key) is not want:
+            violations.append(
+                f"drift.null_diff.{key} = {null.get(key)} (expected "
+                f"{want}: two warm archives of the same statement must "
+                "diff to ~zero with the conservation invariant intact)"
+            )
+    return violations
+
+
 def _dig(d: dict, path: tuple):
     cur = d
     for p in path:
@@ -338,6 +398,19 @@ def check_extra(extra: dict) -> tuple:
     else:
         skipped.append(
             "no membership section recorded (run tools/membership_bench.py)"
+        )
+    drift = extra.get("drift")
+    if isinstance(drift, dict):
+        if drift.get("run_error") or drift.get("error"):
+            skipped.append(
+                "drift: bench errored: "
+                f"{drift.get('run_error') or drift.get('error')}"
+            )
+        else:
+            violations.extend(check_drift(drift))
+    else:
+        skipped.append(
+            "no drift section recorded (run tools/drift_bench.py)"
         )
     serve = extra.get("serve")
     if isinstance(serve, dict):

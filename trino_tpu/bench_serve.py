@@ -144,6 +144,25 @@ def run_serve(schema: str = "tiny", clients: int = 8, rounds: int = 3,
 
     # -- local lanes phase ----------------------------------------------------
     local = LocalQueryRunner(catalog="tpch", schema=schema, target_splits=8)
+    # profile archive riding the serve bench: every concurrently served
+    # statement's artifact lands in the store (lanes share it through
+    # clone_for_dispatch), and the section records the artifact refs —
+    # serving perf is diffable (tools/profile_diff) run-over-run
+    import tempfile
+
+    from trino_tpu.telemetry.profile_store import (
+        ProfileStore,
+        attach_profile_store,
+    )
+
+    import os as _os
+
+    archive_dir = _os.environ.get("BENCH_PROFILE_DIR") or _os.path.join(
+        tempfile.gettempdir(), "trino_tpu_profile_archive", "serve"
+    )
+    store = attach_profile_store(
+        local, ProfileStore(archive_dir=archive_dir)
+    )
     mix, oracle = _mix_and_oracle(local)  # serial warm-up + oracle
     mgr = ResourceGroupManager(
         ResourceGroupConfig(
@@ -153,6 +172,17 @@ def run_serve(schema: str = "tiny", clients: int = 8, rounds: int = 3,
     )
     d = QueryDispatcher(local, mgr, lanes=lanes)
     out["local"] = _serve_once(d, mix, oracle, clients, rounds)
+    out["profile_artifacts"] = {
+        "archive_dir": archive_dir,
+        # a failed flush is recorded: refs to files that never landed
+        # must not read as a usable diff baseline
+        "flushed": store.flush(),
+        "count": len(store.refs()),
+        "recent": [
+            {k: r[k] for k in ("key", "query_id", "sql_hash")}
+            for r in store.refs()[-len(mix):]
+        ],
+    }
 
     # -- mesh phase (shared trace cache => zero warm compile events) -----------
     dist = DistributedQueryRunner(n_workers=8, schema=schema)

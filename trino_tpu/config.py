@@ -351,6 +351,60 @@ class DispatcherConfig(ConfigSection):
 
 
 @dataclass
+class ProfileConfig(ConfigSection):
+    """Query performance observatory: the persistent per-query profile
+    archive (telemetry/profile_store.ProfileStore).  At completion every
+    statement's profile — phases, per-fragment stats, collective bytes,
+    compile events, admission info, gate wait, peak memory — is assembled
+    into ONE structured artifact and persisted through the filesystem SPI
+    off the hot path, so regressions can be *diffed* (tools/profile_diff)
+    instead of re-measured from memory of last week's numbers."""
+
+    archive_dir: str = knob(
+        "", "profile.archive-dir",
+        "profile-artifact archive location (filesystem SPI; empty = "
+        "in-memory ring only when a store is attached, nothing otherwise)",
+    )
+    retention_max_age_s: float = knob(
+        0.0, "profile.retention-max-age",
+        "seconds an archived artifact is retained before the sweep "
+        "deletes it (0 = keep forever)",
+    )
+    retention_max_count: int = knob(
+        0, "profile.retention-max-count",
+        "archived artifacts retained on disk, oldest pruned first "
+        "(0 = unbounded)",
+    )
+    ring_limit: int = knob(
+        256, "profile.ring-limit",
+        "recent artifacts held in memory (the system.runtime."
+        "query_profiles window; archived files are not bounded by this)",
+    )
+
+
+@dataclass
+class AuditConfig(ConfigSection):
+    """Structured JSONL query audit log (telemetry/audit.QueryAuditLog):
+    one line per QueryCompletedEvent through the filesystem SPI, with
+    size-based rotation — the machine-readable trail an external audit
+    pipeline tails (reference role: http/kafka event listeners)."""
+
+    log_path: str = knob(
+        "", "audit.log-path",
+        "audit log location (filesystem SPI; empty = audit log off)",
+    )
+    rotate_bytes: int = knob(
+        64 * 1024 * 1024, "audit.rotate-bytes",
+        "rotate the audit log when it would exceed this size "
+        "(0 = never rotate)",
+    )
+    rotate_keep: int = knob(
+        2, "audit.rotate-keep",
+        "rotated audit segments kept (<path>.1 .. <path>.N, newest first)",
+    )
+
+
+@dataclass
 class MemoryConfig(ConfigSection):
     """Shared-pool memory knobs (runtime/lifecycle LowMemoryKiller)."""
 
@@ -383,6 +437,8 @@ class ClusterConfig:
         default_factory=CompileCacheConfig
     )
     prewarm: PrewarmConfig = field(default_factory=PrewarmConfig)
+    profile: ProfileConfig = field(default_factory=ProfileConfig)
+    audit: AuditConfig = field(default_factory=AuditConfig)
     properties: dict = field(default_factory=dict)
 
     def breaker_for(self, worker: str) -> BreakerConfig:
@@ -423,6 +479,8 @@ def load_cluster_config(props: Optional[dict] = None, env=None) -> ClusterConfig
         memory=MemoryConfig.from_properties(props, env),
         compile_cache=CompileCacheConfig.from_properties(props, env),
         prewarm=PrewarmConfig.from_properties(props, env),
+        profile=ProfileConfig.from_properties(props, env),
+        audit=AuditConfig.from_properties(props, env),
         properties=props,
     )
     cfg._env = env

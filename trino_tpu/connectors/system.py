@@ -12,6 +12,9 @@ Tables (schema `runtime`):
   compilations     — recent SPMD compile events (step, bucket, mesh, wall
                      seconds; telemetry/compile_events ring)
   metrics          — the process metrics registry (telemetry/metrics)
+  query_profiles   — the persistent per-query profile archive's memory
+                     ring (telemetry/profile_store; wall, gate wait,
+                     compile seconds, archived artifact path)
   nodes            — mesh workers and their liveness
   session_properties — property values in effect
   caches           — buffer-pool tiers (bytes, hits, misses)
@@ -144,6 +147,23 @@ _TABLES = {
         ("total_admitted", T.BIGINT),
         ("total_queued", T.BIGINT),
         ("shed", T.BIGINT),
+    ],
+    "query_profiles": [
+        ("query_id", T.VARCHAR),
+        ("sql_hash", T.VARCHAR),
+        ("state", T.VARCHAR),
+        ("wall_s", T.DOUBLE),
+        ("mesh", T.VARCHAR),
+        # resource group the statement was admitted through (NULL for
+        # undispatched executions)
+        ("resource_group", T.VARCHAR),
+        # device time-slice gate wait attributed to the statement
+        ("gate_wait_s", T.DOUBLE),
+        ("compile_s", T.DOUBLE),
+        ("peak_memory_bytes", T.BIGINT),
+        # filesystem-SPI location of the archived artifact (NULL when the
+        # store runs in-memory only)
+        ("archived_path", T.VARCHAR),
     ],
     "session_properties": [
         ("name", T.VARCHAR),
@@ -325,6 +345,12 @@ class SystemConnector(Connector):
                 )
                 for s in stats
             ]
+        if table == "query_profiles":
+            # the profile archive's memory ring (telemetry/profile_store):
+            # one row per recently archived statement artifact; empty when
+            # no store is attached (profile.archive-dir unset)
+            store = getattr(r, "profile_store", None)
+            return store.rows() if store is not None else []
         if table == "session_properties":
             return [
                 (name, str(value), meta.description)

@@ -36,6 +36,25 @@ class FileSystem:
     def write(self, path: str, data: bytes) -> None:
         raise NotImplementedError
 
+    def append(self, path: str, data: bytes) -> None:
+        """Append bytes to a (possibly absent) file — the audit-log /
+        JSONL-sink primitive.  NOT atomic across writers; callers needing
+        single-writer semantics serialize themselves (an object-store
+        implementation would express this as multipart upload parts)."""
+        raise NotImplementedError
+
+    def size(self, path: str) -> int:
+        """Current byte size (0 when absent) — size-based log rotation."""
+        raise NotImplementedError
+
+    def rename(self, src: str, dst: str) -> None:
+        """Move a file, replacing any existing destination (log-segment
+        rotation).  Default: copy-then-delete through the byte interface
+        — correct anywhere, O(size); implementations with a native move
+        (local os.replace, object-store server-side copy) override it."""
+        self.write(dst, self.read(src))
+        self.delete(src)
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -86,6 +105,25 @@ class LocalFileSystem(FileSystem):
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, path)  # atomic publish (spool/iceberg commits)
+
+    def append(self, path: str, data: bytes) -> None:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "ab") as f:
+            f.write(data)
+
+    def size(self, path: str) -> int:
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return 0
+
+    def rename(self, src: str, dst: str) -> None:
+        d = os.path.dirname(dst)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        os.replace(src, dst)
 
     def exists(self, path: str) -> bool:
         return os.path.exists(path)

@@ -306,9 +306,17 @@ class DistributedQueryRunner(LocalQueryRunner):
         # EXPLAIN ANALYZE runs the SAME distributed path, with the profile
         # in blocking mode so per-phase times measure device work
         profile = MeshProfile(blocking=stats is not None, tracer=tr)
+        from trino_tpu.runtime.lifecycle import current_query
+
+        ctx = current_query()
         executor = StageExecutor(
             self.catalogs, self.wm, self.properties,
-            query_id=getattr(self, "_current_qid", "q"),
+            # the statement's own id (lane-safe), not the shared runner
+            # attribute another lane may have overwritten
+            query_id=(
+                ctx.query_id if ctx is not None
+                else getattr(self, "_current_qid", "q")
+            ),
             profile=profile,
         )
         #: kept for tests / EXPLAIN evidence (dynamic filter pruning counts)

@@ -191,6 +191,16 @@ def runner_from_etc(etc_dir: str, **kw):
         from trino_tpu.runtime.events import FileEventListener
 
         r.events.add(FileEventListener(el_props["file.path"]))
+    # query performance observatory: the JSONL audit log (`audit.log-path`)
+    # and the per-query profile archive (`profile.archive-dir`) attach when
+    # configured — both no-ops without their knobs (the archive usually
+    # attached already at runner construction, since load_etc installed the
+    # typed config first; this covers pre-built configs too)
+    from trino_tpu.telemetry.audit import attach_audit_log
+    from trino_tpu.telemetry.profile_store import attach_profile_store
+
+    attach_profile_store(r)
+    attach_audit_log(r)
     # restart resilience: an etc/-driven runner gets its prewarm executor
     # (runtime/prewarm) when `prewarm.manifest-path` is configured — the
     # CoordinatorServer then replays it at start, and grow paths re-trace

@@ -61,21 +61,105 @@ from trino_tpu.runtime.resource_groups import (
     ResourceGroupConfig,
     ResourceGroupManager,
 )
+from trino_tpu.telemetry.spans import now
 
 #: process-wide device time-slice gate: one compiled program launches at a
 #: time; host work (parse/plan/serialize) runs outside it.  An RLock so
 #: nested statement execution (EXECUTE -> execute) re-enters freely.
 _DEVICE_GATE = threading.RLock()
 
+#: gate contention telemetry state.  _GATE_WAITERS is mutated only on the
+#: CONTENDED acquire path (under _WAITERS_LOCK); _GATE_HOLDER/_GATE_DEPTH
+#: are mutated only by the thread HOLDING the gate (the gate itself is
+#: their lock).  Readers (the occupancy callback gauge, the release-path
+#: `if _GATE_WAITERS` check) take snapshots of a single int — stale by at
+#: most one step, never torn.
+_WAITERS_LOCK = threading.Lock()
+_GATE_WAITERS = 0
+_GATE_HOLDER = -1
+_GATE_DEPTH = 0
+
+
+def gate_holder() -> int:
+    """Engine lane currently holding the device gate (-1 = idle); feeds
+    the trino_tpu_device_gate_occupied callback gauge."""
+    return _GATE_HOLDER
+
+
+def gate_waiters() -> int:
+    """Lanes currently blocked in a contended device-gate acquire."""
+    return _GATE_WAITERS
+
+
+class _DeviceSlice:
+    """One timed passage through the device gate (see device_slice()).
+
+    Cost contract (the PR 12 zero-cost-when-idle bar, measured in
+    tests/test_profile_store.py): the UNCONTENDED path is one non-blocking
+    RLock acquire, ONE clock read, and two attribute writes per step — no
+    histogram observe, no contextvar lookup beyond the holder label.  All
+    wait accounting lives on the contended path, where the caller is about
+    to block anyway; hold time is observed only when another lane waited
+    during the hold (the contention-relevant holds)."""
+
+    __slots__ = ("t_acq",)
+
+    def __enter__(self):
+        global _GATE_WAITERS, _GATE_HOLDER, _GATE_DEPTH
+        if _DEVICE_GATE.acquire(blocking=False):
+            self.t_acq = now()  # the one uncontended clock read
+        else:
+            from trino_tpu.runtime import lifecycle
+            from trino_tpu.telemetry.metrics import gate_wait_histogram
+
+            t0 = now()
+            with _WAITERS_LOCK:
+                _GATE_WAITERS += 1
+            try:
+                _DEVICE_GATE.acquire()
+            finally:
+                with _WAITERS_LOCK:
+                    _GATE_WAITERS -= 1
+            self.t_acq = now()
+            wait = self.t_acq - t0
+            gate_wait_histogram().observe(wait)
+            lifecycle.note_gate_wait(wait)
+        # depth/holder are guarded by the gate itself (holder-only writes)
+        _GATE_DEPTH += 1
+        if _GATE_DEPTH == 1:
+            from trino_tpu.runtime.lifecycle import current_lane
+
+            _GATE_HOLDER = current_lane()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        global _GATE_HOLDER, _GATE_DEPTH
+        _GATE_DEPTH -= 1
+        if _GATE_DEPTH == 0:
+            _GATE_HOLDER = -1
+            if _GATE_WAITERS:
+                from trino_tpu.telemetry.metrics import gate_hold_histogram
+
+                gate_hold_histogram().observe(now() - self.t_acq)
+        _DEVICE_GATE.release()
+        return False
+
 
 def device_slice():
-    """The device time-slice gate (a reentrant lock context manager):
+    """The device time-slice gate (a reentrant, TIMED context manager):
     lanes acquire it around each execution step — pipeline construction
     and per-batch pulls — so concurrent queries interleave device work at
     fragment/batch boundaries instead of contending mid-kernel.
-    Uncontended (single lane / no dispatcher) it is one RLock
-    acquire/release per step: noise."""
-    return _DEVICE_GATE
+
+    Telemetry: contended acquires observe
+    `trino_tpu_device_gate_wait_seconds` and fold into the executing
+    query's `gate_wait_s` (QueryStatistics, the query trace, and the
+    archived profile); holds during which another lane waited observe
+    `trino_tpu_device_gate_hold_seconds`; the holding lane is readable as
+    the `trino_tpu_device_gate_occupied{lane}` pull gauge.  Uncontended
+    (single lane / no dispatcher) a step costs one non-blocking RLock
+    acquire + one clock read: noise."""
+    return _DeviceSlice()
 
 
 class QueryShedError(RuntimeError):
@@ -549,6 +633,9 @@ class QueryDispatcher:
         tok_adm = lifecycle.set_admission_info(
             (ticket.group_name, ticket.queued_s)
         )
+        # lane identity for the device-gate occupancy gauge: the statement
+        # thread's device_slice() passages report this lane as the holder
+        tok_lane = lifecycle.set_lane(lane.index)
         session_before = getattr(primary, "session", None)
         if lane.runner is not primary and session_before is not None:
             # lanes inherit the primary's catalog/schema; a USE executed on
@@ -563,6 +650,7 @@ class QueryDispatcher:
                 and getattr(lane.runner, "session", None) is not session_before
             ):
                 primary.session = lane.runner.session
+            lifecycle.reset_lane(tok_lane)
             lifecycle.reset_admission_info(tok_adm)
             lifecycle.reset_group_memory(tok_mem)
             self.release(ticket)

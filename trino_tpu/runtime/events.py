@@ -68,6 +68,16 @@ class QueryStatistics:
     peak_memory_bytes: int = 0
     #: spans recorded by the query tracer (0 when tracing is off)
     spans: int = 0
+    #: seconds the statement waited on the device time-slice gate
+    #: (runtime/dispatcher device_slice; contended acquires only)
+    gate_wait_s: float = 0.0
+    #: resource group the statement was admitted through + its queue wait
+    #: (empty/0 for undispatched executions)
+    group: str = ""
+    queued_s: float = 0.0
+    #: archived profile-artifact key (telemetry/profile_store; empty when
+    #: no store is attached)
+    profile_key: str = ""
 
 
 @dataclass

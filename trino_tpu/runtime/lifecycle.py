@@ -158,6 +158,25 @@ class QueryContext:
         #: not when the abandoned wave generator happens to be GC'd or the
         #: hours-scale orphan sweep runs
         self._spills: list = []
+        # -- per-statement telemetry handles (the lane-safety contract):
+        # concurrent engine lanes each resolve THEIR statement's tracer /
+        # mesh profile / trace export through this context instead of
+        # racing shared runner attributes (runner.last_mesh_profile and
+        # runner.last_trace are properties over these)
+        #: the statement's SpanTracer (None until execute installs one)
+        self.tracer = None
+        #: the statement's MeshProfile (distributed executions only)
+        self.mesh_profile = None
+        #: peak device-memory reservation of the statement's local plan
+        self.peak_memory = 0
+        #: Chrome-trace JSON exported when the statement finished tracing
+        self.trace_json = None
+        #: seconds this statement spent waiting on the device time-slice
+        #: gate (runtime/dispatcher device_slice, contended acquires only)
+        self.gate_wait_s = 0.0
+        #: reference to this statement's archived profile artifact
+        #: (telemetry/profile_store), set after FINISHING
+        self.profile_ref = None
 
     # -- state machine --------------------------------------------------------
 
@@ -330,6 +349,16 @@ class QueryContext:
             except Exception:
                 pass
 
+    # -- device-gate accounting -----------------------------------------------
+
+    def note_gate_wait(self, wait_s: float) -> None:
+        """Fold one contended device-gate wait into this query's total
+        (called by dispatcher._DeviceSlice on the contended path only; a
+        statement's steps run on one thread at a time, the lock guards
+        against an overlapping EXPLAIN-ANALYZE reader)."""
+        with self._lock:
+            self.gate_wait_s += wait_s
+
     # -- spill ----------------------------------------------------------------
 
     def register_spill(self, spiller) -> None:
@@ -463,6 +492,34 @@ def reset_admission_info(token) -> None:
 
 def current_admission():
     return _ADMISSION.get()
+
+
+#: engine-lane index of the executing statement (dispatcher sets it around
+#: each admitted run); the device-gate occupancy gauge labels holds by it.
+#: Default 0: undispatched executions (tests, dbapi, prewarm) are lane 0.
+_LANE: "contextvars.ContextVar[int]" = contextvars.ContextVar(
+    "trino_tpu_lane", default=0
+)
+
+
+def set_lane(index: int):
+    return _LANE.set(index)
+
+
+def reset_lane(token) -> None:
+    _LANE.reset(token)
+
+
+def current_lane() -> int:
+    return _LANE.get()
+
+
+def note_gate_wait(wait_s: float) -> None:
+    """Attribute a contended device-gate wait to the executing query
+    (no-op without one — e.g. a bare planner test taking the gate)."""
+    ctx = _CURRENT.get()
+    if ctx is not None:
+        ctx.note_gate_wait(wait_s)
 
 
 # -- tracker ------------------------------------------------------------------

@@ -101,7 +101,15 @@ class LocalQueryRunner:
             sysconn.runner = self
         # telemetry: per-query span tracer (telemetry/spans; NULL when the
         # query_trace session property is off) + recent trace history
-        # feeding system.runtime.spans and the coordinator trace endpoint
+        # feeding system.runtime.spans and the coordinator trace endpoint.
+        # The tracer / last_trace / last_mesh_profile / peak-memory
+        # surfaces are PROPERTIES resolved through the lifecycle
+        # contextvar: inside a statement they read that statement's
+        # handles, so concurrent engine lanes (and legacy direct
+        # execute() callers on one shared runner) can never observe each
+        # other's EXPLAIN ANALYZE profile or trace; the plain attributes
+        # below are the most-recently-finished fallbacks bench/tests read
+        # after execute() returns.
         from collections import deque
 
         from trino_tpu.telemetry import NULL_TRACER
@@ -113,6 +121,13 @@ class LocalQueryRunner:
         self.traces = deque(maxlen=64)
         #: peak device-memory reservation of the last local execution
         self._last_peak_memory = 0
+        #: persistent per-query profile archive (telemetry/profile_store):
+        #: None = archiving off (zero cost).  Attached at the load points
+        #: that know the config — runner_from_etc and
+        #: CoordinatorServer.start (attach_profile_store) — or explicitly;
+        #: NOT here, so clone_for_dispatch lane construction never builds
+        #: a throwaway store it immediately replaces with the parent's.
+        self.profile_store = None
 
     def clone_for_dispatch(self) -> "Optional[LocalQueryRunner]":
         """An engine-lane clone for the concurrent dispatcher
@@ -149,7 +164,84 @@ class LocalQueryRunner:
         lane.grants = self.grants
         lane.access_control = self.access_control
         lane.traces = self.traces
+        lane.profile_store = self.profile_store
         return lane
+
+    # -- per-statement telemetry handles (lane safety) -------------------------
+    #
+    # Resolution rule shared by all four surfaces: INSIDE a statement the
+    # lifecycle contextvar names that statement's own handle (concurrent
+    # lanes and legacy multi-threaded direct execute() callers each see
+    # their own); OUTSIDE one, the most-recently-finished statement's value
+    # (what bench / verify.device_residency read after execute returns).
+    # Setters write the statement handle AND the shared fallback — last
+    # writer wins on the fallback, which is exactly the pre-lane semantics.
+
+    #: class-level defaults so the properties read cleanly on runners that
+    #: never executed (LocalQueryRunner has no mesh profile at all)
+    _last_mesh_profile = None
+
+    @property
+    def _tracer(self):
+        from trino_tpu.runtime.lifecycle import current_query
+
+        ctx = current_query()
+        if ctx is not None and ctx.tracer is not None:
+            return ctx.tracer
+        return self._tracer_default
+
+    @_tracer.setter
+    def _tracer(self, tracer) -> None:
+        self._tracer_default = tracer
+
+    @property
+    def last_mesh_profile(self):
+        from trino_tpu.runtime.lifecycle import current_query
+
+        ctx = current_query()
+        if ctx is not None and ctx.mesh_profile is not None:
+            return ctx.mesh_profile
+        return self._last_mesh_profile
+
+    @last_mesh_profile.setter
+    def last_mesh_profile(self, profile) -> None:
+        from trino_tpu.runtime.lifecycle import current_query
+
+        ctx = current_query()
+        if ctx is not None:
+            ctx.mesh_profile = profile
+        self._last_mesh_profile = profile
+
+    @property
+    def last_trace(self):
+        from trino_tpu.runtime.lifecycle import current_query
+
+        ctx = current_query()
+        if ctx is not None and ctx.trace_json is not None:
+            return ctx.trace_json
+        return self._last_trace
+
+    @last_trace.setter
+    def last_trace(self, trace) -> None:
+        self._last_trace = trace
+
+    @property
+    def _last_peak_memory(self) -> int:
+        from trino_tpu.runtime.lifecycle import current_query
+
+        ctx = current_query()
+        if ctx is not None:
+            return ctx.peak_memory
+        return self._last_peak
+
+    @_last_peak_memory.setter
+    def _last_peak_memory(self, peak: int) -> None:
+        from trino_tpu.runtime.lifecycle import current_query
+
+        ctx = current_query()
+        if ctx is not None:
+            ctx.peak_memory = peak
+        self._last_peak = peak
 
     @property
     def in_transaction(self) -> bool:
@@ -244,12 +336,11 @@ class LocalQueryRunner:
         )
         prev_tracer = self._tracer  # nested execute (EXECUTE stmt) restores
         self._tracer = tracer
-        # stale-profile guard: only attribute a mesh profile to THIS query's
-        # statistics if the execution actually produced a fresh one; peak
-        # memory resets for the same reason (a failed or distributed query
-        # must not inherit the previous local execution's peak)
-        prof_before = getattr(self, "last_mesh_profile", None)
-        self._last_peak_memory = 0
+        # the statement's own handle (NULL_TRACER included): concurrent
+        # lanes resolve THEIR tracer through the lifecycle contextvar, so
+        # an untraced statement can never record into a traced neighbor's
+        # tree through the shared fallback attribute (lane safety)
+        ctx.tracer = tracer
         t0 = _time.time()
         self.events.query_created(QueryCreatedEvent(qid, sql, t0))
         try:
@@ -266,14 +357,18 @@ class LocalQueryRunner:
             etype = classify_error(e)
             queries_counter().labels(state, etype).inc()
             query_wall_histogram().observe(end - t0)
-            self._finish_trace(qid, tracer, prev_tracer)
+            self._finish_trace(qid, tracer, prev_tracer, ctx)
+            self._archive_profile(
+                ctx, sql, state, end - t0,
+                error_code=getattr(e, "error_code", None),
+            )
             self.events.query_completed(
                 QueryCompletedEvent(
                     qid, sql, state, t0, end, error=str(e),
                     error_type=etype,
                     error_code=getattr(e, "error_code", None),
                     statistics=self._query_statistics(
-                        end - t0, 0, tracer, prof_before
+                        end - t0, 0, tracer, ctx
                     ),
                 )
             )
@@ -286,12 +381,15 @@ class LocalQueryRunner:
         end = _time.time()
         queries_counter().labels("FINISHED", "").inc()
         query_wall_histogram().observe(end - t0)
-        self._finish_trace(qid, tracer, prev_tracer)
+        self._finish_trace(qid, tracer, prev_tracer, ctx)
+        self._archive_profile(
+            ctx, sql, "FINISHED", end - t0, rows=result.row_count
+        )
         self.events.query_completed(
             QueryCompletedEvent(
                 qid, sql, "FINISHED", t0, end, rows=result.row_count,
                 statistics=self._query_statistics(
-                    end - t0, result.row_count, tracer, prof_before
+                    end - t0, result.row_count, tracer, ctx
                 ),
             )
         )
@@ -317,14 +415,50 @@ class LocalQueryRunner:
             {"group": group, "queued_s": round(queued_s, 6)},
         )
 
-    def _finish_trace(self, qid: str, tracer, prev_tracer) -> None:
+    def _finish_trace(self, qid: str, tracer, prev_tracer, ctx=None) -> None:
         """Export the finished query's spans (Chrome JSON + the flattened
-        history row feeding system.runtime.spans)."""
+        history row feeding system.runtime.spans).  Stores the export on
+        the statement's own lifecycle context too, so the coordinator's
+        trace endpoint reads THIS query's trace even while other lanes
+        keep finishing (lane safety)."""
         self._tracer = prev_tracer
         if not tracer.enabled:
             return
-        self.last_trace = tracer.to_chrome_trace()
+        if ctx is not None and ctx.gate_wait_s > 0 and tracer.root is not None:
+            # device-gate contention next to the spans it delayed
+            tracer.root.attrs["gate_wait_s"] = round(ctx.gate_wait_s, 6)
+        trace = tracer.to_chrome_trace()
+        if ctx is not None:
+            ctx.trace_json = trace
+        self.last_trace = trace
         self.traces.append((qid, tracer.flat_spans()))
+
+    def _archive_profile(self, ctx, sql: str, state: str, wall_s: float,
+                         rows: int = 0, error_code=None) -> None:
+        """Assemble + archive this statement's profile artifact
+        (telemetry/profile_store) when a store is attached.  Assembly is
+        host-side dict building; the SPI write happens on the store's
+        background writer — off the statement hot path, after FINISHING.
+        Archiving must never break a query."""
+        store = getattr(self, "profile_store", None)
+        if store is None:
+            return
+        try:
+            from trino_tpu.telemetry.profile_store import artifact_from_runner
+
+            ctx.profile_ref = store.archive(
+                artifact_from_runner(
+                    self, ctx, sql, state, wall_s, rows=rows,
+                    error_code=error_code,
+                )
+            )
+        except Exception:
+            import logging
+
+            logging.getLogger("trino_tpu.profile_store").warning(
+                "failed to assemble profile artifact for %s", ctx.query_id,
+                exc_info=True,
+            )
 
     def compile_manifest(self) -> list:
         """The deduplicated (step, bucket, mesh) compile-key set this
@@ -337,16 +471,17 @@ class LocalQueryRunner:
 
         return OBSERVATORY.manifest()
 
-    def _query_statistics(self, wall_s: float, rows: int, tracer,
-                          prof_before=None):
-        """Build the QueryStatistics event payload from the execution's
-        telemetry (mesh profile when distributed, span count, peak
-        memory)."""
+    def _query_statistics(self, wall_s: float, rows: int, tracer, ctx):
+        """Build the QueryStatistics event payload from the statement's
+        OWN lifecycle handles (mesh profile when distributed, span count,
+        peak memory, device-gate wait, admission info) — per-statement by
+        construction, so concurrent lanes can't cross-attribute."""
         from trino_tpu.runtime.events import QueryStatistics
+        from trino_tpu.runtime.lifecycle import current_admission
 
         stats = QueryStatistics(wall_s=round(wall_s, 6), rows=rows)
-        prof = getattr(self, "last_mesh_profile", None)
-        if prof is not None and prof is not prof_before:
+        prof = ctx.mesh_profile
+        if prof is not None:
             stats.phase_totals_s = prof.phase_totals()
             stats.counters = dict(prof.counters)
             stats.trace_cache = {
@@ -354,7 +489,14 @@ class LocalQueryRunner:
                 "misses": prof.trace_misses,
                 "retraces": prof.retraces,
             }
-        stats.peak_memory_bytes = getattr(self, "_last_peak_memory", 0)
+        stats.peak_memory_bytes = ctx.peak_memory
+        stats.gate_wait_s = round(ctx.gate_wait_s, 6)
+        adm = current_admission()
+        if adm is not None:
+            stats.group, stats.queued_s = adm[0], round(adm[1], 6)
+        ref = ctx.profile_ref
+        if ref is not None:
+            stats.profile_key = ref["key"]
         if tracer.enabled:
             stats.spans = len(tracer.flat_spans())
         return stats

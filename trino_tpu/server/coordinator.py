@@ -93,7 +93,6 @@ class _Query:
         from trino_tpu.runtime.lifecycle import QueryCanceledException
 
         self.state = "RUNNING"
-        trace_before = getattr(runner, "last_trace", None)
         runner._query_context_cb = self._attach
         try:
             self.result = runner.execute(self.sql)
@@ -116,11 +115,14 @@ class _Query:
             # (parse/access-control errors): clear it so a later statement
             # never attaches ITS context to this dead query's cancel surface
             runner._query_context_cb = None
-            # span trace of THIS query (GET /v1/query/{id}/trace): the
-            # engine lock serializes executions, so a CHANGED last_trace is
-            # ours (unchanged = tracing off for this query, store nothing)
-            trace_after = getattr(runner, "last_trace", None)
-            self.trace = trace_after if trace_after is not trace_before else None
+            # span trace of THIS query (GET /v1/query/{id}/trace): read
+            # from the statement's OWN lifecycle context, so a neighboring
+            # lane finishing first can never hand us its trace (the
+            # pre-dispatcher code diffed the shared runner.last_trace,
+            # which raced under concurrent lanes)
+            with self._lock:
+                ctx = self.lifecycle
+            self.trace = getattr(ctx, "trace_json", None)
             self.done.set()
 
     def columns_json(self) -> list:
@@ -176,6 +178,15 @@ class CoordinatorServer:
                     1, int(get_config().dispatcher.lanes)
                 )
         self.resource_groups = resource_groups
+        # query performance observatory: the profile archive must attach
+        # BEFORE the dispatcher clones its engine lanes — lanes copy the
+        # runner's store reference at clone time, so a start()-time attach
+        # would leave lanes 1..N-1 storeless and silently skip archiving
+        # (N-1)/N of served queries.  Idempotent no-op when
+        # profile.archive-dir is unset or a store is already attached.
+        from trino_tpu.telemetry.profile_store import attach_profile_store
+
+        attach_profile_store(self.runner)
         #: the concurrent dispatcher (runtime/dispatcher): replaces the old
         #: global engine lock — statements admit through weighted-fair
         #: resource groups onto engine lanes, overload sheds, queued time
@@ -416,6 +427,61 @@ class CoordinatorServer:
                     self.wfile.write(body)
                     return
                 parts = self.path.strip("/").split("/")
+                # /v1/query/{id}/profile — the archived profile artifact
+                # (telemetry/profile_store): accepts the coordinator's
+                # q_N id (resolved to the engine query id through the
+                # attached lifecycle) or an engine query_N / artifact key
+                if (
+                    len(parts) == 4
+                    and parts[:2] == ["v1", "query"]
+                    and parts[3] == "profile"
+                ):
+                    store = getattr(server.runner, "profile_store", None)
+                    if store is None:
+                        return self._send(
+                            404,
+                            {
+                                "error": {
+                                    "message": "profile archive not "
+                                    "configured (set profile.archive-dir "
+                                    "or attach a ProfileStore)"
+                                }
+                            },
+                        )
+                    lookup = parts[2]
+                    q = server.query(lookup)
+                    if q is not None:
+                        q.done.wait(timeout=poll_wait_s())
+                        if not q.done.is_set():
+                            # a KNOWN still-running query must answer
+                            # "not yet" — falling through to the disk
+                            # scan could serve a PREVIOUS incarnation's
+                            # artifact under the same engine query_N id
+                            return self._send(
+                                404,
+                                {
+                                    "error": {
+                                        "message": "no archived profile "
+                                        "yet (query still running)"
+                                    }
+                                },
+                            )
+                        ctx = q.lifecycle
+                        if ctx is not None:
+                            lookup = ctx.query_id
+                    art = store.get(lookup)
+                    if art is None:
+                        return self._send(
+                            404,
+                            {
+                                "error": {
+                                    "message": "no archived profile for "
+                                    "this query (still running, or the "
+                                    "artifact was pruned)"
+                                }
+                            },
+                        )
+                    return self._send(200, art)
                 # /v1/query/{id}/trace — Perfetto/Chrome-trace JSON
                 if (
                     len(parts) == 4
@@ -599,6 +665,13 @@ class CoordinatorServer:
                 and callable(getattr(det, "stop", None)):
             det.start()
             self._detector_started = True
+        # the JSONL audit log attaches here when configured (idempotent
+        # no-op without audit.log-path; the event pipeline is SHARED
+        # across lanes, so unlike the profile store this can attach after
+        # the dispatcher cloned them)
+        from trino_tpu.telemetry.audit import attach_audit_log
+
+        attach_audit_log(self.runner)
         from trino_tpu.config import get_config
 
         pw = getattr(self.runner, "prewarm", None)

@@ -432,6 +432,15 @@ PREWARM_STATE_CODES = {
 }
 
 
+#: device-gate histogram buckets (seconds): gate waits/holds are the
+#: per-step time-slice granularity — sub-100µs uncontended, up to whole
+#: fragment walls when a long build holds the gate against other lanes
+GATE_SECONDS_BUCKETS = (
+    0.00001, 0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
 def _compile_events_total():
     from trino_tpu.telemetry.compile_events import OBSERVATORY
 
@@ -592,6 +601,59 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
         "tasks force-canceled because worker.drain-task-wait expired "
         "during a graceful drain (the bounded-drain escalation)",
     )
+    # device-gate / lane contention telemetry (runtime/dispatcher
+    # device_slice): wait is observed on CONTENDED acquires only, hold on
+    # holds during which another lane waited — the uncontended single-lane
+    # step stays one clock read (zero-cost-when-idle, the pressure-counter
+    # contract), so an idle scrape sees both series present at 0
+    reg.histogram(
+        _PREFIX + "device_gate_wait_seconds",
+        "seconds an engine lane waited to acquire the device time-slice "
+        "gate (contended acquires only; uncontended steps never observe)",
+        buckets=GATE_SECONDS_BUCKETS,
+    )
+    reg.histogram(
+        _PREFIX + "device_gate_hold_seconds",
+        "seconds the device gate was held while another lane waited "
+        "(the contention-relevant holds; uncontended holds are not timed)",
+        buckets=GATE_SECONDS_BUCKETS,
+    )
+    reg.gauge_fn(
+        _PREFIX + "device_gate_occupied",
+        "which engine lane currently holds the device time-slice gate "
+        "(1 on the holding lane's series; empty when the gate is idle)",
+        _gate_occupancy_series,
+        labelnames=("lane",),
+    )
+    reg.gauge_fn(
+        _PREFIX + "device_gate_waiters",
+        "engine lanes currently blocked waiting for the device gate",
+        _gate_waiters,
+    )
+    # query performance observatory (telemetry/profile_store +
+    # telemetry/audit): pre-registered AND touched so scrapes see the
+    # archive/audit vocabulary as real zeros before the first statement
+    # completes (the project convention since PR 4)
+    reg.counter(
+        _PREFIX + "profiles_archived_total",
+        "per-query profile artifacts archived by the profile store "
+        "(telemetry/profile_store; written through the filesystem SPI "
+        "off the hot path after FINISHING)",
+    ).touch()
+    reg.counter(
+        _PREFIX + "profiles_pruned_total",
+        "archived profile artifacts deleted by the retention sweep "
+        "(profile.retention-max-age / profile.retention-max-count)",
+    ).touch()
+    reg.counter(
+        _PREFIX + "audit_events_total",
+        "query-completion lines appended to the JSONL audit log "
+        "(telemetry/audit.QueryAuditLog)",
+    ).touch()
+    reg.counter(
+        _PREFIX + "audit_rotations_total",
+        "audit-log size-based rotations (audit.rotate-bytes)",
+    ).touch()
     reg.histogram(
         _PREFIX + "compile_seconds",
         "wall seconds per SPMD trace+XLA-compile (compile observatory "
@@ -667,6 +729,19 @@ def _breaker_series():
         (worker,): BREAKER_STATE_CODES[state]
         for worker, state in BREAKERS.states().items()
     }
+
+
+def _gate_occupancy_series():
+    from trino_tpu.runtime import dispatcher
+
+    holder = dispatcher.gate_holder()
+    return {} if holder < 0 else {(str(holder),): 1}
+
+
+def _gate_waiters():
+    from trino_tpu.runtime import dispatcher
+
+    return dispatcher.gate_waiters()
 
 
 def mesh_events_counter() -> Counter:
@@ -780,6 +855,36 @@ def prewarm_state_gauge() -> Gauge:
 def drain_force_kills_counter() -> Counter:
     """Tasks force-canceled by the bounded-drain escalation."""
     return REGISTRY.counter(_PREFIX + "drain_force_kills_total")
+
+
+def gate_wait_histogram() -> Histogram:
+    """Contended device-gate acquire waits (runtime/dispatcher)."""
+    return REGISTRY.histogram(_PREFIX + "device_gate_wait_seconds")
+
+
+def gate_hold_histogram() -> Histogram:
+    """Device-gate holds during which another lane waited."""
+    return REGISTRY.histogram(_PREFIX + "device_gate_hold_seconds")
+
+
+def profiles_archived_counter() -> Counter:
+    """Profile artifacts archived (telemetry/profile_store)."""
+    return REGISTRY.counter(_PREFIX + "profiles_archived_total")
+
+
+def profiles_pruned_counter() -> Counter:
+    """Artifacts deleted by the retention sweep."""
+    return REGISTRY.counter(_PREFIX + "profiles_pruned_total")
+
+
+def audit_events_counter() -> Counter:
+    """Lines appended to the JSONL audit log (telemetry/audit)."""
+    return REGISTRY.counter(_PREFIX + "audit_events_total")
+
+
+def audit_rotations_counter() -> Counter:
+    """Audit-log size-based rotations."""
+    return REGISTRY.counter(_PREFIX + "audit_rotations_total")
 
 
 _register_engine_metrics(REGISTRY)
