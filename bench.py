@@ -426,7 +426,32 @@ dist.execute(
     "'tpch.%s.lineitem:l_orderkey:8,tpch.%s.orders:o_orderkey:8'"
     % (schema, schema)
 )
+# proof-licensed capacity evidence (verify/capacity.py + compare_bench
+# check_licenses): over the WHOLE Q3 phase — cold and warm alike — the
+# licensed joins must never run the runtime sizing protocol
+# (runtime_check == 0; path selection is per-expansion, so cold counts too)
+# and the schedule license must have pre-dispatched at least one
+# independent build fragment asynchronously
+from trino_tpu.telemetry.metrics import (
+    JOIN_CAPACITY_OUTCOMES,
+    collective_async_counter,
+    join_capacity_counter,
+)
+_jc = join_capacity_counter()
+jc0 = {o: int(_jc.value((o,))) for o in JOIN_CAPACITY_OUTCOMES}
+ca0 = int(collective_async_counter().value(()))
 d3_rows, q3_mesh_cold, q3_mesh_warm, q3_coldstart = coldstart_run(3)
+q3_licenses = {
+    "join_capacity": {
+        o: int(_jc.value((o,))) - jc0[o] for o in JOIN_CAPACITY_OUTCOMES
+    },
+    "collective_async": int(collective_async_counter().value(())) - ca0,
+    "schedule": (
+        dist.last_schedule_license.to_json()
+        if getattr(dist, "last_schedule_license", None) is not None
+        else None
+    ),
+}
 q3_prof = dist.last_mesh_profile
 q3_counters = dict(q3_prof.counters) if q3_prof is not None else {}
 t0 = time.perf_counter()
@@ -529,13 +554,24 @@ print(json.dumps({
         "join_speculative_retry": q3_counters.get("join_speculative_retry", 0),
         "join_overflow_check": q3_counters.get("join_overflow_check", 0),
         "join_capacity_sync": q3_counters.get("join_capacity_sync", 0),
+        "join_capacity_proven": q3_counters.get("join_capacity_proven", 0),
+        "collective_async": q3_counters.get("collective_async", 0),
         "scan_bucketize": q3_counters.get("scan_bucketize", 0),
     },
+    # proof-licensed execution evidence over the Q3 phase (cold + warm):
+    # tools/compare_bench.py check_licenses gates runtime_check == 0,
+    # proven > 0, and the deleted sizing gather staying deleted
+    "licenses": q3_licenses,
     # per-collective byte attribution of the warm Q3 profile (the ROADMAP
     # item-2 evidence: all_to_all vs reduce vs gather, summing to the
-    # aggregate collective_bytes by construction)
+    # aggregate collective_bytes by construction).  The capacity_sizing
+    # key is ALWAYS emitted (0 when no sizing gather fired) so the
+    # licenses gate reads a real zero instead of a stale deep-merged value
     "q3_collective_bytes_by": (
-        q3_prof.to_json()["collective_bytes_by"]
+        {
+            "gather/capacity_sizing": 0,
+            **q3_prof.to_json()["collective_bytes_by"],
+        }
         if q3_prof is not None else None
     ),
     # compile observatory: cold wall decomposition + the warm-replay-zero

@@ -22,7 +22,12 @@ alone may hide (a retrace can cost little on tiny data and 30x on SF10):
   * `drift.*` (tools/drift_bench.py): the recorded Q3 drift attribution
     names a dominant (phase, fragment), its phase decomposition sums to
     the measured wall, and the warm-Q6 null-diff self check passes (two
-    warm archives of one statement must profile_diff to ~zero).
+    warm archives of one statement must profile_diff to ~zero);
+  * `licenses.*` (PR 15, check_licenses): proof-licensed joins ran ZERO
+    runtime sizing over the Q3 phase — `join_capacity.runtime_check == 0`
+    cold and warm, `proven > 0`, the schedule license pre-dispatched at
+    least one build fragment (`collective_async > 0`), and the deleted
+    `gather/capacity_sizing` collective stayed deleted.
 
 Modes:
   python tools/compare_bench.py                 # gate the checked-in file
@@ -53,12 +58,63 @@ PROFILE_ZERO = (
 #: MeshProfile counters that must be absent-or-zero on the recorded profile
 PROFILE_COUNTER_ZERO = ("host_restack",)
 
-#: q3 (layouts) counters that must be zero warm
+#: q3 (layouts) counters that must be zero warm.  `join_overflow_check`
+#: joined the list with proof-licensed execution (verify/capacity.py): a
+#: capacity-certified join compiles at its certified fixed capacity, so the
+#: warm profile must record NO overflow-flag reads at all
 Q3_ZERO = (
     "repartition_collective",
     "join_capacity_sync",
     "join_speculative_retry",
+    "join_overflow_check",
 )
+
+
+def check_licenses(schema: str, sec: dict) -> list:
+    """Violations over one mesh section's proof-licensed execution
+    evidence (`licenses`, recorded by bench.py around the Q3 phase): the
+    certified joins must NEVER have run the runtime sizing protocol —
+    cold or warm (`join_capacity.runtime_check == 0`, path selection is
+    per-expansion), at least one join must actually be proven
+    (`proven > 0`), the schedule license must have pre-dispatched an
+    independent build fragment (`collective_async > 0`), and the deleted
+    sizing gather must stay deleted (zero `gather/capacity_sizing` bytes
+    in the warm Q3 profile)."""
+    lic = sec.get("licenses")
+    if not isinstance(lic, dict):
+        return []  # older section: no license evidence recorded yet
+    violations = []
+    jc = lic.get("join_capacity") or {}
+    if jc.get("runtime_check", 1) != 0:
+        violations.append(
+            f"mesh.{schema}.licenses.join_capacity.runtime_check = "
+            f"{jc.get('runtime_check')} (expected 0: certified joins must "
+            "never fall back to the runtime sizing protocol over the Q3 "
+            "phase — a fallback means a license was refused or unsealed)"
+        )
+    if jc.get("proven", 0) <= 0:
+        violations.append(
+            f"mesh.{schema}.licenses.join_capacity.proven = "
+            f"{jc.get('proven')} (expected > 0: Q3's joins carry capacity "
+            "certificates; zero proven expansions means the license pass "
+            "attached nothing)"
+        )
+    if lic.get("collective_async", 0) <= 0:
+        violations.append(
+            f"mesh.{schema}.licenses.collective_async = "
+            f"{lic.get('collective_async')} (expected > 0: the schedule "
+            "license must have pre-dispatched at least one independent "
+            "build fragment asynchronously)"
+        )
+    bytes_by = sec.get("q3_collective_bytes_by") or {}
+    if bytes_by.get("gather/capacity_sizing"):
+        violations.append(
+            f"mesh.{schema}.q3_collective_bytes_by[gather/capacity_sizing]"
+            f" = {bytes_by['gather/capacity_sizing']} (expected absent: "
+            "the licensed joins' sizing round-trip is deleted, not merely "
+            "cheap)"
+        )
+    return violations
 
 #: decimal fast-path contract over the Q1 bench phase (PR 10): path
 #: selections are TRACE-time, so across cold+warm the licensed workload
@@ -464,6 +520,8 @@ def check_extra(extra: dict) -> tuple:
                         f"mesh.{schema}.q3_counters.{name} = {q3[name]} "
                         "(expected 0 under co-partitioned layouts)"
                     )
+        # proof-licensed execution gate (verify/capacity + verify/schedule)
+        violations.extend(check_licenses(schema, sec))
         fp = sec.get("decimal_fastpath")
         if isinstance(fp, dict):
             for name, desc, ok in DECIMAL_FASTPATH_RULES:

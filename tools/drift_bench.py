@@ -218,7 +218,14 @@ def build_drift_section(measured: dict, baseline_sec: dict,
             "dominant_fragment": dominant_fragment,
             "dominant_fragment_kind": dominant_kind,
             "dominant_fragment_phase": dominant_frag_phase,
-            "collective_bytes_by": art.get("collective_bytes_by", {}),
+            # gather/capacity_sizing is ALWAYS emitted (0 when no sizing
+            # gather fired — the proof-licensed join contract) so the
+            # BENCH_EXTRA deep merge overwrites stale values instead of
+            # resurrecting a deleted collective
+            "collective_bytes_by": {
+                "gather/capacity_sizing": 0,
+                **art.get("collective_bytes_by", {}),
+            },
             "sums_to_wall": abs(sum(phases.values()) - wall) < 1e-4,
         },
         "null_diff": {

@@ -692,6 +692,13 @@ def main(argv=None) -> int:
         "--only", choices=("device", "concurrency"), default=None,
         help="run a single pass (default: all)",
     )
+    ap.add_argument(
+        "--check-stale", action="store_true",
+        help="FAIL (exit 1) when a tools/lint_baseline.json entry no "
+        "longer matches any current finding — justified suppressions must "
+        "not outlive the code they excused (on in CI; without the flag "
+        "stale entries only print ratchet reminders)",
+    )
     args = ap.parse_args(argv)
     if args.only == "concurrency" and args.paths:
         # the concurrency pass is repo-wide (its lock-order graph and
@@ -716,23 +723,41 @@ def main(argv=None) -> int:
         print(f)
         if getattr(f, "baseline_key", None):
             print(f"  baseline key: {f.baseline_key!r}")
+    stale_word = "STALE" if args.check_stale else "note"
     for k in stale:
         print(
-            f"note: baseline entry {k!r} has no live finding — ratchet "
-            "tools/lint_baseline.json (unguarded_state) down"
+            f"{stale_word}: baseline entry {k!r} has no live finding — "
+            "ratchet tools/lint_baseline.json (unguarded_state) down"
         )
     if not args.paths:
         for k in numeric_stale:
             print(
-                f"note: numeric_safety baseline entry {k!r} has no live "
-                "finding — ratchet tools/lint_baseline.json down"
+                f"{stale_word}: numeric_safety baseline entry {k!r} has no "
+                "live finding — ratchet tools/lint_baseline.json down"
+            )
+    # stale-baseline detector (--check-stale, on in CI): a justified
+    # suppression whose finding no longer fires has outlived the code it
+    # excused — failing here forces the ratchet instead of letting dead
+    # justifications accumulate.  Path-scoped runs skip it: staleness is
+    # only meaningful against the FULL finding set.
+    stale_errors = []
+    if args.check_stale and not args.paths:
+        stale_errors = [
+            f"stale baseline entry (no live finding): {k!r}"
+            for k in list(stale) + list(numeric_stale)
+        ]
+        if stale_errors:
+            print(
+                f"{len(stale_errors)} stale baseline entr"
+                f"{'y' if len(stale_errors) == 1 else 'ies'} — delete them "
+                "from tools/lint_baseline.json (--check-stale)"
             )
     budget_errors = []
     if not args.paths:  # budget is repo-wide; skip for targeted runs
         budget_errors = check_suppression_budget(None, root)
         for e in budget_errors:
             print(e)
-    if findings or budget_errors:
+    if findings or budget_errors or stale_errors:
         if findings:
             print(f"\n{len(findings)} finding(s) across "
                   f"{len({f.file for f in findings})} file(s)")

@@ -108,6 +108,13 @@ class ColumnStatistics:
     null_fraction: float = 0.0
     low: object = None
     high: object = None
+    #: distinct_count is STRUCTURALLY exact (dense surrogate keys, literal
+    #: enumerations) rather than an estimate or probabilistic upper bound.
+    #: Only exact counts are admissible as UNIQUENESS proofs
+    #: (verify.capacity.unique_sets): a random FK column of a tiny table
+    #: may claim ndv == rows and still collide — that claim must never
+    #: license a join fanout certificate.
+    exact_distinct: bool = False
 
 
 @dataclass(frozen=True)

@@ -59,15 +59,29 @@ class TpcdsMetadata(ConnectorMetadata):
         pk = ds_schema.TABLES[table][0][0]
         for name, _t in ds_schema.TABLES[table]:
             if name == pk and name.endswith("_sk") and not is_fact:
+                # dense surrogate key: the distinct count is a structural
+                # fact, admissible as a uniqueness proof.  time_dim's PK
+                # is 0-based (generator._t_time_dim returns the raw row
+                # index) where every other dimension PK is 1-based
+                # (idx + 1); claiming [1, rows] for it was unsound.
+                # d_date_sk is julian-based, overridden below.
+                lo = 0 if table == "time_dim" else 1
                 cols[name] = ColumnStatistics(
-                    distinct_count=rows, low=1, high=rows
+                    distinct_count=rows, low=lo, high=lo + rows - 1,
+                    exact_distinct=True,
                 )
                 continue
             if name.endswith("_date_sk"):
+                # returns tables lag their parent sale by 1..90 days
+                # (generator._return_column), so the returned-date range
+                # extends past the sales window — the plain sales-window
+                # claim was UNSOUND for *_returned_date_sk (caught by the
+                # stats-vs-generator validation test)
+                lag = 90 if name.endswith("_returned_date_sk") else 0
                 cols[name] = ColumnStatistics(
-                    distinct_count=min(rows, SALES_DAYS),
-                    low=SALES_START,
-                    high=SALES_START + SALES_DAYS - 1,
+                    distinct_count=min(rows, SALES_DAYS + lag),
+                    low=SALES_START + (1 if lag else 0),
+                    high=SALES_START + SALES_DAYS - 1 + lag,
                     null_fraction=nullf,
                 )
                 continue
@@ -87,21 +101,62 @@ class TpcdsMetadata(ConnectorMetadata):
                         null_fraction=nullf,
                     )
                     break
+            if name in cols:
+                continue
+            # generic-rule ranges: exact by construction (the generator's
+            # own randint bounds), admissible for numeric/capacity proofs —
+            # quantity/price/measure columns stop reading as full-dtype
+            rng = gen.column_range(table, name)
+            if rng is not None:
+                cols[name] = ColumnStatistics(low=rng[0], high=rng[1])
         if table == "date_dim":
             import numpy as np
 
             base = np.datetime64("1900-01-01")
+            from trino_tpu.connectors.tpcds.generator import JULIAN_1900
+
+            # the calendar runs `rows` consecutive days from 1900-01-01;
+            # every derived sequence below is an exact function of the row
+            # index (see generator._t_date_dim), so these bounds are the
+            # generator's own rules, not estimates
+            months0_max = int(
+                (base + np.timedelta64(max(0, rows - 1), "D"))
+                .astype("datetime64[M]")
+                .astype(np.int64)
+            ) + 70 * 12
+            cols["d_date_sk"] = ColumnStatistics(
+                # FIX: the dense-PK rule above claimed [1, rows], but
+                # d_date_sk is julian-day based (idx + JULIAN_1900) — the
+                # old claim was unsound for any proof reading it
+                distinct_count=rows, low=JULIAN_1900,
+                high=JULIAN_1900 + rows - 1, exact_distinct=True,
+            )
             cols["d_year"] = ColumnStatistics(
                 distinct_count=201, low=1900, high=2100
             )
+            cols["d_fy_year"] = cols["d_year"]
             cols["d_date"] = ColumnStatistics(
-                distinct_count=rows,
+                distinct_count=rows, exact_distinct=True,
                 low=int((base - np.datetime64("1970-01-01")).astype(int)),
                 high=int((base - np.datetime64("1970-01-01")).astype(int)) + rows,
             )
             cols["d_moy"] = ColumnStatistics(distinct_count=12, low=1, high=12)
             cols["d_dom"] = ColumnStatistics(distinct_count=31, low=1, high=31)
+            cols["d_dow"] = ColumnStatistics(distinct_count=7, low=0, high=6)
             cols["d_qoy"] = ColumnStatistics(distinct_count=4, low=1, high=4)
+            week_hi = rows // 7 + 1
+            cols["d_week_seq"] = ColumnStatistics(
+                distinct_count=week_hi, low=1, high=week_hi
+            )
+            cols["d_fy_week_seq"] = cols["d_week_seq"]
+            cols["d_month_seq"] = ColumnStatistics(
+                distinct_count=months0_max + 1, low=0, high=months0_max
+            )
+            quarter_hi = months0_max // 3 + 1
+            cols["d_quarter_seq"] = ColumnStatistics(
+                distinct_count=quarter_hi, low=1, high=quarter_hi
+            )
+            cols["d_fy_quarter_seq"] = cols["d_quarter_seq"]
         return TableStatistics(row_count=rows, columns=cols)
 
 

@@ -35,6 +35,18 @@ _EPOCH = datetime.date(1970, 1, 1)
 SALES_START = JULIAN_1900 + (datetime.date(1998, 1, 2) - _D1900).days
 SALES_DAYS = 365 * 5
 
+#: inclusive randint bounds for `_generic`'s fallthrough numeric/date
+#: columns.  `column_range` publishes these same tuples as EXACT range
+#: claims consumed by the numeric/capacity verifiers — one definition,
+#: so the generated values and the claims can never desync.
+GENERIC_DECIMAL_SHORT = (0, 100_00)  # precision <= 7, scaled units
+GENERIC_DECIMAL_LONG = (0, 1000_00)
+GENERIC_INTEGER = (1, 100)
+GENERIC_BIGINT = (1, 1000)
+#: epoch-day window generic DATE columns draw from
+GENERIC_DATE_BASE = (datetime.date(1998, 1, 2) - _EPOCH).days
+GENERIC_DATE = (GENERIC_DATE_BASE, GENERIC_DATE_BASE + SALES_DAYS)
+
 # -- fixed vocabularies (spec-visible values queries filter on) --------------
 
 CATEGORIES = (
@@ -195,6 +207,42 @@ class TpcdsGenerator:
         cd = self.column(table, col, 0, 1)
         return cd.dictionary
 
+    def column_range(self, table: str, col: str):
+        """Exact (low, high) LOGICAL-unit value range of a GENERICALLY
+        generated column, or None when no sound claim exists.  Mirrors
+        `column()`'s dispatch: a column a `_t_<table>` special handles
+        makes no generic claim (probed with one row — cheap, and exact
+        because the dispatch is per-column, not per-row).  These ranges
+        are the generator's own rules (randint bounds are inclusive), so
+        they are admissible proof sources for the numeric/capacity
+        verifiers — the same standing as the key-range stats above."""
+        t = dict(column_types(table))[col]
+        special = getattr(self, f"_t_{table}", None)
+        if special is not None:
+            try:
+                if special(col, np.arange(1, dtype=np.int64), t) is not None:
+                    return None
+            except Exception:
+                return None
+        if col.endswith(("_sk", "_id")):
+            return None  # key columns: explicit stats rules in the connector
+        for suffix, _ref in _FK_SUFFIX:
+            if col.endswith(suffix):
+                return None
+        if isinstance(t, T.DecimalType):
+            lo, hi = (
+                GENERIC_DECIMAL_SHORT if t.precision <= 7
+                else GENERIC_DECIMAL_LONG
+            )
+            return (lo, hi / t.scale_factor)
+        if t.name == "integer":
+            return GENERIC_INTEGER
+        if t.name == "bigint":
+            return GENERIC_BIGINT
+        if t is T.DATE:
+            return GENERIC_DATE
+        return None
+
     # -- generic rules --------------------------------------------------------
 
     def _generic(self, table: str, col: str, idx, t) -> ColumnData:
@@ -220,16 +268,23 @@ class TpcdsGenerator:
             d = _pat(prefix, 12, max(n, 1))
             return ColumnData(idx.astype(np.int32), None, d)
         if isinstance(t, T.DecimalType):
-            lo, hi = (0, 100_00) if t.precision <= 7 else (0, 1000_00)
+            lo, hi = (
+                GENERIC_DECIMAL_SHORT if t.precision <= 7
+                else GENERIC_DECIMAL_LONG
+            )
             return ColumnData(randint(stream, idx, lo, hi), None)
         if t.name == "integer":
-            return ColumnData(randint(stream, idx, 1, 100).astype(np.int32), None)
-        if t.name == "bigint":
-            return ColumnData(randint(stream, idx, 1, 1000), None)
-        if t is T.DATE:
-            base = (datetime.date(1998, 1, 2) - _EPOCH).days
             return ColumnData(
-                (base + randint(stream, idx, 0, SALES_DAYS)).astype(np.int32), None
+                randint(stream, idx, *GENERIC_INTEGER).astype(np.int32), None
+            )
+        if t.name == "bigint":
+            return ColumnData(randint(stream, idx, *GENERIC_BIGINT), None)
+        if t is T.DATE:
+            return ColumnData(
+                randint(
+                    stream, idx, GENERIC_DATE[0], GENERIC_DATE[1]
+                ).astype(np.int32),
+                None,
             )
         if T.is_string_kind(t):
             if col.endswith(("_flag", "_active")) or t.name == "varchar(1)":

@@ -53,49 +53,54 @@ class TpchMetadata(ConnectorMetadata):
         rows = gen.row_count(table)
         from trino_tpu.connectors.tpch.generator import ORDER_DATE_SPAN, START_DATE
 
-        def C(ndv=None, low=None, high=None, nulls=0.0):
+        def C(ndv=None, low=None, high=None, nulls=0.0, exact=False):
+            # exact=True: the distinct count is a STRUCTURAL fact of the
+            # generator (dense idx+1 keys), admissible as a uniqueness
+            # proof; everything else is a bound/estimate and never
+            # licenses a fanout certificate (verify.capacity)
             return ColumnStatistics(
-                distinct_count=ndv, low=low, high=high, null_fraction=nulls
+                distinct_count=ndv, low=low, high=high, null_fraction=nulls,
+                exact_distinct=exact,
             )
 
         S, P, Ccust, O = gen.S, gen.P, gen.C, gen.O
         od_hi = START_DATE + ORDER_DATE_SPAN
         per_table = {
             "region": {
-                "r_regionkey": C(5, 0, 4), "r_name": C(5), "r_comment": C(5),
+                "r_regionkey": C(5, 0, 4, exact=True), "r_name": C(5), "r_comment": C(5),
             },
             "nation": {
-                "n_nationkey": C(25, 0, 24), "n_name": C(25),
+                "n_nationkey": C(25, 0, 24, exact=True), "n_name": C(25),
                 "n_regionkey": C(5, 0, 4), "n_comment": C(25),
             },
             "supplier": {
-                "s_suppkey": C(S, 1, S), "s_name": C(S), "s_address": C(S),
+                "s_suppkey": C(S, 1, S, exact=True), "s_name": C(S), "s_address": C(S),
                 "s_nationkey": C(25, 0, 24), "s_phone": C(S),
                 "s_acctbal": C(min(S, 1_100_000), -999.99, 9999.99),
                 "s_comment": C(S),
             },
             "part": {
-                "p_partkey": C(P, 1, P), "p_name": C(P),
+                "p_partkey": C(P, 1, P, exact=True), "p_name": C(P),
                 "p_mfgr": C(5), "p_brand": C(25), "p_type": C(150),
                 "p_size": C(50, 1, 50), "p_container": C(40),
                 "p_retailprice": C(min(P, 120_000), 900.0, 2100.0),
                 "p_comment": C(P),
             },
             "partsupp": {
-                "ps_partkey": C(P, 1, P), "ps_suppkey": C(S, 1, S),
+                "ps_partkey": C(P, 1, P, exact=True), "ps_suppkey": C(S, 1, S),
                 "ps_availqty": C(9999, 1, 9999),
                 "ps_supplycost": C(100_000, 1.0, 1000.0),
                 "ps_comment": C(rows),
             },
             "customer": {
-                "c_custkey": C(Ccust, 1, Ccust), "c_name": C(Ccust),
+                "c_custkey": C(Ccust, 1, Ccust, exact=True), "c_name": C(Ccust),
                 "c_address": C(Ccust), "c_nationkey": C(25, 0, 24),
                 "c_phone": C(Ccust),
                 "c_acctbal": C(min(Ccust, 1_100_000), -999.99, 9999.99),
                 "c_mktsegment": C(5), "c_comment": C(Ccust),
             },
             "orders": {
-                "o_orderkey": C(O, 1, O),
+                "o_orderkey": C(O, 1, O, exact=True),
                 # 2/3 of customers hold orders (spec 4.2.3)
                 "o_custkey": C(max(1, Ccust * 2 // 3), 1, Ccust),
                 "o_orderstatus": C(3), "o_totalprice": C(O, 800.0, 600_000.0),
@@ -104,7 +109,7 @@ class TpchMetadata(ConnectorMetadata):
                 "o_shippriority": C(1, 0, 0), "o_comment": C(O),
             },
             "lineitem": {
-                "l_orderkey": C(O, 1, O), "l_partkey": C(P, 1, P),
+                "l_orderkey": C(O, 1, O, exact=True), "l_partkey": C(P, 1, P),
                 "l_suppkey": C(S, 1, S), "l_linenumber": C(7, 1, 7),
                 "l_quantity": C(50, 1, 50),
                 "l_extendedprice": C(min(rows, 3_800_000), 900.0, 105_000.0),

@@ -106,6 +106,10 @@ from trino_tpu.runtime.memory import batch_bytes
 from trino_tpu.runtime.query_stats import MeshProfile
 from trino_tpu.telemetry import now
 from trino_tpu.telemetry.compile_events import OBSERVATORY
+from trino_tpu.telemetry.metrics import (
+    collective_async_counter,
+    join_capacity_counter,
+)
 from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
 from trino_tpu.planner.functions import HOLISTIC_AGGS, PARTITIONABLE_HOLISTIC
 
@@ -267,7 +271,9 @@ class DistributedQueryRunner(LocalQueryRunner):
     # -- planning -------------------------------------------------------------
 
     def create_subplan(self, plan: P.OutputNode) -> SubPlan:
+        from trino_tpu.verify.capacity import seal_licenses
         from trino_tpu.verify.collectives import collective_signature
+        from trino_tpu.verify.schedule import license_schedule
 
         dplan = add_exchanges(
             plan, self.catalogs, self.properties, n_workers=self.wm.n
@@ -278,10 +284,21 @@ class DistributedQueryRunner(LocalQueryRunner):
             catalogs=self.catalogs,
             n_workers=self.wm.n,
         )
+        # seal every capacity certificate for THIS mesh width: the stage
+        # executor honors a license only when the seal matches the mesh it
+        # is executing on, so a subplan replayed against a shrunk/grown
+        # mesh falls back to the runtime sizing path (never a stale cap)
+        for frag in sub.all_fragments():
+            seal_licenses(frag.root, self.wm.n)
         # the statically enumerated per-fragment collective sequence of the
         # MOST RECENT subplan: verify.device_residency holds warm replays
         # to it (a warm run must issue exactly the recorded collectives)
         self.last_collective_signature = collective_signature(sub)
+        # collective-schedule license: divergence-free fragments authorize
+        # eager pre-dispatch of independent build-side child fragments
+        # (verify/schedule.py); device_residency verifies warm replays
+        # against the licensed schedule
+        self.last_schedule_license = license_schedule(sub, self.wm.n)
         return sub
 
     def explain_distributed(self, sql: str) -> str:
@@ -318,6 +335,7 @@ class DistributedQueryRunner(LocalQueryRunner):
                 else getattr(self, "_current_qid", "q")
             ),
             profile=profile,
+            schedule=getattr(self, "last_schedule_license", None),
         )
         #: kept for tests / EXPLAIN evidence (dynamic filter pruning counts)
         self.last_stage_executor = executor
@@ -345,12 +363,20 @@ class StageExecutor:
     TASK_ATTEMPTS = 4
 
     def __init__(self, catalogs, wm: WorkerMesh, properties, query_id: str = "q",
-                 profile: Optional[MeshProfile] = None):
+                 profile: Optional[MeshProfile] = None, schedule=None):
         self.catalogs = catalogs
         self.wm = wm
         self.properties = properties
         self.query_id = query_id
         self.profile = profile if profile is not None else MeshProfile()
+        #: collective-schedule license (verify/schedule.py): authorizes
+        #: eager pre-dispatch of independent build-side child fragments;
+        #: None = strictly lazy, order-conservative dispatch
+        self.schedule = (
+            schedule
+            if schedule is not None and schedule.mesh_w == wm.n
+            else None
+        )
         self._subplans: dict[int, SubPlan] = {}
         self._results: dict[int, object] = {}
         self._root_fid: Optional[int] = None
@@ -376,6 +402,10 @@ class StageExecutor:
             self.colocate = bool(properties.get("colocated_join"))
         except KeyError:  # pragma: no cover - older property sets
             self.colocate = True
+        try:
+            self.license_caps = bool(properties.get("join_capacity_license"))
+        except KeyError:  # pragma: no cover - older property sets
+            self.license_caps = True
         if self.retry_task:
             from trino_tpu.runtime.fte import SpoolManager
 
@@ -504,7 +534,8 @@ class StageExecutor:
             self._root_fid = sub.fragment.id
             out = self._fragment_result(sub.fragment.id)
             if isinstance(out, _Dist):  # defensive: root should be SINGLE
-                host = unstack_batch(device_get_async(out.stacked))  # lint: allow(host-transfer)
+                self._current_fid = sub.fragment.id
+                host = unstack_batch(device_get_async(self._gather_compact(out.stacked)))  # lint: allow(host-transfer)
                 self.profile.bump("result_gather")
                 self.profile.add_collective(
                     self._root_fid, batch_bytes(host), "gather",
@@ -580,6 +611,22 @@ class StageExecutor:
             with self.profile.tracer.span(
                 f"fragment-{fid}", kind=str(sub.fragment.partitioning)
             ):
+                # schedule-licensed async dispatch (verify/schedule.py):
+                # this fragment's independent build-side feeds dispatch
+                # eagerly, back to back, so their exchange collectives
+                # overlap the consumer body's host work.  Licensed feeds
+                # are sync-free and divergence-free by construction, and
+                # sit on the body's first-evaluated spine — the lazy
+                # order would run them before any of THIS body's dynamic
+                # filters register, so pre-dispatch cannot bypass
+                # pruning.
+                if self.schedule is not None:
+                    for cfid in self.schedule.async_children.get(fid, ()):
+                        if cfid in self._results or cfid not in self._subplans:
+                            continue
+                        self._fragment_result(cfid)
+                        self.profile.bump("collective_async")
+                        collective_async_counter().inc()
                 for _ in range(attempts):
                     check_current()  # fragment-boundary cancellation point
                     try:
@@ -759,6 +806,42 @@ class StageExecutor:
         """Child fragment result WITHOUT the exchange applied."""
         return self._fragment_result(node.fragment_id)
 
+    def _compact_live(self, batch: Batch, tag: str) -> Batch:
+        """Compact a stacked batch to the pow2 bucket of the max
+        per-worker live count (live rows may sit at scattered slots, so
+        this is a gather, not a slice).  Costs one [W] live-count host
+        read under a 'transfer' phase — callers only use it at edges
+        where a host sync is already being paid (state edges, host
+        boundaries)."""
+        cap = _trailing_cap(batch)
+        with self.profile.phase(self._current_fid, "transfer"):
+            live = self._host_pull(jnp.sum(batch.mask(), axis=-1))
+        cap2 = bucket_cap(int(live.max()), floor=64)
+        if cap2 >= cap:
+            return batch
+
+        def build():
+            def step(b: Batch) -> Batch:
+                return b.compact_device(out_capacity=cap2)
+
+            return step
+
+        fn = cached_spmd_step(self.wm, (tag, cap2), build)
+        return self._call(fn, batch)
+
+    def _gather_compact(self, stacked: Batch) -> Batch:
+        """Compact to the live bucket before a host gather, so the
+        device->host pull moves data, not dead capacity.  Matters most
+        for proof-licensed joins: their certified (sound,
+        data-independent) capacities can sit well above the live row
+        count, and shipping the padding to the host would hand the saved
+        sizing sync straight back as transfer + host-iteration cost.
+        The data is about to cross the host boundary anyway, so the
+        live-count read adds no new device-pipeline stall."""
+        if _trailing_cap(stacked) <= 64:
+            return stacked
+        return self._compact_live(stacked, "gather_compact")
+
     def _remote_as_host(self, node: RemoteSourceNode) -> PhysicalPlan:
         """Apply a gather/merge exchange into host batches."""
         child = self._raw_remote(node)
@@ -769,6 +852,7 @@ class StageExecutor:
             batch = self._merge_gather(child, node)
         else:
             stacked = child.stacked  # deferred chain runs as its own phase
+            stacked = self._gather_compact(stacked)
             with self.profile.phase(fid, "transfer"):
                 batch = unstack_batch(device_get_async(stacked))  # lint: allow(host-transfer)
         purpose = "result_gather" if fid == self._root_fid else "host_gather"
@@ -784,7 +868,11 @@ class StageExecutor:
         (MergeOperator/MergeSortedPages role)."""
         from trino_tpu.ops.merge import merge_sorted_shards
 
-        host = device_get_async(child.stacked)  # lint: allow(host-transfer)
+        # compaction is STABLE (cumsum-scatter keeps live-row order), so
+        # the per-worker sorted runs stay sorted for the host merge
+        host = device_get_async(  # lint: allow(host-transfer)
+            self._gather_compact(child.stacked)
+        )
         keys = [
             SortKey(child.channel(s.name), asc, nf)
             for s, asc, nf in node.orderings
@@ -1105,28 +1193,10 @@ class StageExecutor:
         return states, specs, partial_op
 
     def _compact_states(self, states: Batch) -> Batch:
-        """Compact a [W, cap] partial-state batch down to the pow2 bucket of
-        the max per-worker live-group count (live states may sit at
-        range-positional slots, so this is a gather, not a slice).  One tiny
-        [W] host sync; the downstream exchange + final program then run at
+        """Compact a [W, cap] partial-state batch down to its live
+        bucket; the downstream exchange + final program then run at
         state scale, not input scale."""
-        cap = _trailing_cap(states)
-        with self.profile.phase(self._current_fid, "transfer"):
-            live = np.asarray(  # lint: allow(host-sync-asarray)
-                device_get_async(jnp.sum(states.mask(), axis=-1))  # lint: allow(host-transfer)
-            )
-        cap2 = bucket_cap(int(live.max()), floor=64)
-        if cap2 >= cap:
-            return states
-
-        def build():
-            def step(b: Batch) -> Batch:
-                return b.compact_device(out_capacity=cap2)
-
-            return step
-
-        fn = cached_spmd_step(self.wm, ("state_compact", cap2), build)
-        return self._call(fn, states)
+        return self._compact_live(states, "state_compact")
 
     def _final_op(self, specs, partial_op, states) -> AggregationOperator:
         # state types read off the stacked columns directly — the old
@@ -1551,10 +1621,25 @@ class StageExecutor:
             locate, device_emit_total, expand = self._join_step_fns(
                 node, op, pk, bk, _trailing_cap(build_stacked), probe_types
             )
+            # proof-licensed capacity (verify/capacity.py): a certificate
+            # sealed for THIS mesh width licenses a fixed expand capacity
+            # — the sizing gather, overflow flag, and speculative retry
+            # are deleted, not skipped.  Any mismatch (mesh shrink, knob
+            # off, memory-pressure waves above) falls back to the runtime
+            # sizing path: the license is an optimization with a proof,
+            # never a correctness dependency.
+            cert = getattr(node, "capacity_cert", None)
+            if not (
+                self.license_caps
+                and cert is not None
+                and cert.valid_for(self.wm.n)
+            ):
+                cert = None
             out = self._sized_expansion(
                 ("join",) + jkey, probe_stacked, build_stacked,
                 locate, device_emit_total, expand, compact_probe=True,
                 stats_key=("join",) + jkey + (probe_fp,),
+                cert=cert,
             )
             ctx.close()
         return self._dist(
@@ -1766,7 +1851,7 @@ class StageExecutor:
     def _sized_expansion(self, key, probe_stacked, build_stacked,
                          locate, device_total, expand,
                          compact_probe: bool = False,
-                         stats_key=None) -> Batch:
+                         stats_key=None, cert=None) -> Batch:
         """Run a locate+expand pair whose static output capacity depends on
         the data, under the `join_speculative_capacity` policy:
 
@@ -1788,15 +1873,46 @@ class StageExecutor:
 
         Cold and warm paths agree on the expand capacity (the tight
         bucket), so every downstream static shape is identical across runs
-        — warm replays retrace nothing."""
+        — warm replays retrace nothing.
+
+        A capacity certificate (`cert`, verify/capacity.py) supersedes the
+        whole protocol: the proven per-probe-row fanout bounds the emitted
+        total by the probe batch's STATIC capacity, so the expand compiles
+        at the certified fixed capacity with NO sizing gather, NO overflow
+        flag, and NO retry — zero `join_overflow_check`, zero
+        `gather/capacity_sizing` bytes, cold and warm alike."""
+        cap_p = _trailing_cap(probe_stacked)
+        fid = self._current_fid
+
+        if cert is not None:  # proof-licensed fixed capacity
+            oc = next_pow2(
+                cert.licensed_out_cap(cap_p),
+                floor=min(1024, next_pow2(cap_p, floor=1)),
+            )
+
+            def build_licensed(_oc=oc):
+                def step(pb: Batch, bb: Batch):
+                    sb, start, count = locate(pb, bb)
+                    total = device_total(pb, count)
+                    return expand(pb, sb, start, count, total, _oc)
+
+                return step
+
+            fn = cached_spmd_step(
+                self.wm, ("licensed_expand", oc) + key, build_licensed
+            )
+            out = self._call(fn, probe_stacked, build_stacked)
+            self.profile.bump("join_capacity_proven")
+            join_capacity_counter().labels("proven").inc()
+            return out
+
+        join_capacity_counter().labels("runtime_check").inc()
         spec = speculation_mode(self.properties)
         hist_key = ("cap",) + (stats_key if stats_key is not None else key)
         pkey = ("pcap",) + (stats_key if stats_key is not None else key)
         out_cap = (
             initial_cap(hist_key, spec) if spec is not None else None
         )
-        cap_p = _trailing_cap(probe_stacked)
-        fid = self._current_fid
 
         while out_cap is not None:  # speculative fused path
             pcap = CAP_HISTORY.guess(pkey, cap_p) if compact_probe else cap_p

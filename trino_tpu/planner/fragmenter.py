@@ -260,6 +260,9 @@ class ExchangePlacer:
                 [(r, l) for l, r in node.criteria],
                 node.filter,
                 node.distribution,
+                # the capacity certificate is NOT carried: it proved the
+                # original right side unique, and the flip makes the old
+                # LEFT the build side — the runtime sizing path stays on
             )
         left, ldist = self._visit(node.left)
         right, rdist = self._visit(node.right)
@@ -295,21 +298,24 @@ class ExchangePlacer:
             if dist == "colocated" or lex is left:
                 return (
                     P.JoinNode(
-                        node.kind, lex, rex, node.criteria, node.filter, dist
+                        node.kind, lex, rex, node.criteria, node.filter,
+                        dist, node.capacity_cert,
                     ),
                     _Distribution.DISTRIBUTED,
                 )
         if broadcast:
             ex = P.ExchangeNode(right, "broadcast")
             out = P.JoinNode(
-                node.kind, left, ex, node.criteria, node.filter, "broadcast"
+                node.kind, left, ex, node.criteria, node.filter,
+                "broadcast", node.capacity_cert,
             )
         else:
             lex, rex, dist = self._partitioned_join_sides(
                 left, right, node.criteria
             )
             out = P.JoinNode(
-                node.kind, lex, rex, node.criteria, node.filter, dist
+                node.kind, lex, rex, node.criteria, node.filter, dist,
+                node.capacity_cert,
             )
         return out, _Distribution.DISTRIBUTED
 

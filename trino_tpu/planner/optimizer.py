@@ -498,7 +498,22 @@ def optimize(plan: P.OutputNode, rules=None, catalogs=None, verify=None) -> P.Ou
     # checks.  Proof-only: the pass never changes plan shape or results.
     from trino_tpu.verify.numeric import license_decimal_sums
 
+    # capacity licensing FIRST (verify/capacity.py): a join whose build key
+    # is proven unique gets a capacity_cert — the mesh runner compiles its
+    # expand at the certified fixed capacity with no sizing gather — and
+    # its fanout-aware row bounds are what let the decimal-sum licensing
+    # below prove sums ABOVE joins.  Both passes are proof-only.
+    from trino_tpu.verify.capacity import (
+        check_capacity_certificates,
+        license_join_capacities,
+    )
+
+    license_join_capacities(plan, catalogs)
     license_decimal_sums(plan, catalogs)
+    if vmode == "strict":
+        # the verifier rule holds the licensing pass itself to account: a
+        # cert that re-derivation cannot justify fails right here
+        V.enforce(check_capacity_certificates(plan, catalogs), vmode)
     assert isinstance(plan, P.OutputNode)
     return plan
 

@@ -198,6 +198,12 @@ class JoinNode(PlanNode):
     filter: Optional[Expr] = None  # residual over combined symbols
     #: planner hint: 'partitioned' or 'broadcast' (AddExchanges decision)
     distribution: Optional[str] = None
+    #: proof-licensed capacity certificate (verify.capacity): attached by
+    #: license_join_capacities at the end of optimize() when the build-side
+    #: key is proven unique — the mesh runner then compiles the expand at
+    #: the certified fixed capacity with NO sizing gather, overflow flag,
+    #: or speculative retry (None = runtime sizing path)
+    capacity_cert: Optional[object] = None
 
     @property
     def outputs(self):
@@ -210,7 +216,7 @@ class JoinNode(PlanNode):
     def with_children(self, children):
         return JoinNode(
             self.kind, children[0], children[1], self.criteria, self.filter,
-            self.distribution,
+            self.distribution, self.capacity_cert,
         )
 
 

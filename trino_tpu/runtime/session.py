@@ -160,6 +160,14 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "on",
         ),
         PropertyMetadata(
+            "join_capacity_license",
+            "honor capacity certificates (verify.capacity): proven joins "
+            "compile at the certified fixed capacity with zero runtime "
+            "sizing (false = always run the speculative/sizing path)",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
             "table_layouts",
             "declared hash-bucketed layouts for generated tables: "
             "'catalog.schema.table:col1+col2:bucket_count', comma-separated",

@@ -380,6 +380,8 @@ MESH_COUNTER_NAMES = (
     "join_overflow_check",
     "join_capacity_sync",
     "join_speculative_retry",
+    "join_capacity_proven",
+    "collective_async",
     "memory_wave",
     "spill_bytes",
 )
@@ -408,6 +410,15 @@ COLLECTIVE_VOCABULARY = (
 #: window frame sums), pre-registered so the zero-runtime-check gate in
 #: tools/compare_bench.py reads real zeros, not absent series
 DECIMAL_FASTPATHS = ("proven", "runtime_check", "limb")
+
+
+#: join capacity-sizing outcome vocabulary (verify/capacity.py +
+#: parallel/runner._sized_expansion): proven = a capacity certificate
+#: licensed a fixed-capacity expand (no sizing gather, no overflow flag),
+#: runtime_check = the speculative/sizing fallback ran its runtime
+#: protocol.  Pre-registered so the compare_bench check_licenses gate
+#: reads real zeros, not absent series.
+JOIN_CAPACITY_OUTCOMES = ("proven", "runtime_check")
 
 
 #: membership transition vocabulary, pre-registered so scrapes see
@@ -677,6 +688,23 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
     )
     for p in DECIMAL_FASTPATHS:
         fastpath.touch(p)
+    joincap = reg.counter(
+        _PREFIX + "join_capacity_total",
+        "join expand-capacity decisions (parallel/runner._sized_expansion): "
+        "proven = compiled at a capacity-certificate-licensed fixed "
+        "capacity with ZERO runtime sizing (no gather, no overflow flag, "
+        "no retry; verify/capacity.py), runtime_check = the speculative/"
+        "sizing fallback ran its runtime protocol",
+        labelnames=("outcome",),
+    )
+    for o in JOIN_CAPACITY_OUTCOMES:
+        joincap.touch(o)
+    reg.counter(
+        _PREFIX + "collective_async_total",
+        "independent child fragments pre-dispatched asynchronously under a "
+        "collective-schedule license (verify/schedule.py): exchange "
+        "dispatch overlapped the consumer fragment's host work",
+    ).touch()
     collective = reg.counter(
         _PREFIX + "collective_bytes_total",
         "bytes moved by mesh collectives/gathers, by collective kind and "
@@ -756,6 +784,18 @@ def decimal_fastpath_counter() -> Counter:
     with runtime_check deltas == 0 proves the workload runs entirely on
     statically-licensed sums."""
     return REGISTRY.counter(_PREFIX + "decimal_fastpath_total")
+
+
+def join_capacity_counter() -> Counter:
+    """Join expand-capacity decisions, labeled outcome=proven|runtime_check.
+    A warm licensed workload bumps ONLY proven — compare_bench
+    check_licenses gates runtime_check == 0 over the benched warm runs."""
+    return REGISTRY.counter(_PREFIX + "join_capacity_total")
+
+
+def collective_async_counter() -> Counter:
+    """Schedule-licensed asynchronous child-fragment pre-dispatches."""
+    return REGISTRY.counter(_PREFIX + "collective_async_total")
 
 
 def queries_counter() -> Counter:

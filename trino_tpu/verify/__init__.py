@@ -32,7 +32,19 @@ Three layers:
     tools/lint_baseline.json `numeric_safety`), and emits range
     certificates that license provably-exact single-plane i64 decimal
     sum kernels (`license_decimal_sums`, run at the end of plan
-    optimization).
+    optimization); filter predicates refine the certificate facts
+    (`refine_env`), extending the proofs to filter/join outputs;
+  * capacity certificates (`capacity.py`) — sound join-cardinality
+    proofs (build-key uniqueness from exact generator statistics +
+    structural preservation, exact-filter row bounds, key-range proofs):
+    a licensed join compiles its expand at a certified fixed capacity
+    with NO sizing gather / overflow flag / speculative retry (sweep:
+    `python -m trino_tpu.verify.capacity`; the verifier rule rejects any
+    claim tighter than re-derivation proves);
+  * collective-schedule licenses (`schedule.py`) — the divergence-freedom
+    proof's scheduling consequence: independent, sync-free build-side
+    fragments may pre-dispatch asynchronously, and `device_residency`
+    verifies warm replays against the licensed schedule.
 
 Enforcement of the plan checkers follows the `verify_plan` session property
 (strict | warn | off; default strict under pytest, warn in benches).
@@ -48,6 +60,14 @@ from trino_tpu.verify.plan_checker import (
     resolve_mode,
 )
 from trino_tpu.verify.partitioning import check_partitioning
+from trino_tpu.verify.capacity import (
+    CapacityCertificate,
+    check_capacity_certificates,
+    derive_join_certificate,
+    license_join_capacities,
+    seal_licenses,
+)
+from trino_tpu.verify.schedule import ScheduleLicense, license_schedule
 from trino_tpu.verify.collectives import (
     check_collective_uniformity,
     collective_signature,
@@ -83,6 +103,13 @@ __all__ = [
     "check_collective_uniformity",
     "collective_signature",
     "signature_problems",
+    "CapacityCertificate",
+    "check_capacity_certificates",
+    "derive_join_certificate",
+    "license_join_capacities",
+    "seal_licenses",
+    "ScheduleLicense",
+    "license_schedule",
     "InstrumentedLock",
     "LockGraph",
     "LockOrderViolation",

@@ -203,10 +203,17 @@ class _Enumerator:
                     self._emit(side, "all_to_all", "repartition")
         # speculative/sized expansion: the overflow-flag read, and the
         # retry decision it feeds, use the ALL-worker [W] flag — reduced,
-        # therefore uniform (the pass's interesting customer)
+        # therefore uniform (the pass's interesting customer).  A join
+        # carrying a capacity certificate (verify/capacity.py) is PROOF-
+        # GATED: the licensed path compiles at the certified capacity and
+        # issues no sizing gather at all — elidable, because the runner
+        # falls back to the sizing path when the seal doesn't match the
+        # executing mesh (the decision is made once on the coordinator,
+        # uniform by construction, like exchange elision)
         self._emit(
             node, "gather", "capacity_sizing",
             guard=_guard_for(node, GUARD_REDUCED),
+            elidable=getattr(node, "capacity_cert", None) is not None,
         )
 
     def _c_SemiJoinNode(self, node: P.SemiJoinNode) -> None:

@@ -71,6 +71,7 @@ from trino_tpu.expr.ir import (
     SymbolRef,
 )
 from trino_tpu.verify import ranges as R
+from trino_tpu.verify.capacity import FLIPPED_CMP, conjuncts
 from trino_tpu.verify.ranges import Interval, RangeCertificate
 
 RULES = (
@@ -631,6 +632,109 @@ def analyze_expr(expr: Expr, env: Env = None):
 _EXACT_STATS_CATALOGS = ("tpch", "tpcds")
 
 
+# -- predicate refinement: range certificates for filter outputs ---------------
+
+
+def _lit_scaled_point(lit, sym_type) -> Optional[int]:
+    """A literal's exact value in the compared symbol's scaled units, or
+    None when the conversion is not provably exact (float literals,
+    downscales that would round)."""
+    if not isinstance(lit, Literal) or lit.value is None:
+        return None
+    if not R.is_exact_type(sym_type) or not R.is_exact_type(lit.type):
+        return None
+    f = Analyzer()._literal(lit)
+    if f.interval.lo is None or f.interval.lo != f.interval.hi:
+        return None
+    v = f.interval.lo
+    ls = lit.type.scale if isinstance(lit.type, T.DecimalType) else 0
+    ss = sym_type.scale if isinstance(sym_type, T.DecimalType) else 0
+    k = ss - ls
+    if k >= 0:
+        return v * 10 ** k
+    d = 10 ** (-k)
+    if v % d:
+        return None  # would round: not an exact representation
+    return v // d
+
+
+def _conjunct_refinements(c):
+    """(symbol name, admitted Interval) facts one conjunct proves about
+    surviving rows.  Comparisons are NULL-rejecting, so refined symbols
+    are also proven non-null — the caller applies that too."""
+    out = []
+
+    def sym_and_lit(a, b):
+        if isinstance(a, SymbolRef) and isinstance(b, Literal):
+            return a, b, False
+        if isinstance(b, SymbolRef) and isinstance(a, Literal):
+            return b, a, True
+        return None, None, False
+
+    if isinstance(c, Call) and c.name in (
+        "$eq", "$lt", "$le", "$gt", "$ge"
+    ) and len(c.args) == 2:
+        s, lit, flipped = sym_and_lit(*c.args)
+        if s is None:
+            return out
+        v = _lit_scaled_point(lit, s.type)
+        if v is None:
+            return out
+        op = c.name
+        if flipped:
+            op = FLIPPED_CMP[op]
+        if op == "$eq":
+            out.append((s.name, Interval.point(v)))
+        elif op == "$lt":
+            out.append((s.name, Interval(None, v - 1)))
+        elif op == "$le":
+            out.append((s.name, Interval(None, v)))
+        elif op == "$gt":
+            out.append((s.name, Interval(v + 1, None)))
+        else:  # $ge
+            out.append((s.name, Interval(v, None)))
+    elif isinstance(c, SpecialForm) and c.form == Form.BETWEEN and len(c.args) == 3:
+        s = c.args[0]
+        if isinstance(s, SymbolRef):
+            lo = _lit_scaled_point(c.args[1], s.type)
+            hi = _lit_scaled_point(c.args[2], s.type)
+            if lo is not None and hi is not None:
+                out.append((s.name, Interval(lo, hi)))
+    elif isinstance(c, SpecialForm) and c.form == Form.IN and len(c.args) >= 2:
+        s = c.args[0]
+        if isinstance(s, SymbolRef):
+            vals = [_lit_scaled_point(x, s.type) for x in c.args[1:]]
+            if all(v is not None for v in vals):
+                out.append((s.name, Interval(min(vals), max(vals))))
+    return out
+
+
+def refine_env(env: Env, predicate) -> Env:
+    """Filter-output fact refinement: rows surviving `predicate` provably
+    satisfy its literal-comparison conjuncts, so each compared symbol's
+    interval meets the admitted range and turns non-null (comparisons
+    reject NULL).  Only exact facts are admitted — the same sources as
+    the licensing passes — so downstream range certificates built on a
+    refined env stay sound.  This is how PR 10's aggregation-input
+    certificates extend to FILTER (and, through plan_env, join) outputs:
+    a provably-narrow filtered column licenses narrower kernels."""
+    refits: dict = {}
+    for c in conjuncts(predicate):
+        for name, iv in _conjunct_refinements(c):
+            f = env.sym(name)
+            if f is None or not f.tracked or not R.is_exact_type(f.type):
+                continue
+            cur = refits.get(name, f.interval)
+            refits[name] = cur.intersect(iv)
+    if not refits:
+        return env
+    syms = dict(env.symbols)
+    for name, iv in refits.items():
+        f = syms[name]
+        syms[name] = Fact(f.type, iv, False, f.tracked)
+    return Env(syms, env.channels)
+
+
 def _scan_env(node, catalogs) -> Env:
     syms = {}
     stats_cols = {}
@@ -661,7 +765,14 @@ def _scan_env(node, catalogs) -> Env:
             )
         else:
             syms[sym.name] = Fact.untracked(sym.type)
-    return Env(syms)
+    env = Env(syms)
+    if node.pushed_predicate is not None:
+        # range certificates for FILTER OUTPUTS: rows a pushed predicate
+        # admits provably satisfy it, so literal comparisons narrow the
+        # surviving column facts (exactly like the licensing sources —
+        # literals only, never estimates)
+        env = refine_env(env, node.pushed_predicate)
+    return env
 
 
 def row_upper_bound(node, catalogs=None, _memo=None) -> Optional[int]:
@@ -724,6 +835,23 @@ def row_upper_bound(node, catalogs=None, _memo=None) -> Optional[int]:
     return out
 
 
+def sound_rows_bound(node, catalogs=None) -> Optional[int]:
+    """The canonical sound row bound: verify.capacity.rows_bound — which
+    adds exact-filter selectivity and fanout-aware join bounds (a join
+    with a proven-unique build key emits at most its probe side) on top of
+    the structural `row_upper_bound`.  The capacity bounds are what let
+    decimal-sum certificates license aggregations ABOVE joins."""
+    try:
+        from trino_tpu.verify.capacity import rows_bound
+
+        b = rows_bound(node, catalogs)
+    except Exception:
+        b = None
+    if b is not None:
+        return b
+    return row_upper_bound(node, catalogs)
+
+
 def plan_env(node, catalogs=None, _memo=None, issues=None) -> Env:
     """Bottom-up symbol-fact derivation over a logical plan: what interval /
     nullability each output symbol of `node` is PROVEN to satisfy."""
@@ -765,14 +893,14 @@ def _plan_env(node, catalogs, memo, issues) -> Env:
             out[sym.name] = fact
         return Env(out)
     if isinstance(node, P.AggregationNode):
-        rows = row_upper_bound(node.source, catalogs)
+        rows = sound_rows_bound(node.source, catalogs)
         out = {s.name: src.sym(s.name) or Fact.untracked(s.type)
                for s in node.group_symbols}
         for out_sym, agg in node.aggregations:
             out[out_sym.name] = _agg_fact(out_sym, agg, src, rows)
         return Env(out)
     if isinstance(node, P.WindowNode):
-        rows = row_upper_bound(node.source, catalogs)
+        rows = sound_rows_bound(node.source, catalogs)
         out = dict(src.symbols)
         for out_sym, fn in node.functions:
             out[out_sym.name] = _window_fact(out_sym, fn, src, rows)
@@ -824,7 +952,11 @@ def _plan_env(node, catalogs, memo, issues) -> Env:
                 nullable, tracked,
             )
         return Env(out)
-    # structure-preserving nodes (filter/sort/limit/exchange/output/...)
+    if isinstance(node, P.FilterNode):
+        # filter outputs carry refined range facts (see refine_env): the
+        # predicate's literal comparisons narrow surviving symbols
+        return refine_env(src, node.predicate)
+    # structure-preserving nodes (sort/limit/exchange/output/...)
     return src
 
 
@@ -960,7 +1092,7 @@ def license_decimal_sums(plan, catalogs=None) -> int:
     env_memo: dict = {}
     for node in _walk_plan(plan):
         if isinstance(node, P.AggregationNode):
-            rows = row_upper_bound(node.source, catalogs)
+            rows = sound_rows_bound(node.source, catalogs)
             if rows is None:
                 continue
             env = plan_env(node.source, catalogs, env_memo)
@@ -980,7 +1112,7 @@ def license_decimal_sums(plan, catalogs=None) -> int:
                     agg.sum_bound = b
                     n += 1
         elif isinstance(node, P.WindowNode):
-            rows = row_upper_bound(node.source, catalogs)
+            rows = sound_rows_bound(node.source, catalogs)
             if rows is None:
                 continue
             env = plan_env(node.source, catalogs, env_memo)
@@ -1148,6 +1280,12 @@ def main() -> int:  # pragma: no cover - CLI entry
     )
     ap.add_argument("--verbose", action="store_true")
     ap.add_argument("--root", default=".")
+    ap.add_argument(
+        "--check-stale", action="store_true",
+        help="FAIL when a rule:signature baseline entry no longer matches "
+        "any live sweep finding (the stale-baseline detector, on in CI — "
+        "the twin of tools/lint_tpu.py --check-stale for the AST keys)",
+    )
     args = ap.parse_args()
     res = verify_benchmarks(args.verbose, root=args.root)
     # path-prefixed keys belong to the AST pass in tools/lint_tpu.py (its
@@ -1161,15 +1299,18 @@ def main() -> int:  # pragma: no cover - CLI entry
         print(f"  baseline key: {iss.key()!r}")
     for k in sorted(stale):
         print(
-            f"note: numeric_safety baseline entry {k!r} has no live "
-            "finding — ratchet tools/lint_baseline.json down"
+            f"{'STALE' if args.check_stale else 'note'}: numeric_safety "
+            f"baseline entry {k!r} has no live finding — ratchet "
+            "tools/lint_baseline.json down"
         )
     print(
         f"numeric-safety: {res.expressions} expressions — "
         f"{res.proven} PROVEN-SAFE, {res.baselined} BASELINED, "
         f"{len(res.violations)} VIOLATION(s)"
     )
-    return 1 if res.violations else 0
+    if res.violations:
+        return 1
+    return 1 if (args.check_stale and stale) else 0
 
 
 if __name__ == "__main__":  # pragma: no cover

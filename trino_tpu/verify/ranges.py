@@ -84,6 +84,22 @@ class Interval:
         hi = None if self.hi is None or other.hi is None else max(self.hi, other.hi)
         return Interval(lo, hi)
 
+    def intersect(self, other: "Interval") -> "Interval":
+        """Meet: the values in BOTH intervals.  An empty meet (a filter
+        that provably admits nothing) collapses to the empty-ish point
+        convention [lo, lo]-crossed — callers only ever use the result as
+        a sound superset of surviving values, so clamping hi >= lo keeps
+        the lattice well-formed without a bottom element."""
+        lo = self.lo if other.lo is None else (
+            other.lo if self.lo is None else max(self.lo, other.lo)
+        )
+        hi = self.hi if other.hi is None else (
+            other.hi if self.hi is None else min(self.hi, other.hi)
+        )
+        if lo is not None and hi is not None and hi < lo:
+            hi = lo
+        return Interval(lo, hi)
+
     def within(self, other: "Interval") -> bool:
         """self ⊆ other (unbounded `other` sides always contain)."""
         if other.lo is not None and (self.lo is None or self.lo < other.lo):

@@ -199,6 +199,21 @@ def _collective_problems(runner, prof, prev_seq) -> list:
         from trino_tpu.verify.collectives import signature_problems
 
         problems.extend(signature_problems(expected, seq))
+    # collective-schedule license (verify/schedule.py): a licensed query's
+    # warm replay must issue exactly the LICENSED per-fragment schedule —
+    # async pre-dispatch may reorder ACROSS independent fragments, never
+    # within one, so the per-fragment witness comparison still holds.
+    # The license is normally stamped from the same subplan as
+    # last_collective_signature (already checked above); only compare
+    # again when the two witnesses actually differ.
+    lic = getattr(runner, "last_schedule_license", None)
+    if lic is not None and lic.fragments != expected:
+        from trino_tpu.verify.collectives import signature_problems
+
+        problems.extend(
+            f"[licensed schedule] {p}"
+            for p in signature_problems(lic.fragments, seq)
+        )
     return problems
 
 #: mesh-profile counters that are LEGITIMATE host boundaries: explicit
@@ -225,6 +240,12 @@ ALLOWED_COUNTERS = (
     "exchange_elided",
     "repartition_collective",
     "join_overflow_check",
+    # proof-licensed execution (verify/capacity.py + verify/schedule.py):
+    # bookkeeping, not transfers — a licensed join compiled at its
+    # certified fixed capacity, and a schedule-licensed child fragment
+    # pre-dispatched asynchronously
+    "join_capacity_proven",
+    "collective_async",
 )
 
 
