@@ -488,6 +488,21 @@ metrics_snapshot = {
     if not k.startswith("trino_tpu_query_wall_seconds_bucket")
 }
 
+# licensed-never-slower bisection (compare_bench check_licenses gate):
+# re-run warm Q3 with `join_capacity_license = false` so the SAME session
+# measures the runtime sizing path's warm wall next to the licensed wall
+# already benched above.  A license the economy policy should have
+# declined shows up here as licensed_warm_s >> runtime_warm_s.  Runs
+# AFTER the registry snapshot: the runtime path legitimately bumps
+# runtime_check / sizing counters that must not pollute the licensed
+# phase's zero-counter evidence.
+dist.properties.set("join_capacity_license", False)
+dist.execute(QUERIES[3])  # settle: compile the runtime path + learn caps
+q3_runtime_warm = warm_q(dist, 3)
+dist.properties.set("join_capacity_license", True)
+q3_licenses["licensed_warm_s"] = round(q3_mesh_warm, 4)
+q3_licenses["runtime_warm_s"] = round(q3_runtime_warm, 4)
+
 # pressure: Q18 under a pool limit smaller than its build side must
 # complete in k>1 partition waves with filesystem-SPI spill and rows ==
 # the unconstrained local oracle — and every unconstrained query above

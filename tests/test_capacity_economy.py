@@ -1,0 +1,367 @@
+"""Width-adaptive licensed joins (capacity economy): the economy policy's
+licensed-vs-runtime bisection, licensed-output/probe compaction,
+probe-multiplicity and group-count certificates, and the right-flip
+certificate re-derivation.
+
+Fast tier: multiplicity-bound derivation from generator facts, the
+verifier's rejection of multiplicity/group claims tighter than provable,
+and the flipped-join certificate.  Mesh tier (tiny data): the economy
+policy accepting tight certificates (licensed path, rows == local) and
+declining forced-wide ones (runtime path, rows == local), licensed-output
+compaction preserving rows/validity, and the licensed aggregation slot
+cap running Q1-class group-bys with zero capacity_sizing gathers.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu.planner import plan as P
+from trino_tpu.verify.capacity import (
+    CapacityCertificate,
+    GroupCapacityCertificate,
+    check_capacity_certificates,
+    derive_group_certificate,
+    multiplicity_bound,
+    _walk,
+)
+
+LINEITEM_ORDERS = (
+    "tpch.tiny.lineitem:l_orderkey:8,tpch.tiny.orders:o_orderkey:8"
+)
+
+Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+Q1 = """
+select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+       sum(l_extendedprice) as sum_base_price, count(*) as count_order
+from lineitem where l_shipdate <= date '1998-09-02'
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+
+@pytest.fixture(scope="module")
+def local():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    return LocalQueryRunner(catalog="tpch", schema="tiny")
+
+
+@pytest.fixture(scope="module")
+def dist():
+    from trino_tpu.parallel import DistributedQueryRunner
+
+    d = DistributedQueryRunner(n_workers=8, catalog="tpch", schema="tiny")
+    d.execute(f"set session table_layouts = '{LINEITEM_ORDERS}'")
+    return d
+
+
+def _joins(plan):
+    return [n for n in _walk(plan) if isinstance(n, P.JoinNode)]
+
+
+def _aggs(plan):
+    return [n for n in _walk(plan) if isinstance(n, P.AggregationNode)]
+
+
+def rows_ok(res, local, sql):
+    return sorted(res.rows) == sorted(local.execute(sql).rows)
+
+
+# -- probe-multiplicity certificates ------------------------------------------
+
+
+class TestMultiplicity:
+    def test_generator_fact_bounds_lineitem_orderkey(self, local):
+        plan = local.create_plan("select l_orderkey from lineitem")
+        scan = next(
+            n for n in _walk(plan) if isinstance(n, P.TableScanNode)
+        )
+        m = multiplicity_bound(
+            scan, frozenset({"l_orderkey"}), local.catalogs
+        )
+        assert m == 7  # TPC-H spec: 1..7 lineitems per order
+
+    def test_multiplicity_survives_row_subset_nodes(self, local):
+        plan = local.create_plan(
+            "select l_orderkey from lineitem where l_quantity > 25"
+        )
+        # filters only drop rows, so the per-key bound still holds above
+        # the scan; query through the OUTPUT symbol (the projection
+        # renames l_orderkey -> l_orderkey_0, and the bound must reverse
+        # the rename on the way down)
+        proj = next(
+            n for n in _walk(plan) if isinstance(n, P.ProjectNode)
+        )
+        out_sym = proj.assignments[0][0].name
+        m = multiplicity_bound(plan, frozenset({out_sym}), local.catalogs)
+        assert m is not None and m <= 7
+
+    def test_q3_lineitem_probe_carries_multiplicity(self, local):
+        plan = local.create_plan(Q3)
+        certs = [j.capacity_cert for j in _joins(plan) if j.capacity_cert]
+        assert any(c.probe_multiplicity_bound == 7 for c in certs)
+
+    def test_unsound_tighter_multiplicity_rejected(self, local):
+        plan = local.create_plan(Q3)
+        j = next(
+            x for x in _joins(plan)
+            if x.capacity_cert is not None
+            and x.capacity_cert.probe_multiplicity_bound == 7
+        )
+        c = j.capacity_cert
+        j.capacity_cert = CapacityCertificate(
+            fanout_bound=c.fanout_bound,
+            key=c.key,
+            build_rows_bound=c.build_rows_bound,
+            probe_rows_bound=c.probe_rows_bound,
+            probe_multiplicity_bound=3,  # generator proves only <= 7
+        )
+        violations = check_capacity_certificates(plan, local.catalogs)
+        assert violations and violations[0].rule == "capacity-unsound"
+        assert "probe_multiplicity_bound" in str(violations[0])
+
+    def test_multiplicity_tightens_licensed_out_cap(self):
+        cert = CapacityCertificate(
+            fanout_bound=1,
+            build_rows_bound=100,
+            probe_multiplicity_bound=7,
+        )
+        # 7 * 100 = 700 beats the probe capacity 4096
+        assert cert.licensed_out_cap(4096) == 700
+        no_mult = CapacityCertificate(fanout_bound=1, build_rows_bound=100)
+        assert no_mult.licensed_out_cap(4096) == 4096
+
+    def test_fanout_from_multiplicity_when_build_not_unique(self, local):
+        # lineitem as the BUILD side keyed on l_orderkey: no uniqueness,
+        # but the generator bounds the fanout at 7
+        plan = local.create_plan(
+            "select count(*) from orders join lineitem "
+            "on o_orderkey = l_orderkey"
+        )
+        j = _joins(plan)[0]
+        assert j.capacity_cert is not None
+        assert j.capacity_cert.fanout_bound == 7
+
+
+# -- right-flip certificate re-derivation -------------------------------------
+
+
+class TestRightFlipCertificate:
+    def test_flipped_right_join_keeps_a_license(self, dist):
+        # RIGHT joins distribute as the flipped LEFT join; the flipped
+        # build side (the old left) has its own proof, re-derived at flip
+        # time — previously the cert was dropped wholesale
+        sub = dist.create_subplan(dist.create_plan(
+            "select count(*) from lineitem right join orders "
+            "on l_orderkey = o_orderkey"
+        ))
+        joins = [
+            n
+            for frag in sub.all_fragments()
+            for n in _walk(frag.root)
+            if isinstance(n, P.JoinNode)
+        ]
+        assert joins, "flip produced no join"
+        flipped = joins[0]
+        assert flipped.kind == "left"
+        cert = flipped.capacity_cert
+        assert cert is not None
+        # the new build side is lineitem keyed on l_orderkey: fanout 7
+        # from the generator multiplicity fact
+        assert cert.fanout_bound == 7
+
+    def test_flipped_join_rows_match_local(self, dist, local):
+        sql = (
+            "select count(*) from lineitem right join orders "
+            "on l_orderkey = o_orderkey"
+        )
+        assert rows_ok(dist.execute(sql), local, sql)
+
+
+# -- group-count certificates (aggregation slot cap) --------------------------
+
+
+class TestGroupCertificate:
+    def test_q1_group_bound_from_enumeration_stats(self, local):
+        plan = local.create_plan(Q1)
+        agg = next(a for a in _aggs(plan) if a.group_symbols)
+        cert = agg.capacity_cert
+        assert isinstance(cert, GroupCapacityCertificate)
+        # 3 return flags x 2 line statuses, both exact enumerations
+        assert cert.group_bound == 6
+
+    def test_group_cert_tighter_than_provable_rejected(self, local):
+        plan = local.create_plan(Q1)
+        agg = next(a for a in _aggs(plan) if a.group_symbols)
+        good = agg.capacity_cert
+        agg.capacity_cert = GroupCapacityCertificate(
+            group_bound=max(1, good.group_bound - 1),
+            key=good.key,
+        )
+        violations = check_capacity_certificates(plan, local.catalogs)
+        assert violations and violations[0].rule == "capacity-unsound"
+        assert "group_bound" in str(violations[0])
+
+    def test_group_cert_without_witness_rejected(self, local):
+        # group key with no exact distinct stat and an unbounded source
+        plan = local.create_plan(
+            "select o_comment, count(*) from orders group by o_comment"
+        )
+        agg = next(a for a in _aggs(plan) if a.group_symbols)
+        derived = derive_group_certificate(agg, local.catalogs)
+        # rows_bound(source) still bounds the groups — claim TIGHTER
+        agg.capacity_cert = GroupCapacityCertificate(
+            group_bound=max(1, (derived.group_bound if derived else 2) - 1),
+            key=("o_comment",),
+        )
+        violations = check_capacity_certificates(plan, local.catalogs)
+        assert violations and violations[0].rule == "capacity-unsound"
+
+    def test_q1_mesh_licensed_slot_cap(self, dist, local):
+        dist.execute(Q1)  # settle
+        res = dist.execute(Q1)
+        prof = dist.last_mesh_profile
+        counters = dict(prof.counters)
+        assert counters.get("agg_slot_cap_proven", 0) >= 1
+        bytes_by = prof.to_json()["collective_bytes_by"]
+        assert not bytes_by.get("gather/capacity_sizing")
+        assert sorted(res.rows) == sorted(local.execute(Q1).rows)
+
+
+# -- the economy policy -------------------------------------------------------
+
+
+class TestEconomyPolicy:
+    SQL = (
+        "select count(*) from orders join customer "
+        "on o_custkey = c_custkey"
+    )
+
+    def test_tight_cert_stays_licensed(self, dist, local):
+        dist.execute(self.SQL)  # settle
+        res = dist.execute(self.SQL)
+        counters = dict(dist.last_mesh_profile.counters)
+        assert counters.get("join_capacity_proven", 0) >= 1
+        assert counters.get("join_license_declined", 0) == 0
+        assert rows_ok(res, local, self.SQL)
+
+    def test_forced_wide_cert_declines_to_runtime(
+        self, dist, local, monkeypatch
+    ):
+        # the bisection: with the width factor forced to 1, any license
+        # wider than the learned bucket is uneconomical — the SAME query
+        # falls back to the runtime path, counts the decline, and still
+        # answers the local oracle
+        import trino_tpu.parallel.runner as R
+
+        dist.execute(self.SQL)  # ensure history is learned
+        monkeypatch.setattr(R, "_LICENSE_WIDTH_FACTOR", 0)
+        res = dist.execute(self.SQL)
+        counters = dict(dist.last_mesh_profile.counters)
+        assert counters.get("join_capacity_proven", 0) == 0
+        assert counters.get("join_license_declined", 0) >= 1
+        # the declined expansion ran the runtime protocol instead
+        assert (
+            counters.get("join_overflow_check", 0)
+            + counters.get("join_capacity_sync", 0)
+        ) >= 1
+        assert rows_ok(res, local, self.SQL)
+
+    def test_restored_factor_relicenses(self, dist, local):
+        # after the monkeypatch reverts, the same query licenses again —
+        # path selection is per-execution host state, not baked into the
+        # trace cache
+        res = dist.execute(self.SQL)
+        counters = dict(dist.last_mesh_profile.counters)
+        assert counters.get("join_capacity_proven", 0) >= 1
+        assert counters.get("join_license_declined", 0) == 0
+        assert rows_ok(res, local, self.SQL)
+
+    def test_cold_width_guard_declines_fanout_license(self, dist, local):
+        # a multiplicity license (fanout 7) with NO capacity history
+        # compiles ~8x the probe width on the very first run — the cold
+        # guard refuses it and lets the runtime path size once
+        from trino_tpu.partitioning.speculative import CAP_HISTORY
+
+        # RIGHT join flips so PARTSUPP is the build side: the flipped
+        # cert carries fanout_bound 80 (ps_suppkey generator fact), and
+        # the supplier probe is narrow enough (cap <= 1024) that no probe
+        # compaction runs first — a truly cold 80x-wide license, which
+        # the guard refuses in favor of one runtime sizing
+        sql = (
+            "select count(*) from partsupp right join supplier "
+            "on ps_suppkey = s_suppkey"
+        )
+        CAP_HISTORY.clear()
+        res = dist.execute(sql)
+        counters = dict(dist.last_mesh_profile.counters)
+        assert counters.get("join_license_declined", 0) >= 1
+        assert counters.get("join_capacity_proven", 0) == 0
+        # the declined expansion sized itself through the runtime protocol
+        assert (
+            counters.get("join_overflow_check", 0)
+            + counters.get("join_capacity_sync", 0)
+        ) >= 1
+        assert rows_ok(res, local, sql)
+
+
+# -- licensed-output compaction -----------------------------------------------
+
+
+class TestLicensedCompaction:
+    def test_compact_device_is_stable_at_bucket_boundary(self):
+        # the compaction primitive the licensed path uses: live rows keep
+        # their relative order and none are lost when the output capacity
+        # is exactly the live bucket
+        import jax.numpy as jnp
+
+        from trino_tpu.columnar.batch import Batch
+
+        from trino_tpu.columnar.column import Column
+        from trino_tpu.types import BIGINT
+
+        vals = jnp.arange(16, dtype=jnp.int64)
+        valid = vals % 3 != 0  # live rows interleaved with dead
+        b = Batch([Column(vals, BIGINT)], row_mask=valid)
+        out = b.compact_device(out_capacity=16)
+        live = np.asarray(out.columns[0].data)[np.asarray(out.mask())]
+        expect = np.asarray(vals)[np.asarray(valid)]
+        assert list(live) == list(expect)  # stable, complete
+
+    def test_licensed_run_teaches_capacity_history(self, dist):
+        # the licensed path's compaction records the tight bucket into
+        # CapacityHistory — the host-side state the economy policy and
+        # the runtime path both consult
+        from trino_tpu.partitioning.speculative import CAP_HISTORY
+
+        CAP_HISTORY.clear()
+        dist.execute(Q3)
+        counters = dict(dist.last_mesh_profile.counters)
+        assert counters.get("join_capacity_proven", 0) == 2
+        keys = [e["key"] for e in CAP_HISTORY.snapshot()]
+        assert any(
+            k.startswith("('cap'") for k in keys
+        ), "licensed compaction recorded no output buckets"
+        assert any(
+            k.startswith("('pcap'") for k in keys
+        ), "licensed probe compaction recorded no probe buckets"
+
+    def test_warm_licensed_q3_rows_and_zero_sizing(self, dist, local):
+        dist.execute(Q3)
+        res = dist.execute(Q3)
+        counters = dict(dist.last_mesh_profile.counters)
+        assert counters.get("join_overflow_check", 0) == 0
+        assert counters.get("join_capacity_sync", 0) == 0
+        assert counters.get("join_license_declined", 0) == 0
+        assert counters.get("join_capacity_proven", 0) == 2
+        assert sorted(res.rows) == sorted(local.execute(Q3).rows)

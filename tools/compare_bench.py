@@ -114,6 +114,28 @@ def check_licenses(schema: str, sec: dict) -> list:
             "the licensed joins' sizing round-trip is deleted, not merely "
             "cheap)"
         )
+    # licensed-never-slower: bench.py bisects the SAME warm Q3 with
+    # `join_capacity_license = false` and records the runtime path's warm
+    # wall next to the licensed one.  A license is only worth holding when
+    # it is at least as fast as the protocol it deletes — a licensed wall
+    # beyond the runtime wall means the economy policy admitted a
+    # too-wide certificate.  1.25x tolerance: warm best-of-n walls on a
+    # shared box jitter; a real width blowup is multiples, not percent.
+    lw, rw = lic.get("licensed_warm_s"), lic.get("runtime_warm_s")
+    if (
+        isinstance(lw, (int, float))
+        and isinstance(rw, (int, float))
+        and rw > 0
+        and lw > rw * 1.25
+    ):
+        violations.append(
+            f"mesh.{schema}.licenses licensed_warm_s = {lw} > 1.25x "
+            f"runtime_warm_s = {rw} (the licensed path must never be "
+            "slower than the runtime sizing path it replaces — the "
+            "economy policy admitted a certificate whose certified width "
+            "dwarfs the data; bisect with `set session "
+            "join_capacity_license = false`)"
+        )
     return violations
 
 #: decimal fast-path contract over the Q1 bench phase (PR 10): path
@@ -430,6 +452,17 @@ def check_drift(sec: dict) -> list:
                 f"{want}: two warm archives of the same statement must "
                 "diff to ~zero with the conservation invariant intact)"
             )
+    # ratio ceiling recorded by `drift_bench.py --max-ratio`: the drift
+    # section carries its own acceptance threshold, so the gate re-checks
+    # it on every CI run without re-benching
+    max_ratio = sec.get("max_ratio")
+    if max_ratio and cur.get("ratio", 0) > max_ratio:
+        violations.append(
+            f"drift.current.ratio = {cur.get('ratio')} > recorded "
+            f"max_ratio {max_ratio} (the warm mesh/local ratio drifted "
+            "past the era's acceptance ceiling — re-run "
+            "tools/drift_bench.py and attribute)"
+        )
     return violations
 
 

@@ -149,11 +149,16 @@ def build_drift_section(measured: dict, baseline_sec: dict,
     phases = {k: round(float(v), 6) for k, v in art["phases"].items()}
     wall = float(art["wall_s"])
     # dominant (phase, fragment) of the CURRENT warm wall: where the time
-    # lives now.  The baseline era recorded walls + counters but no Q3
-    # phase breakdown (the archive did not exist yet — exactly the gap
-    # this PR closes), so era attribution = wall/ratio factor deltas plus
-    # the current profile's decomposition; future eras diff artifact vs
-    # artifact directly.
+    # lives now.  The PR 3 era recorded walls + counters but no Q3 phase
+    # breakdown (the archive did not exist yet), so against it the era
+    # attribution is wall/ratio factor deltas plus the current profile's
+    # decomposition.  A NEW-era baseline (--emit-baseline) carries its
+    # q3_artifact, and the era diff becomes artifact-vs-artifact
+    # per-phase (profile_diff), never wall-vs-wall.
+    era_diff = None
+    base_art = baseline_sec.get("q3_artifact")
+    if isinstance(base_art, dict):
+        era_diff = diff_artifacts(base_art, art)
     dominant_phase = max(phases, key=lambda k: phases[k])
     dominant_fragment, dominant_kind, best = None, None, 0.0
     dominant_frag_phase = None
@@ -209,6 +214,17 @@ def build_drift_section(measured: dict, baseline_sec: dict,
             for k in sorted(set(base_counters) | set(cur_counters))
             if cur_counters.get(k, 0) != base_counters.get(k, 0)
         },
+        # artifact-vs-artifact era diff (present iff the baseline era
+        # archived its q3_artifact): per-phase deltas between the two
+        # eras' warm profiles, the real drift decomposition
+        "era_diff": (
+            {
+                "wall_delta_s": era_diff["wall_delta_s"],
+                "phases_delta_s": era_diff["phases_delta_s"],
+                "sums_to_wall": era_diff["sums_to_wall"],
+            }
+            if era_diff is not None else None
+        ),
         "attribution": {
             "phases_s": phases,
             "phase_shares": {
@@ -262,6 +278,19 @@ def main(argv=None) -> int:
         default=float(os.environ.get("BENCH_DRIFT_TIMEOUT", 1200)),
     )
     ap.add_argument(
+        "--emit-baseline", default="",
+        help="also write this run as a NEW era baseline file "
+        "(tools/baselines/...) carrying the warm q3_artifact, so the "
+        "next era's drift diffs artifact-vs-artifact per phase",
+    )
+    ap.add_argument(
+        "--max-ratio", type=float, default=0.0,
+        help="fail (and record the threshold) when the current warm "
+        "mesh/local ratio exceeds this — the recorded value becomes part "
+        "of the drift section, so compare_bench check_drift re-gates it "
+        "on every CI run without re-benching (0 = no threshold)",
+    )
+    ap.add_argument(
         "--no-record", action="store_true",
         help="print the section, do not merge into BENCH_EXTRA.json",
     )
@@ -279,14 +308,44 @@ def main(argv=None) -> int:
         args.schema, args.runs, args.archive_dir, args.timeout
     )
     section = build_drift_section(measured, baseline_sec, baseline_ref)
+    if args.max_ratio:
+        section["max_ratio"] = args.max_ratio
+    if args.emit_baseline:
+        with open(args.emit_baseline, "w", encoding="utf-8") as fh:
+            json.dump({
+                "_source": args.emit_baseline,
+                "q3_mesh8_warm_s": measured["q3_mesh_warm_s"],
+                "q3_local_warm_s": measured["q3_local_warm_s"],
+                "q3_counters": measured["q3_artifact"].get("counters", {}),
+                "q3_artifact": measured["q3_artifact"],
+            }, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"drift_bench: wrote era baseline {args.emit_baseline}")
     print(json.dumps(section, indent=2, sort_keys=True))
     ok = section["null_diff"]["pass"] and section["attribution"]["sums_to_wall"]
+    if args.max_ratio and section["current"]["ratio"] > args.max_ratio:
+        print(
+            f"drift_bench: FAIL: current warm ratio "
+            f"{section['current']['ratio']} > --max-ratio {args.max_ratio}"
+        )
+        ok = False
     if not args.no_record:
         sys.path.insert(0, ROOT)
         import bench
 
-        bench._merge_extra({"drift": section})
-        print("drift_bench: merged `drift` section into BENCH_EXTRA.json")
+        # REPLACE the drift section (siblings survive).  _merge_extra's
+        # deep merge is wrong here: a re-recorded run must not inherit
+        # stale keys from the previous recording (a superseded
+        # counters_delta entry would haunt every later era)
+        try:
+            with open(bench._EXTRA_PATH, encoding="utf-8") as fh:
+                extra = dict(json.load(fh))
+        except (OSError, ValueError, TypeError):
+            extra = {}
+        extra["drift"] = section
+        with open(bench._EXTRA_PATH, "w", encoding="utf-8") as fh:
+            json.dump(extra, fh, indent=1)
+        print("drift_bench: recorded `drift` section into BENCH_EXTRA.json")
     if args.null_check_only:
         print(
             "drift_bench: null-diff "

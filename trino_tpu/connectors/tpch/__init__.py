@@ -55,9 +55,10 @@ class TpchMetadata(ConnectorMetadata):
 
         def C(ndv=None, low=None, high=None, nulls=0.0, exact=False):
             # exact=True: the distinct count is a STRUCTURAL fact of the
-            # generator (dense idx+1 keys), admissible as a uniqueness
-            # proof; everything else is a bound/estimate and never
-            # licenses a fanout certificate (verify.capacity)
+            # generator (dense idx+1 keys, or a spec-literal enumeration
+            # the generator draws from), admissible as a uniqueness or
+            # group-count proof; everything else is a bound/estimate and
+            # never licenses a capacity certificate (verify.capacity)
             return ColumnStatistics(
                 distinct_count=ndv, low=low, high=high, null_fraction=nulls,
                 exact_distinct=exact,
@@ -97,15 +98,19 @@ class TpchMetadata(ConnectorMetadata):
                 "c_address": C(Ccust), "c_nationkey": C(25, 0, 24),
                 "c_phone": C(Ccust),
                 "c_acctbal": C(min(Ccust, 1_100_000), -999.99, 9999.99),
-                "c_mktsegment": C(5), "c_comment": C(Ccust),
+                # spec-literal enumeration (clause 4.2.2.13): 5 segments
+                "c_mktsegment": C(5, exact=True), "c_comment": C(Ccust),
             },
             "orders": {
                 "o_orderkey": C(O, 1, O, exact=True),
                 # 2/3 of customers hold orders (spec 4.2.3)
                 "o_custkey": C(max(1, Ccust * 2 // 3), 1, Ccust),
-                "o_orderstatus": C(3), "o_totalprice": C(O, 800.0, 600_000.0),
+                # o_orderstatus/o_orderpriority: spec-literal enumerations
+                "o_orderstatus": C(3, exact=True),
+                "o_totalprice": C(O, 800.0, 600_000.0),
                 "o_orderdate": C(ORDER_DATE_SPAN, START_DATE, od_hi),
-                "o_orderpriority": C(5), "o_clerk": C(max(1, O // 1000)),
+                "o_orderpriority": C(5, exact=True),
+                "o_clerk": C(max(1, O // 1000)),
                 "o_shippriority": C(1, 0, 0), "o_comment": C(O),
             },
             "lineitem": {
@@ -114,11 +119,15 @@ class TpchMetadata(ConnectorMetadata):
                 "l_quantity": C(50, 1, 50),
                 "l_extendedprice": C(min(rows, 3_800_000), 900.0, 105_000.0),
                 "l_discount": C(11, 0.0, 0.10), "l_tax": C(9, 0.0, 0.08),
-                "l_returnflag": C(3), "l_linestatus": C(2),
+                # spec-literal enumerations (A/N/R and O/F): the Q1-class
+                # group-count certificates hang off these exact counts
+                "l_returnflag": C(3, exact=True),
+                "l_linestatus": C(2, exact=True),
                 "l_shipdate": C(ORDER_DATE_SPAN + 121, START_DATE + 1, od_hi + 121),
                 "l_commitdate": C(ORDER_DATE_SPAN + 61, START_DATE + 30, od_hi + 90),
                 "l_receiptdate": C(ORDER_DATE_SPAN + 151, START_DATE + 2, od_hi + 151),
-                "l_shipinstruct": C(4), "l_shipmode": C(7), "l_comment": C(rows),
+                "l_shipinstruct": C(4, exact=True),
+                "l_shipmode": C(7, exact=True), "l_comment": C(rows),
             },
         }
         return TableStatistics(

@@ -1,0 +1,108 @@
+"""Pallas blocked gather-probe kernel for the join inner loop.
+
+Reference role (SURVEY §7): Trino specializes its probe inner loop per
+join signature with PagesHash bytecode generation; here the same
+specialization is a Pallas kernel.  The lexicographically sorted build
+canon stays resident across grid steps while each step runs the
+lower/upper-bound binary search for one probe block — log2(cap_b)+1
+fixed iterations, no data-dependent control flow, semantics identical to
+`ops.join._locate_sorted` (the XLA probe), which stays the fallback and
+the test oracle.
+
+Scope: single-plane integer canon keys only — limb-coded (long-decimal)
+keys keep the XLA path; the runner gates per join.  On non-TPU backends
+the kernel runs in interpreter mode, so CPU meshes (tier-1) execute the
+same program text without a TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+#: probe rows per grid step.  Probe capacities are pow2 buckets, so any
+#: pow2 block evenly tiles them; 1024 keeps the per-step working set
+#: (block state + whole build canon) comfortably VMEM-sized for the
+#: build capacities the knob gate admits.
+_BLOCK = 1024
+
+
+def _probe_kernel(nm_ref, build_ref, probe_ref, nomatch_ref, start_ref,
+                  count_ref, *, iters: int):
+    nm = nm_ref[0]
+    bk = build_ref[...]
+    pk = probe_ref[...]
+    n = pk.shape[0]
+
+    def bounds(le: bool):
+        lo0 = jnp.zeros(n, dtype=jnp.int64)
+        hi0 = jnp.full(n, nm, dtype=jnp.int64)
+
+        def body(_, st):
+            lo, hi = st
+            active = lo < hi
+            mid = (lo + hi) >> 1
+            bv = jnp.take(bk, mid, mode="clip")
+            go_right = (bv <= pk) if le else (bv < pk)
+            lo2 = jnp.where(go_right, mid + 1, lo)
+            hi2 = jnp.where(go_right, hi, mid)
+            return jnp.where(active, lo2, lo), jnp.where(active, hi2, hi)
+
+        lo, _ = jax.lax.fori_loop(0, iters, body, (lo0, hi0))
+        return lo
+
+    lo = bounds(False)
+    hi = bounds(True)
+    nomatch = nomatch_ref[...]
+    zero = jnp.zeros_like(lo)
+    start_ref[...] = jnp.where(nomatch, zero, lo)
+    count_ref[...] = jnp.where(nomatch, zero, hi - lo)
+
+
+@functools.partial(jax.jit, static_argnames=("cap_b", "interpret", "block"))
+def locate_sorted_pallas(build_canon, n_match, probe_canon, probe_nomatch,
+                         cap_b: int, interpret: bool = False,
+                         block: int = _BLOCK):
+    """Drop-in for `ops.join._locate_sorted` on a SINGLE canon plane:
+    per probe row, (start, count) of its matching run in sorted-build row
+    space.  `build_canon`/`probe_canon` are the bare int64 plane arrays
+    (not one-element lists)."""
+    p_cap = probe_canon.shape[0]
+    blk = min(block, p_cap)
+    iters = max(1, int(cap_b).bit_length())
+    nm = jnp.asarray(n_match, dtype=jnp.int64).reshape(1)
+    start, count = pl.pallas_call(
+        functools.partial(_probe_kernel, iters=iters),
+        grid=(p_cap // blk,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda i: (0,)),
+            pl.BlockSpec((build_canon.shape[0],), lambda i: (0,)),
+            pl.BlockSpec((blk,), lambda i: (i,)),
+            pl.BlockSpec((blk,), lambda i: (i,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((blk,), lambda i: (i,)),
+            pl.BlockSpec((blk,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((p_cap,), jnp.int64),
+            jax.ShapeDtypeStruct((p_cap,), jnp.int64),
+        ],
+        interpret=interpret,
+    )(nm, build_canon, probe_canon, probe_nomatch)
+    return start, count
+
+
+def probe_kernel_eligible(build_canon, probe_canon) -> bool:
+    """Single-plane integer canon on both sides (the kernel's scope)."""
+    return (
+        len(build_canon) == 1
+        and len(probe_canon) == 1
+        and build_canon[0].ndim == 1
+        and probe_canon[0].ndim == 1
+        and jnp.issubdtype(build_canon[0].dtype, jnp.integer)
+        and jnp.issubdtype(probe_canon[0].dtype, jnp.integer)
+    )

@@ -66,6 +66,10 @@ from trino_tpu.ops.join import (
     _locate_sorted,
     _sort_build_device,
 )
+from trino_tpu.ops.pallas_probe import (
+    locate_sorted_pallas,
+    probe_kernel_eligible,
+)
 from trino_tpu.ops.sort import OrderByOperator, TopNOperator
 from trino_tpu.parallel import exchange as ex
 from trino_tpu.parallel.spmd import (
@@ -114,6 +118,16 @@ from trino_tpu.runtime.runner import LocalQueryRunner, MaterializedResult
 from trino_tpu.planner.functions import HOLISTIC_AGGS, PARTITIONABLE_HOLISTIC
 
 _DIST_KINDS = (SOURCE, FIXED_HASH, FIXED_ARBITRARY)
+
+#: capacity-economy decline threshold: a licensed join whose certified
+#: expand capacity exceeds the CapacityHistory-learned tight bucket by
+#: more than this factor falls back to the runtime sizing path — the
+#: certified width would compile the whole downstream chain that much
+#: wider than the data needs, and with licensed-output compaction a
+#: license within the factor recovers the width for free.  64 keeps the
+#: measured licensed workloads (Q3's 2^20 certified vs 2^15 learned)
+#: on the proof path while cutting off pathological certificates.
+_LICENSE_WIDTH_FACTOR = 64
 
 
 class _Dist:
@@ -806,16 +820,24 @@ class StageExecutor:
         """Child fragment result WITHOUT the exchange applied."""
         return self._fragment_result(node.fragment_id)
 
-    def _compact_live(self, batch: Batch, tag: str) -> Batch:
+    def _compact_live(self, batch: Batch, tag, history_key=None) -> Batch:
         """Compact a stacked batch to the pow2 bucket of the max
         per-worker live count (live rows may sit at scattered slots, so
         this is a gather, not a slice).  Costs one [W] live-count host
         read under a 'transfer' phase — callers only use it at edges
         where a host sync is already being paid (state edges, host
-        boundaries)."""
+        boundaries).  `history_key` additionally records the live bucket
+        into CapacityHistory (the same floor the runtime sizing path
+        records at), so a licensed join's compaction teaches the
+        capacity-economy policy the tight width without a knob-off run."""
         cap = _trailing_cap(batch)
         with self.profile.phase(self._current_fid, "transfer"):
             live = self._host_pull(jnp.sum(batch.mask(), axis=-1))
+        if history_key is not None:
+            CAP_HISTORY.record(
+                history_key,
+                next_pow2(max(1, int(live.max())), floor=1024),
+            )
         cap2 = bucket_cap(int(live.max()), floor=64)
         if cap2 >= cap:
             return batch
@@ -890,9 +912,16 @@ class StageExecutor:
         child = self._raw_remote(node)
         stacked = self._to_stacked(child)
         if node.exchange_kind == "broadcast":
-            out = self._call(
-                ex.broadcast, stacked.stacked, self.wm, phase="collective"
-            )
+            # ship live rows, not static capacity: all_gather replicates
+            # the batch W times, so compacting to the live bucket first
+            # divides the collective bytes by the dead-padding ratio.
+            # The child fragment just completed (its result is being
+            # consumed), so the [W] live read sits at an already-paid
+            # host boundary; compaction is stable, preserving row order.
+            bs = stacked.stacked
+            if _trailing_cap(bs) > 64:
+                bs = self._compact_live(bs, "broadcast_compact")
+            out = self._call(ex.broadcast, bs, self.wm, phase="collective")
             self.profile.add_collective(
                 self._current_fid, batch_bytes(out), "all_gather", "broadcast"
             )
@@ -1239,10 +1268,30 @@ class StageExecutor:
         # fused exchange: bucketize + all_to_all + the FINAL aggregation
         # step run as one compiled program (phase 1 sizes the slot bucket)
         chans = list(range(ngroups))
-        slot_cap = ex.exchange_slot_cap(
-            states, chans, self.wm, profile=self.profile,
-            fid=self._current_fid,
-        )
+        cap_s = _trailing_cap(states)
+        cert = getattr(node, "capacity_cert", None)
+        slot_cap = None
+        if (
+            self.license_caps
+            and cert is not None
+            and cert.valid_for(self.wm.n)
+        ):
+            # group-count license (verify/capacity.py): the partial agg
+            # emits at most one state row per group per worker, so no
+            # worker ever sends more than group_bound rows to any
+            # destination — a proven slot cap with NO [W, W] counts
+            # gather.  Accepted only when the resulting [W, W*slot] final
+            # footprint stays within the states' own width (or at the
+            # floor bucket), so a loose bound can't inflate the program.
+            licensed = next_pow2(min(int(cert.group_bound), cap_s), floor=64)
+            if self.wm.n * licensed <= max(64 * self.wm.n, cap_s):
+                slot_cap = licensed
+                self.profile.bump("agg_slot_cap_proven")
+        if slot_cap is None:
+            slot_cap = ex.exchange_slot_cap(
+                states, chans, self.wm, profile=self.profile,
+                fid=self._current_fid,
+            )
         fcap = self.wm.n * slot_cap
         # budget enforcement: the fused exchange materializes a [W, fcap]
         # output next to the input states — reserve that footprint BEFORE
@@ -1252,7 +1301,6 @@ class StageExecutor:
         from trino_tpu.runtime.memory import ExceededMemoryLimitException
 
         s_bytes = batch_bytes(states)
-        cap_s = _trailing_cap(states)
         row_bytes = max(1, s_bytes // max(1, self.wm.n * cap_s))
         need = s_bytes + self.wm.n * fcap * row_bytes
         ctx = self.memory.child("agg_final")
@@ -1563,8 +1611,20 @@ class StageExecutor:
                 return ExprCompiler(batch).filter_mask(_e)
 
         if node.distribution == "broadcast":
+            # partitioned-build economy for the broadcast that remains:
+            # all_gather replicates the build's FULL static capacity W
+            # times, dead padding included (the measured Q3 wall: a ~20%
+            # live filtered build shipped 27 MB).  Compact to the live
+            # bucket first — the build boundary already pays a host sync
+            # for the dynamic-filter summary, so the [W] live read adds
+            # no new dispatch stall, and the collective moves only live
+            # rows.  Compaction is stable, so build-row order (and with
+            # it the sorted-probe tie-break order) is unchanged.
+            bs = build.stacked
+            if _trailing_cap(bs) > 64:
+                bs = self._compact_live(bs, "broadcast_compact")
             build_stacked = self._call(
-                ex.broadcast, build.stacked, self.wm, phase="collective"
+                ex.broadcast, bs, self.wm, phase="collective"
             )
             self.profile.add_collective(
                 self._current_fid, batch_bytes(build_stacked),
@@ -1589,6 +1649,9 @@ class StageExecutor:
         jkey = (
             node.kind, tuple(pk), tuple(bk), cap_b,
             _sig(probe.symbols), _sig(build.symbols), residual_key,
+            # the probe-kernel knob changes the compiled program text, so
+            # it must discriminate the trace-cache key
+            bool(self.properties.get("pallas_probe")),
         )
         # capacity-history discriminator: two queries can share the same
         # join signature (and compiled programs) while filtering the probe
@@ -1666,12 +1729,26 @@ class StageExecutor:
             )
             return jnp.sum(emit, dtype=jnp.int64)
 
+        use_pallas = bool(self.properties.get("pallas_probe"))
+
         def locate(pb: Batch, bb: Batch):
             # per-shard PagesHash analog: sort THIS shard's build once,
             # then binary-search the probe keys against it
             sb, canon, n_match = _sort_build_device(bb, bk)
             pc, pn = _canon_probe_device(pb, pk, canon)
-            start, count = _locate_sorted(canon, n_match, pc, pn, cap_b=cap_b)
+            if use_pallas and probe_kernel_eligible(canon, pc):
+                # Pallas gather-probe (ops/pallas_probe.py): same
+                # lower/upper-bound search compiled as one kernel with
+                # the sorted build resident across probe blocks;
+                # interpreter mode off-TPU keeps CPU meshes exact
+                start, count = locate_sorted_pallas(
+                    canon[0], n_match, pc[0], pn, cap_b=cap_b,
+                    interpret=jax.default_backend() != "tpu",
+                )
+            else:
+                start, count = _locate_sorted(
+                    canon, n_match, pc, pn, cap_b=cap_b
+                )
             return sb, start, count
 
         def expand(pb: Batch, sb: Batch, start, count, total, out_cap: int):
@@ -1883,33 +1960,85 @@ class StageExecutor:
         `gather/capacity_sizing` bytes, cold and warm alike."""
         cap_p = _trailing_cap(probe_stacked)
         fid = self._current_fid
+        spec = speculation_mode(self.properties)
+        hist_key = ("cap",) + (stats_key if stats_key is not None else key)
+        pkey = ("pcap",) + (stats_key if stats_key is not None else key)
 
         if cert is not None:  # proof-licensed fixed capacity
+            if compact_probe and cap_p > 1024:
+                # probe compaction at the host boundary: deferred filters
+                # leave dead probe capacity, and the certified output cap
+                # scales with the probe's STATIC width — compacting to the
+                # measured live bucket (a [W] read, sound by measurement
+                # rather than speculation) narrows the whole licensed
+                # chain.  The pkey record is the same bucket the runtime
+                # path's speculative probe compaction learns from.
+                probe_stacked = self._compact_live(
+                    probe_stacked, ("licensed_probe_compact",) + key,
+                    history_key=pkey,
+                )
+                cap_p = _trailing_cap(probe_stacked)
             oc = next_pow2(
                 cert.licensed_out_cap(cap_p),
                 floor=min(1024, next_pow2(cap_p, floor=1)),
             )
-
-            def build_licensed(_oc=oc):
-                def step(pb: Batch, bb: Batch):
-                    sb, start, count = locate(pb, bb)
-                    total = device_total(pb, count)
-                    return expand(pb, sb, start, count, total, _oc)
-
-                return step
-
-            fn = cached_spmd_step(
-                self.wm, ("licensed_expand", oc) + key, build_licensed
+            # Economy policy: a license is only worth holding when its
+            # certified width is in the neighborhood of the widths the
+            # runtime path's own programs would span — the learned output
+            # bucket (its expand) and the learned live-probe bucket (its
+            # locate).  A sound-but-loose certificate (e.g. a fanout
+            # bound of 80 on a probe whose matches are sparse) compiles
+            # the whole expand at 80x-wide shapes, and the extra
+            # FLOPs/bytes on dead lanes dwarf the sizing sync the license
+            # deletes.  Host-side state only: CapacityHistory buckets
+            # taught by earlier runtime runs OR by the licensed path's
+            # own compactions above/below — the licensed path teaches its
+            # own economy decision.
+            learned = max(
+                CAP_HISTORY.guess(hist_key, 0), CAP_HISTORY.guess(pkey, 0)
             )
-            out = self._call(fn, probe_stacked, build_stacked)
-            self.profile.bump("join_capacity_proven")
-            join_capacity_counter().labels("proven").inc()
-            return out
+            declined = None
+            if learned and oc > _LICENSE_WIDTH_FACTOR * learned:
+                declined = f"width {oc} > {_LICENSE_WIDTH_FACTOR}x learned {learned}"
+            elif not learned and oc > next_pow2(cap_p, floor=1024):
+                # cold guard: with no history yet, accept only widths
+                # bounded by the probe's own static capacity (fanout<=1
+                # certificates).  A multiplicity license (fanout k>1)
+                # would compile k*cap_p wide on the very first run —
+                # let the runtime path size it once, then relicense.
+                declined = f"cold width {oc} > probe capacity {cap_p}"
+            if declined is None:
+
+                def build_licensed(_oc=oc):
+                    def step(pb: Batch, bb: Batch):
+                        sb, start, count = locate(pb, bb)
+                        total = device_total(pb, count)
+                        return expand(pb, sb, start, count, total, _oc)
+
+                    return step
+
+                fn = cached_spmd_step(
+                    self.wm, ("licensed_expand", oc, cap_p) + key,
+                    build_licensed,
+                )
+                out = self._call(fn, probe_stacked, build_stacked)
+                self.profile.bump("join_capacity_proven")
+                join_capacity_counter().labels("proven").inc()
+                if oc > 1024:
+                    # compact the licensed output to its live bucket at
+                    # this host boundary (the build sync already stalls
+                    # here) and record the tight width so the NEXT run's
+                    # economy decision sees it — the licensed path
+                    # teaches itself
+                    out = self._compact_live(
+                        out, ("licensed_compact",) + key,
+                        history_key=hist_key,
+                    )
+                return out
+            self.profile.bump("join_license_declined")
+            join_capacity_counter().labels("declined").inc()
 
         join_capacity_counter().labels("runtime_check").inc()
-        spec = speculation_mode(self.properties)
-        hist_key = ("cap",) + (stats_key if stats_key is not None else key)
-        pkey = ("pcap",) + (stats_key if stats_key is not None else key)
         out_cap = (
             initial_cap(hist_key, spec) if spec is not None else None
         )

@@ -260,10 +260,16 @@ class ExchangePlacer:
                 [(r, l) for l, r in node.criteria],
                 node.filter,
                 node.distribution,
-                # the capacity certificate is NOT carried: it proved the
-                # original right side unique, and the flip makes the old
-                # LEFT the build side — the runtime sizing path stays on
             )
+            # the ORIGINAL certificate described the pre-flip build side,
+            # so it cannot be carried verbatim — but the flipped node is a
+            # plain left join whose own proof (the old LEFT side's
+            # uniqueness/multiplicity) is derivable right here.  Without
+            # this, every mirrored plan shape the optimizer emits loses
+            # its license and pays the runtime sizing path.
+            from trino_tpu.verify.capacity import derive_join_certificate
+
+            node.capacity_cert = derive_join_certificate(node, self.catalogs)
         left, ldist = self._visit(node.left)
         right, rdist = self._visit(node.right)
         supported = node.kind in ("inner", "left", "full") and node.criteria

@@ -151,6 +151,12 @@ class AggregationNode(PlanNode):
     group_symbols: list  # [Symbol] (outputs for keys)
     aggregations: list  # [(Symbol, Aggregation)]
     step: str = "single"  # single | partial | final
+    #: proof-licensed group-count certificate (verify.capacity): attached
+    #: by license_join_capacities when the distinct group-key combination
+    #: count is proven bounded — the mesh runner then licenses the fused
+    #: exchange's slot capacity with NO [W, W] counts gather (None =
+    #: runtime counts-sizing path)
+    capacity_cert: Optional[object] = None
 
     @property
     def outputs(self):
@@ -162,7 +168,8 @@ class AggregationNode(PlanNode):
 
     def with_children(self, children):
         return AggregationNode(
-            children[0], self.group_symbols, self.aggregations, self.step
+            children[0], self.group_symbols, self.aggregations, self.step,
+            self.capacity_cert,
         )
 
 

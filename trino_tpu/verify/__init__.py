@@ -62,9 +62,12 @@ from trino_tpu.verify.plan_checker import (
 from trino_tpu.verify.partitioning import check_partitioning
 from trino_tpu.verify.capacity import (
     CapacityCertificate,
+    GroupCapacityCertificate,
     check_capacity_certificates,
+    derive_group_certificate,
     derive_join_certificate,
     license_join_capacities,
+    multiplicity_bound,
     seal_licenses,
 )
 from trino_tpu.verify.schedule import ScheduleLicense, license_schedule
@@ -104,9 +107,12 @@ __all__ = [
     "collective_signature",
     "signature_problems",
     "CapacityCertificate",
+    "GroupCapacityCertificate",
     "check_capacity_certificates",
+    "derive_group_certificate",
     "derive_join_certificate",
     "license_join_capacities",
+    "multiplicity_bound",
     "seal_licenses",
     "ScheduleLicense",
     "license_schedule",

@@ -106,6 +106,14 @@ class CapacityCertificate:
     build_rows_bound: Optional[int] = None
     #: sound bound on TOTAL probe-side rows (None = unproven)
     probe_rows_bound: Optional[int] = None
+    #: INNER joins only: proven max probe rows per distinct join-key value
+    #: (a generator multiplicity fact, e.g. lineitem holds <= 7 rows per
+    #: l_orderkey).  Grouping a worker's probe rows by key value bounds
+    #: its emitted total by multiplicity x live build rows:
+    #: sum_k probe_w(k) * build_w(k) <= m * sum_k build_w(k) <= m * B.
+    #: Outer kinds additionally emit unmatched rows, so the fact is only
+    #: derived (and only applied) for kind == inner.
+    probe_multiplicity_bound: Optional[int] = None
     #: build-side key symbol names the uniqueness proof covers
     key: tuple = ()
     #: audit trail: where each fact came from (stats:/structure:/filter:)
@@ -119,7 +127,19 @@ class CapacityCertificate:
         b = int(cap_p)
         if self.probe_rows_bound is not None:
             b = min(b, int(self.probe_rows_bound))
-        return max(1, int(self.fanout_bound) * b)
+        cap = int(self.fanout_bound) * b
+        if (
+            self.probe_multiplicity_bound is not None
+            and self.build_rows_bound is not None
+        ):
+            # inner-join alternative bound (see field comment): often far
+            # tighter than fanout x cap_p when the build side is filtered
+            cap = min(
+                cap,
+                int(self.probe_multiplicity_bound)
+                * int(self.build_rows_bound),
+            )
+        return max(1, cap)
 
     def valid_for(self, n_workers: int) -> bool:
         return self.mesh_w is not None and int(self.mesh_w) == int(n_workers)
@@ -135,6 +155,44 @@ class CapacityCertificate:
                 None if self.probe_rows_bound is None
                 else int(self.probe_rows_bound)
             ),
+            "probe_multiplicity_bound": (
+                None if self.probe_multiplicity_bound is None
+                else int(self.probe_multiplicity_bound)
+            ),
+            "key": list(self.key),
+            "provenance": list(self.provenance),
+            "mesh_w": self.mesh_w,
+        }
+
+
+@dataclass
+class GroupCapacityCertificate:
+    """Proof that a grouped aggregation produces at most `group_bound`
+    distinct groups, licensing the fused exchange's per-destination slot
+    capacity without the [W, W] counts gather.
+
+    Contract: the partial aggregation emits at most one state row per
+    group per worker, so any worker sends at most `group_bound` rows to
+    any destination — `min(group_bound, cap_states)` is a sound slot
+    capacity.  `group_bound` counts NULL group-key combinations (GROUP BY
+    treats NULL as a value), so it is `prod(ndv_i + nullable_i)` over the
+    group keys, intersected with the source's proven row bound."""
+
+    #: proven max distinct group-key combinations (NULL counted as a value)
+    group_bound: int
+    #: group-key symbol names the proof covers
+    key: tuple = ()
+    #: audit trail: where each fact came from (stats:/rows:)
+    provenance: tuple = field(default_factory=tuple)
+    #: mesh width the license was sealed for (None = not yet sealed)
+    mesh_w: Optional[int] = None
+
+    def valid_for(self, n_workers: int) -> bool:
+        return self.mesh_w is not None and int(self.mesh_w) == int(n_workers)
+
+    def to_json(self) -> dict:
+        return {
+            "group_bound": int(self.group_bound),
             "key": list(self.key),
             "provenance": list(self.provenance),
             "mesh_w": self.mesh_w,
@@ -156,6 +214,7 @@ class _Ctx:
         self.uniq: dict = {}
         self.rows: dict = {}
         self.stats: dict = {}
+        self.mult: dict = {}
 
 
 def _ctx_for(catalogs, ctx) -> "_Ctx":
@@ -357,6 +416,115 @@ def unique_sets(node, catalogs=None, _ctx=None) -> frozenset:
     res = frozenset(out)
     _memo[id(node)] = res
     return res
+
+
+# -- multiplicity derivation ---------------------------------------------------
+
+#: (catalog, table, column) -> max rows holding any one distinct value of
+#: the column — STRUCTURAL facts of the benchmark generators (the same
+#: admissibility rule as exact_distinct: these are spec-mandated
+#: parameters of the data, never estimates).  TPC-H 3.0 spec: each order
+#: generates 1..7 lineitems (clause 4.2.5); each part gets exactly 4
+#: partsupp suppliers (clause 4.2.3).
+_GENERATOR_MULTIPLICITY = {
+    ("tpch", "lineitem", "l_orderkey"): 7,
+    ("tpch", "partsupp", "ps_partkey"): 4,
+    ("tpch", "partsupp", "ps_suppkey"): 80,  # P/S = 200000/10000 per SF
+}
+
+
+def multiplicity_bound(node, cols: frozenset, catalogs=None, _ctx=None) -> Optional[int]:
+    """Sound upper bound on how many output rows of `node` can hold any
+    ONE non-NULL distinct value combination of the symbol-name set
+    `cols`, or None when no admissible proof exists.  A proven-unique set
+    has multiplicity 1; generator facts bound scan columns; row-subset
+    nodes can only shrink a value's row count; a superset of a bounded
+    column set is at least as selective, so any single-column fact in
+    `cols` bounds the whole set."""
+    from trino_tpu.planner import plan as P
+
+    ctx = _ctx_for(catalogs, _ctx)
+    memo_key = (id(node), cols)
+    if memo_key in ctx.mult:
+        return ctx.mult[memo_key]
+    ctx.mult[memo_key] = None  # cycle guard
+    candidates = []
+    if _covers(unique_sets(node, catalogs, ctx), cols):
+        candidates.append(1)
+    rb = rows_bound(node, catalogs, ctx)
+    if rb is not None:
+        candidates.append(int(rb))
+    if isinstance(node, P.TableScanNode):
+        _, exact = _table_stats(node, catalogs)
+        if exact:
+            h = node.handle
+            for sym, col in node.assignments:
+                if sym.name not in cols:
+                    continue
+                m = _GENERATOR_MULTIPLICITY.get((h.catalog, h.table, col))
+                if m is not None:
+                    candidates.append(int(m))
+    elif isinstance(node, P.ProjectNode):
+        # reverse every col through its rename; a non-rename assignment
+        # for a member admits no claim through this path
+        back = {
+            sym.name: e.name
+            for sym, e in node.assignments
+            if isinstance(e, SymbolRef)
+        }
+        if all(n in back for n in cols):
+            m = multiplicity_bound(
+                node.source, frozenset(back[n] for n in cols), catalogs, ctx
+            )
+            if m is not None:
+                candidates.append(m)
+    elif isinstance(node, P.JoinNode):
+        # a side's multiplicity survives when the join multiplies each of
+        # its rows by at most one (same condition as unique_sets): the
+        # other side's key is unique, or it holds at most one row.  Outer
+        # null-extensions carry NULL key values, which non-NULL
+        # multiplicity excludes by definition.
+        lkeys = frozenset(l.name for l, _ in node.criteria)
+        rkeys = frozenset(r.name for _, r in node.criteria)
+        r_one = (
+            bool(node.criteria)
+            and _covers(unique_sets(node.right, catalogs, ctx), rkeys)
+        ) or (
+            (b := rows_bound(node.right, catalogs, ctx)) is not None and b <= 1
+        )
+        l_one = (
+            bool(node.criteria)
+            and _covers(unique_sets(node.left, catalogs, ctx), lkeys)
+        ) or (
+            (b := rows_bound(node.left, catalogs, ctx)) is not None and b <= 1
+        )
+        if r_one:
+            m = multiplicity_bound(node.left, cols, catalogs, ctx)
+            if m is not None:
+                candidates.append(m)
+        if l_one:
+            m = multiplicity_bound(node.right, cols, catalogs, ctx)
+            if m is not None:
+                candidates.append(m)
+    elif isinstance(node, P.SemiJoinNode):
+        m = multiplicity_bound(node.source, cols, catalogs, ctx)
+        if m is not None:
+            candidates.append(m)
+    elif isinstance(
+        node,
+        (
+            P.FilterNode, P.SortNode, P.TopNNode, P.LimitNode, P.SampleNode,
+            P.MarkDistinctNode, P.ExchangeNode, P.EnforceSingleRowNode,
+            P.OutputNode, P.WindowNode,
+        ),
+    ) and len(node.children) == 1:
+        # row-subset / row-preserving: no value combination gains rows
+        m = multiplicity_bound(node.children[0], cols, catalogs, ctx)
+        if m is not None:
+            candidates.append(m)
+    out = min(candidates) if candidates else None
+    ctx.mult[memo_key] = out
+    return out
 
 
 # -- sound row bounds with exact-filter refinement -----------------------------
@@ -574,8 +742,13 @@ def _join_rows_bound(node, catalogs, ctx) -> Optional[int]:
 
 def derive_join_certificate(node, catalogs=None, _ctx=None) -> Optional[CapacityCertificate]:
     """Re-derivable proof for one JoinNode, or None when no admissible
-    proof exists.  Today the only licensed fanout is 1 (build key
-    unique) — exactly the case whose runtime sizing the runner deletes."""
+    proof exists.  The licensed fanout is 1 when the build key is proven
+    unique, else the build side's proven key multiplicity (a generator
+    fact like lineitem's <= 7 rows per l_orderkey) — both exactly the
+    cases whose runtime sizing the runner deletes.  Inner joins
+    additionally carry the PROBE side's key multiplicity, which bounds
+    the emitted total by `multiplicity x build_rows_bound` (see the
+    `probe_multiplicity_bound` field contract)."""
     from trino_tpu.planner import plan as P
 
     if not isinstance(node, P.JoinNode) or not node.criteria:
@@ -587,32 +760,87 @@ def derive_join_certificate(node, catalogs=None, _ctx=None) -> Optional[Capacity
     ctx = _ctx_for(catalogs, _ctx)
     rkeys = frozenset(r.name for _, r in node.criteria)
     r_u = unique_sets(node.right, catalogs, ctx)
-    if not _covers(r_u, rkeys):
-        return None
-    witness = min(
-        (u for u in r_u if u <= rkeys), key=lambda u: (len(u), sorted(u))
-    )
+    prov = []
+    if _covers(r_u, rkeys):
+        fanout = 1
+        witness = min(
+            (u for u in r_u if u <= rkeys), key=lambda u: (len(u), sorted(u))
+        )
+        prov.append(
+            "unique:build[%s]" % ",".join(sorted(witness) or ("<single-row>",))
+        )
+    else:
+        fanout = multiplicity_bound(node.right, rkeys, catalogs, ctx)
+        if fanout is None:
+            return None
+        prov.append(f"multiplicity:build<={fanout}/key")
     build_rows = rows_bound(node.right, catalogs, ctx)
     probe_rows = rows_bound(node.left, catalogs, ctx)
-    prov = [
-        "unique:build[%s]" % ",".join(sorted(witness) or ("<single-row>",)),
-    ]
+    probe_mult = None
+    if node.kind == "inner" and build_rows is not None:
+        lkeys = frozenset(l.name for l, _ in node.criteria)
+        probe_mult = multiplicity_bound(node.left, lkeys, catalogs, ctx)
+        if probe_mult is not None:
+            prov.append(f"multiplicity:probe<={probe_mult}/key")
     if build_rows is not None:
         prov.append(f"rows:build<={build_rows}")
     if probe_rows is not None:
         prov.append(f"rows:probe<={probe_rows}")
     return CapacityCertificate(
-        fanout_bound=1,
+        fanout_bound=fanout,
         build_rows_bound=build_rows,
         probe_rows_bound=probe_rows,
+        probe_multiplicity_bound=probe_mult,
         key=tuple(sorted(rkeys)),
+        provenance=tuple(prov),
+    )
+
+
+def derive_group_certificate(node, catalogs=None, _ctx=None) -> Optional["GroupCapacityCertificate"]:
+    """Re-derivable group-count proof for one grouped AggregationNode, or
+    None.  Admissible sources: the product of exact distinct counts over
+    the group keys (each key's NULL adds one value — GROUP BY groups
+    NULLs), and the source's proven row bound."""
+    from trino_tpu.planner import plan as P
+
+    if not isinstance(node, P.AggregationNode) or not node.group_symbols:
+        return None
+    ctx = _ctx_for(catalogs, _ctx)
+    stats = stats_env(node.source, catalogs, ctx)
+    prov = []
+    candidates = []
+    prod = 1
+    for g in node.group_symbols:
+        cs = stats.get(g.name)
+        if (
+            cs is None
+            or cs.distinct_count is None
+            or not getattr(cs, "exact_distinct", False)
+        ):
+            prod = None
+            break
+        dc = int(cs.distinct_count) + (1 if cs.null_fraction else 0)
+        prod *= max(1, dc)
+    if prod is not None:
+        candidates.append(prod)
+        prov.append(f"stats:distinct<={prod}")
+    rb = rows_bound(node.source, catalogs, ctx)
+    if rb is not None:
+        candidates.append(max(1, int(rb)))
+        prov.append(f"rows:source<={rb}")
+    if not candidates:
+        return None
+    return GroupCapacityCertificate(
+        group_bound=min(candidates),
+        key=tuple(sorted(g.name for g in node.group_symbols)),
         provenance=tuple(prov),
     )
 
 
 def license_join_capacities(plan, catalogs=None) -> int:
     """The planner-facing licensing pass: attach a `capacity_cert` to every
-    join with an admissible fanout proof.  Runs at the end of
+    join with an admissible fanout proof and to every grouped aggregation
+    with an admissible group-count proof.  Runs at the end of
     `optimize()` — before exchange placement and fragmentation, which both
     carry the field through reconstruction.  Proof-only: never changes
     plan shape or results.  Returns the number licensed."""
@@ -621,9 +849,12 @@ def license_join_capacities(plan, catalogs=None) -> int:
     n = 0
     ctx = _Ctx(catalogs)
     for node in _walk(plan):
-        if not isinstance(node, P.JoinNode):
+        if isinstance(node, P.JoinNode):
+            cert = derive_join_certificate(node, catalogs, ctx)
+        elif isinstance(node, P.AggregationNode):
+            cert = derive_group_certificate(node, catalogs, ctx)
+        else:
             continue
-        cert = derive_join_certificate(node, catalogs, ctx)
         if cert is not None:
             node.capacity_cert = cert
             n += 1
@@ -667,8 +898,33 @@ def check_capacity_certificates(plan, catalogs=None) -> list:
         cert = getattr(node, "capacity_cert", None)
         if cert is None:
             continue
+        if isinstance(node, P.AggregationNode):
+            if not isinstance(cert, GroupCapacityCertificate):
+                bad(node, "aggregation carries a non-group certificate")
+                continue
+            if int(cert.group_bound) < 1:
+                bad(node, f"group_bound {cert.group_bound} < 1 is vacuous")
+                continue
+            gd = derive_group_certificate(node, catalogs, ctx)
+            if gd is None:
+                bad(
+                    node,
+                    "no admissible group-count proof exists for group keys "
+                    f"{cert.key} — the certificate asserts <= "
+                    f"{cert.group_bound} groups without a witness",
+                )
+            elif int(cert.group_bound) < int(gd.group_bound):
+                bad(
+                    node,
+                    f"group_bound {cert.group_bound} is tighter than the "
+                    f"provable bound {gd.group_bound}",
+                )
+            continue
         if not isinstance(node, P.JoinNode):
             bad(node, "capacity_cert attached to a non-join node")
+            continue
+        if isinstance(cert, GroupCapacityCertificate):
+            bad(node, "join carries a group certificate")
             continue
         if int(cert.fanout_bound) < 1:
             bad(node, f"fanout_bound {cert.fanout_bound} < 1 is vacuous")
@@ -688,8 +944,10 @@ def check_capacity_certificates(plan, catalogs=None) -> list:
                 f"fanout_bound {cert.fanout_bound} is tighter than the "
                 f"provable bound {derived.fanout_bound}",
             )
-        for name in ("build_rows_bound", "probe_rows_bound"):
-            claimed = getattr(cert, name)
+        for name in (
+            "build_rows_bound", "probe_rows_bound", "probe_multiplicity_bound",
+        ):
+            claimed = getattr(cert, name, None)
             provable = getattr(derived, name)
             if claimed is None:
                 continue
@@ -713,7 +971,10 @@ def verify_benchmarks(verbose: bool = False) -> dict:
     from trino_tpu.planner import plan as P
     from trino_tpu.runtime.runner import LocalQueryRunner
 
-    totals = {"queries": 0, "joins": 0, "licensed": 0, "violations": 0}
+    totals = {
+        "queries": 0, "joins": 0, "licensed": 0, "agg_licensed": 0,
+        "violations": 0,
+    }
     suites = (
         ("tpch", "tiny", "trino_tpu.connectors.tpch.queries"),
         ("tpcds", "tiny", "trino_tpu.connectors.tpcds.queries"),
@@ -735,6 +996,12 @@ def verify_benchmarks(verbose: bool = False) -> dict:
             ]
             totals["joins"] += len(joins)
             totals["licensed"] += len(licensed)
+            totals["agg_licensed"] += sum(
+                1
+                for n in _walk(plan)
+                if isinstance(n, P.AggregationNode)
+                and getattr(n, "capacity_cert", None) is not None
+            )
             violations = check_capacity_certificates(plan, r.catalogs)
             totals["violations"] += len(violations)
             if violations:
@@ -763,6 +1030,7 @@ def main() -> int:  # pragma: no cover - CLI entry
         f"capacity: {t['queries']} plans, {t['joins']} joins — "
         f"{t['licensed']} LICENSED (runtime sizing deleted), "
         f"{t['joins'] - t['licensed']} runtime-check fallback, "
+        f"{t['agg_licensed']} group-count licensed aggregation(s), "
         f"{t['violations']} VIOLATION(s)"
     )
     return 1 if t["violations"] else 0
@@ -771,4 +1039,10 @@ def main() -> int:  # pragma: no cover - CLI entry
 if __name__ == "__main__":  # pragma: no cover
     import sys
 
-    sys.exit(main())
+    # `python -m` loads this file as `__main__`, a SECOND copy of the
+    # module — its certificate classes would then differ from the ones
+    # optimize() attached and every isinstance re-derivation check would
+    # miscompare.  Delegate to the canonical import instead.
+    from trino_tpu.verify import capacity as _canonical
+
+    sys.exit(_canonical.main())

@@ -153,8 +153,15 @@ class _Enumerator:
             return
         # fused exchange: slot-cap counts sync, then bucketize+all_to_all+
         # final/single-stage step as one program (same shape for the
-        # partial/final and the distinct/holistic single-stage paths)
-        self._emit(node, "gather", "capacity_sizing")
+        # partial/final and the distinct/holistic single-stage paths).
+        # A group-count certificate (verify/capacity.py) licenses the slot
+        # cap from the proven group bound — elidable for the same reason
+        # as the licensed join's sizing gather: the runner's accept/
+        # decline decision is host-side and uniform by construction
+        self._emit(
+            node, "gather", "capacity_sizing",
+            elidable=getattr(node, "capacity_cert", None) is not None,
+        )
         self._emit(node.source, "all_to_all", "repartition")
 
     # -- joins -----------------------------------------------------------------
