@@ -490,18 +490,56 @@ metrics_snapshot = {
 
 # licensed-never-slower bisection (compare_bench check_licenses gate):
 # re-run warm Q3 with `join_capacity_license = false` so the SAME session
-# measures the runtime sizing path's warm wall next to the licensed wall
-# already benched above.  A license the economy policy should have
-# declined shows up here as licensed_warm_s >> runtime_warm_s.  Runs
-# AFTER the registry snapshot: the runtime path legitimately bumps
+# measures the runtime sizing path's warm wall next to the licensed wall.
+# A license the economy policy should have declined shows up here as
+# licensed_warm_s >> runtime_warm_s.  The two paths are sampled
+# INTERLEAVED (A/B, per-path minima) under the same instantaneous load —
+# a ratio gate fed one sample from minutes earlier drifts on a busy box.
+# Runs AFTER the registry snapshot: the runtime path legitimately bumps
 # runtime_check / sizing counters that must not pollute the licensed
 # phase's zero-counter evidence.
 dist.properties.set("join_capacity_license", False)
 dist.execute(QUERIES[3])  # settle: compile the runtime path + learn caps
-q3_runtime_warm = warm_q(dist, 3)
-dist.properties.set("join_capacity_license", True)
-q3_licenses["licensed_warm_s"] = round(q3_mesh_warm, 4)
+q3_runtime_warm = q3_licensed_warm = float("inf")
+for _ in range(max(2, runs)):
+    dist.properties.set("join_capacity_license", False)
+    q3_runtime_warm = min(q3_runtime_warm, warm_q(dist, 3))
+    dist.properties.set("join_capacity_license", True)
+    q3_licensed_warm = min(q3_licensed_warm, warm_q(dist, 3))
+q3_licenses["licensed_warm_s"] = round(q3_licensed_warm, 4)
 q3_licenses["runtime_warm_s"] = round(q3_runtime_warm, 4)
+
+# global dictionary service evidence (runtime/dictionary_service +
+# compare_bench check_dictionary): a varchar-keyed distributed join under
+# a layout must co-locate through the shared versioned code assignment —
+# zero repartition collectives, elided exchanges, rows == local — and the
+# dictionary-backed unique business key must license its capacity.  Runs
+# AFTER the registry snapshot (its cold run legitimately compiles).
+try:
+    from trino_tpu.runtime.dictionary_service import DICTIONARY_SERVICE
+    dict_sql = (
+        "select count(*) from customer c1 join customer c2 "
+        "on c1.c_name = c2.c_name"
+    )
+    dist.execute(
+        "set session table_layouts = 'tpch.%s.customer:c_name:8'" % schema
+    )
+    dist.execute(dict_sql)  # settle: compile + learn capacities
+    dict_rows = dist.execute(dict_sql).rows
+    dprof = dist.last_mesh_profile
+    dcounters = dict(dprof.counters) if dprof is not None else {}
+    dict_local = local.execute(dict_sql).rows
+    dictionary = {
+        "exchange_elided": dcounters.get("exchange_elided", 0),
+        "repartition_collective": dcounters.get("repartition_collective", 0),
+        "join_capacity_proven": dcounters.get("join_capacity_proven", 0),
+        "matches_local": (
+            sorted(map(str, dict_rows)) == sorted(map(str, dict_local))
+        ),
+        "service": DICTIONARY_SERVICE.stats(),
+    }
+except Exception as e:
+    dictionary = {"error": f"{type(e).__name__}: {e}"}
 
 # pressure: Q18 under a pool limit smaller than its build side must
 # complete in k>1 partition waves with filesystem-SPI spill and rows ==
@@ -598,6 +636,9 @@ print(json.dumps({
         "manifest_keys": len(dist.compile_manifest()),
         "total_compile_s": round(OBSERVATORY.total_wall_s, 4),
     },
+    # varchar-key co-location through the global dictionary service
+    # (tools/compare_bench.py check_dictionary gates this)
+    "dictionary": dictionary,
     # memory-pressure degradation proof (budget -> revoke -> wave -> kill)
     "pressure": pressure,
     # telemetry-on overhead (acceptance: on/off ratio < 1.05 warm)

@@ -1,0 +1,305 @@
+"""Global dictionary service (runtime/dictionary_service): versioned
+mesh-wide code assignment, snapshot round-trips, serde refs, and the
+version-gated placement claim in partitioning/properties."""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.columnar.dictionary import (
+    PatternDictionary,
+    StringDictionary,
+    UnorderedDictionary,
+)
+from trino_tpu.runtime.dictionary_service import (
+    DICTIONARY_SERVICE,
+    GlobalDictionaryService,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture
+def svc():
+    return GlobalDictionaryService()
+
+
+KEY = ("memory", "s", "t", "c")
+
+
+def _reg(svc, values, **kw):
+    return svc.register(*KEY, StringDictionary(list(values)), **kw)
+
+
+class TestRegistration:
+    def test_idempotent_by_fingerprint(self, svc):
+        e1 = _reg(svc, ["a", "b", "c"])
+        e2 = _reg(svc, ["a", "b", "c"])
+        assert e2 is e1 and e1.version == 1
+        assert svc.stats() == {"keys": 1, "versions": 1, "unique": 0}
+
+    def test_append_extension_bumps_without_remap(self, svc):
+        e1 = _reg(svc, ["a", "b"])
+        e2 = _reg(svc, ["a", "b", "c"])
+        assert e2.version == e1.version + 1 and not e2.remap
+        # old codes keep their meaning: the prior version still resolves
+        assert tuple(svc.resolve(KEY, e1.version).values) == ("a", "b")
+
+    def test_rewrite_is_a_remap_bump(self, svc):
+        e1 = _reg(svc, ["a", "b", "d"])
+        e2 = _reg(svc, ["a", "b", "c", "d"])  # insertion re-maps "d"
+        assert e2.version == e1.version + 1 and e2.remap
+        # claims key on exact versions, so both stay resolvable
+        assert len(svc.resolve(KEY, e1.version)) == 3
+        assert len(svc.resolve(KEY, e2.version)) == 4
+
+    def test_extend_is_append_only(self, svc):
+        e1 = _reg(svc, ["a", "b", "c"])
+        e2 = svc.extend(KEY, ["zz", "b", "aa"])
+        assert e2.version == e1.version + 1 and not e2.remap
+        # existing codes NEVER re-map: the old values stay a prefix
+        assert tuple(e2.dictionary.values)[: len(e1.dictionary)] == tuple(
+            e1.dictionary.values
+        )
+        assert isinstance(e2.dictionary, UnorderedDictionary)
+        # order-dependent dictionary ops must refuse the unordered epoch
+        with pytest.raises(TypeError):
+            e2.dictionary.lower_bound("b")
+        with pytest.raises(TypeError):
+            e2.dictionary.prefix_range("a")
+        # no-op extension returns the current entry unchanged
+        assert svc.extend(KEY, ["a"]) is e2
+        with pytest.raises(KeyError):
+            svc.extend(("memory", "s", "t", "other"), ["x"])
+
+    def test_unique_upgrade_sticks(self, svc):
+        e1 = _reg(svc, ["a", "b"])
+        assert not e1.unique
+        e2 = _reg(svc, ["a", "b"], unique=True)
+        assert e2 is e1 and e1.unique
+
+    def test_resolve_unknown_ref_raises(self, svc):
+        with pytest.raises(KeyError):
+            svc.resolve(("no", "such", "table", "col"), 1)
+
+
+class TestSnapshots:
+    def test_round_trip_through_filesystem(self, svc, tmp_path):
+        _reg(svc, ["a", "b"], unique=True)
+        _reg(svc, ["a", "b", "c"])
+        loc = str(tmp_path / "dicts" / "snapshot.json")
+        svc.save_snapshot(loc)
+        # atomic publish: the final file is valid JSON, no tmp leftovers
+        names = [p.name for p in (tmp_path / "dicts").iterdir()]
+        assert names == ["snapshot.json"]
+        doc = json.loads((tmp_path / "dicts" / "snapshot.json").read_text())
+        assert doc["entries"]
+
+        fresh = GlobalDictionaryService()
+        assert fresh.load_snapshot(loc) == 2
+        assert fresh.stats() == {"keys": 1, "versions": 2, "unique": 1}
+        assert tuple(fresh.resolve(KEY, 1).values) == ("a", "b")
+        assert fresh.entry(KEY, 1).unique
+
+    def test_missing_snapshot_degrades_loudly(self, svc, tmp_path, caplog):
+        with caplog.at_level(logging.WARNING):
+            n = svc.load_snapshot(str(tmp_path / "nope.json"))
+        assert n == 0
+        assert "degrading to producer-local codes" in caplog.text
+        # degraded, not broken: registration still works afterwards
+        assert _reg(svc, ["a"]).version == 1
+
+    def test_torn_snapshot_degrades_loudly(self, svc, tmp_path, caplog):
+        p = tmp_path / "torn.json"
+        p.write_bytes(b'{"version": 1, "entries": [{"key": ["a"')
+        with caplog.at_level(logging.WARNING):
+            n = svc.load_snapshot(str(p))
+        assert n == 0
+        assert "unreadable" in caplog.text
+        assert svc.stats()["versions"] == 0
+
+    def test_bad_entry_skipped_not_fatal(self, svc, caplog):
+        doc = {
+            "version": 1,
+            "entries": [
+                {"nonsense": True},
+                {
+                    "key": list(KEY), "version": 1, "unique": False,
+                    "values": ["a", "b"], "ordered": True,
+                },
+            ],
+        }
+        with caplog.at_level(logging.WARNING):
+            assert svc.load_doc(doc) == 1
+        assert "ignored" in caplog.text
+        assert tuple(svc.resolve(KEY, 1).values) == ("a", "b")
+
+    def test_metadata_only_entry_adopts_recorded_version(self, svc):
+        # a big dictionary snapshots as metadata only; the re-registering
+        # connector must adopt the RECORDED version so pre-restart refs
+        # stay valid
+        big = StringDictionary([f"v{i:04d}" for i in range(64)])
+        e = svc.register(*KEY, big, unique=True)
+        assert e.version == 1
+        doc = svc.snapshot_doc(max_inline=8)
+        assert doc["entries"][0]["values"] is None
+
+        fresh = GlobalDictionaryService()
+        fresh.load_doc(doc)
+        # before re-registration the ref is unresolvable (and says so)
+        with pytest.raises(KeyError):
+            fresh.resolve(KEY, 1)
+        e2 = fresh.register(*KEY, big)
+        assert e2.version == 1 and e2.unique  # recorded version + unique
+        assert fresh.resolve(KEY, 1) is big
+
+    def test_adoption_never_collides_with_new_content(self, svc):
+        e = _reg(svc, ["a", "b"])
+        doc = svc.snapshot_doc(max_inline=0)  # force metadata-only
+        fresh = GlobalDictionaryService()
+        fresh.load_doc(doc)
+        # DIFFERENT content must not steal the recorded version
+        e2 = fresh.register(*KEY, StringDictionary(["x", "y"]))
+        assert e2.version == e.version + 1
+
+    def test_pattern_dictionary_fingerprint_stays_lazy(self, svc):
+        d = PatternDictionary("k#", 10**7, 12)
+        e = svc.register("tpcds", "tiny", "customer", "c_customer_id", d)
+        doc = svc.snapshot_doc()
+        (rec,) = doc["entries"]
+        assert rec["values"] is None and rec["len"] == 10**7
+        assert e.fingerprint[0] == "pattern"
+
+
+class TestSerde:
+    def test_globally_coded_column_ships_as_ref(self):
+        from trino_tpu.columnar import Batch, Column
+        from trino_tpu.parallel.serde import batches_to_bytes, bytes_to_batches
+
+        DICTIONARY_SERVICE.reset()
+        try:
+            d = StringDictionary(["x", "y", "z"])
+            DICTIONARY_SERVICE.register("memory", "s", "t", "c", d)
+            col = Column(
+                np.array([0, 2, 1], np.int32), T.VARCHAR, None, d
+            )
+            wire = batches_to_bytes([Batch([col], np.ones(3, bool))])
+            (got,) = bytes_to_batches(wire)
+            assert got.columns[0].dictionary is d  # resolved, not copied
+            # producer-local dictionaries still ship values
+            d2 = StringDictionary(["m", "n"])
+            col2 = Column(np.array([1, 0], np.int32), T.VARCHAR, None, d2)
+            wire2 = batches_to_bytes([Batch([col2], np.ones(2, bool))])
+            (got2,) = bytes_to_batches(wire2)
+            assert tuple(got2.columns[0].dictionary.values) == ("m", "n")
+        finally:
+            DICTIONARY_SERVICE.reset()
+
+    def test_values_tuple_named_ref_is_not_a_ref(self):
+        # a pathological 3-string dictionary starting with "ref" must NOT
+        # be mistaken for a (ref, key, version) marker
+        from trino_tpu.parallel.serde import _dict_restore
+
+        got = _dict_restore(("ref", "s", "t"))
+        assert tuple(got.values) == ("ref", "s", "t")
+
+    def test_unresolvable_ref_raises_not_misdecodes(self):
+        from trino_tpu.parallel.serde import _dict_restore
+
+        DICTIONARY_SERVICE.reset()
+        try:
+            with pytest.raises(KeyError):
+                _dict_restore(("ref", ("memory", "s", "t", "c"), 7))
+        finally:
+            DICTIONARY_SERVICE.reset()
+
+
+class TestPlacementClaims:
+    """Satellite: the properties.py lift is VERSION-GATED, not deleted —
+    producer-local dictionary keys never claim cross-side placement."""
+
+    def _pair(self):
+        from trino_tpu.planner.plan import Symbol
+
+        return (Symbol("lk", T.VARCHAR), Symbol("rk", T.VARCHAR))
+
+    def test_producer_local_string_pair_stays_excluded(self):
+        from trino_tpu.partitioning.properties import hash_aligned_criteria
+
+        crit = [self._pair()]
+        assert hash_aligned_criteria(crit) == []
+        assert hash_aligned_criteria(crit, coding={}) == []
+        # one side coded, the other producer-local: still excluded
+        ref = (KEY, 1)
+        assert hash_aligned_criteria(crit, coding={"lk": ref}) == []
+
+    def test_mixed_versions_stay_excluded(self):
+        from trino_tpu.partitioning.properties import hash_aligned_criteria
+
+        crit = [self._pair()]
+        coding = {"lk": (KEY, 1), "rk": (KEY, 2)}
+        assert hash_aligned_criteria(crit, coding) == []
+
+    def test_same_ref_lifts_the_exclusion(self):
+        from trino_tpu.partitioning.properties import hash_aligned_criteria
+
+        crit = [self._pair()]
+        coding = {"lk": (KEY, 2), "rk": (KEY, 2)}
+        assert hash_aligned_criteria(crit, coding) == crit
+        # integer pairs are untouched by the gate
+        from trino_tpu.planner.plan import Symbol
+
+        icrit = [(Symbol("a", T.BIGINT), Symbol("b", T.BIGINT))]
+        assert hash_aligned_criteria(icrit) == icrit
+
+    def test_derive_coding_respects_session_gate(self, local_tpch):
+        from trino_tpu.partitioning import derive_dictionary_coding
+        from trino_tpu.partitioning.layout import LayoutResolver
+        from trino_tpu.planner import plan as P
+
+        plan = local_tpch.create_plan("select o_orderpriority from orders")
+        scan = next(
+            n for n in P.walk(plan) if isinstance(n, P.TableScanNode)
+        )
+        r = LayoutResolver(local_tpch.catalogs, None)
+        coding = derive_dictionary_coding(scan, r)
+        assert "o_orderpriority" in coding
+        r.global_dicts = False
+        assert derive_dictionary_coding(scan, r) == {}
+
+
+@pytest.fixture
+def local_tpch():
+    from trino_tpu.runtime.runner import LocalQueryRunner
+
+    return LocalQueryRunner(catalog="tpch", schema="tiny")
+
+
+class TestPrewarmManifest:
+    def test_manifest_carries_and_restores_dictionaries(self, tmp_path):
+        from trino_tpu.runtime.prewarm import WorkloadManifest
+
+        svc = GlobalDictionaryService()
+        svc.register(*KEY, StringDictionary(["a", "b"]), unique=True)
+        m = WorkloadManifest(
+            statements=["select 1"], dictionaries=svc.snapshot_doc()
+        )
+        doc = m.to_json()
+        back = WorkloadManifest.from_json(doc)
+        assert back.dictionaries == m.dictionaries
+        fresh = GlobalDictionaryService()
+        assert fresh.load_doc(back.dictionaries) == 1
+        assert tuple(fresh.resolve(KEY, 1).values) == ("a", "b")
+
+    def test_manifest_without_dictionaries_is_tolerated(self):
+        from trino_tpu.runtime.prewarm import WorkloadManifest
+
+        doc = WorkloadManifest(statements=["select 1"]).to_json()
+        doc.pop("dictionaries", None)
+        back = WorkloadManifest.from_json(doc)
+        assert back.dictionaries is None

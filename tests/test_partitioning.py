@@ -77,6 +77,8 @@ class TestLayouts:
 
         class _Props:
             def get(self, name):
+                if name == "global_dictionaries":
+                    return True
                 assert name == "table_layouts"
                 return "tpch.tiny.lineitem:l_orderkey:16"
 
@@ -110,7 +112,7 @@ class TestLayouts:
 
     def test_scan_partitioning_eligibility(self, clean_layouts, local):
         declare_layout("tpch.tiny.lineitem", ["l_orderkey"], 8)
-        declare_layout("tpch.tiny.orders", ["o_comment"], 8)  # string: no
+        declare_layout("tpch.tiny.orders", ["o_comment"], 8)  # string key
         r = LayoutResolver(local.catalogs, None)
         plan = local.create_plan(
             "select l_orderkey, o_comment from lineitem, orders"
@@ -124,8 +126,15 @@ class TestLayouts:
         }
         hit = PT.scan_partitioning(scans["lineitem"], r, 8)
         assert hit is not None and hit[1] == ("l_orderkey",)
-        # string bucket column: not hash-mirrorable, layout is unusable
-        assert PT.scan_partitioning(scans["orders"], r, 8) is None
+        # string bucket column: usable ONLY through a global dictionary
+        # code assignment (tpch registers one per string column, so codes
+        # hash-mirror like integers); with the service gated off the
+        # layout is unusable again — producer-local codes don't mirror
+        hit_o = PT.scan_partitioning(scans["orders"], r, 8)
+        assert hit_o is not None and hit_o[1] == ("o_comment",)
+        r_off = LayoutResolver(local.catalogs, None)
+        r_off.global_dicts = False
+        assert PT.scan_partitioning(scans["orders"], r_off, 8) is None
         # bucket_count must be a multiple of the worker count
         assert PT.scan_partitioning(scans["lineitem"], r, 3) is None
         # bucket column not scanned: no placement
@@ -485,6 +494,36 @@ class TestMeshExecution:
         dr = d.execute(sql).rows
         lr = local.execute(sql).rows
         assert dr == lr
+
+    def test_varchar_key_colocated_join_via_global_dictionary(self, local):
+        """End-to-end claim of the global dictionary service: a varchar
+        business key under a layout co-locates and elides exchanges like
+        an integer key (codes hash-mirror under the shared versioned
+        assignment), and the dictionary-backed `unique` entry licenses
+        the join's capacity — zero repartition collectives, zero runtime
+        sizing, rows identical to local."""
+        from trino_tpu.parallel import DistributedQueryRunner
+
+        d = DistributedQueryRunner(n_workers=8, catalog="tpcds")
+        d.execute(
+            "set session table_layouts = 'tpcds.tiny.customer:c_customer_id:8'"
+        )
+        sql = (
+            "select count(*) from tpcds.tiny.customer c1 "
+            "join tpcds.tiny.customer c2 "
+            "on c1.c_customer_id = c2.c_customer_id"
+        )
+        dr = d.execute(sql).rows
+        lr = local.execute(sql).rows
+        assert dr == lr
+        c = d.last_mesh_profile.counters
+        assert c.get("repartition_collective", 0) == 0
+        assert c.get("exchange_elided", 0) > 0
+        assert c.get("join_capacity_proven", 0) >= 1
+        # the lift is session-gated: turned off, plans fall back to
+        # producer-local codes — more exchanges, same rows
+        d.execute("set session global_dictionaries = false")
+        assert d.execute(sql).rows == lr
 
     def test_residual_semi_with_misaligned_bucketized_scan(self, local):
         """A side bucketized on OTHER columns than the semi key (lineitem

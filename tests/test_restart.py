@@ -220,6 +220,47 @@ def test_restarted_process_prewarm_closure(tmp_path, mesh2):
     )
 
 
+def test_restart_resolves_dictionary_codes_from_manifest(tmp_path, mesh2):
+    """Global dictionary restart bar: the manifest carries the versioned
+    code assignment (`dictionaries` doc), the restarted process adopts it
+    BEFORE replaying, and a warm varchar statement then records zero
+    compile events above the closure watermark — warm paths never block
+    on (or re-derive differently-versioned) code resolution."""
+    from trino_tpu.parallel import DistributedQueryRunner
+    from trino_tpu.parallel.spmd import TRACE_CACHE
+    from trino_tpu.runtime.dictionary_service import DICTIONARY_SERVICE
+
+    vsql = (
+        "select o_orderpriority, count(*) from orders "
+        "group by o_orderpriority"
+    )
+    loc = str(tmp_path / "manifest.json")
+    mesh2.execute(vsql)
+    ex = PrewarmExecutor(mesh2, loc)
+    ex.record(vsql)
+    assert ex.save() is True
+    m = load_manifest(loc)
+    assert m.dictionaries and m.dictionaries.get("entries"), (
+        "the saved manifest must carry the global dictionary snapshot"
+    )
+
+    # "restart": the trace cache AND the dictionary registry are
+    # process-local; only the manifest survives
+    TRACE_CACHE.clear()
+    DICTIONARY_SERVICE.reset()
+    restarted = DistributedQueryRunner(n_workers=2, schema="tiny")
+    ex2 = attach_prewarm(restarted, loc)
+    ex2.run(reason="start", wait=True)
+    assert ex2.state == "WARM"
+    assert DICTIONARY_SERVICE.stats()["versions"] > 0, (
+        "replay must re-adopt the recorded code assignment"
+    )
+
+    mark = OBSERVATORY.mark()
+    restarted.execute(vsql)
+    assert OBSERVATORY.mark() - mark == 0
+
+
 def test_grow_prewarms_at_new_mesh_signature(tmp_path, mesh2):
     """PR 7 gap (d): after add_worker grows the mesh, the background
     prewarm re-traces the manifest at the NEW mesh signature, so the next

@@ -431,8 +431,19 @@ def _clean_extra():
                     "join_speculative_retry": 0,
                 },
                 "pressure": _clean_pressure(),
+                "dictionary": _clean_dictionary(),
             }
         },
+    }
+
+
+def _clean_dictionary():
+    return {
+        "exchange_elided": 2,
+        "repartition_collective": 0,
+        "join_capacity_proven": 1,
+        "matches_local": True,
+        "service": {"keys": 4, "versions": 4, "unique": 1},
     }
 
 
@@ -505,6 +516,38 @@ def test_compare_bench_pressure_gate():
     violations, skipped = check_extra(missing)
     assert violations == []
     assert any("no pressure section" in s for s in skipped)
+
+
+def test_compare_bench_dictionary_gate():
+    """The PR 18 global-dictionary gate: a varchar-keyed distributed join
+    under a layout must co-locate through the shared code assignment
+    (elided exchanges, zero repartition collectives), answer the local
+    oracle, and carry a capacity-proven join."""
+    check_extra = _compare_bench().check_extra
+    bad = _clean_extra()
+    d = bad["mesh"]["sf1"]["dictionary"]
+    d["exchange_elided"] = 0
+    d["repartition_collective"] = 2
+    d["join_capacity_proven"] = 0
+    d["matches_local"] = False
+    violations, _ = check_extra(bad)
+    text = "\n".join(violations)
+    assert "dictionary.exchange_elided" in text
+    assert "dictionary.repartition_collective" in text
+    assert "dictionary.join_capacity_proven" in text
+    assert "dictionary.matches_local" in text
+    # a missing dictionary section is reported as skipped, not violated
+    missing = _clean_extra()
+    del missing["mesh"]["sf1"]["dictionary"]
+    violations, skipped = check_extra(missing)
+    assert violations == []
+    assert any("no dictionary section" in s for s in skipped)
+    # an errored probe is skipped too
+    errored = _clean_extra()
+    errored["mesh"]["sf1"]["dictionary"] = {"error": "boom"}
+    violations, skipped = check_extra(errored)
+    assert violations == []
+    assert any("dictionary" in s for s in skipped)
 
 
 def test_compare_bench_serve_gate():

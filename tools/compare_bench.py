@@ -27,7 +27,11 @@ alone may hide (a retrace can cost little on tiny data and 30x on SF10):
     runtime sizing over the Q3 phase — `join_capacity.runtime_check == 0`
     cold and warm, `proven > 0`, the schedule license pre-dispatched at
     least one build fragment (`collective_async > 0`), and the deleted
-    `gather/capacity_sizing` collective stayed deleted.
+    `gather/capacity_sizing` collective stayed deleted;
+  * `dictionary.*` (PR 18, check_dictionary): the varchar-keyed join under
+    a global-dictionary layout co-located (`exchange_elided > 0`, ZERO
+    repartition collectives), its unique business key licensed the
+    capacity, and rows matched the local oracle.
 
 Modes:
   python tools/compare_bench.py                 # gate the checked-in file
@@ -164,6 +168,42 @@ COLDSTART_KEYS = (
 #: compile NOTHING)
 RESTART_PHASES = ("cold", "persistent", "prewarmed")
 RESTART_KEYS = ("wall_s", "compile_s", "compile_events", "query_events")
+
+
+def check_dictionary(schema: str, sec: dict) -> list:
+    """Violations over one mesh section's global-dictionary evidence
+    (`dictionary`, recorded by bench.py around a varchar-keyed self-join
+    under a c_name layout): the shared versioned code assignment must
+    have co-located the join (elided exchanges, ZERO repartition
+    collectives), the dictionary-backed unique key must have licensed its
+    capacity, and the rows must equal the local oracle."""
+    violations = []
+    if sec.get("exchange_elided", 0) <= 0:
+        violations.append(
+            f"mesh.{schema}.dictionary.exchange_elided = "
+            f"{sec.get('exchange_elided')} (expected > 0: the varchar-key "
+            "layout must elide the co-located join's exchanges)"
+        )
+    if sec.get("repartition_collective", 0) != 0:
+        violations.append(
+            f"mesh.{schema}.dictionary.repartition_collective = "
+            f"{sec.get('repartition_collective')} (expected 0: globally "
+            "coded varchar keys co-locate like integers — a repartition "
+            "means the dictionary claim was refused)"
+        )
+    if sec.get("join_capacity_proven", 0) <= 0:
+        violations.append(
+            f"mesh.{schema}.dictionary.join_capacity_proven = "
+            f"{sec.get('join_capacity_proven')} (expected > 0: the "
+            "dictionary-backed unique business key must license the "
+            "join's capacity)"
+        )
+    if sec.get("matches_local") is False:
+        violations.append(
+            f"mesh.{schema}.dictionary.matches_local = False (the "
+            "co-located varchar join changed rows vs the local oracle)"
+        )
+    return violations
 
 
 def check_restart(schema: str, sec: dict) -> list:
@@ -605,6 +645,22 @@ def check_extra(extra: dict) -> tuple:
                         f"mesh.{schema}.coldstart.{qname} missing "
                         f"{missing} (cold/warm decomposition incomplete)"
                     )
+        # varchar-key co-location through the global dictionary service
+        # (PR 18): recorded by bench.py's dictionary phase
+        dsec = sec.get("dictionary")
+        if isinstance(dsec, dict):
+            if dsec.get("error"):
+                skipped.append(
+                    f"mesh.{schema}.dictionary: bench errored: "
+                    f"{dsec['error']}"
+                )
+            else:
+                violations.extend(check_dictionary(schema, dsec))
+        else:
+            skipped.append(
+                f"mesh.{schema}: no dictionary section recorded (run "
+                "bench.py --mesh)"
+            )
         # memory-pressure degradation proof (PR 12): waves+spill under a
         # constrained pool, zero cost unconstrained
         p = sec.get("pressure")

@@ -219,6 +219,43 @@ class PatternDictionary(StringDictionary):
         )
 
 
+class UnorderedDictionary(StringDictionary):
+    """Unique but NOT sorted values — the shape of an append-only global
+    dictionary epoch (runtime/dictionary_service.extend): codes of the
+    original prefix keep their meaning, appended values take the next free
+    codes.  Equality semantics (joins, group-bys, =/IN predicates, late
+    materialization) are order-independent and work unchanged; the
+    order-DEPENDENT operations (range predicates, LIKE prefix ranges,
+    code-order sorting) raise instead of silently misordering — a consumer
+    needing order must re-sort values into a fresh ordered dictionary.
+    """
+
+    __slots__ = ()
+
+    def __init__(self, values):
+        vals = tuple(values)
+        assert len(set(vals)) == len(vals), "dictionary values must be unique"
+        object.__setattr__(self, "values", vals)
+        object.__setattr__(self, "_index", None)
+        object.__setattr__(self, "_hash", None)
+        object.__setattr__(self, "_nbytes", None)
+
+    def _no_order(self, op: str):
+        raise TypeError(
+            f"{op} needs an order-preserving dictionary; this is an "
+            "append-only epoch (codes are not rank-ordered)"
+        )
+
+    def lower_bound(self, value: str) -> int:
+        self._no_order("lower_bound")
+
+    def upper_bound(self, value: str) -> int:
+        self._no_order("upper_bound")
+
+    def prefix_range(self, prefix: str):
+        self._no_order("prefix_range")
+
+
 def union_many(dicts):
     """Merge N dictionaries; returns (merged, [recode tables]) where table[i]
     maps dict i's codes -> merged codes (None when already identical).

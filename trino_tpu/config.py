@@ -323,6 +323,26 @@ class PrewarmConfig(ConfigSection):
 
 
 @dataclass
+class DictionaryConfig(ConfigSection):
+    """Global dictionary service (runtime/dictionary_service.py): the
+    coordinator-owned versioned code assignment that makes varchar keys
+    first-class in exchanges, co-located joins, and capacity licenses."""
+
+    snapshot_path: str = knob(
+        "", "dictionary.snapshot-path",
+        "global-dictionary snapshot location (filesystem SPI; empty = "
+        "snapshots off): versioned code assignments persisted atomically "
+        "so a restarted coordinator resolves codes before the first query",
+    )
+    max_inline_values: int = knob(
+        1 << 16, "dictionary.max-inline-values",
+        "largest dictionary whose values inline into snapshots/manifests; "
+        "bigger (and pattern-backed) dictionaries snapshot as metadata "
+        "only and re-adopt their recorded version at re-registration",
+    )
+
+
+@dataclass
 class DispatcherConfig(ConfigSection):
     """Concurrent query dispatcher (runtime/dispatcher.QueryDispatcher):
     admission control, weighted-fair resource groups, load shedding."""
@@ -437,6 +457,7 @@ class ClusterConfig:
         default_factory=CompileCacheConfig
     )
     prewarm: PrewarmConfig = field(default_factory=PrewarmConfig)
+    dictionary: DictionaryConfig = field(default_factory=DictionaryConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     audit: AuditConfig = field(default_factory=AuditConfig)
     properties: dict = field(default_factory=dict)
@@ -479,6 +500,7 @@ def load_cluster_config(props: Optional[dict] = None, env=None) -> ClusterConfig
         memory=MemoryConfig.from_properties(props, env),
         compile_cache=CompileCacheConfig.from_properties(props, env),
         prewarm=PrewarmConfig.from_properties(props, env),
+        dictionary=DictionaryConfig.from_properties(props, env),
         profile=ProfileConfig.from_properties(props, env),
         audit=AuditConfig.from_properties(props, env),
         properties=props,

@@ -168,6 +168,19 @@ class Connector:
         engine-registry declarations."""
         return None
 
+    def global_dictionary(self, handle: TableHandle, column: str):
+        """(dictionary, unique) when every scan of `handle.column` codes its
+        data against ONE dictionary that is stable across splits, workers,
+        and processes — the registration source for the global dictionary
+        service (runtime/dictionary_service).  `unique=True` additionally
+        asserts the column is a NULL-FREE BIJECTION over the table's rows
+        (dense business keys: dictionary size == row count, every row a
+        distinct value) — the structural claim that makes it an
+        exact_distinct uniqueness source for capacity certificates; never
+        claim it for merely-probably-distinct columns.  Return None (the
+        default) for producer-local coding."""
+        return None
+
     def scan_version(self, handle: TableHandle):
         """Cache token for scan results of `handle`: scans of the same split
         + columns + version may be served from the engine's buffer pool.

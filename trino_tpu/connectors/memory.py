@@ -208,6 +208,23 @@ class MemoryConnector(Connector):
         st = self.store.get((handle.schema, handle.table))
         return st.layout if st is not None else None
 
+    def global_dictionary(self, handle: TableHandle, column: str):
+        """The stored dictionary IS the global assignment — every split
+        reads the same arrays.  An append that re-sorts the union is a
+        REMAP version bump at the service (codes of the old version keep
+        resolving, but plans gate claims on exact versions, so stale and
+        fresh codes never co-locate).  No `unique` claim: inserted data
+        carries no structural bijection proof."""
+        st = self.store.get((handle.schema, handle.table))
+        if st is None:
+            return None
+        for meta, cd in zip(st.meta.columns, st.columns):
+            if meta.name == column:
+                if cd.dictionary is None:
+                    return None
+                return cd.dictionary, False
+        return None
+
     def drop_table(self, handle: TableHandle):
         self.store.pop((handle.schema, handle.table), None)
 

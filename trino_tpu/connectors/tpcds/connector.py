@@ -192,6 +192,29 @@ class TpcdsConnector(Connector):
     def scan_version(self, handle):
         return 0  # generated data is immutable per (schema, table)
 
+    def global_dictionary(self, handle: TableHandle, column: str):
+        """tpcds string columns code against one trace-stable dictionary
+        per (table, column, scale factor).  String ``*_id`` business keys
+        on dimension tables are idx-coded null-free bijections (generic
+        rule + d_date_id: code == row index, dictionary size == row
+        count), so they carry the `unique` capacity claim."""
+        from trino_tpu.connectors.tpcds.generator import _FACTS
+
+        try:
+            sf = ds_schema.schema_scale(handle.schema)
+            gen = generator(sf)
+            d = gen.dictionary(handle.table, column)
+        except (KeyError, ValueError):
+            return None
+        if d is None:
+            return None
+        unique = (
+            handle.table not in _FACTS
+            and column.endswith("_id")
+            and len(d.values) == gen.row_count(handle.table)
+        )
+        return d, unique
+
     def splits(self, handle: TableHandle, target_splits: int, predicate=None):
         sf = ds_schema.schema_scale(handle.schema)
         n = generator(sf).row_count(handle.table)

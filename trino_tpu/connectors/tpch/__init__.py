@@ -178,6 +178,33 @@ class TpchConnector(Connector):
     def scan_version(self, handle):
         return 0  # generated data is immutable per (schema, table)
 
+    #: string columns whose codes are a null-free bijection over the
+    #: table's rows (code == row index, dictionary size == row count):
+    #: admissible uniqueness sources for capacity certificates
+    _UNIQUE_DICTIONARY_COLUMNS = frozenset(
+        {("customer", "c_name"), ("supplier", "s_name")}
+    )
+
+    def global_dictionary(self, handle: TableHandle, column: str):
+        """Every tpch string column is coded against ONE dictionary that is
+        a pure function of (table, column, scale factor) — stable across
+        splits, workers, and processes — so all of them are globally
+        codable."""
+        try:
+            sf = tpch_schema.schema_scale(handle.schema)
+            gen = generator_for(sf)
+            d = gen.dictionary(handle.table, column)
+        except (KeyError, ValueError):
+            return None
+        if d is None:
+            return None
+        unique = (
+            handle.table, column
+        ) in self._UNIQUE_DICTIONARY_COLUMNS and len(d.values) == gen.row_count(
+            handle.table
+        )
+        return d, unique
+
     def splits(self, handle: TableHandle, target_splits: int, predicate=None):
         sf = tpch_schema.schema_scale(handle.schema)
         gen = generator_for(sf)

@@ -377,6 +377,30 @@ class ExprCompiler:
     def _form_coalesce(self, f: SpecialForm) -> Val:
         shp = self.bshape()
         vals = [self.value(a) for a in f.args]
+        if isinstance(f.type, T.DecimalType) and f.type.is_long:
+            # limb planes fold like _case_fold_long: a 1-D broadcast over
+            # [capacity, 2] data is shape-invalid
+            from trino_tpu.expr.functions import _to_planes
+
+            def planes(v):
+                h, l = _to_planes(v, f.type.scale)
+                return (
+                    jnp.broadcast_to(jnp.asarray(h, jnp.int64), shp),
+                    jnp.broadcast_to(jnp.asarray(l, jnp.int64), shp),
+                )
+
+            acc = vals[-1]
+            acc_h, acc_l = planes(acc)
+            acc_valid = _valid_arr(acc.valid, shp)
+            for v in reversed(vals[:-1]):
+                va = _valid_arr(v.valid, shp)
+                vh, vl = planes(v)
+                acc_h = jnp.where(va, vh, acc_h)
+                acc_l = jnp.where(va, vl, acc_l)
+                acc_valid = jnp.logical_or(va, acc_valid)
+            return Val(
+                jnp.stack([acc_h, acc_l], axis=-1), acc_valid, f.type
+            )
         out_dict = self._merge_branch_dicts(vals, f.type)
         acc = vals[-1]
         acc_data = jnp.broadcast_to(

@@ -19,6 +19,39 @@ import numpy as np
 from trino_tpu.columnar import Batch, Column, StringDictionary
 
 
+def _dict_payload(d):
+    """Wire form of a column dictionary: a `("ref", key, version)` global
+    dictionary ref when the service knows the assignment (i32 global codes
+    ship with ZERO value bytes and the consumer resolves locally), else the
+    value tuple (producer-local codes — the consumer re-unions them)."""
+    if d is None:
+        return None
+    from trino_tpu.runtime.dictionary_service import DICTIONARY_SERVICE
+
+    ref = DICTIONARY_SERVICE.ref_of(d)
+    if ref is not None:
+        key, version = ref
+        return ("ref", key, version)
+    return tuple(d.values)
+
+
+def _dict_restore(payload):
+    if payload is None:
+        return None
+    if (
+        isinstance(payload, tuple)
+        and len(payload) == 3
+        and payload[0] == "ref"
+        and isinstance(payload[1], tuple)  # a real values-tuple holds strings
+    ):
+        from trino_tpu.runtime.dictionary_service import DICTIONARY_SERVICE
+
+        # resolve raises on an unresolvable ref: decoding through a wrong
+        # dictionary would be silently wrong results
+        return DICTIONARY_SERVICE.resolve(payload[1], payload[2])
+    return StringDictionary(list(payload))
+
+
 def batches_to_bytes(batches: Sequence[Batch]) -> bytes:
     """Serialize host batches (device arrays are pulled to host)."""
     doc = []
@@ -33,11 +66,7 @@ def batches_to_bytes(batches: Sequence[Batch]) -> bytes:
                         None if c.lengths is None else np.asarray(c.lengths)
                     ),
                     "type": c.type,
-                    "dict": (
-                        None
-                        if c.dictionary is None
-                        else tuple(c.dictionary.values)
-                    ),
+                    "dict": _dict_payload(c.dictionary),
                 }
             )
         doc.append({"cols": cols, "mask": np.asarray(b.mask())})
@@ -50,11 +79,7 @@ def bytes_to_batches(payload: bytes) -> list:
     for b in doc:
         cols = []
         for c in b["cols"]:
-            d = (
-                None
-                if c["dict"] is None
-                else StringDictionary(list(c["dict"]))
-            )
+            d = _dict_restore(c["dict"])
             cols.append(
                 Column(c["data"], c["type"], c["valid"], d, c["lengths"])
             )

@@ -22,6 +22,7 @@ from trino_tpu.partitioning.layout import (
 )
 from trino_tpu.partitioning.properties import (
     align_through_criteria,
+    derive_dictionary_coding,
     derive_partitioning,
     hash_aligned_criteria,
     join_output_placements,
@@ -46,6 +47,7 @@ __all__ = [
     "parse_layout_property",
     "scan_partitioning",
     "align_through_criteria",
+    "derive_dictionary_coding",
     "derive_partitioning",
     "hash_aligned_criteria",
     "join_output_placements",

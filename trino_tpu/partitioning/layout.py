@@ -95,6 +95,9 @@ class LayoutResolver:
     def __init__(self, catalogs=None, properties=None):
         self.catalogs = catalogs
         self._session: dict[tuple, TableLayout] = {}
+        #: whether plan claims may lean on the global dictionary service
+        #: (the `global_dictionaries` session property; default on)
+        self.global_dicts = True
         if properties is not None:
             try:
                 self._session = parse_layout_property(
@@ -102,6 +105,10 @@ class LayoutResolver:
                 )
             except KeyError:  # older property sets
                 self._session = {}
+            try:
+                self.global_dicts = bool(properties.get("global_dictionaries"))
+            except KeyError:  # older property sets
+                pass
 
     def __call__(self, handle) -> Optional[TableLayout]:
         key = (handle.catalog, handle.schema, handle.table)
@@ -151,10 +158,31 @@ def scan_partitioning(node, resolver, n_workers: int):
             return None  # bucket column not scanned: cannot place by it
         ch, sym = hit
         if not hashable_layout_type(sym.type):
-            return None
+            if not _globally_coded_column(node.handle, col, sym.type, resolver):
+                return None
         names.append(sym.name)
         channels.append(ch)
     return layout, tuple(names), tuple(channels)
+
+
+def _globally_coded_column(handle, column, t, resolver) -> bool:
+    """A string bucket column is layout-usable iff its codes are one
+    versioned mesh-global assignment (runtime/dictionary_service): the
+    host/device hash then runs over codes that mean the same thing on
+    every worker, so `(h % B) % W == h % W` places by VALUE exactly like
+    an integer key.  Producer-local dictionaries stay excluded."""
+    if not T.is_string_kind(t):
+        return False
+    if not getattr(resolver, "global_dicts", True):
+        return False
+    from trino_tpu.runtime.dictionary_service import DICTIONARY_SERVICE
+
+    return (
+        DICTIONARY_SERVICE.coding(
+            handle, column, getattr(resolver, "catalogs", None)
+        )
+        is not None
+    )
 
 
 def host_bucket_hash(columns, valids, cap: int) -> np.ndarray:

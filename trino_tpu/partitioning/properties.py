@@ -104,7 +104,14 @@ def _d_join(node, resolver, n_workers):
     if node.kind == "cross" or not node.criteria:
         return ()
     probe = derive_partitioning(node.left, resolver, n_workers)
-    return join_output_placements(probe, node.criteria, node.kind)
+    # build-side equivalents may only be claimed through criteria whose
+    # hash is dictionary-independent OR whose two sides share one global
+    # dictionary version — a producer-local string pair maps equal values
+    # to different codes, so the mirrored claim would be unsound
+    usable = hash_aligned_criteria(
+        node.criteria, derive_dictionary_coding(node, resolver)
+    )
+    return join_output_placements(probe, usable, node.kind)
 
 
 def _d_agg(node, resolver, n_workers):
@@ -135,28 +142,95 @@ _RULES = {
 }
 
 
-def hash_aligned_criteria(criteria) -> list:
+def hash_aligned_criteria(criteria, coding=None) -> list:
     """Criteria pairs usable for cross-side co-location claims: both key
     types must hash dictionary-independently (plain integer kinds).  A
     dictionary-coded (string) key hashes its producer-local codes, so two
     independently-produced sides place equal strings on DIFFERENT workers —
-    eliding their exchange would silently drop matches."""
+    eliding their exchange would silently drop matches.
+
+    The one VERSION-GATED exception (`coding`: symbol name -> (key,
+    version) global dictionary ref, from `derive_dictionary_coding`): when
+    both sides of a string pair carry the SAME versioned global assignment,
+    equal strings provably have equal codes everywhere, so the pair hashes
+    cross-side like an integer key.  Producer-local keys (no ref) and
+    mixed-version pairs stay excluded."""
+    from trino_tpu import types as T
     from trino_tpu.partitioning.layout import hashable_layout_type
 
-    return [
-        (l, r)
-        for l, r in criteria
-        if hashable_layout_type(l.type) and hashable_layout_type(r.type)
-    ]
+    out = []
+    for l, r in criteria:
+        if hashable_layout_type(l.type) and hashable_layout_type(r.type):
+            out.append((l, r))
+        elif (
+            coding is not None
+            and T.is_string_kind(l.type)
+            and T.is_string_kind(r.type)
+            and coding.get(l.name) is not None
+            and coding.get(l.name) == coding.get(r.name)
+        ):
+            out.append((l, r))
+    return out
 
 
-def align_through_criteria(placements, criteria, left_side: bool):
+def derive_dictionary_coding(node, resolver) -> dict:
+    """Bottom-up map of symbol name -> (key, version) global dictionary ref
+    for every string symbol of the subtree's output that is provably coded
+    under one versioned mesh-wide assignment (runtime/dictionary_service).
+    Empty claims are always sound (they just keep the exclusion); a symbol
+    appears ONLY when its codes survive unchanged from a registered scan:
+    identity projections, filters, exchanges (global codes ship as-is),
+    join pass-through, and group keys.  Derived transforms (upper(x),
+    concat, ...) produce fresh dictionaries and drop out."""
+    if resolver is None or not getattr(resolver, "global_dicts", True):
+        return {}
+    return _coding(node, resolver)
+
+
+def _coding(node, resolver) -> dict:
+    name = type(node).__name__
+    if name == "TableScanNode":
+        from trino_tpu import types as T
+        from trino_tpu.runtime.dictionary_service import DICTIONARY_SERVICE
+
+        out = {}
+        for sym, col in node.assignments:
+            if T.is_string_kind(sym.type):
+                ref = DICTIONARY_SERVICE.coding(
+                    node.handle, col, getattr(resolver, "catalogs", None)
+                )
+                if ref is not None:
+                    out[sym.name] = ref
+        return out
+    if name == "ProjectNode":
+        src = _coding(node.source, resolver)
+        out = {}
+        for sym, e in node.assignments:
+            if isinstance(e, SymbolRef) and e.name in src:
+                out[sym.name] = src[e.name]
+        return out
+    if name == "AggregationNode":
+        src = _coding(node.source, resolver)
+        gnames = {s.name for s in node.group_symbols}
+        return {n: ref for n, ref in src.items() if n in gnames}
+    # everything else (filters, exchanges, joins, sorts, ...): the union of
+    # the children's claims — plan symbol names are unique, and these nodes
+    # pass key columns through without re-coding.  Fragment boundaries
+    # (RemoteSourceNode, no children) claim nothing.
+    out = {}
+    for c in node.children:
+        out.update(_coding(c, resolver))
+    return out
+
+
+def align_through_criteria(placements, criteria, left_side: bool,
+                           coding=None):
     """First placement tuple expressible entirely in `criteria` keys of the
     given side, with its opposite-side image: -> (own tuple of Symbols,
     other tuple of Symbols) or None.  Used to co-partition a join: if one
     side is already placed on (a subset of) its keys, the other side only
     needs repartitioning on the ALIGNED opposite keys to co-locate."""
-    usable = hash_aligned_criteria(criteria)
+    usable = hash_aligned_criteria(criteria, coding)
     if left_side:
         own = {l.name: (l, r) for l, r in usable}
     else:

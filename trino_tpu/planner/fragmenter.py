@@ -129,8 +129,25 @@ class ExchangePlacer:
         return derive_partitioning(node, self.resolver, self.n_workers)
 
     def place(self, node: P.PlanNode):
+        self._register_scan_dictionaries(node)
         out, dist = self._visit(node)
         return out
+
+    def _register_scan_dictionaries(self, node: P.PlanNode) -> None:
+        """Eagerly register global dictionaries for every scanned string
+        column (runtime/dictionary_service), not just join keys: the
+        exchange serde then ships (key, version) refs instead of
+        dictionary values for ANY distributed varchar column, and the
+        prewarm manifest snapshots the assignment the workload actually
+        ran under.  Connector dictionaries are cached, so this is one
+        cheap fingerprint lookup per (table, column) per plan."""
+        from trino_tpu.partitioning.properties import (
+            derive_dictionary_coding,
+        )
+
+        for n in P.walk(node):
+            if isinstance(n, P.TableScanNode):
+                derive_dictionary_coding(n, self.resolver)
 
     # returns (node, distribution)
     def _visit(self, node: P.PlanNode):
@@ -331,25 +348,33 @@ class ExchangePlacer:
         keeps its placement and skips the repartition; when BOTH sides
         share an aligned placement the join is fully co-located.  The
         repartitioned side hashes the keys ALIGNED with the placed side's
-        tuple, so equal-key rows of the two sides land on one worker."""
+        tuple, so equal-key rows of the two sides land on one worker.
+
+        String keys participate ONLY when both sides carry the same
+        versioned global dictionary assignment (`derive_dictionary_coding`)
+        — the version gate that makes varchar keys co-locate like integer
+        keys without ever trusting producer-local codes."""
         from trino_tpu.partitioning import (
             align_through_criteria,
+            derive_dictionary_coding,
             hash_aligned_criteria,
         )
 
         lprops = self._placements(left)
         rprops = self._placements(right)
-        l2r = {l.name: r for l, r in hash_aligned_criteria(criteria)}
+        coding = dict(derive_dictionary_coding(left, self.resolver))
+        coding.update(derive_dictionary_coding(right, self.resolver))
+        l2r = {l.name: r for l, r in hash_aligned_criteria(criteria, coding)}
         for tl in lprops:
             if tl and all(n in l2r for n in tl):
                 tr = tuple(l2r[n].name for n in tl)
                 if tr in rprops:
                     return left, right, "colocated"
-        lal = align_through_criteria(lprops, criteria, left_side=True)
+        lal = align_through_criteria(lprops, criteria, True, coding)
         if lal is not None:
             _, other = lal
             return left, P.ExchangeNode(right, "repartition", list(other)), "partitioned"
-        ral = align_through_criteria(rprops, criteria, left_side=False)
+        ral = align_through_criteria(rprops, criteria, False, coding)
         if ral is not None:
             _, other = ral
             return P.ExchangeNode(left, "repartition", list(other)), right, "partitioned"

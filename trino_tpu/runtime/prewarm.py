@@ -119,6 +119,11 @@ class WorkloadManifest:
     #: the observatory's deduplicated key set at save (informational: which
     #: steps/buckets the replay is expected to trace)
     compile_keys: list = field(default_factory=list)
+    #: global dictionary snapshot document (runtime/dictionary_service
+    #: snapshot_doc): shipped with the manifest so a restarted process
+    #: resolves versioned code assignments BEFORE replaying — warm paths
+    #: never block on (or re-derive differently-versioned) code resolution
+    dictionaries: Optional[dict] = None
 
     def to_json(self) -> dict:
         return {
@@ -129,6 +134,7 @@ class WorkloadManifest:
             "closed": self.closed,
             "workers": self.workers,
             "manifest": list(self.compile_keys),
+            "dictionaries": self.dictionaries,
         }
 
     @classmethod
@@ -144,6 +150,11 @@ class WorkloadManifest:
             closed=doc.get("closed"),
             workers=int(doc.get("workers") or 0),
             compile_keys=list(doc.get("manifest") or ()),
+            dictionaries=(
+                doc.get("dictionaries")
+                if isinstance(doc.get("dictionaries"), dict)
+                else None
+            ),
         )
 
 
@@ -304,10 +315,16 @@ class PrewarmExecutor:
         """A manifest of everything recorded in THIS process, with the
         current learned capacities and observatory state."""
         from trino_tpu.partitioning import CAP_HISTORY
+        from trino_tpu.runtime.dictionary_service import DICTIONARY_SERVICE
         from trino_tpu.telemetry.compile_events import OBSERVATORY
 
         with self._state_lock:
             stmts = list(self._recorded)
+        from trino_tpu.config import get_config
+
+        dicts = DICTIONARY_SERVICE.snapshot_doc(
+            get_config().dictionary.max_inline_values
+        )
         return WorkloadManifest(
             statements=stmts,
             cap_history=CAP_HISTORY.snapshot(),
@@ -316,6 +333,7 @@ class PrewarmExecutor:
             workers=getattr(getattr(self.runner, "wm", None), "n", 0)
             or len(getattr(self.runner, "worker_urls", ())),
             compile_keys=OBSERVATORY.manifest(),
+            dictionaries=dicts if dicts.get("entries") else None,
         )
 
     def save(self) -> bool:
@@ -405,6 +423,16 @@ class PrewarmExecutor:
                     # statements take the fused path at the right bucket on
                     # run 1 and the key set closes without extra rounds
                     CAP_HISTORY.seed(m.cap_history)
+                    # adopt the recorded global dictionary assignment BEFORE
+                    # replaying: the replay re-registers connector
+                    # dictionaries under the RECORDED versions, so refs and
+                    # compiled traces from before the restart stay valid
+                    if m.dictionaries:
+                        from trino_tpu.runtime.dictionary_service import (
+                            DICTIONARY_SERVICE,
+                        )
+
+                        DICTIONARY_SERVICE.load_doc(m.dictionaries)
                     # the loaded set joins the recorded set: a restarted
                     # server's save() persists the UNION of the seed
                     # manifest and this incarnation's observed statements

@@ -175,6 +175,16 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "",
         ),
         PropertyMetadata(
+            "global_dictionaries",
+            "let plans lean on the global dictionary service "
+            "(runtime/dictionary_service): varchar join/group keys whose "
+            "two sides share one versioned mesh-wide code assignment "
+            "co-locate and elide exchanges like integer keys (false = "
+            "producer-local codes only; always sound, just more exchanges)",
+            bool,
+            True,
+        ),
+        PropertyMetadata(
             "query_trace",
             "per-query span tracing from admission through SPMD launches "
             "(runner.last_trace / EXPLAIN ANALYZE VERBOSE / "

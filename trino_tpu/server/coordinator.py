@@ -426,6 +426,60 @@ class CoordinatorServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                # /v1/dictionary/{catalog}/{schema}/{table}/{column}
+                # ?version=N — versioned global code assignment fetch
+                # (runtime/dictionary_service): a worker holding a
+                # `("ref", key, version)` wire dictionary it cannot resolve
+                # locally pulls the exact recorded version from the
+                # coordinator, never a "close enough" one
+                if self.path.split("?", 1)[0].startswith("/v1/dictionary/"):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from trino_tpu.runtime.dictionary_service import (
+                        DICTIONARY_SERVICE,
+                    )
+
+                    u = urlsplit(self.path)
+                    dparts = u.path.strip("/").split("/")
+                    if len(dparts) != 6:
+                        return self._send(
+                            404, {"error": {"message": "not found"}}
+                        )
+                    key = tuple(dparts[2:6])
+                    qs = parse_qs(u.query)
+                    try:
+                        version = int(qs.get("version", ["0"])[0])
+                    except ValueError:
+                        return self._send(
+                            400, {"error": {"message": "bad version"}}
+                        )
+                    try:
+                        entry = DICTIONARY_SERVICE.entry(key, version)
+                    except KeyError:
+                        return self._send(
+                            404,
+                            {
+                                "error": {
+                                    "message": "no such dictionary version"
+                                }
+                            },
+                        )
+                    from trino_tpu.columnar.dictionary import (
+                        UnorderedDictionary,
+                    )
+
+                    return self._send(
+                        200,
+                        {
+                            "key": list(key),
+                            "version": entry.version,
+                            "values": list(entry.dictionary.values),
+                            "ordered": not isinstance(
+                                entry.dictionary, UnorderedDictionary
+                            ),
+                            "unique": entry.unique,
+                        },
+                    )
                 parts = self.path.strip("/").split("/")
                 # /v1/query/{id}/profile — the archived profile artifact
                 # (telemetry/profile_store): accepts the coordinator's

@@ -175,6 +175,18 @@ class WorkerServer:
         #: coordinator to announce to at start (auto-rejoin); falls back to
         #: the `worker.coordinator-url` config knob
         self._coordinator_url = coordinator_url
+        # global dictionary refs shipped in exchange pages resolve against
+        # this worker's own catalogs first (generated catalogs re-derive
+        # deterministically); anything else is pulled from the coordinator
+        from trino_tpu.runtime.dictionary_service import (
+            DICTIONARY_SERVICE,
+            coordinator_fetch_hook,
+        )
+
+        DICTIONARY_SERVICE.attach_catalogs(self.catalogs)
+        coord = coordinator_url or get_config().worker.coordinator_url
+        if coord:
+            DICTIONARY_SERVICE.fetch_hook = coordinator_fetch_hook(coord)
         #: set once a register announce succeeded (test/ops evidence)
         self.registered = threading.Event()
         self._secret = cluster_secret()

@@ -305,6 +305,26 @@ def _covers(unique_sets_of_node, cols: frozenset) -> bool:
     return any(u <= cols for u in unique_sets_of_node)
 
 
+def _dictionary_unique_scan(handle, column: str, t, catalogs, rows) -> bool:
+    """A `unique` global dictionary entry whose size equals the table's
+    exact row count is a NULL-FREE BIJECTION (code space == row space):
+    a STRUCTURAL exact-distinct witness, which is how capacity
+    certificates reach varchar dimension keys (the business keys the
+    benchmark generators mint densely, e.g. TPC-DS `*_id`)."""
+    from trino_tpu import types as T
+
+    if not T.is_string_kind(t):
+        return False
+    from trino_tpu.runtime.dictionary_service import DICTIONARY_SERVICE
+
+    ent = DICTIONARY_SERVICE.lookup(handle, column, catalogs)
+    return (
+        ent is not None
+        and ent.unique
+        and len(ent.dictionary.values) == int(rows)
+    )
+
+
 def unique_sets(node, catalogs=None, _ctx=None) -> frozenset:
     """Minimal symbol-name sets proven NON-NULL-UNIQUE on the node's
     output: every non-NULL value combination of the set occurs in at most
@@ -340,6 +360,11 @@ def unique_sets(node, catalogs=None, _ctx=None) -> frozenset:
                     # connector marks STRUCTURALLY exact (dense surrogate
                     # keys) are admissible fanout witnesses.
                     and getattr(cs, "exact_distinct", False)
+                ):
+                    out.add(frozenset({sym.name}))
+                    continue
+                if _dictionary_unique_scan(
+                    node.handle, col, sym.type, catalogs, rows
                 ):
                     out.add(frozenset({sym.name}))
     elif isinstance(node, P.ValuesNode):
@@ -971,10 +996,49 @@ def verify_benchmarks(verbose: bool = False) -> dict:
     from trino_tpu.planner import plan as P
     from trino_tpu.runtime.runner import LocalQueryRunner
 
+    def _varchar_keyed(n) -> bool:
+        from trino_tpu import types as T
+
+        return any(
+            T.is_string_kind(l.type) or T.is_string_kind(r.type)
+            for l, r in n.criteria
+        )
+
     totals = {
         "queries": 0, "joins": 0, "licensed": 0, "agg_licensed": 0,
-        "violations": 0,
+        "varchar_licensed": 0, "violations": 0,
     }
+
+    def _sweep(r, catalog: str, q: str, sql: str) -> None:
+        plan = r.create_plan(sql)
+        totals["queries"] += 1
+        joins = [n for n in _walk(plan) if isinstance(n, P.JoinNode)]
+        licensed = [
+            n for n in joins
+            if getattr(n, "capacity_cert", None) is not None
+        ]
+        totals["joins"] += len(joins)
+        totals["licensed"] += len(licensed)
+        totals["varchar_licensed"] += sum(
+            1 for n in licensed if _varchar_keyed(n)
+        )
+        totals["agg_licensed"] += sum(
+            1
+            for n in _walk(plan)
+            if isinstance(n, P.AggregationNode)
+            and getattr(n, "capacity_cert", None) is not None
+        )
+        violations = check_capacity_certificates(plan, r.catalogs)
+        totals["violations"] += len(violations)
+        if violations:
+            raise violations[0]
+        if verbose:
+            for n in licensed:
+                print(
+                    f"{catalog} {q}: licensed join on {n.capacity_cert.key} "
+                    f"({', '.join(n.capacity_cert.provenance)})"
+                )
+
     suites = (
         ("tpch", "tiny", "trino_tpu.connectors.tpch.queries"),
         ("tpcds", "tiny", "trino_tpu.connectors.tpcds.queries"),
@@ -985,33 +1049,22 @@ def verify_benchmarks(verbose: bool = False) -> dict:
         queries = importlib.import_module(mod).QUERIES
         r = LocalQueryRunner(catalog=catalog, schema=schema)
         for q in sorted(queries):
-            plan = r.create_plan(queries[q])
-            totals["queries"] += 1
-            joins = [
-                n for n in _walk(plan) if isinstance(n, P.JoinNode)
-            ]
-            licensed = [
-                n for n in joins
-                if getattr(n, "capacity_cert", None) is not None
-            ]
-            totals["joins"] += len(joins)
-            totals["licensed"] += len(licensed)
-            totals["agg_licensed"] += sum(
-                1
-                for n in _walk(plan)
-                if isinstance(n, P.AggregationNode)
-                and getattr(n, "capacity_cert", None) is not None
-            )
-            violations = check_capacity_certificates(plan, r.catalogs)
-            totals["violations"] += len(violations)
-            if violations:
-                raise violations[0]
-            if verbose:
-                for n in licensed:
-                    print(
-                        f"{catalog} {q}: licensed join on {n.capacity_cert.key} "
-                        f"({', '.join(n.capacity_cert.provenance)})"
-                    )
+            _sweep(r, catalog, q, queries[q])
+    # varchar-key probes: dictionary-backed `unique` business keys
+    # (null-free bijections) must license joins the same way dense
+    # integer surrogates do — the global dictionary service's capacity
+    # reach, asserted by `python -m trino_tpu.verify.capacity`
+    probes = (
+        ("tpcds", "tiny", "varchar:c_customer_id",
+         "SELECT count(*) FROM customer c1 JOIN customer c2 "
+         "ON c1.c_customer_id = c2.c_customer_id"),
+        ("tpcds", "tiny", "varchar:d_date_id",
+         "SELECT count(*) FROM date_dim d1 JOIN date_dim d2 "
+         "ON d1.d_date_id = d2.d_date_id"),
+    )
+    for catalog, schema, q, sql in probes:
+        r = LocalQueryRunner(catalog=catalog, schema=schema)
+        _sweep(r, catalog, q, sql)
     return totals
 
 
@@ -1030,9 +1083,17 @@ def main() -> int:  # pragma: no cover - CLI entry
         f"capacity: {t['queries']} plans, {t['joins']} joins — "
         f"{t['licensed']} LICENSED (runtime sizing deleted), "
         f"{t['joins'] - t['licensed']} runtime-check fallback, "
+        f"{t['varchar_licensed']} varchar-keyed licensed "
+        "(dictionary-backed uniqueness), "
         f"{t['agg_licensed']} group-count licensed aggregation(s), "
         f"{t['violations']} VIOLATION(s)"
     )
+    if not t["varchar_licensed"]:
+        print(
+            "capacity: FAIL — no varchar-keyed join licensed; the global "
+            "dictionary service's exact_distinct reach is broken"
+        )
+        return 1
     return 1 if t["violations"] else 0
 
 

@@ -34,14 +34,16 @@ def _is_repartition(node) -> bool:
     )
 
 
-def _aligned(placements, criteria, left_side: bool):
+def _aligned(placements, criteria, left_side: bool, coding=None):
     """Placement tuples of one side expressible in its join keys, with the
     opposite-side image: -> list of (own names, other names).  Only
-    dictionary-independent (integer-kind) keys count — the same restriction
-    the placer applies, so a colocated claim on string keys is flagged."""
+    dictionary-independent keys count — integer kinds, plus string pairs
+    whose two sides share one versioned GLOBAL dictionary assignment
+    (`coding`) — the same restriction the placer applies, so a colocated
+    claim on producer-local string keys is flagged."""
     from trino_tpu.partitioning import hash_aligned_criteria
 
-    usable = hash_aligned_criteria(criteria)
+    usable = hash_aligned_criteria(criteria, coding)
     if left_side:
         m = {l.name: r.name for l, r in usable}
     else:
@@ -54,16 +56,24 @@ def _aligned(placements, criteria, left_side: bool):
 
 
 def check_partitioning(root: P.PlanNode, resolver, n_workers: int) -> list:
-    from trino_tpu.partitioning import derive_partitioning
+    from trino_tpu.partitioning import (
+        derive_dictionary_coding,
+        derive_partitioning,
+    )
 
     violations: list = []
     for node in P.walk(root):
         if not isinstance(node, P.JoinNode) or not node.criteria:
             continue
+        # the verifier re-derives the SAME dictionary-version gate the
+        # placer used: a string-key claim passes only when both sides
+        # share one (key, version) global assignment
+        coding = dict(derive_dictionary_coding(node.left, resolver))
+        coding.update(derive_dictionary_coding(node.right, resolver))
         if node.distribution == "colocated":
             lprops = derive_partitioning(node.left, resolver, n_workers)
             rprops = derive_partitioning(node.right, resolver, n_workers)
-            pairs = _aligned(lprops, node.criteria, left_side=True)
+            pairs = _aligned(lprops, node.criteria, True, coding)
             if not any(other in rprops for _, other in pairs):
                 violations.append(
                     _violation(
@@ -91,7 +101,7 @@ def check_partitioning(root: P.PlanNode, resolver, n_workers: int) -> list:
                 (node.left, node.right) if r_ex else (node.right, node.left)
             )
             props = derive_partitioning(placed, resolver, n_workers)
-            pairs = _aligned(props, node.criteria, left_side=r_ex)
+            pairs = _aligned(props, node.criteria, r_ex, coding)
             ex_names = tuple(
                 s.name for s in getattr(ex_side, "partition_symbols", ())
             )
