@@ -556,6 +556,34 @@ except Exception as e:
     set_memory_pool_limit(0)  # never leave the probe's limit armed
     pressure = {"error": f"{type(e).__name__}: {e}"}
 
+# plan-decision ledger evidence (telemetry/decisions + compare_bench
+# check_decisions): one more WARM execution of each benched query, whose
+# archived artifact must carry a COMPLETE ledger — every exchange-plane
+# byte (all_to_all/all_gather) attributed to exactly one decision, zero
+# unattributed bytes, and zero `regret` verdicts on the warm set.  Runs
+# after the pressure phase with the Q3 layouts restored, so the ledgers
+# describe the same warm shapes the headline walls measured.
+try:
+    dist.execute(
+        "set session table_layouts = "
+        "'tpch.%s.lineitem:l_orderkey:8,tpch.%s.orders:o_orderkey:8'"
+        % (schema, schema)
+    )
+
+    def _warm_ledger(q):
+        dist.execute(QUERIES[q])
+        ref = _profile_store.refs()[-1]
+        art = _profile_store.get(ref["query_id"]) or {}
+        return {
+            "query_id": ref["query_id"],
+            "ledger": art.get("decisions"),
+            "collective_bytes_by": art.get("collective_bytes_by") or {},
+        }
+
+    decisions_evidence = {"q6": _warm_ledger(6), "q3": _warm_ledger(3)}
+except Exception as e:
+    decisions_evidence = {"error": f"{type(e).__name__}: {e}"}
+
 # archived profile-artifact refs for this bench's executions: the
 # comparable record tools/profile_diff.py consumes next run.  A failed
 # flush is recorded — refs to files that never landed must not read as a
@@ -641,6 +669,9 @@ print(json.dumps({
     "dictionary": dictionary,
     # memory-pressure degradation proof (budget -> revoke -> wave -> kill)
     "pressure": pressure,
+    # plan-decision ledger completeness + zero-regret evidence
+    # (tools/compare_bench.py check_decisions gates this)
+    "decisions": decisions_evidence,
     # telemetry-on overhead (acceptance: on/off ratio < 1.05 warm)
     "q6_mesh8_warm_trace_off_s": round(q6_warm_trace_off, 4),
     "q6_mesh8_warm_trace_on_s": round(q6_warm_trace_on, 4),

@@ -303,3 +303,102 @@ class TestPrewarmManifest:
         doc.pop("dictionaries", None)
         back = WorkloadManifest.from_json(doc)
         assert back.dictionaries is None
+
+
+class TestInsertAppend:
+    """Satellite: memory-connector INSERT extends stored dictionaries
+    append-only through DICTIONARY_SERVICE.extend — a page of
+    already-known values bumps NOTHING (the coding ref, and with it any
+    version-gated placement claim, stays valid), and new values take the
+    next free codes under a remap=False bump."""
+
+    def _runner(self):
+        from trino_tpu.connectors.api import CatalogManager
+        from trino_tpu.connectors.memory import MemoryConnector
+        from trino_tpu.runtime.runner import LocalQueryRunner
+
+        cm = CatalogManager()
+        mem = MemoryConnector()
+        cm.register("mem", mem)
+        return LocalQueryRunner(cm, catalog="mem", schema="s"), mem
+
+    def _dict(self, mem, column="b"):
+        st = mem.store[("s", "t")]
+        for meta, cd in zip(st.meta.columns, st.columns):
+            if meta.name == column:
+                return cd.dictionary
+        raise AssertionError(f"no column {column}")
+
+    def test_known_values_append_bumps_nothing(self):
+        from trino_tpu.connectors.api import TableHandle
+
+        DICTIONARY_SERVICE.reset()
+        try:
+            r, mem = self._runner()
+            r.execute("create table t (a bigint, b varchar)")
+            r.execute("insert into t values (1,'x'),(2,'y')")
+            handle = TableHandle("mem", "s", "t")
+            key = ("mem", "s", "t", "b")
+            e1 = DICTIONARY_SERVICE.register(
+                *key, self._dict(mem)
+            )
+            ref1 = DICTIONARY_SERVICE.coding(handle, "b")
+            assert ref1 == (key, e1.version)
+            # append of already-known values: NO version bump, the stored
+            # dictionary stays the service's registered object, and the
+            # coding ref (the placement claim gate) is unchanged
+            r.execute("insert into t values (3,'x'),(4,'y')")
+            assert self._dict(mem) is e1.dictionary
+            assert DICTIONARY_SERVICE.coding(handle, "b") == ref1
+            assert DICTIONARY_SERVICE.stats()["versions"] == 1
+            assert r.execute("select b from t order by a").rows == [
+                ("x",), ("y",), ("x",), ("y",)
+            ]
+        finally:
+            DICTIONARY_SERVICE.reset()
+
+    def test_new_values_extend_without_remap(self):
+        DICTIONARY_SERVICE.reset()
+        try:
+            r, mem = self._runner()
+            r.execute("create table t (a bigint, b varchar)")
+            r.execute("insert into t values (1,'x'),(2,'y')")
+            key = ("mem", "s", "t", "b")
+            e1 = DICTIONARY_SERVICE.register(*key, self._dict(mem))
+            old_values = tuple(e1.dictionary.values)
+            r.execute("insert into t values (3,'zz'),(4,'x')")
+            d2 = self._dict(mem)
+            # old codes keep their meaning: old values stay a prefix, the
+            # bump is remap=False, and the prior version still resolves
+            assert tuple(d2.values)[: len(old_values)] == old_values
+            ref2 = DICTIONARY_SERVICE.ref_of(d2)
+            assert ref2 == (key, e1.version + 1)
+            e2 = DICTIONARY_SERVICE.entry(key, e1.version + 1)
+            assert not e2.remap and d2 is e2.dictionary
+            assert tuple(
+                DICTIONARY_SERVICE.resolve(key, e1.version).values
+            ) == old_values
+            assert r.execute("select b from t order by a").rows == [
+                ("x",), ("y",), ("zz",), ("x",)
+            ]
+        finally:
+            DICTIONARY_SERVICE.reset()
+
+    def test_unregistered_table_append_stays_local(self):
+        # a table the service never saw: the sink's local merge is still
+        # append-only, and nothing registers as a side effect
+        DICTIONARY_SERVICE.reset()
+        try:
+            r, mem = self._runner()
+            r.execute("create table t (a bigint, b varchar)")
+            r.execute("insert into t values (1,'x'),(2,'y')")
+            d1 = self._dict(mem)
+            r.execute("insert into t values (3,'x'),(4,'w')")
+            d2 = self._dict(mem)
+            assert tuple(d2.values)[: len(d1)] == tuple(d1.values)
+            assert DICTIONARY_SERVICE.stats()["keys"] == 0
+            assert r.execute("select b from t order by a").rows == [
+                ("x",), ("y",), ("x",), ("w",)
+            ]
+        finally:
+            DICTIONARY_SERVICE.reset()

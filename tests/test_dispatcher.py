@@ -798,3 +798,41 @@ def test_serve_chaos_fast():
         assert outcomes.count("ok") >= 1  # progress under churn
     finally:
         srv.shutdown()
+
+
+def test_concurrent_lanes_isolate_decision_ledgers():
+    """Dispatcher lanes serve statements concurrently: every archived
+    profile carries ITS OWN statement's finalized decision ledger (the
+    lifecycle-contextvar resolution — never a shared runner attribute a
+    neighboring lane could overwrite)."""
+    from trino_tpu.runtime.runner import LocalQueryRunner
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.telemetry.profile_store import (
+        ProfileStore,
+        attach_profile_store,
+    )
+
+    r = LocalQueryRunner()
+    store = ProfileStore()
+    attach_profile_store(r, store)
+    srv = CoordinatorServer(runner=r, port=0)
+    srv.start()
+    try:
+        assert srv.dispatcher.lanes >= 2
+        qs = [srv.submit(f"select {i} + {i}") for i in range(6)]
+        for i, q in enumerate(qs):
+            assert q.done.wait(timeout=30)
+            assert q.state == "FINISHED", q.error
+        arts = [store.get(ref["key"]) for ref in store.refs()]
+        assert len(arts) == 6
+        for a in arts:
+            led = a["decisions"]
+            assert led is not None and led["finalized"] is True
+            assert led["query_id"] == a["query_id"]
+            assert led["unattributed_bytes_by"] == {}
+        # six statements, six distinct ledgers — ids never collide even
+        # when lanes interleave
+        qids = [a["decisions"]["query_id"] for a in arts]
+        assert len(qids) == len(set(qids))
+    finally:
+        srv.shutdown()

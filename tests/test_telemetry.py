@@ -432,8 +432,37 @@ def _clean_extra():
                 },
                 "pressure": _clean_pressure(),
                 "dictionary": _clean_dictionary(),
+                "decisions": _clean_decisions(),
             }
         },
+    }
+
+
+def _clean_decisions():
+    def d(did, kind, choice, xbytes=0):
+        return {
+            "decision_id": did, "kind": kind, "site": "join@f1",
+            "choice": choice, "alternative": "other", "inputs": {},
+            "audit_seq": 0, "measured": {"fragment_wall_s": 0.01},
+            "bytes_by": {"all_to_all/repartition": xbytes} if xbytes else {},
+            "exchange_bytes": xbytes, "fragments": [1],
+            "hindsight": "vindicated", "hindsight_detail": "",
+        }
+
+    return {
+        "q3": {
+            "query_id": "query_3",
+            "ledger": {
+                "query_id": "query_3",
+                "decisions": [
+                    d("d000", "join_distribution", "partitioned", xbytes=4096),
+                    d("d001", "join_capacity", "licensed"),
+                ],
+                "unattributed_bytes_by": {},
+                "finalized": True,
+            },
+            "collective_bytes_by": {"all_to_all/repartition": 4096},
+        }
     }
 
 
@@ -833,3 +862,119 @@ def test_compile_close_rechecks_deadline(dist, monkeypatch):
         assert i + 1 < len(log) and log[i + 1][0] == "check", (
             "a compile-event close must be followed by a deadline check"
         )
+
+
+# -- plan-decision metrics: coordinator/worker parity + lane isolation --------
+
+
+def _metric_names(text: str) -> set:
+    return {
+        line.split("{", 1)[0].split(" ", 1)[0]
+        for line in text.splitlines()
+        if line and not line.startswith("#")
+    }
+
+
+def test_worker_metrics_expose_decision_counters():
+    """Satellite: a worker's GET /v1/metrics exposes the SAME decision
+    counters as the coordinator — fleet dashboards aggregate one name
+    set, whichever node they scrape."""
+    import urllib.request
+
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.server.worker import WorkerServer
+
+    srv = CoordinatorServer(port=0)
+    srv.start()
+    w = WorkerServer(port=0).start()
+    try:
+        texts = {}
+        for name, port in (("coord", srv.port), ("worker", w.port)):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/metrics", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                texts[name] = resp.read().decode()
+        names = {k: _metric_names(t) for k, t in texts.items()}
+        assert names["coord"] == names["worker"]
+        assert "trino_tpu_plan_decisions_total" in names["worker"]
+        # the pre-registered label grid is visible on BOTH surfaces
+        for text in texts.values():
+            assert (
+                'trino_tpu_plan_decisions_total{kind="join_distribution",'
+                'outcome="broadcast",hindsight="regret"}'
+            ) in text
+            assert (
+                'trino_tpu_plan_decisions_total{kind="join_capacity",'
+                'outcome="licensed",hindsight="vindicated"}'
+            ) in text
+    finally:
+        w.shutdown()
+        srv.shutdown()
+
+
+def test_concurrent_statements_isolate_spans_and_ledgers(dist):
+    """Concurrent statements on one engine: every span and every decision
+    lands in ITS OWN statement's trace/ledger (the lifecycle-contextvar
+    lane-safety contract), and each ledger stays complete."""
+    import threading
+
+    from trino_tpu.telemetry.profile_store import (
+        ProfileStore,
+        attach_profile_store,
+    )
+
+    store = ProfileStore()
+    attach_profile_store(dist, store)
+    try:
+        sqls = [
+            "select count(*) from customer join orders on c_custkey = o_custkey",
+            "select count(*) from nation",
+            "select c_mktsegment, count(*) from customer join orders "
+            "on c_custkey = o_custkey group by c_mktsegment",
+            "select count(*) from region",
+        ]
+        errors = []
+
+        def run(sql):
+            try:
+                dist.execute(sql)
+            except Exception as e:  # pragma: no cover - diagnostic
+                errors.append((sql, e))
+
+        threads = [
+            threading.Thread(target=run, args=(s,), daemon=True)
+            for s in sqls
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+        assert not errors, errors
+        arts = [store.get(ref["key"]) for ref in store.refs()[-4:]]
+        by_sql = {a["sql"]: a for a in arts}
+        assert len(by_sql) == 4
+        for a in arts:
+            led = a["decisions"]
+            # the ledger is the STATEMENT's own: its id matches, finalized,
+            # and no exchange byte leaked into (or out of) another lane
+            assert led["query_id"] == a["query_id"]
+            assert led["finalized"] is True
+            assert led["unattributed_bytes_by"] == {}
+            kinds = {d["kind"] for d in led["decisions"]}
+            if "join" in a["sql"]:
+                assert "join_distribution" in kinds
+            else:
+                assert "join_distribution" not in kinds
+        # span isolation: every span in a statement's trace carries that
+        # statement's query id (flat_spans stamps the owning tracer's)
+        traced = {qid: spans for qid, spans in dist.traces}
+        for a in arts:
+            spans = traced.get(a["query_id"])
+            if not spans:
+                continue
+            assert {sp["query_id"] for sp in spans} == {a["query_id"]}
+            assert sum(1 for sp in spans if sp["name"] == "query") == 1
+    finally:
+        dist.profile_store = None

@@ -201,3 +201,78 @@ def test_lint_flags_raw_perf_counter(tmp_path):
         f for f in lint_tpu.lint_file(str(ok))
         if f.rule == "raw-perf-counter"
     ] == []
+
+
+# -- lint: telemetry discipline (stray registries, ledger bypasses) -----------
+
+
+def _lint_tpu():
+    import os
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo_root, "tools"))
+    try:
+        import lint_tpu
+    finally:
+        sys.path.pop(0)
+    return lint_tpu
+
+
+def test_lint_flags_stray_registry_and_ledger_bypass(tmp_path):
+    lint_tpu = _lint_tpu()
+    pkg = tmp_path / "trino_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "from trino_tpu.telemetry.metrics import MetricsRegistry\n"
+        "reg = MetricsRegistry()\n"
+        "def smuggle(artifact, led):\n"
+        "    artifact['decisions'] = led\n"
+    )
+    (pkg / "ok.py").write_text(
+        "from trino_tpu.telemetry.metrics import REGISTRY\n"
+        "c = REGISTRY.counter('x_total')\n"
+        "def fine(artifact, led):\n"
+        "    artifact['other'] = led\n"
+        "def boundary():  # lint: allow(stray-metrics-registry)\n"
+        "    from trino_tpu.telemetry.metrics import MetricsRegistry\n"
+        "    return MetricsRegistry()\n"
+    )
+    findings, stale = lint_tpu.run_telemetry_discipline(
+        str(tmp_path), baseline={}
+    )
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["ledger-bypass", "stray-metrics-registry"]
+    assert all("bad.py" in f.file for f in findings)
+    assert stale == []
+
+
+def test_lint_telemetry_baseline_and_stale_detection(tmp_path):
+    lint_tpu = _lint_tpu()
+    pkg = tmp_path / "trino_tpu"
+    pkg.mkdir()
+    (pkg / "legacy.py").write_text(
+        "from trino_tpu.telemetry.metrics import MetricsRegistry\n"
+        "reg = MetricsRegistry()\n"
+    )
+    baseline = {
+        "trino_tpu/legacy.py:stray-metrics-registry": "pre-ledger survivor",
+        "trino_tpu/gone.py:ledger-bypass": "file was deleted",
+    }
+    findings, stale = lint_tpu.run_telemetry_discipline(
+        str(tmp_path), baseline=baseline
+    )
+    assert findings == []  # triaged: baselined findings never fail
+    assert stale == ["trino_tpu/gone.py:ledger-bypass"]  # honest baseline
+
+
+def test_lint_telemetry_repo_is_triaged():
+    """The shipped tree passes the telemetry-discipline pass with the
+    shipped baseline, and the baseline holds no stale keys."""
+    import os
+
+    lint_tpu = _lint_tpu()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings, stale = lint_tpu.run_telemetry_discipline(repo_root)
+    assert [f"{f.file}:{f.rule}" for f in findings] == []
+    assert stale == []

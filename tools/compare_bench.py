@@ -31,7 +31,12 @@ alone may hide (a retrace can cost little on tiny data and 30x on SF10):
   * `dictionary.*` (PR 18, check_dictionary): the varchar-keyed join under
     a global-dictionary layout co-located (`exchange_elided > 0`, ZERO
     repartition collectives), its unique business key licensed the
-    capacity, and rows matched the local oracle.
+    capacity, and rows matched the local oracle;
+  * `decisions.*` (check_decisions): every benched statement archives a
+    COMPLETE plan-decision ledger — each all_to_all/all_gather byte maps
+    to exactly one recorded decision, the unattributed bucket is empty —
+    and the warm benched set carries zero `regret` hindsight verdicts
+    (telemetry/decisions).
 
 Modes:
   python tools/compare_bench.py                 # gate the checked-in file
@@ -203,6 +208,95 @@ def check_dictionary(schema: str, sec: dict) -> list:
             f"mesh.{schema}.dictionary.matches_local = False (the "
             "co-located varchar join changed rows vs the local oracle)"
         )
+    return violations
+
+
+#: exchange-plane collective kinds every benched byte must attribute to a
+#: decision (telemetry/decisions EXCHANGE_KINDS; gathers are host pulls,
+#: reduces are dynamic-filter summaries — neither is a placement choice)
+DECISION_EXCHANGE_KINDS = ("all_to_all", "all_gather")
+
+
+def check_decisions(schema: str, sec: dict) -> list:
+    """Violations over one mesh section's plan-decision ledger evidence
+    (`decisions`, recorded by bench.py from one extra warm run of each
+    benched query): the ledger must be COMPLETE — every exchange-plane
+    byte (all_to_all + all_gather) the profile recorded attributes to
+    exactly one decision, the unattributed bucket is empty, at least one
+    join-distribution choice and one capacity-economy verdict were
+    recorded — and the warm benched set carries ZERO `regret` verdicts (a
+    warm regret means the planner keeps re-making a choice the runtime
+    has already measured as wrong)."""
+    violations = []
+    for qname, ev in sorted(sec.items()):
+        if not isinstance(ev, dict):
+            continue
+        led = ev.get("ledger")
+        if not isinstance(led, dict) or not led.get("decisions"):
+            violations.append(
+                f"mesh.{schema}.decisions.{qname}: no ledger recorded "
+                "(expected every benched statement to archive a "
+                "plan-decision ledger)"
+            )
+            continue
+        if not led.get("finalized"):
+            violations.append(
+                f"mesh.{schema}.decisions.{qname}: ledger not finalized "
+                "(hindsight verdicts never stamped)"
+            )
+        unatt = led.get("unattributed_bytes_by") or {}
+        if unatt:
+            violations.append(
+                f"mesh.{schema}.decisions.{qname}: unattributed exchange "
+                f"bytes {unatt} (every all_to_all/all_gather byte must "
+                "map to exactly one decision)"
+            )
+        # completeness: per exchange kind, decision-attributed bytes ==
+        # the profile's collective totals for that kind
+        by_kind: dict = {k: 0 for k in DECISION_EXCHANGE_KINDS}
+        kinds_seen = set()
+        regrets = []
+        for d in led["decisions"]:
+            kinds_seen.add(d.get("kind"))
+            if d.get("hindsight") == "regret":
+                regrets.append(
+                    f"{d.get('decision_id')} {d.get('kind')}/"
+                    f"{d.get('choice')} at {d.get('site')}: "
+                    f"{d.get('hindsight_detail')}"
+                )
+            for key, b in (d.get("bytes_by") or {}).items():
+                kind = key.split("/", 1)[0]
+                if kind in by_kind:
+                    by_kind[kind] += int(b)
+        profile_by = ev.get("collective_bytes_by") or {}
+        for kind in DECISION_EXCHANGE_KINDS:
+            total = sum(
+                int(b) for key, b in profile_by.items()
+                if key.split("/", 1)[0] == kind
+            )
+            if total != by_kind[kind]:
+                violations.append(
+                    f"mesh.{schema}.decisions.{qname}: {kind} bytes "
+                    f"attributed to decisions = {by_kind[kind]} but the "
+                    f"profile moved {total} (incomplete ledger: a "
+                    "placement executed without recording its decision)"
+                )
+        if "join_distribution" not in kinds_seen:
+            violations.append(
+                f"mesh.{schema}.decisions.{qname}: no join_distribution "
+                "decision recorded (benched queries join)"
+            )
+        if qname == "q3" and "join_capacity" not in kinds_seen:
+            violations.append(
+                f"mesh.{schema}.decisions.{qname}: no join_capacity "
+                "decision recorded (the licensed/declined/runtime_check "
+                "economy verdict must land in the ledger)"
+            )
+        for r in regrets:
+            violations.append(
+                f"mesh.{schema}.decisions.{qname}: warm regret — {r} "
+                "(zero regrets expected on the warm benched set)"
+            )
     return violations
 
 
@@ -675,6 +769,22 @@ def check_extra(extra: dict) -> tuple:
             skipped.append(
                 f"mesh.{schema}: no pressure section recorded (run "
                 "tools/pressure_bench.py)"
+            )
+        # plan-decision ledger completeness + zero-regret (this PR):
+        # recorded by bench.py's decisions phase
+        dec = sec.get("decisions")
+        if isinstance(dec, dict):
+            if dec.get("error"):
+                skipped.append(
+                    f"mesh.{schema}.decisions: bench errored: "
+                    f"{dec['error']}"
+                )
+            else:
+                violations.extend(check_decisions(schema, dec))
+        else:
+            skipped.append(
+                f"mesh.{schema}: no decisions section recorded (run "
+                "bench.py --mesh)"
             )
         # the registry snapshot bench.py records into the section is the
         # fresh-run diff surface: apply the process-lifetime expectations

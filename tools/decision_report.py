@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Which decision cost this wall?  Render a query's plan-decision ledger
+(telemetry/decisions) next to its measured outcomes, most expensive
+choice first.
+
+The ledger records every consequential planner/runtime choice with the
+inputs it saw and the alternative it rejected; post-execution the runner
+joins each decision with the collective bytes it moved and the wall of
+the fragments it touched, then stamps a hindsight verdict.  This tool is
+the human surface over that join: given an archived profile artifact it
+prints one line per decision sorted by attributed fragment wall (byte
+volume as the tiebreak), flags regrets, and totals the attribution so a
+wall regression can be bisected to the CHOICE that caused it rather than
+the fragment that exhibited it.
+
+Usage:
+  python tools/decision_report.py ARTIFACT.json         # archived artifact
+  python tools/decision_report.py --query-id query_3 --archive-dir DIR
+  python tools/decision_report.py ARTIFACT.json --json  # machine output
+  python tools/decision_report.py ARTIFACT.json --regrets-only
+
+Exit status: 0 when the ledger holds zero regrets, 2 when any decision
+was stamped `regret` (scriptable: the same verdict check_decisions gates
+in CI), 1 on usage/read errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load_artifact(args) -> dict:
+    if args.artifact:
+        with open(args.artifact, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    # --query-id lookup over an archive directory of artifact JSON files
+    best = None
+    for name in sorted(os.listdir(args.archive_dir)):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(args.archive_dir, name)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                art = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if art.get("query_id") == args.query_id or art.get("key") == args.query_id:
+            best = art  # later files win: the most recent incarnation
+    if best is None:
+        raise FileNotFoundError(
+            f"no artifact for {args.query_id} under {args.archive_dir}"
+        )
+    return best
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def report(artifact: dict) -> dict:
+    """The sorted attribution: {query_id, wall_s, rows: [...], regrets,
+    unattributed_bytes_by} — rows carry (decision_id, kind, site, choice,
+    alternative, hindsight, hindsight_detail, exchange_bytes,
+    fragment_wall_s, inputs, measured)."""
+    led = artifact.get("decisions") or {}
+    rows = []
+    for d in led.get("decisions", ()):
+        rows.append(
+            {
+                "decision_id": d["decision_id"],
+                "kind": d["kind"],
+                "site": d["site"],
+                "choice": d["choice"],
+                "alternative": d["alternative"],
+                "hindsight": d["hindsight"],
+                "hindsight_detail": d["hindsight_detail"],
+                "exchange_bytes": int(d.get("exchange_bytes", 0)),
+                "bytes_by": d.get("bytes_by") or {},
+                "fragment_wall_s": float(
+                    (d.get("measured") or {}).get("fragment_wall_s", 0.0)
+                ),
+                "fragments": d.get("fragments", []),
+                "inputs": d.get("inputs") or {},
+                "measured": d.get("measured") or {},
+            }
+        )
+    rows.sort(
+        key=lambda r: (r["fragment_wall_s"], r["exchange_bytes"]),
+        reverse=True,
+    )
+    return {
+        "query_id": artifact.get("query_id"),
+        "sql": artifact.get("sql"),
+        "wall_s": artifact.get("wall_s"),
+        "rows": rows,
+        "regrets": [r for r in rows if r["hindsight"] == "regret"],
+        "unattributed_bytes_by": led.get("unattributed_bytes_by") or {},
+        "finalized": bool(led.get("finalized")),
+    }
+
+
+def render(rep: dict, regrets_only: bool = False) -> str:
+    lines = [
+        f"decision report: {rep['query_id']} "
+        f"(wall {rep['wall_s']:.3f}s)" if isinstance(rep.get("wall_s"), (int, float))
+        else f"decision report: {rep['query_id']}",
+    ]
+    rows = rep["regrets"] if regrets_only else rep["rows"]
+    if not rows:
+        lines.append(
+            "  (no regrets)" if regrets_only else "  (empty ledger)"
+        )
+    for r in rows:
+        mark = "!!" if r["hindsight"] == "regret" else "  "
+        alt = f" over {r['alternative']}" if r["alternative"] else ""
+        lines.append(
+            f"{mark} {r['decision_id']} {r['fragment_wall_s']:8.3f}s "
+            f"{_fmt_bytes(r['exchange_bytes']):>10} "
+            f"{r['kind']}={r['choice']}{alt}  [{r['site']}] "
+            f"{r['hindsight']}"
+        )
+        if r["hindsight_detail"]:
+            lines.append(f"       {r['hindsight_detail']}")
+    if rep["unattributed_bytes_by"]:
+        lines.append(
+            f"   UNATTRIBUTED exchange bytes: {rep['unattributed_bytes_by']}"
+            " (a placement executed without recording its decision)"
+        )
+    if not rep["finalized"]:
+        lines.append("   ledger never finalized (query still running?)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="rank a query's plan decisions by measured cost"
+    )
+    ap.add_argument("artifact", nargs="?", help="archived artifact JSON")
+    ap.add_argument("--query-id", help="query id to look up in --archive-dir")
+    ap.add_argument(
+        "--archive-dir", help="profile archive directory (profile.archive-dir)"
+    )
+    ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument(
+        "--regrets-only", action="store_true",
+        help="print only decisions stamped `regret`",
+    )
+    args = ap.parse_args(argv)
+    if not args.artifact and not (args.query_id and args.archive_dir):
+        ap.error("give an ARTIFACT path, or --query-id with --archive-dir")
+    try:
+        artifact = _load_artifact(args)
+    except (OSError, ValueError) as e:
+        print(f"decision_report: {e}", file=sys.stderr)
+        return 1
+    rep = report(artifact)
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        print(render(rep, regrets_only=args.regrets_only))
+    return 2 if rep["regrets"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

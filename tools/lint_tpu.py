@@ -41,6 +41,20 @@ A second pass — the concurrency analyzer (trino_tpu/verify/concurrency.py)
                     | acquisition-order graph has a cycle (the static half;
                     | verify.lockgraph is the dynamic half)
 
+A third pass — telemetry discipline — also runs over ALL of trino_tpu/:
+
+  stray-metrics-registry | `MetricsRegistry()` constructed outside
+                         | telemetry/metrics.py — counters in a private
+                         | registry never reach /v1/metrics or the
+                         | system.metrics tables
+  ledger-bypass          | assignment to a `["decisions"]` key outside
+                         | telemetry/decisions.py + profile_store.py —
+                         | decisions emitted past the ledger API skip
+                         | hindsight stamping, the plan_decisions counter,
+                         | and the check_decisions completeness gate
+                         | (survivors triage through the
+                         | `telemetry_discipline` baseline map)
+
 Rules are path-scoped: device rules run over ops/parallel/expr;
 raw-http-timeout runs over trino_tpu/server/ and parallel/remote.py (and
 only that rule runs over server/ — host transfers are legal there).
@@ -102,6 +116,16 @@ RULES = {
     "unguarded-state": "lock-guarded attribute accessed outside any lock",
     "thread-discipline": "threading.Thread without name= / explicit daemon=",
     "lock-order-cycle": "inconsistent nested lock acquisition order",
+    # telemetry-discipline pass (repo-wide over trino_tpu/)
+    "stray-metrics-registry": "MetricsRegistry constructed outside "
+                              "telemetry/metrics.py — counters registered "
+                              "in a private registry never reach the "
+                              "/v1/metrics expositions or the system "
+                              "tables",
+    "ledger-bypass": "direct write to a `decisions` artifact key outside "
+                     "the ledger API (telemetry/decisions) — decisions "
+                     "emitted past the ledger skip hindsight, metrics, "
+                     "and the completeness gate",
 }
 
 #: paths the concurrency pass walks (everything; locks live in runtime/,
@@ -617,6 +641,141 @@ def suppression_budget(root: str = ".") -> int:
         return int(json.load(fh)["allow_budget"])
 
 
+#: paths the telemetry-discipline pass walks (the whole package: a stray
+#: registry or a ledger bypass is a hazard wherever it lives)
+TELEMETRY_PATHS = ("trino_tpu",)
+
+#: files where the flagged constructs ARE the implementation
+_TELEMETRY_EXEMPT = (
+    "trino_tpu/telemetry/metrics.py",
+    "trino_tpu/telemetry/decisions.py",
+    "trino_tpu/telemetry/profile_store.py",
+)
+
+
+class _TelemetryLinter(ast.NodeVisitor):
+    """Telemetry-discipline pass: every counter must land in THE process
+    registry (`telemetry.metrics.REGISTRY` — a private `MetricsRegistry()`
+    never reaches /v1/metrics or system.metrics), and every plan-decision
+    emission must go through the ledger API (`telemetry/decisions` —
+    writing an artifact's `decisions` key by hand skips hindsight
+    stamping, the plan_decisions counter, and the check_decisions
+    completeness gate).  Survivors triage through the
+    `telemetry_discipline` baseline map in tools/lint_baseline.json."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.findings: list[Finding] = []
+        self.allow = _allowances(source)
+        #: (def/class line, end line) stack: allowances on an enclosing
+        #: definition line cover the whole body (same contract as the
+        #: device pass)
+        self._scopes: list[tuple[int, int]] = []
+
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        for at in (
+            node.lineno,
+            *[s for s, e in self._scopes if s <= node.lineno <= e],
+        ):
+            rules = self.allow.get(at)
+            if rules and (rule in rules or "*" in rules):
+                return
+        self.findings.append(
+            Finding(
+                self.relpath, node.lineno, rule, message,
+                baseline_key=f"{self.relpath}:{rule}",
+            )
+        )
+
+    def _visit_scope(self, node) -> None:
+        self._scopes.append((node.lineno, node.end_lineno or node.lineno))
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+    visit_ClassDef = _visit_scope
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None
+        )
+        if name == "MetricsRegistry":
+            self._flag(
+                "stray-metrics-registry", node,
+                "MetricsRegistry() constructed outside telemetry/metrics.py"
+                " — register counters in the shared REGISTRY so both "
+                "exposition endpoints and system.metrics see them",
+            )
+        self.generic_visit(node)
+
+    def _check_decisions_write(self, target: ast.AST) -> None:
+        if (
+            isinstance(target, ast.Subscript)
+            and isinstance(target.slice, ast.Constant)
+            and target.slice.value == "decisions"
+        ):
+            self._flag(
+                "ledger-bypass", target,
+                "direct `[\"decisions\"]` write — emit through "
+                "telemetry.decisions (record_decision/DecisionLedger) so "
+                "the choice gets hindsight, metrics, and gate coverage",
+            )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_decisions_write(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_decisions_write(node.target)
+        self.generic_visit(node)
+
+
+def telemetry_discipline_baseline(root: str = ".") -> dict:
+    """{relpath:rule -> justification} from tools/lint_baseline.json
+    `telemetry_discipline`."""
+    import json
+
+    path = os.path.join(root, "tools", "lint_baseline.json")
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return dict(json.load(fh).get("telemetry_discipline") or {})
+    except (OSError, ValueError):
+        return {}
+
+
+def run_telemetry_discipline(root: str = ".", baseline=None):
+    """The telemetry-discipline pass over trino_tpu/ (stray registries +
+    ledger bypasses), triaged through the `telemetry_discipline` baseline.
+    Returns (failing findings, stale baseline keys)."""
+    if baseline is None:
+        baseline = telemetry_discipline_baseline(root)
+    findings = []
+    for f in _lint_files(TELEMETRY_PATHS, root):
+        rel = os.path.relpath(f, root).replace(os.sep, "/")
+        if rel in _TELEMETRY_EXEMPT:
+            continue
+        with open(f, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            tree = ast.parse(source, filename=f)
+        except SyntaxError:
+            continue  # the device pass reports syntax errors
+        linter = _TelemetryLinter(rel, source)
+        linter.visit(tree)
+        findings.extend(linter.findings)
+    kept, used = [], set()
+    for f in findings:
+        if f.baseline_key in baseline:
+            used.add(f.baseline_key)
+            continue
+        kept.append(f)
+    stale = sorted(k for k in baseline if k not in used)
+    return kept, stale
+
+
 def check_suppression_budget(paths=None, root: str = ".") -> list:
     """-> [error message] when the allow() count exceeds the baseline."""
     try:
@@ -689,7 +848,8 @@ def main(argv=None) -> int:
         help="repo root (default: parent of this script's directory)",
     )
     ap.add_argument(
-        "--only", choices=("device", "concurrency"), default=None,
+        "--only", choices=("device", "concurrency", "telemetry"),
+        default=None,
         help="run a single pass (default: all)",
     )
     ap.add_argument(
@@ -711,13 +871,17 @@ def main(argv=None) -> int:
     )
     findings = []
     numeric_stale = []
-    if args.only != "concurrency":
+    if args.only not in ("concurrency", "telemetry"):
         device, numeric_stale = _run_lint_full(args.paths or None, root=root)
         findings.extend(device)
     stale = []
-    if args.only != "device" and not args.paths:
+    if args.only in (None, "concurrency") and not args.paths:
         conc, stale = run_concurrency(root)
         findings.extend(conc)
+    tele_stale = []
+    if args.only in (None, "telemetry") and not args.paths:
+        tele, tele_stale = run_telemetry_discipline(root)
+        findings.extend(tele)
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     for f in findings:
         print(f)
@@ -735,6 +899,12 @@ def main(argv=None) -> int:
                 f"{stale_word}: numeric_safety baseline entry {k!r} has no "
                 "live finding — ratchet tools/lint_baseline.json down"
             )
+        for k in tele_stale:
+            print(
+                f"{stale_word}: telemetry_discipline baseline entry {k!r} "
+                "has no live finding — ratchet tools/lint_baseline.json "
+                "down"
+            )
     # stale-baseline detector (--check-stale, on in CI): a justified
     # suppression whose finding no longer fires has outlived the code it
     # excused — failing here forces the ratchet instead of letting dead
@@ -744,7 +914,7 @@ def main(argv=None) -> int:
     if args.check_stale and not args.paths:
         stale_errors = [
             f"stale baseline entry (no live finding): {k!r}"
-            for k in list(stale) + list(numeric_stale)
+            for k in list(stale) + list(numeric_stale) + list(tele_stale)
         ]
         if stale_errors:
             print(

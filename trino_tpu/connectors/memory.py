@@ -133,8 +133,51 @@ class _MemoryPageSource(PageSource):
 
 
 class _MemorySink:
-    def __init__(self, stored: _Stored):
+    def __init__(self, stored: _Stored, handle: TableHandle = None):
         self.stored = stored
+        self.handle = handle
+
+    def _extend_dictionary(self, column, old_d, new_d, nv):
+        """Append-only dictionary merge (ROADMAP item 4a): the stored
+        codes NEVER re-map — only the NEW page recodes against the
+        extended value list.  The global dictionary service sees the same
+        extension (`extend`: a version bump whose old codes keep their
+        meaning, remap=False; NO bump at all when the page introduces no
+        new values), so placement claims keyed on the assignment survive
+        appends that a sorted-union remap used to invalidate."""
+        from trino_tpu.columnar.dictionary import UnorderedDictionary
+
+        old_vals = tuple(old_d.values)
+        seen = set(old_vals)
+        appended = [v for v in new_d.values if v not in seen]
+        merged = (
+            old_d
+            if not appended
+            else UnorderedDictionary(old_vals + tuple(appended))
+        )
+        index = {v: i for i, v in enumerate(merged.values)}
+        rb = np.asarray(
+            [index[v] for v in new_d.values], dtype=np.int64
+        )
+        nv = rb[nv.astype(np.int64)]
+        if self.handle is not None:
+            from trino_tpu.runtime.dictionary_service import (
+                DICTIONARY_SERVICE,
+            )
+
+            key = (
+                self.handle.catalog, self.handle.schema,
+                self.handle.table, column,
+            )
+            try:
+                ent = DICTIONARY_SERVICE.extend(key, list(new_d.values))
+                if tuple(ent.dictionary.values) == tuple(merged.values):
+                    # the service's epoch IS the merge: store its object
+                    # so ref_of resolves the stored dictionary by identity
+                    merged = ent.dictionary
+            except KeyError:
+                pass  # never registered: lazy lookup adopts `merged`
+        return merged, nv
 
     def append(self, columns: Sequence[ColumnData]) -> int:
         st = self.stored
@@ -142,7 +185,7 @@ class _MemorySink:
             st.columns = list(columns)
         else:
             merged = []
-            for old, new in zip(st.columns, columns):
+            for meta, old, new in zip(st.meta.columns, st.columns, columns):
                 dictionary = old.dictionary
                 ov, nv = old.values, new.values
                 if (old.dictionary is None) != (new.dictionary is None):
@@ -151,8 +194,6 @@ class _MemorySink:
                         "column (or vice versa)"
                     )
                 if old.dictionary is not None:
-                    from trino_tpu.columnar.dictionary import union_dictionaries
-
                     if len(new.dictionary) == 0:
                         # an all-NULL page carries an empty dictionary; its
                         # code payload is masked, nothing to recode
@@ -161,11 +202,9 @@ class _MemorySink:
                         dictionary = new.dictionary
                         ov = np.zeros_like(ov)
                     else:
-                        dictionary, ra, rb = union_dictionaries(
-                            old.dictionary, new.dictionary
+                        dictionary, nv = self._extend_dictionary(
+                            meta.name, old.dictionary, new.dictionary, nv
                         )
-                        ov = ra[ov.astype(np.int64)]
-                        nv = rb[nv.astype(np.int64)]
                 valid = None
                 if old.valid is not None or new.valid is not None:
                     valid = np.concatenate(
@@ -210,11 +249,12 @@ class MemoryConnector(Connector):
 
     def global_dictionary(self, handle: TableHandle, column: str):
         """The stored dictionary IS the global assignment — every split
-        reads the same arrays.  An append that re-sorts the union is a
-        REMAP version bump at the service (codes of the old version keep
-        resolving, but plans gate claims on exact versions, so stale and
-        fresh codes never co-locate).  No `unique` claim: inserted data
-        carries no structural bijection proof."""
+        reads the same arrays.  INSERT appends extend it append-only
+        (`_MemorySink._extend_dictionary` routes through
+        DICTIONARY_SERVICE.extend): existing codes never re-map, a page
+        of already-known values bumps NOTHING, and new values take the
+        next free codes under a remap=False version bump.  No `unique`
+        claim: inserted data carries no structural bijection proof."""
         st = self.store.get((handle.schema, handle.table))
         if st is None:
             return None
@@ -236,7 +276,7 @@ class MemoryConnector(Connector):
                 handle.table,
                 [ColumnMeta(n, t) for n, t in zip(column_names, column_types)],
             )
-        return _MemorySink(self.store[key])
+        return _MemorySink(self.store[key], handle)
 
     def splits(self, handle: TableHandle, target_splits: int, predicate=None):
         st = self.store[(handle.schema, handle.table)]

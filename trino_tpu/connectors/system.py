@@ -15,6 +15,9 @@ Tables (schema `runtime`):
   query_profiles   — the persistent per-query profile archive's memory
                      ring (telemetry/profile_store; wall, gate wait,
                      compile seconds, archived artifact path)
+  plan_decisions   — per-query plan-decision ledgers of recently archived
+                     statements (telemetry/decisions; choice, rejected
+                     alternative, measured bytes, hindsight verdict)
   nodes            — mesh workers and their liveness
   session_properties — property values in effect
   caches           — buffer-pool tiers (bytes, hits, misses)
@@ -164,6 +167,27 @@ _TABLES = {
         # filesystem-SPI location of the archived artifact (NULL when the
         # store runs in-memory only)
         ("archived_path", T.VARCHAR),
+    ],
+    "plan_decisions": [
+        ("query_id", T.VARCHAR),
+        ("decision_id", T.VARCHAR),
+        ("kind", T.VARCHAR),
+        ("site", T.VARCHAR),
+        ("choice", T.VARCHAR),
+        ("alternative", T.VARCHAR),
+        # JSON: the inputs the decider saw (estimated rows, license
+        # width, economy verdict)
+        ("inputs", T.VARCHAR),
+        # audit-log watermark at decision time (telemetry/audit seq)
+        ("audit_seq", T.BIGINT),
+        # exchange-plane bytes (all_to_all + all_gather) this choice moved
+        ("exchange_bytes", T.BIGINT),
+        # JSON: {kind/purpose: bytes} full attribution
+        ("bytes_by", T.VARCHAR),
+        # summed wall of the fragments whose collectives attributed here
+        ("fragment_wall_s", T.DOUBLE),
+        ("hindsight", T.VARCHAR),
+        ("hindsight_detail", T.VARCHAR),
     ],
     "session_properties": [
         ("name", T.VARCHAR),
@@ -351,6 +375,12 @@ class SystemConnector(Connector):
             # no store is attached (profile.archive-dir unset)
             store = getattr(r, "profile_store", None)
             return store.rows() if store is not None else []
+        if table == "plan_decisions":
+            # the decision ledgers of recently archived statements
+            # (telemetry/decisions via the profile ring); empty when no
+            # store is attached
+            store = getattr(r, "profile_store", None)
+            return store.decision_rows() if store is not None else []
         if table == "session_properties":
             return [
                 (name, str(value), meta.description)

@@ -110,6 +110,11 @@ from trino_tpu.runtime.memory import batch_bytes
 from trino_tpu.runtime.query_stats import MeshProfile
 from trino_tpu.telemetry import now
 from trino_tpu.telemetry.compile_events import OBSERVATORY
+from trino_tpu.telemetry.decisions import (
+    decision_scope,
+    observe_decision,
+    record_decision,
+)
 from trino_tpu.telemetry.metrics import (
     collective_async_counter,
     join_capacity_counter,
@@ -313,6 +318,18 @@ class DistributedQueryRunner(LocalQueryRunner):
         # (verify/schedule.py); device_residency verifies warm replays
         # against the licensed schedule
         self.last_schedule_license = license_schedule(sub, self.wm.n)
+        lic = self.last_schedule_license
+        n_async = (
+            sum(len(v) for v in lic.async_children.values())
+            if lic is not None
+            else 0
+        )
+        record_decision(
+            "schedule_license", "planner.create_subplan",
+            "async" if n_async else "sync",
+            "sync" if n_async else "async",
+            {"async_children": n_async},
+        )
         return sub
 
     def explain_distributed(self, sql: str) -> str:
@@ -908,9 +925,17 @@ class StageExecutor:
         return merge_sorted_shards(shards, keys)
 
     def _remote_as_dist(self, node: RemoteSourceNode) -> _Dist:
-        """Apply a repartition/broadcast exchange into a stacked batch."""
+        """Apply a repartition/broadcast exchange into a stacked batch.
+        The application runs under the placer decision's scope (child
+        execution stays OUTSIDE it — nested exchanges scope themselves),
+        so the collective's bytes join the recorded choice."""
         child = self._raw_remote(node)
         stacked = self._to_stacked(child)
+        with decision_scope(node.decision_id):
+            return self._apply_dist_exchange(node, stacked)
+
+    def _apply_dist_exchange(self, node: RemoteSourceNode,
+                             stacked: _Dist) -> _Dist:
         if node.exchange_kind == "broadcast":
             # ship live rows, not static capacity: all_gather replicates
             # the batch W times, so compacting to the live bucket first
@@ -936,6 +961,7 @@ class StageExecutor:
                 t and set(t) <= set(names) for t in stacked.placements
             ):
                 self.profile.bump("exchange_elided")
+                observe_decision(node.decision_id, elided=1)
                 return stacked
             chans = [stacked.channel(s.name) for s in node.partition_symbols]
             return self._repartition_side(stacked, chans)
@@ -1262,7 +1288,8 @@ class StageExecutor:
             # on one worker, then run the single-stage kernel per worker
             # (uniform DISTINCT prepends an in-jit dedupe pre-aggregation) —
             # no partial/merge states and no coordinator gather
-            return self._spmd_single_stage(node, src)
+            with decision_scope(node.source.decision_id):
+                return self._spmd_single_stage(node, src)
         states, specs, partial_op = self._agg_partial(node, src)
         final_op = self._final_op(specs, partial_op, states)
         # fused exchange: bucketize + all_to_all + the FINAL aggregation
@@ -1310,28 +1337,35 @@ class StageExecutor:
         except ExceededMemoryLimitException:
             wave_k = _spill.wave_count(need, self._budget(), self.properties)
         if wave_k:
-            out = self._wave_agg_exchange(
-                node, states, chans, final_op, specs, wave_k, ctx
+            wdid = record_decision(
+                "wave", "runtime.agg_final", "waves", "direct",
+                {"waves": int(wave_k), "need_bytes": int(need),
+                 "budget_bytes": int(self._budget() or 0)},
             )
+            with decision_scope(wdid):
+                out = self._wave_agg_exchange(
+                    node, states, chans, final_op, specs, wave_k, ctx
+                )
         else:
             def final_step(b: Batch) -> Batch:
                 return final_op._reduce_step(b, out_cap=fcap)
 
-            out = self._call(
-                ex.fused_repartition,
-                states,
-                chans,
-                self.wm,
-                final_step,
-                ("agg_final", _spec_sig(specs), fcap,
-                 _sig(node.outputs)),
-                slot_cap,
-                phase="collective",
-            )
-            self.profile.add_collective(
-                self._current_fid, batch_bytes(out), "all_to_all",
-                "repartition",
-            )
+            with decision_scope(node.source.decision_id):
+                out = self._call(
+                    ex.fused_repartition,
+                    states,
+                    chans,
+                    self.wm,
+                    final_step,
+                    ("agg_final", _spec_sig(specs), fcap,
+                     _sig(node.outputs)),
+                    slot_cap,
+                    phase="collective",
+                )
+                self.profile.add_collective(
+                    self._current_fid, batch_bytes(out), "all_to_all",
+                    "repartition",
+                )
             ctx.close()
         return self._dist(
             out, node.outputs,
@@ -1610,6 +1644,7 @@ class StageExecutor:
             def residual(batch: Batch, _e=expr):
                 return ExprCompiler(batch).filter_mask(_e)
 
+        did = node.decision_id
         if node.distribution == "broadcast":
             # partitioned-build economy for the broadcast that remains:
             # all_gather replicates the build's FULL static capacity W
@@ -1621,22 +1656,24 @@ class StageExecutor:
             # rows.  Compaction is stable, so build-row order (and with
             # it the sorted-probe tie-break order) is unchanged.
             bs = build.stacked
-            if _trailing_cap(bs) > 64:
-                bs = self._compact_live(bs, "broadcast_compact")
-            build_stacked = self._call(
-                ex.broadcast, bs, self.wm, phase="collective"
-            )
-            self.profile.add_collective(
-                self._current_fid, batch_bytes(build_stacked),
-                "all_gather", "broadcast",
-            )
+            with decision_scope(did):
+                if _trailing_cap(bs) > 64:
+                    bs = self._compact_live(bs, "broadcast_compact")
+                build_stacked = self._call(
+                    ex.broadcast, bs, self.wm, phase="collective"
+                )
+                self.profile.add_collective(
+                    self._current_fid, batch_bytes(build_stacked),
+                    "all_gather", "broadcast",
+                )
         else:
-            build = self._place_join_side(
-                build_node, build, [r for _, r in node.criteria]
-            )
-            probe = self._place_join_side(
-                probe_node, probe, [l for l, _ in node.criteria]
-            )
+            with decision_scope(did):
+                build = self._place_join_side(
+                    build_node, build, [r for _, r in node.criteria]
+                )
+                probe = self._place_join_side(
+                    probe_node, probe, [l for l, _ in node.criteria]
+                )
             build_stacked = build.stacked
 
         op = HashJoinOperator(
@@ -1660,6 +1697,25 @@ class StageExecutor:
         probe_fp = tuple(k for k, _, _ in probe.pending)
         probe_stacked = probe.stacked
         probe_types = [s.type for s in probe.symbols]
+        if did is not None:
+            # outcome inputs for the hindsight join (telemetry/decisions):
+            # static-shape byte math only, no device sync.  build_bytes is
+            # ONE logical build copy (a broadcast's stacked batch holds W
+            # replicas); probe_move_bytes is what the rejected partitioned
+            # plan would have had to move for an unplaced probe.
+            bb = int(batch_bytes(build_stacked))
+            observe_decision(
+                did,
+                build_bytes=(
+                    bb // max(1, self.wm.n)
+                    if node.distribution == "broadcast" else bb
+                ),
+                probe_move_bytes=(
+                    0 if (node.distribution == "broadcast"
+                          and probe.placements)
+                    else int(batch_bytes(probe_stacked))
+                ),
+            )
 
         # budget enforcement: reserve the build's device footprint (raw +
         # sorted copy) BEFORE the expansion materializes; over budget the
@@ -1676,10 +1732,16 @@ class StageExecutor:
         except ExceededMemoryLimitException:
             wave_k = _spill.wave_count(need, self._budget(), self.properties)
         if wave_k:
-            out = self._wave_join(
-                node, op, probe_stacked, build_stacked, pk, bk, jkey,
-                probe_types, wave_k, ctx,
+            wdid = record_decision(
+                "wave", "runtime.join_build", "waves", "direct",
+                {"waves": int(wave_k), "need_bytes": int(need),
+                 "budget_bytes": int(self._budget() or 0)},
             )
+            with decision_scope(wdid):
+                out = self._wave_join(
+                    node, op, probe_stacked, build_stacked, pk, bk, jkey,
+                    probe_types, wave_k, ctx,
+                )
         else:
             locate, device_emit_total, expand = self._join_step_fns(
                 node, op, pk, bk, _trailing_cap(build_stacked), probe_types
@@ -2007,7 +2069,17 @@ class StageExecutor:
                 # would compile k*cap_p wide on the very first run —
                 # let the runtime path size it once, then relicense.
                 declined = f"cold width {oc} > probe capacity {cap_p}"
+            cap_inputs = {
+                "cert_kind": type(cert).__name__,
+                "licensed_cap": int(oc),
+                "learned_cap": int(learned),
+                "probe_cap": int(cap_p),
+            }
             if declined is None:
+                did = record_decision(
+                    "join_capacity", "runtime.sized_expansion", "licensed",
+                    "runtime_check", cap_inputs,
+                )
 
                 def build_licensed(_oc=oc):
                     def step(pb: Batch, bb: Batch):
@@ -2021,22 +2093,36 @@ class StageExecutor:
                     self.wm, ("licensed_expand", oc, cap_p) + key,
                     build_licensed,
                 )
-                out = self._call(fn, probe_stacked, build_stacked)
-                self.profile.bump("join_capacity_proven")
-                join_capacity_counter().labels("proven").inc()
-                if oc > 1024:
-                    # compact the licensed output to its live bucket at
-                    # this host boundary (the build sync already stalls
-                    # here) and record the tight width so the NEXT run's
-                    # economy decision sees it — the licensed path
-                    # teaches itself
-                    out = self._compact_live(
-                        out, ("licensed_compact",) + key,
-                        history_key=hist_key,
-                    )
+                with decision_scope(did):
+                    out = self._call(fn, probe_stacked, build_stacked)
+                    self.profile.bump("join_capacity_proven")
+                    join_capacity_counter().labels("proven").inc()
+                    if oc > 1024:
+                        # compact the licensed output to its live bucket at
+                        # this host boundary (the build sync already stalls
+                        # here) and record the tight width so the NEXT run's
+                        # economy decision sees it — the licensed path
+                        # teaches itself
+                        out = self._compact_live(
+                            out, ("licensed_compact",) + key,
+                            history_key=hist_key,
+                        )
+                observe_decision(
+                    did, executed=1,
+                    live_cap=int(CAP_HISTORY.guess(hist_key, 0)),
+                )
                 return out
             self.profile.bump("join_license_declined")
             join_capacity_counter().labels("declined").inc()
+            did = record_decision(
+                "join_capacity", "runtime.sized_expansion", "declined",
+                "licensed", {**cap_inputs, "declined_reason": declined},
+            )
+        else:
+            did = record_decision(
+                "join_capacity", "runtime.sized_expansion", "runtime_check",
+                "", {"probe_cap": int(cap_p)},
+            )
 
         join_capacity_counter().labels("runtime_check").inc()
         out_cap = (
@@ -2068,20 +2154,24 @@ class StageExecutor:
             fn = cached_spmd_step(
                 self.wm, ("fused_expand", out_cap, pcap) + key, build_fused
             )
-            out, total, live, over = self._call(
-                fn, probe_stacked, build_stacked
-            )
-            with self.profile.phase(fid, "transfer"):
-                over_h, total_h, live_h = self._host_pull(over, total, live)
-            self.profile.bump("join_overflow_check")
-            self.profile.add_collective(
-                fid, int(over_h.nbytes + total_h.nbytes + live_h.nbytes),
-                "gather", "capacity_sizing",
-            )
+            with decision_scope(did):
+                out, total, live, over = self._call(
+                    fn, probe_stacked, build_stacked
+                )
+                with self.profile.phase(fid, "transfer"):
+                    over_h, total_h, live_h = self._host_pull(
+                        over, total, live
+                    )
+                self.profile.bump("join_overflow_check")
+                self.profile.add_collective(
+                    fid, int(over_h.nbytes + total_h.nbytes + live_h.nbytes),
+                    "gather", "capacity_sizing",
+                )
             if not over_h.any():
                 CAP_HISTORY.record(hist_key, out_cap)
                 if compact_probe:
                     CAP_HISTORY.record(pkey, pcap)
+                observe_decision(did, executed=1, runtime_cap=int(out_cap))
                 return out
             self.profile.bump("join_speculative_retry")
             if int(live_h.max()) > pcap:
@@ -2101,16 +2191,17 @@ class StageExecutor:
             return step
 
         loc = cached_spmd_step(self.wm, ("locate",) + key, build_locate)
-        sb, start, count, total_dev, live_dev = self._call(
-            loc, probe_stacked, build_stacked
-        )
-        with self.profile.phase(fid, "transfer"):
-            totals, lives = self._host_pull(total_dev, live_dev)
-        self.profile.bump("join_capacity_sync")
-        self.profile.add_collective(
-            fid, int(totals.nbytes + lives.nbytes), "gather",
-            "capacity_sizing",
-        )
+        with decision_scope(did):
+            sb, start, count, total_dev, live_dev = self._call(
+                loc, probe_stacked, build_stacked
+            )
+            with self.profile.phase(fid, "transfer"):
+                totals, lives = self._host_pull(total_dev, live_dev)
+            self.profile.bump("join_capacity_sync")
+            self.profile.add_collective(
+                fid, int(totals.nbytes + lives.nbytes), "gather",
+                "capacity_sizing",
+            )
         cap = next_pow2(max(1, int(totals.max())), floor=1024)
 
         def build_expand(oc=cap):
@@ -2120,7 +2211,9 @@ class StageExecutor:
             return step
 
         fn = cached_spmd_step(self.wm, ("expand", cap) + key, build_expand)
-        out = self._call(fn, probe_stacked, sb, start, count, total_dev)
+        with decision_scope(did):
+            out = self._call(fn, probe_stacked, sb, start, count, total_dev)
+        observe_decision(did, executed=1, runtime_cap=int(cap))
         if spec is not None:
             CAP_HISTORY.record(hist_key, cap)
             if compact_probe:
@@ -2191,14 +2284,17 @@ class StageExecutor:
             if self.colocate and (
                 src_placed or filt_placed or src.realigned or filt.realigned
             ):
-                if src_placed:
-                    self.profile.bump("exchange_elided")
-                else:
-                    src = self._repartition_side(src, [sk])
-                if filt_placed:
-                    self.profile.bump("exchange_elided")
-                else:
-                    filt = self._repartition_side(filt, [fk])
+                with decision_scope(node.decision_id):
+                    if src_placed:
+                        self.profile.bump("exchange_elided")
+                        observe_decision(node.decision_id, elided=1)
+                    else:
+                        src = self._repartition_side(src, [sk])
+                    if filt_placed:
+                        self.profile.bump("exchange_elided")
+                        observe_decision(node.decision_id, elided=1)
+                    else:
+                        filt = self._repartition_side(filt, [fk])
             filt_stacked = filt.stacked
             has_null = _global_has_null(filt_stacked)
             cap_b = _trailing_cap(filt_stacked)
@@ -2239,12 +2335,22 @@ class StageExecutor:
         op = SemiJoinOperator(
             sk, fk, [s.type for s in filt.symbols], null_aware=node.null_aware
         )
-        bcast = self._call(
-            ex.broadcast, filt.stacked, self.wm, phase="collective"
-        )
-        self.profile.add_collective(
-            self._current_fid, batch_bytes(bcast), "all_gather", "broadcast"
-        )
+        with decision_scope(node.decision_id):
+            bcast = self._call(
+                ex.broadcast, filt.stacked, self.wm, phase="collective"
+            )
+            self.profile.add_collective(
+                self._current_fid, batch_bytes(bcast), "all_gather",
+                "broadcast",
+            )
+        if node.decision_id is not None:
+            observe_decision(
+                node.decision_id,
+                build_bytes=int(batch_bytes(bcast)) // max(1, self.wm.n),
+                probe_move_bytes=(
+                    0 if src.placements else int(batch_bytes(src.stacked))
+                ),
+            )
         cap_b = _trailing_cap(bcast)
         has_null = _global_has_null(bcast)
 

@@ -211,6 +211,10 @@ class JoinNode(PlanNode):
     #: the certified fixed capacity with NO sizing gather, overflow flag,
     #: or speculative retry (None = runtime sizing path)
     capacity_cert: Optional[object] = None
+    #: plan-decision ledger id of the distribution choice
+    #: (telemetry/decisions): the runtime scopes this join's collectives
+    #: under it so measured bytes join back to the recorded decision
+    decision_id: Optional[str] = None
 
     @property
     def outputs(self):
@@ -223,7 +227,7 @@ class JoinNode(PlanNode):
     def with_children(self, children):
         return JoinNode(
             self.kind, children[0], children[1], self.criteria, self.filter,
-            self.distribution, self.capacity_cert,
+            self.distribution, self.capacity_cert, self.decision_id,
         )
 
 
@@ -241,6 +245,8 @@ class SemiJoinNode(PlanNode):
     #: IN-subquery null semantics (mark NULL on null key / null in filtering
     #: side); False for EXISTS, whose mark is plain boolean
     null_aware: bool = True
+    #: plan-decision ledger id of the distribution choice
+    decision_id: Optional[str] = None
 
     @property
     def outputs(self):
@@ -253,7 +259,7 @@ class SemiJoinNode(PlanNode):
     def with_children(self, children):
         return SemiJoinNode(
             children[0], children[1], self.source_key, self.filtering_key,
-            self.mark, self.filter, self.null_aware,
+            self.mark, self.filter, self.null_aware, self.decision_id,
         )
 
 
@@ -522,6 +528,10 @@ class ExchangeNode(PlanNode):
     kind: str  # repartition | broadcast | gather | merge
     partition_symbols: list = field(default_factory=list)
     orderings: list = field(default_factory=list)  # for merge exchanges
+    #: plan-decision ledger id of the placement choice that inserted this
+    #: exchange; the fragmenter copies it onto the RemoteSourceNode so the
+    #: runtime attributes the applied collective's bytes to the decision
+    decision_id: Optional[str] = None
 
     @property
     def outputs(self):
@@ -533,7 +543,8 @@ class ExchangeNode(PlanNode):
 
     def with_children(self, children):
         return ExchangeNode(
-            children[0], self.kind, self.partition_symbols, self.orderings
+            children[0], self.kind, self.partition_symbols, self.orderings,
+            self.decision_id,
         )
 
 

@@ -177,6 +177,11 @@ class QueryContext:
         #: reference to this statement's archived profile artifact
         #: (telemetry/profile_store), set after FINISHING
         self.profile_ref = None
+        #: the statement's plan-decision ledger (telemetry/decisions):
+        #: planner rules and runtime branches record choices here via the
+        #: contextvar, the runner joins outcomes + stamps hindsight before
+        #: archiving (same lane-safety contract as the tracer)
+        self.decisions = None
 
     # -- state machine --------------------------------------------------------
 

@@ -19,6 +19,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from trino_tpu.telemetry import NULL_TRACER, now
+from trino_tpu.telemetry.decisions import observe_collective
 from trino_tpu.telemetry.metrics import (
     collective_bytes_counter,
     mesh_events_counter,
@@ -156,6 +157,10 @@ class MeshProfile:
         key = (kind, purpose)
         st.collective_by[key] = st.collective_by.get(key, 0) + nbytes
         collective_bytes_counter().labels(kind, purpose).inc(nbytes)
+        # decision-ledger attribution (telemetry/decisions): the same
+        # bytes, credited to the planner choice whose scope is active —
+        # host-side bookkeeping on an int the profile already holds
+        observe_collective(fid, nbytes, kind, purpose)
 
     def collective_sequences(self) -> dict:
         """{fragment id: ((kind, purpose), ...)} of mesh collectives in

@@ -341,6 +341,11 @@ class LocalQueryRunner:
         # an untraced statement can never record into a traced neighbor's
         # tree through the shared fallback attribute (lane safety)
         ctx.tracer = tracer
+        # plan-decision ledger: attached per-statement like the tracer, so
+        # concurrent lanes record into disjoint ledgers (lane safety)
+        from trino_tpu.telemetry.decisions import ensure_ledger
+
+        ensure_ledger(ctx)
         t0 = _time.time()
         self.events.query_created(QueryCreatedEvent(qid, sql, t0))
         try:
@@ -358,6 +363,7 @@ class LocalQueryRunner:
             queries_counter().labels(state, etype).inc()
             query_wall_histogram().observe(end - t0)
             self._finish_trace(qid, tracer, prev_tracer, ctx)
+            self._finalize_decisions(ctx)
             self._archive_profile(
                 ctx, sql, state, end - t0,
                 error_code=getattr(e, "error_code", None),
@@ -382,6 +388,7 @@ class LocalQueryRunner:
         queries_counter().labels("FINISHED", "").inc()
         query_wall_histogram().observe(end - t0)
         self._finish_trace(qid, tracer, prev_tracer, ctx)
+        self._finalize_decisions(ctx)
         self._archive_profile(
             ctx, sql, "FINISHED", end - t0, rows=result.row_count
         )
@@ -432,6 +439,42 @@ class LocalQueryRunner:
             ctx.trace_json = trace
         self.last_trace = trace
         self.traces.append((qid, tracer.flat_spans()))
+
+    def _finalize_decisions(self, ctx) -> None:
+        """Join the statement's plan-decision ledger with its measured
+        outcomes and stamp hindsight verdicts (telemetry/decisions).
+        Runs before the profile artifact is assembled so the ledger lands
+        in it.  Host-side arithmetic on integers the profile already
+        holds; must never break a query."""
+        ledger = getattr(ctx, "decisions", None)
+        if ledger is None:
+            return
+        try:
+            wm = getattr(self, "wm", None)
+            n = wm.n if wm is not None else 1
+            prof = ctx.mesh_profile
+            phases = (
+                {fid: st.wall_s for fid, st in prof.fragments.items()}
+                if prof is not None
+                else None
+            )
+            ledger.finalize(
+                n_workers=n,
+                regret_ratio=float(
+                    self.properties.get("decision_regret_ratio")
+                ),
+                min_bytes=int(
+                    self.properties.get("decision_regret_min_bytes")
+                ),
+                fragment_phases=phases,
+            )
+        except Exception:
+            import logging
+
+            logging.getLogger("trino_tpu.decisions").warning(
+                "failed to finalize decision ledger for %s", ctx.query_id,
+                exc_info=True,
+            )
 
     def _archive_profile(self, ctx, sql: str, state: str, wall_s: float,
                          rows: int = 0, error_code=None) -> None:

@@ -193,6 +193,22 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             True,
         ),
         PropertyMetadata(
+            "decision_regret_ratio",
+            "hindsight threshold for the plan-decision ledger "
+            "(telemetry/decisions): a decision is stamped 'regret' when "
+            "its measured cost exceeds this multiple of the estimated "
+            "cost of the alternative it rejected",
+            float,
+            2.0,
+        ),
+        PropertyMetadata(
+            "decision_regret_min_bytes",
+            "byte floor below which the decision ledger never flags "
+            "regret (tiny broadcasts are noise, not mistakes)",
+            int,
+            1 << 20,
+        ),
+        PropertyMetadata(
             "pallas_agg",
             "use the Pallas MXU one-hot-matmul kernel for eligible "
             "small-domain float aggregations",

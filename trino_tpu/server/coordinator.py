@@ -536,6 +536,57 @@ class CoordinatorServer:
                             },
                         )
                     return self._send(200, art)
+                # /v1/query/{id}/decisions — the plan-decision ledger
+                # (telemetry/decisions) out of the archived profile
+                # artifact: what the planner chose, what it cost, and the
+                # hindsight verdicts; same id resolution as /profile
+                if (
+                    len(parts) == 4
+                    and parts[:2] == ["v1", "query"]
+                    and parts[3] == "decisions"
+                ):
+                    store = getattr(server.runner, "profile_store", None)
+                    if store is None:
+                        return self._send(
+                            404,
+                            {
+                                "error": {
+                                    "message": "profile archive not "
+                                    "configured (set profile.archive-dir "
+                                    "or attach a ProfileStore)"
+                                }
+                            },
+                        )
+                    lookup = parts[2]
+                    q = server.query(lookup)
+                    if q is not None:
+                        q.done.wait(timeout=poll_wait_s())
+                        if not q.done.is_set():
+                            return self._send(
+                                404,
+                                {
+                                    "error": {
+                                        "message": "no decision ledger "
+                                        "yet (query still running)"
+                                    }
+                                },
+                            )
+                        ctx = q.lifecycle
+                        if ctx is not None:
+                            lookup = ctx.query_id
+                    art = store.get(lookup)
+                    if art is None or art.get("decisions") is None:
+                        return self._send(
+                            404,
+                            {
+                                "error": {
+                                    "message": "no decision ledger for "
+                                    "this query (still running, or the "
+                                    "artifact was pruned)"
+                                }
+                            },
+                        )
+                    return self._send(200, art["decisions"])
                 # /v1/query/{id}/trace — Perfetto/Chrome-trace JSON
                 if (
                     len(parts) == 4

@@ -28,6 +28,28 @@ from trino_tpu.runtime.events import EventListener
 
 log = logging.getLogger("trino_tpu.audit")
 
+#: process-wide monotonic audit sequence: every appended line carries the
+#: next value, so external tails detect gaps (a dropped line is visible)
+#: and the decision ledger cross-references in-flight decisions against
+#: shed/kill/drain events by (query_id, seq) — a decision whose
+#: `audit_seq` watermark is below a kill line's seq was made BEFORE the
+#: kill landed
+_seq_lock = threading.Lock()
+_seq = 0
+
+
+def _next_sequence() -> int:
+    global _seq
+    with _seq_lock:
+        _seq += 1
+        return _seq
+
+
+def sequence_watermark() -> int:
+    """Highest audit sequence issued so far (0 before any line)."""
+    with _seq_lock:
+        return _seq
+
 
 class QueryAuditLog(EventListener):
     """JSONL sink for query completions (see module doc).  Thread-safe:
@@ -71,6 +93,7 @@ class QueryAuditLog(EventListener):
 
         stats = getattr(e, "statistics", None)
         doc = {
+            "seq": _next_sequence(),
             "ts": self.clock(),
             "query_id": e.query_id,
             "state": e.state,

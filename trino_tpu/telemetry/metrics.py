@@ -418,7 +418,25 @@ DECIMAL_FASTPATHS = ("proven", "runtime_check", "limb")
 #: runtime_check = the speculative/sizing fallback ran its runtime
 #: protocol.  Pre-registered so the compare_bench check_licenses gate
 #: reads real zeros, not absent series.
-JOIN_CAPACITY_OUTCOMES = ("proven", "runtime_check")
+JOIN_CAPACITY_OUTCOMES = ("proven", "runtime_check", "declined")
+
+
+#: plan-decision vocabulary (telemetry/decisions.py), pre-registered on
+#: BOTH exposition endpoints (coordinator and worker /v1/metrics render
+#: the same process registry) so scrapes see the full (kind, outcome,
+#: hindsight) grid at zero before the first statement decides anything.
+#: `pending` counts recordings at decision time; the hindsight verdicts
+#: count at finalize.
+PLAN_DECISION_SERIES = (
+    ("join_distribution", ("broadcast", "partitioned", "colocated")),
+    ("join_capacity", ("licensed", "declined", "runtime_check")),
+    ("dictionary_placement", ("coded_colocate",)),
+    ("schedule_license", ("async", "sync")),
+    ("wave", ("waves",)),
+    ("exchange", ("repartition", "broadcast", "gather", "merge", "elide")),
+)
+
+PLAN_DECISION_HINDSIGHT = ("pending", "vindicated", "regret", "unmeasured")
 
 
 #: membership transition vocabulary, pre-registered so scrapes see
@@ -699,6 +717,18 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
     )
     for o in JOIN_CAPACITY_OUTCOMES:
         joincap.touch(o)
+    decisions = reg.counter(
+        _PREFIX + "plan_decisions_total",
+        "plan-decision ledger entries (telemetry/decisions.py) by decision "
+        "kind, chosen outcome, and hindsight verdict: pending counts at "
+        "decision time; vindicated/regret/unmeasured count once the runner "
+        "joins each decision with its measured outcome",
+        labelnames=("kind", "outcome", "hindsight"),
+    )
+    for kind, outcomes in PLAN_DECISION_SERIES:
+        for o in outcomes:
+            for h in PLAN_DECISION_HINDSIGHT:
+                decisions.touch(kind, o, h)
     reg.counter(
         _PREFIX + "collective_async_total",
         "independent child fragments pre-dispatched asynchronously under a "
@@ -796,6 +826,13 @@ def join_capacity_counter() -> Counter:
 def collective_async_counter() -> Counter:
     """Schedule-licensed asynchronous child-fragment pre-dispatches."""
     return REGISTRY.counter(_PREFIX + "collective_async_total")
+
+
+def plan_decisions_counter() -> Counter:
+    """Plan-decision ledger entries, labeled (kind, outcome, hindsight).
+    compare_bench check_decisions gates regret == 0 over the warm benched
+    set."""
+    return REGISTRY.counter(_PREFIX + "plan_decisions_total")
 
 
 def queries_counter() -> Counter:

@@ -93,6 +93,7 @@ def build_artifact(
     compile_events=None,
     error_code=None,
     created_at: Optional[float] = None,
+    decisions=None,
 ) -> dict:
     """Assemble one archived profile artifact (plain JSON-able dict).
 
@@ -170,6 +171,7 @@ def build_artifact(
         "gate": {"wait_s": round(float(gate_wait_s), 9)},
         "peak_memory_bytes": int(peak_memory_bytes),
         "spans": spans,
+        "decisions": decisions,
     }
 
 
@@ -205,6 +207,11 @@ def artifact_from_runner(runner, ctx, sql: str, state: str, wall_s: float,
         mesh=mesh,
         compile_events=OBSERVATORY.events(),
         error_code=error_code,
+        decisions=(
+            ctx.decisions.to_json()
+            if getattr(ctx, "decisions", None) is not None
+            else None
+        ),
     )
 
 
@@ -415,6 +422,39 @@ class ProfileStore:
             )
             for a in arts
         ]
+
+    def decision_rows(self) -> list:
+        """system.runtime.plan_decisions feed: one row per recorded plan
+        decision across ring artifacts (telemetry/decisions), oldest
+        artifact first — (query_id, decision_id, kind, site, choice,
+        alternative, inputs, audit_seq, exchange_bytes, bytes_by,
+        fragment_wall_s, hindsight, hindsight_detail)."""
+        import json as _json
+
+        with self._lock:
+            arts = list(self._ring.values())
+        out = []
+        for a in arts:
+            led = a.get("decisions") or {}
+            for d in led.get("decisions", ()):
+                out.append(
+                    (
+                        a["query_id"],
+                        d["decision_id"],
+                        d["kind"],
+                        d["site"],
+                        d["choice"],
+                        d["alternative"],
+                        _json.dumps(d["inputs"], sort_keys=True),
+                        d["audit_seq"],
+                        d["exchange_bytes"],
+                        _json.dumps(d["bytes_by"], sort_keys=True),
+                        d["measured"].get("fragment_wall_s"),
+                        d["hindsight"],
+                        d["hindsight_detail"],
+                    )
+                )
+        return out
 
     # -- retention -------------------------------------------------------------
 
