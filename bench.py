@@ -841,11 +841,13 @@ def _run_serve(args) -> dict:
     (zero warm compile events, shared trace cache).  Runs in a sanitized
     child like the mesh bench (the virtual mesh needs the device-count
     flag before jax initializes); records the top-level `serve` section
-    tools/compare_bench.py `check_serve` gates."""
+    tools/compare_bench.py `check_serve` gates, including the `chaos`
+    phase (worker killed mid-Q18 under fault_tolerant_execution) that
+    `check_chaos` gates."""
     from _cleanenv import cpu_env
 
     env = cpu_env(os.environ, n_virtual_devices=8)
-    timeout = float(os.environ.get("BENCH_SERVE_TIMEOUT", 900))
+    timeout = float(os.environ.get("BENCH_SERVE_TIMEOUT", 1200))
     try:
         r = subprocess.run(
             [sys.executable, "-m", "trino_tpu.bench_serve"],
@@ -973,9 +975,9 @@ def _extra_child_budget(args) -> float:
         or os.environ.get("BENCH_SERVE") == "1"
     ):
         try:
-            extra += float(os.environ.get("BENCH_SERVE_TIMEOUT", 900)) + 60
+            extra += float(os.environ.get("BENCH_SERVE_TIMEOUT", 1200)) + 60
         except ValueError:
-            extra += 960
+            extra += 1260
     return extra
 
 

@@ -50,6 +50,22 @@ def lockgraph():
     graph.assert_acyclic()
 
 
+@pytest.fixture(scope="module", autouse=True)
+def no_spool_leaks():
+    """Chaos kills must never leak spool directories: every query-owned
+    spool (fault-tolerant recovery included) is removed when its query
+    ends, so /tmp holds zero orphan .npz spools after the module."""
+    import glob
+    import os
+    import tempfile
+
+    pat = os.path.join(tempfile.gettempdir(), "trino_tpu_spool_*")
+    before = set(glob.glob(pat))
+    yield
+    leaked = set(glob.glob(pat)) - before
+    assert not leaked, f"spool directories leaked: {sorted(leaked)}"
+
+
 @pytest.fixture(scope="module")
 def workers(lockgraph):
     ws = [WorkerServer(port=0).start() for _ in range(2)]

@@ -309,7 +309,11 @@ def test_shrink_unregisters_detector_entries():
 
     runner = DistributedQueryRunner(n_workers=4, schema="tiny")
     runner.resize_mesh(2)
-    assert sorted(runner.failure_detector._last) == ["worker-0", "worker-1"]
+    # the detector is a facade over the membership registry — the dropped
+    # workers' entries must be gone from it entirely
+    assert sorted(runner.failure_detector.active_workers()) == [
+        "worker-0", "worker-1",
+    ]
     # push the clock past timeout_s: surviving workers re-heartbeat at
     # query start, dropped ones must simply be gone
     runner.failure_detector.clock = (

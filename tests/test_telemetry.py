@@ -501,6 +501,16 @@ def _clean_serve():
         "schema": "tiny",
         "local": dict(phase),
         "mesh": {**phase, "warm_compile_events": 0},
+        "chaos": {
+            **phase,
+            "query": "Q18",
+            "injected_kills": 1,
+            "task_retries": {"retry": 1, "replan": 0, "fail": 0},
+            "spooled_fragments": 12,
+            "spool_hits": 9,
+            "full_replans": 0,
+            "p99_degradation_ratio": 1.4,
+        },
     }
 
 
@@ -605,6 +615,34 @@ def test_compare_bench_serve_gate():
     violations, skipped = check_extra(errored)
     assert violations == []
     assert any("serve: bench errored" in s for s in skipped)
+
+
+def test_compare_bench_chaos_gate():
+    """The fault-tolerance chaos gate: a worker killed mid-Q18 under
+    concurrent serve load must classify as a task RETRY (never fail),
+    resume from spooled intermediates, and never re-plan the mesh."""
+    check_extra = _compare_bench().check_extra
+    bad = _clean_extra()
+    bad["serve"]["chaos"].update(
+        rows_match=False, injected_kills=0, spool_hits=0, full_replans=2,
+        task_retries={"retry": 0, "replan": 0, "fail": 3}, clients=1,
+    )
+    violations, _ = check_extra(bad)
+    text = "\n".join(violations)
+    assert "serve.chaos.rows_match" in text
+    assert "serve.chaos.clients" in text
+    assert "serve.chaos.injected_kills" in text
+    assert "serve.chaos.task_retries.retry" in text
+    assert "serve.chaos.task_retries.fail" in text
+    assert "serve.chaos.spool_hits" in text
+    assert "serve.chaos.full_replans" in text
+    # a recorded serve section WITHOUT chaos is skipped, not violated
+    # (older BENCH_EXTRA recordings stay green until re-run)
+    missing = _clean_extra()
+    del missing["serve"]["chaos"]
+    violations, skipped = check_extra(missing)
+    assert violations == []
+    assert any("serve.chaos" in s for s in skipped)
 
 
 def test_compare_bench_flags_drift():

@@ -533,6 +533,76 @@ def check_serve(sec: dict) -> list:
     return violations
 
 
+#: chaos-section keys (bench_serve's fault-tolerant recovery phase): the
+#: recovery claim is only evidence with the kill count, the per-outcome
+#: retry classification, the spool evidence, AND the correctness bit
+CHAOS_KEYS = SERVE_KEYS + (
+    "injected_kills", "task_retries", "spooled_fragments", "spool_hits",
+    "full_replans",
+)
+
+
+def check_chaos(sec) -> list:
+    """Violations over `serve.chaos` (trino_tpu/bench_serve._run_chaos):
+    a worker killed mid-Q18 under K >= 2 concurrent serve clients, with
+    fault_tolerant_execution on, must leave every statement answering the
+    serial oracle, the kill classified RETRY (never fail), the statement
+    resumed from spooled stage outputs (spool reads happened), and ZERO
+    mesh-shrink full re-plans — a retryable kill re-runs lost tasks, it
+    never re-fragments the query."""
+    if not isinstance(sec, dict):
+        return ["serve.chaos missing (re-run bench.py --serve)"]
+    violations = []
+    missing = [k for k in CHAOS_KEYS if k not in sec]
+    if missing:
+        return [f"serve.chaos missing {missing}"]
+    if sec.get("rows_match") is not True:
+        violations.append(
+            f"serve.chaos.rows_match = {sec.get('rows_match')} (expected "
+            "true: the killed statement must complete with the serial "
+            f"oracle's rows; errors: {sec.get('errors')})"
+        )
+    if sec.get("clients", 0) < 2:
+        violations.append(
+            f"serve.chaos.clients = {sec.get('clients')} (expected >= 2: "
+            "recovery must be exercised UNDER concurrent serve load)"
+        )
+    if sec.get("injected_kills", 0) < 1:
+        violations.append(
+            f"serve.chaos.injected_kills = {sec.get('injected_kills')} "
+            "(expected >= 1: the chaos phase must actually kill a worker)"
+        )
+    retries = sec.get("task_retries") or {}
+    if retries.get("retry", 0) < 1:
+        violations.append(
+            f"serve.chaos.task_retries.retry = {retries.get('retry')} "
+            "(expected >= 1: the kill must classify as a task RETRY)"
+        )
+    if retries.get("fail", 0) != 0:
+        violations.append(
+            f"serve.chaos.task_retries.fail = {retries.get('fail')} "
+            "(expected 0: a retryable kill must never exhaust into fail)"
+        )
+    for key, why in (
+        ("spooled_fragments",
+         "stage outputs must spool through the filesystem SPI"),
+        ("spool_hits",
+         "recovery must resume from spooled intermediates, not re-run "
+         "finished fragments"),
+    ):
+        if not sec.get(key, 0) > 0:
+            violations.append(
+                f"serve.chaos.{key} = {sec.get(key)} (expected > 0: {why})"
+            )
+    if sec.get("full_replans", 0) != 0:
+        violations.append(
+            f"serve.chaos.full_replans = {sec.get('full_replans')} "
+            "(expected 0: a retryable kill re-runs lost tasks only — the "
+            "query is never re-planned)"
+        )
+    return violations
+
+
 #: drift-section keys the attribution is only evidence WITH: the era walls
 #: on both sides, the multiplicative ratio decomposition, and the named
 #: dominant (phase, fragment) of the current profile
@@ -644,6 +714,13 @@ def check_extra(extra: dict) -> tuple:
             )
         else:
             violations.extend(check_serve(serve))
+            if "chaos" in serve:
+                violations.extend(check_chaos(serve.get("chaos")))
+            else:
+                skipped.append(
+                    "no serve.chaos section recorded (re-run bench.py "
+                    "--serve for the fault-tolerance gate)"
+                )
     else:
         skipped.append(
             "no serve section recorded (run bench.py --serve)"

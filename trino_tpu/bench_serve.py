@@ -13,6 +13,12 @@ TPC-H mix through the dispatcher (runtime/dispatcher) must
     the shared-trace-cache contract (near-zero marginal compile cost per
     added client), asserted through the compile observatory.
 
+A final `chaos` phase (gated by `check_chaos`) turns fault_tolerant
+execution on, kills a worker mid-Q18 while the mix serves concurrently,
+and asserts the recovery contract: the killed statement completes from
+spooled intermediates with only the lost stage re-run, and zero
+mesh-shrink re-plans.
+
 Run standalone (prints one JSON line):
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -125,6 +131,93 @@ def _mix_and_oracle(runner) -> tuple:
     return mix, oracle
 
 
+def _recovery_metrics() -> dict:
+    """Point-in-time recovery counter values (chaos evidence is the
+    before/after delta): task retries by outcome, spooled fragments,
+    spool rehydration reads, and mesh-shrink full re-plans."""
+    from trino_tpu.telemetry.metrics import (
+        TASK_RETRY_OUTCOMES,
+        membership_events_counter,
+        mesh_events_counter,
+        spooled_fragments_counter,
+        task_retries_counter,
+    )
+
+    retries = task_retries_counter()
+    return {
+        "task_retries": {
+            o: retries.labels(o).value() for o in TASK_RETRY_OUTCOMES
+        },
+        "spooled_fragments": spooled_fragments_counter().value(),
+        "spool_hits": mesh_events_counter().labels("spool_read").value(),
+        "full_replans": membership_events_counter().labels(
+            "shrink_replan"
+        ).value(),
+    }
+
+
+def _run_chaos(dist, dm, mix: list, oracle: dict, clients: int,
+               rounds: int, p99_mesh) -> dict:
+    """The `serve.chaos` section: kill a worker mid-Q18 while K clients
+    serve the mix concurrently, with fault_tolerant_execution on.  The
+    recovery contract under measurement: the killed statement completes
+    from spooled intermediates (spool_hits delta > 0), only the lost
+    stage re-runs (task_retries.retry >= 1), and the mesh is never
+    re-planned for a retryable kill (full_replans delta == 0)."""
+    from trino_tpu.connectors.tpch.queries import QUERIES
+    from trino_tpu.runtime.retry import FAILURE_INJECTOR, InjectedFailure
+
+    q18 = QUERIES[18]
+    dist.properties.set("fault_tolerant_execution", True)
+    try:
+        # serial oracle + warm-up at the spooled-execution keys
+        oracle = dict(oracle)
+        oracle[q18] = sorted(map(str, dist.execute(q18).rows))
+        mix = [q18] + list(mix)  # client 0 opens with Q18
+        base = _recovery_metrics()
+
+        fired = [0]
+        orig_fail = FAILURE_INJECTOR.maybe_fail
+
+        def chaos_kill(point: str) -> None:
+            # one worker "death" mid-Q18: fires in client 0's FIRST
+            # statement (Q18), at the finish hook of a stage whose
+            # children already completed and spooled — the retry must
+            # resume from those spooled outputs, never re-plan
+            if (
+                not fired[0]
+                and point.startswith("stage:")
+                and point.endswith(":finish")
+                and not point.startswith("stage:0:")
+                and threading.current_thread().name == "serve-client-0"
+            ):
+                fired[0] += 1
+                raise InjectedFailure(f"chaos: worker killed at {point}")
+            return orig_fail(point)
+
+        FAILURE_INJECTOR.maybe_fail = chaos_kill
+        try:
+            chaos = _serve_once(dm, mix, oracle, clients, rounds)
+        finally:
+            FAILURE_INJECTOR.maybe_fail = orig_fail
+        after = _recovery_metrics()
+    finally:
+        dist.properties.set("fault_tolerant_execution", False)
+    chaos["query"] = "Q18"
+    chaos["injected_kills"] = fired[0]
+    chaos["task_retries"] = {
+        o: after["task_retries"][o] - base["task_retries"][o]
+        for o in after["task_retries"]
+    }
+    for key in ("spooled_fragments", "spool_hits", "full_replans"):
+        chaos[key] = after[key] - base[key]
+    chaos["p99_degradation_ratio"] = (
+        round(chaos["p99_s"] / p99_mesh, 3)
+        if chaos.get("p99_s") and p99_mesh else None
+    )
+    return chaos
+
+
 def run_serve(schema: str = "tiny", clients: int = 8, rounds: int = 3,
               lanes: int = 4) -> dict:
     """The `serve` section: a local concurrent phase (host planning /
@@ -203,6 +296,11 @@ def run_serve(schema: str = "tiny", clients: int = 8, rounds: int = 3,
     mesh = _serve_once(dm, mix, oracle, clients, rounds)
     mesh["warm_compile_events"] = OBSERVATORY.mark() - watermark
     out["mesh"] = mesh
+
+    # -- chaos phase (task-level fault tolerance under serve load) -------------
+    out["chaos"] = _run_chaos(
+        dist, dm, mix, oracle, clients, rounds, mesh.get("p99_s")
+    )
     return out
 
 

@@ -216,6 +216,13 @@ class RemoteConfig(ConfigSection):
         8, "remote.max-replans",
         "mesh-shrink re-planning attempts per query before giving up",
     )
+    max_task_retries: int = knob(
+        4, "remote.max-task-retries",
+        "same-plan recovery attempts per query under "
+        "fault_tolerant_execution (lost tasks re-run on survivors, "
+        "spooled fragments resume) before classifying the mesh as shrunk "
+        "below the plan's requirements and re-planning",
+    )
 
 
 @dataclass

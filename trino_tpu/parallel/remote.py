@@ -197,6 +197,15 @@ class MultiHostQueryRunner(LocalQueryRunner):
         self.last_replans = 0
         #: worker set the LAST statement's plan was fragmented against
         self.last_plan_workers: list = []
+        #: fault-tolerant recovery evidence for the LAST statement
+        self.last_task_retries = 0
+        self.last_spool_hits = 0
+        #: spool + completed-fragment map, live only while a
+        #: fault_tolerant_execution query is executing
+        self._fte_spool = None
+        self._fte_completed: dict = {}
+        self._fte_qid = "q"
+        self._fte_attempt = 0
 
     # -- membership (grow / drain) --------------------------------------------
 
@@ -250,40 +259,157 @@ class MultiHostQueryRunner(LocalQueryRunner):
             # execute locally instead of distributing the scan
             return self._execute_local(plan)
         self.last_replans = 0
+        self.last_task_retries = 0
+        self.last_spool_hits = 0
         max_replans = get_config().remote.max_replans
-        while True:
-            check_current()  # canceled queries stop re-planning too
-            workers = self.membership.active_workers()
-            if not workers:
-                raise RuntimeError("no live workers")
-            try:
-                return self._execute_on(plan, workers)
-            except MeshChangedError as e:
-                # mesh-shrink re-planning: record the membership change,
-                # drop caches keyed by the old mesh, and re-fragment the
-                # query against the survivors (W-1).  Spooled/pull
-                # exchanges make the replay deterministic; layouts whose
-                # bucket_count no longer divides the new W lose their
-                # placement claims at re-plan time (scan_partitioning).
-                for w in e.dead:
-                    # mark_dead itself skips the breaker trip for DRAINING
-                    # workers (their exit is the drain completing by choice)
-                    self.membership.mark_dead(w)
-                    self._worker_health[w] = (_monotonic(), False)
-                for w in e.drained:
-                    self.membership.drain(w)
-                if self.last_replans >= max_replans:
-                    raise RuntimeError(
-                        f"query re-planned {self.last_replans} times without "
-                        f"a stable mesh (last change: {e})"
-                    ) from e
-                self.last_replans += 1
-                invalidate_mesh_scans()
-                from trino_tpu.telemetry.metrics import (
-                    membership_events_counter,
-                )
+        # fault-tolerant execution: fragment outputs fetched by the
+        # coordinator spool through the filesystem SPI keyed by
+        # (query_id, fragment_id, attempt_id); a mid-query worker death
+        # RETRIES the same plan on the survivors, resuming finished
+        # fragments from the spool — only lost outputs re-run.  Off (the
+        # default) keeps today's behavior: every mesh change re-plans.
+        try:
+            fte = bool(self.properties.get("fault_tolerant_execution"))
+        except KeyError:  # pragma: no cover - older property sets
+            fte = False
+        retries_left = get_config().remote.max_task_retries if fte else 0
+        plan_w: Optional[int] = None
+        if fte:
+            from trino_tpu.runtime.fte import SpoolManager
 
-                membership_events_counter().labels("shrink_replan").inc()
+            self._fte_spool = SpoolManager()
+            self._fte_completed = {}
+            self._fte_qid = f"q{next(self._task_seq)}"
+            self._fte_attempt = 0
+        try:
+            while True:
+                check_current()  # canceled queries stop re-planning too
+                workers = self.membership.active_workers()
+                if not workers:
+                    raise RuntimeError("no live workers")
+                if plan_w is None:
+                    plan_w = len(workers)
+                try:
+                    return self._execute_on(plan, workers, plan_w=plan_w)
+                except MeshChangedError as e:
+                    for w in e.dead:
+                        # mark_dead itself skips the breaker trip for
+                        # DRAINING workers (their exit is the drain
+                        # completing by choice)
+                        self.membership.mark_dead(w)
+                        self._worker_health[w] = (_monotonic(), False)
+                    for w in e.drained:
+                        self.membership.drain(w)
+                    if fte and retries_left > 0:
+                        # RETRY: same plan (same fragment ids, same bucket
+                        # counts), lost tasks re-run round-robin on the
+                        # survivors, finished coordinator-consumed
+                        # fragments resume from the spool.  Classification
+                        # comes from the per-error-code table — a
+                        # user/semantic error never lands here (it is not
+                        # a MeshChangedError to begin with).
+                        retries_left -= 1
+                        self.last_task_retries += 1
+                        self._fte_attempt += 1
+                        self._record_recovery(e, "retry", "replan")
+                        continue
+                    if fte:
+                        # the mesh kept changing past the retry budget:
+                        # the plan's worker requirement is no longer
+                        # hostable — classify as a true mesh shrink and
+                        # re-fragment at the surviving W
+                        self._record_recovery(
+                            e, "replan", "retry",
+                            code="MESH_SHRINK_BELOW_REQUIREMENT",
+                        )
+                        self._fte_completed.clear()  # fragment ids change
+                        self._fte_spool.dedup.clear(self._fte_qid)
+                    # mesh-shrink re-planning: record the membership
+                    # change, drop caches keyed by the old mesh, and
+                    # re-fragment the query against the survivors (W-1).
+                    # Spooled/pull exchanges make the replay
+                    # deterministic; layouts whose bucket_count no longer
+                    # divides the new W lose their placement claims at
+                    # re-plan time (scan_partitioning).
+                    if self.last_replans >= max_replans:
+                        raise RuntimeError(
+                            f"query re-planned {self.last_replans} times "
+                            f"without a stable mesh (last change: {e})"
+                        ) from e
+                    self.last_replans += 1
+                    plan_w = None  # re-fragment at the shrunk worker set
+                    invalidate_mesh_scans()
+                    from trino_tpu.telemetry.metrics import (
+                        membership_events_counter,
+                    )
+
+                    membership_events_counter().labels("shrink_replan").inc()
+        finally:
+            if fte:
+                self._fte_spool.close()
+                self._fte_spool = None
+                self._fte_completed = {}
+
+    def _record_recovery(self, exc: BaseException, outcome: str,
+                         alternative: str, code: Optional[str] = None) -> None:
+        """Book one recovery decision: the {outcome}-labeled retry metric
+        plus a `recovery` entry in the plan-decision ledger (PR 19)."""
+        from trino_tpu.runtime.lifecycle import error_code_of
+        from trino_tpu.telemetry.decisions import record_decision
+        from trino_tpu.telemetry.metrics import task_retries_counter
+
+        task_retries_counter().labels(outcome).inc()
+        record_decision(
+            "recovery", "remote:mesh", outcome, alternative,
+            {"error_code": code or error_code_of(exc),
+             "spooled_fragments": len(self._fte_completed)},
+        )
+
+    # -- fault-tolerant spool (coordinator side) ------------------------------
+
+    def _spool_fragment(self, fid: int, batches: list, symbols) -> None:
+        """Record one fully-fetched fragment output: spooled through the
+        filesystem SPI keyed by (query_id, fragment_id, attempt_id), so a
+        recovery pass serves it from disk instead of re-executing the
+        fragment."""
+        if self._fte_spool is None or fid in self._fte_completed:
+            return
+        from trino_tpu.telemetry.metrics import spooled_fragments_counter
+
+        dicts = (
+            [c.dictionary for c in batches[0].columns]
+            if batches else [None] * len(symbols)
+        )
+        self._fte_spool.save(
+            self._fte_qid, fid, batches, symbols,
+            attempt_id=self._fte_attempt,
+        )
+        self._fte_completed[fid] = (symbols, dicts)
+        spooled_fragments_counter().inc()
+
+    def _load_spooled_fragment(self, fid: int) -> PhysicalPlan:
+        """Rehydrate a completed fragment for a recovery pass; the FIRST
+        committed attempt wins for every consumer, duplicates are deleted
+        unread (the DeduplicatingDirectExchangeBuffer contract)."""
+        symbols, dicts = self._fte_completed[fid]
+        spool = self._fte_spool
+        att = spool.dedup.committed(self._fte_qid, fid)
+        if att is None:
+            atts = spool.attempts(self._fte_qid, fid)
+            att = spool.dedup.commit(
+                self._fte_qid, fid, atts[0] if atts else 0
+            )
+            spool.discard_duplicates(self._fte_qid, fid, att)
+        batches = spool.load(
+            self._fte_qid, fid, symbols, dicts, attempt_id=att
+        )
+        if batches is None:
+            # the spool file itself was lost: this fragment's output is
+            # gone, so it re-runs like any other lost task
+            del self._fte_completed[fid]
+            return None
+        self.last_spool_hits += 1
+        return PhysicalPlan(iter(batches), symbols)
 
     @staticmethod
     def _system_only(plan) -> bool:
@@ -304,19 +430,25 @@ class MultiHostQueryRunner(LocalQueryRunner):
         self._check_table_access(plan)
         return self._execute_plan(plan)
 
-    def _execute_on(self, plan, workers: list) -> MaterializedResult:
+    def _execute_on(self, plan, workers: list,
+                    plan_w: Optional[int] = None) -> MaterializedResult:
         """One scheduling attempt against a FIXED worker set (the mesh a
-        membership change never mutates — it re-plans instead)."""
+        membership change never mutates — it re-plans instead).  Under
+        fault-tolerant recovery `plan_w` keeps the ORIGINAL fragmentation
+        width: the same plan (same fragment ids, same bucket counts)
+        re-executes with its plan_w task slots placed round-robin on the
+        survivors, so spooled fragment outputs stay addressable."""
         self.last_plan_workers = list(workers)
+        w = plan_w or len(workers)
         # colocate=False: HTTP workers shard scans by split_mod, not by the
         # exchange hash — layout placements would be claims the data plane
         # does not realize (the in-process mesh runner is the elision home)
         dplan = add_exchanges(
             plan, self.catalogs, self.properties,
-            n_workers=len(workers), colocate=False,
+            n_workers=w, colocate=False,
         )
         sub = create_subplans(dplan, properties=self.properties)
-        sched = _StageScheduler(self, workers)
+        sched = _StageScheduler(self, workers, plan_w=w)
         try:
             with self._tracer.span("execute"):
                 out = sched.run(sub)
@@ -354,7 +486,8 @@ class _StageScheduler:
     worker (the task re-reads its splits/inputs — deterministic replay, the
     EventDrivenFaultTolerantQueryScheduler retry property)."""
 
-    def __init__(self, runner: MultiHostQueryRunner, workers=None):
+    def __init__(self, runner: MultiHostQueryRunner, workers=None,
+                 plan_w: Optional[int] = None):
         self.runner = runner
         candidates = list(
             runner.worker_urls if workers is None else workers
@@ -370,6 +503,11 @@ class _StageScheduler:
         self.workers = candidates
         if not self.workers:
             raise RuntimeError("no live workers")
+        #: the plan's fragmentation width (task slots per distributed
+        #: stage, output bucket counts).  Equals len(workers) on a fresh
+        #: plan; a fault-tolerant RECOVERY pass keeps the original width
+        #: and places slots round-robin on the survivors.
+        self.plan_w = plan_w or len(self.workers)
         #: fragment_id -> list[RemoteTaskClient] (producing tasks)
         self._stage_tasks: dict[int, list] = {}
         #: fragment_id -> {probe symbol name: (lo, hi)} awaiting delivery
@@ -647,6 +785,17 @@ class _StageScheduler:
         fid = sub.fragment.id
         if fid in self._stage_tasks:
             return self._stage_tasks[fid]
+        if fid in self.runner._fte_completed:
+            # fault-tolerant recovery: this fragment finished on an
+            # earlier attempt and its output is spooled — serve it from
+            # disk, and do NOT recurse into its children (finished
+            # upstream fragments are never re-executed: the Tardigrade
+            # property the spool buys).  A lost spool file falls through
+            # to normal re-execution.
+            spooled = self.runner._load_spooled_fragment(fid)
+            if spooled is not None:
+                self._stage_tasks[fid] = _LocalResult(spooled)
+                return self._stage_tasks[fid]
         self._collect_dynamic_filters(sub)
         for child in sub.children:
             self._ensure_stage(child)
@@ -656,7 +805,7 @@ class _StageScheduler:
             out = self._coordinator_fragment(sub)
             self._stage_tasks[fid] = _LocalResult(out)
             return self._stage_tasks[fid]
-        w = len(self.workers)
+        w = self.plan_w
         tasks = []
         # tasks inherit what's left of the query deadline: a worker bounds
         # its own run AND its input-pull timeouts by it, so no task outlives
@@ -677,7 +826,11 @@ class _StageScheduler:
             )
             self._fragment_spans[fid] = fsp
             trace_context = (self.tracer.query_id, fsp.span_id)
-        for i, url in enumerate(self.workers):
+        # plan_w task slots round-robin over the (possibly fewer) live
+        # workers: a recovery pass keeps the fragmentation width, so a
+        # survivor may host more than one slot of a stage
+        for i in range(w):
+            url = self.workers[i % len(self.workers)]
             desc = TaskDescriptor(
                 task_id=f"t{next(self.runner._task_seq)}_f{fid}_w{i}",
                 fragment_root=sub.fragment.root,
@@ -791,7 +944,7 @@ class _StageScheduler:
                 if o.name == s.name:
                     chans.append(i)
                     break
-        return (chans, len(self.workers))
+        return (chans, self.plan_w)
 
     def _parent_remote(self, sub: SubPlan) -> Optional[RemoteSourceNode]:
         target = sub.fragment.id
@@ -868,6 +1021,14 @@ class _StageScheduler:
                     batches.extend(bs)
                 if node.exchange_kind == "merge":
                     return sched._merge(per_producer, node)
+                # the fragment's output is fully fetched: spool it (no-op
+                # unless fault_tolerant_execution) so a recovery pass
+                # resumes from here instead of re-executing the fragment.
+                # Merge exchanges skip the spool: their consumption is
+                # per-producer ordered, not a flat batch list.
+                sched.runner._spool_fragment(
+                    node.fragment_id, batches, node.symbols
+                )
                 return PhysicalPlan(iter(batches), node.symbols)
             return saved(node)
 

@@ -226,7 +226,7 @@ class DistributedQueryRunner(LocalQueryRunner):
         n_workers: Optional[int] = None,
         devices=None,
     ):
-        from trino_tpu.runtime.fte import HeartbeatFailureDetector
+        from trino_tpu.runtime.membership import HeartbeatFailureDetector
 
         super().__init__(catalogs, catalog=catalog, schema=schema)
         #: device pool resize_mesh slices from (None = jax.devices())
@@ -415,9 +415,19 @@ class StageExecutor:
         #: per-stage elapsed bookkeeping so fragment walls are SELF time
         self._frame_stack: list[dict] = []
         self._trace_base = (TRACE_CACHE.hits, TRACE_CACHE.misses, TRACE_CACHE.retraces)
-        self.retry_task = properties.get("retry_policy") == "TASK"
+        try:
+            self.fte = bool(properties.get("fault_tolerant_execution"))
+        except KeyError:  # pragma: no cover - older property sets
+            self.fte = False
+        # fault_tolerant_execution implies the TASK machinery: stage
+        # outputs spool, stages retry individually, consumers dedup
+        self.retry_task = (
+            properties.get("retry_policy") == "TASK" or self.fte
+        )
         self.spool = None
         self._spool_meta: dict[int, tuple] = {}
+        #: duplicate spooled attempts discarded by consumer-side dedup
+        self.dedup_discards = 0
         #: cross-fragment dynamic filters (reference:
         #: server/DynamicFilterService.java:107): probe symbol name ->
         #: (lo, hi) build-side key range, registered when a build fragment
@@ -658,7 +668,7 @@ class StageExecutor:
                         self._fragment_result(cfid)
                         self.profile.bump("collective_async")
                         collective_async_counter().inc()
-                for _ in range(attempts):
+                for attempt in range(attempts):
                     check_current()  # fragment-boundary cancellation point
                     try:
                         FAILURE_INJECTOR.maybe_fail(f"stage:{fid}")
@@ -670,13 +680,20 @@ class StageExecutor:
                         # fires after the body ran (children memoized/
                         # spooled): a failure here retries ONLY this stage
                         FAILURE_INJECTOR.maybe_fail(f"stage:{fid}:finish")
-                        self._spool(fid, res)
+                        self._spool(fid, res, attempt)
+                        # fires after the attempt's output is durably
+                        # spooled: a failure here makes the RETRY spool a
+                        # duplicate attempt, exercising consumer dedup
+                        FAILURE_INJECTOR.maybe_fail(f"stage:{fid}:spooled")
                         return res
                     except RETRYABLE as e:
                         last = e
+                        if self.retry_task and attempt + 1 < attempts:
+                            self._record_recovery(fid, e, "retry")
                 if not self.retry_task:
                     # keep the original (QUERY-level-retryable) error
                     raise last
+                self._record_recovery(fid, last, "fail")
                 raise StageFailedException(
                     f"stage {fid} failed after {attempts} attempts: {last}"
                 ) from last
@@ -690,17 +707,38 @@ class StageExecutor:
 
     # -- spooled stage outputs (ExchangeManager role) -------------------------
 
-    def _spool(self, fid: int, res) -> None:
-        """Persist a distributed stage's output host-side.  Only _Dist
-        results spool: a stacked batch shares one dictionary per column
-        across workers, so rehydration is exact; SINGLE-fragment host
-        results already live host-side and stay in the memo."""
+    def _record_recovery(self, fid: int, exc: BaseException,
+                         outcome: str) -> None:
+        """Book one task-recovery decision: the {outcome} retry metric plus
+        a `recovery` entry in the plan-decision ledger (PR 19), so chaos
+        runs show WHAT the engine decided per failure, not just that the
+        query survived."""
+        from trino_tpu.runtime.lifecycle import error_code_of
+        from trino_tpu.telemetry.decisions import record_decision
+        from trino_tpu.telemetry.metrics import task_retries_counter
+
+        task_retries_counter().labels(outcome).inc()
+        record_decision(
+            "recovery", f"stage:{fid}", outcome,
+            "fail" if outcome == "retry" else "retry",
+            {"error_code": error_code_of(exc), "fragment": int(fid)},
+        )
+
+    def _spool(self, fid: int, res, attempt_id: int = 0) -> None:
+        """Persist a distributed stage's output host-side, keyed by the
+        attempt that produced it.  Only _Dist results spool: a stacked
+        batch shares one dictionary per column across workers, so
+        rehydration is exact; SINGLE-fragment host results already live
+        host-side and stay in the memo."""
         if self.spool is None or not isinstance(res, _Dist):
             return
+        from trino_tpu.telemetry.metrics import spooled_fragments_counter
+
         stacked = res.stacked  # deferred chain runs as its own phase
         with self.profile.phase(fid, "transfer"):
             host = device_get_async(stacked)  # lint: allow(host-transfer)
         self.profile.bump("spool_write")
+        spooled_fragments_counter().inc()
         self.profile.fragment(fid).bytes_to_host += batch_bytes(host)
         # full-capacity per-worker shards, masks included (the spooled
         # page files of FileSystemExchangeSink)
@@ -711,16 +749,32 @@ class StageExecutor:
         dicts = (
             [c.dictionary for c in shards[0].columns] if shards else []
         )
-        self.spool.save(self.query_id, fid, shards, res.symbols)
+        self.spool.save(
+            self.query_id, fid, shards, res.symbols, attempt_id=attempt_id
+        )
         self._spool_meta[fid] = (
             res.symbols, dicts, res.placements, res.realigned
         )
 
     def _load_spooled(self, fid: int) -> "_Dist":
         # spooled shards rehydrate worker-for-worker, so the stage output's
-        # placements survive the host round-trip
+        # placements survive the host round-trip.  Consumer-side dedup
+        # (DeduplicatingDirectExchangeBuffer): the FIRST committed attempt
+        # wins for every consumer of this fragment, and the losing
+        # duplicate attempts are deleted unread
         symbols, dicts, placements, realigned = self._spool_meta[fid]
-        shards = self.spool.load(self.query_id, fid, symbols, dicts)
+        att = self.spool.dedup.committed(self.query_id, fid)
+        if att is None:
+            atts = self.spool.attempts(self.query_id, fid)
+            att = self.spool.dedup.commit(
+                self.query_id, fid, atts[0] if atts else 0
+            )
+            self.dedup_discards += self.spool.discard_duplicates(
+                self.query_id, fid, att
+            )
+        shards = self.spool.load(
+            self.query_id, fid, symbols, dicts, attempt_id=att
+        )
         self.profile.bump("spool_read")
         return self._dist(
             stack_batches(shards, self.wm), symbols, placements=placements,

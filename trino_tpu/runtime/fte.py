@@ -4,32 +4,83 @@ Reference: execution/scheduler/EventDrivenFaultTolerantQueryScheduler.java
 (stage-by-stage execution with replayable intermediates),
 core/trino-spi/.../spi/exchange/ExchangeManager.java:42 +
 plugin/trino-exchange-filesystem (spooled exchange storage),
-failuredetector/HeartbeatFailureDetector.java:78.
+execution/DeduplicatingDirectExchangeBuffer (exactly-once consumption of
+speculative/duplicate task attempts), and
+failuredetector/HeartbeatFailureDetector.java:78 — the detector itself now
+lives in runtime/membership (one implementation, sticky death, breaker
+integration); the alias below keeps this module's import surface.
 
 TPU mapping: a "task" is one fragment execution over the mesh; its output
 (a stacked device batch or host batches) is the replayable unit.  The spool
-persists fragment outputs host-side (npz files), so a failed downstream
-fragment retries WITHOUT re-running its finished children — the
-EventDriven scheduler's core property.  The heartbeat detector watches
-worker liveness the coordinator-side way; with in-process mesh workers it
-guards the host feeder threads and remote (server-mode) workers.
+persists fragment outputs host-side (npz files) keyed by
+``(query_id, fragment_id, attempt_id)``, so a failed downstream fragment —
+or a whole recovery pass after a worker death — retries WITHOUT re-running
+its finished children (the EventDriven scheduler's core property).  Writes
+are crash-atomic (a ``.tmp`` sibling renamed through the filesystem SPI):
+a writer killed mid-save can never leave a torn ``.npz`` that a retrying
+consumer would load.  Duplicate attempt outputs are deduplicated at the
+CONSUMER: ``AttemptDedup`` commits exactly one attempt per fragment, and
+every other attempt's output is discarded unread.
 """
 
 from __future__ import annotations
 
 import os
+import re
 import tempfile
+import threading
 import time
 from typing import Callable, Optional
 
 import numpy as np
 
+# the ONE heartbeat failure detector (unified into runtime/membership —
+# timeout facade over ClusterMembership with sticky death + breaker
+# integration); re-exported here for the module's historical import surface
+from trino_tpu.runtime.membership import (  # noqa: F401
+    HeartbeatFailureDetector,
+)
 
 #: spool files older than this are orphans (their query is long gone — a
 #: crashed coordinator never reaches SpoolManager.close); swept on
 #: construction of any manager sharing the directory (reference:
 #: FileSystemExchangeManager's exchange-directory cleanup on startup)
 SPOOL_ORPHAN_MAX_AGE_S = 6 * 3600.0
+
+#: committed spool filename shape: {query_id}_f{fid}.npz for attempt 0
+#: (the historical name, shared with the spill tier) and
+#: {query_id}_f{fid}_a{attempt}.npz for retry attempts
+_ATTEMPT_RE = re.compile(r"_f(\d+)(?:_a(\d+))?\.npz$")
+
+
+class AttemptDedup:
+    """Consumer-side exactly-once attempt selection (reference:
+    DeduplicatingDirectExchangeBuffer): speculative or duplicate task
+    attempts may each spool an output for the same ``(query_id,
+    fragment_id)``; the FIRST attempt a consumer commits wins, every
+    consumer thereafter reads that same attempt, and the duplicates are
+    discarded unread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._committed: dict[tuple, int] = {}
+
+    def commit(self, query_id: str, fragment_id: int, attempt_id: int) -> int:
+        """Commit an attempt for consumption; returns the attempt EVERY
+        consumer must read (the first committed one — a later speculative
+        attempt's commit is a no-op and is told which attempt won)."""
+        key = (query_id, int(fragment_id))
+        with self._lock:
+            return self._committed.setdefault(key, int(attempt_id))
+
+    def committed(self, query_id: str, fragment_id: int) -> Optional[int]:
+        with self._lock:
+            return self._committed.get((query_id, int(fragment_id)))
+
+    def clear(self, query_id: str) -> None:
+        with self._lock:
+            for key in [k for k in self._committed if k[0] == query_id]:
+                del self._committed[key]
 
 
 class SpoolManager:
@@ -53,17 +104,32 @@ class SpoolManager:
             directory or tempfile.mkdtemp(prefix="trino_tpu_spool_")
         )
         self.fs.mkdirs(self.dir)
+        #: exactly-once attempt selection for this spool's consumers
+        self.dedup = AttemptDedup()
         if not self._own:
-            # a SHARED directory accumulates {qid}_f{fid}.npz orphans from
-            # queries that crashed before close(); sweep them by age so the
-            # spool volume is bounded by live work, not by failure history
+            # a SHARED directory accumulates {qid}_f{fid}[_a{n}].npz
+            # orphans from queries that crashed before close(); sweep them
+            # by age so the spool volume is bounded by live work, not by
+            # failure history
             self.gc(orphan_max_age_s)
 
-    def _path(self, query_id: str, fragment_id: int) -> str:
-        return os.path.join(self.dir, f"{query_id}_f{fragment_id}.npz")
+    def _path(
+        self, query_id: str, fragment_id: int, attempt_id: int = 0
+    ) -> str:
+        suffix = f"_a{attempt_id}" if attempt_id else ""
+        return os.path.join(
+            self.dir, f"{query_id}_f{fragment_id}{suffix}.npz"
+        )
 
-    def save(self, query_id: str, fragment_id: int, batches, symbols) -> str:
-        """Spool host batches (list of Batch) for one fragment."""
+    def save(self, query_id: str, fragment_id: int, batches, symbols,
+             attempt_id: int = 0) -> str:
+        """Spool host batches (list of Batch) for one fragment attempt.
+
+        CRASH-ATOMIC: the npz streams into a ``.tmp`` sibling and is
+        renamed into place through the filesystem SPI (one atomic
+        ``os.replace`` on the local implementation) — a writer killed
+        mid-save leaves at worst a ``.tmp`` the GC sweeps, never a torn
+        ``.npz`` a retrying consumer would load."""
         arrays: dict = {"__nbatches__": np.asarray(len(batches))}
         for bi, b in enumerate(batches):
             arrays[f"b{bi}_mask"] = np.asarray(b.mask())
@@ -75,12 +141,24 @@ class SpoolManager:
                     # array columns: per-row element counts ride along so a
                     # spilled/spooled batch rehydrates exactly
                     arrays[f"b{bi}_c{ci}_len"] = np.asarray(c.lengths)
-        path = self._path(query_id, fragment_id)
-        with self.fs.open_output(path) as f:  # streaming: no double-buffer
-            np.savez(f, **arrays)
+        path = self._path(query_id, fragment_id, attempt_id)
+        tmp = path + ".tmp"
+        try:
+            with self.fs.open_output(tmp) as f:  # streaming: no double-buffer
+                np.savez(f, **arrays)
+        except BaseException:
+            # a failed/killed write must not leave the torn sibling behind
+            # for the next writer to trip on
+            try:
+                self.fs.delete(tmp)
+            except OSError:
+                pass
+            raise
+        self.fs.rename(tmp, path)
         return path
 
-    def load(self, query_id: str, fragment_id: int, symbols, dictionaries):
+    def load(self, query_id: str, fragment_id: int, symbols, dictionaries,
+             attempt_id: int = 0):
         """Rehydrate spooled batches (schema from the fragment's symbols).
 
         `dictionaries` is validated against the stored codes instead of
@@ -89,7 +167,7 @@ class SpoolManager:
         load beats corrupt results downstream."""
         from trino_tpu.columnar import Batch, Column
 
-        path = self._path(query_id, fragment_id)
+        path = self._path(query_id, fragment_id, attempt_id)
         if not self.fs.exists(path):
             return None
         if len(dictionaries) != len(symbols):
@@ -126,20 +204,55 @@ class SpoolManager:
             out.append(Batch(cols, mask))
         return out
 
-    def exists(self, query_id: str, fragment_id: int) -> bool:
-        return self.fs.exists(self._path(query_id, fragment_id))
+    def exists(self, query_id: str, fragment_id: int,
+               attempt_id: int = 0) -> bool:
+        return self.fs.exists(self._path(query_id, fragment_id, attempt_id))
+
+    def attempts(self, query_id: str, fragment_id: int) -> list:
+        """Committed (fully renamed) attempt ids spooled for a fragment,
+        ascending.  ``.tmp`` siblings are invisible by construction — an
+        attempt only appears here after its atomic rename."""
+        prefix = f"{query_id}_f"
+        out = []
+        for p in list(self.fs.list(self.dir)):
+            name = os.path.basename(p)
+            if not name.startswith(prefix):
+                continue
+            m = _ATTEMPT_RE.search(name)
+            if m is None or int(m.group(1)) != int(fragment_id):
+                continue
+            out.append(int(m.group(2) or 0))
+        return sorted(out)
+
+    def discard_duplicates(self, query_id: str, fragment_id: int,
+                           keep_attempt: int) -> int:
+        """Delete every spooled attempt EXCEPT the committed one (the
+        DeduplicatingDirectExchangeBuffer discard: duplicate/speculative
+        outputs must never be consumed, and holding them costs spool
+        volume).  Returns the number of duplicates removed."""
+        removed = 0
+        for att in self.attempts(query_id, fragment_id):
+            if att == keep_attempt:
+                continue
+            try:
+                self.fs.delete(self._path(query_id, fragment_id, att))
+                removed += 1
+            except OSError:
+                continue
+        return removed
 
     def gc(self, max_age_s: float) -> list:
         """Delete spool files not modified within `max_age_s` seconds;
         returns the paths removed.  Age-based (not liveness-based) on
         purpose: the writer may be a coordinator in another process, so
-        mtime is the only signal every deployment shape shares.  All IO
-        (list/mtime/delete) rides the filesystem SPI, so GC follows the
-        spool to whatever storage implementation hosts it."""
+        mtime is the only signal every deployment shape shares.  Torn
+        ``.npz.tmp`` siblings (a writer killed mid-save) age out the same
+        way.  All IO (list/mtime/delete) rides the filesystem SPI, so GC
+        follows the spool to whatever storage implementation hosts it."""
         cutoff = self.clock() - max_age_s
         removed = []
         for p in list(self.fs.list(self.dir)):
-            if not p.endswith(".npz"):
+            if not (p.endswith(".npz") or p.endswith(".npz.tmp")):
                 continue  # never touch files the spool didn't write
             try:
                 if self.fs.mtime(p) < cutoff:
@@ -158,53 +271,3 @@ class SpoolManager:
             for p in list(self.fs.list(self.dir)):
                 self.fs.delete(p)
             self.fs.delete_recursive(self.dir)
-
-
-class HeartbeatFailureDetector:
-    """Coordinator-side liveness tracking (reference:
-    failuredetector/HeartbeatFailureDetector.java:78, ping():350): workers
-    heartbeat; ones silent past the threshold are marked failed and excluded
-    from scheduling."""
-
-    def __init__(self, timeout_s: float = 10.0, clock: Callable[[], float] = time.monotonic):
-        self.timeout_s = timeout_s
-        self.clock = clock
-        self._last: dict[str, float] = {}
-        self._failed: set[str] = set()
-
-    def register(self, worker: str) -> None:
-        self._last[worker] = self.clock()
-        self._failed.discard(worker)
-
-    def unregister(self, worker: str) -> None:
-        """Forget a worker entirely (a mesh SHRINK removes it by intent —
-        the stale entry must not time out and fail liveness checks that no
-        longer concern it)."""
-        self._last.pop(worker, None)
-        self._failed.discard(worker)
-
-    def heartbeat(self, worker: str) -> None:
-        self._last[worker] = self.clock()
-        self._failed.discard(worker)
-
-    def refresh(self) -> None:
-        now = self.clock()
-        # snapshot: concurrent heartbeat()/register() calls resize the dict
-        # mid-iteration (RuntimeError under load).  dict.copy() is one
-        # atomic C-level operation under the GIL; list(items()) is NOT —
-        # its iteration can still observe the resize
-        for w, t in self._last.copy().items():
-            if now - t > self.timeout_s:
-                self._failed.add(w)
-
-    def failed_workers(self) -> set:
-        self.refresh()
-        return set(self._failed)
-
-    def active_workers(self) -> list:
-        self.refresh()
-        return sorted(w for w in self._last if w not in self._failed)
-
-    def is_alive(self, worker: str) -> bool:
-        self.refresh()
-        return worker in self._last and worker not in self._failed

@@ -76,6 +76,79 @@ _REASON_EXC = {
 }
 
 
+# -- task-recovery classification (fault-tolerant execution) -------------------
+
+#: recovery action vocabulary (the {outcome} label of
+#: trino_tpu_task_retries_total and the `recovery` decision kind)
+RETRY = "retry"
+REPLAN = "replan"
+FAIL = "fail"
+
+#: per-error-code recovery classification (reference: the retry-type
+#: predicate split of EventDrivenFaultTolerantQueryScheduler — worker
+#: failures re-run only the lost tasks; user errors are never retried).
+#:
+#:   retry  — same plan, lost tasks only: the mesh signature the plan was
+#:            fragmented for still has live hosts, finished fragments
+#:            resume from spooled intermediates, only lost outputs re-run.
+#:   replan — the mesh signature truly changed (survivors cannot host the
+#:            plan's fragments): re-fragment the query at the shrunk W.
+#:   fail   — user/semantic errors: retrying re-raises the same error, so
+#:            the classification NEVER retries them.  Unknown codes
+#:            default here too — an unclassified error is not evidence of
+#:            a lost task.
+RECOVERY_CLASSIFICATION = {
+    # lost tasks: the work is retryable, the plan is not at fault
+    "WORKER_DEATH": RETRY,
+    "WORKER_DRAIN": RETRY,
+    "TRANSIENT_FETCH": RETRY,
+    # the mesh the plan was fragmented for no longer exists
+    "MESH_SHRINK_BELOW_REQUIREMENT": REPLAN,
+    # user/semantic: retrying cannot change the outcome
+    "USER_CANCELED": FAIL,
+    "EXCEEDED_TIME_LIMIT": FAIL,
+    "EXCEEDED_QUEUED_TIME_LIMIT": FAIL,
+    "CLUSTER_OUT_OF_MEMORY": FAIL,
+    "ABORTED": FAIL,
+    "STAGE_FAILED": FAIL,
+    "INTERNAL_ERROR": FAIL,
+}
+
+
+def error_code_of(exc: BaseException) -> str:
+    """Classify an exception into the recovery table's error-code
+    vocabulary (lifecycle aborts carry their own code; infrastructure
+    failures map onto worker-death/drain/transient-fetch)."""
+    if isinstance(exc, QueryAbortedException):
+        return exc.error_code
+    # local import: membership imports retry/metrics at call time itself,
+    # and lifecycle must stay importable first
+    from trino_tpu.runtime.membership import (
+        MeshChangedError,
+        WorkerDrainingError,
+    )
+    from trino_tpu.runtime.retry import StageFailedException
+
+    if isinstance(exc, MeshChangedError):
+        if exc.drained and not exc.dead:
+            return "WORKER_DRAIN"
+        return "WORKER_DEATH"
+    if isinstance(exc, StageFailedException):
+        return "STAGE_FAILED"
+    if isinstance(exc, WorkerDrainingError):
+        return "WORKER_DRAIN"
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return "TRANSIENT_FETCH"
+    return "INTERNAL_ERROR"
+
+
+def recovery_action(exc: BaseException) -> str:
+    """The classified recovery action for an error (`retry` | `replan` |
+    `fail`); unknown codes fail — an unclassified error is never
+    retried."""
+    return RECOVERY_CLASSIFICATION.get(error_code_of(exc), FAIL)
+
+
 # -- state machine ------------------------------------------------------------
 
 QUEUED = "QUEUED"

@@ -177,6 +177,17 @@ class ClusterMembership:
             e.last_heartbeat = self.clock()
             e.misses = 0
 
+    def forget(self, worker_id: str) -> None:
+        """Remove a worker from the registry entirely (a mesh SHRINK drops
+        it by intent — a stale entry must not time out later and fail
+        liveness checks that no longer concern it).  Unlike death this is
+        not a failure event: no breaker trip, no death metric — only the
+        liveness gauge drops."""
+        with self._lock:
+            if self._workers.pop(worker_id, None) is None:
+                return
+        self._set_alive(worker_id, 0)
+
     def miss(self, worker_id: str) -> int:
         """A failed probe; returns the consecutive-miss count."""
         with self._lock:
@@ -207,6 +218,18 @@ class ClusterMembership:
         """Workers the detector should ping (everything not DEAD)."""
         with self._lock:
             return [w for w, e in self._workers.items() if e.state != DEAD]
+
+    def entries(self) -> list:
+        """Point-in-time (worker_id, state, last_heartbeat) triples.  The
+        list is built UNDER the lock, so callers iterate a stable snapshot
+        — the fte detector's old dict.copy() refresh-race fix, subsumed by
+        the registry lock (a concurrent heartbeat/register can never
+        resize the dict mid-iteration here)."""
+        with self._lock:
+            return [
+                (w, e.state, e.last_heartbeat)
+                for w, e in self._workers.items()
+            ]
 
     def snapshot(self) -> list:
         """system.runtime.nodes feed: (worker id, state, seconds since the
@@ -379,6 +402,92 @@ class HeartbeatDetector:
         with self._loop_lock:
             self._stop.set()
             self._thread = None
+
+
+class HeartbeatFailureDetector:
+    """Timeout-based liveness facade over a ``ClusterMembership`` — THE
+    heartbeat failure detector (reference:
+    failuredetector/HeartbeatFailureDetector.java:78, ping():350).
+
+    This unifies the duplicate detector ``runtime/fte.py`` used to carry:
+    the in-process mesh runner's timeout-based API (register / heartbeat /
+    failed_workers / active_workers) is preserved, but the state now lives
+    in the membership registry, so the mesh runner inherits sticky death,
+    breaker integration (``mark_dead`` trips the worker's breaker OPEN),
+    and the lock-guarded snapshot iteration that subsumed the old
+    ``dict.copy()`` refresh-race fix (see ``ClusterMembership.entries``).
+
+    Semantics preserved from the old detector:
+
+      * a worker silent past ``timeout_s`` fails liveness checks,
+      * a fresh ``heartbeat`` from a failed worker RECOVERS it — mapped to
+        ``register`` (a worker-originated announce is the explicit rejoin
+        intent sticky death requires; a mere probe success still cannot
+        resurrect a DEAD worker, because probes route through
+        ``ClusterMembership.heartbeat`` which keeps DEAD sticky),
+      * ``unregister`` forgets a worker entirely (mesh shrink by intent).
+    """
+
+    def __init__(
+        self,
+        timeout_s: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+        membership: Optional[ClusterMembership] = None,
+    ):
+        self.timeout_s = timeout_s
+        # an explicitly provided membership keeps ITS clock; otherwise the
+        # facade's clock argument seeds the registry it creates
+        self.membership = (
+            membership if membership is not None
+            else ClusterMembership(clock=clock)
+        )
+
+    # the detector and its registry share ONE time source (the old
+    # detector's semantics): overriding `detector.clock` must move the
+    # heartbeat timestamps too, or a test-shifted clock would mark every
+    # worker stale the instant it heartbeats
+    @property
+    def clock(self) -> Callable[[], float]:
+        return self.membership.clock
+
+    @clock.setter
+    def clock(self, fn: Callable[[], float]) -> None:
+        self.membership.clock = fn
+
+    def register(self, worker: str) -> None:
+        self.membership.register(worker)
+
+    def unregister(self, worker: str) -> None:
+        self.membership.forget(worker)
+
+    def heartbeat(self, worker: str) -> None:
+        # a worker-originated announce: refreshes a live worker, rejoins a
+        # DEAD one (registration is the explicit resurrection intent)
+        self.membership.register(worker)
+
+    def refresh(self) -> None:
+        """Mark every worker silent past the timeout DEAD (sticky; trips
+        its breaker).  Iterates a lock-built snapshot — see entries()."""
+        now = self.clock()
+        for w, state, last in self.membership.entries():
+            if state != DEAD and now - last > self.timeout_s:
+                self.membership.mark_dead(w)
+
+    def failed_workers(self) -> set:
+        self.refresh()
+        return {
+            w for w, state, _ in self.membership.entries() if state == DEAD
+        }
+
+    def active_workers(self) -> list:
+        self.refresh()
+        return sorted(
+            w for w, state, _ in self.membership.entries() if state == ACTIVE
+        )
+
+    def is_alive(self, worker: str) -> bool:
+        self.refresh()
+        return self.membership.state(worker) in (ACTIVE, DRAINING)
 
 
 # -- mesh-signature cache invalidation -----------------------------------------

@@ -352,9 +352,17 @@ class LocalQueryRunner:
             ctx.begin()
             with tracer.span("query", query_id=qid, sql=sql[:200]):
                 self._record_queue_span(tracer)
-                result = execute_with_retry(
-                    lambda: m(stmt), self.properties.get("retry_policy")
-                )
+                # fault_tolerant_execution implies per-task retry: the
+                # spool/dedup machinery only engages under the TASK policy,
+                # so the session flag promotes NONE -> TASK (an explicit
+                # QUERY policy wins — the user asked for whole-query rerun)
+                policy = self.properties.get("retry_policy")
+                if (
+                    policy == "NONE"
+                    and self.properties.get("fault_tolerant_execution")
+                ):
+                    policy = "TASK"
+                result = execute_with_retry(lambda: m(stmt), policy)
             ctx.finish()
         except BaseException as e:
             end = _time.time()

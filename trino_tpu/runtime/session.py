@@ -106,6 +106,18 @@ SESSION_PROPERTIES: dict[str, PropertyMetadata] = {
             "NONE",
         ),
         PropertyMetadata(
+            "fault_tolerant_execution",
+            "spool completed fragment outputs through the filesystem SPI "
+            "keyed by (query_id, fragment_id, attempt_id) so a mid-query "
+            "worker death resumes from spooled intermediates: only "
+            "fragments whose outputs are lost re-run, duplicate attempt "
+            "outputs are deduplicated at the consumer (reference: "
+            "RetryPolicy.TASK + DeduplicatingDirectExchangeBuffer; false "
+            "= today's behavior, retry_policy alone decides)",
+            bool,
+            False,
+        ),
+        PropertyMetadata(
             "scan_cache",
             "serve immutable splits from the host/device buffer pool",
             bool,

@@ -61,6 +61,11 @@ DECISION_KINDS = (
     "schedule_license",
     "wave",
     "exchange",
+    # task-recovery classification (runtime/lifecycle RECOVERY table):
+    # retry (same plan, lost tasks only) vs replan (mesh signature truly
+    # changed) vs fail (user/semantic — never retried), recorded with the
+    # error code and mesh evidence the classifier saw
+    "recovery",
 )
 
 #: hindsight vocabulary (the {hindsight} label)

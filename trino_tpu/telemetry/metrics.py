@@ -434,6 +434,7 @@ PLAN_DECISION_SERIES = (
     ("schedule_license", ("async", "sync")),
     ("wave", ("waves",)),
     ("exchange", ("repartition", "broadcast", "gather", "merge", "elide")),
+    ("recovery", ("retry", "replan", "fail")),
 )
 
 PLAN_DECISION_HINDSIGHT = ("pending", "vindicated", "regret", "unmeasured")
@@ -442,6 +443,12 @@ PLAN_DECISION_HINDSIGHT = ("pending", "vindicated", "regret", "unmeasured")
 #: membership transition vocabulary, pre-registered so scrapes see
 #: join/drain/death at 0 before any transition fires
 MEMBERSHIP_EVENT_KINDS = ("join", "drain", "death", "rejoin", "shrink_replan")
+
+
+#: task-recovery classification vocabulary (the FTE retry-vs-replan-vs-
+#: fail table in runtime/lifecycle), pre-registered so the chaos gate
+#: reads real zeros for the outcomes that must NOT fire
+TASK_RETRY_OUTCOMES = ("retry", "replan", "fail")
 
 
 #: resource groups pre-registered on the serving metrics so scrapes see
@@ -605,6 +612,23 @@ def _register_engine_metrics(reg: MetricsRegistry) -> None:
         "per-worker liveness from the heartbeat failure detector "
         "(1 = ACTIVE/DRAINING, 0 = DEAD)",
         labelnames=("worker",),
+    )
+    retries = reg.counter(
+        _PREFIX + "task_retries_total",
+        "task-level recovery classifications under fault-tolerant "
+        "execution, by outcome (retry = same plan, lost tasks re-run from "
+        "spooled intermediates; replan = mesh signature truly changed, "
+        "re-fragment at the shrunk W; fail = user/semantic error, never "
+        "retried)",
+        labelnames=("outcome",),
+    )
+    for outcome in TASK_RETRY_OUTCOMES:
+        retries.touch(outcome)
+    reg.counter(
+        _PREFIX + "spooled_fragments_total",
+        "fragment outputs spooled through the filesystem SPI keyed by "
+        "(query_id, fragment_id, attempt_id); zero when "
+        "fault_tolerant_execution is off and retry_policy is not TASK",
     )
     prewarm = reg.counter(
         _PREFIX + "prewarm_runs_total",
@@ -902,6 +926,23 @@ def membership_events_counter() -> Counter:
 def worker_alive_gauge() -> Gauge:
     """Per-worker liveness set by the heartbeat failure detector."""
     return REGISTRY.gauge(_PREFIX + "worker_alive")
+
+
+def task_retries_counter() -> Counter:
+    """Task-level recovery classifications (runtime FTE), labeled
+    outcome=retry (same plan, lost tasks only) | replan (mesh signature
+    truly changed: re-fragment at the shrunk W) | fail (user/semantic —
+    never retried).  The chaos gate reads this: a retryable worker kill
+    under fault_tolerant_execution must bump retry and leave replan/fail
+    untouched."""
+    return REGISTRY.counter(_PREFIX + "task_retries_total")
+
+
+def spooled_fragments_counter() -> Counter:
+    """Fragment outputs spooled through the filesystem SPI keyed by
+    (query_id, fragment_id, attempt_id) — the replayable intermediates a
+    recovery pass resumes from instead of re-running finished stages."""
+    return REGISTRY.counter(_PREFIX + "spooled_fragments_total")
 
 
 def compile_seconds_histogram() -> Histogram:
